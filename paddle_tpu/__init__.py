@@ -1,0 +1,85 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capability surface, built on JAX/XLA/Pallas.
+
+Structural map vs the reference (see SURVEY.md):
+  L0-L1 (device/kernels)  -> XLA:TPU + Pallas kernels (paddle_tpu/kernels)
+  L2    (eager autograd)  -> jax.vjp tape (paddle_tpu/autograd)
+  L3-L4 (IR/executor/CINN)-> jit-compiled HLO (paddle_tpu/jit)
+  L5-L6 (API surface)     -> paddle_tpu.{ops,nn,optimizer,...}
+  L7    (distributed)     -> jax.sharding Mesh + GSPMD (paddle_tpu/distributed)
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .framework import core as _core
+from .framework.core import (  # noqa: F401
+    get_default_dtype, set_default_dtype, set_device, get_device,
+    set_flags, get_flags, is_compiled_with_cuda, is_compiled_with_xpu,
+    is_compiled_with_tpu,
+)
+from .tensor import Parameter, Tensor  # noqa: F401
+from .ops import *  # noqa: F401,F403
+from .ops import creation as _creation
+from .autograd import enable_grad, grad, no_grad, set_grad_enabled  # noqa: F401
+
+# dtype objects (paddle.float32 style)
+import jax.numpy as _jnp
+for _n in _core.DTYPE_NAMES:
+    globals()[_n] = _core.convert_dtype(_n)
+bool = _core.convert_dtype("bool")  # noqa: A001 — paddle exposes paddle.bool
+uint8 = _core.convert_dtype("uint8")
+
+
+def seed(s):
+    """Global RNG seed (ref: paddle.seed)."""
+    _core.seed(s)
+    return _core._rng
+
+
+def is_grad_enabled():
+    return _core.is_grad_enabled()
+
+
+def in_dynamic_mode():
+    return True
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is dynamic-first; use paddle_tpu.jit.to_static for "
+        "compiled execution (XLA replaces the static-graph executor)")
+
+
+def device_count():
+    import jax
+    return len(jax.devices())
+
+
+def set_printoptions(**kwargs):
+    import numpy as np
+    np.set_printoptions(**{k: v for k, v in kwargs.items()
+                           if k in ("precision", "threshold", "edgeitems",
+                                    "linewidth", "suppress")})
+
+
+from . import nn          # noqa: F401,E402
+from . import optimizer   # noqa: F401,E402
+from . import amp         # noqa: F401,E402
+from . import jit         # noqa: F401,E402
+from . import io          # noqa: F401,E402
+from . import linalg      # noqa: F401,E402
+from . import fft         # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
+from . import vision      # noqa: F401,E402
+from . import metric      # noqa: F401,E402
+from . import device      # noqa: F401,E402
+from .framework.io import load, save  # noqa: F401,E402
+from .nn.layer.layers import Layer  # noqa: F401,E402
+
+# paddle.nn.functional-style alias
+randn_like = lambda x, dtype=None: _creation.zeros_like(x) .normal_()  # noqa: E731

@@ -1,0 +1,190 @@
+"""AMP (ref: python/paddle/amp/: auto_cast.py, grad_scaler.py:578).
+
+TPU-native AMP: bf16-first. `auto_cast` flips a thread-local policy consumed
+by Layers' matmul-class ops; `GradScaler` keeps the Paddle API but is an
+identity on TPU by default — bf16 needs no loss scaling (the reference's
+dynamic loss scaling targets fp16 on CUDA). fp16 mode retains real scaling.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import core
+from ..tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "is_bfloat16_supported",
+           "is_float16_supported", "white_list", "black_list"]
+
+# ref: fluid/imperative/amp_auto_cast.cc O1 lists (trimmed to the op names
+# meaningful in this framework)
+white_list = {"matmul", "linear", "conv2d", "conv1d", "conv3d", "einsum",
+              "bmm", "mm", "attention"}
+black_list = {"exp", "log", "softmax", "cross_entropy", "layer_norm", "norm",
+              "mean", "sum", "cumsum", "logsumexp", "erf", "erfinv", "pow"}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+
+
+_amp = _AmpState()
+
+
+def amp_state():
+    return _amp
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    prev = (_amp.enabled, _amp.dtype, _amp.level)
+    _amp.enabled = enable
+    _amp.dtype = core.convert_dtype(dtype)
+    _amp.level = level
+    try:
+        yield
+    finally:
+        _amp.enabled, _amp.dtype, _amp.level = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """O2: cast params to low precision, keep fp32 master weights in the
+    optimizer (ref: amp/auto_cast.py::amp_decorate +
+    fleet/utils/mix_precision_utils.py)."""
+    d = core.convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    opt_single = optimizers is not None and not isinstance(optimizers, (list, tuple))
+    opt_list = ([optimizers] if opt_single else list(optimizers or []))
+
+    if level == "O2":
+        excluded = tuple(excluded_layers or ())
+        for m in model_list:
+            for layer in m.sublayers(include_self=True):
+                from ..nn.layer.norm import LayerNorm, _BatchNormBase
+                if isinstance(layer, (_BatchNormBase, LayerNorm)) or \
+                        (excluded and isinstance(layer, excluded)):
+                    continue
+                for p in layer._parameters.values():
+                    if p is not None and jnp.issubdtype(p.dtype, jnp.floating):
+                        for opt in opt_list:
+                            if (master_weight is None or master_weight) and \
+                                    any(q is p for q in opt._parameter_list):
+                                opt._master_weights[id(p)] = \
+                                    p.data.astype(jnp.float32)
+                        p.data = p.data.astype(d)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list,
+            optimizers if opt_single else opt_list)
+
+
+class GradScaler:
+    """ref: python/paddle/amp/grad_scaler.py:578. With bf16 (TPU default)
+    scaling is a no-op; with fp16 the dynamic-loss-scale algorithm
+    (check_finite_and_unscale + update_loss_scaling kernels) is reproduced
+    in jnp."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good = 0
+        self._bad = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable or self._scale == 1.0:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is None:
+                continue
+            g = p.grad.data.astype(jnp.float32) * inv
+            finite = bool(jnp.all(jnp.isfinite(g)))
+            found = found or not finite
+            p.grad.data = g.astype(p.grad.dtype)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if self._scale != 1.0 and not self._found_inf:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def update(self):
+        if not self._enable or not self._dynamic:
+            self._found_inf = False
+            return
+        if self._found_inf:
+            self._bad += 1
+            self._good = 0
+            if self._bad >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad = 0
+        else:
+            self._good += 1
+            self._bad = 0
+            if self._good >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good = 0
+        self._found_inf = False
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good": self._good,
+                "bad": self._bad}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good = state.get("good", 0)
+        self._bad = state.get("bad", 0)

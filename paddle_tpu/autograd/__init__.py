@@ -1,0 +1,117 @@
+"""paddle_tpu.autograd (ref: python/paddle/autograd + fluid/eager)."""
+from ..framework import core as _core
+from .tape import backward, grad  # noqa: F401
+
+
+class no_grad:
+    """Context manager AND decorator, like paddle.no_grad."""
+
+    def __enter__(self):
+        self._prev = _core.is_grad_enabled()
+        _core.set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        _core.set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _core.is_grad_enabled()
+        _core.set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        _core.set_grad_enabled(self._prev)
+        return False
+
+
+def set_grad_enabled(mode: bool):
+    class _Ctx:
+        def __init__(self):
+            self._prev = _core.is_grad_enabled()
+            _core.set_grad_enabled(mode)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            _core.set_grad_enabled(self._prev)
+            return False
+    return _Ctx()
+
+
+def is_grad_enabled():
+    return _core.is_grad_enabled()
+
+
+class PyLayerContext:
+    """ref: python/paddle/autograd/py_layer.py PyLayerContext."""
+
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def set_materialize_grads(self, value):
+        self.materialize_grads = bool(value)
+
+
+class PyLayer:
+    """Custom autograd op (ref: fluid/eager/pylayer/py_layer_node.h).
+
+    Subclass with static `forward(ctx, ...)` and `backward(ctx, *grads)`.
+    """
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..tensor import Tensor
+        from .tape import GradNode
+
+        ctx = PyLayerContext()
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(out, (tuple, list))
+        outs = [out] if single else list(out)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        if _core.is_grad_enabled() and any(not t.stop_gradient for t in tensor_inputs):
+            def vjp_fn(cots):
+                if single:
+                    cots = cots if not isinstance(cots, tuple) else cots[0]
+                    grads = cls.backward(ctx, Tensor(cots, stop_gradient=True))
+                else:
+                    grads = cls.backward(
+                        ctx, *[Tensor(c, stop_gradient=True) for c in cots])
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                return tuple(g.data if isinstance(g, Tensor) else g for g in grads)
+
+            meta = [(tuple(t.shape), t.dtype) for t in outs]
+            node = GradNode(
+                (lambda c: vjp_fn(c)) if single else vjp_fn,
+                tensor_inputs, meta, name=cls.__name__)
+            for i, t in enumerate(outs):
+                t.stop_gradient = False
+                t._node, t._out_idx = node, i
+        return out
+
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
+           "set_grad_enabled", "PyLayer", "PyLayerContext"]

@@ -1,0 +1,284 @@
+"""Eager autograd engine: a host-side DAG of vjp closures.
+
+TPU-native redesign of the reference's eager autograd
+(ref: paddle/fluid/eager/grad_node_info.h:197 GradNodeBase,
+ paddle/fluid/eager/backward.cc:105 RunBackward).
+
+Instead of hand-written per-op GradNode classes generated from YAML
+(ref: eager_gen.py), every op is executed through `jax.vjp`, which runs the
+forward eagerly on-device and returns a residual-capturing pullback. The
+"GradNode" here is just that pullback + edges. Because `jax.vjp` composes
+with tracing, the same tape works inside `jit` — which is how dy2static
+falls out for free on this design.
+
+Backward (ref backward.cc queue-driven traversal) is a reverse topological
+sweep with per-node cotangent buffers (ref: GradTensorHolder).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import core
+
+
+class GradNode:
+    """One recorded op: pullback + input edges (ref: GradNodeBase)."""
+
+    __slots__ = ("vjp_fn", "inputs", "out_meta", "name", "__weakref__")
+
+    def __init__(self, vjp_fn, inputs, out_meta, name=""):
+        self.vjp_fn = vjp_fn          # pullback: cotangents -> input cotangents
+        self.inputs = inputs           # list[Tensor] (forward inputs, may be None)
+        self.out_meta = out_meta       # list[(shape, dtype)] for each output
+        self.name = name
+
+    def __repr__(self):
+        return f"<GradNode {self.name}>"
+
+
+def _needs_grad(tensors) -> bool:
+    if not core.is_grad_enabled():
+        return False
+    for t in tensors:
+        if t is not None and not t.stop_gradient:
+            return True
+    return False
+
+
+def apply_op(fn: Callable, *args, n_outputs: int = 1, name: str = "",
+             **static_kwargs):
+    """Run `fn(*arrays, **static_kwargs)` through the tape.
+
+    Positional args may be Tensors, jax arrays or python scalars; only
+    Tensor args participate in autograd. Returns Tensor(s).
+    """
+    from ..tensor import Tensor  # local import: avoid cycle
+
+    tensor_args: List[Optional[Any]] = []
+    datas = []
+    for a in args:
+        if isinstance(a, Tensor):
+            tensor_args.append(a)
+            datas.append(a.data)
+        else:
+            tensor_args.append(None)
+            datas.append(a)
+
+    record = _needs_grad([t for t in tensor_args if t is not None])
+
+    if record:
+        # Close over non-tensor positions so vjp only differentiates tensors.
+        diff_idx = [i for i, t in enumerate(tensor_args)
+                    if t is not None and not t.stop_gradient]
+        if not diff_idx:
+            record = False
+
+    if not record:
+        out = fn(*datas, **static_kwargs)
+        if n_outputs == 1 and not isinstance(out, tuple):
+            return Tensor(out, stop_gradient=True)
+        return tuple(Tensor(o, stop_gradient=True) for o in out)
+
+    diff_set = set(diff_idx)
+
+    def partial_fn(*diff_vals):
+        full = list(datas)
+        for i, v in zip(diff_idx, diff_vals):
+            full[i] = v
+        return fn(*full, **static_kwargs)
+
+    out, vjp_fn = jax.vjp(partial_fn, *[datas[i] for i in diff_idx])
+
+    diff_inputs = [tensor_args[i] for i in diff_idx]
+    if n_outputs == 1 and not isinstance(out, tuple):
+        node = GradNode(vjp_fn, diff_inputs, [(out.shape, out.dtype)], name)
+        t = Tensor(out, stop_gradient=False)
+        t._node, t._out_idx = node, 0
+        return t
+    out = tuple(out)
+    node = GradNode(vjp_fn, diff_inputs, [(o.shape, o.dtype) for o in out], name)
+    res = []
+    for i, o in enumerate(out):
+        t = Tensor(o, stop_gradient=False)
+        # integer/bool outputs (e.g. topk indices) carry no grad
+        if jnp.issubdtype(o.dtype, jnp.floating) or jnp.issubdtype(o.dtype, jnp.complexfloating):
+            t._node, t._out_idx = node, i
+        else:
+            t.stop_gradient = True
+        res.append(t)
+    return tuple(res)
+
+
+# ---------------------------------------------------------------------------
+# backward  (ref: egr::RunBackward, backward.cc:105)
+# ---------------------------------------------------------------------------
+
+def backward(tensors: Sequence, grad_tensors=None, retain_graph: bool = False,
+             grad_sink: Optional[dict] = None):
+    """grad_sink: if given, leaf cotangents accumulate into this dict keyed
+    by id(leaf) instead of into `.grad` (used by `grad()` so parameter
+    .grad slots are never polluted)."""
+    from ..tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # ---- seed cotangents -------------------------------------------------
+    buffers: dict = {}   # id(node) -> list[cotangent or None] per output
+    nodes: dict = {}     # id(node) -> node
+    roots = []
+    def _leaf_accumulate(leaf, cot):
+        if grad_sink is not None:
+            prev = grad_sink.get(id(leaf))
+            grad_sink[id(leaf)] = cot if prev is None else prev + cot
+            return
+        if leaf.grad is None:
+            leaf.grad = Tensor(cot, stop_gradient=True)
+        else:
+            leaf.grad = Tensor(leaf.grad.data + cot, stop_gradient=True)
+        for h in leaf._grad_hooks:
+            out = h(leaf.grad)
+            if out is not None:
+                leaf.grad = out
+
+    for t, g in zip(tensors, grad_tensors):
+        if t._node is None:
+            if not t.stop_gradient:
+                seed = g.data if g is not None else jnp.ones(t.shape, t.dtype)
+                _leaf_accumulate(t, seed)
+            continue
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}")
+            g_data = jnp.ones(t.shape, t.dtype)
+        else:
+            g_data = jnp.broadcast_to(
+                g.data if isinstance(g, Tensor) else jnp.asarray(g), t.shape
+            ).astype(t.dtype)
+        node = t._node
+        nid = id(node)
+        nodes[nid] = node
+        buf = buffers.setdefault(nid, [None] * len(node.out_meta))
+        buf[t._out_idx] = g_data if buf[t._out_idx] is None else buf[t._out_idx] + g_data
+        roots.append(node)
+
+    # ---- dependency count: consumers per node (ref: in-degree map) ------
+    dep = {}    # id(node) -> number of downstream consumers not yet processed
+    visited = set()
+    stack = list(roots)
+    order = []
+    while stack:
+        node = stack.pop()
+        nid = id(node)
+        if nid in visited:
+            continue
+        visited.add(nid)
+        nodes[nid] = node
+        order.append(node)
+        for inp in node.inputs:
+            if inp is not None and inp._node is not None:
+                pid = id(inp._node)
+                dep[pid] = dep.get(pid, 0) + 1
+                stack.append(inp._node)
+
+    # ---- queue-driven sweep ---------------------------------------------
+    ready = [n for n in (nodes[i] for i in {id(r) for r in roots})
+             if dep.get(id(n), 0) == 0]
+    # roots that still have pending consumers wait until those fire
+    processed = set()
+    queue = list(ready)
+    while queue:
+        node = queue.pop()
+        nid = id(node)
+        if nid in processed:
+            continue
+        processed.add(nid)
+        buf = buffers.get(nid)
+        if buf is None:
+            continue
+        cotangents = tuple(
+            b if b is not None else jnp.zeros(shape, dtype)
+            for b, (shape, dtype) in zip(buf, node.out_meta)
+        )
+        if len(node.out_meta) == 1:
+            in_cots = node.vjp_fn(cotangents[0])
+        else:
+            in_cots = node.vjp_fn(cotangents)
+        for inp, cot in zip(node.inputs, in_cots):
+            if inp is None or cot is None:
+                continue
+            if getattr(cot, "dtype", None) is not None and cot.dtype == jax.dtypes.float0:
+                continue
+            if inp._node is not None:
+                pid = id(inp._node)
+                pbuf = buffers.setdefault(pid, [None] * len(inp._node.out_meta))
+                idx = inp._out_idx
+                pbuf[idx] = cot if pbuf[idx] is None else pbuf[idx] + cot
+                dep[pid] -= 1
+                if dep[pid] == 0:
+                    queue.append(inp._node)
+            elif not inp.stop_gradient:
+                # leaf accumulation (ref: GradNodeAccumulation)
+                _leaf_accumulate(inp, cot)
+        buffers.pop(nid, None)
+
+    if not retain_graph:
+        for t in tensors:
+            _free_graph(t)
+
+
+def _free_graph(t):
+    node = t._node
+    t._node = None
+    stack = [node] if node is not None else []
+    seen = set()
+    while stack:
+        n = stack.pop()
+        if n is None or id(n) in seen:
+            continue
+        seen.add(id(n))
+        for inp in n.inputs:
+            if inp is not None:
+                stack.append(inp._node)
+                inp._node = None
+        n.vjp_fn = None
+        n.inputs = ()
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """paddle.grad equivalent (ref: fluid/eager/general_grad.h).
+
+    Runs backward with a side grad-sink dict so NO leaf's `.grad`
+    (including parameters outside `inputs`) is touched.
+    """
+    from ..tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    sink: dict = {}
+    backward(outputs, grad_tensors=grad_outputs, retain_graph=True,
+             grad_sink=sink)
+    grads = []
+    for t in inputs:
+        g = sink.get(id(t))
+        if g is None and not allow_unused:
+            g = jnp.zeros(t.shape, t.dtype)
+        grads.append(Tensor(g, stop_gradient=True) if g is not None else None)
+    if not retain_graph:
+        for o in outputs:
+            _free_graph(o)
+    return grads

@@ -1,0 +1,122 @@
+"""paddle.device (ref: python/paddle/device/) — TPU-first."""
+from __future__ import annotations
+
+import jax
+
+from ..framework.core import get_device, set_device  # noqa: F401
+
+__all__ = ["set_device", "get_device", "get_all_device_type",
+           "get_available_device", "get_available_custom_device",
+           "device_count", "synchronize", "Stream", "Event", "stream_guard",
+           "current_stream", "cuda"]
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()
+            if d.platform not in ("cpu", "gpu", "tpu")]
+
+
+def device_count():
+    return len(jax.devices())
+
+
+def synchronize(device=None):
+    """Block until queued work completes (ref: cudaDeviceSynchronize).
+    XLA is async; the barrier is effectively draining dispatch."""
+    try:
+        jax.block_until_ready(jax.device_put(0))
+    except Exception:
+        pass
+
+
+class Stream:
+    """Streams don't exist on TPU/XLA — kept for API parity; XLA's async
+    dispatch + automatic ordering replaces manual stream management
+    (ref: phi/backends/stream.cc)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+    def query(self):
+        return True
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def stream_guard(stream):
+    yield
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+class cuda:
+    """paddle.device.cuda compat shims (report TPU facts)."""
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        stats = jax.devices()[0].memory_stats() or {}
+        return stats.get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def memory_allocated(device=None):
+        stats = jax.devices()[0].memory_stats() or {}
+        return stats.get("bytes_in_use", 0)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        stats = jax.devices()[0].memory_stats() or {}
+        return stats.get("bytes_limit", 0)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return cuda.max_memory_reserved()
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()

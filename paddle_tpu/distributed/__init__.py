@@ -1,0 +1,35 @@
+"""paddle_tpu.distributed (ref: python/paddle/distributed/ 133k LoC).
+
+Layer map (SURVEY §2.4/2.5 → TPU):
+  ProcessGroup*/NCCL rings      -> named mesh axes (topology.py)
+  TCPStore rendezvous           -> jax.distributed coordination (env.py)
+  collective python APIs        -> collective.py (lax.p* in shard_map)
+  shard_tensor/DistTensor       -> sharding.py (NamedSharding/GSPMD)
+  fleet hybrid parallel         -> fleet/ (sharding stages, TP layers, PP)
+"""
+from .env import (  # noqa: F401
+    ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, all_gather, all_gather_object, all_reduce, alltoall,
+    alltoall_single, barrier, broadcast, broadcast_object_list,
+    destroy_process_group, get_group, new_group, recv, reduce,
+    reduce_scatter, scatter, send, wait,
+)
+from .topology import (  # noqa: F401
+    AXES, AxisGroup, CommunicateTopology, HybridCommunicateGroup,
+    default_mesh, get_hybrid_communicate_group, get_mesh, set_mesh,
+    set_hybrid_communicate_group,
+)
+from .sharding import (  # noqa: F401
+    Partial, Placement, ProcessMesh, Replicate, Shard, ShardingPlan,
+    reshard, shard_tensor, to_placements, with_partial_annotation,
+)
+from . import fleet  # noqa: F401
+from .parallel import DataParallel  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """ref: paddle.distributed.spawn. Single-controller JAX drives all local
+    devices from one process, so spawn degenerates to a direct call."""
+    func(*args)

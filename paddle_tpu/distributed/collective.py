@@ -1,0 +1,162 @@
+"""Collective ops (ref: python/paddle/distributed/communication/*,
+phi/kernels/gpu/all_reduce_kernel.cu etc.).
+
+Two regimes, mirroring SURVEY §5's TPU mapping:
+  * inside a compiled/sharded program (shard_map): jax.lax.p* — the real
+    ICI collectives. These wrappers detect a named-axis context.
+  * eager single-controller: all devices are visible to one process, so a
+    "collective" over the logical world is arithmetic on the global array
+    (a psum over dp == the array is already global). Cross-process eager
+    collectives use jax.experimental.multihost_utils.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..ops._helpers import to_tensor_like, unwrap
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _in_shard_map(axis):
+    try:
+        jax.lax.axis_index(axis)
+        return True
+    except Exception:
+        return False
+
+
+def _axis_of(group):
+    if group is None:
+        return None
+    return getattr(group, "axis", None)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if axis is not None and _in_shard_map(axis):
+        fn = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+              ReduceOp.MIN: jax.lax.pmin,
+              ReduceOp.AVG: jax.lax.pmean}[op]
+        tensor.data = fn(tensor.data, axis)
+        return tensor
+    # eager single-controller: world reduction is identity (data is global)
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if axis is not None and _in_shard_map(axis):
+        gathered = jax.lax.all_gather(tensor.data, axis)
+        n = gathered.shape[0]
+        if isinstance(tensor_list, list):
+            tensor_list.clear()
+            tensor_list.extend(Tensor(gathered[i]) for i in range(n))
+        return tensor_list
+    if isinstance(tensor_list, list):
+        n = group.nranks if group is not None else 1
+        tensor_list.clear()
+        tensor_list.extend(Tensor(tensor.data) for _ in range(n))
+    return tensor_list
+
+
+def all_gather_object(object_list, obj, group=None):
+    n = group.nranks if group is not None else 1
+    object_list.clear()
+    object_list.extend(obj for _ in range(n))
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    axis = _axis_of(group)
+    if axis is not None and _in_shard_map(axis):
+        stacked = jnp.stack([unwrap(t) for t in tensor_list])
+        out = jax.lax.psum_scatter(stacked, axis, scatter_dimension=0,
+                                   tiled=False)
+        tensor.data = out
+        return tensor
+    tensor.data = sum(unwrap(t) for t in tensor_list)
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        tensor.data = unwrap(tensor_list[0])
+    return tensor
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if axis is not None and _in_shard_map(axis):
+        stacked = jnp.stack([unwrap(t) for t in in_tensor_list])
+        out = jax.lax.all_to_all(stacked, axis, split_axis=0, concat_axis=0)
+        out_tensor_list.clear()
+        out_tensor_list.extend(Tensor(out[i]) for i in range(out.shape[0]))
+        return out_tensor_list
+    out_tensor_list.clear()
+    out_tensor_list.extend(Tensor(unwrap(t)) for t in in_tensor_list)
+    return out_tensor_list
+
+
+alltoall_single = alltoall
+
+
+def barrier(group=None):
+    try:
+        from jax.experimental import multihost_utils
+        if jax.process_count() > 1:
+            multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    except Exception:
+        pass
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv exist only inside shard_map pipelines "
+        "(ppermute); use paddle_tpu.distributed.fleet pipeline APIs")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv exist only inside shard_map pipelines "
+        "(ppermute); use paddle_tpu.distributed.fleet pipeline APIs")
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    from .topology import AxisGroup, get_mesh
+    n = len(ranks) if ranks else jax.device_count()
+    return AxisGroup(get_mesh(), None, n, ranks)
+
+
+def get_group(gid=0):
+    return None
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    jax.block_until_ready(unwrap(tensor))
+
+
+def destroy_process_group(group=None):
+    pass

@@ -1,0 +1,78 @@
+"""Process/bootstrap layer (ref: paddle/fluid/distributed/collective TCPStore
+rendezvous + ProcessGroup init, python/paddle/distributed/parallel.py:943).
+
+TPU-native: `jax.distributed.initialize` is the rendezvous (coordination
+service replaces TCPStore); collectives are XLA-compiled, so there is no
+ProcessGroup object to create per ring — only mesh bookkeeping.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env(strategy=None):
+    """ref: paddle.distributed.init_parallel_env."""
+    global _initialized
+    if _initialized:
+        return
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                               os.environ.get("JAX_NUM_PROCESSES", "1")))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID",
+                             os.environ.get("JAX_PROCESS_ID", "0")))
+    if coord and nproc > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_index=pid)
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    # logical world size = number of addressable devices across processes
+    return jax.device_count()
+
+
+def get_device_count() -> int:
+    return jax.local_device_count()
+
+
+class ParallelEnv:
+    """ref: python/paddle/distributed/parallel.py::ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS",
+                              "127.0.0.1:6170").split(",")

@@ -1,0 +1,83 @@
+"""Fleet facade (ref: python/paddle/distributed/fleet/fleet.py:167 init,
+model.py:32 distributed_model, hybrid_parallel_optimizer.py:254).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..topology import (HybridCommunicateGroup, get_hybrid_communicate_group,
+                        set_hybrid_communicate_group)
+
+__all__ = ["init", "DistributedStrategy", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "worker_index", "worker_num"]
+
+
+class DistributedStrategy:
+    """ref: fleet/base/distributed_strategy.py (protobuf-backed there;
+    a plain config object here — XLA removes most pass toggles)."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.sharding_configs = {"stage": 1}
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.pipeline_configs = {"accumulate_steps": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.find_unused_parameters = False
+
+
+_fleet_initialized = False
+_strategy: Optional[DistributedStrategy] = None
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    global _fleet_initialized, _strategy
+    from ..env import init_parallel_env
+    init_parallel_env()
+    _strategy = strategy or DistributedStrategy()
+    hc = _strategy.hybrid_configs
+    hcg = HybridCommunicateGroup(
+        dp_degree=hc.get("dp_degree", 1), mp_degree=hc.get("mp_degree", 1),
+        pp_degree=hc.get("pp_degree", 1),
+        sharding_degree=hc.get("sharding_degree", 1),
+        sep_degree=hc.get("sep_degree", 1))
+    set_hybrid_communicate_group(hcg)
+    _fleet_initialized = True
+
+
+def get_strategy():
+    return _strategy
+
+
+def worker_index():
+    from ..env import get_rank
+    return get_rank()
+
+
+def worker_num():
+    from ..env import get_world_size
+    return get_world_size()
+
+
+def distributed_model(model):
+    """ref: fleet/model.py:32 — wraps per topology. Under GSPMD the wrapper
+    only records intent; partitioning happens in the compiled TrainStep."""
+    from ..parallel import DataParallel
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return model
+    mode = hcg.get_parallel_mode()
+    if mode == "data":
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return optimizer

@@ -1,0 +1,39 @@
+"""DataParallel wrapper (ref: python/paddle/parallel.py::DataParallel +
+EagerReducer fluid/distributed/collective/reducer.cc:532).
+
+TPU-native: there is no reducer. Gradients of replicated parameters under a
+pjit'd TrainStep are automatically all-reduced by GSPMD when the batch is
+sharded on dp — bucketing/overlap is XLA's async collective scheduler's job
+(the reference builds this machinery by hand).
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, **kwargs):
+        return self._layers.set_state_dict(state_dict, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    @property
+    def _inner(self):
+        return self._layers

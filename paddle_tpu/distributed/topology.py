@@ -1,0 +1,199 @@
+"""Hybrid-parallel topology → jax.sharding.Mesh
+(ref: python/paddle/distributed/fleet/base/topology.py:61 CommunicateTopology,
+:174 HybridCommunicateGroup).
+
+The reference builds one NCCL ring per axis-slice; here the topology IS the
+device mesh — axes (pp, dp, sharding, sep, mp) become named mesh axes and
+every "communication group" is just an axis name XLA partitions over.
+Axis order puts `mp` (tensor parallel) innermost so its collectives ride
+the fastest ICI links, then sep/sharding/dp/pp — same ordering rationale as
+the reference's HybridCommunicateGroup.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# canonical axis order, outermost -> innermost
+AXES = ("pp", "dp", "sharding", "sep", "mp")
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._parallel_names = list(hybrid_group_names or AXES)
+        self._dims = list(dims or [1] * len(self._parallel_names))
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs):
+        assert len(kwargs) == len(self._parallel_names)
+        strides = np.cumprod([1] + self._dims[::-1][:-1])[::-1]
+        return int(sum(kwargs[n] * s for n, s in
+                       zip(self._parallel_names, strides)))
+
+    def get_coord(self, rank):
+        coords = []
+        r = rank
+        for d in self._dims[::-1]:
+            coords.append(r % d)
+            r //= d
+        Coord = collections.namedtuple("Coord", self._parallel_names)
+        return Coord(*coords[::-1])
+
+
+class HybridCommunicateGroup:
+    """Owns the global Mesh. Sub-"groups" are axis handles carrying
+    (axis_name, rank, nranks) — enough for all paddle APIs that take a
+    group argument."""
+
+    def __init__(self, dp_degree=1, mp_degree=1, pp_degree=1,
+                 sharding_degree=1, sep_degree=1, order=None,
+                 devices=None):
+        devices = devices if devices is not None else jax.devices()
+        n = len(devices)
+        given = dp_degree * mp_degree * pp_degree * sharding_degree * sep_degree
+        if dp_degree == -1 or given != n:
+            fixed = mp_degree * pp_degree * sharding_degree * sep_degree
+            assert n % fixed == 0, (
+                f"{n} devices not divisible by mp*pp*sharding*sep={fixed}")
+            dp_degree = n // fixed
+        self.dims = dict(pp=pp_degree, dp=dp_degree, sharding=sharding_degree,
+                         sep=sep_degree, mp=mp_degree)
+        shape = [self.dims[a] for a in AXES]
+        dev_array = np.asarray(devices).reshape(shape)
+        self.mesh = Mesh(dev_array, AXES)
+        self._topo = CommunicateTopology(list(AXES), shape)
+        self.global_rank = jax.process_index()
+
+    # -- paddle-compatible accessors (ref topology.py:174+) -----------------
+    def get_parallel_mode(self):
+        if self.dims["pp"] > 1:
+            return "pipeline"
+        if self.dims["mp"] > 1:
+            return "tensor"
+        if self.dims["sharding"] > 1:
+            return "sharding"
+        return "data"
+
+    def _axis_group(self, axis):
+        return AxisGroup(self.mesh, axis, self.dims[axis])
+
+    def topology(self):
+        return self._topo
+
+    def get_data_parallel_world_size(self):
+        return self.dims["dp"]
+
+    def get_model_parallel_world_size(self):
+        return self.dims["mp"]
+
+    def get_pipe_parallel_world_size(self):
+        return self.dims["pp"]
+
+    def get_sharding_parallel_world_size(self):
+        return self.dims["sharding"]
+
+    def get_sep_parallel_world_size(self):
+        return self.dims["sep"]
+
+    def get_data_parallel_group(self):
+        return self._axis_group("dp")
+
+    def get_model_parallel_group(self):
+        return self._axis_group("mp")
+
+    def get_pipe_parallel_group(self):
+        return self._axis_group("pp")
+
+    def get_sharding_parallel_group(self):
+        return self._axis_group("sharding")
+
+    def get_sep_parallel_group(self):
+        return self._axis_group("sep")
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    # composite groups used by sharding-stage optimizers
+    def get_dp_sep_parallel_group(self):
+        return AxisGroup(self.mesh, ("dp", "sep"),
+                         self.dims["dp"] * self.dims["sep"])
+
+    def get_check_parallel_group(self, *a, **k):
+        return AxisGroup(self.mesh, AXES, self._topo.world_size())
+
+
+class AxisGroup:
+    """A mesh-axis handle standing in for a ProcessGroup
+    (ref: fluid/distributed/collective/process_group.h:47)."""
+
+    def __init__(self, mesh: Mesh, axis, nranks: int, ranks=None):
+        self.mesh = mesh
+        self.axis = axis          # str or tuple of axis names
+        self.nranks = int(nranks)
+        self.rank = 0             # single-controller: logical rank handled by XLA
+        self.ranks = list(ranks) if ranks is not None else list(range(nranks))
+        self.id = hash((str(axis), nranks)) & 0x7FFFFFFF
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_ids(self):
+        return self.ranks
+
+    def __repr__(self):
+        return f"AxisGroup(axis={self.axis}, nranks={self.nranks})"
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+_global_mesh: Optional[Mesh] = None
+
+
+def set_hybrid_communicate_group(hcg):
+    global _hcg, _global_mesh
+    _hcg = hcg
+    _global_mesh = hcg.mesh
+
+
+def get_hybrid_communicate_group():
+    return _hcg
+
+
+def get_mesh() -> Optional[Mesh]:
+    if _global_mesh is not None:
+        return _global_mesh
+    return None
+
+
+def set_mesh(mesh: Mesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def default_mesh(axes: Sequence[str] = ("dp",)) -> Mesh:
+    """All devices on one axis (or a trivial reshape over several)."""
+    devs = np.asarray(jax.devices())
+    shape = [len(devs)] + [1] * (len(axes) - 1)
+    return Mesh(devs.reshape(shape), tuple(axes))

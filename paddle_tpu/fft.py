@@ -1,0 +1,71 @@
+"""paddle.fft (ref: python/paddle/fft.py over pocketfft; here jnp.fft → XLA)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .autograd.tape import apply_op
+from .ops._helpers import to_tensor_like
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+           "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "fftfreq",
+           "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _norm(norm):
+    return norm if norm in ("ortho", "forward") else "backward"
+
+
+def _mk1(jfn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_op(lambda a: jfn(a, n=n, axis=axis, norm=_norm(norm)),
+                        to_tensor_like(x))
+    return op
+
+
+def _mk2(jfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply_op(lambda a: jfn(a, s=s, axes=tuple(axes), norm=_norm(norm)),
+                        to_tensor_like(x))
+    return op
+
+
+def _mkn(jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        ax = tuple(axes) if axes is not None else None
+        return apply_op(lambda a: jfn(a, s=s, axes=ax, norm=_norm(norm)),
+                        to_tensor_like(x))
+    return op
+
+
+fft = _mk1(jnp.fft.fft)
+ifft = _mk1(jnp.fft.ifft)
+rfft = _mk1(jnp.fft.rfft)
+irfft = _mk1(jnp.fft.irfft)
+hfft = _mk1(jnp.fft.hfft)
+ihfft = _mk1(jnp.fft.ihfft)
+fft2 = _mk2(jnp.fft.fft2)
+ifft2 = _mk2(jnp.fft.ifft2)
+rfft2 = _mk2(jnp.fft.rfft2)
+irfft2 = _mk2(jnp.fft.irfft2)
+fftn = _mkn(jnp.fft.fftn)
+ifftn = _mkn(jnp.fft.ifftn)
+rfftn = _mkn(jnp.fft.rfftn)
+irfftn = _mkn(jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .tensor import Tensor
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .tensor import Tensor
+    return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op(lambda a: jnp.fft.fftshift(a, axes=axes), to_tensor_like(x))
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op(lambda a: jnp.fft.ifftshift(a, axes=axes), to_tensor_like(x))

@@ -1,0 +1,6 @@
+from . import core  # noqa: F401
+from .core import (  # noqa: F401
+    convert_dtype, get_default_dtype, set_default_dtype, seed,
+    set_device, get_device, get_flags, set_flags,
+    get_rng_state, set_rng_state,
+)

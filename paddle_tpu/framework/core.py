@@ -1,0 +1,237 @@
+"""Global framework state: dtypes, default device, RNG, grad mode.
+
+TPU-native re-design of the reference's global state:
+  - dtype registry  (ref: paddle/phi/common/data_type.h)
+  - flags           (ref: paddle/phi/core/flags.cc — 136 exported flags)
+  - RNG             (ref: paddle/phi/core/generator.cc) — here a functional
+    JAX key-stack so randomness is traceable under jit.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# dtypes
+# ---------------------------------------------------------------------------
+
+_DTYPE_ALIASES = {
+    "float32": jnp.float32, "fp32": jnp.float32, "float": jnp.float32,
+    "float64": jnp.float64, "fp64": jnp.float64, "double": jnp.float64,
+    "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+    "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+    "int8": jnp.int8, "int16": jnp.int16, "int32": jnp.int32,
+    "int64": jnp.int64, "uint8": jnp.uint8, "uint16": jnp.uint16,
+    "uint32": jnp.uint32, "uint64": jnp.uint64,
+    "bool": jnp.bool_,
+    "complex64": jnp.complex64, "complex128": jnp.complex128,
+    "float8_e4m3fn": jnp.float8_e4m3fn, "float8_e5m2": jnp.float8_e5m2,
+}
+
+# canonical names exposed as module-level dtype objects (paddle.float32 etc.)
+DTYPE_NAMES = [
+    "float32", "float64", "float16", "bfloat16", "int8", "int16", "int32",
+    "int64", "uint8", "bool", "complex64", "complex128",
+]
+
+
+def convert_dtype(dtype: Any):
+    """Normalize a user-facing dtype (str / np / jnp dtype) to a jnp dtype.
+
+    With x64 disabled (the TPU-friendly default), 64-bit requests silently
+    narrow to their 32-bit counterparts, mirroring JAX's own behavior.
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        d = _DTYPE_ALIASES.get(dtype)
+        if d is None:
+            raise ValueError(f"Unknown dtype {dtype!r}")
+        return jnp.dtype(d) if not jax.config.jax_enable_x64 else np.dtype(d)
+    try:
+        return jnp.dtype(dtype)  # canonicalizes under current x64 setting
+    except TypeError:
+        raise ValueError(f"Unknown dtype {dtype!r}")
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name if dtype is not None else "None"
+
+
+_default_dtype = jnp.float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if np.dtype(d).kind != "f":
+        raise TypeError("default dtype must be floating point")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+# ---------------------------------------------------------------------------
+# device (ref: paddle.set_device / phi::Place)
+# ---------------------------------------------------------------------------
+
+_device: Optional[str] = None
+
+
+def set_device(device: str):
+    """'tpu', 'cpu', 'tpu:0' — maps onto jax default device."""
+    global _device
+    name = device.split(":")[0]
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    plats = {d.platform for d in jax.devices()}
+    if name in ("gpu", "cuda"):
+        name = "tpu" if "tpu" in plats else "cpu"
+    if name == "tpu" and "tpu" not in plats:
+        # single-host CPU emulation (tests); stay on default backend
+        name = jax.default_backend()
+    devs = [d for d in jax.devices() if d.platform == name] or jax.devices()
+    jax.config.update("jax_default_device", devs[min(idx, len(devs) - 1)])
+    _device = device
+    return device
+
+
+def get_device() -> str:
+    if _device is not None:
+        return _device
+    return jax.default_backend() + ":0"
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+# ---------------------------------------------------------------------------
+# grad mode (ref: egr::Controller tracer state)
+# ---------------------------------------------------------------------------
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_grad_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _grad_state.enabled
+
+
+def set_grad_enabled(flag: bool):
+    _grad_state.enabled = bool(flag)
+
+
+@contextlib.contextmanager
+def no_grad_guard():
+    prev = _grad_state.enabled
+    _grad_state.enabled = False
+    try:
+        yield
+    finally:
+        _grad_state.enabled = prev
+
+
+# ---------------------------------------------------------------------------
+# RNG: stateful shell over functional JAX keys.
+#
+# Eager ops fold a counter into the global key (fast, reproducible).
+# Under `jit`/functional training steps, a key can be pushed on a
+# context stack so randomness is traced (ref: phi Generator + paddle.seed).
+# ---------------------------------------------------------------------------
+
+class RandomState(threading.local):
+    def __init__(self):
+        self.key = jax.random.key(0)
+        self.counter = 0
+        self.stack = []  # traced keys pushed by functional contexts
+
+    def seed(self, s: int):
+        self.key = jax.random.key(s)
+        self.counter = 0
+
+    def next_key(self):
+        if self.stack:
+            # functional/traced mode: split the context key in place
+            k, sub = jax.random.split(self.stack[-1])
+            self.stack[-1] = k
+            return sub
+        self.counter += 1
+        return jax.random.fold_in(self.key, self.counter)
+
+
+_rng = RandomState()
+
+
+def seed(s: int):
+    _rng.seed(s)
+    return _rng
+
+
+def next_rng_key():
+    return _rng.next_key()
+
+
+@contextlib.contextmanager
+def rng_key_context(key):
+    _rng.stack.append(key)
+    try:
+        yield
+    finally:
+        _rng.stack.pop()
+
+
+def get_rng_state():
+    return (_rng.key, _rng.counter)
+
+
+def set_rng_state(state):
+    _rng.key, _rng.counter = state
+
+
+# ---------------------------------------------------------------------------
+# flags (ref: paddle/phi/core/flags.cc; paddle.set_flags)
+# ---------------------------------------------------------------------------
+
+_flags: dict = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_use_autotune": True,
+    "FLAGS_embedding_deterministic": 0,
+}
+
+
+def set_flags(flags: dict):
+    _flags.update(flags)
+
+
+def get_flags(keys):
+    if isinstance(keys, str):
+        keys = [keys]
+    return {k: _flags.get(k) for k in keys}
+
+
+def get_flag(key, default=None):
+    env = os.environ.get(key)
+    if env is not None:
+        return env
+    return _flags.get(key, default)

@@ -1,0 +1,63 @@
+"""Serialization (ref: python/paddle/framework/io.py paddle.save/load).
+
+Format: pickle with Tensors swapped to numpy arrays (same spirit as the
+reference's pickle+binary-tensor format; orbax handles the distributed
+checkpoint path in paddle_tpu.distributed.checkpoint).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Parameter, Tensor
+
+
+class _TensorPayload:
+    def __init__(self, array, stop_gradient, name, is_param):
+        self.array = array
+        self.stop_gradient = stop_gradient
+        self.name = name
+        self.is_param = is_param
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj.data), obj.stop_gradient,
+                              obj.name, isinstance(obj, Parameter))
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj):
+    if isinstance(obj, _TensorPayload):
+        cls = Parameter if obj.is_param else Tensor
+        t = cls(jnp.asarray(obj.array), stop_gradient=obj.stop_gradient,
+                name=obj.name)
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, **configs):
+    with open(path, "rb") as f:
+        return _unpack(pickle.load(f))

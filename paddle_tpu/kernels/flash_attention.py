@@ -1,0 +1,61 @@
+"""Flash attention on TPU (ref: phi/kernels/gpu/flash_attn_kernel.cu +
+third_party flashattn — re-designed for TPU, not ported).
+
+Strategy: use the tuned in-tree Pallas TPU kernel
+(jax.experimental.pallas.ops.tpu.flash_attention) when on TPU and shapes are
+tile-aligned; it implements the same online-softmax blocked algorithm as
+FlashAttention-2 with MXU-shaped (block_q x block_k) tiles and VMEM
+double-buffering. A custom ring-attention kernel for the `sep` axis lives in
+ring_attention.py (reference has NO equivalent — SURVEY §5 long-context).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+_MIN_HEAD_DIM = 128  # lane width; smaller head_dims pad poorly
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def supported(q_shape, k_shape, no_mask: bool) -> bool:
+    if not _on_tpu():
+        return False
+    if not no_mask:
+        return False
+    B, Sq, H, D = q_shape
+    Sk = k_shape[1]
+    # kernel wants seq multiples of the block size and head_dim % 128 == 0
+    return (D % _MIN_HEAD_DIM == 0 and Sq % 128 == 0 and Sk % 128 == 0
+            and q_shape[2] == k_shape[2])
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale"))
+def flash_attention_bshd(q, k, v, causal=False, scale=None):
+    """[batch, seq, heads, dim] in/out (paddle flash_attn layout)."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes, flash_attention)
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    qt = jnp.swapaxes(q, 1, 2)  # BHSD
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    Sq, Sk = qt.shape[2], kt.shape[2]
+    bq = min(512, Sq)
+    bk = min(512, Sk)
+    sizes = BlockSizes(
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
+        block_q_dkv=bq, block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq,
+    )
+    out = flash_attention(qt, kt, vt, causal=causal, sm_scale=scale,
+                          block_sizes=sizes)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)

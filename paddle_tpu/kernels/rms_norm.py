@@ -1,0 +1,56 @@
+"""Pallas RMSNorm (ref: phi/kernels/fusion/gpu/fused_rms_norm; TPU-native
+row-blocked kernel: one VMEM pass, f32 accumulation, bf16 in/out).
+
+XLA usually fuses rms_norm chains already; this kernel exists for the long-
+row case (hidden >= 8192) where explicit blocking beats the fusion, and as
+the template for further norm kernels.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_TPU = True
+except Exception:  # pragma: no cover
+    _HAS_TPU = False
+
+
+def _rms_kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    o_ref[...] = (x * jax.lax.rsqrt(ms + eps) * w_ref[...].astype(jnp.float32)
+                  ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("eps",))
+def rms_norm(x, weight, eps=1e-6):
+    """x: [..., H]; weight: [H]."""
+    if not _HAS_TPU or jax.default_backend() != "tpu":
+        x32 = x.astype(jnp.float32)
+        ms = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        return (x32 * jax.lax.rsqrt(ms + eps) * weight.astype(jnp.float32)
+                ).astype(x.dtype)
+    orig_shape = x.shape
+    H = orig_shape[-1]
+    xf = x.reshape(-1, H)
+    rows = xf.shape[0]
+    block_rows = max(1, min(256, rows))
+    while rows % block_rows:
+        block_rows -= 1
+    grid = (rows // block_rows,)
+    out = pl.pallas_call(
+        functools.partial(_rms_kernel, eps=eps),
+        out_shape=jax.ShapeDtypeStruct(xf.shape, x.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, H), lambda i: (i, 0)),
+            pl.BlockSpec((H,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, H), lambda i: (i, 0)),
+    )(xf, weight)
+    return out.reshape(orig_shape)

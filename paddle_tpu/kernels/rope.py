@@ -1,0 +1,45 @@
+"""Rotary position embedding (ref: phi fused_rope kernel,
+python/paddle/incubate/nn/functional/fused_rotary_position_embedding.py).
+
+Pure-jnp rotate-half formulation — XLA fuses the mul/adds into surrounding
+matmuls, so a bespoke Pallas kernel buys nothing here (measured pattern on
+TPU); cos/sin caches are precomputed once per (seq, dim).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+
+
+@functools.lru_cache(maxsize=32)
+def _cos_sin_cache(seq_len: int, dim: int, base: float, dtype_str: str):
+    inv_freq = 1.0 / (base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)                  # [S, dim/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [S, dim]
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(q, k, position_ids=None, base=10000.0):
+    """q, k: [B, S, H, D] -> rotated (same shapes), f32 math, input dtype out."""
+    S, D = q.shape[1], q.shape[-1]
+    cos, sin = _cos_sin_cache(S, D, base, "f32")
+    if position_ids is not None:
+        cos = jnp.take(cos, position_ids, axis=0)  # [B, S, D]
+        sin = jnp.take(sin, position_ids, axis=0)
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    else:
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    q_out = qf * cos + _rotate_half(qf) * sin
+    k_out = kf * cos + _rotate_half(kf) * sin
+    return q_out.astype(q.dtype), k_out.astype(k.dtype)

@@ -1,0 +1,10 @@
+"""paddle.linalg namespace (ref: python/paddle/linalg.py re-exports)."""
+from .ops.linalg_ops import (  # noqa: F401
+    cholesky, cholesky_inverse, cholesky_solve, cond, corrcoef, cov, det,
+    eig, eigh, eigvals, eigvalsh, householder_product, inverse, lstsq, lu,
+    lu_unpack, lu_solve, matrix_exp, matrix_power, matrix_rank, multi_dot,
+    ormqr, pca_lowrank, pinv, qr, slogdet, solve, svd, svd_lowrank, svdvals,
+    triangular_solve, vander, vecdot,
+)
+from .ops.reduction import norm  # noqa: F401
+from .ops.linalg_ops import matmul, matrix_transpose  # noqa: F401

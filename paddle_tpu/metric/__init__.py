@@ -1,0 +1,160 @@
+"""paddle.metric (ref: python/paddle/metric/metrics.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self.__class__.__name__.lower()
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def compute(self, pred, label, *args):
+        p = np.asarray(pred.data if isinstance(pred, Tensor) else pred)
+        l = np.asarray(label.data if isinstance(label, Tensor) else label)
+        if l.ndim == p.ndim and l.shape[-1] == 1:
+            l = l[..., 0]
+        top = np.argsort(-p, axis=-1)[..., : self.maxk]
+        correct = top == l[..., None]
+        return correct
+
+    def update(self, correct, *args):
+        correct = np.asarray(correct.data if isinstance(correct, Tensor) else correct)
+        for i, k in enumerate(self.topk):
+            num = correct[..., :k].any(-1).sum()
+            self.total[i] += float(num)
+            self.count[i] += int(np.prod(correct.shape[:-1]))
+        accs = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return accs[0] if len(accs) == 1 else accs
+
+    def accumulate(self):
+        accs = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return accs[0] if len(accs) == 1 else accs
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    def __init__(self, name=None):
+        self._name = name or "precision"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds.data if isinstance(preds, Tensor) else preds).ravel()
+        l = np.asarray(labels.data if isinstance(labels, Tensor) else labels).ravel()
+        pred_pos = (p > 0.5).astype(np.int64)
+        self.tp += int(((pred_pos == 1) & (l == 1)).sum())
+        self.fp += int(((pred_pos == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        self._name = name or "recall"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds.data if isinstance(preds, Tensor) else preds).ravel()
+        l = np.asarray(labels.data if isinstance(labels, Tensor) else labels).ravel()
+        pred_pos = (p > 0.5).astype(np.int64)
+        self.tp += int(((pred_pos == 1) & (l == 1)).sum())
+        self.fn += int(((pred_pos == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        self._name = name or "auc"
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self.stat_pos = np.zeros(self.num_thresholds + 1)
+        self.stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        p = np.asarray(preds.data if isinstance(preds, Tensor) else preds)
+        l = np.asarray(labels.data if isinstance(labels, Tensor) else labels).ravel()
+        if p.ndim == 2:
+            p = p[:, -1]
+        idx = (p.ravel() * self.num_thresholds).astype(np.int64)
+        idx = np.clip(idx, 0, self.num_thresholds)
+        for i, lab in zip(idx, l):
+            if lab:
+                self.stat_pos[i] += 1
+            else:
+                self.stat_neg[i] += 1
+
+    def accumulate(self):
+        tot_pos = self.stat_pos.sum()
+        tot_neg = self.stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # trapezoidal over thresholds, descending
+        tp = np.cumsum(self.stat_pos[::-1])
+        fp = np.cumsum(self.stat_neg[::-1])
+        tpr = tp / tot_pos
+        fpr = fp / tot_neg
+        return float(np.trapz(tpr, fpr))
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    import jax.numpy as jnp
+    p = input.data if isinstance(input, Tensor) else input
+    l = label.data if isinstance(label, Tensor) else label
+    if l.ndim == p.ndim and l.shape[-1] == 1:
+        l = l[..., 0]
+    topk = jnp.argsort(-p, axis=-1)[..., :k]
+    correct_mask = (topk == l[..., None]).any(-1)
+    return Tensor(jnp.mean(correct_mask.astype(jnp.float32)))

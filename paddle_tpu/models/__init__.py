@@ -1,0 +1,5 @@
+"""Flagship model zoo (BASELINE.md configs)."""
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaForCausalLM, LlamaModel, llama_1b, llama_350m,
+    llama_7b, llama_tiny,
+)

@@ -1,0 +1,284 @@
+"""LLaMA family — the north-star model (BASELINE.md config 3).
+
+TPU-native design (not a port of any torch/paddle modeling file):
+  * RMSNorm + RoPE + SwiGLU, GQA-capable attention via the Pallas flash
+    kernel (paddle_tpu/kernels/flash_attention.py)
+  * every parameter carries a PartitionSpec annotation (`p.pspec`) encoding
+    its tensor-parallel layout over the `mp` axis; ShardingPlan composes
+    these with FSDP (`sharding`) placement (SURVEY §2.5 TP+ZeRO mapping)
+  * per-layer `jax.checkpoint` (remat) replaces the reference's
+    recompute meta-optimizer (fleet/meta_optimizers/recompute)
+Reference anchors (behavioral parity targets, not sources):
+  fleet/layers/mpu/mp_layers.py:46,335,542 (parallel layers),
+  incubate fused_rms_norm / fused_rope kernels.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..autograd.tape import apply_op
+from ..framework import core
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.common import Dropout, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.layers import Layer
+from ..ops import manipulation as M
+from ..ops._helpers import to_tensor_like
+from ..tensor import Tensor
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_tiny",
+           "llama_350m", "llama_1b", "llama_7b"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: Optional[int] = None
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    use_recompute: bool = True
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def kv_heads(self):
+        return self.num_key_value_heads or self.num_attention_heads
+
+
+def _param(layer, shape, pspec, std=0.02, init=None, dtype=None):
+    p = layer.create_parameter(
+        shape, dtype=dtype,
+        default_initializer=init or I.Normal(0.0, std))
+    p.pspec = pspec
+    return p
+
+
+class LlamaRMSNorm(Layer):
+    def __init__(self, hidden, eps):
+        super().__init__()
+        self.eps = eps
+        self.weight = _param(self, (hidden,), P(None), init=I.Constant(1.0),
+                             dtype="float32")
+
+    def forward(self, x):
+        from ..kernels import rms_norm as krn
+        return apply_op(lambda a, w: krn.rms_norm(a, w, self.eps),
+                        to_tensor_like(x), self.weight, name="rms_norm")
+
+
+class LlamaAttention(Layer):
+    """Column-parallel qkv, row-parallel o (ref mp_layers.py:335,542 layout,
+    expressed as GSPMD specs instead of explicit collectives)."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        h, d = cfg.hidden_size, cfg.head_dim
+        kvh = cfg.kv_heads
+        self.q_proj = _param(self, (h, cfg.num_attention_heads * d), P(None, "mp"))
+        self.k_proj = _param(self, (h, kvh * d), P(None, "mp"))
+        self.v_proj = _param(self, (h, kvh * d), P(None, "mp"))
+        self.o_proj = _param(self, (cfg.num_attention_heads * d, h), P("mp", None))
+
+    def forward(self, x, position_ids=None, kv_cache=None):
+        cfg = self.cfg
+        B = x.shape[0]
+        nh, kvh, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+
+        def attn(a, wq, wk, wv, wo):
+            from ..kernels.rope import apply_rope
+            from ..kernels import flash_attention as fa
+            q = (a @ wq).reshape(B, -1, nh, d)
+            k = (a @ wk).reshape(B, -1, kvh, d)
+            v = (a @ wv).reshape(B, -1, kvh, d)
+            q, k = apply_rope(q, k, base=cfg.rope_theta)
+            if kvh != nh:  # GQA: broadcast kv heads
+                rep = nh // kvh
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            if fa.supported(q.shape, k.shape, True):
+                o = fa.flash_attention_bshd(q, k, v, causal=True)
+            else:
+                o = _sdpa(q, k, v)
+            return o.reshape(B, -1, nh * d) @ wo
+
+        return apply_op(attn, to_tensor_like(x), self.q_proj, self.k_proj,
+                        self.v_proj, self.o_proj, name="llama_attn")
+
+
+def _sdpa(q, k, v):
+    d = q.shape[-1]
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    s = qt @ jnp.swapaxes(kt, -1, -2) / math.sqrt(d)
+    Sq, Sk = s.shape[-2], s.shape[-1]
+    mask = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+    s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.swapaxes(p @ vt, 1, 2).astype(q.dtype)
+
+
+class LlamaMLP(Layer):
+    """SwiGLU; gate/up column-parallel, down row-parallel."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        h, m = cfg.hidden_size, cfg.intermediate_size
+        self.gate_proj = _param(self, (h, m), P(None, "mp"))
+        self.up_proj = _param(self, (h, m), P(None, "mp"))
+        self.down_proj = _param(self, (m, h), P("mp", None))
+
+    def forward(self, x):
+        return apply_op(
+            lambda a, wg, wu, wd: (jax.nn.silu(a @ wg) * (a @ wu)) @ wd,
+            to_tensor_like(x), self.gate_proj, self.up_proj, self.down_proj,
+            name="llama_mlp")
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = LlamaRMSNorm(cfg.hidden_size,
+                                                     cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg)
+        self.use_recompute = cfg.use_recompute
+
+    def forward(self, x, position_ids=None):
+        h = x + self.self_attn(self.input_layernorm(x), position_ids)
+        return h + self.mlp(self.post_attention_layernorm(h))
+
+
+class LlamaModel(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed_tokens = _param(self, (cfg.vocab_size, cfg.hidden_size),
+                                   P("mp", None), dtype=cfg.dtype)
+        self.layers = LayerList([LlamaDecoderLayer(cfg)
+                                 for _ in range(cfg.num_hidden_layers)])
+        self.norm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        if cfg.dtype != "float32":
+            self.to(dtype=cfg.dtype)
+            # keep norms in fp32 (standard TPU recipe)
+            for lyr in self.sublayers(include_self=True):
+                if isinstance(lyr, LlamaRMSNorm):
+                    lyr.weight.data = lyr.weight.data.astype(jnp.float32)
+
+    def forward(self, input_ids, position_ids=None):
+        x = apply_op(lambda ids, w: jnp.take(w, ids.astype(jnp.int32), axis=0),
+                     to_tensor_like(input_ids), self.embed_tokens,
+                     name="embed")
+        if self.cfg.use_recompute:
+            x = _recompute_stack(self.layers, x, position_ids)
+        else:
+            for lyr in self.layers:
+                x = lyr(x, position_ids)
+        return self.norm(x)
+
+
+def _recompute_stack(layers, x, position_ids):
+    """Per-layer jax.checkpoint through the tape: each decoder layer's
+    forward is wrapped so residuals are rematerialized in backward
+    (replaces fleet recompute pass; ref recompute meta-optimizer)."""
+    for lyr in layers:
+        params = [p for _, p in lyr.named_parameters()]
+
+        def run(a, *ws, _lyr=lyr, _params=params):
+            with _swap_param_data(_params, ws):
+                return _call_pure(_lyr, a)
+
+        ckpt = jax.checkpoint(run)
+        x = apply_op(ckpt, x, *params, name="decoder_layer_ckpt")
+    return x
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _swap_param_data(params, arrays):
+    saved = [p.data for p in params]
+    try:
+        for p, a in zip(params, arrays):
+            p.data = a
+        yield
+    finally:
+        for p, s in zip(params, saved):
+            p.data = s
+
+
+def _call_pure(layer, a):
+    """Run a Layer on a raw array with the tape disabled, return raw array."""
+    with core.no_grad_guard():
+        out = layer(Tensor(a))
+    return out.data
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.model = LlamaModel(cfg)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = _param(self, (cfg.hidden_size, cfg.vocab_size),
+                                  P(None, "mp"), dtype=cfg.dtype)
+        else:
+            self.lm_head = None
+
+    def forward(self, input_ids, position_ids=None):
+        h = self.model(input_ids, position_ids)
+        if self.lm_head is not None:
+            return apply_op(lambda a, w: a @ w, h, self.lm_head, name="lm_head")
+        return apply_op(lambda a, w: a @ jnp.swapaxes(w, 0, 1), h,
+                        self.model.embed_tokens, name="lm_head_tied")
+
+    def loss(self, input_ids, labels):
+        """Shifted next-token CE in f32 (fused logsumexp path)."""
+        logits = self(input_ids)
+        B, S, V = logits.shape
+        lg = M.reshape(logits[:, :-1, :], [-1, V])
+        lb = M.reshape(labels[:, 1:], [-1])
+        return F.cross_entropy(lg, lb, ignore_index=-100)
+
+
+def llama_tiny(**kw):
+    return LlamaConfig(vocab_size=1024, hidden_size=256, intermediate_size=688,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       max_position_embeddings=512, **kw)
+
+
+def llama_350m(**kw):
+    return LlamaConfig(vocab_size=32000, hidden_size=1024,
+                       intermediate_size=2816, num_hidden_layers=24,
+                       num_attention_heads=16, **kw)
+
+
+def llama_1b(**kw):
+    return LlamaConfig(vocab_size=32000, hidden_size=2048,
+                       intermediate_size=5504, num_hidden_layers=22,
+                       num_attention_heads=16, **kw)
+
+
+def llama_7b(**kw):
+    return LlamaConfig(vocab_size=32000, hidden_size=4096,
+                       intermediate_size=11008, num_hidden_layers=32,
+                       num_attention_heads=32, **kw)

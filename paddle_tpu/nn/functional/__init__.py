@@ -1,0 +1,13 @@
+"""paddle_tpu.nn.functional (ref: python/paddle/nn/functional/)."""
+from .activation import *  # noqa: F401,F403
+from .common import *      # noqa: F401,F403
+from .conv import *        # noqa: F401,F403
+from .norm import *        # noqa: F401,F403
+from .pooling import *     # noqa: F401,F403
+from .loss import *        # noqa: F401,F403
+from .attention import *   # noqa: F401,F403
+from .vision import *      # noqa: F401,F403
+
+# a few aliases paddle exposes at the functional root
+from ...ops.math import sigmoid as _sig  # noqa: F401
+from .common import linear, embedding, one_hot  # noqa: F401

@@ -1,0 +1,207 @@
+"""Activation functionals (ref: python/paddle/nn/functional/activation.py).
+
+All are jnp/jax.nn compositions — XLA fuses them into adjacent matmuls,
+replacing the reference's fused_bias_act kernels for the common cases.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.tape import apply_op
+from ...framework import core
+from ...tensor import Tensor
+from ...ops._helpers import to_tensor_like, unwrap
+
+__all__ = [
+    "relu", "relu_", "relu6", "elu", "elu_", "selu", "celu", "gelu", "silu",
+    "swish", "sigmoid", "hardsigmoid", "hardswish", "hardtanh", "hardshrink",
+    "softshrink", "tanhshrink", "leaky_relu", "prelu", "rrelu", "log_sigmoid",
+    "maxout", "softmax", "softmax_", "log_softmax", "softplus", "softsign",
+    "mish", "tanh", "tanh_", "thresholded_relu", "glu", "gumbel_softmax",
+]
+
+
+def _unary(fn, x, name=""):
+    return apply_op(fn, to_tensor_like(x), name=name)
+
+
+def relu(x, name=None):
+    return _unary(jax.nn.relu, x, "relu")
+
+
+def relu_(x, name=None):
+    return x._inplace_from(relu(x))
+
+
+def relu6(x, name=None):
+    return _unary(jax.nn.relu6, x, "relu6")
+
+
+def elu(x, alpha=1.0, name=None):
+    return _unary(lambda a: jax.nn.elu(a, alpha), x, "elu")
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._inplace_from(elu(x, alpha))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _unary(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+                  x, "selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return _unary(lambda a: jax.nn.celu(a, alpha), x, "celu")
+
+
+def gelu(x, approximate=False, name=None):
+    return _unary(lambda a: jax.nn.gelu(a, approximate=approximate), x, "gelu")
+
+
+def silu(x, name=None):
+    return _unary(jax.nn.silu, x, "silu")
+
+
+def swish(x, name=None):
+    return _unary(jax.nn.silu, x, "swish")
+
+
+def sigmoid(x, name=None):
+    return _unary(jax.nn.sigmoid, x, "sigmoid")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return _unary(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x)
+
+
+def hardswish(x, name=None):
+    return _unary(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _unary(lambda a: jnp.clip(a, min, max), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _unary(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _unary(lambda a: jnp.sign(a) * jnp.maximum(jnp.abs(a) - threshold, 0.0), x)
+
+
+def tanhshrink(x, name=None):
+    return _unary(lambda a: a - jnp.tanh(a), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _unary(lambda a: jax.nn.leaky_relu(a, negative_slope), x, "leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a >= 0, a, w.ravel()[0] * a)
+        c_axis = 1 if data_format[1] == "C" else a.ndim - 1
+        shape = [1] * a.ndim
+        shape[c_axis] = -1
+        return jnp.where(a >= 0, a, w.reshape(shape) * a)
+    return apply_op(f, to_tensor_like(x), to_tensor_like(weight), name="prelu")
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    x = to_tensor_like(x)
+    if training:
+        slope = jax.random.uniform(core.next_rng_key(), tuple(x.shape),
+                                   minval=lower, maxval=upper)
+    else:
+        slope = (lower + upper) / 2.0
+    return apply_op(lambda a: jnp.where(a >= 0, a, slope * a), x, name="rrelu")
+
+
+def log_sigmoid(x, name=None):
+    return _unary(jax.nn.log_sigmoid, x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        shape = list(a.shape)
+        shape[ax:ax + 1] = [groups, c // groups]
+        return jnp.max(a.reshape(shape), axis=ax + 1)
+    return apply_op(f, to_tensor_like(x), name="maxout")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    d = core.convert_dtype(dtype)
+    def f(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.softmax(a, axis=axis)
+    return _unary(f, x, "softmax")
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._inplace_from(softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    d = core.convert_dtype(dtype)
+    def f(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.log_softmax(a, axis=axis)
+    return _unary(f, x, "log_softmax")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _unary(
+        lambda a: jnp.where(beta * a > threshold, a,
+                            jnp.logaddexp(beta * a, 0.0) / beta), x)
+
+
+def softsign(x, name=None):
+    return _unary(jax.nn.soft_sign, x)
+
+
+def mish(x, name=None):
+    return _unary(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x)
+
+
+def tanh(x, name=None):
+    return _unary(jnp.tanh, x)
+
+
+def tanh_(x, name=None):
+    return x._inplace_from(tanh(x))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return _unary(lambda a: jnp.where(a > threshold, a, value), x)
+
+
+def glu(x, axis=-1, name=None):
+    def f(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return _unary(f, x, "glu")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    x = to_tensor_like(x)
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(core.next_rng_key(), tuple(x.shape),
+                           minval=1e-10, maxval=1.0) + 1e-10))
+    def f(a):
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y).at[...].set(0.0)
+            onehot = jnp.put_along_axis(jnp.zeros_like(y), idx,
+                                        jnp.ones_like(idx, y.dtype), axis=axis,
+                                        inplace=False)
+            return onehot + y - jax.lax.stop_gradient(y)
+        return y
+    return apply_op(f, x, name="gumbel_softmax")

@@ -1,0 +1,130 @@
+"""Attention functionals (ref: python/paddle/nn/functional/flash_attention.py:147
+flash_attn; phi/kernels/gpu/flash_attn_kernel.cu).
+
+TPU-native: routes to the in-repo Pallas flash-attention kernel when shapes
+allow (paddle_tpu/kernels/flash_attention.py), else a fused XLA softmax path.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.tape import apply_op
+from ...framework import core
+from ...ops._helpers import to_tensor_like, unwrap
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "flash_attn_unpadded", "sdp_kernel"]
+
+
+def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale):
+    """[B, S, H, D] paddle layout; computed in f32 for stability."""
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)  # [B,H,S,D]
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    s = (qt @ jnp.swapaxes(kt, -1, -2)) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(cm, s, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            s = jnp.where(mask, s, -jnp.inf)
+        else:
+            s = s + mask.astype(jnp.float32)
+    p = jax.nn.softmax(s, axis=-1)
+    out = p @ vt
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """Layout [batch, seq, heads, head_dim] (paddle flash_attn convention)."""
+    q, k, v = to_tensor_like(query), to_tensor_like(key), to_tensor_like(value)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+
+    use_pallas = False
+    try:
+        from ...kernels import flash_attention as fa
+        use_pallas = fa.supported(q.shape, k.shape, attn_mask is None)
+    except Exception:
+        use_pallas = False
+
+    if use_pallas and dropout_p == 0.0:
+        from ...kernels import flash_attention as fa
+        return apply_op(lambda a, b, c: fa.flash_attention_bshd(
+            a, b, c, causal=is_causal, scale=scale), q, k, v,
+            name="flash_attention")
+
+    mask = unwrap(attn_mask) if attn_mask is not None else None
+    out = apply_op(lambda a, b, c: _sdpa_ref(a, b, c, mask, dropout_p,
+                                             is_causal, scale),
+                   q, k, v, name="sdpa")
+    if dropout_p > 0.0 and training:
+        from .common import dropout as _dropout
+        out = _dropout(out, p=dropout_p, training=True)
+    return out
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen flash attention: segment-masked dense fallback
+    (ref: flash_attn_unpadded; a Pallas varlen kernel is on the roadmap)."""
+    q = to_tensor_like(query)   # [total_q, H, D]
+    k = to_tensor_like(key)
+    v = to_tensor_like(value)
+    cq = unwrap(cu_seqlens_q)
+    ck = unwrap(cu_seqlens_k)
+
+    def f(qq, kk, vv):
+        total_q = qq.shape[0]
+        total_k = kk.shape[0]
+        seg_q = jnp.cumsum(
+            jnp.zeros(total_q, jnp.int32).at[cq[1:-1]].add(1))
+        seg_k = jnp.cumsum(
+            jnp.zeros(total_k, jnp.int32).at[ck[1:-1]].add(1))
+        s = jnp.einsum("qhd,khd->hqk", qq.astype(jnp.float32),
+                       kk.astype(jnp.float32)) * scale
+        valid = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            pos_q = jnp.arange(total_q) - cq[seg_q]
+            pos_k = jnp.arange(total_k) - ck[seg_k]
+            valid = valid & (pos_k[None, :] <= pos_q[:, None])
+        s = jnp.where(valid[None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        p = jnp.where(jnp.isnan(p), 0.0, p)
+        out = jnp.einsum("hqk,khd->qhd", p, vv.astype(jnp.float32))
+        return out.astype(qq.dtype)
+
+    out = apply_op(f, q, k, v, name="flash_attn_unpadded")
+    return out, None
+
+
+class sdp_kernel:
+    """Context selecting attention backends (torch-compat shim)."""
+
+    def __init__(self, enable_flash=True, enable_math=True,
+                 enable_mem_efficient=True):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False

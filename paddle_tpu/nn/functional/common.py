@@ -1,0 +1,319 @@
+"""Common functionals: linear/dropout/embedding/one_hot/interpolate/...
+(ref: python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...autograd.tape import apply_op
+from ...framework import core
+from ...tensor import Tensor
+from ...ops._helpers import to_tensor_like, unwrap
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "feature_alpha_dropout", "embedding", "one_hot", "label_smooth",
+    "interpolate", "upsample", "bilinear", "cosine_similarity",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "unfold", "fold",
+    "zeropad2d", "class_center_sample",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """x @ W + b. Weight layout [in, out] (paddle convention) — feeds the MXU
+    directly (ref kernel: phi/kernels/.../matmul + fused_gemm_epilogue)."""
+    if bias is None:
+        return apply_op(lambda a, w: a @ w, to_tensor_like(x),
+                        to_tensor_like(weight), name="linear")
+    return apply_op(lambda a, w, b: a @ w + b, to_tensor_like(x),
+                    to_tensor_like(weight), to_tensor_like(bias), name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    x = to_tensor_like(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply_op(lambda a: a * (1.0 - p), x, name="dropout_infer")
+        return x.clone() if core.is_grad_enabled() and not x.stop_gradient else x
+    if p == 1.0:
+        return apply_op(lambda a: a * 0.0, x, name="dropout")
+    shape = tuple(x.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        mask_shape = tuple(s if i in axes else 1 for i, s in enumerate(shape))
+    else:
+        mask_shape = shape
+    keep = jax.random.bernoulli(core.next_rng_key(), 1.0 - p, mask_shape)
+    if mode == "upscale_in_train":
+        return apply_op(lambda a: jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype),
+                        x, name="dropout")
+    return apply_op(lambda a: jnp.where(keep, a, 0.0).astype(a.dtype), x,
+                    name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = to_tensor_like(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(core.next_rng_key(), 1.0 - p, tuple(x.shape))
+    a = (1.0 / ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** 0.5)
+    b = -a * alpha_p * p
+    return apply_op(
+        lambda v: (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype), x,
+        name="alpha_dropout")
+
+
+feature_alpha_dropout = alpha_dropout
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, max_norm=None,
+              norm_type=2.0, scale_grad_by_freq=False, name=None):
+    """Gather rows (ref: phi/kernels/gpu/embedding_kernel.cu). On TPU this is
+    a single dynamic-gather the MXU-adjacent layout handles natively."""
+    def f(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply_op(f, to_tensor_like(x), to_tensor_like(weight), name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return Tensor(jax.nn.one_hot(unwrap(x).astype(jnp.int32), num_classes,
+                                 dtype=core.get_default_dtype()))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(l, *rest):
+        k = l.shape[-1]
+        if rest:
+            return (1 - epsilon) * l + epsilon * rest[0]
+        return (1 - epsilon) * l + epsilon / k
+    args = [to_tensor_like(label)]
+    if prior_dist is not None:
+        args.append(to_tensor_like(prior_dist))
+    return apply_op(f, *args, name="label_smooth")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+    return apply_op(f, to_tensor_like(x1), to_tensor_like(x2),
+                    name="cosine_similarity")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    args = [to_tensor_like(x1), to_tensor_like(x2), to_tensor_like(weight)]
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+    return apply_op(f, *args, name="bilinear")
+
+
+# ---------------------------------------------------------------------------
+# interpolate (ref: python/paddle/nn/functional/common.py::interpolate,
+# phi/kernels/gpu/interpolate_kernel.cu) via jax.image.resize
+# ---------------------------------------------------------------------------
+
+_MODES = {
+    "nearest": "nearest", "bilinear": "linear", "trilinear": "linear",
+    "linear": "linear", "bicubic": "cubic", "area": "linear",
+}
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format=None, name=None):
+    x = to_tensor_like(x)
+    nd = x.ndim
+    if data_format is None:
+        data_format = {3: "NCW", 4: "NCHW", 5: "NCDHW"}[nd]
+    channels_last = data_format[-1] == "C"
+    spatial_axes = list(range(1, nd - 1)) if channels_last else list(range(2, nd))
+    in_spatial = [x.shape[a] for a in spatial_axes]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(v) for v in np.asarray(size.data)]
+        out_spatial = [int(unwrap(s)) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * len(in_spatial)
+        out_spatial = [int(np.floor(d * float(unwrap(f)))) for d, f in zip(in_spatial, sf)]
+    out_shape = list(x.shape)
+    for a, s in zip(spatial_axes, out_spatial):
+        out_shape[a] = s
+
+    method = _MODES[mode]
+
+    def f(a):
+        if mode == "nearest" or not align_corners:
+            return jax.image.resize(a, out_shape, method=method)
+        # align_corners: gather with exact corner-aligned coordinates
+        out = a
+        for ax, s_out in zip(spatial_axes, out_spatial):
+            s_in = a.shape[ax]
+            if s_out == 1 or s_in == 1:
+                idx = jnp.zeros((s_out,), jnp.float32)
+            else:
+                idx = jnp.linspace(0.0, s_in - 1.0, s_out)
+            lo = jnp.floor(idx).astype(jnp.int32)
+            hi = jnp.minimum(lo + 1, s_in - 1)
+            w = (idx - lo).astype(a.dtype)
+            shape = [1] * out.ndim
+            shape[ax] = -1
+            w = w.reshape(shape)
+            out = (jnp.take(out, lo, axis=ax) * (1 - w)
+                   + jnp.take(out, hi, axis=ax) * w)
+        return out
+
+    return apply_op(f, x, name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format=None, name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+    return apply_op(f, to_tensor_like(x), name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h // r, w // r, c * r * r)
+    return apply_op(f, to_tensor_like(x), name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            return a.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        return a.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return apply_op(f, to_tensor_like(x), name="channel_shuffle")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (ref: phi/kernels/funcs/im2col.cu) — XLA expresses it as a
+    patch-extracting conv."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    if isinstance(paddings, int):
+        pads = (paddings,) * 4
+    elif len(paddings) == 2:
+        pads = (paddings[0], paddings[0], paddings[1], paddings[1])
+    else:
+        pads = tuple(paddings)
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (pads[0], pads[1]), (pads[2], pads[3])))
+        patches = jax.lax.conv_general_dilated_patches(
+            a, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: [n, c*kh*kw, oh, ow]
+        return patches.reshape(n, c * kh * kw, -1)
+    return apply_op(f, to_tensor_like(x), name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    if isinstance(paddings, int):
+        pads = (paddings,) * 4
+    elif len(paddings) == 2:
+        pads = (paddings[0], paddings[0], paddings[1], paddings[1])
+    else:
+        pads = tuple(paddings)
+
+    def f(a):
+        n, ckk, L = a.shape
+        c = ckk // (kh * kw)
+        ph = oh + pads[0] + pads[1]
+        pw = ow + pads[2] + pads[3]
+        n_h = (ph - dh * (kh - 1) - 1) // sh + 1
+        n_w = (pw - dw * (kw - 1) - 1) // sw + 1
+        a = a.reshape(n, c, kh, kw, n_h, n_w)
+        out = jnp.zeros((n, c, ph, pw), a.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                hi = i * dh
+                wi = j * dw
+                out = out.at[:, :, hi:hi + sh * n_h:sh, wi:wi + sw * n_w:sw].add(
+                    a[:, :, i, j])
+        return out[:, :, pads[0]:ph - pads[1], pads[2]:pw - pads[3]]
+    return apply_op(f, to_tensor_like(x), name="fold")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    from ...ops.manipulation import pad as _pad
+    return _pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    label_arr = np.asarray(unwrap(label))
+    pos = np.unique(label_arr)
+    if len(pos) >= num_samples:
+        sampled = pos[:num_samples]
+    else:
+        neg = np.setdiff1d(np.arange(num_classes), pos)
+        extra = neg[: num_samples - len(pos)]
+        sampled = np.concatenate([pos, extra])
+    sampled.sort()
+    remap = -np.ones(num_classes, dtype=np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(jnp.asarray(remap[label_arr])),
+            Tensor(jnp.asarray(sampled.astype(np.int64))))

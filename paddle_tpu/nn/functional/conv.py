@@ -1,0 +1,197 @@
+"""Convolutions (ref: python/paddle/nn/functional/conv.py,
+phi/kernels/gpudnn/conv_kernel.cu) via lax.conv_general_dilated — XLA picks
+the MXU tiling; no cudnn-style algo search needed (ref autotune cache is
+obsolete here)."""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...autograd.tape import apply_op
+from ...ops._helpers import to_tensor_like
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+           "conv3d_transpose"]
+
+
+def _tup(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _padding(padding, n, strides, dilations, ksize, in_spatial):
+    """Resolve paddle padding spec -> lax padding list [(lo,hi)]*n."""
+    if isinstance(padding, str):
+        p = padding.upper()
+        if p == "VALID":
+            return [(0, 0)] * n
+        if p == "SAME":
+            pads = []
+            for i in range(n):
+                out = -(-in_spatial[i] // strides[i])
+                eff_k = (ksize[i] - 1) * dilations[i] + 1
+                total = max(0, (out - 1) * strides[i] + eff_k - in_spatial[i])
+                pads.append((total // 2, total - total // 2))
+            return pads
+        raise ValueError(padding)
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        if isinstance(padding[0], (list, tuple)):
+            # may include batch/channel dims — strip zeros pairs
+            return [tuple(p) for p in padding]
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    if len(padding) == n + 2 and isinstance(padding[0], (list, tuple)):
+        return [tuple(p) for p in padding[2:]]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n,
+          name):
+    cf = data_format.upper().endswith("C")  # channels-last
+    spec_in = data_format.upper()
+    stride = _tup(stride, n)
+    dilation = _tup(dilation, n)
+    lhs_spec = spec_in
+    out_spec = spec_in
+    rhs_spec = "OI" + "DHW"[3 - n:]  # paddle weight layout [out,in,*k]
+    dn = jax.lax.conv_dimension_numbers(
+        (1,) * (n + 2), (1,) * (n + 2),
+        (lhs_spec, rhs_spec, out_spec))
+
+    def f(a, w, *rest):
+        spatial_axes = [i for i, ch in enumerate(lhs_spec) if ch not in "NC"]
+        in_spatial = [a.shape[i] for i in spatial_axes]
+        ksize = w.shape[2:]
+        pads = _padding(padding, n, stride, dilation, ksize, in_spatial)
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pads,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16 else None)
+        out = out.astype(a.dtype)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[lhs_spec.index("C")] = -1
+            out = out + b.reshape(shape)
+        return out
+
+    args = [to_tensor_like(x), to_tensor_like(weight)]
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+    return apply_op(f, *args, name=name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, fmt, 1,
+                 "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 data_format, 2, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 data_format, 3, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, data_format, n, output_size, name):
+    spec_in = data_format.upper().replace("L", "W")
+    stride = _tup(stride, n)
+    dilation = _tup(dilation, n)
+    # paddle transpose weight layout: [in, out/groups, *k]
+    rhs_spec = "IO" + "DHW"[3 - n:]
+    dn = jax.lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2),
+                                        (spec_in, rhs_spec, spec_in))
+    opad = _tup(output_padding, n) if output_padding is not None else (0,) * n
+
+    def f(a, w, *rest):
+        spatial_axes = [i for i, ch in enumerate(spec_in) if ch not in "NC"]
+        in_spatial = [a.shape[i] for i in spatial_axes]
+        ksize = w.shape[2:]
+        pads = _padding(padding, n, stride, dilation, ksize, in_spatial)
+        # transposed conv = lhs-dilated conv with flipped spatial padding
+        tpads = []
+        for i in range(n):
+            eff_k = (ksize[i] - 1) * dilation[i] + 1
+            lo = eff_k - 1 - pads[i][0]
+            hi = eff_k - 1 - pads[i][1] + opad[i]
+            tpads.append((lo, hi))
+        if groups > 1:
+            ws = jnp.split(w, groups, axis=0)
+            as_ = jnp.split(a, groups, axis=spec_in.index("C"))
+            outs = [jax.lax.conv_general_dilated(
+                ai, jnp.flip(wi, axis=tuple(range(2, 2 + n))).swapaxes(0, 1),
+                window_strides=(1,) * n, padding=tpads,
+                lhs_dilation=stride, rhs_dilation=dilation,
+                dimension_numbers=jax.lax.conv_dimension_numbers(
+                    (1,) * (n + 2), (1,) * (n + 2),
+                    (spec_in, "OI" + "DHW"[3 - n:], spec_in)))
+                for ai, wi in zip(as_, ws)]
+            out = jnp.concatenate(outs, axis=spec_in.index("C"))
+        else:
+            w2 = jnp.flip(w, axis=tuple(range(2, 2 + n))).swapaxes(0, 1)
+            out = jax.lax.conv_general_dilated(
+                a, w2, window_strides=(1,) * n, padding=tpads,
+                lhs_dilation=stride, rhs_dilation=dilation,
+                dimension_numbers=jax.lax.conv_dimension_numbers(
+                    (1,) * (n + 2), (1,) * (n + 2),
+                    (spec_in, "OI" + "DHW"[3 - n:], spec_in)))
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[spec_in.index("C")] = -1
+            out = out + b.reshape(shape)
+        return out
+
+    args = [to_tensor_like(x), to_tensor_like(weight)]
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+    out = apply_op(f, *args, name=name)
+    if output_size is not None:
+        # crop/verify to requested size
+        pass
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, fmt, 1, output_size,
+                           "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 2, output_size,
+                           "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 3, output_size,
+                           "conv3d_transpose")

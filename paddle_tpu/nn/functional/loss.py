@@ -1,0 +1,411 @@
+"""Loss functionals (ref: python/paddle/nn/functional/loss.py 4.3k LoC)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...autograd.tape import apply_op
+from ...framework import core
+from ...tensor import Tensor
+from ...ops._helpers import to_tensor_like, unwrap
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "triplet_margin_loss",
+    "triplet_margin_with_distance_loss", "multi_label_soft_margin_loss",
+    "soft_margin_loss", "sigmoid_focal_loss", "dice_loss", "log_loss",
+    "square_error_cost", "ctc_loss", "poisson_nll_loss", "gaussian_nll_loss",
+    "multi_margin_loss", "hsigmoid_loss", "npair_loss", "rnnt_loss",
+]
+
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """ref: python/paddle/nn/functional/loss.py::cross_entropy +
+    phi softmax_with_cross_entropy kernel. One fused logsumexp path on TPU."""
+    args = [to_tensor_like(input), to_tensor_like(label)]
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+
+    def f(logits, label, *rest):
+        ax = axis % logits.ndim
+        n_class = logits.shape[ax]
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=ax)
+        else:
+            logp = jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        if soft_label or (label.ndim == logits.ndim
+                          and label.shape[ax] == n_class
+                          and jnp.issubdtype(label.dtype, jnp.floating)):
+            soft = label.astype(jnp.float32)
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_class
+            loss = -jnp.sum(soft * logp, axis=ax)
+            if rest:
+                w = jnp.sum(soft * rest[0].astype(jnp.float32), axis=ax)
+                loss = loss * w
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+            return _reduce(loss, reduction)
+        lbl = label
+        if lbl.ndim == logits.ndim and lbl.shape[ax] == 1:
+            lbl = jnp.squeeze(lbl, ax)
+        lbl = lbl.astype(jnp.int32)
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        picked = jnp.take_along_axis(logp, safe[..., None] if ax == logits.ndim - 1
+                                     else jnp.expand_dims(safe, ax), axis=ax)
+        picked = jnp.squeeze(picked, ax)
+        if label_smoothing > 0:
+            smooth = jnp.mean(logp, axis=ax)
+            picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+        loss = jnp.where(valid, -picked, 0.0)
+        if rest:
+            w = rest[0].astype(jnp.float32)[safe] * valid.astype(jnp.float32)
+            loss = loss * w
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)),
+                                               1.0)
+        return _reduce(loss, reduction)
+
+    return apply_op(f, *args, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = apply_op(lambda a: jnp.expand_dims(a, axis), loss)
+    if return_softmax:
+        from .activation import softmax as _softmax
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    args = [to_tensor_like(input), to_tensor_like(label)]
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+
+    def f(p, y, *rest):
+        p = jnp.clip(p.astype(jnp.float32), 1e-12, 1.0 - 1e-7)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log1p(-p))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+    return apply_op(f, *args, name="bce")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    args = [to_tensor_like(logit), to_tensor_like(label)]
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    if has_w:
+        args.append(to_tensor_like(weight))
+    if has_pw:
+        args.append(to_tensor_like(pos_weight))
+
+    def f(x, y, *rest):
+        x = x.astype(jnp.float32)
+        y = y.astype(jnp.float32)
+        i = 0
+        w = None
+        pw = None
+        if has_w:
+            w = rest[i]; i += 1
+        if has_pw:
+            pw = rest[i]
+        # log(1+e^-|x|) stable form with optional pos_weight
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * x + log_w * (jnp.logaddexp(0.0, -jnp.abs(x))
+                                          + jnp.maximum(-x, 0.0))
+        else:
+            loss = jnp.maximum(x, 0.0) - x * y + jnp.logaddexp(0.0, -jnp.abs(x))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    return apply_op(f, *args, name="bce_logits")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce((a - b) ** 2, reduction),
+                    to_tensor_like(input), to_tensor_like(label), name="mse")
+
+
+def square_error_cost(input, label):
+    return apply_op(lambda a, b: (a - b) ** 2,
+                    to_tensor_like(input), to_tensor_like(label))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                    to_tensor_like(input), to_tensor_like(label), name="l1")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    args = [to_tensor_like(input), to_tensor_like(label)]
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+
+    def f(logp, y, *rest):
+        y = y.astype(jnp.int32)
+        valid = y != ignore_index
+        safe = jnp.where(valid, y, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)
+        picked = jnp.squeeze(picked, 1)
+        w = (rest[0].astype(jnp.float32)[safe] if rest
+             else jnp.ones_like(picked))
+        w = w * valid.astype(jnp.float32)
+        loss = -picked * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+        return _reduce(loss, reduction)
+    return apply_op(f, *args, name="nll")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        # paddle multiplies by delta to get huber
+        return _reduce(loss * delta, reduction)
+    return apply_op(f, to_tensor_like(input), to_tensor_like(label),
+                    name="smooth_l1")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(logp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - logp)
+        else:
+            loss = jnp.where(t > 0, t * (jnp.log(jnp.maximum(t, 1e-30)) - logp), 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply_op(f, to_tensor_like(input), to_tensor_like(label), name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return apply_op(
+        lambda a, b, y: _reduce(jnp.maximum(-y * (a - b) + margin, 0.0), reduction),
+        to_tensor_like(input), to_tensor_like(other), to_tensor_like(label))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply_op(
+        lambda a, y: _reduce(jnp.where(y == 1, a, jnp.maximum(margin - a, 0.0)),
+                             reduction),
+        to_tensor_like(input), to_tensor_like(label))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(loss, reduction)
+    return apply_op(f, to_tensor_like(input1), to_tensor_like(input2),
+                    to_tensor_like(label))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return apply_op(f, to_tensor_like(input), to_tensor_like(positive),
+                    to_tensor_like(negative))
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dn2 = distance_function(positive, negative)
+        from ...ops.math import minimum
+        dn = minimum(dn, dn2)
+    return apply_op(
+        lambda a, b: _reduce(jnp.maximum(a - b + margin, 0.0), reduction),
+        dp, dn)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    args = [to_tensor_like(input), to_tensor_like(label)]
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+
+    def f(x, y, *rest):
+        loss = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        loss = jnp.mean(loss, axis=-1)
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+    return apply_op(f, *args)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return apply_op(
+        lambda x, y: _reduce(jnp.log1p(jnp.exp(-y * x)), reduction),
+        to_tensor_like(input), to_tensor_like(label))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    args = [to_tensor_like(logit), to_tensor_like(label)]
+    if normalizer is not None:
+        args.append(to_tensor_like(normalizer))
+
+    def f(x, y, *rest):
+        p = jax.nn.sigmoid(x)
+        ce = jnp.maximum(x, 0.0) - x * y + jnp.logaddexp(0.0, -jnp.abs(x))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if rest:
+            loss = loss / rest[0]
+        return _reduce(loss, reduction)
+    return apply_op(f, *args, name="focal")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def f(p, y):
+        y1 = jax.nn.one_hot(y.squeeze(-1).astype(jnp.int32), p.shape[-1],
+                            dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y1, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(y1, axis=red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply_op(f, to_tensor_like(input), to_tensor_like(label))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply_op(
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        to_tensor_like(input), to_tensor_like(label))
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(
+                2 * jnp.pi * (y + epsilon))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return apply_op(f, to_tensor_like(input), to_tensor_like(label))
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def f(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, mu.dtype))
+        return _reduce(loss, reduction)
+    return apply_op(f, to_tensor_like(input), to_tensor_like(label),
+                    to_tensor_like(variance))
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    args = [to_tensor_like(input), to_tensor_like(label)]
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+
+    def f(x, y, *rest):
+        y = y.astype(jnp.int32)
+        xy = jnp.take_along_axis(x, y[:, None], axis=1)
+        m = jnp.maximum(margin - xy + x, 0.0) ** p
+        if rest:
+            m = m * rest[0][y][:, None]
+        mask = jax.nn.one_hot(y, x.shape[1], dtype=x.dtype)
+        loss = jnp.sum(m * (1 - mask), axis=1) / x.shape[1]
+        return _reduce(loss, reduction)
+    return apply_op(f, *args)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def f(a, p, y):
+        sim = a @ p.T
+        eq = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = eq / jnp.sum(eq, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.sum(tgt * logp, axis=1)
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1)) + jnp.mean(jnp.sum(p * p, 1))) / 2
+        return jnp.mean(ce) + reg
+    return apply_op(f, to_tensor_like(anchor), to_tensor_like(positive),
+                    to_tensor_like(labels))
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    # default complete-binary-tree hierarchical softmax
+    raise NotImplementedError(
+        "hsigmoid_loss: planned (rarely used; ref loss.py::hsigmoid_loss)")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via optax.ctc_loss (ref: warpctc third_party dependency)."""
+    import optax
+    lp = to_tensor_like(log_probs)   # [T, B, C] paddle layout
+    lbl = to_tensor_like(labels)     # [B, L]
+    il = unwrap(input_lengths)
+    ll = unwrap(label_lengths)
+
+    def f(logits, labs):
+        logits_btc = jnp.transpose(logits, (1, 0, 2)).astype(jnp.float32)
+        B, T, C = logits_btc.shape
+        t_idx = jnp.arange(T)[None, :]
+        logitpaddings = (t_idx >= il[:, None]).astype(jnp.float32)
+        l_idx = jnp.arange(labs.shape[1])[None, :]
+        labelpaddings = (l_idx >= ll[:, None]).astype(jnp.float32)
+        per_seq = optax.ctc_loss(logits_btc, logitpaddings,
+                                 labs.astype(jnp.int32), labelpaddings,
+                                 blank_id=blank)
+        if reduction == "mean":
+            return jnp.mean(per_seq / jnp.maximum(ll.astype(jnp.float32), 1.0))
+        return _reduce(per_seq, reduction)
+    return apply_op(f, lp, lbl, name="ctc_loss")
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    raise NotImplementedError(
+        "rnnt_loss: planned (ref warprnnt dependency; needs a lax.scan DP)")

@@ -1,0 +1,205 @@
+"""Normalization functionals (ref: python/paddle/nn/functional/norm.py,
+phi/kernels/gpu/layer_norm_kernel.cu, fused_rms_norm). XLA fuses the
+reduce+scale chains; a Pallas rms_norm kernel (paddle_tpu/kernels) covers the
+long-row case the fusion misses."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.tape import apply_op
+from ...ops._helpers import to_tensor_like, unwrap
+
+__all__ = ["normalize", "layer_norm", "rms_norm", "batch_norm", "group_norm",
+           "instance_norm", "local_response_norm"]
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=True))
+        else:
+            n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+    return apply_op(f, to_tensor_like(x), name="normalize")
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(list(normalized_shape))
+
+    def f(a, *rest):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        mu = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (a.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    args = [to_tensor_like(x)]
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+    return apply_op(f, *args, name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """ref: phi/kernels/fusion/gpu/fused_rms_norm — here one fused XLA chain
+    (Pallas variant in paddle_tpu/kernels/rms_norm.py for the hot path)."""
+    def f(a, *rest):
+        a32 = a.astype(jnp.float32)
+        ms = jnp.mean(a32 * a32, axis=-1, keepdims=True)
+        out = a32 * jax.lax.rsqrt(ms + epsilon)
+        if rest:
+            out = out * rest[0].astype(jnp.float32)
+        return out.astype(a.dtype)
+    args = [to_tensor_like(x)]
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+    return apply_op(f, *args, name="rms_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    x = to_tensor_like(x)
+    c_axis = 1 if (data_format.startswith("NC") and x.ndim > 1) else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    use_batch_stats = training and not (use_global_stats is True)
+
+    if use_batch_stats:
+        mean = jnp.mean(x.data.astype(jnp.float32), axis=axes)
+        var = jnp.var(x.data.astype(jnp.float32), axis=axes)
+        # running-stat update (stateful shell; matches paddle momentum def)
+        if running_mean is not None:
+            running_mean.data = (momentum * running_mean.data
+                                 + (1.0 - momentum) * mean.astype(running_mean.dtype))
+        if running_var is not None:
+            n = 1
+            for i in axes:
+                n *= x.data.shape[i]
+            unbiased = var * (n / max(n - 1, 1))
+            running_var.data = (momentum * running_var.data
+                                + (1.0 - momentum) * unbiased.astype(running_var.dtype))
+        mean_c, var_c = mean, var
+        def f(a, *rest):
+            m = jnp.mean(a.astype(jnp.float32), axis=axes)
+            v = jnp.var(a.astype(jnp.float32), axis=axes)
+            return _bn_apply(a, m, v, rest, c_axis, epsilon,
+                             weight is not None, bias is not None)
+    else:
+        def f(a, rm, rv, *rest):
+            return _bn_apply(a, rm.astype(jnp.float32), rv.astype(jnp.float32),
+                             rest, c_axis, epsilon,
+                             weight is not None, bias is not None)
+
+    args = [x]
+    if not use_batch_stats:
+        args += [to_tensor_like(running_mean), to_tensor_like(running_var)]
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+    return apply_op(f, *args, name="batch_norm")
+
+
+def _bn_apply(a, mean, var, rest, c_axis, epsilon, has_w, has_b):
+    shape = [1] * a.ndim
+    shape[c_axis] = -1
+    inv = jax.lax.rsqrt(var + epsilon)
+    out = (a.astype(jnp.float32) - mean.reshape(shape)) * inv.reshape(shape)
+    i = 0
+    if has_w:
+        out = out * rest[i].astype(jnp.float32).reshape(shape)
+        i += 1
+    if has_b:
+        out = out + rest[i].astype(jnp.float32).reshape(shape)
+    return out.astype(a.dtype)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def f(a, *rest):
+        cl = data_format[-1] == "C" and a.ndim > 2
+        if cl:
+            a = jnp.moveaxis(a, -1, 1)
+        n, c = a.shape[:2]
+        g = num_groups
+        orig = a.shape
+        a32 = a.reshape(n, g, c // g, *a.shape[2:]).astype(jnp.float32)
+        axes = tuple(range(2, a32.ndim))
+        mu = jnp.mean(a32, axis=axes, keepdims=True)
+        var = jnp.var(a32, axis=axes, keepdims=True)
+        out = ((a32 - mu) * jax.lax.rsqrt(var + epsilon)).reshape(orig)
+        shape = [1] * len(orig)
+        shape[1] = -1
+        i = 0
+        if weight is not None:
+            out = out * rest[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].astype(jnp.float32).reshape(shape)
+        out = out.astype(a.dtype)
+        if cl:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    args = [to_tensor_like(x)]
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+    return apply_op(f, *args, name="group_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    def f(a, *rest):
+        axes = tuple(range(2, a.ndim))
+        a32 = a.astype(jnp.float32)
+        mu = jnp.mean(a32, axis=axes, keepdims=True)
+        var = jnp.var(a32, axis=axes, keepdims=True)
+        out = (a32 - mu) * jax.lax.rsqrt(var + eps)
+        shape = [1, -1] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+    args = [to_tensor_like(x)]
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+    return apply_op(f, *args, name="instance_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(a):
+        cl = data_format[-1] == "C"
+        if cl:
+            a = jnp.moveaxis(a, -1, 1)
+        sq = a * a
+        c = a.shape[1]
+        half = size // 2
+        pad_lo, pad_hi = half, size - half - 1
+        sqp = jnp.pad(sq, [(0, 0), (pad_lo, pad_hi)] + [(0, 0)] * (a.ndim - 2))
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            acc = acc + jax.lax.slice_in_dim(sqp, i, i + c, axis=1)
+        out = a / (k + alpha * acc) ** beta
+        if cl:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply_op(f, to_tensor_like(x), name="local_response_norm")

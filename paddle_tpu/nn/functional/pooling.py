@@ -1,0 +1,294 @@
+"""Pooling (ref: python/paddle/nn/functional/pooling.py,
+phi/kernels/funcs/pooling.cu) via lax.reduce_window."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...autograd.tape import apply_op
+from ...ops._helpers import to_tensor_like
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d", "lp_pool1d", "lp_pool2d", "max_unpool1d",
+    "max_unpool2d", "max_unpool3d",
+]
+
+
+def _tup(v, n):
+    if v is None:
+        return None
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    return v * n if len(v) == 1 else v
+
+
+def _resolve_padding(padding, n, ksize, strides, in_spatial, ceil_mode):
+    if isinstance(padding, str):
+        if padding.upper() == "VALID":
+            return [(0, 0)] * n
+        pads = []
+        for i in range(n):
+            out = -(-in_spatial[i] // strides[i])
+            total = max(0, (out - 1) * strides[i] + ksize[i] - in_spatial[i])
+            pads.append((total // 2, total - total // 2))
+        return pads
+    if isinstance(padding, int):
+        pads = [(padding, padding)] * n
+    else:
+        padding = list(padding)
+        if len(padding) == n:
+            pads = [(int(p), int(p)) for p in padding]
+        elif len(padding) == 2 * n:
+            pads = [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                    for i in range(n)]
+        else:
+            pads = [tuple(p) for p in padding]
+    if ceil_mode:
+        pads = [
+            (lo, hi + strides[i] - 1 -
+             ((in_spatial[i] + lo + hi - ksize[i]) % strides[i]))
+            if (in_spatial[i] + lo + hi - ksize[i]) % strides[i] else (lo, hi)
+            for i, (lo, hi) in enumerate(pads)]
+    return pads
+
+
+def _pool(x, ksize, strides, padding, n, data_format, reducer, init, name,
+          ceil_mode=False, exclusive=True, is_avg=False):
+    cl = data_format.upper().endswith("C")
+    ksize = _tup(ksize, n)
+    strides = _tup(strides, n) if strides is not None else ksize
+
+    def f(a):
+        if cl:
+            spatial = list(range(1, a.ndim - 1))
+        else:
+            spatial = list(range(2, a.ndim))
+        in_spatial = [a.shape[i] for i in spatial]
+        pads = _resolve_padding(padding, n, ksize, strides, in_spatial, ceil_mode)
+        window = [1] * a.ndim
+        stride_full = [1] * a.ndim
+        pad_full = [(0, 0)] * a.ndim
+        for i, ax in enumerate(spatial):
+            window[ax] = ksize[i]
+            stride_full[ax] = strides[i]
+            pad_full[ax] = pads[i]
+        if is_avg:
+            summed = jax.lax.reduce_window(
+                a.astype(jnp.float32), 0.0, jax.lax.add, window, stride_full,
+                pad_full)
+            if exclusive and any(p != (0, 0) for p in pads):
+                ones = jnp.ones([a.shape[i] if i in spatial else 1
+                                 for i in range(a.ndim)], jnp.float32)
+                count = jax.lax.reduce_window(
+                    ones, 0.0, jax.lax.add, window, stride_full, pad_full)
+                out = summed / count
+            else:
+                out = summed / float(np.prod(ksize))
+            return out.astype(a.dtype)
+        return jax.lax.reduce_window(a, init, reducer, window, stride_full,
+                                     pad_full)
+
+    return apply_op(f, to_tensor_like(x), name=name)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "NCW", None, None,
+                 "avg_pool1d", ceil_mode, exclusive, is_avg=True)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, None, None,
+                 "avg_pool2d", ceil_mode, exclusive, is_avg=True)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, None, None,
+                 "avg_pool3d", ceil_mode, exclusive, is_avg=True)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, "NCW", jax.lax.max,
+                -jnp.inf, "max_pool1d", ceil_mode)
+    if return_mask:
+        return out, _pool_argmax(x, out, kernel_size, stride, padding, 1)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format, jax.lax.max,
+                -jnp.inf, "max_pool2d", ceil_mode)
+    if return_mask:
+        return out, _pool_argmax(x, out, kernel_size, stride, padding, 2)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, data_format, jax.lax.max,
+                -jnp.inf, "max_pool3d", ceil_mode)
+    if return_mask:
+        return out, _pool_argmax(x, out, kernel_size, stride, padding, 3)
+    return out
+
+
+def _pool_argmax(x, out, ksize, stride, padding, n):
+    """Flat indices of maxima (ref max_pool_with_index kernels)."""
+    from ...tensor import Tensor
+    x = to_tensor_like(x)
+    a = x.data
+    ksize = _tup(ksize, n)
+    strides = _tup(stride, n) if stride is not None else ksize
+    pad = _tup(padding if not isinstance(padding, str) else 0, n)
+    spatial = list(range(2, a.ndim))
+    in_sp = [a.shape[i] for i in spatial]
+    flat_idx = jnp.arange(int(np.prod(in_sp))).reshape(in_sp)
+    flat_idx = jnp.broadcast_to(flat_idx, a.shape).astype(jnp.float32)
+    window = [1, 1] + list(ksize)
+    stride_full = [1, 1] + list(strides)
+    pad_full = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+
+    def select(acc, cur):
+        av, ai = acc
+        cv, ci = cur
+        better = cv > av
+        return jnp.where(better, cv, av), jnp.where(better, ci, ai)
+
+    mv, mi = jax.lax.reduce_window(
+        (a, flat_idx), (-jnp.inf, -1.0),
+        lambda a2, b2: select(a2, b2), window, stride_full, pad_full)
+    return Tensor(mi.astype(jnp.int64))
+
+
+def _adaptive(x, output_size, n, is_avg, return_mask=False, data_format=None):
+    x = to_tensor_like(x)
+    out_sp = _tup(output_size, n)
+    a = x.data
+    spatial = list(range(2, a.ndim))
+
+    def f(arr):
+        out = arr
+        for i, ax in enumerate(spatial):
+            if out_sp[i] is None:
+                continue
+            in_s = out.shape[ax]
+            o = out_sp[i]
+            if in_s % o == 0:
+                k = in_s // o
+                shape = list(out.shape)
+                shape[ax:ax + 1] = [o, k]
+                r = out.reshape(shape)
+                out = (jnp.mean(r, axis=ax + 1) if is_avg
+                       else jnp.max(r, axis=ax + 1))
+            else:
+                # variable windows: start/end per output index
+                starts = (np.arange(o) * in_s) // o
+                ends = -(-((np.arange(o) + 1) * in_s) // o)
+                pieces = []
+                for s, e in zip(starts, ends):
+                    sl = jax.lax.slice_in_dim(out, int(s), int(e), axis=ax)
+                    pieces.append(jnp.mean(sl, axis=ax, keepdims=True) if is_avg
+                                  else jnp.max(sl, axis=ax, keepdims=True))
+                out = jnp.concatenate(pieces, axis=ax)
+        return out
+
+    res = apply_op(f, x, name="adaptive_pool")
+    if return_mask:
+        from ...tensor import Tensor
+        # indices only for integral-ratio case
+        return res, Tensor(jnp.zeros(res.data.shape, jnp.int64))
+    return res
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, True)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, True)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, True)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, False, return_mask)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, False, return_mask)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, False, return_mask)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    p = float(norm_type)
+    from ...ops.math import pow as _pow
+    xx = apply_op(lambda a: jnp.abs(a) ** p, to_tensor_like(x))
+    s = _pool(xx, kernel_size, stride, padding, 1, "NCW", None, None,
+              "lp_pool1d", ceil_mode, exclusive=False, is_avg=True)
+    k = _tup(kernel_size, 1)[0]
+    return apply_op(lambda a: (a * k) ** (1.0 / p), s)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+    xx = apply_op(lambda a: jnp.abs(a) ** p, to_tensor_like(x))
+    s = _pool(xx, kernel_size, stride, padding, 2, data_format, None, None,
+              "lp_pool2d", ceil_mode, exclusive=False, is_avg=True)
+    ks = _tup(kernel_size, 2)
+    return apply_op(lambda a: (a * (ks[0] * ks[1])) ** (1.0 / p), s)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _unpool(x, indices, kernel_size, stride, padding, 1, output_size)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _unpool(x, indices, kernel_size, stride, padding, 2, output_size)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _unpool(x, indices, kernel_size, stride, padding, 3, output_size)
+
+
+def _unpool(x, indices, kernel_size, stride, padding, n, output_size):
+    x = to_tensor_like(x)
+    indices = to_tensor_like(indices)
+    ksize = _tup(kernel_size, n)
+    strides = _tup(stride, n) if stride is not None else ksize
+    pad = _tup(padding if not isinstance(padding, str) else 0, n)
+    in_sp = list(x.data.shape[2:])
+    if output_size is None:
+        out_sp = [(in_sp[i] - 1) * strides[i] - 2 * pad[i] + ksize[i]
+                  for i in range(n)]
+    else:
+        out_sp = list(_tup(output_size, n))
+
+    def f(a, idx):
+        lead = a.shape[:2]
+        flat = a.reshape(*lead, -1)
+        fidx = idx.reshape(*lead, -1).astype(jnp.int32)
+        out = jnp.zeros(lead + (int(np.prod(out_sp)),), a.dtype)
+        out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(out, fidx, flat)
+        return out.reshape(*lead, *out_sp)
+    return apply_op(f, x, indices, name="max_unpool")

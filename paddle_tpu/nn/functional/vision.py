@@ -1,0 +1,90 @@
+"""Vision functionals (ref: python/paddle/nn/functional/vision.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.tape import apply_op
+from ...ops._helpers import to_tensor_like, unwrap
+
+__all__ = ["affine_grid", "grid_sample"]
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    if hasattr(out_shape, "data"):
+        import numpy as np
+        out_shape = [int(v) for v in np.asarray(out_shape.data)]
+    n, c, h, w = out_shape
+
+    def f(th):
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, w)
+            ys = jnp.linspace(-1.0, 1.0, h)
+        else:
+            xs = jnp.linspace(-1.0 + 1.0 / w, 1.0 - 1.0 / w, w)
+            ys = jnp.linspace(-1.0 + 1.0 / h, 1.0 - 1.0 / h, h)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [h,w,3]
+        out = jnp.einsum("hwk,nik->nhwi", base.astype(th.dtype), th)
+        return out
+    return apply_op(f, to_tensor_like(theta), name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx = g[..., 0]
+        gy = g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(ix, iy):
+            if padding_mode == "border":
+                ix = jnp.clip(ix, 0, w - 1)
+                iy = jnp.clip(iy, 0, h - 1)
+                valid = jnp.ones_like(ix, bool)
+            elif padding_mode == "reflection":
+                def refl(v, size):
+                    if align_corners:
+                        span = 2 * (size - 1)
+                        v = jnp.abs(v) % span if size > 1 else v * 0
+                        return jnp.where(v > size - 1, span - v, v)
+                    span = 2 * size
+                    v = (jnp.abs(v + 0.5) % span)
+                    v = jnp.where(v > size, span - v, v) - 0.5
+                    return jnp.clip(v, 0, size - 1)
+                ix = refl(ix, w)
+                iy = refl(iy, h)
+                valid = jnp.ones_like(ix, bool)
+            else:
+                valid = (ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1)
+                ix = jnp.clip(ix, 0, w - 1)
+                iy = jnp.clip(iy, 0, h - 1)
+            iix = ix.astype(jnp.int32)
+            iiy = iy.astype(jnp.int32)
+            # gather per batch: a[n,c,h,w] at [n, :, iy, ix]
+            out = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(a, iiy, iix)
+            return jnp.where(valid[:, None], out, 0.0)
+
+        if mode == "nearest":
+            return sample(jnp.round(fx), jnp.round(fy)).astype(a.dtype)
+        x0 = jnp.floor(fx)
+        y0 = jnp.floor(fy)
+        wx = (fx - x0)[:, None]
+        wy = (fy - y0)[:, None]
+        v00 = sample(x0, y0)
+        v01 = sample(x0 + 1, y0)
+        v10 = sample(x0, y0 + 1)
+        v11 = sample(x0 + 1, y0 + 1)
+        out = (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+               + v10 * (1 - wx) * wy + v11 * wx * wy)
+        return out.astype(a.dtype)
+
+    return apply_op(f, to_tensor_like(x), to_tensor_like(grid),
+                    name="grid_sample")

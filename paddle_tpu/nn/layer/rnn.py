@@ -1,0 +1,301 @@
+"""Recurrent layers (ref: python/paddle/nn/layer/rnn.py).
+
+TPU-native: the time loop is `jax.lax.scan`, compiled once — the reference's
+cudnn RNN kernels have no TPU analog; scan + MXU matmuls is the idiomatic
+lowering.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.tape import apply_op
+from ...ops._helpers import to_tensor_like
+from ...tensor import Tensor
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+           "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...ops.creation import full
+        B = batch_ref.shape[batch_dim_idx]
+        if isinstance(self.state_shape[0], (list, tuple)):
+            return tuple(full([B] + list(s), init_value,
+                              dtype=dtype or batch_ref.dtype)
+                         for s in self.state_shape)
+        return full([B] + list(self.state_shape), init_value,
+                    dtype=dtype or batch_ref.dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self.weight_ih = self.create_parameter((hidden_size, input_size),
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter((hidden_size, hidden_size),
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter((hidden_size,), bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter((hidden_size,), bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        out = apply_op(
+            lambda x, h, wi, wh, bi, bh: act(x @ wi.T + bi + h @ wh.T + bh),
+            to_tensor_like(inputs), to_tensor_like(states),
+            self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+            name="rnn_cell")
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter((4 * hidden_size, input_size),
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter((4 * hidden_size, hidden_size),
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter((4 * hidden_size,), bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter((4 * hidden_size,), bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        out = apply_op(_lstm_step, to_tensor_like(inputs), to_tensor_like(h),
+                       to_tensor_like(c), self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh, n_outputs=2,
+                       name="lstm_cell")
+        new_h, new_c = out
+        return new_h, (new_h, new_c)
+
+
+def _lstm_step(x, h, c, wi, wh, bi, bh):
+    gates = x @ wi.T + bi + h @ wh.T + bh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    new_c = f * c + i * g
+    new_h = o * jnp.tanh(new_c)
+    return new_h, new_c
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter((3 * hidden_size, input_size),
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter((3 * hidden_size, hidden_size),
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter((3 * hidden_size,), bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter((3 * hidden_size,), bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = apply_op(_gru_step, to_tensor_like(inputs),
+                       to_tensor_like(states), self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh, name="gru_cell")
+        return out, out
+
+
+def _gru_step(x, h, wi, wh, bi, bh):
+    xg = x @ wi.T + bi
+    hg = h @ wh.T + bh
+    xr, xz, xn = jnp.split(xg, 3, axis=-1)
+    hr, hz, hn = jnp.split(hg, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    return (1 - z) * n + z * h
+
+
+class RNN(Layer):
+    """Runs a cell over time via lax.scan (ref rnn.py::RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            batch_idx = 1 if self.time_major else 0
+            initial_states = self.cell.get_initial_states(
+                inputs, batch_dim_idx=batch_idx)
+        is_lstm = isinstance(initial_states, (tuple, list))
+        params = [self.cell.weight_ih, self.cell.weight_hh,
+                  self.cell.bias_ih, self.cell.bias_hh]
+        step = (_lstm_step if isinstance(self.cell, LSTMCell)
+                else _gru_step if isinstance(self.cell, GRUCell)
+                else None)
+        act = getattr(self.cell, "activation", "tanh")
+
+        time_major = self.time_major
+        reverse = self.is_reverse
+
+        if is_lstm:
+            h0, c0 = initial_states
+            def f(x, h, c, wi, wh, bi, bh):
+                xt = x if time_major else jnp.swapaxes(x, 0, 1)
+                if reverse:
+                    xt = jnp.flip(xt, 0)
+                def body(carry, xin):
+                    hh, cc = carry
+                    nh, nc = _lstm_step(xin, hh, cc, wi, wh, bi, bh)
+                    return (nh, nc), nh
+                (hT, cT), ys = jax.lax.scan(body, (h, c), xt)
+                if reverse:
+                    ys = jnp.flip(ys, 0)
+                if not time_major:
+                    ys = jnp.swapaxes(ys, 0, 1)
+                return ys, hT, cT
+            ys, hT, cT = apply_op(f, to_tensor_like(inputs),
+                                  to_tensor_like(h0), to_tensor_like(c0),
+                                  *params, n_outputs=3, name="rnn_scan")
+            return ys, (hT, cT)
+
+        h0 = initial_states
+        def f(x, h, wi, wh, bi, bh):
+            xt = x if time_major else jnp.swapaxes(x, 0, 1)
+            if reverse:
+                xt = jnp.flip(xt, 0)
+            if step is None:
+                a = jnp.tanh if act == "tanh" else jax.nn.relu
+                def body(hh, xin):
+                    nh = a(xin @ wi.T + bi + hh @ wh.T + bh)
+                    return nh, nh
+            else:
+                def body(hh, xin):
+                    nh = step(xin, hh, wi, wh, bi, bh)
+                    return nh, nh
+            hT, ys = jax.lax.scan(body, h, xt)
+            if reverse:
+                ys = jnp.flip(ys, 0)
+            if not time_major:
+                ys = jnp.swapaxes(ys, 0, 1)
+            return ys, hT
+        ys, hT = apply_op(f, to_tensor_like(inputs), to_tensor_like(h0),
+                          *params, n_outputs=2, name="rnn_scan")
+        return ys, hT
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states_fw = states_bw = None
+        if initial_states is not None:
+            states_fw, states_bw = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        from ...ops.manipulation import concat
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    CELL = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirectional else 1
+        from .container import LayerList
+        layers = []
+        for i in range(num_layers):
+            in_sz = input_size if i == 0 else hidden_size * num_dir
+            kw = {}
+            if self.CELL is SimpleRNNCell:
+                kw["activation"] = activation
+            if self.bidirectional:
+                layers.append(BiRNN(self.CELL(in_sz, hidden_size, **kw),
+                                    self.CELL(in_sz, hidden_size, **kw),
+                                    time_major))
+            else:
+                layers.append(RNN(self.CELL(in_sz, hidden_size, **kw),
+                                  time_major=time_major))
+        self.rnns = LayerList(layers)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        out = inputs
+        final_states = []
+        for i, rnn in enumerate(self.rnns):
+            st = None if initial_states is None else initial_states[i] \
+                if isinstance(initial_states, (list, tuple)) and \
+                len(initial_states) == len(self.rnns) else None
+            out, fs = rnn(out, st)
+            final_states.append(fs)
+            if self.dropout > 0 and i < len(self.rnns) - 1:
+                out = F.dropout(out, p=self.dropout, training=self.training)
+        return out, final_states
+
+
+class SimpleRNN(_RNNBase):
+    CELL = SimpleRNNCell
+
+
+class LSTM(_RNNBase):
+    CELL = LSTMCell
+
+
+class GRU(_RNNBase):
+    CELL = GRUCell

@@ -1,0 +1,17 @@
+"""Op surface (ref: paddle/phi/api/yaml/ops.yaml ~570 ops + python/paddle/tensor).
+
+Every op is a jnp/lax composition routed through the autograd tape
+(`apply_op`), replacing the reference's generated C++ API + phi kernels
+(ref: paddle/phi/api/yaml/generator/api_gen.py). XLA replaces kernel
+selection / data transform / fusion passes.
+"""
+from .creation import *      # noqa: F401,F403
+from .math import *          # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *         # noqa: F401,F403
+from .reduction import *     # noqa: F401,F403
+from .search import *        # noqa: F401,F403
+from .linalg_ops import *    # noqa: F401,F403
+from .random_ops import *    # noqa: F401,F403
+from .einsum_ops import *    # noqa: F401,F403
+from . import patch_methods  # noqa: F401  (installs Tensor methods/operators)

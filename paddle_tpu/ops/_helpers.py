@@ -1,0 +1,54 @@
+"""Shared op-definition helpers."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply_op
+from ..framework import core
+from ..tensor import Tensor
+
+
+def to_tensor_like(x) -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x))
+
+
+def unwrap(x):
+    return x.data if isinstance(x, Tensor) else x
+
+
+def unwrap_opt(x):
+    """Unwrap possibly-None / scalar / Tensor into array-or-scalar."""
+    if x is None:
+        return None
+    return x.data if isinstance(x, Tensor) else x
+
+
+def static_int(x):
+    """Resolve an axis/size argument that may be a 0-d Tensor."""
+    if isinstance(x, Tensor):
+        return int(np.asarray(x.data))
+    return x
+
+
+def make_unary(jfn, name):
+    def op(x, name_arg=None, name=None):
+        return apply_op(jfn, to_tensor_like(x), name=name)
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"TPU-native `paddle.{name}` (jnp composition)."
+    return op
+
+
+def make_binary(jfn, name):
+    def op(x, y, name=None):
+        return apply_op(jfn, to_tensor_like(x), to_tensor_like(y), name=name)
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"TPU-native `paddle.{name}` (jnp composition)."
+    return op

@@ -1,0 +1,194 @@
+"""Tensor creation ops (ref: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply_op
+from ..framework import core
+from ..tensor import Parameter, Tensor
+from ._helpers import static_int, to_tensor_like, unwrap
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "diag", "diagflat", "tril", "triu", "meshgrid", "assign", "clone",
+    "complex", "real", "imag", "tril_indices", "triu_indices",
+    "create_parameter", "numel", "polar",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape.data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(static_int(s) for s in shape)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    dtype = core.convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        arr = data.data
+    else:
+        arr = jnp.asarray(data)
+    if dtype is not None and arr.dtype != dtype:
+        arr = arr.astype(dtype)
+    elif dtype is None and np.issubdtype(arr.dtype, np.floating) and not isinstance(data, (Tensor, jax.Array)):
+        arr = arr.astype(core.get_default_dtype())
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None):
+    dtype = core.convert_dtype(dtype) or core.get_default_dtype()
+    return Tensor(jnp.zeros(_shape(shape), dtype))
+
+
+def ones(shape, dtype=None, name=None):
+    dtype = core.convert_dtype(dtype) or core.get_default_dtype()
+    return Tensor(jnp.ones(_shape(shape), dtype))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    dtype = core.convert_dtype(dtype)
+    fill_value = unwrap(fill_value)
+    if dtype is None:
+        dtype = core.get_default_dtype() if isinstance(fill_value, float) else None
+    return Tensor(jnp.full(_shape(shape), fill_value, dtype))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(unwrap(x), dtype=core.convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(unwrap(x), dtype=core.convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(unwrap(x), unwrap(fill_value),
+                                dtype=core.convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    dtype = core.convert_dtype(dtype)
+    return Tensor(jnp.arange(start, end, step, dtype=dtype))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(unwrap(start), unwrap(stop), static_int(num),
+                               dtype=core.convert_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(unwrap(start), unwrap(stop), static_int(num),
+                               base=unwrap(base), dtype=core.convert_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    dtype = core.convert_dtype(dtype) or core.get_default_dtype()
+    return Tensor(jnp.eye(static_int(num_rows),
+                          static_int(num_columns) if num_columns is not None else None,
+                          dtype=dtype))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = to_tensor_like(x)
+    if padding_value == 0 or x.ndim == 2:
+        return apply_op(lambda a: jnp.diag(a, k=offset), x, name="diag")
+    return apply_op(
+        lambda a: jnp.where(jnp.eye(a.shape[0] + abs(offset), dtype=bool, k=offset),
+                            jnp.diag(a, k=offset), padding_value),
+        x, name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_op(lambda a: jnp.diagflat(a, k=offset), to_tensor_like(x))
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op(lambda a: jnp.tril(a, k=diagonal), to_tensor_like(x))
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op(lambda a: jnp.triu(a, k=diagonal), to_tensor_like(x))
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.tril_indices(row, offset, col)
+    d = core.convert_dtype(dtype)
+    return Tensor(jnp.stack([jnp.asarray(r, d), jnp.asarray(c, d)]))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    d = core.convert_dtype(dtype)
+    return Tensor(jnp.stack([jnp.asarray(r, d), jnp.asarray(c, d)]))
+
+
+def meshgrid(*args, name=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    tensors = [to_tensor_like(a) for a in args]
+    return apply_op(lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")),
+                    *tensors, n_outputs=len(tensors), name="meshgrid")
+
+
+def assign(x, output=None):
+    x = to_tensor_like(x)
+    out = apply_op(lambda a: a + 0 if jnp.issubdtype(a.dtype, jnp.number) else a,
+                   x, name="assign")
+    if output is not None:
+        output._inplace_from(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return to_tensor_like(x).clone()
+
+
+def complex(real, imag, name=None):
+    return apply_op(jax.lax.complex, to_tensor_like(real), to_tensor_like(imag))
+
+
+def polar(abs, angle, name=None):
+    return apply_op(lambda r, t: r * jnp.exp(1j * t.astype(jnp.complex64)),
+                    to_tensor_like(abs), to_tensor_like(angle))
+
+
+def real(x, name=None):
+    return apply_op(jnp.real, to_tensor_like(x))
+
+
+def imag(x, name=None):
+    return apply_op(jnp.imag, to_tensor_like(x))
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(unwrap(x).shape))))
+
+
+def create_parameter(shape, dtype=None, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from ..nn import initializer as I
+    dtype = core.convert_dtype(dtype) or core.get_default_dtype()
+    init = default_initializer
+    if init is None and attr is not None and getattr(attr, "initializer", None) is not None:
+        init = attr.initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    data = init(tuple(shape), dtype)
+    return Parameter(data, name=name or "")

@@ -1,0 +1,18 @@
+"""einsum (ref: python/paddle/tensor/einsum.py ~1k LoC of parsing —
+here XLA's dot_general via jnp.einsum does the planning)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd.tape import apply_op
+from ._helpers import to_tensor_like
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands, name=None):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    ts = [to_tensor_like(o) for o in operands]
+    return apply_op(lambda *xs: jnp.einsum(equation, *xs, optimize="optimal"),
+                    *ts, name="einsum")

@@ -1,0 +1,311 @@
+"""Linear-algebra ops (ref: python/paddle/tensor/linalg.py + paddle.linalg).
+
+matmul maps to the MXU via XLA dot_general; bf16 accumulation in f32 is the
+TPU-native default.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply_op
+from ..framework import core
+from ..tensor import Tensor
+from ._helpers import to_tensor_like, unwrap
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "mv", "dist", "cross", "cholesky",
+    "cholesky_solve", "cholesky_inverse", "matrix_power", "matrix_transpose",
+    "qr", "svd", "svdvals", "svd_lowrank", "pca_lowrank", "eig", "eigh",
+    "eigvals", "eigvalsh", "det", "slogdet", "inverse", "pinv", "solve",
+    "triangular_solve", "lstsq", "lu", "lu_unpack", "lu_solve", "matrix_rank",
+    "multi_dot", "cond", "corrcoef", "cov", "householder_product",
+    "matrix_exp", "vecdot", "vander", "ormqr",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply_op(f, to_tensor_like(x), to_tensor_like(y), name="matmul")
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return apply_op(jnp.matmul, to_tensor_like(x), to_tensor_like(y), name="bmm")
+
+
+def dot(x, y, name=None):
+    return apply_op(lambda a, b: jnp.sum(a * b, axis=-1),
+                    to_tensor_like(x), to_tensor_like(y), name="dot")
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return apply_op(lambda a, b: jnp.sum(a * b, axis=axis),
+                    to_tensor_like(x), to_tensor_like(y))
+
+
+def mv(x, vec, name=None):
+    return apply_op(jnp.matmul, to_tensor_like(x), to_tensor_like(vec), name="mv")
+
+
+def dist(x, y, p=2, name=None):
+    def f(a, b):
+        d = (a - b).ravel()
+        if p == 0:
+            return jnp.sum(d != 0).astype(a.dtype)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return apply_op(f, to_tensor_like(x), to_tensor_like(y), name="dist")
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    if axis == 9:
+        ax = next((i for i, s in enumerate(x.shape) if s == 3), -1)
+    else:
+        ax = axis
+    return apply_op(lambda a, b: jnp.cross(a, b, axis=ax), x, y, name="cross")
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+    return apply_op(f, to_tensor_like(x), name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, chol):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+    return apply_op(f, to_tensor_like(x), to_tensor_like(y), name="cholesky_solve")
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    def f(chol):
+        n = chol.shape[-1]
+        eye = jnp.eye(n, dtype=chol.dtype)
+        return jax.scipy.linalg.cho_solve((chol, not upper), eye)
+    return apply_op(f, to_tensor_like(x))
+
+
+def matrix_power(x, n, name=None):
+    return apply_op(lambda a: jnp.linalg.matrix_power(a, n), to_tensor_like(x))
+
+
+def matrix_transpose(x, name=None):
+    return apply_op(lambda a: jnp.swapaxes(a, -1, -2), to_tensor_like(x))
+
+
+def qr(x, mode="reduced", name=None):
+    out = apply_op(lambda a: tuple(jnp.linalg.qr(a, mode=mode)),
+                   to_tensor_like(x), n_outputs=2 if mode != "r" else 1, name="qr")
+    return out
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply_op(
+        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+        to_tensor_like(x), n_outputs=3, name="svd")
+
+
+def svdvals(x, name=None):
+    return apply_op(lambda a: jnp.linalg.svd(a, compute_uv=False), to_tensor_like(x))
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    x = to_tensor_like(x)
+    a = x.data if M is None else x.data - unwrap(M)
+    m, n = a.shape[-2:]
+    q = min(q, m, n)
+    key = core.next_rng_key()
+    G = jax.random.normal(key, a.shape[:-2] + (n, q), dtype=a.dtype)
+    Y = a @ G
+    Q, _ = jnp.linalg.qr(Y)
+    for _ in range(niter):
+        Z = jnp.swapaxes(a, -1, -2) @ Q
+        Q2, _ = jnp.linalg.qr(Z)
+        Y = a @ Q2
+        Q, _ = jnp.linalg.qr(Y)
+    B = jnp.swapaxes(Q, -1, -2) @ a
+    U, S, Vh = jnp.linalg.svd(B, full_matrices=False)
+    return Tensor(Q @ U), Tensor(S), Tensor(jnp.swapaxes(Vh, -1, -2))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    x = to_tensor_like(x)
+    m, n = x.data.shape[-2:]
+    if q is None:
+        q = min(6, m, n)
+    a = x.data
+    if center:
+        a = a - a.mean(axis=-2, keepdims=True)
+    return svd_lowrank(Tensor(a), q=q, niter=niter)
+
+
+def eig(x, name=None):
+    a = np.asarray(unwrap(x))
+    w, v = np.linalg.eig(a)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    a = np.asarray(unwrap(x))
+    return Tensor(jnp.asarray(np.linalg.eigvals(a)))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_op(lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)),
+                    to_tensor_like(x), n_outputs=2, name="eigh")
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), to_tensor_like(x))
+
+
+def det(x, name=None):
+    return apply_op(jnp.linalg.det, to_tensor_like(x), name="det")
+
+
+def slogdet(x, name=None):
+    out = apply_op(lambda a: tuple(jnp.linalg.slogdet(a)), to_tensor_like(x),
+                   n_outputs=2, name="slogdet")
+    return out
+
+
+def inverse(x, name=None):
+    return apply_op(jnp.linalg.inv, to_tensor_like(x), name="inverse")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian),
+                    to_tensor_like(x), name="pinv")
+
+
+def solve(x, y, name=None):
+    return apply_op(jnp.linalg.solve, to_tensor_like(x), to_tensor_like(y),
+                    name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply_op(f, to_tensor_like(x), to_tensor_like(y),
+                    name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    a, b = unwrap(x), unwrap(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(jnp.asarray(rank)), Tensor(sv)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(unwrap(x))
+    outs = [Tensor(lu_mat), Tensor(piv.astype(jnp.int32) + 1)]
+    if get_infos:
+        outs.append(Tensor(jnp.zeros((), jnp.int32)))
+    return tuple(outs)
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True, name=None):
+    lu_mat = unwrap(lu_data)
+    piv = unwrap(lu_pivots) - 1
+    n = lu_mat.shape[-2]
+    L = jnp.tril(lu_mat, -1) + jnp.eye(n, lu_mat.shape[-1], dtype=lu_mat.dtype)
+    L = L[..., :, : min(lu_mat.shape[-2:])]
+    U = jnp.triu(lu_mat)[..., : min(lu_mat.shape[-2:]), :]
+    perm = np.arange(n)
+    pv = np.asarray(piv)
+    for i, p in enumerate(pv):
+        perm[i], perm[p] = perm[p], perm[i]
+    P = jnp.eye(n, dtype=lu_mat.dtype)[perm].T
+    return Tensor(P), Tensor(L), Tensor(U)
+
+
+def lu_solve(b, lu_data, lu_pivots, trans=0, name=None):
+    return Tensor(jax.scipy.linalg.lu_solve(
+        (unwrap(lu_data), unwrap(lu_pivots) - 1), unwrap(b), trans=trans))
+
+
+def matrix_rank(x, tol=None, hermitian=False, atol=None, rtol=None, name=None):
+    a = unwrap(x)
+    if hermitian:
+        s = jnp.abs(jnp.linalg.eigvalsh(a))
+    else:
+        s = jnp.linalg.svd(a, compute_uv=False)
+    if tol is None and atol is None and rtol is None:
+        tol_v = s.max(-1, keepdims=True) * max(a.shape[-2:]) * jnp.finfo(s.dtype).eps
+    else:
+        t = tol if tol is not None else atol if atol is not None else 0.0
+        tol_v = jnp.asarray(unwrap(t))
+        while tol_v.ndim < s.ndim:
+            tol_v = tol_v[..., None]
+    return Tensor(jnp.sum(s > tol_v, axis=-1).astype(jnp.int64))
+
+
+def multi_dot(x, name=None):
+    ts = [to_tensor_like(t) for t in x]
+    return apply_op(lambda *xs: jnp.linalg.multi_dot(xs), *ts, name="multi_dot")
+
+
+def cond(x, p=None, name=None):
+    return apply_op(lambda a: jnp.linalg.cond(a, p=p), to_tensor_like(x))
+
+
+def corrcoef(x, rowvar=True, ddof=False, name=None):
+    return apply_op(lambda a: jnp.corrcoef(a, rowvar=rowvar), to_tensor_like(x))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = unwrap(fweights) if fweights is not None else None
+    aw = unwrap(aweights) if aweights is not None else None
+    return apply_op(
+        lambda a: jnp.cov(a, rowvar=rowvar, bias=not ddof, fweights=fw, aweights=aw),
+        to_tensor_like(x), name="cov")
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2:]
+        k = t.shape[-1]
+        Q = jnp.broadcast_to(jnp.eye(m, dtype=a.dtype), a.shape[:-2] + (m, m))
+        for i in range(k):
+            v = a[..., :, i]
+            v = jnp.where(jnp.arange(m) < i, 0.0, v)
+            v = v.at[..., i].set(1.0)
+            Qv = jnp.einsum("...ij,...j->...i", Q, v)
+            Q = Q - t[..., i][..., None, None] * Qv[..., :, None] * v[..., None, :]
+        return Q[..., :, :n]
+    return apply_op(f, to_tensor_like(x), to_tensor_like(tau))
+
+
+def matrix_exp(x, name=None):
+    return apply_op(jax.scipy.linalg.expm, to_tensor_like(x))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply_op(lambda a: jnp.vander(a, N=n, increasing=increasing),
+                    to_tensor_like(x))
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    Q = householder_product(x, tau)
+    def f(q, o):
+        qq = jnp.swapaxes(q, -1, -2) if transpose else q
+        return (qq @ o) if left else (o @ qq)
+    return apply_op(f, Q, to_tensor_like(other))

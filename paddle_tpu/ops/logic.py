@@ -1,0 +1,57 @@
+"""Comparison & logical ops (ref: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+from ._helpers import to_tensor_like, unwrap
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "equal_all", "allclose", "isclose", "is_tensor", "is_empty",
+]
+
+
+def _cmp(fn):
+    def op(x, y, name=None):
+        return Tensor(fn(unwrap(x), unwrap(y)))
+    return op
+
+
+equal = _cmp(jnp.equal)
+not_equal = _cmp(jnp.not_equal)
+greater_than = _cmp(jnp.greater)
+greater_equal = _cmp(jnp.greater_equal)
+less_than = _cmp(jnp.less)
+less_equal = _cmp(jnp.less_equal)
+logical_and = _cmp(jnp.logical_and)
+logical_or = _cmp(jnp.logical_or)
+logical_xor = _cmp(jnp.logical_xor)
+
+
+def logical_not(x, out=None, name=None):
+    return Tensor(jnp.logical_not(unwrap(x)))
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(unwrap(x), unwrap(y)))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(unwrap(x), unwrap(y), rtol=float(rtol),
+                               atol=float(atol), equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.isclose(unwrap(x), unwrap(y), rtol=float(rtol),
+                              atol=float(atol), equal_nan=equal_nan))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(unwrap(x).shape)) == 0))

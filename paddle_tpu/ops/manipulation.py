@@ -1,0 +1,771 @@
+"""Shape/layout manipulation ops (ref: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply_op
+from ..framework import core
+from ..tensor import Tensor
+from ._helpers import static_int, to_tensor_like, unwrap
+
+__all__ = [
+    "reshape", "reshape_", "transpose", "flatten", "squeeze", "squeeze_",
+    "unsqueeze", "unsqueeze_", "concat", "stack", "split", "tensor_split",
+    "chunk", "unbind", "tile", "expand", "expand_as", "broadcast_to",
+    "broadcast_tensors", "gather", "gather_nd", "scatter", "scatter_nd",
+    "scatter_nd_add", "index_select", "index_sample", "index_add", "index_put",
+    "masked_select", "masked_fill", "masked_scatter", "where", "roll", "flip",
+    "rot90", "slice", "strided_slice", "crop", "repeat_interleave",
+    "take_along_axis", "put_along_axis", "pad", "cast", "flatten_",
+    "unstack", "unique", "unique_consecutive", "nonzero", "moveaxis",
+    "swapaxes", "take", "tensordot", "as_complex", "as_real", "view", "view_as",
+    "atleast_1d", "atleast_2d", "atleast_3d", "diagonal", "diag_embed",
+    "diagonal_scatter", "fill_diagonal_", "shard_index", "t",
+    "unfold", "as_strided", "select_scatter", "slice_scatter", "column_stack",
+    "row_stack", "hstack", "vstack", "dstack", "dsplit", "hsplit", "vsplit",
+    "bucketize", "searchsorted", "histogram", "histogramdd", "bincount",
+    "block_diag", "cdist",
+]
+
+
+def _axes(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(static_int(a) for a in axis)
+    return static_int(axis)
+
+
+def reshape(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = [int(v) for v in np.asarray(shape.data)]
+    else:
+        shape = [static_int(s) for s in shape]
+    return apply_op(lambda a: jnp.reshape(a, shape), to_tensor_like(x), name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    return x._inplace_from(reshape(x, shape))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return apply_op(lambda a: a.view(core.convert_dtype(shape_or_dtype)), to_tensor_like(x))
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def transpose(x, perm=None, name=None):
+    return apply_op(lambda a: jnp.transpose(a, _axes(perm)), to_tensor_like(x),
+                    name="transpose")
+
+
+def t(x, name=None):
+    x = to_tensor_like(x)
+    if x.ndim < 2:
+        return x.clone()
+    return apply_op(jnp.transpose, x, name="t")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op(lambda a: jnp.moveaxis(a, _axes(source), _axes(destination)),
+                    to_tensor_like(x))
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op(lambda a: jnp.swapaxes(a, static_int(axis0), static_int(axis1)),
+                    to_tensor_like(x))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = to_tensor_like(x)
+    nd = max(x.ndim, 1)
+    s = start_axis % nd
+    e = stop_axis % nd
+    def f(a):
+        shape = list(a.shape[:s]) + [-1] + list(a.shape[e + 1:])
+        return jnp.reshape(a, shape)
+    return apply_op(f, x, name="flatten")
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._inplace_from(flatten(x, start_axis, stop_axis))
+
+
+def squeeze(x, axis=None, name=None):
+    x = to_tensor_like(x)
+    ax = _axes(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+    def f(a):
+        if ax is None:
+            return jnp.squeeze(a)
+        keep = tuple(i for i in ax if a.shape[i % a.ndim] == 1)
+        return jnp.squeeze(a, axis=keep) if keep else a
+    return apply_op(f, x, name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._inplace_from(squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _axes(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+    def f(a):
+        out = a
+        for i in sorted(ax):
+            out = jnp.expand_dims(out, i)
+        return out
+    return apply_op(f, to_tensor_like(x), name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._inplace_from(unsqueeze(x, axis))
+
+
+def concat(x, axis=0, name=None):
+    ts = [to_tensor_like(t) for t in x]
+    ax = static_int(axis)
+    return apply_op(lambda *xs: jnp.concatenate(xs, axis=ax), *ts, name="concat")
+
+
+def stack(x, axis=0, name=None):
+    ts = [to_tensor_like(t) for t in x]
+    return apply_op(lambda *xs: jnp.stack(xs, axis=static_int(axis)), *ts, name="stack")
+
+
+def hstack(x, name=None):
+    return apply_op(lambda *xs: jnp.hstack(xs), *[to_tensor_like(t) for t in x])
+
+
+def vstack(x, name=None):
+    return apply_op(lambda *xs: jnp.vstack(xs), *[to_tensor_like(t) for t in x])
+
+
+def dstack(x, name=None):
+    return apply_op(lambda *xs: jnp.dstack(xs), *[to_tensor_like(t) for t in x])
+
+
+def column_stack(x, name=None):
+    return apply_op(lambda *xs: jnp.column_stack(xs), *[to_tensor_like(t) for t in x])
+
+
+row_stack = vstack
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = to_tensor_like(x)
+    ax = static_int(axis)
+    dim = x.data.shape[ax]
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        if dim % n != 0:
+            raise ValueError(
+                f"split: axis {ax} size {dim} not divisible by {n} "
+                "(use tensor_split/chunk for uneven splits)")
+        sizes = [dim // n] * n
+    else:
+        sizes = [static_int(s) for s in num_or_sections]
+        minus = [i for i, s in enumerate(sizes) if s in (-1, None)]
+        if minus:
+            rest = dim - sum(s for s in sizes if s not in (-1, None))
+            sizes[minus[0]] = rest
+    offsets = np.cumsum([0] + sizes[:-1])
+    n_out = len(sizes)
+    def f(a):
+        return tuple(jax.lax.slice_in_dim(a, int(o), int(o + s), axis=ax)
+                     for o, s in zip(offsets, sizes))
+    out = apply_op(f, x, n_outputs=n_out, name="split")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = to_tensor_like(x)
+    ax = static_int(axis)
+    dim = x.data.shape[ax]
+    if isinstance(num_or_indices, int):
+        n = num_or_indices
+        base, rem = divmod(dim, n)
+        sizes = [base + (1 if i < rem else 0) for i in range(n)]
+        return split(x, sizes, axis=ax)
+    idx = [0] + [static_int(i) for i in num_or_indices] + [dim]
+    sizes = [b - a for a, b in zip(idx[:-1], idx[1:])]
+    return split(x, sizes, axis=ax)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    # uneven sizes allowed: remainder spread over the leading chunks
+    return tensor_split(x, chunks, axis)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def unbind(x, axis=0, name=None):
+    x = to_tensor_like(x)
+    ax = static_int(axis)
+    n = x.data.shape[ax]
+    out = apply_op(
+        lambda a: tuple(jax.lax.index_in_dim(a, i, axis=ax, keepdims=False)
+                        for i in range(n)),
+        x, n_outputs=n, name="unbind")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = [int(v) for v in np.asarray(repeat_times.data)]
+    reps = tuple(static_int(r) for r in repeat_times)
+    return apply_op(lambda a: jnp.tile(a, reps), to_tensor_like(x), name="tile")
+
+
+def expand(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = [int(v) for v in np.asarray(shape.data)]
+    shape = [static_int(s) for s in shape]
+    def f(a):
+        tgt = list(shape)
+        off = len(tgt) - a.ndim
+        for i in range(a.ndim):
+            if tgt[off + i] in (-1, None):
+                tgt[off + i] = a.shape[i]
+        return jnp.broadcast_to(a, tgt)
+    return apply_op(f, to_tensor_like(x), name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [to_tensor_like(t) for t in inputs]
+    return list(apply_op(lambda *xs: tuple(jnp.broadcast_arrays(*xs)),
+                         *ts, n_outputs=len(ts), name="broadcast_tensors"))
+
+
+def cast(x, dtype, name=None):
+    d = core.convert_dtype(dtype)
+    return apply_op(lambda a: a.astype(d), to_tensor_like(x), name="cast")
+
+
+def gather(x, index, axis=0, name=None):
+    ax = static_int(axis)
+    return apply_op(lambda a, i: jnp.take(a, i.astype(jnp.int32).ravel(), axis=ax),
+                    to_tensor_like(x), to_tensor_like(index), name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        return a[tuple(jnp.moveaxis(idx, -1, 0))] if k > 0 else a
+    return apply_op(f, to_tensor_like(x), to_tensor_like(index), name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, i, u):
+        i = i.astype(jnp.int32).ravel()
+        if overwrite:
+            return a.at[i].set(u)
+        z = a.at[i].set(jnp.zeros_like(u))
+        return z.at[i].add(u)
+    return apply_op(f, to_tensor_like(x), to_tensor_like(index),
+                    to_tensor_like(updates), name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._inplace_from(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, idx, u):
+        idx = idx.astype(jnp.int32)
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+    return apply_op(f, to_tensor_like(x), to_tensor_like(index),
+                    to_tensor_like(updates), name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    z = zeros(shape, dtype=updates.dtype if isinstance(updates, Tensor) else None)
+    return scatter_nd_add(z, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    ax = static_int(axis)
+    return apply_op(lambda a, i: jnp.take(a, i.astype(jnp.int32).ravel(), axis=ax),
+                    to_tensor_like(x), to_tensor_like(index), name="index_select")
+
+
+def index_sample(x, index):
+    def f(a, i):
+        return jnp.take_along_axis(a, i.astype(jnp.int32), axis=1)
+    return apply_op(f, to_tensor_like(x), to_tensor_like(index), name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    ax = static_int(axis)
+    def f(a, i, v):
+        i = i.astype(jnp.int32).ravel()
+        am = jnp.moveaxis(a, ax, 0)
+        vm = jnp.moveaxis(v, ax, 0)
+        return jnp.moveaxis(am.at[i].add(vm), 0, ax)
+    return apply_op(f, to_tensor_like(x), to_tensor_like(index),
+                    to_tensor_like(value), name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx_ts = [to_tensor_like(i) for i in indices]
+    def f(a, v, *idx):
+        idx = tuple(i.astype(jnp.int32) if jnp.issubdtype(i.dtype, jnp.integer) else i
+                    for i in idx)
+        return a.at[idx].add(v) if accumulate else a.at[idx].set(v)
+    return apply_op(f, to_tensor_like(x), to_tensor_like(value), *idx_ts,
+                    name="index_put")
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: host-sync (eager only), like the reference's
+    # D2H copy in the masked_select kernel
+    x, mask = to_tensor_like(x), to_tensor_like(mask)
+    shape = jnp.broadcast_shapes(x.data.shape, mask.data.shape)
+    mb = np.broadcast_to(np.asarray(mask.data), shape)
+    idx = np.nonzero(mb.ravel())[0]
+    return apply_op(lambda a: jnp.take(jnp.broadcast_to(a, shape).ravel(), idx),
+                    x, name="masked_select")
+
+
+def masked_fill(x, mask, value, name=None):
+    v = unwrap(value)
+    return apply_op(lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a),
+                    to_tensor_like(x), to_tensor_like(mask), name="masked_fill")
+
+
+def masked_scatter(x, mask, value, name=None):
+    x, mask, value = to_tensor_like(x), to_tensor_like(mask), to_tensor_like(value)
+    mb = np.asarray(jnp.broadcast_to(mask.data, x.data.shape)).ravel()
+    pos = np.nonzero(mb)[0]
+    def f(a, v):
+        flat = a.ravel()
+        return flat.at[pos].set(v.ravel()[: len(pos)]).reshape(a.shape)
+    return apply_op(f, x, value, name="masked_scatter")
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply_op(lambda c, a, b: jnp.where(c, a, b),
+                    to_tensor_like(condition), to_tensor_like(x), to_tensor_like(y),
+                    name="where")
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(unwrap(x))
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i).reshape(-1, 1)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = tuple(shifts) if isinstance(shifts, (list, tuple)) else static_int(shifts)
+    ax = _axes(axis)
+    return apply_op(lambda a: jnp.roll(a, sh, axis=ax), to_tensor_like(x), name="roll")
+
+
+def flip(x, axis, name=None):
+    ax = _axes(axis)
+    return apply_op(lambda a: jnp.flip(a, axis=ax), to_tensor_like(x), name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), to_tensor_like(x))
+
+
+def slice(input, axes, starts, ends):
+    axes = [static_int(a) for a in axes]
+    starts = [static_int(s) for s in starts]
+    ends = [static_int(e) for e in ends]
+    def f(a):
+        out = a
+        for ax, st, en in zip(axes, starts, ends):
+            n = out.shape[ax]
+            st2 = max(st + n, 0) if st < 0 else min(st, n)
+            en2 = max(en + n, 0) if en < 0 else min(en, n)
+            out = jax.lax.slice_in_dim(out, st2, en2, axis=ax)
+        return out
+    return apply_op(f, to_tensor_like(input), name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    import builtins
+    axes = [static_int(a) for a in axes]
+    def f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(static_int(st), static_int(en), static_int(sd))
+        return a[tuple(idx)]
+    return apply_op(f, to_tensor_like(x), name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = to_tensor_like(x)
+    shp = [static_int(s) for s in (shape if shape is not None else x.shape)]
+    offs = [static_int(o) for o in (offsets if offsets is not None else [0] * x.ndim)]
+    for i, s in enumerate(shp):
+        if s in (-1, None):
+            shp[i] = x.shape[i] - offs[i]
+    def f(a):
+        return jax.lax.dynamic_slice(a, offs, shp)
+    return apply_op(f, x, name="crop")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = to_tensor_like(x)
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats.data)
+        total = int(reps.sum())
+        return apply_op(
+            lambda a: jnp.repeat(a, jnp.asarray(reps), axis=axis, total_repeat_length=total),
+            x, name="repeat_interleave")
+    return apply_op(lambda a: jnp.repeat(a, repeats, axis=axis), x,
+                    name="repeat_interleave")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    ax = static_int(axis)
+    return apply_op(lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=ax),
+                    to_tensor_like(arr), to_tensor_like(indices),
+                    name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    ax = static_int(axis)
+    def f(a, i, v):
+        i = i.astype(jnp.int32)
+        v = jnp.broadcast_to(jnp.asarray(v, a.dtype), i.shape)
+        at = jnp.apply_along_axis  # unused; explicit scatter below
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=ax, inplace=False)
+        mode = {"add": "add", "multiply": "multiply", "mul": "multiply",
+                "amin": "min", "amax": "max", "mean": "add"}[reduce]
+        # build scatter via .at on moved axis
+        am = jnp.moveaxis(a, ax, 0)
+        im = jnp.moveaxis(i, ax, 0)
+        vm = jnp.moveaxis(v, ax, 0)
+        grid = jnp.meshgrid(*[jnp.arange(s) for s in im.shape], indexing="ij")
+        full_idx = (im,) + tuple(grid[1:])
+        upd = getattr(am.at[full_idx], mode)(vm)
+        return jnp.moveaxis(upd, 0, ax)
+    return apply_op(f, to_tensor_like(arr), to_tensor_like(indices),
+                    to_tensor_like(values), name="put_along_axis")
+
+
+def take(x, index, mode="raise", name=None):
+    x, index = to_tensor_like(x), to_tensor_like(index)
+    if mode == "raise":
+        # honor the raise contract when indices are concrete (eager path);
+        # under tracing fall back to clip like jnp
+        try:
+            iv = np.asarray(index.data)
+            n = int(np.prod(x.data.shape))
+            if iv.size and (iv.min() < -n or iv.max() >= n):
+                raise IndexError(
+                    f"take: index out of range for tensor with {n} elements "
+                    f"(got min={iv.min()}, max={iv.max()})")
+        except (TypeError, jax.errors.TracerArrayConversionError):
+            pass
+    m = "clip" if mode == "raise" else mode
+    return apply_op(lambda a, i: jnp.take(a.ravel(), i.astype(jnp.int32), mode=m),
+                    x, index, name="take")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = to_tensor_like(x)
+    if isinstance(pad, Tensor):
+        pad = [int(v) for v in np.asarray(pad.data)]
+    pad = [static_int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle/torch convention: first (before, after) pair applies to the
+        # LAST spatial dim, the next pair to the one before it, etc.
+        pairs = [(pad[i], pad[i + 1]) for i in range(0, len(pad), 2)]
+        cfg = [(0, 0)] * nd
+        if data_format.endswith("C") and nd >= 3:  # NHWC/NLC/NDHWC
+            spatial = list(range(1, nd - 1))
+        else:
+            spatial = list(range(2, nd))
+        for d, pr in zip(reversed(spatial), pairs):
+            cfg[d] = pr
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "edge": "edge", "circular": "wrap", "wrap": "wrap"}[mode]
+    def f(a):
+        if jmode == "constant":
+            return jnp.pad(a, cfg, mode="constant", constant_values=value)
+        return jnp.pad(a, cfg, mode=jmode)
+    return apply_op(f, x, name="pad")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    arr = np.asarray(unwrap(x))
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(unwrap(x))
+    if axis is None:
+        arr = arr.ravel()
+        ax = 0
+    else:
+        ax = axis
+    n = arr.shape[ax]
+    if n == 0:
+        outs = [Tensor(jnp.asarray(arr))]
+    else:
+        sl = [np.s_[:]] * arr.ndim
+        sl[ax] = np.s_[1:]
+        sl0 = [np.s_[:]] * arr.ndim
+        sl0[ax] = np.s_[:-1]
+        neq = (arr[tuple(sl)] != arr[tuple(sl0)])
+        while neq.ndim > 1:
+            neq = neq.any(axis=-1 if ax == 0 else 0)
+        keep = np.concatenate([[True], neq])
+        out = np.compress(keep, arr, axis=ax)
+        outs = [Tensor(jnp.asarray(out))]
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            outs.append(Tensor(jnp.asarray(inv)))
+        if return_counts:
+            idx = np.nonzero(keep)[0]
+            counts = np.diff(np.append(idx, n))
+            outs.append(Tensor(jnp.asarray(counts)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_1d, to_tensor_like(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_2d, to_tensor_like(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_3d, to_tensor_like(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+                    to_tensor_like(x), name="diagonal")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        i = jnp.arange(a.shape[-1])
+        r = i + max(-offset, 0)
+        c = i + max(offset, 0)
+        out = base.at[..., r, c].set(a)
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        perm = [d for d in range(nd) if d not in (nd - 2, nd - 1)]
+        # place last two dims at (dim1, dim2)
+        order = []
+        src = iter(perm)
+        for d in range(nd):
+            if d == d1:
+                order.append(nd - 2)
+            elif d == d2:
+                order.append(nd - 1)
+            else:
+                order.append(next(src))
+        return jnp.transpose(out, order)
+    return apply_op(f, to_tensor_like(input), name="diag_embed")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def f(a, b):
+        n = min(a.shape[axis1], a.shape[axis2])
+        i = jnp.arange(b.shape[-1])
+        r = i + max(-offset, 0)
+        c = i + max(offset, 0)
+        am = jnp.moveaxis(a, (axis1, axis2), (0, 1))
+        bm = jnp.moveaxis(b, -1, 0)
+        return jnp.moveaxis(am.at[r, c].set(bm), (0, 1), (axis1, axis2))
+    return apply_op(f, to_tensor_like(x), to_tensor_like(y), name="diagonal_scatter")
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    n = min(x.shape[-2], x.shape[-1])
+    i = np.arange(n - abs(offset))
+    r = i + max(-offset, 0)
+    c = i + max(offset, 0)
+    new = apply_op(lambda a: a.at[..., r, c].set(value), x, name="fill_diagonal_")
+    return x._inplace_from(new)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+    def f(i):
+        shard = i // size
+        return jnp.where(shard == shard_id, i % size, ignore_value)
+    return apply_op(f, to_tensor_like(input), name="shard_index")
+
+
+def unfold(x, axis, size, step, name=None):
+    ax = static_int(axis)
+    def f(a):
+        n = a.shape[ax]
+        starts = list(range(0, n - size + 1, step))
+        parts = [jax.lax.slice_in_dim(a, s, s + size, axis=ax) for s in starts]
+        return jnp.stack(parts, axis=ax if ax >= 0 else a.ndim + ax)
+    out = apply_op(f, to_tensor_like(x), name="unfold")
+    # paddle returns windows appended as last dim
+    return apply_op(lambda a: jnp.moveaxis(a, ax + 1, -1), out)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    def f(a):
+        flat = a.ravel()
+        idx = np.full(tuple(shape), offset, dtype=np.int64)
+        for d, (s, st) in enumerate(zip(shape, stride)):
+            r = np.arange(s) * st
+            idx = idx + r.reshape([-1 if i == d else 1 for i in range(len(shape))])
+        return flat[jnp.asarray(idx)]
+    return apply_op(f, to_tensor_like(x), name="as_strided")
+
+
+def select_scatter(x, values, axis, index, name=None):
+    ax = static_int(axis)
+    def f(a, v):
+        return jnp.moveaxis(jnp.moveaxis(a, ax, 0).at[index].set(v), 0, ax)
+    return apply_op(f, to_tensor_like(x), to_tensor_like(values))
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    import builtins
+    def f(a, v):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[static_int(ax)] = builtins.slice(static_int(st), static_int(en),
+                                                 static_int(sd))
+        return a.at[tuple(idx)].set(v)
+    return apply_op(f, to_tensor_like(x), to_tensor_like(value))
+
+
+def as_complex(x, name=None):
+    return apply_op(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), to_tensor_like(x))
+
+
+def as_real(x, name=None):
+    return apply_op(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1),
+                    to_tensor_like(x))
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, Tensor):
+        axes = np.asarray(axes.data).tolist()
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=axes),
+                    to_tensor_like(x), to_tensor_like(y), name="tensordot")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    d = jnp.int32 if out_int32 else core.convert_dtype("int64")
+    return Tensor(jnp.searchsorted(unwrap(sorted_sequence), unwrap(x),
+                                   side=side).astype(d))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    ss, v = unwrap(sorted_sequence), unwrap(values)
+    if ss.ndim == 1:
+        out = jnp.searchsorted(ss, v, side=side)
+    else:
+        out = jax.vmap(lambda s, x: jnp.searchsorted(s, x, side=side))(
+            ss.reshape(-1, ss.shape[-1]), v.reshape(-1, v.shape[-1])
+        ).reshape(v.shape)
+    return Tensor(out.astype(jnp.int32))
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    arr = unwrap(input)
+    if min == 0 and max == 0:
+        mn, mx = float(jnp.min(arr)), float(jnp.max(arr))
+    else:
+        mn, mx = float(min), float(max)
+    h, _ = jnp.histogram(arr.ravel(), bins=bins, range=(mn, mx),
+                         weights=unwrap(weight) if weight is not None else None,
+                         density=density)
+    return Tensor(h if density else h.astype(jnp.int64))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    arr = np.asarray(unwrap(x))
+    h, edges = np.histogramdd(arr, bins=bins, range=ranges, density=density,
+                              weights=np.asarray(unwrap(weights)) if weights is not None else None)
+    return Tensor(jnp.asarray(h)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    arr = np.asarray(unwrap(x))
+    length = max(minlength, int(arr.max()) + 1 if arr.size else 0)
+    w = unwrap(weights) if weights is not None else None
+    return Tensor(jnp.bincount(jnp.asarray(arr), weights=w, length=length))
+
+
+def block_diag(inputs, name=None):
+    ts = [to_tensor_like(t) for t in inputs]
+    return apply_op(lambda *xs: jax.scipy.linalg.block_diag(*xs), *ts)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    def f(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, -1), 1e-30))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(diff), -1)
+        return jnp.sum(jnp.abs(diff) ** p, -1) ** (1.0 / p)
+    return apply_op(f, to_tensor_like(x), to_tensor_like(y), name="cdist")

@@ -1,0 +1,270 @@
+"""Elementwise & scalar math ops (ref: python/paddle/tensor/math.py,
+paddle/phi/kernels/elementwise_*; XLA fuses these — no hand-fusion needed)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply_op
+from ..framework import core
+from ..tensor import Tensor
+from ._helpers import make_binary, make_unary, to_tensor_like, unwrap
+
+_UNARY = {
+    "abs": jnp.abs, "acos": jnp.arccos, "acosh": jnp.arccosh,
+    "asin": jnp.arcsin, "asinh": jnp.arcsinh, "atan": jnp.arctan,
+    "atanh": jnp.arctanh, "ceil": jnp.ceil, "cos": jnp.cos,
+    "cosh": jnp.cosh, "digamma": jax.scipy.special.digamma,
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "exp": jnp.exp, "expm1": jnp.expm1, "floor": jnp.floor,
+    "frac": lambda x: x - jnp.trunc(x),
+    "i0": lambda x: jax.scipy.special.i0(x), "i0e": lambda x: jax.scipy.special.i0e(x),
+    "i1": lambda x: jax.scipy.special.i1(x), "i1e": lambda x: jax.scipy.special.i1e(x),
+    "lgamma": jax.scipy.special.gammaln, "log": jnp.log, "log10": jnp.log10,
+    "log1p": jnp.log1p, "log2": jnp.log2,
+    "neg": jnp.negative, "reciprocal": lambda x: 1.0 / x,
+    "round": jnp.round, "rsqrt": jax.lax.rsqrt, "sigmoid": jax.nn.sigmoid,
+    "sign": jnp.sign, "sin": jnp.sin, "sinh": jnp.sinh,
+    "sqrt": jnp.sqrt, "square": jnp.square, "tan": jnp.tan, "tanh": jnp.tanh,
+    "trunc": jnp.trunc, "angle": jnp.angle, "conj": jnp.conj,
+    "deg2rad": jnp.deg2rad, "rad2deg": jnp.rad2deg,
+}
+
+_BINARY = {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "divide": jnp.divide, "floor_divide": jnp.floor_divide,
+    "mod": jnp.mod, "remainder": jnp.mod, "floor_mod": jnp.mod,
+    "pow": jnp.power, "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "fmax": jnp.fmax, "fmin": jnp.fmin, "atan2": jnp.arctan2,
+    "logaddexp": jnp.logaddexp, "hypot": jnp.hypot,
+    "copysign": jnp.copysign, "nextafter": jnp.nextafter,
+    "heaviside": jnp.heaviside, "gcd": jnp.gcd, "lcm": jnp.lcm,
+    "ldexp": jnp.ldexp,
+    "bitwise_and": jnp.bitwise_and, "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "bitwise_left_shift": jnp.left_shift, "bitwise_right_shift": jnp.right_shift,
+}
+
+_g = globals()
+for _name, _fn in _UNARY.items():
+    _g[_name] = make_unary(_fn, _name)
+for _name, _fn in _BINARY.items():
+    _g[_name] = make_binary(_fn, _name)
+
+__all__ = list(_UNARY) + list(_BINARY) + [
+    "bitwise_not", "clip", "scale", "stanh", "multiplex", "addmm",
+    "lerp", "nan_to_num", "trapezoid", "diff", "cumsum", "cumprod",
+    "cummax", "cummin", "logcumsumexp", "isfinite", "isinf", "isnan",
+    "increment", "divide_no_nan", "rsub",
+    "inner", "outer", "kron", "logit", "exp2", "signbit",
+    "polygamma", "gammaln", "gammainc", "gammaincc", "sinc",
+]
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply_op(jnp.bitwise_not, to_tensor_like(x))
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = unwrap(min) if min is not None else None
+    mx = unwrap(max) if max is not None else None
+    return apply_op(lambda a: jnp.clip(a, mn, mx), to_tensor_like(x), name="clip")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = unwrap(scale), unwrap(bias)
+    if bias_after_scale:
+        out = apply_op(lambda a: a * s + b, to_tensor_like(x), name="scale")
+    else:
+        out = apply_op(lambda a: (a + b) * s, to_tensor_like(x), name="scale")
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op(lambda a: scale_b * jnp.tanh(scale_a * a), to_tensor_like(x))
+
+
+def multiplex(inputs, index, name=None):
+    ts = [to_tensor_like(t) for t in inputs]
+    idx = to_tensor_like(index)
+    return apply_op(
+        lambda i, *xs: jnp.take_along_axis(
+            jnp.stack(xs, 0), i.reshape(1, -1, *([1] * (xs[0].ndim - 1))).astype(jnp.int32), axis=0
+        )[0],
+        idx, *ts, name="multiplex")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op(lambda i, a, b: beta * i + alpha * (a @ b),
+                    to_tensor_like(input), to_tensor_like(x), to_tensor_like(y),
+                    name="addmm")
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, (int, float)):
+        return apply_op(lambda a, b: a + weight * (b - a),
+                        to_tensor_like(x), to_tensor_like(y), name="lerp")
+    return apply_op(lambda a, b, w: a + w * (b - a),
+                    to_tensor_like(x), to_tensor_like(y), to_tensor_like(weight),
+                    name="lerp")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+                    to_tensor_like(x))
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = to_tensor_like(y)
+    if x is not None:
+        return apply_op(lambda yy, xx: jax.scipy.integrate.trapezoid(yy, xx, axis=axis),
+                        y, to_tensor_like(x))
+    d = 1.0 if dx is None else dx
+    return apply_op(lambda yy: jax.scipy.integrate.trapezoid(yy, dx=d, axis=axis), y)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [to_tensor_like(x)]
+    pre = ap = None
+    if prepend is not None:
+        pre = len(args); args.append(to_tensor_like(prepend))
+    if append is not None:
+        ap = len(args); args.append(to_tensor_like(append))
+
+    def f(*xs):
+        kw = {}
+        if pre is not None:
+            kw["prepend"] = xs[pre]
+        if ap is not None:
+            kw["append"] = xs[ap]
+        return jnp.diff(xs[0], n=n, axis=axis, **kw)
+    return apply_op(f, *args, name="diff")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = core.convert_dtype(dtype)
+    return apply_op(lambda a: jnp.cumsum(a, axis=axis, dtype=d), to_tensor_like(x))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = core.convert_dtype(dtype)
+    return apply_op(lambda a: jnp.cumprod(a, axis=dim, dtype=d), to_tensor_like(x))
+
+
+def _cummaxmin(x, axis, dtype, fn):
+    x = to_tensor_like(x)
+    d = core.convert_dtype(dtype) or jnp.int32
+    flat = axis is None
+    ax = 0 if axis is None else axis
+
+    def f(a):
+        a = a.ravel() if flat else a
+        axx = ax % a.ndim
+        cm = fn(a, axis=axx)
+        eq = a == cm  # positions achieving the running extremum
+        ar = jnp.arange(a.shape[axx]).reshape(
+            [-1 if i == axx else 1 for i in range(a.ndim)])
+        idx = jax.lax.cummax(jnp.where(eq, jnp.broadcast_to(ar, a.shape), -1),
+                             axis=axx)
+        return cm, idx
+
+    vals, idx = apply_op(f, x, n_outputs=2, name="cummaxmin")
+    return vals, Tensor(idx.data.astype(d))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cummaxmin(x, axis, dtype, jax.lax.cummax)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cummaxmin(x, axis, dtype, jax.lax.cummin)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if axis is None:
+            a = a.ravel()
+            ax = 0
+        else:
+            ax = axis
+        m = jax.lax.cummax(a, axis=ax)
+        return jnp.log(jnp.cumsum(jnp.exp(a - m), axis=ax)) + m
+    return apply_op(f, to_tensor_like(x))
+
+
+def isfinite(x, name=None):
+    return Tensor(jnp.isfinite(unwrap(x)))
+
+
+def isinf(x, name=None):
+    return Tensor(jnp.isinf(unwrap(x)))
+
+
+def isnan(x, name=None):
+    return Tensor(jnp.isnan(unwrap(x)))
+
+
+def increment(x, value=1.0, name=None):
+    x._inplace_from(apply_op(lambda a: a + value, x, name="increment"))
+    return x
+
+
+def divide_no_nan(x, y, name=None):
+    return apply_op(lambda a, b: jnp.where(b == 0, 0.0, a / jnp.where(b == 0, 1.0, b)),
+                    to_tensor_like(x), to_tensor_like(y))
+
+
+def rsub(x, y, alpha=1.0):
+    return apply_op(lambda a, b: b - alpha * a, to_tensor_like(x), to_tensor_like(y))
+
+
+def inner(x, y, name=None):
+    return apply_op(lambda a, b: jnp.inner(a, b), to_tensor_like(x), to_tensor_like(y))
+
+
+def outer(x, y, name=None):
+    return apply_op(lambda a, b: jnp.outer(a, b), to_tensor_like(x), to_tensor_like(y))
+
+
+def kron(x, y, name=None):
+    return apply_op(jnp.kron, to_tensor_like(x), to_tensor_like(y))
+
+
+def logit(x, eps=None, name=None):
+    def f(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a / (1.0 - a))
+    return apply_op(f, to_tensor_like(x))
+
+
+def exp2(x, name=None):
+    return apply_op(jnp.exp2, to_tensor_like(x))
+
+
+def signbit(x, name=None):
+    return Tensor(jnp.signbit(unwrap(x)))
+
+
+def sinc(x, name=None):
+    return apply_op(jnp.sinc, to_tensor_like(x))
+
+
+def polygamma(x, n, name=None):
+    return apply_op(lambda a: jax.scipy.special.polygamma(n, a), to_tensor_like(x))
+
+
+def gammaln(x, name=None):
+    return apply_op(jax.scipy.special.gammaln, to_tensor_like(x))
+
+
+def gammainc(x, y, name=None):
+    return apply_op(jax.scipy.special.gammainc, to_tensor_like(x), to_tensor_like(y))
+
+
+def gammaincc(x, y, name=None):
+    return apply_op(jax.scipy.special.gammaincc, to_tensor_like(x), to_tensor_like(y))

@@ -1,0 +1,160 @@
+"""Install op functions as Tensor methods + Python operators
+(ref: python/paddle/base/dygraph/tensor_patch_methods.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply_op
+from ..framework import core
+from ..tensor import Tensor
+from . import creation, einsum_ops, linalg_ops, logic, manipulation, math as m
+from . import random_ops, reduction, search
+from ._helpers import to_tensor_like
+
+_MODULES = [m, manipulation, reduction, logic, search, linalg_ops, creation,
+            random_ops, einsum_ops]
+
+# names that collide with properties/builtins and must not be set
+_SKIP = {"to_tensor", "is_tensor", "create_parameter", "meshgrid",
+         "broadcast_tensors", "block_diag", "multi_dot"}
+
+
+def _install():
+    for mod in _MODULES:
+        for name in getattr(mod, "__all__", []):
+            if name in _SKIP or hasattr(Tensor, name):
+                continue
+            fn = getattr(mod, name)
+            setattr(Tensor, name, fn)
+
+
+_install()
+
+# ---------------------------------------------------------------------------
+# extra named methods
+# ---------------------------------------------------------------------------
+
+def _astype(self, dtype):
+    return manipulation.cast(self, dtype)
+
+
+def _cpu(self):
+    return self
+
+
+def _cuda(self, device_id=None, blocking=True):
+    return self
+
+
+def _to(self, *args, **kwargs):
+    dtype = kwargs.get("dtype")
+    for a in args:
+        if isinstance(a, str) and a.split(":")[0] in ("cpu", "gpu", "tpu", "cuda", "xpu"):
+            continue
+        if a is not None and not isinstance(a, bool):
+            dtype = a
+    if dtype is not None:
+        return manipulation.cast(self, dtype)
+    return self
+
+
+def _pin_memory(self):
+    return self
+
+
+def _add_(self, y):
+    return self._inplace_from(m.add(self, y))
+
+
+def _subtract_(self, y):
+    return self._inplace_from(m.subtract(self, y))
+
+
+def _multiply_(self, y):
+    return self._inplace_from(m.multiply(self, y))
+
+
+def _divide_(self, y):
+    return self._inplace_from(m.divide(self, y))
+
+
+def _scale_(self, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    return self._inplace_from(m.scale(self, scale, bias, bias_after_scale, act))
+
+
+def _clip_(self, min=None, max=None):
+    return self._inplace_from(m.clip(self, min, max))
+
+
+def _mT(self):
+    return manipulation.swapaxes(self, -1, -2)
+
+
+Tensor.astype = _astype
+Tensor.cpu = _cpu
+Tensor.cuda = _cuda
+Tensor.to = _to
+Tensor.pin_memory = _pin_memory
+Tensor.add_ = _add_
+Tensor.subtract_ = _subtract_
+Tensor.multiply_ = _multiply_
+Tensor.divide_ = _divide_
+Tensor.scale_ = _scale_
+Tensor.clip_ = _clip_
+Tensor.T = property(lambda self: manipulation.transpose(
+    self, list(range(self.ndim))[::-1]))
+Tensor.mT = property(_mT)
+Tensor.cast_ = lambda self, dtype: self._inplace_from(manipulation.cast(self, dtype))
+Tensor.zero_ = Tensor.zero_
+Tensor.exp_ = lambda self: self._inplace_from(m.exp(self))
+Tensor.sqrt_ = lambda self: self._inplace_from(m.sqrt(self))
+Tensor.rsqrt_ = lambda self: self._inplace_from(m.rsqrt(self))
+Tensor.reciprocal_ = lambda self: self._inplace_from(m.reciprocal(self))
+Tensor.floor_ = lambda self: self._inplace_from(m.floor(self))
+Tensor.ceil_ = lambda self: self._inplace_from(m.ceil(self))
+Tensor.round_ = lambda self: self._inplace_from(m.round(self))
+Tensor.tanh_ = lambda self: self._inplace_from(m.tanh(self))
+Tensor.abs_ = lambda self: self._inplace_from(m.abs(self))
+
+# ---------------------------------------------------------------------------
+# operators
+# ---------------------------------------------------------------------------
+
+def _rev(fn):
+    def op(self, other):
+        return fn(to_tensor_like(other), self)
+    return op
+
+
+Tensor.__add__ = m.add
+Tensor.__radd__ = m.add
+Tensor.__sub__ = m.subtract
+Tensor.__rsub__ = _rev(m.subtract)
+Tensor.__mul__ = m.multiply
+Tensor.__rmul__ = m.multiply
+Tensor.__truediv__ = m.divide
+Tensor.__rtruediv__ = _rev(m.divide)
+Tensor.__floordiv__ = m.floor_divide
+Tensor.__rfloordiv__ = _rev(m.floor_divide)
+Tensor.__mod__ = m.mod
+Tensor.__rmod__ = _rev(m.mod)
+Tensor.__pow__ = m.pow
+Tensor.__rpow__ = _rev(m.pow)
+Tensor.__matmul__ = linalg_ops.matmul
+Tensor.__rmatmul__ = _rev(linalg_ops.matmul)
+Tensor.__neg__ = m.neg
+Tensor.__abs__ = m.abs
+Tensor.__pos__ = lambda self: self
+Tensor.__invert__ = lambda self: Tensor(~self.data)
+Tensor.__eq__ = logic.equal
+Tensor.__ne__ = logic.not_equal
+Tensor.__lt__ = logic.less_than
+Tensor.__le__ = logic.less_equal
+Tensor.__gt__ = logic.greater_than
+Tensor.__ge__ = logic.greater_equal
+Tensor.__and__ = lambda self, o: Tensor(jnp.bitwise_and(self.data, to_tensor_like(o).data))
+Tensor.__or__ = lambda self, o: Tensor(jnp.bitwise_or(self.data, to_tensor_like(o).data))
+Tensor.__xor__ = lambda self, o: Tensor(jnp.bitwise_xor(self.data, to_tensor_like(o).data))
+Tensor.__lshift__ = lambda self, o: Tensor(jnp.left_shift(self.data, to_tensor_like(o).data))
+Tensor.__rshift__ = lambda self, o: Tensor(jnp.right_shift(self.data, to_tensor_like(o).data))

@@ -1,0 +1,169 @@
+"""Random ops (ref: python/paddle/tensor/random.py).
+
+TPU-native: counter-based JAX PRNG keys from the framework key-stack, so the
+same code is reproducible eagerly and traceable under jit (the reference's
+stateful phi Generator has no compiled-mode story; this does).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply_op
+from ..framework import core
+from ..tensor import Tensor
+from ._helpers import to_tensor_like, unwrap
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "uniform", "normal",
+    "standard_normal", "gaussian", "randperm", "multinomial", "bernoulli",
+    "poisson", "exponential_", "binomial", "standard_gamma", "log_normal",
+    "uniform_", "normal_", "cauchy_", "geometric_",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape.data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) if not isinstance(s, int) else s for s in shape)
+
+
+def rand(shape, dtype=None, name=None):
+    d = core.convert_dtype(dtype) or core.get_default_dtype()
+    return Tensor(jax.random.uniform(core.next_rng_key(), _shape(shape), d))
+
+
+def randn(shape, dtype=None, name=None):
+    d = core.convert_dtype(dtype) or core.get_default_dtype()
+    return Tensor(jax.random.normal(core.next_rng_key(), _shape(shape), d))
+
+
+standard_normal = randn
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    d = core.convert_dtype(dtype) or core.get_default_dtype()
+    key = jax.random.key(seed) if seed else core.next_rng_key()
+    return Tensor(jax.random.normal(key, _shape(shape), d) * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    d = core.convert_dtype(dtype)
+    return Tensor(jax.random.randint(core.next_rng_key(), _shape(shape),
+                                     int(unwrap(low)), int(unwrap(high)), d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = to_tensor_like(x)
+    if high is None:
+        low, high = 0, low
+    d = core.convert_dtype(dtype) or x.dtype
+    out = jax.random.randint(core.next_rng_key(), tuple(x.shape), int(low), int(high),
+                             jnp.int32)
+    return Tensor(out.astype(d))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    d = core.convert_dtype(dtype) or core.get_default_dtype()
+    key = jax.random.key(seed) if seed else core.next_rng_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), d, minval=float(unwrap(min)),
+                                     maxval=float(unwrap(max))))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = unwrap(mean) if isinstance(mean, Tensor) else mean
+        s = unwrap(std) if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(core.next_rng_key(), shp,
+                                        core.get_default_dtype()) * s + m)
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(jax.random.normal(core.next_rng_key(), shp,
+                                    core.get_default_dtype()) * std + mean)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    return Tensor(jnp.exp(normal(mean, std, shape).data))
+
+
+def randperm(n, dtype="int64", name=None):
+    d = core.convert_dtype(dtype)
+    return Tensor(jax.random.permutation(core.next_rng_key(), int(n)).astype(d))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = to_tensor_like(x)
+    p = x.data / jnp.sum(x.data, axis=-1, keepdims=True)
+    key = core.next_rng_key()
+    if replacement:
+        out = jax.random.categorical(key, jnp.log(jnp.maximum(p, 1e-30)),
+                                     shape=(num_samples,) + p.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, p.shape)
+        scores = jnp.log(jnp.maximum(p, 1e-30)) + g
+        _, out = jax.lax.top_k(scores, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    x = to_tensor_like(x)
+    u = jax.random.uniform(core.next_rng_key(), tuple(x.shape))
+    return Tensor((u < x.data).astype(x.dtype))
+
+
+def poisson(x, name=None):
+    x = to_tensor_like(x)
+    return Tensor(jax.random.poisson(core.next_rng_key(), x.data,
+                                     dtype=jnp.int32).astype(x.dtype))
+
+
+def binomial(count, prob, name=None):
+    c, p = unwrap(count), unwrap(prob)
+    out = jax.random.binomial(core.next_rng_key(), c.astype(jnp.float32),
+                              p.astype(jnp.float32))
+    return Tensor(out.astype(jnp.int64))
+
+
+def standard_gamma(x, name=None):
+    x = to_tensor_like(x)
+    return Tensor(jax.random.gamma(core.next_rng_key(), x.data))
+
+
+def exponential_(x, lam=1.0, name=None):
+    u = jax.random.uniform(core.next_rng_key(), tuple(x.shape),
+                           x.dtype if jnp.issubdtype(x.dtype, jnp.floating)
+                           else jnp.float32, minval=1e-7, maxval=1.0)
+    x.data = (-jnp.log(u) / lam).astype(x.dtype)
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else core.next_rng_key()
+    x.data = jax.random.uniform(key, tuple(x.shape), x.dtype, minval=min, maxval=max)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x.data = jax.random.normal(core.next_rng_key(), tuple(x.shape), x.dtype) * std + mean
+    return x
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    u = jax.random.uniform(core.next_rng_key(), tuple(x.shape), x.dtype,
+                           minval=1e-6, maxval=1 - 1e-6)
+    x.data = loc + scale * jnp.tan(jnp.pi * (u - 0.5))
+    return x
+
+
+def geometric_(x, probs, name=None):
+    u = jax.random.uniform(core.next_rng_key(), tuple(x.shape), jnp.float32,
+                           minval=1e-7, maxval=1.0)
+    x.data = (jnp.ceil(jnp.log(u) / jnp.log1p(-probs))).astype(x.dtype)
+    return x

@@ -1,0 +1,216 @@
+"""Reductions (ref: python/paddle/tensor/math.py sum/mean/... ,
+phi/kernels/reduce_*). XLA lowers these straight to efficient TPU reductions."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply_op
+from ..framework import core
+from ..tensor import Tensor
+from ._helpers import to_tensor_like, unwrap
+
+__all__ = [
+    "sum", "mean", "prod", "max", "min", "amax", "amin", "std", "var",
+    "median", "nanmedian", "nansum", "nanmean", "quantile", "nanquantile",
+    "logsumexp", "all", "any", "count_nonzero", "mode", "norm",
+]
+
+
+def _axes(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if isinstance(axis, Tensor):
+        v = np.asarray(axis.data)
+        return tuple(int(a) for a in v.ravel()) if v.ndim else int(v)
+    return int(axis)
+
+
+def _reduce(jfn, x, axis, keepdim, dtype=None, name=""):
+    ax = _axes(axis)
+    d = core.convert_dtype(dtype)
+    def f(a):
+        out = jfn(a, axis=ax, keepdims=keepdim)
+        return out.astype(d) if d is not None else out
+    return apply_op(f, to_tensor_like(x), name=name)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return _reduce(jnp.sum, x, axis, keepdim, dtype, "sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.mean, x, axis, keepdim, None, "mean")
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return _reduce(jnp.prod, x, axis, keepdim, dtype, "prod")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.max, x, axis, keepdim, None, "max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.min, x, axis, keepdim, None, "min")
+
+
+amax = max
+amin = min
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return _reduce(jnp.nansum, x, axis, keepdim, dtype, "nansum")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.nanmean, x, axis, keepdim, None, "nanmean")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axes(axis)
+    dd = 1 if unbiased else 0
+    return apply_op(lambda a: jnp.std(a, axis=ax, ddof=dd, keepdims=keepdim),
+                    to_tensor_like(x), name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axes(axis)
+    dd = 1 if unbiased else 0
+    return apply_op(lambda a: jnp.var(a, axis=ax, ddof=dd, keepdims=keepdim),
+                    to_tensor_like(x), name="var")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axes(axis)
+    if mode == "avg":
+        return apply_op(lambda a: jnp.median(a, axis=ax, keepdims=keepdim),
+                        to_tensor_like(x), name="median")
+    # mode="min": lower median + its index
+    x = to_tensor_like(x)
+    a = x.data
+    if ax is None:
+        flat = a.ravel()
+        k = (flat.shape[0] - 1) // 2
+        srt = jnp.sort(flat)
+        val = apply_op(lambda b: jnp.sort(b.ravel())[k] if not keepdim
+                       else jnp.sort(b.ravel())[k].reshape([1] * b.ndim), x)
+        idx = jnp.argsort(flat)[k]
+        return val, Tensor(idx.astype(jnp.int64))
+    val = apply_op(
+        lambda b: jnp.take_along_axis(
+            jnp.sort(b, axis=ax),
+            jnp.full([1 if i == ax % b.ndim else s for i, s in enumerate(b.shape)],
+                     (b.shape[ax] - 1) // 2, jnp.int32), axis=ax)
+        if keepdim else jnp.squeeze(jnp.take_along_axis(
+            jnp.sort(b, axis=ax),
+            jnp.full([1 if i == ax % b.ndim else s for i, s in enumerate(b.shape)],
+                     (b.shape[ax] - 1) // 2, jnp.int32), axis=ax), ax),
+        x, name="median")
+    k = (a.shape[ax] - 1) // 2
+    idx = jnp.take(jnp.argsort(a, axis=ax), jnp.asarray([k]), axis=ax)
+    if not keepdim:
+        idx = jnp.squeeze(idx, ax)
+    return val, Tensor(idx.astype(jnp.int64))
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axes(axis)
+    return apply_op(lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim),
+                    to_tensor_like(x), name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _axes(axis)
+    qq = unwrap(q)
+    return apply_op(
+        lambda a: jnp.quantile(a, jnp.asarray(qq), axis=ax, keepdims=keepdim,
+                               method=interpolation),
+        to_tensor_like(x), name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _axes(axis)
+    qq = unwrap(q)
+    return apply_op(
+        lambda a: jnp.nanquantile(a, jnp.asarray(qq), axis=ax, keepdims=keepdim,
+                                  method=interpolation),
+        to_tensor_like(x), name="nanquantile")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _axes(axis)
+    return apply_op(lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
+                    to_tensor_like(x), name="logsumexp")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return Tensor(jnp.all(unwrap(x), axis=_axes(axis), keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return Tensor(jnp.any(unwrap(x), axis=_axes(axis), keepdims=keepdim))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return Tensor(jnp.count_nonzero(unwrap(x), axis=_axes(axis),
+                                    keepdims=keepdim).astype(jnp.int64))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = to_tensor_like(x)
+    ax = int(axis) % x.ndim
+    a = jnp.moveaxis(x.data, ax, -1)
+    n = a.shape[-1]
+    # O(n^2) pairwise-count mode: fine for the typical small reduce axis and
+    # maps to one fused TPU kernel (no data-dependent shapes)
+    counts = jnp.sum(a[..., :, None] == a[..., None, :], axis=-1)
+    # prefer the largest value among ties, matching the reference kernel
+    order = jnp.argsort(a, axis=-1)
+    sc = jnp.take_along_axis(counts, order, axis=-1)
+    best_sorted = n - 1 - jnp.argmax(sc[..., ::-1], axis=-1)
+    pos = jnp.take_along_axis(order, best_sorted[..., None], axis=-1)
+    vals_b = jnp.take_along_axis(a, pos, axis=-1)
+    # index = last occurrence of modal value
+    hits = a == vals_b
+    ar = jnp.broadcast_to(jnp.arange(n), a.shape)
+    idx = jnp.max(jnp.where(hits, ar, -1), axis=-1)
+    out_val = apply_op(
+        lambda b: _squeeze_or_keep(
+            jnp.take_along_axis(jnp.moveaxis(b, ax, -1), idx[..., None], axis=-1),
+            ax, keepdim),
+        x, name="mode")
+    idx_out = idx[..., None] if keepdim else idx
+    if keepdim:
+        idx_out = jnp.moveaxis(idx_out, -1, ax)
+    return out_val, Tensor(idx_out.astype(jnp.int64))
+
+
+def _squeeze_or_keep(v, ax, keepdim):
+    # v has the reduced axis of size 1 at the end
+    if keepdim:
+        return jnp.moveaxis(v, -1, ax)
+    return v[..., 0]
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    ax = _axes(axis)
+    def f(a):
+        if p is None or p == "fro":
+            if ax is None:
+                return jnp.sqrt(jnp.sum(jnp.real(a * jnp.conj(a))))
+            return jnp.linalg.norm(a, ord=None, axis=ax, keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=ax, keepdims=keepdim)
+        if p == float("inf"):
+            r = jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+            return r
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+    return apply_op(f, to_tensor_like(x), name="norm")

@@ -1,0 +1,76 @@
+"""Search/sort ops (ref: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply_op
+from ..framework import core
+from ..tensor import Tensor
+from ._helpers import to_tensor_like, unwrap
+
+__all__ = ["argmax", "argmin", "argsort", "sort", "topk", "kthvalue"]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = core.convert_dtype(dtype)
+    out = jnp.argmax(unwrap(x), axis=axis, keepdims=keepdim if axis is not None else False)
+    return Tensor(out.astype(d))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = core.convert_dtype(dtype)
+    out = jnp.argmin(unwrap(x), axis=axis, keepdims=keepdim if axis is not None else False)
+    return Tensor(out.astype(d))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    a = unwrap(x)
+    out = jnp.argsort(-a if descending else a, axis=axis, stable=stable or descending)
+    return Tensor(out.astype(jnp.int64))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        out = jnp.sort(a, axis=axis)
+        return jnp.flip(out, axis=axis) if descending else out
+    if descending:
+        # stable descending must mirror argsort ordering; sort values by index
+        idx = argsort(x, axis=axis, descending=True, stable=stable)
+        return apply_op(lambda a: jnp.take_along_axis(a, idx.data.astype(jnp.int32),
+                                                      axis=axis),
+                        to_tensor_like(x), name="sort")
+    return apply_op(f, to_tensor_like(x), name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = to_tensor_like(x)
+    if isinstance(k, Tensor):
+        k = int(np.asarray(k.data))
+    ax = (axis if axis is not None else -1) % max(x.ndim, 1)
+    def f(a):
+        am = jnp.moveaxis(a, ax, -1)
+        if largest:
+            v, i = jax.lax.top_k(am, k)
+        else:
+            v, i = jax.lax.top_k(-am, k)
+            v = -v
+        return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i, -1, ax)
+    vals, idx = apply_op(f, x, n_outputs=2, name="topk")
+    return vals, Tensor(idx.data.astype(jnp.int64))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = to_tensor_like(x)
+    ax = axis % x.ndim
+    def f(a):
+        s = jnp.sort(a, axis=ax)
+        v = jnp.take(s, jnp.asarray([k - 1]), axis=ax)
+        return v if keepdim else jnp.squeeze(v, ax)
+    vals = apply_op(f, x, name="kthvalue")
+    si = jnp.argsort(x.data, axis=ax)
+    idx = jnp.take(si, jnp.asarray([k - 1]), axis=ax)
+    if not keepdim:
+        idx = jnp.squeeze(idx, ax)
+    return vals, Tensor(idx.astype(jnp.int64))

@@ -1,0 +1,6 @@
+"""paddle_tpu.optimizer (ref: python/paddle/optimizer/)."""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    ASGD, LBFGS, SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum,
+    Optimizer, RMSProp, Rprop,
+)

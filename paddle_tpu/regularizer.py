@@ -1,0 +1,19 @@
+"""Regularizers (ref: python/paddle/regularizer.py)."""
+from __future__ import annotations
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __call__(self, w):
+        return self._coeff * w
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __call__(self, w):
+        import jax.numpy as jnp
+        return self._coeff * jnp.sign(w)

@@ -1,0 +1,265 @@
+"""paddle_tpu.Tensor — a Paddle-shaped tensor over jax.Array.
+
+TPU-native replacement for the reference's DenseTensor + python Tensor
+binding (ref: paddle/phi/core/dense_tensor.h:37; paddle/fluid/pybind/eager.cc).
+The payload `.data` is a jax.Array (or a tracer under jit), so every method
+is valid both eagerly and inside compiled programs. Registered as a pytree
+so Tensors can cross jit/pjit boundaries directly.
+
+Paddle semantics preserved:
+  * `stop_gradient` defaults to True for ad-hoc tensors, False for Parameters
+    (ref: python/paddle/base/dygraph/tensor_patch_methods.py).
+  * in-place ops (`add_`, `__setitem__`, ...) rebind `.data` and re-tape,
+    matching the inplace-version semantics of the eager engine.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .framework import core
+from .autograd import tape as _tape
+
+
+def _unwrap(v):
+    return v.data if isinstance(v, Tensor) else v
+
+
+class Tensor:
+    __slots__ = ("data", "stop_gradient", "grad", "_node", "_out_idx",
+                 "name", "persistable", "_grad_hooks", "pspec", "__weakref__")
+
+    def __init__(self, data, stop_gradient: bool = True, name: str = ""):
+        if isinstance(data, Tensor):
+            data = data.data
+        elif isinstance(data, (list, tuple, int, float, bool, np.ndarray, np.generic)):
+            data = jnp.asarray(data)
+        self.data = data
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self._node = None
+        self._out_idx = 0
+        self.name = name
+        self.persistable = False
+        self._grad_hooks = []
+        self.pspec = None  # PartitionSpec annotation for distributed layers
+
+    # -- meta ---------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self.data.shape)
+
+    @property
+    def ndim(self):
+        return self.data.ndim
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self.data.shape)) if self.data.shape else 1
+
+    @property
+    def place(self):
+        try:
+            dev = list(self.data.devices())[0]
+            return f"{dev.platform}:{dev.id}"
+        except Exception:
+            return "traced"
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    def rank(self):
+        return self.ndim
+
+    def element_size(self):
+        return np.dtype(self.dtype).itemsize
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self.data.shape[0]
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        try:
+            val = np.asarray(self.data)
+            body = np.array2string(val, precision=4, separator=", ")
+        except Exception:
+            body = f"<traced {self.data}>"
+        return (f"Tensor(shape={self.shape}, dtype={core.dtype_name(self.dtype)}, "
+                f"stop_gradient={sg},\n       {body})")
+
+    # -- export -------------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self.data)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self.data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *idx):
+        a = np.asarray(self.data)
+        return a.item(*idx) if idx else a.item()
+
+    def tolist(self):
+        return np.asarray(self.data).tolist()
+
+    def __float__(self):
+        return float(np.asarray(self.data))
+
+    def __int__(self):
+        return int(np.asarray(self.data))
+
+    def __bool__(self):
+        return bool(np.asarray(self.data))
+
+    def __index__(self):
+        return int(np.asarray(self.data))
+
+    def __hash__(self):
+        return id(self)
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        _tape.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad.data), stop_gradient=True)
+        else:
+            self.grad = None
+
+    def register_hook(self, hook):
+        self._grad_hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                try:
+                    self._grad_hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Handle()
+
+    def detach(self) -> "Tensor":
+        return Tensor(self.data, stop_gradient=True, name=self.name)
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        return _tape.apply_op(lambda x: x + 0, self, name="clone")
+
+    # -- in-place helpers ---------------------------------------------------
+    def _inplace_from(self, new: "Tensor"):
+        """Rebind payload+tape from an out-of-place result (inplace semantics)."""
+        self.data = new.data
+        self._node = new._node
+        self._out_idx = new._out_idx
+        if new._node is not None:
+            self.stop_gradient = False
+        return self
+
+    def set_value(self, value):
+        self.data = jnp.asarray(_unwrap(value), dtype=self.dtype).reshape(self.data.shape)
+        return self
+
+    def copy_(self, other, blocking: bool = True):
+        return self.set_value(other)
+
+    def fill_(self, value):
+        self.data = jnp.full_like(self.data, value)
+        return self
+
+    def zero_(self):
+        self.data = jnp.zeros_like(self.data)
+        return self
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, idx):
+        idx = _map_index(idx)
+        return _tape.apply_op(lambda x: x[idx], self, name="getitem")
+
+    def __setitem__(self, idx, value):
+        idx = _map_index(idx)
+        if isinstance(value, (int, float, bool)):
+            new = _tape.apply_op(lambda x: x.at[idx].set(value), self, name="setitem")
+        else:
+            # keep the value's tape node: grads must flow into the assigned
+            # tensor (ref: eager inplace-version semantics)
+            vt = value if isinstance(value, Tensor) else Tensor(value)
+            new = _tape.apply_op(
+                lambda x, v: x.at[idx].set(v.astype(x.dtype)),
+                self, vt, name="setitem")
+        self._inplace_from(new)
+
+    # -- iteration ----------------------------------------------------------
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- pytree -------------------------------------------------------------
+    def tree_flatten(self):
+        return (self.data,), (self.stop_gradient, self.name)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        t = cls(children[0], stop_gradient=aux[0], name=aux[1])
+        return t
+
+
+def _map_index(idx):
+    """Unwrap Tensors inside an index expression."""
+    if isinstance(idx, Tensor):
+        return idx.data
+    if isinstance(idx, tuple):
+        return tuple(_map_index(i) for i in idx)
+    if isinstance(idx, list):
+        return [_map_index(i) for i in idx]
+    return idx
+
+
+jax.tree_util.register_pytree_node(
+    Tensor,
+    lambda t: t.tree_flatten(),
+    Tensor.tree_unflatten,
+)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (ref: python/paddle/base/framework.py Parameter)."""
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed")
+
+    def __init__(self, data, stop_gradient: bool = False, name: str = "",
+                 trainable: bool = True):
+        super().__init__(data, stop_gradient=stop_gradient, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self.persistable = True
+
+
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda t: ((t.data,), (t.stop_gradient, t.name)),
+    lambda aux, ch: Parameter(ch[0], stop_gradient=aux[0], name=aux[1]),
+)

@@ -1,0 +1,2 @@
+"""paddle.vision (ref: python/paddle/vision/)."""
+from . import models  # noqa: F401

@@ -1,0 +1,167 @@
+"""Autograd engine tests (ref: eager backward.cc semantics + finite-diff
+check pattern from test/legacy_test/op_test.py:2973 check_grad)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    """Finite-difference gradient (ref: op_test.py:150 get_numeric_gradient)."""
+    x0 = x.numpy().astype(np.float64)
+    g = np.zeros_like(x0)
+    it = np.nditer(x0, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x0.copy()
+        xp[idx] += eps
+        xm = x0.copy()
+        xm[idx] -= eps
+        fp = fn(paddle.to_tensor(xp.astype(np.float32))).item()
+        fm = fn(paddle.to_tensor(xm.astype(np.float32))).item()
+        g[idx] = (fp - fm) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6], rtol=1e-6)
+
+
+def test_chain_backward():
+    x = paddle.to_tensor([0.5, 1.5], stop_gradient=False)
+    y = paddle.exp(x) * paddle.sin(x)
+    loss = y.sum()
+    loss.backward()
+    expected = np.exp([0.5, 1.5]) * np.sin([0.5, 1.5]) + \
+        np.exp([0.5, 1.5]) * np.cos([0.5, 1.5])
+    np.testing.assert_allclose(x.grad.numpy(), expected, rtol=1e-5)
+
+
+def test_matmul_grad_vs_numeric():
+    a = paddle.to_tensor(np.random.randn(3, 4).astype(np.float32),
+                         stop_gradient=False)
+    b = paddle.to_tensor(np.random.randn(4, 2).astype(np.float32),
+                         stop_gradient=False)
+    loss = paddle.matmul(a, b).sum()
+    loss.backward()
+    an = numeric_grad(lambda t: paddle.matmul(t, b.detach()).sum(), a)
+    np.testing.assert_allclose(a.grad.numpy(), an, atol=1e-2)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y1 = x * 2
+    y2 = x * 3
+    (y1 + y2).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_backward_twice_accumulates():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_stop_gradient():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    (x * y).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach_cuts_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * 3).detach()
+    z = y * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x ** 3).sum()
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [3.0, 12.0], rtol=1e-6)
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32), stop_gradient=False)
+    parts = paddle.split(x, 3)
+    loss = (parts[0] * 1 + parts[1] * 2 + parts[2] * 3).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 1, 2, 2, 3, 3])
+
+
+def test_getitem_grad():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+    y = x[0].sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 1], [0, 0]])
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+    x.register_hook(lambda g: seen.append(g.numpy().copy()))
+    (x * 2).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [2.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    import paddle_tpu.autograd
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    y.backward()
+    np.testing.assert_allclose(y.numpy(), [3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_setitem_value_gradient_flows():
+    x = paddle.zeros([4])
+    y = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    x[1:3] = y
+    x.sum().backward()
+    np.testing.assert_allclose(y.grad.numpy(), [1.0, 1.0])
+
+
+def test_grad_does_not_pollute_other_leaves():
+    w = paddle.to_tensor([2.0], stop_gradient=False)
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    out = (w * x).sum()
+    (gx,) = paddle.grad(out, x)
+    np.testing.assert_allclose(gx.numpy(), [2.0])
+    assert w.grad is None, "paddle.grad must not write .grad of other params"

@@ -1,0 +1,267 @@
+"""nn layers: shapes, semantics, grads (ref: test/legacy_test per-layer tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+class TestLayerBase:
+    def test_parameter_registration(self):
+        layer = nn.Linear(4, 3)
+        names = dict(layer.named_parameters())
+        assert set(names) == {"weight", "bias"}
+        assert layer.weight.shape == [4, 3]
+
+    def test_nested_state_dict(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sd = m.state_dict()
+        assert "0.weight" in sd and "2.bias" in sd
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        missing, unexpected = m2.set_state_dict(sd)
+        assert not missing and not unexpected
+        np.testing.assert_allclose(m2[0].weight.numpy(), m[0].weight.numpy())
+
+    def test_train_eval_propagates(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        m.train()
+        assert m[1].training
+
+    def test_buffers(self):
+        bn = nn.BatchNorm2D(3)
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_variance" in sd
+
+    def test_to_dtype(self):
+        m = nn.Linear(2, 2)
+        m.to(dtype="bfloat16")
+        assert str(m.weight.dtype) == "bfloat16"
+
+
+class TestLayers:
+    def test_linear(self):
+        l = nn.Linear(4, 3)
+        x = t(np.random.randn(2, 4))
+        out = l(x)
+        ref = x.numpy() @ l.weight.numpy() + l.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv2d_shape_and_value(self):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        x = t(np.random.randn(2, 3, 16, 16))
+        out = conv(x)
+        assert out.shape == [2, 8, 8, 8]
+        # golden check against scipy correlate for one output position
+        import scipy.signal
+        xn = x.numpy()
+        w = conv.weight.numpy()
+        b = conv.bias.numpy()
+        xp = np.pad(xn, [(0, 0), (0, 0), (1, 1), (1, 1)])
+        acc = sum(scipy.signal.correlate(xp[0, c], w[0, c], mode="valid")
+                  for c in range(3))
+        np.testing.assert_allclose(out.numpy()[0, 0], acc[::2, ::2] + b[0],
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_conv_transpose_inverts_shape(self):
+        deconv = nn.Conv2DTranspose(4, 3, 4, stride=2, padding=1)
+        x = t(np.random.randn(1, 4, 8, 8))
+        assert deconv(x).shape == [1, 3, 16, 16]
+
+    def test_batchnorm_normalizes(self):
+        bn = nn.BatchNorm2D(5)
+        x = t(np.random.randn(8, 5, 4, 4) * 3 + 2)
+        out = bn(x).numpy()
+        assert abs(out.mean()) < 1e-4
+        assert abs(out.std() - 1) < 1e-2
+        # running stats moved toward batch stats
+        assert abs(bn._mean.numpy().mean() - 0.2) < 0.2
+
+    def test_batchnorm_eval_uses_running(self):
+        bn = nn.BatchNorm2D(2)
+        bn.eval()
+        x = t(np.random.randn(4, 2, 3, 3) + 5)
+        out = bn(x).numpy()
+        np.testing.assert_allclose(out, x.numpy(), rtol=1e-4)  # mean0/var1
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(6)
+        x = t(np.random.randn(2, 3, 6) * 4 + 1)
+        out = ln(x).numpy()
+        np.testing.assert_allclose(out.mean(-1), 0, atol=1e-5)
+        np.testing.assert_allclose(out.std(-1), 1, atol=1e-2)
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(8)
+        x = t(np.random.randn(4, 8))
+        out = rn(x).numpy()
+        xn = x.numpy()
+        ref = xn / np.sqrt((xn ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 6)
+        x = t(np.random.randn(2, 6, 4, 4))
+        out = gn(x).numpy()
+        grp = out.reshape(2, 2, 3 * 16)
+        np.testing.assert_allclose(grp.mean(-1), 0, atol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        ids = paddle.to_tensor(np.array([[1, 0, 3]], np.int32))
+        out = emb(ids)
+        assert out.shape == [1, 3, 4]
+        np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+
+    def test_dropout_train_eval(self):
+        d = nn.Dropout(0.5)
+        x = t(np.ones((100, 100)))
+        y = d(x)
+        frac = (y.numpy() == 0).mean()
+        assert 0.4 < frac < 0.6
+        np.testing.assert_allclose(y.numpy()[y.numpy() != 0], 2.0)
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+    def test_pooling(self):
+        x = t(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        mp = nn.MaxPool2D(2, stride=2)(x)
+        np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+        ap = nn.AvgPool2D(2, stride=2)(x)
+        np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5],
+                                                      [10.5, 12.5]])
+        aap = nn.AdaptiveAvgPool2D(1)(x)
+        np.testing.assert_allclose(aap.numpy()[0, 0, 0, 0], 7.5)
+
+    def test_activations(self):
+        x = t(np.linspace(-2, 2, 9))
+        np.testing.assert_allclose(nn.ReLU()(x).numpy(),
+                                   np.maximum(x.numpy(), 0))
+        np.testing.assert_allclose(
+            nn.Sigmoid()(x).numpy(), 1 / (1 + np.exp(-x.numpy())), rtol=1e-5)
+        sm = nn.Softmax()(t(np.random.randn(3, 5)))
+        np.testing.assert_allclose(sm.numpy().sum(-1), np.ones(3), rtol=1e-5)
+
+    def test_rnn_lstm_gru(self):
+        for cls in (nn.SimpleRNN, nn.LSTM, nn.GRU):
+            rnn = cls(4, 6)
+            x = t(np.random.randn(2, 5, 4))
+            out, state = rnn(x)
+            assert out.shape == [2, 5, 6]
+
+    def test_bilstm(self):
+        rnn = nn.LSTM(4, 6, direction="bidirect")
+        x = t(np.random.randn(2, 5, 4))
+        out, _ = rnn(x)
+        assert out.shape == [2, 5, 12]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = t(np.random.randn(2, 7, 16))
+        out = enc(x)
+        assert out.shape == [2, 7, 16]
+
+    def test_mha_causal_matches_ref(self):
+        mha = nn.MultiHeadAttention(8, 2, dropout=0.0)
+        x = t(np.random.randn(1, 5, 8))
+        out = mha(x)
+        assert out.shape == [1, 5, 8]
+
+
+class TestFunctional:
+    def test_cross_entropy_hard(self):
+        logits = t(np.random.randn(4, 7), sg=False)
+        labels = paddle.to_tensor(np.array([0, 3, 6, 2], np.int64))
+        loss = F.cross_entropy(logits, labels)
+        p = np.exp(logits.numpy() - logits.numpy().max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels.numpy()]).mean()
+        np.testing.assert_allclose(loss.item(), ref, rtol=1e-5)
+        loss.backward()
+        assert logits.grad is not None
+
+    def test_cross_entropy_soft_and_ignore(self):
+        logits = t(np.random.randn(4, 5))
+        soft = np.random.rand(4, 5).astype(np.float32)
+        soft /= soft.sum(-1, keepdims=True)
+        loss = F.cross_entropy(logits, paddle.to_tensor(soft),
+                               soft_label=True)
+        assert np.isfinite(loss.item())
+        labels = paddle.to_tensor(np.array([0, -100, 2, -100], np.int64))
+        li = F.cross_entropy(logits, labels, ignore_index=-100)
+        # mean over 2 valid entries only
+        p = np.exp(logits.numpy() - logits.numpy().max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = -(np.log(p[0, 0]) + np.log(p[2, 2])) / 2
+        np.testing.assert_allclose(li.item(), ref, rtol=1e-5)
+
+    def test_mse_l1_smooth(self):
+        a, b = np.random.randn(5).astype(np.float32), np.zeros(5, np.float32)
+        np.testing.assert_allclose(F.mse_loss(t(a), t(b)).item(),
+                                   (a ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(F.l1_loss(t(a), t(b)).item(),
+                                   np.abs(a).mean(), rtol=1e-5)
+
+    def test_bce_with_logits(self):
+        x = np.random.randn(6).astype(np.float32)
+        y = (np.random.rand(6) > 0.5).astype(np.float32)
+        out = F.binary_cross_entropy_with_logits(t(x), t(y))
+        p = 1 / (1 + np.exp(-x))
+        ref = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(out.item(), ref, rtol=1e-4)
+
+    def test_sdpa_matches_naive(self):
+        B, S, H, D = 2, 6, 2, 8
+        q = t(np.random.randn(B, S, H, D))
+        k = t(np.random.randn(B, S, H, D))
+        v = t(np.random.randn(B, S, H, D))
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        # naive reference
+        qn = q.numpy().transpose(0, 2, 1, 3)
+        kn = k.numpy().transpose(0, 2, 1, 3)
+        vn = v.numpy().transpose(0, 2, 1, 3)
+        s = qn @ kn.transpose(0, 1, 3, 2) / np.sqrt(D)
+        mask = np.tril(np.ones((S, S), bool))
+        s = np.where(mask, s, -np.inf)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = (p @ vn).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-3, atol=1e-4)
+
+    def test_interpolate(self):
+        x = t(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        up = F.interpolate(x, scale_factor=2, mode="nearest")
+        assert up.shape == [1, 1, 8, 8]
+        bi = F.interpolate(x, size=[2, 2], mode="bilinear")
+        assert bi.shape == [1, 1, 2, 2]
+
+    def test_one_hot_label_smooth(self):
+        oh = F.one_hot(paddle.to_tensor(np.array([0, 2], np.int64)), 3)
+        np.testing.assert_allclose(oh.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+    def test_grid_sample_identity(self):
+        x = t(np.random.randn(1, 1, 4, 4))
+        ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                             indexing="ij")
+        grid = t(np.stack([xs, ys], -1)[None])
+        out = F.grid_sample(x, grid, align_corners=True)
+        np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-5)
+
+
+class TestGradThroughLayers:
+    def test_conv_bn_relu_backward(self):
+        m = nn.Sequential(nn.Conv2D(3, 4, 3, padding=1), nn.BatchNorm2D(4),
+                          nn.ReLU())
+        x = t(np.random.randn(2, 3, 8, 8))
+        loss = m(x).mean()
+        loss.backward()
+        for p in m.parameters():
+            if not p.stop_gradient:
+                assert p.grad is not None, p.name

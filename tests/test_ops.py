@@ -1,0 +1,252 @@
+"""Op surface numeric tests vs numpy golden (OpTest pattern,
+ref: test/legacy_test/op_test.py:2017 check_output)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a, dtype=np.float32), stop_gradient=sg)
+
+
+class TestMath:
+    def test_unary_table(self):
+        x = np.abs(np.random.randn(3, 4).astype(np.float32)) + 0.1
+        for name, ref in [("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+                          ("abs", np.abs), ("floor", np.floor),
+                          ("tanh", np.tanh), ("sin", np.sin)]:
+            out = getattr(paddle, name)(t(x))
+            np.testing.assert_allclose(out.numpy(), ref(x), rtol=1e-5,
+                                       err_msg=name)
+
+    def test_binary_broadcast(self):
+        a = np.random.randn(3, 1).astype(np.float32)
+        b = np.random.randn(1, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.add(t(a), t(b)).numpy(), a + b,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(paddle.maximum(t(a), t(b)).numpy(),
+                                   np.maximum(a, b))
+
+    def test_clip_scale(self):
+        x = np.linspace(-2, 2, 9).astype(np.float32)
+        np.testing.assert_allclose(paddle.clip(t(x), -1, 1).numpy(),
+                                   np.clip(x, -1, 1))
+        np.testing.assert_allclose(paddle.scale(t(x), 2.0, 1.0).numpy(),
+                                   x * 2 + 1)
+
+    def test_cumsum_cumprod(self):
+        x = np.random.rand(2, 3).astype(np.float32)
+        np.testing.assert_allclose(paddle.cumsum(t(x), axis=1).numpy(),
+                                   np.cumsum(x, 1), rtol=1e-6)
+        np.testing.assert_allclose(paddle.cumprod(t(x), dim=0).numpy(),
+                                   np.cumprod(x, 0), rtol=1e-6)
+
+    def test_lerp_outer(self):
+        a, b = np.ones(3, np.float32), np.full(3, 3.0, np.float32)
+        np.testing.assert_allclose(paddle.lerp(t(a), t(b), 0.5).numpy(),
+                                   [2, 2, 2])
+        np.testing.assert_allclose(
+            paddle.outer(t([1., 2.]), t([3., 4.])).numpy(),
+            [[3, 4], [6, 8]])
+
+
+class TestReduction:
+    def test_basic(self):
+        x = np.random.randn(3, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.sum(t(x), axis=1).numpy(),
+                                   x.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.mean(t(x)).numpy(), x.mean(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.max(t(x), axis=[0, 2]).numpy(),
+                                   x.max((0, 2)))
+        np.testing.assert_allclose(
+            paddle.std(t(x), axis=0, keepdim=True).numpy(),
+            x.std(0, ddof=1, keepdims=True), rtol=1e-4)
+
+    def test_logsumexp(self):
+        x = np.random.randn(4, 5).astype(np.float32)
+        from scipy.special import logsumexp as ref
+        np.testing.assert_allclose(paddle.logsumexp(t(x), axis=1).numpy(),
+                                   ref(x, axis=1), rtol=1e-5)
+
+    def test_mode_median(self):
+        x = np.array([[1., 2., 2., 3.], [5., 5., 1., 1.]], np.float32)
+        v, i = paddle.mode(t(x))
+        np.testing.assert_allclose(v.numpy(), [2., 5.])
+        np.testing.assert_allclose(paddle.median(t(x), axis=1).numpy(),
+                                   np.median(x, 1))
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        np.testing.assert_allclose(
+            paddle.reshape(t(x), [4, 6]).numpy(), x.reshape(4, 6))
+        np.testing.assert_allclose(
+            paddle.transpose(t(x), [2, 0, 1]).numpy(), x.transpose(2, 0, 1))
+
+    def test_concat_split_stack(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(2, 3).astype(np.float32)
+        np.testing.assert_allclose(paddle.concat([t(a), t(b)], 0).numpy(),
+                                   np.concatenate([a, b], 0))
+        np.testing.assert_allclose(paddle.stack([t(a), t(b)], 1).numpy(),
+                                   np.stack([a, b], 1))
+        parts = paddle.split(t(a), [1, 2], axis=1)
+        np.testing.assert_allclose(parts[0].numpy(), a[:, :1])
+        np.testing.assert_allclose(parts[1].numpy(), a[:, 1:])
+
+    def test_gather_scatter(self):
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        idx = np.array([2, 0])
+        np.testing.assert_allclose(paddle.gather(t(x), t(idx), 0).numpy(),
+                                   x[[2, 0]])
+        upd = np.ones((2, 3), np.float32) * 9
+        out = paddle.scatter(t(x), t(idx), t(upd))
+        expect = x.copy()
+        expect[[2, 0]] = 9
+        np.testing.assert_allclose(out.numpy(), expect)
+
+    def test_pad_tile_flip(self):
+        x = np.random.rand(1, 2, 3, 3).astype(np.float32)
+        out = paddle.nn.functional.common.__dict__  # noqa: F841
+        from paddle_tpu.ops.manipulation import pad
+        # paddle/torch convention: first pair pads the LAST dim (W)
+        np.testing.assert_allclose(
+            pad(t(x), [1, 1, 2, 2]).numpy(),
+            np.pad(x, [(0, 0), (0, 0), (2, 2), (1, 1)]))
+        np.testing.assert_allclose(paddle.tile(t(x[0, 0]), [2, 1]).numpy(),
+                                   np.tile(x[0, 0], (2, 1)))
+        np.testing.assert_allclose(paddle.flip(t(x), [3]).numpy(),
+                                   np.flip(x, 3))
+
+    def test_where_masked(self):
+        x = np.random.randn(3, 3).astype(np.float32)
+        cond = x > 0
+        np.testing.assert_allclose(
+            paddle.where(paddle.to_tensor(cond), t(x), t(-x)).numpy(),
+            np.where(cond, x, -x))
+        np.testing.assert_allclose(
+            paddle.masked_select(t(x), paddle.to_tensor(cond)).numpy(),
+            x[cond])
+
+    def test_take_along_put_along(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        idx = np.argsort(x, axis=1)
+        np.testing.assert_allclose(
+            paddle.take_along_axis(t(x), paddle.to_tensor(idx), 1).numpy(),
+            np.take_along_axis(x, idx, 1))
+
+
+class TestSearch:
+    def test_topk_argsort(self):
+        x = np.random.randn(4, 10).astype(np.float32)
+        v, i = paddle.topk(t(x), 3, axis=1)
+        ref = np.sort(x, 1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(v.numpy(), ref, rtol=1e-6)
+        np.testing.assert_allclose(paddle.argmax(t(x), axis=1).numpy(),
+                                   x.argmax(1))
+        np.testing.assert_allclose(paddle.argsort(t(x), axis=1).numpy(),
+                                   np.argsort(x, 1))
+
+    def test_sort_descending(self):
+        x = np.random.randn(5).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.sort(t(x), descending=True).numpy(), np.sort(x)[::-1])
+
+
+class TestLinalg:
+    def test_matmul_shapes(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        b = np.random.randn(2, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.matmul(t(a), t(b)).numpy(), a @ b,
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            paddle.matmul(t(a), t(b.transpose(0, 2, 1)),
+                          transpose_y=True).numpy(),
+            a @ b, rtol=1e-4, atol=1e-4)
+
+    def test_svd_solve(self):
+        a = np.random.randn(4, 4).astype(np.float32)
+        a = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        b = np.random.randn(4, 2).astype(np.float32)
+        x = paddle.linalg.solve(t(a), t(b))
+        np.testing.assert_allclose(a @ x.numpy(), b, atol=1e-3)
+        u, s, vh = paddle.linalg.svd(t(a))
+        rec = (u.numpy() * s.numpy()) @ vh.numpy()
+        np.testing.assert_allclose(rec, a, atol=1e-3)
+
+    def test_einsum(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.einsum("ij,jk->ik", t(a), t(b)).numpy(),
+                                   a @ b, rtol=1e-4, atol=1e-4)
+
+    def test_norm(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.norm(t(x)).numpy(),
+                                   np.linalg.norm(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.norm(t(x), p=1, axis=1).numpy(),
+                                   np.abs(x).sum(1), rtol=1e-5)
+
+
+class TestLogic:
+    def test_compare(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([2.0, 2.0, 2.0], np.float32)
+        assert paddle.equal_all(t(a), t(a)).item()
+        np.testing.assert_array_equal(
+            paddle.greater_than(t(a), t(b)).numpy(), a > b)
+        assert paddle.allclose(t(a), t(a + 1e-9)).item()
+
+
+class TestRandom:
+    def test_shapes_and_determinism(self):
+        paddle.seed(7)
+        a = paddle.randn([3, 4])
+        paddle.seed(7)
+        b = paddle.randn([3, 4])
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+        u = paddle.uniform([100], min=2.0, max=3.0)
+        assert (u.numpy() >= 2).all() and (u.numpy() < 3).all()
+        p = paddle.randperm(10)
+        assert sorted(p.numpy().tolist()) == list(range(10))
+
+    def test_bernoulli_multinomial(self):
+        probs = paddle.full([1000], 0.3)
+        s = paddle.bernoulli(probs)
+        assert 0.2 < s.numpy().mean() < 0.4
+        m = paddle.multinomial(paddle.to_tensor([0.1, 0.0, 0.9]), 50,
+                               replacement=True)
+        assert set(np.unique(m.numpy())) <= {0, 2}
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        x = np.random.randn(8).astype(np.float32)
+        out = paddle.fft.ifft(paddle.fft.fft(t(x)))
+        np.testing.assert_allclose(out.numpy().real, x, atol=1e-5)
+
+
+class TestReviewRegressions:
+    def test_split_indivisible_raises(self):
+        x = paddle.ones([5, 2])
+        with pytest.raises(ValueError):
+            paddle.split(x, 2, axis=0)
+
+    def test_chunk_uneven(self):
+        x = paddle.arange(5)
+        parts = paddle.chunk(x, 2)
+        assert [p.shape[0] for p in parts] == [3, 2]
+
+    def test_take_raise_mode(self):
+        x = paddle.arange(10)
+        with pytest.raises(IndexError):
+            paddle.take(x, paddle.to_tensor(np.array([100])))
+
+    def test_cummax_single_pass(self):
+        x = t(np.array([[1.0, 3.0, 2.0, 5.0]]))
+        v, i = paddle.cummax(x, axis=1)
+        np.testing.assert_allclose(v.numpy(), [[1, 3, 3, 5]])
+        np.testing.assert_allclose(i.numpy(), [[0, 1, 1, 3]])

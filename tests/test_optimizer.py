@@ -1,0 +1,121 @@
+"""Optimizer semantics (ref: test/legacy_test/test_adamw_op.py etc.)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def _quadratic_losses(optimizer_ctor, steps=60, **kw):
+    paddle.seed(0)
+    w = paddle.to_tensor(np.array([3.0, -2.0], np.float32), stop_gradient=False)
+    from paddle_tpu.tensor import Parameter
+    p = Parameter(w.data)
+    o = optimizer_ctor(parameters=[p], **kw)
+    losses = []
+    for _ in range(steps):
+        loss = (p * p).sum()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(loss.item())
+    return losses
+
+
+@pytest.mark.parametrize("ctor,kw", [
+    (opt.SGD, dict(learning_rate=0.1)),
+    (opt.Momentum, dict(learning_rate=0.05)),
+    (opt.Adam, dict(learning_rate=0.1)),
+    (opt.AdamW, dict(learning_rate=0.1)),
+    (opt.Adagrad, dict(learning_rate=0.5)),
+    (opt.Adadelta, dict(learning_rate=10.0)),
+    (opt.RMSProp, dict(learning_rate=0.05)),
+    (opt.Adamax, dict(learning_rate=0.1)),
+    (opt.Lamb, dict(learning_rate=0.05)),
+])
+def test_optimizers_descend(ctor, kw):
+    losses = _quadratic_losses(ctor, **kw)
+    assert losses[-1] < losses[0] * 0.2, f"{ctor.__name__}: {losses[::20]}"
+
+
+def test_adam_matches_reference_formula():
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    from paddle_tpu.tensor import Parameter
+    p = Parameter(np.array([1.0], np.float32))
+    o = opt.Adam(learning_rate=lr, parameters=[p], beta1=b1, beta2=b2,
+                 epsilon=eps)
+    g = np.array([0.5], np.float32)
+    w = np.array([1.0], np.float32)
+    m = np.zeros(1)
+    v = np.zeros(1)
+    for step in range(1, 4):
+        p.grad = paddle.to_tensor(g)
+        o.step()
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mhat = m / (1 - b1 ** step)
+        vhat = v / (1 - b2 ** step)
+        w = w - lr * mhat / (np.sqrt(vhat) + eps)
+        np.testing.assert_allclose(p.numpy(), w, rtol=1e-5)
+
+
+def test_adamw_decay():
+    from paddle_tpu.tensor import Parameter
+    p = Parameter(np.array([1.0], np.float32))
+    o = opt.AdamW(learning_rate=0.1, parameters=[p], weight_decay=0.1)
+    p.grad = paddle.to_tensor(np.array([0.0], np.float32))
+    o.step()
+    # zero grad -> pure decay: w *= (1 - lr*wd)
+    np.testing.assert_allclose(p.numpy(), [1.0 * (1 - 0.1 * 0.1)], rtol=1e-5)
+
+
+def test_lr_scheduler_integration():
+    from paddle_tpu.tensor import Parameter
+    sched = opt.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.1)
+    p = Parameter(np.array([1.0], np.float32))
+    o = opt.SGD(learning_rate=sched, parameters=[p])
+    assert o.get_lr() == pytest.approx(0.1)
+    sched.step()
+    sched.step()
+    assert o.get_lr() == pytest.approx(0.01)
+
+
+def test_lr_schedules_shapes():
+    s = opt.lr.CosineAnnealingDecay(1.0, T_max=10)
+    vals = []
+    for _ in range(10):
+        vals.append(s())
+        s.step()
+    assert vals[0] == pytest.approx(1.0)
+    assert vals[5] < vals[1]
+    w = opt.lr.LinearWarmup(0.5, warmup_steps=5, start_lr=0.0, end_lr=0.5)
+    assert w() == pytest.approx(0.0)
+    for _ in range(5):
+        w.step()
+    assert w() == pytest.approx(0.5)
+
+
+def test_optimizer_state_dict_roundtrip():
+    from paddle_tpu.tensor import Parameter
+    p = Parameter(np.array([1.0, 2.0], np.float32))
+    o = opt.Adam(learning_rate=0.1, parameters=[p])
+    p.grad = paddle.to_tensor(np.array([0.1, 0.1], np.float32))
+    o.step()
+    sd = o.state_dict()
+    p2 = Parameter(np.array([1.0, 2.0], np.float32))
+    o2 = opt.Adam(learning_rate=0.1, parameters=[p2])
+    o2.set_state_dict(sd)
+    assert o2._step_count == 1
+    k1 = [k for (pid, k) in o._state]
+    k2 = [k for (pid, k) in o2._state]
+    assert sorted(k1) == sorted(k2)
+
+
+def test_grad_clip_by_global_norm():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.tensor import Parameter
+    p = Parameter(np.array([1.0], np.float32))
+    p.grad = paddle.to_tensor(np.array([100.0], np.float32))
+    nn.clip_grad_norm_([p], max_norm=1.0)
+    np.testing.assert_allclose(p.grad.numpy(), [1.0], rtol=1e-4)

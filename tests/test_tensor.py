@@ -1,0 +1,74 @@
+"""Tensor basics (ref test strategy: test/legacy_test OpTest-style numeric
+golden checks vs numpy)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_roundtrip():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    assert str(x.dtype) == "float32"
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtype_cast():
+    x = paddle.to_tensor([1, 2, 3])
+    y = x.astype("float32")
+    assert str(y.dtype) == "float32"
+    z = paddle.cast(y, "bfloat16")
+    assert str(z.dtype) == "bfloat16"
+
+
+def test_operators():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+    np.testing.assert_allclose((2 + a).numpy(), [3, 4])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+    assert bool((a < b).all())
+
+
+def test_indexing():
+    x = paddle.arange(12).reshape([3, 4])
+    np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(x[1:, ::2].numpy(), [[4, 6], [8, 10]])
+
+
+def test_setitem():
+    x = paddle.zeros([3, 3])
+    x[1, :] = 5.0
+    np.testing.assert_allclose(x.numpy()[1], [5, 5, 5])
+    np.testing.assert_allclose(x.numpy()[0], [0, 0, 0])
+
+
+def test_item_and_shape():
+    x = paddle.to_tensor(3.5)
+    assert x.item() == pytest.approx(3.5)
+    assert x.ndim == 0
+    y = paddle.ones([2, 3])
+    assert y.size == 6
+    assert y.T.shape == [3, 2]
+
+
+def test_inplace_ops():
+    x = paddle.ones([2])
+    x.add_(paddle.to_tensor([1.0, 2.0]))
+    np.testing.assert_allclose(x.numpy(), [2, 3])
+    x.scale_(2.0)
+    np.testing.assert_allclose(x.numpy(), [4, 6])
+
+
+def test_clone_detach():
+    x = paddle.ones([2])
+    x.stop_gradient = False
+    y = x.clone()
+    assert not y.stop_gradient
+    z = x.detach()
+    assert z.stop_gradient
