@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Benchmark driver hook: LLaMA pretraining step on the available devices.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/s/chip", "vs_baseline": N}
+
+vs_baseline is MFU relative to the A100+NCCL parity target (BASELINE.json):
+A100 LLaMA pretraining lands at ~50% MFU with a tuned Megatron-style stack,
+so vs_baseline = our_MFU / 0.50 (>= 1.0 means we beat the baseline).
+
+Env knobs: BENCH_MODEL (tiny|350m|1b|7b), BENCH_BATCH, BENCH_SEQ, BENCH_STEPS.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+# bf16 peak FLOP/s per chip by TPU generation (match order matters:
+# "v5lite"/"v5e" before the bare "v5" -> v5p fallback)
+_PEAK = {
+    "v3": 123e12,
+    "v4": 275e12,
+    "v5litepod": 197e12, "v5lite": 197e12, "v5e": 197e12,
+    "v6e": 918e12, "trillium": 918e12,
+    "v5p": 459e12, "v5": 459e12,
+}
+
+
+def _peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
+    for tag, peak in _PEAK.items():
+        if tag in kind:
+            return peak
+    if device.platform == "tpu":
+        return 459e12  # assume v5p (BASELINE.md hardware)
+    return None
+
+
+def main():
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu.models import llama as L
+
+    devs = jax.devices()
+    on_tpu = devs[0].platform == "tpu"
+    kind = getattr(devs[0], "device_kind", "").lower().replace(" ", "")
+    small_hbm = ("lite" in kind) or ("v5e" in kind)  # v5e: 16 GB HBM
+
+    if on_tpu:
+        default_model = "350m" if small_hbm else "1b"
+    else:
+        default_model = "tiny"
+    size = os.environ.get("BENCH_MODEL", default_model)
+    cfg = {"tiny": L.llama_tiny, "350m": L.llama_350m,
+           "1b": L.llama_1b, "7b": L.llama_7b}[size]()
+    # batch must divide evenly over the sharding axis (= all chips)
+    batch = int(os.environ.get("BENCH_BATCH",
+                               max(4, len(devs)) if on_tpu else 2))
+    batch = max(batch, len(devs))
+    seq = int(os.environ.get("BENCH_SEQ", 2048 if on_tpu else 256))
+    steps = int(os.environ.get("BENCH_STEPS", 8 if on_tpu else 2))
+    cfg.max_position_embeddings = max(cfg.max_position_embeddings, seq)
+
+    paddle.seed(0)
+    model = L.LlamaForCausalLM(cfg)
+    opt = popt.AdamW(learning_rate=3e-4, parameters=model.parameters(),
+                     weight_decay=0.1)
+
+    def step_fn(ids, labels):
+        return model.loss(ids, labels)
+
+    shard = None
+    if len(devs) > 1:
+        from paddle_tpu.distributed.sharding import ShardingPlan
+        from paddle_tpu.distributed.topology import HybridCommunicateGroup
+        hcg = HybridCommunicateGroup(dp_degree=1, sharding_degree=len(devs))
+        shard = ShardingPlan(hcg.mesh, stage=3)
+    step = paddle.jit.TrainStep(model, opt, step_fn, shard=shard)
+
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+
+    # warmup: 2 steps — the first creates optimizer state (widening the
+    # state tree => second trace/compile); steady state begins at step 2
+    for _ in range(2):
+        loss = step(ids, ids)
+    float(loss.numpy())
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, ids)
+    last = float(loss.numpy())  # blocks until all steps complete
+    dt = time.perf_counter() - t0
+
+    n_chips = len(devs)
+    tokens = batch * seq * steps
+    tok_per_sec_chip = tokens / dt / n_chips
+
+    n_params = sum(int(np.prod(t.shape)) for t in model.parameters())
+    # PaLM-appendix accounting: 6N per token + attention 12*L*d_model*S
+    flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * \
+        cfg.hidden_size * seq
+    peak = _peak_flops(devs[0])
+    mfu = (tok_per_sec_chip * flops_per_token / peak) if peak else 0.0
+    vs_baseline = mfu / 0.50 if peak else 0.0
+
+    print(json.dumps({
+        "metric": f"llama_{size}_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs_baseline, 4),
+        "extra": {
+            "mfu": round(mfu, 4), "loss": round(last, 4),
+            "batch": batch, "seq": seq, "steps": steps,
+            "n_params": n_params, "n_chips": n_chips,
+            "device": getattr(devs[0], "device_kind", devs[0].platform),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -324,6 +324,12 @@ class ShardingPlan:
             return jax.tree_util.tree_map(
                 lambda a: NamedSharding(mesh, spec_fn(a)), tree)
 
+        def _master_spec(self, k, v, p_specs):
+            pname = getattr(self, "_pid_to_name", {}).get(k, "")
+            if pname in p_specs and len(tuple(p_specs[pname])) <= v.ndim:
+                return p_specs[pname]
+            return self.param_spec(pname, v)
+
         def compiled_factory(params, buffers, opt_state, master, step_i, lr,
                              key, batch):
             p_specs = {k: self.param_spec(k, v) for k, v in params.items()}
@@ -332,9 +338,7 @@ class ShardingPlan:
                 {k: NamedSharding(mesh, P()) for k in buffers},
                 {k: NamedSharding(mesh, self.opt_spec(k, v, p_specs))
                  for k, v in opt_state.items()},
-                {k: NamedSharding(
-                    mesh, self.param_spec(
-                        getattr(self, "_pid_to_name", {}).get(k, ""), v))
+                {k: NamedSharding(mesh, _master_spec(self, k, v, p_specs))
                  for k, v in master.items()},
                 NamedSharding(mesh, P()),
                 NamedSharding(mesh, P()),
@@ -342,13 +346,28 @@ class ShardingPlan:
                 jax.tree_util.tree_map(
                     lambda a: NamedSharding(mesh, self.batch_spec(a)), batch),
             )
-            out_shardings = (
-                NamedSharding(mesh, P()),
-                in_shardings[0],
-                in_shardings[1],
-                in_shardings[2],
-                in_shardings[3],
-            )
+            # optimizer state / master weights are created lazily INSIDE the
+            # first step; only then can the output tree be wider than the
+            # input tree — shape-infer it abstractly to get out_shardings.
+            # In steady state (both populated) skip the extra trace.
+            # fast path only when BOTH lazily-created dicts are populated
+            # (a restored opt_state with masters still pending would make
+            # the output tree wider than the inputs)
+            if opt_state and master:
+                out_shardings = (NamedSharding(mesh, P()),) + in_shardings[:4]
+            else:
+                out_abs = jax.eval_shape(pure, params, buffers, opt_state,
+                                         master, step_i, lr, key, batch)
+                _, p_abs, b_abs, os_abs, mw_abs = out_abs
+                out_shardings = (
+                    NamedSharding(mesh, P()),
+                    {k: NamedSharding(mesh, p_specs[k]) for k in p_abs},
+                    {k: NamedSharding(mesh, P()) for k in b_abs},
+                    {k: NamedSharding(mesh, self.opt_spec(k, v, p_specs))
+                     for k, v in os_abs.items()},
+                    {k: NamedSharding(mesh, _master_spec(self, k, v, p_specs))
+                     for k, v in mw_abs.items()},
+                )
             return jax.jit(pure, in_shardings=in_shardings,
                            out_shardings=out_shardings,
                            donate_argnums=donate)

@@ -3,7 +3,11 @@ row-blocked kernel: one VMEM pass, f32 accumulation, bf16 in/out).
 
 XLA usually fuses rms_norm chains already; this kernel exists for the long-
 row case (hidden >= 8192) where explicit blocking beats the fusion, and as
-the template for further norm kernels.
+the template for further norm kernels. Reverse-mode AD is provided by an
+analytic custom_vjp (Pallas calls carry no AD rule of their own):
+  y  = x * r * w,  r = rsqrt(mean(x^2) + eps)
+  dx = r*(g*w) - x * r^3/H * sum(g*w*x)
+  dw = sum_rows(g * x * r)
 """
 from __future__ import annotations
 
@@ -14,7 +18,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 try:
-    from jax.experimental.pallas import tpu as pltpu
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
     _HAS_TPU = True
 except Exception:  # pragma: no cover
     _HAS_TPU = False
@@ -27,9 +31,7 @@ def _rms_kernel(x_ref, w_ref, o_ref, *, eps):
                   ).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("eps",))
-def rms_norm(x, weight, eps=1e-6):
-    """x: [..., H]; weight: [H]."""
+def _fwd_impl(x, weight, eps):
     if not _HAS_TPU or jax.default_backend() != "tpu":
         x32 = x.astype(jnp.float32)
         ms = jnp.mean(x32 * x32, axis=-1, keepdims=True)
@@ -54,3 +56,30 @@ def rms_norm(x, weight, eps=1e-6):
         out_specs=pl.BlockSpec((block_rows, H), lambda i: (i, 0)),
     )(xf, weight)
     return out.reshape(orig_shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def rms_norm(x, weight, eps=1e-6):
+    """x: [..., H]; weight: [H]."""
+    return _fwd_impl(x, weight, eps)
+
+
+def _rms_fwd(x, weight, eps):
+    return _fwd_impl(x, weight, eps), (x, weight)
+
+
+def _rms_bwd(eps, res, g):
+    x, w = res
+    H = x.shape[-1]
+    x32 = x.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    w32 = w.astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    gw = g32 * w32
+    dx = r * gw - x32 * (r ** 3) * jnp.sum(gw * x32, axis=-1,
+                                           keepdims=True) / H
+    dw = jnp.sum((g32 * x32 * r).reshape(-1, H), axis=0)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+rms_norm.defvjp(_rms_fwd, _rms_bwd)

@@ -10,15 +10,18 @@ from __future__ import annotations
 import functools
 
 import jax.numpy as jnp
+import numpy as np
 
 
 @functools.lru_cache(maxsize=32)
 def _cos_sin_cache(seq_len: int, dim: int, base: float, dtype_str: str):
-    inv_freq = 1.0 / (base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
-    t = jnp.arange(seq_len, dtype=jnp.float32)
-    freqs = jnp.outer(t, inv_freq)                  # [S, dim/2]
-    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [S, dim]
-    return jnp.cos(emb), jnp.sin(emb)
+    # host-side numpy so cached values are concrete constants — caching
+    # device arrays here would leak tracers when called under jit/remat
+    inv_freq = 1.0 / (base ** (np.arange(0, dim, 2, dtype=np.float32) / dim))
+    t = np.arange(seq_len, dtype=np.float32)
+    freqs = np.outer(t, inv_freq)                  # [S, dim/2]
+    emb = np.concatenate([freqs, freqs], axis=-1)  # [S, dim]
+    return np.cos(emb), np.sin(emb)
 
 
 def _rotate_half(x):
