@@ -7,10 +7,13 @@ from typing import Optional
 
 from ..topology import (HybridCommunicateGroup, get_hybrid_communicate_group,
                         set_hybrid_communicate_group)
+from . import layers  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from . import mpu  # noqa: F401
 
 __all__ = ["init", "DistributedStrategy", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",
-           "worker_index", "worker_num"]
+           "worker_index", "worker_num", "layers", "meta_parallel", "mpu"]
 
 
 class DistributedStrategy:
@@ -20,7 +23,7 @@ class DistributedStrategy:
     def __init__(self):
         self.hybrid_configs = {
             "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
-            "sharding_degree": 1, "sep_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1, "ep_degree": 1,
         }
         self.sharding_configs = {"stage": 1}
         self.amp = False
@@ -47,7 +50,8 @@ def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
         dp_degree=hc.get("dp_degree", 1), mp_degree=hc.get("mp_degree", 1),
         pp_degree=hc.get("pp_degree", 1),
         sharding_degree=hc.get("sharding_degree", 1),
-        sep_degree=hc.get("sep_degree", 1))
+        sep_degree=hc.get("sep_degree", 1),
+        ep_degree=hc.get("ep_degree", 1))
     set_hybrid_communicate_group(hcg)
     _fleet_initialized = True
 
@@ -67,17 +71,31 @@ def worker_num():
 
 
 def distributed_model(model):
-    """ref: fleet/model.py:32 — wraps per topology. Under GSPMD the wrapper
-    only records intent; partitioning happens in the compiled TrainStep."""
+    """ref: fleet/model.py:32,141-160 — wraps per topology. PP gets the real
+    scheduled runtime; TP/sharding/DP wrappers record intent (GSPMD
+    partitions at compile inside TrainStep/ShardingPlan)."""
     from ..parallel import DataParallel
+    from .meta_parallel import (PipelineLayer, PipelineParallel,
+                                ShardingParallel, TensorParallel)
     hcg = get_hybrid_communicate_group()
     if hcg is None:
         return model
     mode = hcg.get_parallel_mode()
-    if mode == "data":
-        return DataParallel(model)
-    return model
+    if mode == "pipeline":
+        assert isinstance(model, PipelineLayer), (
+            "pipeline parallel requires a PipelineLayer model "
+            "(ref fleet/model.py:160 same constraint)")
+        return PipelineParallel(model, hcg=hcg, strategy=_strategy)
+    if mode == "tensor":
+        return TensorParallel(model, hcg=hcg, strategy=_strategy)
+    if mode == "sharding":
+        return ShardingParallel(model, hcg=hcg, strategy=_strategy)
+    return DataParallel(model)
 
 
 def distributed_optimizer(optimizer, strategy=None):
+    """ref: fleet/fleet.py distributed_optimizer → HybridParallelOptimizer
+    (dygraph_optimizer/hybrid_parallel_optimizer.py:254). TP-aware grad
+    clipping is already global under single-controller (grads are logical
+    full tensors), so the wrapper is the optimizer itself."""
     return optimizer

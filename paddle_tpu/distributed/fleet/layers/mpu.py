@@ -1,0 +1,7 @@
+"""paddle.distributed.fleet.layers.mpu — re-export (canonical impl lives in
+fleet/mpu.py; ref path: python/paddle/distributed/fleet/layers/mpu/)."""
+from ..mpu import *  # noqa: F401,F403
+from ..mpu import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RNGStatesTracker,
+    RowParallelLinear, VocabParallelEmbedding, get_rng_state_tracker,
+    model_parallel_random_seed, split)

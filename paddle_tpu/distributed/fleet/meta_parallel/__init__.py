@@ -1,0 +1,39 @@
+"""fleet.meta_parallel (ref: python/paddle/distributed/fleet/meta_parallel/).
+
+TensorParallel/ShardingParallel/SegmentParallel are annotation-recording
+wrappers under GSPMD (partitioning happens at compile); PipelineParallel is
+a real scheduled runtime (see pipeline_parallel.py).
+"""
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+
+__all__ = ["LayerDesc", "PipelineLayer", "SharedLayerDesc",
+           "PipelineParallel", "TensorParallel", "ShardingParallel",
+           "SegmentParallel"]
+
+
+class _IdentityWrapper:
+    """Base for wrappers that only record parallel intent (ref
+    meta_parallel/{tensor,segment}_parallel.py do param broadcast + RNG
+    sync — both automatic under single-controller GSPMD)."""
+
+    def __init__(self, layers, hcg=None, strategy=None, **kw):
+        self._layers = layers
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_layers"], item)
+
+    def __call__(self, *a, **kw):
+        return self._layers(*a, **kw)
+
+
+class TensorParallel(_IdentityWrapper):
+    pass
+
+
+class ShardingParallel(_IdentityWrapper):
+    pass
+
+
+class SegmentParallel(_IdentityWrapper):
+    pass

@@ -1,0 +1,215 @@
+"""PipelineLayer — layer list + stage segmentation
+(ref: python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+pp_layers.py:237 PipelineLayer, :56 LayerDesc, :76 SharedLayerDesc).
+
+TPU-native reinterpretation: the reference materializes only this rank's
+stage and wires NCCL p2p between ranks. Under single-controller JAX the
+PipelineLayer holds the WHOLE model; stage segmentation decides which
+layers run inside the shard_map pipeline loop (the homogeneous "middle"
+blocks, stacked [num_stages, layers_per_stage, ...] and sharded over the
+`pp` mesh axis) versus the prefix/suffix (embedding, final norm, head)
+that run replicated-over-pp at the pipeline's edges.
+
+Like the reference's "uniform" segmentation (pp_layers.py seg_method), the
+middle must split evenly across stages; unlike it, middle blocks must be
+structurally identical (same class/config) — true for every transformer
+the reference pipelines, and the property that lets one compiled body
+serve every stage.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ....nn.layer.layers import Layer
+from ....tensor import Tensor
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    """Deferred layer constructor (ref pp_layers.py:56)."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer appearing in several stages (ref pp_layers.py:76,
+    used for tied embeddings). Single-controller: the SAME built Layer
+    object is reused, so tying is aliasing — no broadcast/allreduce of
+    tied grads needed (the tape accumulates both uses)."""
+
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr
+                 ="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """ref pp_layers.py:237. Builds every described layer; segments into
+    num_stages stages. Callable as a plain sequential model (the 1-stage /
+    debug path the reference also supports)."""
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 loss_fn: Optional[Callable] = None, topology=None,
+                 seg_method: str = "uniform", recompute_interval: int = 0,
+                 **kwargs):
+        super().__init__()
+        self.descs = list(layers)
+        self._loss_fn = loss_fn
+        self.recompute_interval = recompute_interval
+        if num_stages is None:
+            from ...topology import get_hybrid_communicate_group
+            hcg = get_hybrid_communicate_group()
+            num_stages = (hcg.get_pipe_parallel_world_size()
+                          if hcg is not None else 1)
+        self.num_stages = num_stages
+
+        shared = {}
+        built: List[Layer] = []
+        self._shared_keys = []
+        for d in self.descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in shared:
+                    shared[d.layer_name] = d.build_layer()
+                base = shared[d.layer_name]
+                # later occurrences run forward_func(layer, x) (ref
+                # pp_layers.py SharedLayerDesc — tied-embedding head)
+                built.append(base if d.layer_name not in self._shared_keys
+                             or d.forward_func is None
+                             else _SharedFnLayer(base, d.forward_func))
+                self._shared_keys.append(d.layer_name)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            elif callable(d):
+                built.append(_FnLayer(d))
+            else:
+                raise TypeError(f"bad pipeline entry {d!r}")
+        self.run_function = built
+        for i, lyr in enumerate(built):
+            self.add_sublayer(str(i), lyr)
+        self._segment()
+
+    # -- segmentation -------------------------------------------------------
+    def _segment(self):
+        """Find the longest run of structurally-identical layers (the
+        pipelined middle); everything before/after is prefix/suffix."""
+        sig = [self._sig(l) for l in self.run_function]
+        best_start, best_len = 0, 0
+        i = 0
+        n = len(sig)
+        while i < n:
+            j = i
+            while j < n and sig[j] == sig[i]:
+                j += 1
+            if j - i > best_len:
+                best_start, best_len = i, j - i
+            i = j
+        S = self.num_stages
+        if S > 1:
+            if best_len < S or best_len % S:
+                raise ValueError(
+                    f"pipeline middle has {best_len} identical blocks, not "
+                    f"divisible into {S} stages")
+        self.prefix = self.run_function[:best_start]
+        self.blocks = self.run_function[best_start:best_start + best_len]
+        self.suffix = self.run_function[best_start + best_len:]
+
+    @staticmethod
+    def _sig(layer):
+        return (type(layer).__name__,
+                tuple(sorted((n, tuple(p.shape), str(p.dtype))
+                             for n, p in layer.named_parameters())))
+
+    @property
+    def layers_per_stage(self):
+        return len(self.blocks) // max(1, self.num_stages)
+
+    def loss_fn(self, *args, **kwargs):
+        if self._loss_fn is None:
+            raise ValueError("PipelineLayer built without loss_fn")
+        return self._loss_fn(*args, **kwargs)
+
+    # -- plain sequential execution (1-stage/debug path) --------------------
+    def forward(self, x):
+        for lyr in self.run_function:
+            x = lyr(x)
+        return x
+
+    # -- functional views used by PipelineParallel --------------------------
+    def edge_params(self):
+        ps = {}
+        for scope, layers in (("prefix", self.prefix), ("suffix", self.suffix)):
+            for i, lyr in enumerate(layers):
+                for n, p in lyr.named_parameters():
+                    ps[f"{scope}.{i}.{n}"] = p
+        return ps
+
+    def block_param_names(self):
+        if not self.blocks:
+            return []
+        return [n for n, _ in self.blocks[0].named_parameters()]
+
+    def stacked_block_params(self):
+        """{name: [L, ...] Tensor-data stack} over the middle blocks."""
+        names = self.block_param_names()
+        out = {}
+        for n in names:
+            arrs = []
+            for b in self.blocks:
+                arrs.append(dict(b.named_parameters())[n].data)
+            out[n] = jnp.stack(arrs)
+        return out
+
+    def scatter_block_grads(self, grads):
+        """Write [L, ...] grad stacks back onto per-block Parameters."""
+        for n, g in grads.items():
+            for i, b in enumerate(self.blocks):
+                p = dict(b.named_parameters())[n]
+                piece = Tensor(g[i])
+                p.grad = piece if p.grad is None else Tensor(
+                    p.grad.data + piece.data)
+
+    def set_stacked_block_params(self, values):
+        for n, v in values.items():
+            for i, b in enumerate(self.blocks):
+                dict(b.named_parameters())[n].data = v[i]
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self.fn = fn
+
+    def forward(self, x):
+        return self.fn(x)
+
+
+class _SharedFnLayer(Layer):
+    """A repeated SharedLayerDesc occurrence: same underlying layer (weight
+    tying by aliasing), alternate forward."""
+
+    def __init__(self, base, forward_func):
+        super().__init__()
+        self.base = base            # registered: named_parameters dedupes
+        self.forward_func = forward_func
+
+    def forward(self, x):
+        return self.forward_func(self.base, x)

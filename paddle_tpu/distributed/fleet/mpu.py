@@ -1,0 +1,184 @@
+"""Tensor-parallel (model-parallel) layers — the mpu layer set
+(ref: python/paddle/distributed/fleet/layers/mpu/mp_layers.py:46
+VocabParallelEmbedding, :335 ColumnParallelLinear, :542 RowParallelLinear,
+:743 ParallelCrossEntropy; comm prims mp_ops.py:83,126,285).
+
+TPU-native: the reference materializes per-rank weight shards and inserts
+explicit c_identity/c_concat/mp_allreduce collectives. Under GSPMD the
+layers hold the FULL logical weight annotated with a PartitionSpec over the
+`mp` mesh axis; XLA partitions the weight and inserts the matching ICI
+collectives (all-reduce after row-parallel, all-gather for gather_output)
+during SPMD propagation. Rank-local arithmetic, weight slicing, and the
+identity/allreduce autograd pairs all disappear.
+
+The layers stay meaningful on a 1-device mesh (specs become no-ops), so
+model code is portable across parallel configs — same property the
+reference achieves via world_size==1 fallbacks (mp_layers.py:120 etc.).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...autograd.tape import apply_op
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer.layers import Layer
+from ...ops._helpers import to_tensor_like
+from ..sharding import with_partial_annotation
+from ..topology import get_hybrid_communicate_group
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy",
+           "get_rng_state_tracker", "RNGStatesTracker", "split"]
+
+
+def _mp_degree():
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return 1
+    return hcg.get_model_parallel_world_size()
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over `mp`
+    (ref mp_layers.py:46). GSPMD turns the gather into a masked local
+    lookup + allreduce — the same algorithm the reference hand-codes."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=I.Normal(0.0, 0.02))
+        self.weight.pspec = P("mp", None)
+
+    def forward(self, x):
+        return apply_op(
+            lambda ids, w: jnp.take(w, ids.astype(jnp.int32), axis=0),
+            to_tensor_like(x), self.weight, name="vocab_parallel_embedding")
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with out_features sharded over `mp` (ref mp_layers.py:335).
+    gather_output=True re-replicates the activation (reference: c_concat)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.pspec = P(None, "mp")
+        self.bias = (self.create_parameter((out_features,), is_bias=True)
+                     if has_bias else None)
+        if self.bias is not None:
+            self.bias.pspec = P("mp")
+
+    def forward(self, x):
+        out = F.linear(to_tensor_like(x), self.weight, self.bias)
+        if self.gather_output:
+            out = with_partial_annotation(out, P(*([None] * out.ndim)))
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Linear with in_features sharded over `mp` (ref mp_layers.py:542).
+    The partial-sum allreduce the reference emits by hand is inserted by
+    GSPMD when the contraction crosses the sharded dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.pspec = P("mp", None)
+        self.bias = (self.create_parameter((out_features,), is_bias=True)
+                     if has_bias else None)
+
+    def forward(self, x):
+        return F.linear(to_tensor_like(x), self.weight, self.bias)
+
+
+class ParallelCrossEntropy(Layer):
+    """CE over mp-sharded logits (ref mp_layers.py:743). The reference
+    computes a rank-local max/logsumexp then allreduces; GSPMD derives the
+    identical schedule from the plain logsumexp formulation."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """ref: paddle.distributed.split (mp_ops.py:700) — builds the matching
+    parallel layer. Kept for API parity."""
+    if operation == "embedding":
+        lyr = VocabParallelEmbedding(size[0], size[1], weight_attr)
+    elif axis == 1:
+        lyr = ColumnParallelLinear(size[0], size[1], weight_attr,
+                                   has_bias=bias_attr is not False,
+                                   gather_output=gather_out)
+    else:
+        lyr = RowParallelLinear(size[0], size[1], weight_attr,
+                                has_bias=bias_attr is not False)
+    return lyr(x)
+
+
+class RNGStatesTracker:
+    """ref: fleet/layers/mpu/random.py get_rng_state_tracker. On TPU the
+    global PRNG key is threaded through compiled programs; mp ranks see the
+    SAME key (replicated), so dropout masks agree across TP shards without
+    per-rank seed juggling. The tracker survives as an API shim that forks
+    named keys for local-parallel regions."""
+
+    def __init__(self):
+        self.states_ = {}
+
+    def add(self, name, seed):
+        import jax
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def rng_state(self, name="model_parallel_rng"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            from ...framework import core
+            if name in self.states_:
+                with core.rng_key_context(self.states_[name]):
+                    yield
+            else:
+                yield
+        return ctx()
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import jax
+    _RNG_STATE_TRACKER.states_ = {}
+    _RNG_STATE_TRACKER.add("model_parallel_rng", seed or 0)

@@ -19,7 +19,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 # canonical axis order, outermost -> innermost
-AXES = ("pp", "dp", "sharding", "sep", "mp")
+AXES = ("pp", "dp", "sharding", "sep", "ep", "mp")
 
 
 class CommunicateTopology:
@@ -58,18 +58,20 @@ class HybridCommunicateGroup:
     group argument."""
 
     def __init__(self, dp_degree=1, mp_degree=1, pp_degree=1,
-                 sharding_degree=1, sep_degree=1, order=None,
-                 devices=None):
+                 sharding_degree=1, sep_degree=1, ep_degree=1,
+                 order=None, devices=None):
         devices = devices if devices is not None else jax.devices()
         n = len(devices)
-        given = dp_degree * mp_degree * pp_degree * sharding_degree * sep_degree
+        given = (dp_degree * mp_degree * pp_degree * sharding_degree
+                 * sep_degree * ep_degree)
         if dp_degree == -1 or given != n:
-            fixed = mp_degree * pp_degree * sharding_degree * sep_degree
+            fixed = (mp_degree * pp_degree * sharding_degree * sep_degree
+                     * ep_degree)
             assert n % fixed == 0, (
-                f"{n} devices not divisible by mp*pp*sharding*sep={fixed}")
+                f"{n} devices not divisible by mp*pp*sharding*sep*ep={fixed}")
             dp_degree = n // fixed
         self.dims = dict(pp=pp_degree, dp=dp_degree, sharding=sharding_degree,
-                         sep=sep_degree, mp=mp_degree)
+                         sep=sep_degree, ep=ep_degree, mp=mp_degree)
         shape = [self.dims[a] for a in AXES]
         dev_array = np.asarray(devices).reshape(shape)
         self.mesh = Mesh(dev_array, AXES)
@@ -121,6 +123,12 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_group(self):
         return self._axis_group("sep")
+
+    def get_expert_parallel_world_size(self):
+        return self.dims["ep"]
+
+    def get_expert_parallel_group(self):
+        return self._axis_group("ep")
 
     def get_data_parallel_rank(self):
         return 0

@@ -1,0 +1,17 @@
+"""Mixture-of-Experts with expert parallelism
+(ref: python/paddle/incubate/distributed/models/moe/moe_layer.py:263
+MoELayer; gates gate/{naive,gshard,switch}_gate.py; dispatch via
+global_scatter/global_gather all-to-all ops, moe_layer.py:119-190).
+
+TPU-native: the reference routes tokens with explicit all-to-all C++ ops
+(global_scatter/global_gather). Here dispatch/combine are GShard-style
+one-hot einsums over [tokens, experts, capacity]; with expert weights
+annotated P("ep", ...) GSPMD lowers those einsums to the SAME all-to-all
+over the `ep` mesh axis — no routing kernels to maintain. Gates implement
+top-1 (Switch) and top-2 (GShard) with capacity dropping + load-balance
+aux loss, numerically following the papers the reference's gates cite.
+"""
+from .moe_layer import MoELayer  # noqa: F401
+from .gate import GShardGate, NaiveGate, SwitchGate  # noqa: F401
+
+__all__ = ["MoELayer", "NaiveGate", "GShardGate", "SwitchGate"]

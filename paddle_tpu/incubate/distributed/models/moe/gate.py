@@ -1,0 +1,110 @@
+"""MoE gates (ref: python/paddle/incubate/distributed/models/moe/gate/
+naive_gate.py, gshard_gate.py, switch_gate.py).
+
+Each gate maps token reprs [T, d] -> (dispatch [T, E, C], combine
+[T, E, C], aux_loss scalar). All ops are one-hot/cumsum compositions that
+XLA handles without sorting networks.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....nn import initializer as I
+from .....nn.layer.layers import Layer
+
+__all__ = ["NaiveGate", "SwitchGate", "GShardGate"]
+
+
+def _capacity(T, E, k, capacity_factor):
+    return max(1, int(capacity_factor * k * T / E + 0.5))
+
+
+def _one_hot_dispatch(idx, prob, E, C, position):
+    """idx/prob/position: [T] -> dispatch/combine contributions [T, E, C]."""
+    keep = position < C
+    e_hot = jax.nn.one_hot(idx, E, dtype=jnp.float32)          # [T, E]
+    c_hot = jax.nn.one_hot(jnp.where(keep, position, C), C + 1,
+                           dtype=jnp.float32)[:, :C]           # [T, C]
+    disp = e_hot[:, :, None] * c_hot[:, None, :]               # [T, E, C]
+    comb = disp * prob[:, None, None]
+    return disp, comb
+
+
+def _position_in_expert(idx, E):
+    """Running slot index of each token within its expert's queue."""
+    e_hot = jax.nn.one_hot(idx, E, dtype=jnp.int32)            # [T, E]
+    pos = jnp.cumsum(e_hot, axis=0) - e_hot                    # slots before
+    return jnp.sum(pos * e_hot, axis=1)                        # [T]
+
+
+def _load_balance_loss(gates_softmax, idx, E):
+    """GShard aux loss: E * mean(fraction_routed_e * mean_prob_e)."""
+    me = jnp.mean(gates_softmax, axis=0)                       # [E]
+    ce = jnp.mean(jax.nn.one_hot(idx, E, dtype=jnp.float32), axis=0)
+    return jnp.sum(me * ce) * E
+
+
+class _GateBase(Layer):
+    def __init__(self, d_model, num_experts, capacity_factor=1.5):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.weight = self.create_parameter(
+            (d_model, num_experts),
+            default_initializer=I.XavierUniform())
+
+    def logits(self, x_arr, w):
+        return (x_arr.astype(jnp.float32) @ w.astype(jnp.float32))
+
+
+class SwitchGate(_GateBase):
+    """Top-1 routing (ref switch_gate.py; Switch Transformer)."""
+
+    top_k = 1
+
+    def route(self, x_arr, w):
+        T = x_arr.shape[0]
+        E = self.num_experts
+        C = _capacity(T, E, 1, self.capacity_factor)
+        g = jax.nn.softmax(self.logits(x_arr, w), axis=-1)     # [T, E]
+        idx = jnp.argmax(g, axis=-1)
+        prob = jnp.max(g, axis=-1)
+        pos = _position_in_expert(idx, E)
+        disp, comb = _one_hot_dispatch(idx, prob, E, C, pos)
+        return disp, comb, _load_balance_loss(g, idx, E)
+
+
+class GShardGate(_GateBase):
+    """Top-2 routing with second-expert sampling (ref gshard_gate.py)."""
+
+    top_k = 2
+
+    def route(self, x_arr, w):
+        T = x_arr.shape[0]
+        E = self.num_experts
+        C = _capacity(T, E, 2, self.capacity_factor)
+        g = jax.nn.softmax(self.logits(x_arr, w), axis=-1)
+        idx1 = jnp.argmax(g, axis=-1)
+        p1 = jnp.max(g, axis=-1)
+        g2 = g * (1.0 - jax.nn.one_hot(idx1, E, dtype=jnp.float32))
+        idx2 = jnp.argmax(g2, axis=-1)
+        p2 = jnp.max(g2, axis=-1)
+        denom = jnp.maximum(p1 + p2, 1e-9)
+        p1n, p2n = p1 / denom, p2 / denom
+
+        pos1 = _position_in_expert(idx1, E)
+        d1, c1 = _one_hot_dispatch(idx1, p1n, E, C, pos1)
+        # expert-1 tokens occupy slots first; expert-2 tokens queue after
+        used = jnp.sum(d1, axis=(0, 2))                        # [E] slots used
+        e2_hot = jax.nn.one_hot(idx2, E, dtype=jnp.int32)
+        pos2 = (jnp.cumsum(e2_hot, axis=0) - e2_hot)
+        pos2 = jnp.sum(pos2 * e2_hot, axis=1) + used[idx2].astype(jnp.int32)
+        d2, c2 = _one_hot_dispatch(idx2, p2n, E, C, pos2)
+        return d1 + d2, c1 + c2, _load_balance_loss(g, idx1, E)
+
+
+class NaiveGate(SwitchGate):
+    """ref naive_gate.py — top-k gate without extras; top-1 variant here."""
+    pass

@@ -1,0 +1,170 @@
+"""Pipeline parallel: PipelineLayer segmentation + compiled ppermute
+schedule numerics vs plain sequential training (ref test pattern:
+test/collective/fleet/hybrid_parallel_pp_* compare pp loss vs single)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed.fleet as fleet
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    LayerDesc, PipelineLayer, PipelineParallel)
+
+
+class Block(nn.Layer):
+    def __init__(self, h=16):
+        super().__init__()
+        self.fc = nn.Linear(h, h)
+
+    def forward(self, x):
+        return x + F.tanh(self.fc(x))
+
+
+class Head(nn.Layer):
+    def __init__(self, h=16, out=4):
+        super().__init__()
+        self.fc = nn.Linear(h, out)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+class Stem(nn.Layer):
+    def __init__(self, d=8, h=16):
+        super().__init__()
+        self.fc = nn.Linear(d, h)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+def _mse(pred, y):
+    return F.mse_loss(pred, y)
+
+
+def _make_pipe(num_stages):
+    paddle.seed(5)
+    return PipelineLayer(
+        layers=[LayerDesc(Stem), *[LayerDesc(Block) for _ in range(4)],
+                LayerDesc(Head)],
+        num_stages=num_stages, loss_fn=_mse)
+
+
+def test_segmentation():
+    pipe = _make_pipe(num_stages=2)
+    assert len(pipe.prefix) == 1
+    assert len(pipe.blocks) == 4
+    assert len(pipe.suffix) == 1
+    assert pipe.layers_per_stage == 2
+
+
+def test_pipeline_matches_sequential_training():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 2, "sharding_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    np.random.seed(0)
+    x = np.random.randn(8, 8).astype(np.float32)
+    y = np.random.randn(8, 4).astype(np.float32)
+
+    # sequential reference: same microbatch-mean loss
+    ref_pipe = _make_pipe(num_stages=1)
+    o1 = opt.AdamW(learning_rate=0.01, parameters=ref_pipe.parameters())
+    ref_losses = []
+    for _ in range(3):
+        mb_losses = []
+        for i in range(4):  # same 4-microbatch accumulation
+            xi = paddle.to_tensor(x[i * 2:(i + 1) * 2])
+            yi = paddle.to_tensor(y[i * 2:(i + 1) * 2])
+            mb_losses.append(_mse(ref_pipe(xi), yi))
+        loss = mb_losses[0]
+        for l in mb_losses[1:]:
+            loss = loss + l
+        loss = loss / 4
+        loss.backward()
+        o1.step()
+        o1.clear_grad()
+        ref_losses.append(loss.item())
+
+    # 2-stage pipelined
+    pipe = _make_pipe(num_stages=2)
+    pp = PipelineParallel(pipe, strategy=strategy)
+    o2 = opt.AdamW(learning_rate=0.01, parameters=pp.parameters())
+    got = [pp.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)),
+                          o2).item() for _ in range(3)]
+    np.testing.assert_allclose(got, ref_losses, rtol=2e-4, atol=1e-6)
+
+
+def test_pipeline_tied_embedding_grads():
+    """SharedLayerDesc ties embedding+head: tied weight must accumulate BOTH
+    partial grads (embedding lookup + output projection)."""
+    from paddle_tpu.distributed.fleet.meta_parallel import SharedLayerDesc
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "pp_degree": 2,
+                               "mp_degree": 1, "sharding_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    V, H = 16, 8
+
+    class Emb(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter((V, H))
+
+        def forward(self, ids):
+            import jax.numpy as jnp
+            from paddle_tpu.autograd.tape import apply_op
+            return apply_op(
+                lambda i, w: jnp.take(w, i.astype(jnp.int32), axis=0),
+                ids, self.weight, name="emb")
+
+    def head_fwd(layer, h):
+        import jax.numpy as jnp
+        from paddle_tpu.autograd.tape import apply_op
+        return apply_op(lambda a, w: a @ jnp.swapaxes(w, 0, 1), h,
+                        layer.weight, name="tied_head")
+
+    def ce(logits, labels):
+        return F.cross_entropy(
+            logits.reshape([-1, V]), labels.reshape([-1]))
+
+    paddle.seed(0)
+    pipe = PipelineLayer(
+        layers=[SharedLayerDesc("emb", Emb),
+                *[LayerDesc(Block, 8) for _ in range(2)],
+                SharedLayerDesc("emb", Emb, forward_func=head_fwd)],
+        num_stages=2, loss_fn=ce)
+    pp = PipelineParallel(pipe, strategy=strategy)
+    # tied weight listed once for the optimizer
+    emb_params = [p for p in pp.parameters() if tuple(p.shape) == (V, H)]
+    assert len(emb_params) == 1
+    o = opt.SGD(learning_rate=0.1, parameters=pp.parameters())
+    ids = paddle.to_tensor(np.random.randint(0, V, (4, 6)))
+    losses = [pp.train_batch((ids, ids), o).item() for _ in range(10)]
+    assert losses[-1] < losses[0], losses
+    # both tied uses contributed a gradient (cleared after step, so check
+    # via a fresh grad computation path: loss keeps decreasing is the
+    # behavioral evidence; structural: edge map has two keys -> one param)
+    tied_keys = [k for k, p in pp._edge.items() if p is emb_params[0]]
+    assert len(tied_keys) == 2, tied_keys
+
+
+def test_pipeline_four_stages():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 4, "sharding_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 8}
+    fleet.init(is_collective=True, strategy=strategy)
+    pipe = _make_pipe(num_stages=4)
+    pp = PipelineParallel(pipe, strategy=strategy)
+    o = opt.SGD(learning_rate=0.05, parameters=pp.parameters())
+    x = paddle.to_tensor(np.random.randn(16, 8).astype(np.float32))
+    y = paddle.to_tensor(np.random.randn(16, 4).astype(np.float32))
+    losses = [pp.train_batch((x, y), o).item() for _ in range(8)]
+    assert losses[-1] < losses[0], losses
