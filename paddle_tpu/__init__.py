@@ -78,6 +78,10 @@ from . import distributed  # noqa: F401,E402
 from . import vision      # noqa: F401,E402
 from . import metric      # noqa: F401,E402
 from . import device      # noqa: F401,E402
+from . import hapi        # noqa: F401,E402
+from . import profiler    # noqa: F401,E402
+from . import incubate    # noqa: F401,E402
+from .hapi import Model   # noqa: F401,E402
 from .framework.io import load, save  # noqa: F401,E402
 from .nn.layer.layers import Layer  # noqa: F401,E402
 

@@ -1,0 +1,87 @@
+"""`python -m paddle_tpu.distributed.launch` — the launch CLI
+(ref: python/paddle/distributed/launch/main.py:20; CollectiveController
+spawning per-GPU workers launch/controllers/collective.py:22).
+
+TPU-native: JAX is single-controller per HOST (one process drives all
+local chips), so "nproc_per_node" collapses to one worker per node; the
+controller's job is to export the jax.distributed bootstrap env
+(coordinator address, process id/count — replacing PADDLE_TRAINER_ID/
+ENDPOINTS + TCPStore rendezvous) and exec the training script, restarting
+it on failure up to --max_restart times (the reference's watcher/elastic
+relaunch, SURVEY §5)."""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main"]
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch a distributed training script on TPU hosts")
+    p.add_argument("--master", default=None,
+                   help="coordinator address host:port (ref --master)")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_NNODES", "1")))
+    p.add_argument("--rank", type=int,
+                   default=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+                   help="this node's process index")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="kept for CLI parity; JAX drives all local chips "
+                        "from one process")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--devices", default=None,
+                   help="visible TPU chips, e.g. '0,1,2,3'")
+    p.add_argument("--elastic_level", type=int, default=0)
+    p.add_argument("script", help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _bootstrap_env(args):
+    env = dict(os.environ)
+    if args.master:
+        env["JAX_COORDINATOR_ADDRESS"] = args.master
+        env["JAX_NUM_PROCESSES"] = str(args.nnodes)
+        env["JAX_PROCESS_ID"] = str(args.rank)
+    if args.devices is not None:
+        env["TPU_VISIBLE_DEVICES"] = args.devices
+    # paddle-compat env names, read by ParallelEnv (env.py)
+    env["PADDLE_TRAINER_ID"] = str(args.rank)
+    env["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
+    return env
+
+
+def launch(argv=None):
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    env = _bootstrap_env(args)
+    cmd = [sys.executable, args.script] + args.script_args
+    restarts = 0
+    while True:
+        t0 = time.time()
+        proc = subprocess.Popen(cmd, env=env)
+        rc = proc.wait()
+        if rc == 0:
+            return 0
+        restarts += 1
+        if restarts > args.max_restart:
+            print(f"launch: worker failed rc={rc}, restarts exhausted",
+                  file=sys.stderr)
+            return rc
+        print(f"launch: worker failed rc={rc} after {time.time()-t0:.0f}s, "
+              f"restart {restarts}/{args.max_restart}", file=sys.stderr)
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

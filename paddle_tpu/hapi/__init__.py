@@ -1,0 +1,7 @@
+from .model import Model  # noqa: F401
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger)
+
+__all__ = ["Model", "callbacks", "Callback", "EarlyStopping", "LRScheduler",
+           "ModelCheckpoint", "ProgBarLogger"]

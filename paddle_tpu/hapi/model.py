@@ -1,0 +1,212 @@
+"""hapi Model — high-level fit/evaluate/predict
+(ref: python/paddle/hapi/model.py:1054 Model, fit :1756, evaluate, predict,
+save/load; trains through the dygraph path with optional AMP).
+
+TPU-native: fit() trains through a compiled TrainStep (one XLA program per
+step — the reference's dygraph loop pays per-op dispatch instead);
+evaluate/predict run the compiled forward. Callbacks/metrics keep the
+reference's interface."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..framework import core
+from ..tensor import Tensor
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _as_tuple(x):
+    if x is None:
+        return ()
+    if isinstance(x, (list, tuple)):
+        return tuple(x)
+    return (x,)
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List = []
+        self.stop_training = False
+        self._train_step = None
+
+    # -- configuration (ref model.py prepare) -------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = list(_as_tuple(metrics))
+        self._train_step = None
+        return self
+
+    # -- step functions -----------------------------------------------------
+    def _build_train_step(self, has_labels: bool):
+        from .. import jit as pjit
+
+        net, loss_fn = self.network, self._loss
+
+        if has_labels:
+            def step_fn(*batch):
+                *xs, y = batch
+                return loss_fn(net(*xs), y)
+        else:   # unsupervised: loss_fn takes the network output alone
+            def step_fn(*xs):
+                return loss_fn(net(*xs))
+
+        self._train_step = pjit.TrainStep(net, self._optimizer, step_fn)
+        self._train_step_has_labels = has_labels
+
+    def train_batch(self, inputs, labels=None):
+        has_labels = labels is not None
+        if self._train_step is None or \
+                getattr(self, "_train_step_has_labels", None) != has_labels:
+            self._build_train_step(has_labels)
+        args = tuple(_as_tuple(inputs)) + tuple(_as_tuple(labels))
+        loss = self._train_step(*args)
+        return [float(loss.numpy())]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        with core.no_grad_guard():
+            out = self.network(*_as_tuple(inputs))
+            loss = self._loss(out, *_as_tuple(labels)) if self._loss else None
+            for m in self._metrics:
+                m.update(*[t.numpy() if isinstance(t, Tensor) else t
+                           for t in m.compute(out, *_as_tuple(labels))])
+        self.network.train()
+        return [float(loss.numpy())] if loss is not None else []
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        with core.no_grad_guard():
+            out = self.network(*_as_tuple(inputs))
+        self.network.train()
+        return [o.numpy() if isinstance(o, Tensor) else o
+                for o in _as_tuple(out)]
+
+    # -- loops (ref model.py:1756 fit) --------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = self._as_loader(train_data, batch_size, shuffle, drop_last,
+                                 num_workers)
+        cbs = config_callbacks(callbacks, model=self, epochs=epochs,
+                               steps=len(loader) if hasattr(
+                                   loader, "__len__") else None,
+                               log_freq=log_freq, verbose=verbose,
+                               save_freq=save_freq, save_dir=save_dir,
+                               metrics=self._metrics)
+        self.stop_training = False
+        for cb in cbs:
+            cb.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            for cb in cbs:
+                cb.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(loader):
+                for cb in cbs:
+                    cb.on_train_batch_begin(step)
+                xs, ys = self._split_batch(batch)
+                losses = self.train_batch(xs, ys)
+                logs = {"loss": losses[0] if losses else 0.0}
+                for cb in cbs:
+                    cb.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                          verbose=0, num_workers=num_workers)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+                for cb in cbs:
+                    cb.on_eval_end(eval_logs)
+            for cb in cbs:
+                cb.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        for cb in cbs:
+            cb.on_train_end()
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = self._as_loader(eval_data, batch_size, False, False,
+                                 num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            xs, ys = self._split_batch(batch)
+            losses.extend(self.eval_batch(xs, ys))
+        logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            logs[m.name() if callable(getattr(m, "name", None))
+                 else str(m)] = m.accumulate()
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        outs = []
+        for batch in loader:
+            xs = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(self.predict_batch(_as_tuple(xs)))
+        if stack_outputs and outs:
+            n = len(outs[0])
+            return [np.concatenate([o[i] for o in outs]) for i in range(n)]
+        return outs
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework import io as fio
+        fio.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fio.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework import io as fio
+        self.network.set_state_dict(fio.load(path + ".pdparams"))
+        import os
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fio.load(path + ".pdopt"))
+        return self
+
+    def parameters(self, *a, **k):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(int(np.prod(p.shape))
+                       for p in self.network.parameters())
+        print(f"Model: {type(self.network).__name__}, "
+              f"params: {n_params:,}")
+        return {"total_params": n_params}
+
+    # -- helpers ------------------------------------------------------------
+    @staticmethod
+    def _as_loader(data, batch_size, shuffle, drop_last, num_workers):
+        from ..io import DataLoader, Dataset
+        if data is None:
+            return []
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset) or hasattr(data, "__getitem__"):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              drop_last=drop_last, num_workers=num_workers)
+        return data  # already an iterable of batches
+
+    @staticmethod
+    def _split_batch(batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return batch[:-1], batch[-1]
+        return (batch,), None

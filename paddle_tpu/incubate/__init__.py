@@ -1,0 +1,5 @@
+"""paddle.incubate (ref: python/paddle/incubate/ — fused transformer ops,
+MoE, ASP). MoE lives in incubate.distributed.models.moe; fused functional
+ops in incubate.nn.functional."""
+from . import distributed  # noqa: F401
+from . import nn  # noqa: F401

@@ -1,0 +1,118 @@
+"""incubate.nn.functional — fused-op API surface
+(ref: python/paddle/incubate/nn/functional/: fused_rotary_position_
+embedding, fused_rms_norm, fused_layer_norm, fused_bias_act...). On TPU
+these route to the Pallas kernels / XLA-fused compositions."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....autograd.tape import apply_op
+from ....ops._helpers import to_tensor_like
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kw):
+    from ....kernels.rms_norm import rms_norm
+    xt = to_tensor_like(x)
+    wt = to_tensor_like(norm_weight)
+    out = apply_op(lambda a, w: rms_norm(a, w, epsilon), xt, wt,
+                   name="fused_rms_norm")
+    if norm_bias is not None:
+        out = out + to_tensor_like(norm_bias)
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, **kw):
+    from ....nn import functional as F
+    xt = to_tensor_like(x)
+    return F.layer_norm(xt, xt.shape[-1:], weight=norm_weight,
+                        bias=norm_bias, epsilon=epsilon)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    """ref incubate/nn/functional/fused_rotary_position_embedding.py —
+    honors explicit sin/cos caches and position_ids; v passes through
+    unrotated (paddle semantics: rope applies to q/k only)."""
+    from ....kernels.rope import apply_rope
+    qt = to_tensor_like(q)
+    kt = to_tensor_like(k) if k is not None else None
+    pid = to_tensor_like(position_ids) if position_ids is not None else None
+
+    if sin is not None and cos is not None:
+        st, ct = to_tensor_like(sin), to_tensor_like(cos)
+
+        def rot(a, s, c, *p):
+            # caches come as [S, D] (or already broadcastable 4-D);
+            # a is [B, S, H, D]
+            s32, c32 = s.astype(jnp.float32), c.astype(jnp.float32)
+            if p:
+                tbl_s = s32.reshape(-1, s32.shape[-1])
+                tbl_c = c32.reshape(-1, c32.shape[-1])
+                s32 = jnp.take(tbl_s, p[0].astype(jnp.int32),
+                               axis=0)[:, :, None, :]     # [B, S, 1, D]
+                c32 = jnp.take(tbl_c, p[0].astype(jnp.int32),
+                               axis=0)[:, :, None, :]
+            elif s32.ndim == 2:
+                s32 = s32[None, :, None, :]               # [1, S, 1, D]
+                c32 = c32[None, :, None, :]
+            a32 = a.astype(jnp.float32)
+            h = a32.shape[-1] // 2
+            rot_half = jnp.concatenate([-a32[..., h:], a32[..., :h]], axis=-1)
+            return (a32 * c32 + rot_half * s32).astype(a.dtype)
+
+        pargs = (pid,) if pid is not None else ()
+        q_out = apply_op(rot, qt, st, ct, *pargs, name="fused_rope_q")
+        k_out = (apply_op(rot, kt, st, ct, *pargs, name="fused_rope_k")
+                 if kt is not None else None)
+        return (q_out, k_out, to_tensor_like(v) if v is not None else None)
+
+    if kt is not None:
+        if pid is not None:
+            outs = apply_op(lambda a, b, p: apply_rope(a, b, position_ids=p),
+                            qt, kt, pid, n_outputs=2, name="fused_rope")
+        else:
+            outs = apply_op(lambda a, b: apply_rope(a, b), qt, kt,
+                            n_outputs=2, name="fused_rope")
+        return (outs[0], outs[1],
+                to_tensor_like(v) if v is not None else None)
+    if pid is not None:
+        q_out = apply_op(lambda a, p: apply_rope(a, a, position_ids=p)[0],
+                         qt, pid, name="fused_rope_q")
+    else:
+        q_out = apply_op(lambda a: apply_rope(a, a)[0], qt,
+                         name="fused_rope_q")
+    return (q_out, None, to_tensor_like(v) if v is not None else None)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+           "silu": jax.nn.silu, "swiglu": None}[act_method]
+    xt = to_tensor_like(x)
+    if act_method == "swiglu":
+        def swiglu(a, *b):
+            if b:
+                a = a + b[0]
+            u, g = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(u) * g
+        args = (xt,) + ((to_tensor_like(bias),) if bias is not None else ())
+        return apply_op(swiglu, *args, name="fused_swiglu")
+    def f(a, *b):
+        if b:
+            a = a + b[0]
+        return act(a)
+    args = (xt,) + ((to_tensor_like(bias),) if bias is not None else ())
+    return apply_op(f, *args, name="fused_bias_act")
+
+
+def swiglu(x, y=None):
+    xt = to_tensor_like(x)
+    if y is not None:
+        return apply_op(lambda a, b: jax.nn.silu(a) * b, xt,
+                        to_tensor_like(y), name="swiglu")
+    def f(a):
+        u, g = jnp.split(a, 2, axis=-1)
+        return jax.nn.silu(u) * g
+    return apply_op(f, xt, name="swiglu")

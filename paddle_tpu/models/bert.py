@@ -1,0 +1,254 @@
+"""BERT family — BASELINE.md config 2 (masked-LM fine-tune).
+
+TPU-native design (not a port of any modeling file):
+  * post-LN transformer encoder per the original architecture, built on
+    paddle_tpu.nn layers; attention uses the flash kernel when shapes
+    allow, else the fused sdpa path
+  * parameters carry TP PartitionSpecs over `mp` (qkv/ffn column, out/proj
+    row) so the same model runs tensor-parallel under a ShardingPlan
+  * bf16-first: master weights handled by the optimizer, norms in f32
+Reference anchors (parity targets only): the reference trains BERT through
+fused_attention / fused_feedforward (paddle/fluid/operators/fused/
+fused_attention_op.cu, fused_feedforward_op.cu) — here XLA fuses the same
+pattern from the plain composition.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..autograd.tape import apply_op
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.common import Dropout, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+from ..ops._helpers import to_tensor_like
+from ..tensor import Tensor
+
+__all__ = ["BertConfig", "BertModel", "BertForMaskedLM",
+           "BertForSequenceClassification", "bert_base", "bert_large",
+           "bert_tiny"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    pad_token_id: int = 0
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def _tp(p, spec):
+    p.pspec = spec
+    return p
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        std = cfg.initializer_range
+        self.word_embeddings = _tp(self.create_parameter(
+            (cfg.vocab_size, cfg.hidden_size),
+            default_initializer=I.Normal(0.0, std)), P("mp", None))
+        self.position_embeddings = self.create_parameter(
+            (cfg.max_position_embeddings, cfg.hidden_size),
+            default_initializer=I.Normal(0.0, std))
+        self.token_type_embeddings = self.create_parameter(
+            (cfg.type_vocab_size, cfg.hidden_size),
+            default_initializer=I.Normal(0.0, std))
+        self.layer_norm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        ids = to_tensor_like(input_ids)
+        S = ids.shape[-1]
+
+        def embed(i, w, pw, tw, tt):
+            x = jnp.take(w, i.astype(jnp.int32), axis=0)
+            pos = jnp.arange(S)
+            x = x + pw[pos][None]
+            x = x + jnp.take(tw, tt.astype(jnp.int32), axis=0)
+            return x
+
+        tt = (to_tensor_like(token_type_ids) if token_type_ids is not None
+              else Tensor(jnp.zeros(ids.shape, jnp.int32)))
+        out = apply_op(embed, ids, self.word_embeddings,
+                       self.position_embeddings, self.token_type_embeddings,
+                       tt, name="bert_embed")
+        return self.dropout(self.layer_norm(out))
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.cfg = cfg
+        self.qkv = Linear(h, 3 * h)
+        _tp(self.qkv.weight, P(None, "mp"))
+        self.out = Linear(h, h)
+        _tp(self.out.weight, P("mp", None))
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x, attn_mask=None):
+        from ..framework import core
+        cfg = self.cfg
+        nh, d = cfg.num_attention_heads, cfg.head_dim
+        qkv = self.qkv(x)
+        B, S = qkv.shape[0], qkv.shape[1]
+        attn_p = cfg.attention_probs_dropout_prob
+        # attention-probs dropout (distinct from the output-proj dropout);
+        # draws its key here, closed over by the pure op body
+        drop_key = (core.next_rng_key()
+                    if self.training and attn_p > 0.0 else None)
+
+        def attn(a, mask=None):
+            q, k, v = jnp.split(a, 3, axis=-1)
+            q = q.reshape(B, S, nh, d)
+            k = k.reshape(B, S, nh, d)
+            v = v.reshape(B, S, nh, d)
+            from ..kernels import flash_attention as fa
+            if mask is None and drop_key is None and \
+                    fa.supported(q.shape, k.shape, True):
+                o = fa.flash_attention_bshd(q, k, v, causal=False)
+            else:
+                qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+                kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+                vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+                s = qt @ jnp.swapaxes(kt, -1, -2) / math.sqrt(d)
+                if mask is not None:
+                    s = s + mask
+                p = jax.nn.softmax(s, axis=-1)
+                if drop_key is not None:
+                    keep = jax.random.bernoulli(drop_key, 1.0 - attn_p,
+                                                p.shape)
+                    p = jnp.where(keep, p / (1.0 - attn_p), 0.0)
+                o = jnp.swapaxes(p @ vt, 1, 2).astype(a.dtype)
+            return o.reshape(B, S, nh * d)
+
+        if attn_mask is not None:
+            ctx = apply_op(attn, qkv, to_tensor_like(attn_mask),
+                           name="bert_attn")
+        else:
+            ctx = apply_op(attn, qkv, name="bert_attn")
+        return self.dropout(self.out(ctx))
+
+
+class BertLayer(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(cfg)
+        self.attn_norm = LayerNorm(cfg.hidden_size,
+                                   epsilon=cfg.layer_norm_eps)
+        self.ffn_in = Linear(cfg.hidden_size, cfg.intermediate_size)
+        _tp(self.ffn_in.weight, P(None, "mp"))
+        self.ffn_out = Linear(cfg.intermediate_size, cfg.hidden_size)
+        _tp(self.ffn_out.weight, P("mp", None))
+        self.ffn_norm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x, attn_mask=None):
+        x = self.attn_norm(x + self.attention(x, attn_mask))
+        h = self.ffn_out(F.gelu(self.ffn_in(x)))
+        return self.ffn_norm(x + self.dropout(h))
+
+
+class BertModel(Layer):
+    """ref parity: paddlenlp-style BertModel surface (the reference repo's
+    nn stack trains it through fused attention ops)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.layers = LayerList([BertLayer(cfg)
+                                 for _ in range(cfg.num_hidden_layers)])
+        self.pooler = Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        mask = None
+        if attention_mask is not None:
+            am = to_tensor_like(attention_mask)
+            mask = apply_op(
+                lambda m: (1.0 - m[:, None, None, :].astype(jnp.float32))
+                * jnp.finfo(jnp.float32).min, am, name="bert_mask")
+        for lyr in self.layers:
+            x = lyr(x, mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForMaskedLM(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.bert = BertModel(cfg)
+        self.transform = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.transform_norm = LayerNorm(cfg.hidden_size,
+                                        epsilon=cfg.layer_norm_eps)
+        self.decoder_bias = self.create_parameter((cfg.vocab_size,),
+                                                  is_bias=True)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.transform_norm(F.gelu(self.transform(seq)))
+        # decoder tied to word embeddings (standard BERT head)
+        return apply_op(
+            lambda a, w, b: a @ jnp.swapaxes(w, 0, 1) + b, h,
+            self.bert.embeddings.word_embeddings, self.decoder_bias,
+            name="mlm_head")
+
+    def loss(self, input_ids, labels, token_type_ids=None,
+             attention_mask=None, ignore_index=-100):
+        logits = self(input_ids, token_type_ids, attention_mask)
+        V = logits.shape[-1]
+        from ..ops import manipulation as M
+        return F.cross_entropy(M.reshape(logits, [-1, V]),
+                               M.reshape(to_tensor_like(labels), [-1]),
+                               ignore_index=ignore_index)
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, cfg: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        self.classifier = Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+def bert_tiny(**kw):
+    return BertConfig(vocab_size=1024, hidden_size=128, num_hidden_layers=2,
+                      num_attention_heads=2, intermediate_size=512,
+                      max_position_embeddings=128, **kw)
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def bert_large(**kw):
+    return BertConfig(hidden_size=1024, num_hidden_layers=24,
+                      num_attention_heads=16, intermediate_size=4096, **kw)

@@ -1,0 +1,226 @@
+"""paddle.profiler — window-scheduled profiling over jax.profiler
+(ref: python/paddle/profiler/profiler.py:346 Profiler, :79 ProfilerState,
+:215 export_chrome_tracing; RecordEvent user spans; host/device tracers
+fluid/platform/profiler/* merged to chrome-tracing JSON).
+
+TPU-native: the device tracer is XLA/XProf via jax.profiler (TensorBoard
+trace viewer instead of chrome://tracing, same JSON idea); host spans are
+jax.profiler.TraceAnnotation. The scheduler-window semantics (CLOSED/
+READY/RECORD/RECORD_AND_RETURN) and the user API are kept."""
+from __future__ import annotations
+
+import enum
+import os
+import time
+from typing import Callable, Iterable, Optional
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+           "SummaryView"]
+
+
+class ProfilerState(enum.IntEnum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.IntEnum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class SummaryView(enum.IntEnum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """ref profiler.py make_scheduler — step -> state window function."""
+    period = closed + ready + record
+
+    def sched(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return sched
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """ref profiler.py:215 — on_trace_ready callback; Profiler reads the
+    `_trace_dir` attribute at construction so the XLA trace is written
+    directly into `dir_name`."""
+    def handler(prof):
+        prof._exported_dir = dir_name
+    handler._trace_dir = dir_name
+    handler._worker_name = worker_name
+    return handler
+
+
+class _ProfilerResult:
+    def __init__(self, trace_dir):
+        self.trace_dir = trace_dir
+
+    def save(self, path, format="json"):
+        pass
+
+
+def load_profiler_result(path):
+    return _ProfilerResult(path)
+
+
+class Profiler:
+    """ref profiler.py:346. Usage identical to the reference:
+
+        p = Profiler(scheduler=(2, 5), on_trace_ready=..., targets=[...])
+        p.start(); loop: ...; p.step(); p.stop(); p.summary()
+    """
+
+    def __init__(self, *, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready: Optional[Callable] = None,
+                 record_shapes: bool = False, profile_memory: bool = False,
+                 timer_only: bool = False, emit_nvtx: bool = False,
+                 custom_device_types=None, with_flops: bool = False):
+        if scheduler is None:
+            self._sched = lambda step: ProfilerState.RECORD
+        elif callable(scheduler):
+            self._sched = scheduler
+        else:   # (start, end) tuple per reference
+            start, end = scheduler
+            self._sched = make_scheduler(closed=max(start, 0), ready=0,
+                                         record=end - start, repeat=1)
+        self._on_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._dir = getattr(on_trace_ready, "_trace_dir", None) or \
+            os.environ.get("PADDLE_TPU_PROFDIR", "/tmp/paddle_tpu_prof")
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._tracing = False
+        self._step_times = []
+        self._t0 = None
+        self._exported_dir = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        self._state = self._sched(self._step)
+        self._maybe_toggle()
+        self._t0 = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self._tracing:
+            self._stop_trace()
+        if self._on_ready is not None:
+            self._on_ready(self)
+        self._state = ProfilerState.CLOSED
+
+    def step(self, num_samples: Optional[int] = None):
+        if self._t0 is not None:
+            self._step_times.append(time.perf_counter() - self._t0)
+        self._step += 1
+        new_state = self._sched(self._step)
+        if new_state != self._state:
+            self._state = new_state
+            self._maybe_toggle()
+        if self._state == ProfilerState.RECORD_AND_RETURN and \
+                self._on_ready is not None:
+            self._on_ready(self)
+        self._t0 = time.perf_counter()
+
+    def _maybe_toggle(self):
+        want = self._state in (ProfilerState.RECORD,
+                               ProfilerState.RECORD_AND_RETURN)
+        if want and not self._tracing and not self._timer_only:
+            self._start_trace()
+        elif not want and self._tracing:
+            self._stop_trace()
+
+    def _start_trace(self):
+        import jax
+        os.makedirs(self._dir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(self._dir)
+            self._tracing = True
+        except Exception:
+            self._tracing = False
+
+    def _stop_trace(self):
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        self._tracing = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- reporting ----------------------------------------------------------
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return ""
+        avg = sum(self._step_times) / len(self._step_times)
+        return (f"avg step {avg*1000:.2f} ms, ips "
+                f"{1.0/avg if avg else 0:.2f} steps/s")
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms", views=None):
+        n = len(self._step_times)
+        if not n:
+            print("profiler: no steps recorded")
+            return
+        tot = sum(self._step_times)
+        print(f"-------------------  Profiler Summary  -------------------")
+        print(f"steps: {n}   total: {tot*1000:.2f} ms   "
+              f"avg: {tot/n*1000:.2f} ms")
+        if self._exported_dir or self._tracing:
+            print(f"XLA trace: {self._dir} (open with TensorBoard XProf)")
+
+
+class RecordEvent:
+    """ref profiler user span — maps to jax.profiler.TraceAnnotation."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ann = None
+
+    def begin(self):
+        import jax
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()

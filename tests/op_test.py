@@ -1,0 +1,119 @@
+"""OpTest harness (ref: test/legacy_test/op_test.py:420 OpTest —
+check_output vs numpy golden across dtypes with per-dtype tolerances
+:2017, check_grad vs finite differences :150,2973; white-list tolerance
+gating test/white_list/op_accuracy_white_list.py).
+
+TPU adaptation: places collapse to the CPU mesh (the driver benches TPU);
+the dtype axis keeps fp32/bf16 like the reference's fp32/fp16/bf16 rows,
+and the dygraph-vs-static consistency check becomes eager-vs-jit."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import Tensor
+
+TOL = {
+    "float32": dict(rtol=1e-5, atol=1e-6),
+    "bfloat16": dict(rtol=2e-2, atol=2e-2),
+    "float64": dict(rtol=1e-12, atol=1e-12),
+    "int32": dict(rtol=0, atol=0),
+    "int64": dict(rtol=0, atol=0),
+    "bool": dict(rtol=0, atol=0),
+}
+
+
+def _to_np(t):
+    a = np.asarray(t.data if isinstance(t, Tensor) else t)
+    if a.dtype == jnp.bfloat16:
+        a = a.astype(np.float32)
+    return a
+
+
+def check_output(op_fn, ref_fn, inputs, dtypes=("float32",), kwargs=None,
+                 jit_check=True):
+    """op_fn(*paddle Tensors) vs ref_fn(*numpy arrays); both may return
+    tuples. Also asserts eager == jit (the dygraph-vs-static axis)."""
+    kwargs = kwargs or {}
+    for dt in dtypes:
+        cast = [np.asarray(a).astype(dt) if np.asarray(a).dtype.kind == "f"
+                else np.asarray(a) for a in inputs]
+        tens = [paddle.to_tensor(
+            jnp.asarray(a, dtype=jnp.bfloat16) if dt == "bfloat16"
+            and a.dtype.kind == "f" else a) for a in [
+                np.asarray(c, dtype=np.float32) if dt == "bfloat16"
+                and np.asarray(c).dtype.kind == "f" else c for c in cast]]
+        got = op_fn(*tens, **kwargs)
+        ref = ref_fn(*[_to_np(t) for t in tens], **kwargs)
+        gots = got if isinstance(got, (tuple, list)) else (got,)
+        refs = ref if isinstance(ref, (tuple, list)) else (ref,)
+        tol = TOL[dt]
+        for g, r in zip(gots, refs):
+            np.testing.assert_allclose(_to_np(g), np.asarray(r), **tol,
+                                       err_msg=f"dtype={dt}")
+        if jit_check:
+            jitted = jax.jit(lambda *arrs: _unbox(
+                op_fn(*[Tensor(a) for a in arrs], **kwargs)))
+            jg = jitted(*[t.data for t in tens])
+            jgs = jg if isinstance(jg, (tuple, list)) else (jg,)
+            for g, j in zip(gots, jgs):
+                np.testing.assert_allclose(_to_np(g), _to_np(j), rtol=1e-6,
+                                           atol=1e-6,
+                                           err_msg=f"eager!=jit dtype={dt}")
+
+
+def _unbox(x):
+    if isinstance(x, (tuple, list)):
+        return tuple(_unbox(v) for v in x)
+    return x.data if isinstance(x, Tensor) else x
+
+
+def check_grad(op_fn, inputs, grad_inputs=None, eps=1e-3, rtol=2e-2,
+               atol=2e-3, reduce_fn=None):
+    """Analytic grads (tape) vs central finite differences (ref
+    get_numeric_gradient op_test.py:150). Scalar-valued via sum-reduction
+    unless reduce_fn given. f64 finite differences for stability."""
+    arrays = [np.asarray(a, np.float64) for a in inputs]
+    grad_idx = (list(range(len(arrays))) if grad_inputs is None
+                else list(grad_inputs))
+
+    def scalar(*arrs):
+        out = op_fn(*[paddle.to_tensor(a.astype(np.float32)) for a in arrs])
+        if reduce_fn is not None:
+            out = reduce_fn(out)
+        elif isinstance(out, (tuple, list)):
+            out = sum(o.sum() for o in out)
+        else:
+            out = out.sum()
+        return out
+
+    # analytic via the tape
+    tens = [paddle.to_tensor(a.astype(np.float32)) for a in arrays]
+    for i in grad_idx:
+        tens[i].stop_gradient = False
+    out = op_fn(*tens)
+    if reduce_fn is not None:
+        s = reduce_fn(out)
+    elif isinstance(out, (tuple, list)):
+        s = sum(o.sum() for o in out)
+    else:
+        s = out.sum()
+    s.backward()
+    analytic = [tens[i].grad.numpy() for i in grad_idx]
+
+    for gi, i in enumerate(grad_idx):
+        num = np.zeros_like(arrays[i])
+        flat = arrays[i].reshape(-1)
+        nflat = num.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            fp = float(scalar(*arrays).item())
+            flat[j] = orig - eps
+            fm = float(scalar(*arrays).item())
+            flat[j] = orig
+            nflat[j] = (fp - fm) / (2 * eps)
+        np.testing.assert_allclose(analytic[gi], num, rtol=rtol, atol=atol,
+                                   err_msg=f"grad input {i}")

@@ -1,0 +1,143 @@
+"""Aux subsystems: hapi Model.fit, profiler windows, elastic resume,
+incubate fused functional ops, launch CLI arg parsing."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+
+
+def test_hapi_fit_evaluate_predict():
+    from paddle_tpu.io import TensorDataset
+    paddle.seed(0)
+    np.random.seed(0)
+    W = np.random.randn(8, 4).astype(np.float32)
+    x = np.random.randn(64, 8).astype(np.float32)
+    y = x @ W
+    ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+
+    net = nn.Sequential(nn.Linear(8, 32), nn.Tanh(), nn.Linear(32, 4))
+    model = paddle.Model(net)
+    model.prepare(optimizer=opt.Adam(learning_rate=0.01,
+                                     parameters=net.parameters()),
+                  loss=F.mse_loss)
+    model.fit(ds, batch_size=16, epochs=30, verbose=0)
+    logs = model.evaluate(ds, batch_size=16, verbose=0)
+    assert logs["loss"] < 0.5, logs
+    preds = model.predict(ds, batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (64, 4)
+
+
+def test_hapi_save_load():
+    net = nn.Linear(4, 2)
+    model = paddle.Model(net)
+    model.prepare(optimizer=opt.SGD(learning_rate=0.1,
+                                    parameters=net.parameters()))
+    with tempfile.TemporaryDirectory() as d:
+        model.save(os.path.join(d, "ckpt"))
+        net2 = nn.Linear(4, 2)
+        m2 = paddle.Model(net2)
+        m2.prepare(optimizer=opt.SGD(learning_rate=0.1,
+                                     parameters=net2.parameters()))
+        m2.load(os.path.join(d, "ckpt"))
+        np.testing.assert_array_equal(net.weight.numpy(),
+                                      net2.weight.numpy())
+
+
+def test_profiler_scheduler_windows():
+    from paddle_tpu.profiler import ProfilerState, make_scheduler
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=1,
+                           skip_first=1)
+    states = [sched(i) for i in range(6)]
+    assert states[0] == ProfilerState.CLOSED           # skip_first
+    assert states[1] == ProfilerState.CLOSED
+    assert states[2] == ProfilerState.READY
+    assert states[3] == ProfilerState.RECORD
+    assert states[4] == ProfilerState.RECORD_AND_RETURN
+    assert states[5] == ProfilerState.CLOSED           # repeat done
+
+
+def test_profiler_timer_only():
+    from paddle_tpu.profiler import Profiler
+    p = Profiler(timer_only=True)
+    p.start()
+    for _ in range(3):
+        _ = paddle.to_tensor(np.ones(4)) + 1.0
+        p.step()
+    p.stop()
+    assert "avg step" in p.step_info()
+
+
+def test_record_event():
+    from paddle_tpu.profiler import RecordEvent
+    with RecordEvent("user_span"):
+        _ = paddle.to_tensor([1.0]) * 2
+
+
+def test_elastic_resume_after_crash():
+    from paddle_tpu.distributed.elastic import ElasticManager
+    paddle.seed(0)
+    calls = {"n": 0}
+
+    with tempfile.TemporaryDirectory() as d:
+        em = ElasticManager(d, save_interval=2, max_restarts=2)
+
+        def make_state():
+            paddle.seed(0)
+            net = nn.Linear(4, 2)
+            o = opt.SGD(learning_rate=0.1, parameters=net.parameters())
+            return {"net": net, "opt": o, **net.state_dict()}
+
+        x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+
+        def train_step(state, step):
+            calls["n"] += 1
+            if calls["n"] == 4:      # crash once mid-training
+                raise RuntimeError("simulated preemption")
+            net = state["net"]
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            state["opt"].step()
+            state["opt"].clear_grad()
+            return loss.item()
+
+        losses = em.run(make_state, train_step, total_steps=6)
+        # crashed at global call 4 (= step 3 of first run), resumed from
+        # step 2 checkpoint and completed 6 steps total
+        assert len(losses) >= 6
+        step, path = em.latest()
+        assert step == 6 and path is not None
+
+
+def test_incubate_fused_ops():
+    import paddle_tpu.incubate.nn.functional as FF
+    x = paddle.to_tensor(np.random.randn(2, 8, 16).astype(np.float32))
+    w = paddle.to_tensor(np.ones(16, np.float32))
+    out = FF.fused_rms_norm(x, w)
+    ref = x.numpy() / np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True)
+                              + 1e-6)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    y = FF.swiglu(paddle.to_tensor(np.random.randn(4, 8).astype(np.float32)))
+    assert y.shape == [4, 4]
+
+    b = FF.fused_bias_act(x, act_method="gelu")
+    np.testing.assert_allclose(b.numpy(), np.asarray(
+        __import__("jax").nn.gelu(x.data)), rtol=1e-5)
+
+
+def test_launch_arg_parsing():
+    from paddle_tpu.distributed.launch.main import _bootstrap_env, _parse
+    args = _parse(["--master", "10.0.0.1:1234", "--nnodes", "4", "--rank",
+                   "2", "train.py", "--lr", "0.1"])
+    env = _bootstrap_env(args)
+    assert env["JAX_COORDINATOR_ADDRESS"] == "10.0.0.1:1234"
+    assert env["JAX_NUM_PROCESSES"] == "4"
+    assert env["JAX_PROCESS_ID"] == "2"
+    assert args.script == "train.py"
+    assert args.script_args == ["--lr", "0.1"]
