@@ -79,6 +79,10 @@ from . import vision      # noqa: F401,E402
 from . import metric      # noqa: F401,E402
 from . import device      # noqa: F401,E402
 from . import hapi        # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
+from . import sparse      # noqa: F401,E402
+from . import quantization  # noqa: F401,E402
+from . import models      # noqa: F401,E402
 from . import profiler    # noqa: F401,E402
 from . import incubate    # noqa: F401,E402
 from .hapi import Model   # noqa: F401,E402

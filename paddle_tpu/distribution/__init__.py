@@ -1,0 +1,685 @@
+"""paddle.distribution (ref: python/paddle/distribution/ ~8.1k LoC —
+Distribution base, Normal/Uniform/Categorical/..., kl_divergence registry,
+transformed distributions).
+
+TPU-native: log_probs/samples are jnp compositions routed through the tape
+(differentiable wherever the reference's are); sampling threads the global
+PRNG key via framework.core so draws are reproducible under paddle.seed
+and traceable under jit."""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.tape import apply_op
+from ..framework import core
+from ..ops._helpers import to_tensor_like, unwrap
+from ..tensor import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Exponential", "Gamma", "Geometric",
+           "Gumbel", "Laplace", "LogNormal", "Multinomial", "Cauchy",
+           "StudentT", "Poisson", "Binomial", "ContinuousBernoulli",
+           "ExponentialFamily", "TransformedDistribution", "kl_divergence",
+           "register_kl"]
+
+
+def _arr(v, dtype=jnp.float32):
+    if isinstance(v, Tensor):
+        return v.data.astype(dtype)
+    return jnp.asarray(v, dtype=dtype)
+
+
+class Distribution:
+    """ref distribution/distribution.py Distribution base."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(unwrap(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _extend(self, shape):
+        return tuple(shape) + self._batch_shape + self._event_shape
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def sample(self, shape=()):
+        key = core.next_rng_key()
+        eps = jax.random.normal(key, self._extend(shape))
+        return Tensor(self.loc + self.scale * eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = to_tensor_like(value)
+        return apply_op(
+            lambda x: -((x - self.loc) ** 2) / (2 * self.scale ** 2)
+            - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi),
+            v, name="normal_log_prob")
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape))
+
+    def cdf(self, value):
+        v = _arr(unwrap(value))
+        return Tensor(0.5 * (1 + jax.scipy.special.erf(
+            (v - self.loc) / (self.scale * math.sqrt(2)))))
+
+
+class LogNormal(Normal):
+    def sample(self, shape=()):
+        return Tensor(jnp.exp(unwrap(super().sample(shape))))
+
+    rsample = sample
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def log_prob(self, value):
+        v = to_tensor_like(value)
+        return apply_op(
+            lambda x: -((jnp.log(x) - self.loc) ** 2) / (2 * self.scale ** 2)
+            - jnp.log(x * self.scale) - 0.5 * math.log(2 * math.pi),
+            v, name="lognormal_log_prob")
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale) + self.loc)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    @property
+    def mean(self):
+        return Tensor((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return Tensor((self.high - self.low) ** 2 / 12)
+
+    def sample(self, shape=()):
+        key = core.next_rng_key()
+        u = jax.random.uniform(key, self._extend(shape))
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = to_tensor_like(value)
+        return apply_op(
+            lambda x: jnp.where((x >= self.low) & (x < self.high),
+                                -jnp.log(self.high - self.low), -jnp.inf),
+            v, name="uniform_log_prob")
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is not None:
+            self.logits = _arr(unwrap(logits))
+            self._log_p = jax.nn.log_softmax(self.logits, axis=-1)
+        else:
+            p = _arr(unwrap(probs))
+            p = p / p.sum(-1, keepdims=True)
+            self._log_p = jnp.log(p)
+            self.logits = self._log_p
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return Tensor(jnp.exp(self._log_p))
+
+    def sample(self, shape=()):
+        key = core.next_rng_key()
+        return Tensor(jax.random.categorical(
+            key, self.logits, shape=tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        idx = _arr(unwrap(value), jnp.int32)
+        return Tensor(jnp.take_along_axis(
+            self._log_p, idx[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        p = jnp.exp(self._log_p)
+        return Tensor(-jnp.sum(p * self._log_p, axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs_ = jnp.clip(_arr(unwrap(probs)), 1e-7, 1 - 1e-7)
+            self.logits_ = jnp.log(self.probs_ / (1 - self.probs_))
+        else:
+            self.logits_ = _arr(unwrap(logits))
+            # clip: f32 sigmoid saturates to exactly 0/1 for |logit|>~17,
+            # which would turn log_prob into 0*(-inf)=NaN
+            self.probs_ = jnp.clip(jax.nn.sigmoid(self.logits_),
+                                   1e-7, 1 - 1e-7)
+        super().__init__(self.probs_.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.probs_)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape=()):
+        key = core.next_rng_key()
+        return Tensor(jax.random.bernoulli(
+            key, self.probs_, self._extend(shape)).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = to_tensor_like(value)
+        return apply_op(
+            lambda x: x * jnp.log(self.probs_)
+            + (1 - x) * jnp.log(1 - self.probs_), v, name="bern_log_prob")
+
+    def entropy(self):
+        p = self.probs_
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log(1 - p)))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(unwrap(rate))
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate ** -2)
+
+    def sample(self, shape=()):
+        key = core.next_rng_key()
+        return Tensor(jax.random.exponential(
+            key, self._extend(shape)) / self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = to_tensor_like(value)
+        return apply_op(lambda x: jnp.log(self.rate) - self.rate * x, v,
+                        name="exp_log_prob")
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _arr(unwrap(concentration))
+        self.rate = _arr(unwrap(rate))
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / self.rate ** 2)
+
+    def sample(self, shape=()):
+        key = core.next_rng_key()
+        return Tensor(jax.random.gamma(
+            key, self.concentration, self._extend(shape)) / self.rate)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = to_tensor_like(value)
+        a, b = self.concentration, self.rate
+        return apply_op(
+            lambda x: a * jnp.log(b) + (a - 1) * jnp.log(x) - b * x
+            - gammaln(a), v, name="gamma_log_prob")
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+        a, b = self.concentration, self.rate
+        return Tensor(a - jnp.log(b) + gammaln(a) + (1 - a) * digamma(a))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(unwrap(alpha))
+        self.beta = _arr(unwrap(beta))
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (s ** 2 * (s + 1)))
+
+    def sample(self, shape=()):
+        key = core.next_rng_key()
+        return Tensor(jax.random.beta(key, self.alpha, self.beta,
+                                      self._extend(shape)))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+        v = to_tensor_like(value)
+        a, b = self.alpha, self.beta
+        return apply_op(
+            lambda x: (a - 1) * jnp.log(x) + (b - 1) * jnp.log1p(-x)
+            - betaln(a, b), v, name="beta_log_prob")
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(unwrap(concentration))
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration
+                      / self.concentration.sum(-1, keepdims=True))
+
+    def sample(self, shape=()):
+        key = core.next_rng_key()
+        return Tensor(jax.random.dirichlet(
+            key, self.concentration, tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = to_tensor_like(value)
+        a = self.concentration
+
+        def lp(x):
+            return (jnp.sum((a - 1) * jnp.log(x), -1)
+                    + gammaln(a.sum(-1)) - jnp.sum(gammaln(a), -1))
+        return apply_op(lp, v, name="dirichlet_log_prob")
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(unwrap(loc))
+        self.scale = _arr(unwrap(scale))
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(2 * self.scale ** 2)
+
+    def sample(self, shape=()):
+        key = core.next_rng_key()
+        return Tensor(self.loc + self.scale * jax.random.laplace(
+            key, self._extend(shape)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = to_tensor_like(value)
+        return apply_op(
+            lambda x: -jnp.abs(x - self.loc) / self.scale
+            - jnp.log(2 * self.scale), v, name="laplace_log_prob")
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(unwrap(loc))
+        self.scale = _arr(unwrap(scale))
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * 0.57721566490153286)
+
+    @property
+    def variance(self):
+        return Tensor((math.pi ** 2 / 6) * self.scale ** 2)
+
+    def sample(self, shape=()):
+        key = core.next_rng_key()
+        return Tensor(self.loc + self.scale * jax.random.gumbel(
+            key, self._extend(shape)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = to_tensor_like(value)
+
+        def lp(x):
+            z = (x - self.loc) / self.scale
+            return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+        return apply_op(lp, v, name="gumbel_log_prob")
+
+
+class Geometric(Distribution):
+    """Support {0, 1, ...}: pmf p(k) = (1-p)^k p (paddle semantics,
+    ref distribution/geometric.py mean = 1/p - 1, pmf :152)."""
+
+    def __init__(self, probs, name=None):
+        self.probs_ = jnp.clip(_arr(unwrap(probs)), 1e-7, 1 - 1e-7)
+        super().__init__(self.probs_.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.probs_ - 1.0)
+
+    @property
+    def variance(self):
+        return Tensor((1 - self.probs_) / self.probs_ ** 2)
+
+    def sample(self, shape=()):
+        key = core.next_rng_key()
+        # jax.random.geometric samples k >= 0 with pmf p(1-p)^k already
+        return Tensor(jax.random.geometric(
+            key, self.probs_, self._extend(shape)).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = to_tensor_like(value)
+        return apply_op(
+            lambda k: k * jnp.log1p(-self.probs_) + jnp.log(self.probs_),
+            v, name="geometric_log_prob")
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(unwrap(loc))
+        self.scale = _arr(unwrap(scale))
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        key = core.next_rng_key()
+        return Tensor(self.loc + self.scale * jax.random.cauchy(
+            key, self._extend(shape)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = to_tensor_like(value)
+
+        def lp(x):
+            z = (x - self.loc) / self.scale
+            return -jnp.log(math.pi * self.scale * (1 + z ** 2))
+        return apply_op(lp, v, name="cauchy_log_prob")
+
+    def entropy(self):
+        return Tensor(jnp.log(4 * math.pi * self.scale))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _arr(unwrap(df))
+        self.loc = _arr(unwrap(loc))
+        self.scale = _arr(unwrap(scale))
+        super().__init__(jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        key = core.next_rng_key()
+        return Tensor(self.loc + self.scale * jax.random.t(
+            key, self.df, self._extend(shape)))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = to_tensor_like(value)
+        df, loc, sc = self.df, self.loc, self.scale
+
+        def lp(x):
+            z = (x - loc) / sc
+            return (gammaln((df + 1) / 2) - gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(sc)
+                    - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+        return apply_op(lp, v, name="studentt_log_prob")
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(unwrap(rate))
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def sample(self, shape=()):
+        key = core.next_rng_key()
+        return Tensor(jax.random.poisson(
+            key, self.rate, self._extend(shape)).astype(jnp.float32))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = to_tensor_like(value)
+        return apply_op(
+            lambda k: k * jnp.log(self.rate) - self.rate - gammaln(k + 1),
+            v, name="poisson_log_prob")
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _arr(unwrap(total_count))
+        self.probs_ = jnp.clip(_arr(unwrap(probs)), 1e-7, 1 - 1e-7)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs_.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs_)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape=()):
+        key = core.next_rng_key()
+        n = int(jnp.max(self.total_count))
+        u = jax.random.uniform(key, tuple(shape) + self.batch_shape + (n,))
+        draws = (u < self.probs_[..., None]).astype(jnp.float32)
+        mask = jnp.arange(n) < self.total_count[..., None]
+        return Tensor((draws * mask).sum(-1))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = to_tensor_like(value)
+        n, p = self.total_count, self.probs_
+
+        def lp(k):
+            return (gammaln(n + 1) - gammaln(k + 1) - gammaln(n - k + 1)
+                    + k * jnp.log(p) + (n - k) * jnp.log1p(-p))
+        return apply_op(lp, v, name="binomial_log_prob")
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        p = _arr(unwrap(probs))
+        self.probs_ = p / p.sum(-1, keepdims=True)
+        super().__init__(self.probs_.shape[:-1], self.probs_.shape[-1:])
+
+    def sample(self, shape=()):
+        key = core.next_rng_key()
+        logits = jnp.log(self.probs_)
+        draws = jax.random.categorical(
+            key, logits, shape=tuple(shape) + self.batch_shape
+            + (self.total_count,))
+        K = self.probs_.shape[-1]
+        return Tensor(jax.nn.one_hot(draws, K).sum(-2))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = to_tensor_like(value)
+        p = self.probs_
+
+        def lp(k):
+            return (gammaln(k.sum(-1) + 1) - jnp.sum(gammaln(k + 1), -1)
+                    + jnp.sum(k * jnp.log(p), -1))
+        return apply_op(lp, v, name="multinomial_log_prob")
+
+
+class ContinuousBernoulli(Bernoulli):
+    pass
+
+
+class ExponentialFamily(Distribution):
+    pass
+
+
+class TransformedDistribution(Distribution):
+    """ref distribution/transformed_distribution.py — minimal bijector
+    chain (forward sample, log_prob via inverse + log-det)."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = unwrap(self.base.sample(shape))
+        for t in self.transforms:
+            x = t.forward(x)
+        return Tensor(x)
+
+    def log_prob(self, value):
+        y = _arr(unwrap(value))
+        lp = jnp.zeros(())
+        x = y
+        for t in reversed(self.transforms):
+            x_prev = t.inverse(x)
+            lp = lp - t.forward_log_det_jacobian(x_prev)
+            x = x_prev
+        return Tensor(unwrap(self.base.log_prob(Tensor(x))) + lp)
+
+
+# -- KL registry (ref distribution/kl.py) -----------------------------------
+_KL_TABLE: Dict[Tuple[type, type], callable] = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_TABLE[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    for (pc, qc), fn in _KL_TABLE.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_p, var_q = p.scale ** 2, q.scale ** 2
+    return Tensor(jnp.log(q.scale / p.scale)
+                  + (var_p + (p.loc - q.loc) ** 2) / (2 * var_q) - 0.5)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    pp = jnp.exp(p._log_p)
+    return Tensor(jnp.sum(pp * (p._log_p - q._log_p), axis=-1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    a, b = p.probs_, q.probs_
+    return Tensor(a * jnp.log(a / b) + (1 - a) * jnp.log((1 - a) / (1 - b)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    return Tensor(jnp.log(p.rate / q.rate) + q.rate / p.rate - 1)

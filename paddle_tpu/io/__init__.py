@@ -267,6 +267,22 @@ def get_worker_info():
     return getattr(_worker_info, "info", None)
 
 
+def _stack_np(arrays):
+    """np.stack with the native parallel-memcpy collate engine when
+    available (io/_native/batcher.cpp, the C++ data-feed equivalent of the
+    reference's buffered_reader; falls back to np.stack)."""
+    if len(arrays) >= 8 and arrays[0].nbytes >= (1 << 12):
+        try:
+            from . import _native
+            out = _native.collate_stack(
+                [np.ascontiguousarray(a) for a in arrays])
+            if out is not None:
+                return out
+        except Exception:
+            pass
+    return np.stack(arrays)
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, (Tensor,)):
@@ -274,7 +290,7 @@ def default_collate_fn(batch):
         return Tensor(jnp.stack([b.data for b in batch]))
     if isinstance(sample, np.ndarray):
         import jax.numpy as jnp
-        return Tensor(jnp.asarray(np.stack(batch)))
+        return Tensor(jnp.asarray(_stack_np(list(batch))))
     if isinstance(sample, (int, np.integer)):
         import jax.numpy as jnp
         return Tensor(jnp.asarray(np.asarray(batch, np.int64)))

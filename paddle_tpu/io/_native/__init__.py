@@ -1,0 +1,126 @@
+"""ctypes bindings for the native data-feed engine (batcher.cpp).
+
+Builds libbatcher.so on first import with g++ (cached next to the source);
+falls back to None when no toolchain is available — DataLoader then uses
+the pure-Python path."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "batcher.cpp")
+_SO = os.path.join(_HERE, "libbatcher.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", _SRC, "-o", _SO]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def load():
+    """Returns the ctypes lib or None."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_SO) or (
+                    os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except Exception:
+            return None
+        lib.parallel_collate.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int]
+        lib.queue_create.restype = ctypes.c_void_p
+        lib.queue_create.argtypes = [ctypes.c_int64]
+        lib.queue_push.restype = ctypes.c_int
+        lib.queue_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                   ctypes.c_int64, ctypes.c_int64]
+        lib.queue_next_size.restype = ctypes.c_int64
+        lib.queue_next_size.argtypes = [ctypes.c_void_p]
+        lib.queue_pop.restype = ctypes.c_int64
+        lib.queue_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                  ctypes.c_int64]
+        lib.queue_size.restype = ctypes.c_int64
+        lib.queue_size.argtypes = [ctypes.c_void_p]
+        lib.queue_close.argtypes = [ctypes.c_void_p]
+        lib.queue_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def collate_stack(arrays, out=None, threads: int = 0):
+    """Stack N same-shape contiguous numpy arrays into [N, ...] using the
+    native parallel memcpy; returns numpy array (or None if lib missing)."""
+    import numpy as np
+    lib = load()
+    if lib is None or not arrays:
+        return None
+    a0 = arrays[0]
+    if a0.dtype.hasobject:   # PyObject pointers must never be raw-memcpy'd
+        return None
+    if any(a.shape != a0.shape or a.dtype != a0.dtype or
+           not a.flags["C_CONTIGUOUS"] for a in arrays):
+        return None
+    n = len(arrays)
+    item = a0.nbytes
+    if out is None:
+        out = np.empty((n,) + a0.shape, a0.dtype)
+    ptrs = (ctypes.c_void_p * n)(
+        *[a.ctypes.data_as(ctypes.c_void_p) for a in arrays])
+    lib.parallel_collate(ptrs, n, item,
+                         out.ctypes.data_as(ctypes.c_void_p), threads)
+    return out
+
+
+class NativeQueue:
+    """Prefetch channel over the C++ ring queue (bytes + tag)."""
+
+    CLOSED = -(2 ** 63)
+
+    def __init__(self, capacity: int = 4):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native batcher unavailable")
+        self._h = self._lib.queue_create(capacity)
+        self._pop_lock = threading.Lock()
+
+    def push(self, data: bytes, tag: int = 0) -> bool:
+        return self._lib.queue_push(self._h, data, len(data), tag) == 0
+
+    def pop(self):
+        """-> (bytes, tag) or (None, None) when closed+drained. The
+        size-peek + pop pair is guarded so concurrent consumers can't
+        interleave between them (queue_pop truncates on undersized dst)."""
+        import numpy as np
+        with self._pop_lock:
+            size = self._lib.queue_next_size(self._h)
+            if size < 0:
+                return None, None
+            buf = np.empty(size, dtype=np.uint8)
+            tag = self._lib.queue_pop(
+                self._h, buf.ctypes.data_as(ctypes.c_void_p), size)
+        if tag == self.CLOSED:
+            return None, None
+        return buf.tobytes(), tag
+
+    def qsize(self):
+        return self._lib.queue_size(self._h)
+
+    def close(self):
+        self._lib.queue_close(self._h)
+
+    def __del__(self):
+        try:
+            self._lib.queue_destroy(self._h)
+        except Exception:
+            pass

@@ -1,5 +1,16 @@
-"""Flagship model zoo (BASELINE.md configs)."""
+"""Flagship model zoo (BASELINE.md configs 1-5)."""
 from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, llama_1b, llama_350m,
     llama_7b, llama_tiny,
+)
+from .bert import (  # noqa: F401
+    BertConfig, BertForMaskedLM, BertForSequenceClassification, BertModel,
+    bert_base, bert_large, bert_tiny,
+)
+from .ernie import (  # noqa: F401
+    ErnieConfig, ErnieForPretraining, ErnieModel, build_ernie_pipeline,
+    ernie_3_0_medium, ernie_base, ernie_tiny,
+)
+from .unet import (  # noqa: F401
+    UNet2DConditionModel, UNetConfig, unet_sd15, unet_tiny,
 )

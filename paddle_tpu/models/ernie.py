@@ -1,0 +1,209 @@
+"""ERNIE-3.0-style encoder — BASELINE.md config 4 (TP+PP hybrid on a TPU
+mesh).
+
+TPU-native: a pre-LN transformer encoder whose blocks are homogeneous, so
+the model factors directly into a PipelineLayer (prefix = embeddings,
+middle = N identical ErnieBlock, suffix = final norm + head) and every
+matmul weight carries a TP PartitionSpec over `mp`. This is the shape the
+reference trains with TensorParallel+PipelineParallel
+(ref anchors: fleet/layers/mpu/mp_layers.py:335,542 column/row layouts;
+fleet/meta_parallel/pipeline_parallel.py:440 1F1B loop; ERNIE itself lives
+outside the reference repo — the parallel plumbing is the parity target).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..autograd.tape import apply_op
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.common import Dropout, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+from ..ops._helpers import to_tensor_like
+
+__all__ = ["ErnieConfig", "ErnieEmbedding", "ErnieBlock", "ErnieHead",
+           "ErnieModel", "ErnieForPretraining", "ernie_tiny", "ernie_base",
+           "ernie_3_0_medium", "build_ernie_pipeline"]
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 2048
+    hidden_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def _tp(p, spec):
+    p.pspec = spec
+    return p
+
+
+class ErnieEmbedding(Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        std = cfg.initializer_range
+        self.word_emb = _tp(self.create_parameter(
+            (cfg.vocab_size, cfg.hidden_size),
+            default_initializer=I.Normal(0.0, std)), P("mp", None))
+        self.pos_emb = self.create_parameter(
+            (cfg.max_position_embeddings, cfg.hidden_size),
+            default_initializer=I.Normal(0.0, std))
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids):
+        ids = to_tensor_like(input_ids)
+        S = ids.shape[-1]
+        out = apply_op(
+            lambda i, w, pw: jnp.take(w, i.astype(jnp.int32), axis=0)
+            + pw[:S][None], ids, self.word_emb, self.pos_emb,
+            name="ernie_embed")
+        return self.dropout(out)
+
+
+class ErnieBlock(Layer):
+    """Pre-LN block: ln -> attn -> +res; ln -> ffn -> +res. All blocks are
+    structurally identical => pipeline-middle eligible."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.cfg = cfg
+        self.ln1 = LayerNorm(h, epsilon=cfg.layer_norm_eps)
+        self.qkv = Linear(h, 3 * h)
+        _tp(self.qkv.weight, P(None, "mp"))
+        self.proj = Linear(h, h)
+        _tp(self.proj.weight, P("mp", None))
+        self.ln2 = LayerNorm(h, epsilon=cfg.layer_norm_eps)
+        self.fc1 = Linear(h, cfg.intermediate_size)
+        _tp(self.fc1.weight, P(None, "mp"))
+        self.fc2 = Linear(cfg.intermediate_size, h)
+        _tp(self.fc2.weight, P("mp", None))
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x):
+        cfg = self.cfg
+        nh, d = cfg.num_attention_heads, cfg.head_dim
+        a = self.ln1(x)
+        qkv = self.qkv(a)
+        B, S = qkv.shape[0], qkv.shape[1]
+
+        def attn(t):
+            q, k, v = jnp.split(t, 3, axis=-1)
+            q = q.reshape(B, S, nh, d)
+            k = k.reshape(B, S, nh, d)
+            v = v.reshape(B, S, nh, d)
+            from ..kernels import flash_attention as fa
+            if fa.supported(q.shape, k.shape, True):
+                o = fa.flash_attention_bshd(q, k, v, causal=False)
+            else:
+                qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+                kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+                vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+                s = qt @ jnp.swapaxes(kt, -1, -2) / math.sqrt(d)
+                p = jax.nn.softmax(s, axis=-1)
+                o = jnp.swapaxes(p @ vt, 1, 2).astype(t.dtype)
+            return o.reshape(B, S, nh * d)
+
+        x = x + self.proj(apply_op(attn, qkv, name="ernie_attn"))
+        h = self.fc2(F.gelu(self.fc1(self.ln2(x))))
+        return x + self.dropout(h)
+
+
+class ErnieHead(Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.norm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.decoder = Linear(cfg.hidden_size, cfg.vocab_size)
+        _tp(self.decoder.weight, P(None, "mp"))
+
+    def forward(self, x):
+        return self.decoder(self.norm(x))
+
+
+class ErnieModel(Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = ErnieEmbedding(cfg)
+        self.blocks = LayerList([ErnieBlock(cfg)
+                                 for _ in range(cfg.num_hidden_layers)])
+        self.norm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+    def forward(self, input_ids):
+        x = self.embeddings(input_ids)
+        for b in self.blocks:
+            x = b(x)
+        return self.norm(x)
+
+
+class ErnieForPretraining(Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.ernie = ErnieModel(cfg)
+        self.head = Linear(cfg.hidden_size, cfg.vocab_size)
+        _tp(self.head.weight, P(None, "mp"))
+
+    def forward(self, input_ids):
+        return self.head(self.ernie(input_ids))
+
+    def loss(self, input_ids, labels, ignore_index=-100):
+        logits = self(input_ids)
+        from ..ops import manipulation as M
+        V = logits.shape[-1]
+        return F.cross_entropy(M.reshape(logits, [-1, V]),
+                               M.reshape(to_tensor_like(labels), [-1]),
+                               ignore_index=ignore_index)
+
+
+def build_ernie_pipeline(cfg: ErnieConfig, num_stages: int, loss_fn=None):
+    """PipelineLayer factoring of ERNIE for TP+PP hybrid (config 4):
+    embeddings -> N identical blocks (pipelined middle, stacked over pp)
+    -> norm+head suffix."""
+    from ..distributed.fleet.meta_parallel import LayerDesc, PipelineLayer
+
+    def default_loss(logits, labels):
+        from ..ops import manipulation as M
+        V = logits.shape[-1]
+        return F.cross_entropy(M.reshape(logits, [-1, V]),
+                               M.reshape(labels, [-1]))
+
+    return PipelineLayer(
+        layers=[LayerDesc(ErnieEmbedding, cfg),
+                *[LayerDesc(ErnieBlock, cfg)
+                  for _ in range(cfg.num_hidden_layers)],
+                LayerDesc(ErnieHead, cfg)],
+        num_stages=num_stages,
+        loss_fn=loss_fn or default_loss)
+
+
+def ernie_tiny(**kw):
+    return ErnieConfig(vocab_size=1024, hidden_size=128, num_hidden_layers=4,
+                       num_attention_heads=4, intermediate_size=512,
+                       max_position_embeddings=128, **kw)
+
+
+def ernie_base(**kw):
+    return ErnieConfig(**kw)
+
+
+def ernie_3_0_medium(**kw):
+    return ErnieConfig(hidden_size=768, num_hidden_layers=6,
+                       num_attention_heads=12, intermediate_size=3072, **kw)

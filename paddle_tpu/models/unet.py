@@ -1,0 +1,308 @@
+"""Stable-Diffusion-style conditional UNet — BASELINE.md config 5
+(conv + cross-attention; reference kernel anchors:
+phi/kernels/gpudnn/conv_kernel.cu, phi/kernels/fusion/cutlass/
+memory_efficient_attention/ — on TPU both are XLA: MXU convolutions and
+fused attention).
+
+TPU-native design: ResBlock(GroupNorm+SiLU+Conv) + SpatialTransformer
+(self-attn + cross-attn on text context + GEGLU MLP) at each resolution,
+sinusoidal timestep embedding, skip-connected down/up path — the standard
+SD UNet topology, sized by `block_out_channels`."""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.tape import apply_op
+from ..nn import functional as F
+from ..nn.layer.common import Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.conv import Conv2D
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import GroupNorm, LayerNorm
+from ..ops import manipulation as M
+from ..ops._helpers import to_tensor_like
+from ..tensor import Tensor
+
+__all__ = ["UNetConfig", "UNet2DConditionModel", "unet_tiny", "unet_sd15"]
+
+
+@dataclass
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    block_out_channels: Sequence[int] = (320, 640, 1280, 1280)
+    layers_per_block: int = 2
+    cross_attention_dim: int = 768
+    attention_head_dim: int = 8
+    norm_num_groups: int = 32
+    sample_size: int = 64
+
+
+def timestep_embedding(t, dim, max_period=10000.0):
+    """Sinusoidal embedding [B] -> [B, dim] (f32)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period)
+                    * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+class ResBlock(Layer):
+    def __init__(self, in_c, out_c, temb_c, groups):
+        super().__init__()
+        g1 = math.gcd(groups, in_c)
+        g2 = math.gcd(groups, out_c)
+        self.norm1 = GroupNorm(g1, in_c)
+        self.conv1 = Conv2D(in_c, out_c, 3, padding=1)
+        self.temb_proj = Linear(temb_c, out_c)
+        self.norm2 = GroupNorm(g2, out_c)
+        self.conv2 = Conv2D(out_c, out_c, 3, padding=1)
+        self.skip = Conv2D(in_c, out_c, 1) if in_c != out_c else None
+
+    def forward(self, x, temb):
+        h = self.conv1(F.silu(self.norm1(x)))
+        t = self.temb_proj(F.silu(temb))
+        h = _add_temb(h, t)
+        h = self.conv2(F.silu(self.norm2(h)))
+        return h + (self.skip(x) if self.skip is not None else x)
+
+
+def _add_temb(h, t):
+    return apply_op(lambda a, b: a + b[:, :, None, None], h, t,
+                    name="temb_broadcast")
+
+
+class CrossAttention(Layer):
+    def __init__(self, q_dim, ctx_dim, heads, head_dim):
+        super().__init__()
+        inner = heads * head_dim
+        self.heads = heads
+        self.head_dim = head_dim
+        self.to_q = Linear(q_dim, inner, bias_attr=False)
+        self.to_k = Linear(ctx_dim, inner, bias_attr=False)
+        self.to_v = Linear(ctx_dim, inner, bias_attr=False)
+        self.to_out = Linear(inner, q_dim)
+
+    def forward(self, x, context=None):
+        ctx = x if context is None else context
+        q, k, v = self.to_q(x), self.to_k(ctx), self.to_v(ctx)
+        H, D = self.heads, self.head_dim
+
+        def attn(q, k, v):
+            B, Sq = q.shape[0], q.shape[1]
+            Sk = k.shape[1]
+            qh = q.reshape(B, Sq, H, D)
+            kh = k.reshape(B, Sk, H, D)
+            vh = v.reshape(B, Sk, H, D)
+            qt = jnp.swapaxes(qh, 1, 2).astype(jnp.float32)
+            kt = jnp.swapaxes(kh, 1, 2).astype(jnp.float32)
+            vt = jnp.swapaxes(vh, 1, 2).astype(jnp.float32)
+            s = qt @ jnp.swapaxes(kt, -1, -2) / math.sqrt(D)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.swapaxes(p @ vt, 1, 2).astype(q.dtype)
+            return o.reshape(B, Sq, H * D)
+
+        out = apply_op(attn, q, k, v, name="cross_attn")
+        return self.to_out(out)
+
+
+class GEGLU(Layer):
+    def __init__(self, dim, mult=4):
+        super().__init__()
+        self.proj = Linear(dim, dim * mult * 2)
+        self.out = Linear(dim * mult, dim)
+
+    def forward(self, x):
+        h = self.proj(x)
+        h = apply_op(lambda a: jax.nn.gelu(
+            jnp.split(a, 2, axis=-1)[1]) * jnp.split(a, 2, axis=-1)[0],
+            h, name="geglu")
+        return self.out(h)
+
+
+class TransformerBlock(Layer):
+    def __init__(self, dim, ctx_dim, heads, head_dim):
+        super().__init__()
+        self.norm1 = LayerNorm(dim)
+        self.attn1 = CrossAttention(dim, dim, heads, head_dim)
+        self.norm2 = LayerNorm(dim)
+        self.attn2 = CrossAttention(dim, ctx_dim, heads, head_dim)
+        self.norm3 = LayerNorm(dim)
+        self.ff = GEGLU(dim)
+
+    def forward(self, x, context):
+        x = x + self.attn1(self.norm1(x))
+        x = x + self.attn2(self.norm2(x), context)
+        return x + self.ff(self.norm3(x))
+
+
+class SpatialTransformer(Layer):
+    """NCHW <-> tokens wrapper around TransformerBlock."""
+
+    def __init__(self, channels, ctx_dim, heads, groups):
+        super().__init__()
+        self.norm = GroupNorm(math.gcd(groups, channels), channels)
+        self.proj_in = Conv2D(channels, channels, 1)
+        self.block = TransformerBlock(channels, ctx_dim, heads,
+                                      channels // heads)
+        self.proj_out = Conv2D(channels, channels, 1)
+
+    def forward(self, x, context):
+        B, C, Hh, W = x.shape
+        h = self.proj_in(self.norm(x))
+        tokens = M.reshape(M.transpose(h, [0, 2, 3, 1]), [B, Hh * W, C])
+        tokens = self.block(tokens, context)
+        h = M.transpose(M.reshape(tokens, [B, Hh, W, C]), [0, 3, 1, 2])
+        return x + self.proj_out(h)
+
+
+class Downsample(Layer):
+    def __init__(self, c):
+        super().__init__()
+        self.conv = Conv2D(c, c, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class Upsample(Layer):
+    def __init__(self, c):
+        super().__init__()
+        self.conv = Conv2D(c, c, 3, padding=1)
+
+    def forward(self, x):
+        x = F.interpolate(x, scale_factor=2, mode="nearest")
+        return self.conv(x)
+
+
+class UNet2DConditionModel(Layer):
+    def __init__(self, cfg: UNetConfig = None, **kw):
+        super().__init__()
+        cfg = cfg or UNetConfig(**kw)
+        self.cfg = cfg
+        chs = list(cfg.block_out_channels)
+        temb_c = chs[0] * 4
+        g = cfg.norm_num_groups
+        self.time_fc1 = Linear(chs[0], temb_c)
+        self.time_fc2 = Linear(temb_c, temb_c)
+        self.conv_in = Conv2D(cfg.in_channels, chs[0], 3, padding=1)
+
+        heads = cfg.attention_head_dim
+        self.down_res = LayerList()
+        self.down_attn = LayerList()
+        self.downsamplers = LayerList()
+        c = chs[0]
+        self.down_plan = []
+        for i, out_c in enumerate(chs):
+            use_attn = i < len(chs) - 1   # SD: no attn at the last (deepest)
+            for _ in range(cfg.layers_per_block):
+                self.down_res.append(ResBlock(c, out_c, temb_c, g))
+                self.down_attn.append(
+                    SpatialTransformer(out_c, cfg.cross_attention_dim,
+                                       max(1, out_c // (heads * 8)), g)
+                    if use_attn else _Identity())
+                c = out_c
+                self.down_plan.append(("block", use_attn))
+            if i < len(chs) - 1:
+                self.downsamplers.append(Downsample(c))
+                self.down_plan.append(("down", False))
+
+        self.mid_res1 = ResBlock(c, c, temb_c, g)
+        self.mid_attn = SpatialTransformer(c, cfg.cross_attention_dim,
+                                           max(1, c // (heads * 8)), g)
+        self.mid_res2 = ResBlock(c, c, temb_c, g)
+
+        self.up_res = LayerList()
+        self.up_attn = LayerList()
+        self.upsamplers = LayerList()
+        skip_chs = self._skip_channels(chs, cfg.layers_per_block)
+        for i, out_c in enumerate(reversed(chs)):
+            use_attn = i > 0
+            for j in range(cfg.layers_per_block + 1):
+                skip = skip_chs.pop()
+                self.up_res.append(ResBlock(c + skip, out_c, temb_c, g))
+                self.up_attn.append(
+                    SpatialTransformer(out_c, cfg.cross_attention_dim,
+                                       max(1, out_c // (heads * 8)), g)
+                    if use_attn else _Identity())
+                c = out_c
+            if i < len(chs) - 1:
+                self.upsamplers.append(Upsample(c))
+
+        self.norm_out = GroupNorm(math.gcd(g, c), c)
+        self.conv_out = Conv2D(c, cfg.out_channels, 3, padding=1)
+
+    @staticmethod
+    def _skip_channels(chs, lpb):
+        skips = [chs[0]]
+        c = chs[0]
+        for i, out_c in enumerate(chs):
+            for _ in range(lpb):
+                c = out_c
+                skips.append(c)
+            if i < len(chs) - 1:
+                skips.append(c)
+        return skips
+
+    def forward(self, sample, timestep, encoder_hidden_states):
+        cfg = self.cfg
+        t = to_tensor_like(timestep)
+        temb = apply_op(
+            lambda tt: timestep_embedding(tt, cfg.block_out_channels[0]),
+            t, name="time_embed")
+        temb = self.time_fc2(F.silu(self.time_fc1(temb)))
+
+        x = self.conv_in(to_tensor_like(sample))
+        skips = [x]
+        ri = ai = di = 0
+        for kind, _ in self.down_plan:
+            if kind == "block":
+                x = self.down_res[ri](x, temb)
+                x = self.down_attn[ai](x, encoder_hidden_states)
+                ri += 1
+                ai += 1
+            else:
+                x = self.downsamplers[di](x)
+                di += 1
+            skips.append(x)
+
+        x = self.mid_res1(x, temb)
+        x = self.mid_attn(x, encoder_hidden_states)
+        x = self.mid_res2(x, temb)
+
+        ui = 0
+        n_up = len(self.up_res)
+        chs = list(self.cfg.block_out_channels)
+        per = self.cfg.layers_per_block + 1
+        for i in range(len(chs)):
+            for j in range(per):
+                x = M.concat([x, skips.pop()], axis=1)
+                x = self.up_res[ui](x, temb)
+                x = self.up_attn[ui](x, encoder_hidden_states)
+                ui += 1
+            if i < len(chs) - 1:
+                x = self.upsamplers[i](x)
+
+        return self.conv_out(F.silu(self.norm_out(x)))
+
+
+class _Identity(Layer):
+    def forward(self, x, *a, **k):
+        return x
+
+
+def unet_tiny(**kw):
+    return UNetConfig(in_channels=4, out_channels=4,
+                      block_out_channels=(32, 64),
+                      layers_per_block=1, cross_attention_dim=64,
+                      attention_head_dim=4, norm_num_groups=8,
+                      sample_size=16, **kw)
+
+
+def unet_sd15(**kw):
+    return UNetConfig(**kw)

@@ -1,0 +1,168 @@
+"""paddle.quantization — QAT/PTQ (ref: python/paddle/quantization/ —
+QuantConfig, QAT with FakeQuant observers, PTQ with calibration
+observers).
+
+TPU-native: fake-quant is a straight-through-estimator quantize/dequantize
+pair that XLA folds into the surrounding ops; int8 deployment on TPU means
+feeding the quantized weights to XLA as int8 with dequant scales (the
+reference's conversion pass); this module implements the training-time
+surface: observers, QAT wrapping, PTQ calibration, convert()."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.tape import apply_op
+from ..nn.layer.layers import Layer
+from ..ops._helpers import to_tensor_like
+from ..tensor import Tensor
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver",
+           "MovingAverageObserver", "FakeQuant", "QuantedLinear",
+           "quant_dequant"]
+
+
+import functools
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _fake_quant(v, s, qmax):
+    q = jnp.clip(jnp.round(v / s * qmax), -qmax - 1, qmax)
+    return q / qmax * s
+
+
+def _fq_fwd(v, s, qmax):
+    return _fake_quant(v, s, qmax), ()
+
+
+def _fq_bwd(qmax, res, g):   # straight-through estimator
+    return (g, None)
+
+
+_fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+def quant_dequant(x, scale, bits=8):
+    """STE fake quant: round(x/scale*qmax)/qmax*scale with identity grad."""
+    qmax = 2.0 ** (bits - 1) - 1
+    xt = to_tensor_like(x)
+    sc = scale.data if isinstance(scale, Tensor) else jnp.asarray(scale)
+    return apply_op(lambda a: _fake_quant(a, sc, qmax), xt,
+                    name="fake_quant")
+
+
+class AbsmaxObserver:
+    """ref quantization/observers/abs_max.py — per-tensor absmax scale."""
+
+    def __init__(self, quant_bits=8):
+        self.bits = quant_bits
+        self._absmax = 0.0
+
+    def observe(self, x):
+        a = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        self._absmax = max(self._absmax, float(jnp.abs(a).max()))
+
+    def scale(self):
+        return max(self._absmax, 1e-8)
+
+
+class MovingAverageObserver(AbsmaxObserver):
+    def __init__(self, quant_bits=8, momentum=0.9):
+        super().__init__(quant_bits)
+        self.momentum = momentum
+        self._ema = None
+
+    def observe(self, x):
+        a = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        cur = float(jnp.abs(a).max())
+        self._ema = (cur if self._ema is None
+                     else self.momentum * self._ema
+                     + (1 - self.momentum) * cur)
+        self._absmax = self._ema
+
+
+class FakeQuant(Layer):
+    def __init__(self, observer=None, bits=8):
+        super().__init__()
+        self.observer = observer or AbsmaxObserver(bits)
+        self.bits = bits
+
+    def forward(self, x):
+        if self.training:
+            self.observer.observe(x)
+        return quant_dequant(x, self.observer.scale(), self.bits)
+
+
+class QuantedLinear(Layer):
+    """Linear with weight+activation fake-quant (ref nn/quant layers)."""
+
+    def __init__(self, linear, w_bits=8, a_bits=8):
+        super().__init__()
+        self.inner = linear
+        self.w_fq = FakeQuant(bits=w_bits)
+        self.a_fq = FakeQuant(bits=a_bits)
+
+    def forward(self, x):
+        from ..nn import functional as F
+        x = self.a_fq(x)
+        w = self.w_fq(self.inner.weight)
+        return F.linear(x, w, self.inner.bias)
+
+
+class QuantConfig:
+    """ref quantization/config.py — maps layer types to quant wrappers."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._type_map: Dict[Type[Layer], Type[Layer]] = {}
+        from ..nn.layer.common import Linear
+        self._type_map[Linear] = QuantedLinear
+
+    def add_type_config(self, layer_type, activation=None, weight=None,
+                        wrapper=None):
+        if wrapper is not None:
+            self._type_map[layer_type] = wrapper
+
+
+def _wrap_layers(model: Layer, cfg: QuantConfig):
+    for name, child in list(model._sub_layers.items()):
+        wrapper = cfg._type_map.get(type(child))
+        if wrapper is not None:
+            model._sub_layers[name] = wrapper(child)
+        else:
+            _wrap_layers(child, cfg)
+    return model
+
+
+class QAT:
+    """ref quantization/qat.py — quantize-aware-training wrapper."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace=False):
+        return _wrap_layers(model, self.config)
+
+    def convert(self, model: Layer, inplace=False):
+        """Fold observers: freeze scales (deployment handled by XLA int8)."""
+        model.eval()
+        return model
+
+
+class PTQ:
+    """ref quantization/ptq.py — post-training calibration."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace=False):
+        m = _wrap_layers(model, self.config)
+        m.train()   # observers active during calibration passes
+        return m
+
+    def convert(self, model: Layer, inplace=False):
+        model.eval()
+        return model

@@ -1,0 +1,221 @@
+"""paddle.sparse — COO/CSR tensors + sparse functional ops
+(ref: python/paddle/sparse/ — sparse_coo_tensor/sparse_csr_tensor
+creation.py, unary/binary ops, sparse matmul; phi/kernels/sparse/ C++).
+
+TPU-native: COO is backed by jax.experimental.sparse.BCOO (XLA-native
+scatter/gather lowering). Sparse×dense matmul lowers to gather+dot — the
+pattern XLA:TPU handles; there's no cuSPARSE analog to wrap. CSR is kept
+as a (crows, cols, values) view that converts through COO for compute."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..tensor import Tensor
+from ..ops._helpers import unwrap
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "is_sparse_coo", "is_sparse_csr", "add",
+           "subtract", "multiply", "divide", "matmul", "masked_matmul",
+           "relu", "transpose", "coalesce", "nn"]
+
+
+class SparseCooTensor:
+    """ref: phi/core/sparse_coo_tensor.h — (indices [ndim, nnz], values
+    [nnz, ...], dense shape)."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- paddle surface -----------------------------------------------------
+    def indices(self):
+        return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self):
+        return SparseCsrTensor.from_coo(self)
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self):
+        return self._bcoo.nse
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """ref: phi/core/sparse_csr_tensor.h."""
+
+    def __init__(self, crows, cols, values, shape):
+        self.crows_ = jnp.asarray(unwrap(crows), jnp.int32)
+        self.cols_ = jnp.asarray(unwrap(cols), jnp.int32)
+        self.values_ = jnp.asarray(unwrap(values))
+        self._shape = list(shape)
+
+    @classmethod
+    def from_coo(cls, coo: SparseCooTensor):
+        c = coo.coalesce()
+        idx = np.asarray(jnp.swapaxes(c._bcoo.indices, 0, 1))
+        rows, cols = idx[0], idx[1]
+        n_rows = c.shape[0]
+        counts = np.bincount(rows, minlength=n_rows)
+        crows = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
+        return cls(crows, cols, np.asarray(c._bcoo.data), c.shape)
+
+    def crows(self):
+        return Tensor(self.crows_)
+
+    def cols(self):
+        return Tensor(self.cols_)
+
+    def values(self):
+        return Tensor(self.values_)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    def to_sparse_coo(self, sparse_dim=2):
+        n_rows = self._shape[0]
+        counts = self.crows_[1:] - self.crows_[:-1]
+        rows = jnp.repeat(jnp.arange(n_rows), counts,
+                          total_repeat_length=self.cols_.shape[0])
+        idx = jnp.stack([rows, self.cols_], axis=1)
+        bcoo = jsparse.BCOO((self.values_, idx), shape=tuple(self._shape))
+        return SparseCooTensor(bcoo)
+
+    def to_dense(self):
+        return self.to_sparse_coo().to_dense()
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """ref: python/paddle/sparse/creation.py sparse_coo_tensor."""
+    idx = jnp.asarray(unwrap(indices), jnp.int32)
+    vals = jnp.asarray(unwrap(values))
+    if dtype is not None:
+        from ..framework import core
+        vals = vals.astype(core.convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx.max(axis=1)))
+        shape = shape + vals.shape[1:]
+    bcoo = jsparse.BCOO((vals, jnp.swapaxes(idx, 0, 1)),
+                        shape=tuple(int(s) for s in shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    return SparseCsrTensor(crows, cols, values, shape)
+
+
+def is_sparse_coo(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def is_sparse_csr(x):
+    return isinstance(x, SparseCsrTensor)
+
+
+def _as_coo(x):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    return x
+
+
+def _binary(a, b, op):
+    a, b = _as_coo(a), _as_coo(b)
+    if isinstance(a, SparseCooTensor) and isinstance(b, SparseCooTensor):
+        return SparseCooTensor(
+            jsparse.BCOO.fromdense(op(a._bcoo.todense(), b._bcoo.todense())))
+    raise TypeError("sparse binary ops need two sparse operands")
+
+
+def add(a, b):
+    return _binary(a, b, jnp.add)
+
+
+def subtract(a, b):
+    return _binary(a, b, jnp.subtract)
+
+
+def multiply(a, b):
+    return _binary(a, b, jnp.multiply)
+
+
+def divide(a, b):
+    a, b = _as_coo(a), _as_coo(b)
+    return SparseCooTensor(jsparse.BCOO.fromdense(
+        jnp.where(b._bcoo.todense() != 0,
+                  a._bcoo.todense() / b._bcoo.todense(), 0.0)))
+
+
+def matmul(a, b):
+    """sparse @ dense -> dense (ref sparse/matmul.py)."""
+    a = _as_coo(a)
+    bd = b.data if isinstance(b, Tensor) else jnp.asarray(unwrap(b))
+    if isinstance(a, SparseCooTensor):
+        out = a._bcoo @ bd
+        return Tensor(out)
+    raise TypeError("matmul: first operand must be sparse")
+
+
+def masked_matmul(a, b, mask):
+    """dense @ dense with sparse output pattern (ref sparse/matmul.py)."""
+    ad = a.data if isinstance(a, Tensor) else jnp.asarray(unwrap(a))
+    bd = b.data if isinstance(b, Tensor) else jnp.asarray(unwrap(b))
+    mask = _as_coo(mask)
+    dense = ad @ bd
+    idx = mask._bcoo.indices
+    vals = dense[idx[:, 0], idx[:, 1]]
+    return SparseCooTensor(jsparse.BCOO((vals, idx),
+                                        shape=tuple(mask.shape)))
+
+
+def relu(x):
+    x = _as_coo(x)
+    return SparseCooTensor(jsparse.BCOO(
+        (jnp.maximum(x._bcoo.data, 0), x._bcoo.indices),
+        shape=x._bcoo.shape))
+
+
+def transpose(x, perm):
+    x = _as_coo(x)
+    return SparseCooTensor(x._bcoo.transpose(tuple(perm)))
+
+
+def coalesce(x):
+    return _as_coo(x).coalesce()
+
+
+class _SparseNN:
+    """paddle.sparse.nn namespace (ReLU etc.)."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+
+nn = _SparseNN()
+nn.ReLU = _SparseNN.ReLU

@@ -1,0 +1,110 @@
+"""DenseNet (ref: python/paddle/vision/models/densenet.py — capability
+parity)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...autograd.tape import apply_op
+from ...nn import functional as F
+from ...nn.layer.container import Sequential
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.common import Linear
+from ...nn.layer.layers import Layer
+from ...nn.layer.norm import BatchNorm2D
+from ...nn.layer.pooling import AdaptiveAvgPool2D, AvgPool2D, MaxPool2D
+from ...ops import manipulation as M
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CFG = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+    264: (64, 32, [6, 12, 64, 48]),
+}
+
+
+class DenseLayer(Layer):
+    def __init__(self, in_c, growth, bn_size=4):
+        super().__init__()
+        self.bn1 = BatchNorm2D(in_c)
+        self.conv1 = Conv2D(in_c, bn_size * growth, 1, bias_attr=False)
+        self.bn2 = BatchNorm2D(bn_size * growth)
+        self.conv2 = Conv2D(bn_size * growth, growth, 3, padding=1,
+                            bias_attr=False)
+
+    def forward(self, x):
+        out = self.conv1(F.relu(self.bn1(x)))
+        out = self.conv2(F.relu(self.bn2(out)))
+        return M.concat([x, out], axis=1)
+
+
+class Transition(Layer):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.bn = BatchNorm2D(in_c)
+        self.conv = Conv2D(in_c, out_c, 1, bias_attr=False)
+        self.pool = AvgPool2D(kernel_size=2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(F.relu(self.bn(x))))
+
+
+class DenseNet(Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        init_c, growth, blocks = _CFG[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        from ...nn.layer.activation import ReLU
+        self.stem = Sequential(
+            Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False),
+            BatchNorm2D(init_c), ReLU(),
+            MaxPool2D(kernel_size=3, stride=2, padding=1))
+        feats = []
+        c = init_c
+        for i, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(DenseLayer(c, growth, bn_size))
+                c += growth
+            if i != len(blocks) - 1:
+                feats.append(Transition(c, c // 2))
+                c //= 2
+        self.features = Sequential(*feats)
+        self.final_bn = BatchNorm2D(c)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.features(self.stem(x))
+        x = F.relu(self.final_bn(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(layers=121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(layers=161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(layers=169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(layers=201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return DenseNet(layers=264, **kwargs)

@@ -1,0 +1,237 @@
+"""MobileNet v1/v2/v3 (ref: python/paddle/vision/models/mobilenetv1.py,
+mobilenetv2.py, mobilenetv3.py — capability parity; depthwise convs are
+grouped XLA convolutions)."""
+from __future__ import annotations
+
+from ...nn import functional as F
+from ...nn.layer.activation import Hardsigmoid, Hardswish, ReLU, ReLU6
+from ...nn.layer.common import Dropout, Linear
+from ...nn.layer.container import Sequential
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.layers import Layer
+from ...nn.layer.norm import BatchNorm2D
+from ...nn.layer.pooling import AdaptiveAvgPool2D
+
+__all__ = ["MobileNetV1", "MobileNetV2", "MobileNetV3Small",
+           "MobileNetV3Large", "mobilenet_v1", "mobilenet_v2",
+           "mobilenet_v3_small", "mobilenet_v3_large"]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNLayer(Layer):
+    def __init__(self, in_c, out_c, k, stride=1, groups=1, act="relu"):
+        super().__init__()
+        self.conv = Conv2D(in_c, out_c, k, stride=stride,
+                           padding=(k - 1) // 2, groups=groups,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(out_c)
+        self.act = {"relu": F.relu, "relu6": F.relu6,
+                    "hardswish": F.hardswish, None: None}[act]
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act else x
+
+
+class MobileNetV1(Layer):
+    """ref mobilenetv1.py: depthwise-separable stacks."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        s = lambda c: int(c * scale)
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2), *[(512, 512, 1)] * 5,
+               (512, 1024, 2), (1024, 1024, 1)]
+        layers = [ConvBNLayer(3, s(32), 3, stride=2)]
+        for in_c, out_c, stride in cfg:
+            layers.append(ConvBNLayer(s(in_c), s(in_c), 3, stride=stride,
+                                      groups=s(in_c)))       # depthwise
+            layers.append(ConvBNLayer(s(in_c), s(out_c), 1)) # pointwise
+        self.features = Sequential(*layers)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+class InvertedResidual(Layer):
+    """v2 block (ref mobilenetv2.py)."""
+
+    def __init__(self, in_c, out_c, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(in_c * expand_ratio))
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNLayer(in_c, hidden, 1, act="relu6"))
+        layers.append(ConvBNLayer(hidden, hidden, 3, stride=stride,
+                                  groups=hidden, act="relu6"))
+        layers.append(ConvBNLayer(hidden, out_c, 1, act=None))
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_c = _make_divisible(32 * scale)
+        layers = [ConvBNLayer(3, in_c, 3, stride=2, act="relu6")]
+        for t, c, n, s in cfg:
+            out_c = _make_divisible(c * scale)
+            for i in range(n):
+                layers.append(InvertedResidual(in_c, out_c,
+                                               s if i == 0 else 1, t))
+                in_c = out_c
+        last = _make_divisible(1280 * max(1.0, scale))
+        layers.append(ConvBNLayer(in_c, last, 1, act="relu6"))
+        self.features = Sequential(*layers)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(Dropout(0.2),
+                                         Linear(last, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class SqueezeExcite(Layer):
+    def __init__(self, c, r=4):
+        super().__init__()
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc1 = Conv2D(c, _make_divisible(c // r), 1)
+        self.fc2 = Conv2D(_make_divisible(c // r), c, 1)
+
+    def forward(self, x):
+        s = self.pool(x)
+        s = F.relu(self.fc1(s))
+        s = F.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class V3Block(Layer):
+    def __init__(self, in_c, exp, out_c, k, stride, se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if exp != in_c:
+            layers.append(ConvBNLayer(in_c, exp, 1, act=act))
+        layers.append(ConvBNLayer(exp, exp, k, stride=stride, groups=exp,
+                                  act=act))
+        if se:
+            layers.append(SqueezeExcite(exp))
+        layers.append(ConvBNLayer(exp, out_c, 1, act=None))
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+_V3_SMALL = [
+    # k, exp, out, se, act, stride
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1)]
+
+_V3_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1)]
+
+
+class _MobileNetV3(Layer):
+    def __init__(self, cfg, last_exp, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        in_c = _make_divisible(16 * scale)
+        layers = [ConvBNLayer(3, in_c, 3, stride=2, act="hardswish")]
+        for k, exp, out_c, se, act, stride in cfg:
+            layers.append(V3Block(in_c, _make_divisible(exp * scale),
+                                  _make_divisible(out_c * scale), k, stride,
+                                  se, act))
+            in_c = _make_divisible(out_c * scale)
+        last_c = _make_divisible(last_exp * scale)
+        layers.append(ConvBNLayer(in_c, last_c, 1, act="hardswish"))
+        self.features = Sequential(*layers)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            out_f = 1024 if last_exp == 576 else 1280
+            self.classifier = Sequential(
+                Linear(last_c, out_f), Hardswish(), Dropout(0.2),
+                Linear(out_f, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, 576, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, 960, scale, num_classes, with_pool)
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)

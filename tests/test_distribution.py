@@ -1,0 +1,100 @@
+"""paddle.distribution: log_prob golden vs scipy-free closed forms,
+sampling moments, KL registry (ref: test/distribution/ suites)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def test_normal_log_prob_and_moments():
+    n = D.Normal(loc=1.0, scale=2.0)
+    x = np.array([0.0, 1.0, 3.0], np.float32)
+    lp = n.log_prob(paddle.to_tensor(x)).numpy()
+    ref = -((x - 1) ** 2) / 8 - np.log(2.0) - 0.5 * np.log(2 * np.pi)
+    np.testing.assert_allclose(lp, ref, rtol=1e-5)
+    paddle.seed(0)
+    s = n.sample([20000]).numpy()
+    assert abs(s.mean() - 1.0) < 0.05
+    assert abs(s.std() - 2.0) < 0.05
+    assert abs(float(n.entropy().numpy())
+               - (0.5 + 0.5 * np.log(2 * np.pi) + np.log(2.0))) < 1e-5
+
+
+def test_normal_log_prob_differentiable():
+    n = D.Normal(loc=0.0, scale=1.0)
+    x = paddle.to_tensor(np.array([0.5], np.float32))
+    x.stop_gradient = False
+    n.log_prob(x).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [-0.5], rtol=1e-5)
+
+
+def test_categorical():
+    logits = np.log(np.array([[0.2, 0.3, 0.5]], np.float32))
+    c = D.Categorical(logits=paddle.to_tensor(logits))
+    lp = c.log_prob(paddle.to_tensor(np.array([2]))).numpy()
+    np.testing.assert_allclose(lp, [np.log(0.5)], rtol=1e-5)
+    paddle.seed(0)
+    s = c.sample([4000]).numpy()
+    freq = np.bincount(s.ravel(), minlength=3) / s.size
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+    ent = c.entropy().numpy()
+    np.testing.assert_allclose(
+        ent, [-(0.2 * np.log(0.2) + 0.3 * np.log(0.3)
+                + 0.5 * np.log(0.5))], rtol=1e-5)
+
+
+def test_uniform_bernoulli_exponential():
+    u = D.Uniform(0.0, 4.0)
+    np.testing.assert_allclose(
+        u.log_prob(paddle.to_tensor([1.0])).numpy(), [np.log(0.25)],
+        rtol=1e-6)
+    b = D.Bernoulli(probs=0.3)
+    np.testing.assert_allclose(
+        b.log_prob(paddle.to_tensor([1.0])).numpy(), [np.log(0.3)],
+        rtol=1e-5)
+    e = D.Exponential(rate=2.0)
+    np.testing.assert_allclose(
+        e.log_prob(paddle.to_tensor([1.0])).numpy(),
+        [np.log(2.0) - 2.0], rtol=1e-5)
+
+
+def test_gamma_beta_dirichlet_log_prob():
+    from scipy import stats
+    g = D.Gamma(concentration=2.0, rate=3.0)
+    x = np.array([0.5, 1.5], np.float32)
+    np.testing.assert_allclose(
+        g.log_prob(paddle.to_tensor(x)).numpy(),
+        stats.gamma.logpdf(x, a=2.0, scale=1 / 3.0), rtol=1e-4)
+    be = D.Beta(alpha=2.0, beta=5.0)
+    xb = np.array([0.1, 0.7], np.float32)
+    np.testing.assert_allclose(
+        be.log_prob(paddle.to_tensor(xb)).numpy(),
+        stats.beta.logpdf(xb, 2.0, 5.0), rtol=1e-4)
+
+
+def test_kl_registry():
+    p = D.Normal(0.0, 1.0)
+    q = D.Normal(1.0, 2.0)
+    kl = float(D.kl_divergence(p, q).numpy())
+    ref = np.log(2.0) + (1 + 1) / 8 - 0.5
+    np.testing.assert_allclose(kl, ref, rtol=1e-5)
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(p, D.Gamma(1.0, 1.0))
+
+
+def test_poisson_laplace_gumbel():
+    from scipy import stats
+    po = D.Poisson(rate=3.0)
+    k = np.array([0.0, 2.0, 5.0], np.float32)
+    np.testing.assert_allclose(
+        po.log_prob(paddle.to_tensor(k)).numpy(),
+        stats.poisson.logpmf(k, 3.0), rtol=1e-4)
+    la = D.Laplace(0.0, 1.5)
+    np.testing.assert_allclose(
+        la.log_prob(paddle.to_tensor([1.0])).numpy(),
+        stats.laplace.logpdf(1.0, scale=1.5), rtol=1e-4)
+    gu = D.Gumbel(0.0, 2.0)
+    np.testing.assert_allclose(
+        gu.log_prob(paddle.to_tensor([0.5])).numpy(),
+        stats.gumbel_r.logpdf(0.5, scale=2.0), rtol=1e-4)

@@ -1,0 +1,109 @@
+"""paddle.sparse + paddle.quantization (ref: test/legacy_test sparse op
+tests; test/quantization QAT/PTQ tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu import sparse as S
+from paddle_tpu.quantization import PTQ, QAT, QuantConfig, quant_dequant
+
+
+def _coo():
+    idx = np.array([[0, 1, 2], [1, 2, 0]])
+    vals = np.array([1.0, 2.0, -3.0], np.float32)
+    return S.sparse_coo_tensor(idx, vals, shape=[3, 3])
+
+
+def test_coo_roundtrip():
+    sp = _coo()
+    dense = sp.to_dense().numpy()
+    ref = np.zeros((3, 3), np.float32)
+    ref[0, 1], ref[1, 2], ref[2, 0] = 1, 2, -3
+    np.testing.assert_array_equal(dense, ref)
+    assert sp.nnz == 3
+    assert S.is_sparse_coo(sp)
+
+
+def test_csr_conversion():
+    sp = _coo()
+    csr = sp.to_sparse_csr()
+    np.testing.assert_array_equal(csr.crows().numpy(), [0, 1, 2, 3])
+    np.testing.assert_array_equal(csr.cols().numpy(), [1, 2, 0])
+    back = csr.to_sparse_coo()
+    np.testing.assert_array_equal(back.to_dense().numpy(),
+                                  sp.to_dense().numpy())
+
+
+def test_sparse_matmul_and_ops():
+    sp = _coo()
+    d = np.random.randn(3, 4).astype(np.float32)
+    out = S.matmul(sp, paddle.to_tensor(d))
+    np.testing.assert_allclose(out.numpy(), sp.to_dense().numpy() @ d,
+                               rtol=1e-6)
+    r = S.relu(sp)
+    assert float(r.to_dense().numpy().min()) >= 0
+    s2 = S.add(sp, sp)
+    np.testing.assert_allclose(s2.to_dense().numpy(),
+                               2 * sp.to_dense().numpy())
+
+
+def test_masked_matmul():
+    a = np.random.randn(3, 5).astype(np.float32)
+    b = np.random.randn(5, 3).astype(np.float32)
+    mask = _coo()
+    out = S.masked_matmul(paddle.to_tensor(a), paddle.to_tensor(b), mask)
+    dense = a @ b
+    got = out.to_dense().numpy()
+    ref = np.zeros_like(got)
+    ref[0, 1], ref[1, 2], ref[2, 0] = dense[0, 1], dense[1, 2], dense[2, 0]
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_quant_dequant_ste():
+    x = paddle.to_tensor(np.linspace(-1, 1, 11).astype(np.float32))
+    x.stop_gradient = False
+    y = quant_dequant(x, 1.0, bits=8)
+    # quantization error bounded by scale/qmax
+    assert float(np.abs(y.numpy() - x.numpy()).max()) <= 1.0 / 127 + 1e-6
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(11), rtol=1e-6)
+
+
+def test_qat_wraps_and_trains():
+    paddle.seed(0)
+    np.random.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    qat = QAT(QuantConfig())
+    qm = qat.quantize(m)
+    from paddle_tpu.quantization import QuantedLinear
+    assert isinstance(qm[0], QuantedLinear)
+    o = opt.Adam(learning_rate=0.01, parameters=qm.parameters())
+    x = paddle.to_tensor(np.random.randn(16, 8).astype(np.float32))
+    y = paddle.to_tensor(np.random.randn(16, 4).astype(np.float32))
+    losses = []
+    for _ in range(20):
+        loss = F.mse_loss(qm(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(loss.item())
+    assert losses[-1] < losses[0]
+    qat.convert(qm)
+
+
+def test_ptq_calibration():
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 4))
+    ptq = PTQ()
+    qm = ptq.quantize(m)
+    x = paddle.to_tensor(np.random.randn(32, 8).astype(np.float32))
+    qm(x)  # calibration pass observes scales
+    from paddle_tpu.quantization import QuantedLinear
+    assert qm[0].a_fq.observer.scale() > 0
+    ptq.convert(qm)
+    out1 = qm(x).numpy()
+    out2 = qm(x).numpy()
+    np.testing.assert_array_equal(out1, out2)
