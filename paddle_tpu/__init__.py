@@ -83,6 +83,9 @@ from . import distribution  # noqa: F401,E402
 from . import sparse      # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
 from . import models      # noqa: F401,E402
+from . import signal      # noqa: F401,E402
+from . import geometric   # noqa: F401,E402
+from . import audio       # noqa: F401,E402
 from . import profiler    # noqa: F401,E402
 from . import incubate    # noqa: F401,E402
 from .hapi import Model   # noqa: F401,E402

@@ -1,0 +1,5 @@
+"""paddle.audio — features + functional (ref: python/paddle/audio/:
+features/layers.py Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC,
+functional/window.py get_window, functional/functional.py mel utils)."""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401

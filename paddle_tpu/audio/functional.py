@@ -1,0 +1,110 @@
+"""paddle.audio.functional (ref: python/paddle/audio/functional/ —
+get_window, hz_to_mel, mel_to_hz, mel_frequencies, compute_fbank_matrix,
+power_to_db, create_dct)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["get_window", "hz_to_mel", "mel_to_hz", "mel_frequencies",
+           "fft_frequencies", "compute_fbank_matrix", "power_to_db",
+           "create_dct"]
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    if isinstance(window, tuple):
+        window, *args = window
+    n = win_length
+    m = n if fftbins else n - 1
+    t = np.arange(n)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * t / m)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * t / m)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * t / m)
+             + 0.08 * np.cos(4 * np.pi * t / m))
+    elif window in ("rect", "boxcar", "ones"):
+        w = np.ones(n)
+    elif window == "bartlett":
+        w = 1 - np.abs(2 * t / m - 1)
+    else:
+        raise ValueError(f"unknown window {window!r}")
+    return Tensor(jnp.asarray(w, jnp.float32))
+
+
+def hz_to_mel(freq, htk=False):
+    if htk:
+        return 2595.0 * np.log10(1.0 + np.asarray(freq) / 700.0)
+    f = np.asarray(freq, np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mel = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(f >= min_log_hz,
+                    min_log_mel + np.log(f / min_log_hz) / logstep, mel)
+
+
+def mel_to_hz(mel, htk=False):
+    if htk:
+        return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+    m = np.asarray(mel, np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freq = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(m >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (m - min_log_mel)), freq)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return Tensor(jnp.asarray(mel_to_hz(mels, htk), jnp.float32))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2, dtype=jnp.float32))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    f_max = f_max or sr / 2
+    fft_f = np.linspace(0, sr / 2, 1 + n_fft // 2)
+    mel_f = np.asarray(mel_to_hz(
+        np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                    n_mels + 2), htk))
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    fb = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        fb *= enorm[:, None]
+    return Tensor(jnp.asarray(fb, jnp.float32))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    s = spect.data if isinstance(spect, Tensor) else jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    dct = np.cos(math.pi / n_mels * (n + 0.5) * k)
+    if norm == "ortho":
+        dct[0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    return Tensor(jnp.asarray(dct.T, jnp.float32))

@@ -1,0 +1,15 @@
+"""Semi-auto / auto parallel API (ref: python/paddle/distributed/
+auto_parallel/ — ProcessMesh/shard_tensor/reshard semi-auto API in api.py,
+static Engine in static/engine.py:61, Strategy in strategy.py).
+
+TPU-native: DistTensor == jax.Array with NamedSharding (sharding.py);
+SPMD rules == GSPMD propagation; the Engine compiles fit/evaluate through
+TrainStep+ShardingPlan instead of completion/partitioner/reshard passes."""
+from ..sharding import (  # noqa: F401
+    Partial, Placement, ProcessMesh, Replicate, Shard, dtensor_from_fn,
+    reshard, shard_tensor)
+from .engine import Engine, Strategy  # noqa: F401
+
+__all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+           "shard_tensor", "reshard", "dtensor_from_fn", "Engine",
+           "Strategy"]

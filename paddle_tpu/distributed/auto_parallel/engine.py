@@ -1,0 +1,127 @@
+"""Auto-parallel Engine (ref: python/paddle/distributed/auto_parallel/
+static/engine.py:61 Engine, fit :991, prepare :1555; strategy.py Strategy).
+
+The reference's Engine runs completion (dist-attr propagation) +
+partitioner + reshard passes over a static program. Under GSPMD the
+completion/partition/reshard pipeline IS the XLA SPMD partitioner, so the
+Engine here: builds the mesh from the strategy, wraps the model+optimizer
+in a sharded TrainStep, and drives fit/evaluate/predict."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...tensor import Tensor
+
+__all__ = ["Engine", "Strategy"]
+
+
+class Strategy:
+    """ref auto_parallel/strategy.py — config container."""
+
+    def __init__(self, config=None):
+        config = config or {}
+        self.auto_mode = config.get("auto_mode", "semi")
+        sharding = config.get("sharding", {})
+        self.sharding_degree = sharding.get("degree", 1)
+        self.sharding_stage = sharding.get("stage", 2)
+        self.mp_degree = config.get("mp_degree", 1)
+        self.pp_degree = config.get("pp_degree", 1)
+        self.dp_degree = config.get("dp_degree", -1)
+        self.amp = config.get("amp", {}).get("enable", False)
+        self.recompute = config.get("recompute", {}).get("enable", False)
+        self.gradient_merge = config.get("gradient_merge", {})
+
+
+class Engine:
+    """ref static/engine.py Engine(model, loss, optimizer, metrics,
+    strategy)."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy: Optional[Strategy] = None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics or []
+        self.strategy = strategy or Strategy()
+        self._step = None
+        self._mesh = None
+
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        from ..topology import HybridCommunicateGroup, set_mesh
+        s = self.strategy
+        hcg = HybridCommunicateGroup(
+            dp_degree=s.dp_degree, mp_degree=s.mp_degree,
+            pp_degree=s.pp_degree, sharding_degree=s.sharding_degree)
+        self._mesh = hcg.mesh
+        set_mesh(hcg.mesh)
+
+        from ... import jit as pjit
+        from ..sharding import ShardingPlan
+
+        model, loss_fn = self.model, self.loss
+
+        def step_fn(*batch):
+            *xs, y = batch
+            out = model(*xs)
+            return loss_fn(out, y)
+
+        plan = ShardingPlan(self._mesh, stage=s.sharding_stage)
+        self._step = pjit.TrainStep(model, self.optimizer, step_fn,
+                                    shard=plan)
+        return self
+
+    def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
+            log_freq=10, verbose=0, **kw):
+        if self._step is None:
+            self.prepare()
+        from ...io import DataLoader, Dataset
+        loader = (train_data if isinstance(train_data, DataLoader)
+                  else DataLoader(train_data, batch_size=batch_size,
+                                  shuffle=True))
+        history = {"loss": []}
+        for ep in range(epochs):
+            for i, batch in enumerate(loader):
+                if steps_per_epoch is not None and i >= steps_per_epoch:
+                    break
+                xs, y = batch[:-1], batch[-1]
+                loss = self._step(*xs, y)
+                history["loss"].append(float(loss.numpy()))
+                if verbose and i % log_freq == 0:
+                    print(f"epoch {ep} step {i}: loss "
+                          f"{history['loss'][-1]:.4f}")
+        return history
+
+    def evaluate(self, valid_data, batch_size=1, **kw):
+        from ...framework import core
+        from ...io import DataLoader
+        loader = (valid_data if isinstance(valid_data, DataLoader)
+                  else DataLoader(valid_data, batch_size=batch_size))
+        losses = []
+        with core.no_grad_guard():
+            for batch in loader:
+                xs, y = batch[:-1], batch[-1]
+                losses.append(float(self.loss(self.model(*xs), y).numpy()))
+        return {"loss": float(np.mean(losses))}
+
+    def predict(self, test_data, batch_size=1, **kw):
+        from ...framework import core
+        from ...io import DataLoader
+        loader = (test_data if isinstance(test_data, DataLoader)
+                  else DataLoader(test_data, batch_size=batch_size))
+        outs = []
+        with core.no_grad_guard():
+            for batch in loader:
+                xs = batch if not isinstance(batch, (list, tuple)) \
+                    else batch[:-1]
+                outs.append(self.model(*xs))
+        return outs
+
+    def save(self, path, training=True):
+        from .. import checkpoint as dck
+        dck.save_state_dict(dict(self.model.state_dict()), path)
+
+    def load(self, path, strict=True, load_optimizer=True):
+        from .. import checkpoint as dck
+        dck.load_state_dict(dict(self.model.state_dict()), path)

@@ -1,0 +1,108 @@
+"""Launch-time auto-tuner (ref: python/paddle/distributed/auto_tuner/ —
+tuner.py:21 AutoTuner grid search over dp/mp/pp/sharding/micro-batch
+configs, prune.py pruning rules, utils.py candidate generation).
+
+TPU-native: candidates are mesh factorizations of the device count;
+pruning uses divisibility + memory estimates; trials run a user-provided
+`trial_fn(config) -> metric` (typically a few compiled train steps) in
+process — no subprocess relaunch needed under single-controller JAX."""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["AutoTuner", "default_candidates", "prune_by_memory",
+           "prune_by_divisibility"]
+
+
+@dataclass
+class TrialResult:
+    config: Dict
+    metric: Optional[float]
+    error: Optional[str] = None
+
+
+def default_candidates(n_devices: int, model_layers: int = 0,
+                       max_mp: int = 8, max_pp: int = 8):
+    """All (dp, mp, pp, sharding, micro_bsz) factorizations of n_devices
+    (ref utils.py gen candidates)."""
+    out = []
+    for mp, pp in itertools.product(range(1, max_mp + 1),
+                                    range(1, max_pp + 1)):
+        if n_devices % (mp * pp):
+            continue
+        rest = n_devices // (mp * pp)
+        for sharding in [d for d in range(1, rest + 1) if rest % d == 0]:
+            dp = rest // sharding
+            for micro in (1, 2, 4, 8):
+                out.append(dict(dp_degree=dp, mp_degree=mp, pp_degree=pp,
+                                sharding_degree=sharding,
+                                micro_batch_size=micro))
+    return out
+
+
+def prune_by_divisibility(cands, hidden_size=None, num_heads=None,
+                          num_layers=None, global_batch=None):
+    """ref prune.py — drop configs that cannot partition the model."""
+    kept = []
+    for c in cands:
+        mp, pp = c["mp_degree"], c["pp_degree"]
+        if num_heads and num_heads % mp:
+            continue
+        if hidden_size and hidden_size % mp:
+            continue
+        if num_layers and pp > 1 and num_layers % pp:
+            continue
+        if global_batch:
+            ways = c["dp_degree"] * c["sharding_degree"]
+            if global_batch % ways:
+                continue
+            if (global_batch // ways) % c["micro_batch_size"]:
+                continue
+        kept.append(c)
+    return kept
+
+
+def prune_by_memory(cands, param_bytes, hbm_bytes_per_chip,
+                    optimizer_factor=6.0):
+    """Reject configs whose per-chip (param+grad+optstate) estimate exceeds
+    HBM: params split over mp*pp*sharding (stage-3 semantics)."""
+    kept = []
+    for c in cands:
+        split = (c["mp_degree"] * c["pp_degree"] * c["sharding_degree"])
+        need = param_bytes * optimizer_factor / split
+        if need <= hbm_bytes_per_chip * 0.9:
+            kept.append(c)
+    return kept
+
+
+class AutoTuner:
+    """ref tuner.py AutoTuner — iterate candidates, run trials, keep best.
+
+    metric_mode: 'max' (throughput) or 'min' (step time)."""
+
+    def __init__(self, candidates: List[Dict],
+                 trial_fn: Callable[[Dict], float],
+                 metric_mode: str = "max", max_trials: Optional[int] = None):
+        self.candidates = list(candidates)
+        self.trial_fn = trial_fn
+        self.metric_mode = metric_mode
+        self.max_trials = max_trials or len(self.candidates)
+        self.history: List[TrialResult] = []
+
+    def tune(self):
+        for cfg in self.candidates[: self.max_trials]:
+            try:
+                metric = float(self.trial_fn(cfg))
+                self.history.append(TrialResult(cfg, metric))
+            except Exception as e:  # failed trial: recorded, not fatal
+                self.history.append(TrialResult(cfg, None, str(e)))
+        return self.best()
+
+    def best(self):
+        ok = [t for t in self.history if t.metric is not None]
+        if not ok:
+            return None
+        key = (max if self.metric_mode == "max" else min)
+        return key(ok, key=lambda t: t.metric)

@@ -1,0 +1,110 @@
+"""paddle.geometric — graph message passing + segment ops
+(ref: python/paddle/geometric/: send_u_recv/send_ue_recv message_passing,
+segment_sum/mean/max/min math; C++ graph_send_recv kernels).
+
+TPU-native: all routed through jax segment ops (XLA scatter-reduce)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.tape import apply_op
+from ..ops._helpers import to_tensor_like, unwrap
+from ..tensor import Tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min"]
+
+_SEG = {
+    "sum": jax.ops.segment_sum if hasattr(jax.ops, "segment_sum") else None,
+}
+
+
+def _segment(data, ids, num, pool):
+    if pool == "sum":
+        return jax.ops.segment_sum(data, ids, num)
+    if pool == "mean":
+        s = jax.ops.segment_sum(data, ids, num)
+        c = jax.ops.segment_sum(jnp.ones_like(ids, jnp.float32), ids, num)
+        return s / jnp.maximum(c, 1.0).reshape((-1,) + (1,) * (s.ndim - 1))
+    if pool == "max":
+        return jax.ops.segment_max(data, ids, num)
+    if pool == "min":
+        return jax.ops.segment_min(data, ids, num)
+    raise ValueError(pool)
+
+
+def _finite(x, pool):
+    """segment_max/min yield +-inf for empty segments; paddle zeros them."""
+    if pool in ("max", "min"):
+        return jnp.where(jnp.isfinite(x), x, 0.0)
+    return x
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """ref geometric/message_passing/send_recv.py:33 — gather src features,
+    scatter-reduce onto dst nodes."""
+    xt = to_tensor_like(x)
+    src = jnp.asarray(unwrap(src_index), jnp.int32)
+    dst = jnp.asarray(unwrap(dst_index), jnp.int32)
+
+    def f(a):
+        n = out_size if out_size is not None else a.shape[0]
+        msgs = jnp.take(a, src, axis=0)
+        return _finite(_segment(msgs, dst, n, reduce_op), reduce_op)
+
+    return apply_op(f, xt, name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """ref send_recv.py send_ue_recv — combine node + edge features."""
+    xt = to_tensor_like(x)
+    yt = to_tensor_like(y)
+    src = jnp.asarray(unwrap(src_index), jnp.int32)
+    dst = jnp.asarray(unwrap(dst_index), jnp.int32)
+    comb = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+            "div": jnp.divide}[message_op]
+
+    def f(a, e):
+        n = out_size if out_size is not None else a.shape[0]
+        msgs = comb(jnp.take(a, src, axis=0), e)
+        return _finite(_segment(msgs, dst, n, reduce_op), reduce_op)
+
+    return apply_op(f, xt, yt, name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """ref — per-edge message from both endpoints (no reduce)."""
+    xt = to_tensor_like(x)
+    yt = to_tensor_like(y)
+    src = jnp.asarray(unwrap(src_index), jnp.int32)
+    dst = jnp.asarray(unwrap(dst_index), jnp.int32)
+    comb = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+            "div": jnp.divide}[message_op]
+
+    def f(a, b):
+        return comb(jnp.take(a, src, axis=0), jnp.take(b, dst, axis=0))
+
+    return apply_op(f, xt, yt, name="send_uv")
+
+
+def _segment_api(pool):
+    def op(data, segment_ids, name=None):
+        dt = to_tensor_like(data)
+        ids = jnp.asarray(unwrap(segment_ids), jnp.int32)
+        num = int(jnp.max(ids)) + 1 if ids.size else 0
+
+        def f(a):
+            return _finite(_segment(a, ids, num, pool), pool)
+
+        return apply_op(f, dt, name=f"segment_{pool}")
+    op.__name__ = f"segment_{pool}"
+    return op
+
+
+segment_sum = _segment_api("sum")
+segment_mean = _segment_api("mean")
+segment_max = _segment_api("max")
+segment_min = _segment_api("min")

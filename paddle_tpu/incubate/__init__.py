@@ -3,3 +3,4 @@ MoE, ASP). MoE lives in incubate.distributed.models.moe; fused functional
 ops in incubate.nn.functional."""
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
+from . import asp  # noqa: F401

@@ -1,0 +1,100 @@
+"""ASP — 2:4 structured sparsity (ref: python/paddle/incubate/asp/asp.py —
+prune_model, decorate, mask computation utils; fleet asp_optimizer).
+
+TPU note: XLA:TPU has no 2:4 sparse MXU mode (that's an Ampere tensor-core
+feature), so ASP here delivers the PRUNING semantics — 2:4 masks computed
+and enforced through training (mask re-applied after each optimizer step
+by the decorated optimizer) — with dense execution. The API matches, the
+model you get is genuinely 2:4-sparse."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...nn.layer.layers import Layer
+from ...tensor import Tensor
+
+__all__ = ["calculate_density", "create_mask", "check_mask_2d",
+           "prune_model", "decorate", "reset_excluded_layers",
+           "set_excluded_layers"]
+
+_EXCLUDED: set = set()
+_MASKS: Dict[int, jnp.ndarray] = {}
+
+
+def calculate_density(x) -> float:
+    a = np.asarray(x.data if isinstance(x, Tensor) else x)
+    return float((a != 0).sum() / a.size)
+
+
+def create_mask(weight, func_name="mask_2d_best", n=2, m=4):
+    """2:4 mask along the last dim: keep the n largest-|w| of every m."""
+    a = np.asarray(weight.data if isinstance(weight, Tensor) else weight)
+    orig = a.shape
+    if a.ndim < 2 or a.shape[-1] % m:
+        return np.ones_like(a)
+    flat = np.abs(a).reshape(-1, m)
+    keep = np.argsort(-flat, axis=1)[:, :n]
+    mask = np.zeros_like(flat)
+    np.put_along_axis(mask, keep, 1.0, axis=1)
+    return mask.reshape(orig).astype(a.dtype)
+
+
+def check_mask_2d(mat, n=2, m=4) -> bool:
+    a = np.asarray(mat.data if isinstance(mat, Tensor) else mat)
+    if a.shape[-1] % m:
+        return False
+    groups = (a.reshape(-1, m) != 0).sum(axis=1)
+    return bool((groups <= n).all())
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def _prunable(name, p):
+    return (p.data.ndim == 2 and not p.stop_gradient
+            and p.shape[-1] % 4 == 0
+            and not any(ex in name for ex in _EXCLUDED))
+
+
+def prune_model(model: Layer, n=2, m=4, mask_algo="mask_2d_best",
+                with_mask=True):
+    """ref asp.py prune_model — compute + apply 2:4 masks to eligible
+    weights; masks retained for training enforcement."""
+    masks = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        mask = jnp.asarray(create_mask(p, mask_algo, n, m))
+        p.data = p.data * mask
+        _MASKS[id(p)] = mask
+        masks[name] = mask
+    return masks
+
+
+def decorate(optimizer):
+    """ref asp.py decorate — optimizer wrapper that re-applies masks after
+    every step so pruned weights stay zero through training."""
+
+    class ASPOptimizer:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, k):
+            return getattr(self.__dict__["_inner"], k)
+
+        def step(self):
+            self._inner.step()
+            for p in getattr(self._inner, "_parameter_list", []) or []:
+                mask = _MASKS.get(id(p))
+                if mask is not None:
+                    p.data = p.data * mask
+
+    return ASPOptimizer(optimizer)

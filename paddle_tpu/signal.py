@@ -1,0 +1,127 @@
+"""paddle.signal — stft/istft (ref: python/paddle/signal.py; C++ frame/
+overlap_add ops phi/kernels/frame_kernel.* overlap_add_kernel.*).
+
+TPU-native: framing is a gather (XLA lowers to efficient slices), FFT is
+XLA's; everything is differentiable through the tape."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .autograd.tape import apply_op
+from .ops._helpers import to_tensor_like, unwrap
+from .tensor import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """[..., T] -> [..., frame_length, n_frames] (axis=-1 case; ref
+    signal.py frame)."""
+    xt = to_tensor_like(x)
+
+    def f(a):
+        T = a.shape[-1]
+        n = 1 + (T - frame_length) // hop_length
+        starts = jnp.arange(n) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None]   # [n, L]
+        out = jnp.take(a, idx, axis=-1)                          # [..., n, L]
+        return jnp.swapaxes(out, -1, -2)                         # [..., L, n]
+
+    return apply_op(f, xt, name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """[..., frame_length, n_frames] -> [..., T] (inverse of frame)."""
+    xt = to_tensor_like(x)
+
+    def f(a):
+        L, n = a.shape[-2], a.shape[-1]
+        T = (n - 1) * hop_length + L
+        frames = jnp.swapaxes(a, -1, -2)                        # [..., n, L]
+        out = jnp.zeros(a.shape[:-2] + (T,), a.dtype)
+        starts = jnp.arange(n) * hop_length
+        idx = starts[:, None] + jnp.arange(L)[None]             # [n, L]
+        flat_idx = idx.reshape(-1)
+        flat_frames = frames.reshape(frames.shape[:-2] + (-1,))
+        return out.at[..., flat_idx].add(flat_frames)
+
+    return apply_op(f, xt, name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """ref signal.py stft — returns [..., n_fft//2+1 or n_fft, n_frames]
+    complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    xt = to_tensor_like(x)
+    if window is not None:
+        w = jnp.asarray(unwrap(window), jnp.float32)
+    else:
+        w = jnp.ones(win_length, jnp.float32)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+
+    def f(a):
+        if center:
+            pads = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            a = jnp.pad(a, pads, mode=pad_mode)
+        T = a.shape[-1]
+        n = 1 + (T - n_fft) // hop_length
+        starts = jnp.arange(n) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None]
+        frames = jnp.take(a, idx, axis=-1)            # [..., n, n_fft]
+        frames = frames * w
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))    # [..., n, F]
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        return jnp.swapaxes(spec, -1, -2)             # [..., F, n]
+
+    return apply_op(f, xt, name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """ref signal.py istft — window-weighted overlap-add inverse."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    xt = to_tensor_like(x)
+    if window is not None:
+        w = jnp.asarray(unwrap(window), jnp.float32)
+    else:
+        w = jnp.ones(win_length, jnp.float32)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+
+    def f(spec):
+        sp = jnp.swapaxes(spec, -1, -2)               # [..., n, F]
+        if normalized:
+            sp = sp * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = (jnp.fft.irfft(sp, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(sp, axis=-1).real)
+        frames = frames * w
+        n = frames.shape[-2]
+        T = (n - 1) * hop_length + n_fft
+        starts = jnp.arange(n) * hop_length
+        idx = (starts[:, None] + jnp.arange(n_fft)[None]).reshape(-1)
+        out = jnp.zeros(frames.shape[:-2] + (T,), frames.dtype)
+        out = out.at[..., idx].add(
+            frames.reshape(frames.shape[:-2] + (-1,)))
+        wsq = jnp.zeros(T, jnp.float32).at[idx].add(
+            jnp.tile(w ** 2, n))
+        out = out / jnp.maximum(wsq, 1e-11)
+        if center:
+            out = out[..., n_fft // 2: T - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return apply_op(f, xt, name="istft")

@@ -1,2 +1,5 @@
 """paddle.vision (ref: python/paddle/vision/)."""
 from . import models  # noqa: F401
+
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401

@@ -1,0 +1,147 @@
+"""paddle.vision.datasets (ref: python/paddle/vision/datasets/ — MNIST,
+FashionMNIST, Cifar10/100, Flowers, VOC...). This container has zero
+egress, so `download=True` raises with instructions; datasets load from
+local files in the reference's formats, and `FakeData` provides a
+synthetic drop-in for pipelines/tests."""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+def _no_download(name):
+    raise RuntimeError(
+        f"{name}: this environment has no network egress; place the "
+        f"dataset files locally and pass their path (image_path/data_file), "
+        f"or use paddle_tpu.vision.datasets.FakeData for synthetic data")
+
+
+class FakeData(Dataset):
+    """Synthetic image dataset (deterministic per index)."""
+
+    def __init__(self, size=256, image_shape=(3, 32, 32), num_classes=10,
+                 transform: Optional[Callable] = None):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(idx)
+        img = rng.standard_normal(self.image_shape).astype(np.float32)
+        label = np.int64(idx % self.num_classes)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class MNIST(Dataset):
+    """ref vision/datasets/mnist.py — idx-ubyte format loader."""
+
+    NAME = "MNIST"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if image_path is None or label_path is None:
+            if download:
+                _no_download(self.NAME)
+            raise ValueError(f"{self.NAME}: provide image_path/label_path")
+        self.transform = transform
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") \
+            else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            data = f.read()
+        n = int.from_bytes(data[4:8], "big")
+        h = int.from_bytes(data[8:12], "big")
+        w = int.from_bytes(data[12:16], "big")
+        return np.frombuffer(data, np.uint8, offset=16).reshape(n, h, w)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            data = f.read()
+        return np.frombuffer(data, np.uint8, offset=8)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])
+
+
+class FashionMNIST(MNIST):
+    NAME = "FashionMNIST"
+
+
+class Cifar10(Dataset):
+    """ref vision/datasets/cifar.py — python-pickle batch format."""
+
+    N_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file is None:
+            if download:
+                _no_download(type(self).__name__)
+            raise ValueError("provide data_file (cifar tar.gz or batch dir)")
+        self.transform = transform
+        self.mode = mode
+        self.images, self.labels = self._load(data_file)
+
+    def _load(self, path):
+        imgs, labels = [], []
+        key = b"labels" if self.N_CLASSES == 10 else b"fine_labels"
+        if path.endswith((".tar.gz", ".tgz", ".tar")):
+            with tarfile.open(path) as tar:
+                names = [m for m in tar.getmembers()
+                         if ("data_batch" in m.name if self.mode == "train"
+                             else "test_batch" in m.name)]
+                for m in sorted(names, key=lambda m: m.name):
+                    d = pickle.loads(tar.extractfile(m).read(),
+                                     encoding="bytes")
+                    imgs.append(d[b"data"])
+                    labels.extend(d[key])
+        else:
+            for fname in sorted(os.listdir(path)):
+                if (self.mode == "train") != ("data_batch" in fname):
+                    continue
+                with open(os.path.join(path, fname), "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                imgs.append(d[b"data"])
+                labels.extend(d[key])
+        images = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+        return images, np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class Cifar100(Cifar10):
+    N_CLASSES = 100

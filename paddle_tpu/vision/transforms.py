@@ -1,0 +1,293 @@
+"""paddle.vision.transforms (ref: python/paddle/vision/transforms/ —
+Compose + class transforms + functional). Host-side numpy preprocessing
+(the TPU pipeline does per-batch device transforms inside jit; these run
+in DataLoader workers)."""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Pad", "Transpose", "BrightnessTransform", "ContrastTransform",
+           "RandomRotation", "Grayscale", "to_tensor", "normalize",
+           "resize", "center_crop", "crop", "hflip", "vflip", "pad"]
+
+
+def _to_np(img):
+    if isinstance(img, Tensor):
+        return img.numpy()
+    return np.asarray(img)
+
+
+def _is_chw(img):
+    return img.ndim == 3 and img.shape[0] in (1, 3, 4) \
+        and img.shape[0] < img.shape[-1]
+
+
+# -- functional --------------------------------------------------------------
+
+def to_tensor(img, data_format="CHW"):
+    a = _to_np(img)
+    if a.dtype == np.uint8:
+        a = a.astype(np.float32) / 255.0
+    if a.ndim == 2:
+        a = a[None] if data_format == "CHW" else a[..., None]
+    elif data_format == "CHW" and not _is_chw(a):
+        a = np.transpose(a, (2, 0, 1))
+    return Tensor(a.astype(np.float32))
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    a = _to_np(img).astype(np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        a = (a - mean[:, None, None]) / std[:, None, None]
+    else:
+        a = (a - mean) / std
+    return Tensor(a) if isinstance(img, Tensor) else a
+
+
+def resize(img, size, interpolation="bilinear"):
+    a = _to_np(img)
+    chw = _is_chw(a)
+    if chw:
+        a = np.transpose(a, (1, 2, 0))
+    if isinstance(size, numbers.Number):
+        h, w = a.shape[:2]
+        if h < w:
+            size = (int(size), int(size * w / h))
+        else:
+            size = (int(size * h / w), int(size))
+    out_h, out_w = size
+    in_h, in_w = a.shape[:2]
+    if interpolation == "nearest":
+        ri = (np.arange(out_h) * in_h / out_h).astype(int).clip(0, in_h - 1)
+        ci = (np.arange(out_w) * in_w / out_w).astype(int).clip(0, in_w - 1)
+        out = a[ri][:, ci]
+    else:  # bilinear
+        ry = (np.arange(out_h) + 0.5) * in_h / out_h - 0.5
+        rx = (np.arange(out_w) + 0.5) * in_w / out_w - 0.5
+        y0 = np.clip(np.floor(ry).astype(int), 0, in_h - 1)
+        x0 = np.clip(np.floor(rx).astype(int), 0, in_w - 1)
+        y1 = np.clip(y0 + 1, 0, in_h - 1)
+        x1 = np.clip(x0 + 1, 0, in_w - 1)
+        wy = (ry - y0).clip(0, 1)[:, None, None]
+        wx = (rx - x0).clip(0, 1)[None, :, None]
+        af = a.astype(np.float32)
+        if af.ndim == 2:
+            af = af[..., None]
+        out = (af[y0][:, x0] * (1 - wy) * (1 - wx)
+               + af[y1][:, x0] * wy * (1 - wx)
+               + af[y0][:, x1] * (1 - wy) * wx
+               + af[y1][:, x1] * wy * wx)
+        if a.ndim == 2:
+            out = out[..., 0]
+        out = out.astype(a.dtype) if a.dtype != np.uint8 else \
+            np.clip(out + 0.5, 0, 255).astype(np.uint8)
+    if chw:
+        out = np.transpose(out, (2, 0, 1))
+    return out
+
+
+def crop(img, top, left, height, width):
+    a = _to_np(img)
+    if _is_chw(a):
+        return a[:, top:top + height, left:left + width]
+    return a[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    a = _to_np(img)
+    h, w = (a.shape[1:] if _is_chw(a) else a.shape[:2])
+    th, tw = output_size
+    return crop(a, (h - th) // 2, (w - tw) // 2, th, tw)
+
+
+def hflip(img):
+    a = _to_np(img)
+    return a[:, :, ::-1] if _is_chw(a) else a[:, ::-1]
+
+
+def vflip(img):
+    a = _to_np(img)
+    return a[:, ::-1, :] if _is_chw(a) else a[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    a = _to_np(img)
+    if isinstance(padding, numbers.Number):
+        padding = (padding,) * 4
+    if len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    l, t, r, b = padding
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    if _is_chw(a):
+        return np.pad(a, ((0, 0), (t, b), (l, r)), mode=mode, **kw)
+    pads = ((t, b), (l, r)) + (((0, 0),) if a.ndim == 3 else ())
+    return np.pad(a, pads, mode=mode, **kw)
+
+
+# -- class transforms --------------------------------------------------------
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop:
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def __call__(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def __call__(self, img):
+        a = _to_np(img)
+        if self.padding is not None:
+            a = pad(a, self.padding, self.fill, self.padding_mode)
+        h, w = (a.shape[1:] if _is_chw(a) else a.shape[:2])
+        th, tw = self.size
+        top = random.randint(0, max(0, h - th))
+        left = random.randint(0, max(0, w - tw))
+        return crop(a, top, left, th, tw)
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        return hflip(img) if random.random() < self.prob else _to_np(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        return vflip(img) if random.random() < self.prob else _to_np(img)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding, self.fill, self.mode = padding, fill, padding_mode
+
+    def __call__(self, img):
+        return pad(img, self.padding, self.fill, self.mode)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        return np.transpose(_to_np(img), self.order)
+
+
+class BrightnessTransform:
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def __call__(self, img):
+        a = _to_np(img).astype(np.float32)
+        f = 1 + random.uniform(-self.value, self.value)
+        return np.clip(a * f, 0, 255 if a.max() > 1 else 1).astype(
+            _to_np(img).dtype)
+
+
+class ContrastTransform:
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def __call__(self, img):
+        a = _to_np(img).astype(np.float32)
+        f = 1 + random.uniform(-self.value, self.value)
+        m = a.mean()
+        return np.clip((a - m) * f + m, 0,
+                       255 if a.max() > 1 else 1).astype(_to_np(img).dtype)
+
+
+class RandomRotation:
+    """90-degree-multiple rotation (full affine omitted: host preprocessing
+    for TPU pipelines keeps to array ops)."""
+
+    def __init__(self, degrees, keys=None):
+        self.degrees = degrees
+
+    def __call__(self, img):
+        a = _to_np(img)
+        k = random.randint(0, 3)
+        axes = (1, 2) if _is_chw(a) else (0, 1)
+        return np.rot90(a, k, axes=axes).copy()
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1, keys=None):
+        self.n = num_output_channels
+
+    def __call__(self, img):
+        a = _to_np(img).astype(np.float32)
+        if _is_chw(a):
+            g = (0.299 * a[0] + 0.587 * a[1] + 0.114 * a[2])[None]
+            return np.repeat(g, self.n, 0).astype(_to_np(img).dtype)
+        g = (0.299 * a[..., 0] + 0.587 * a[..., 1]
+             + 0.114 * a[..., 2])[..., None]
+        return np.repeat(g, self.n, -1).astype(_to_np(img).dtype)

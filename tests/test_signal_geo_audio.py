@@ -1,0 +1,131 @@
+"""signal (stft/istft roundtrip), geometric (message passing vs numpy),
+audio features, vision transforms/datasets."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_stft_istft_roundtrip():
+    from paddle_tpu import signal as S
+    from paddle_tpu.audio.functional import get_window
+    t = np.linspace(0, 1, 4096).astype(np.float32)  # exact frame coverage
+    x = np.sin(2 * np.pi * 440 * t) + 0.5 * np.sin(2 * np.pi * 880 * t)
+    w = get_window("hann", 512)
+    spec = S.stft(paddle.to_tensor(x), n_fft=512, hop_length=128, window=w)
+    assert spec.shape[0] == 257
+    back = S.istft(spec, n_fft=512, hop_length=128, window=w,
+                   length=len(x))
+    np.testing.assert_allclose(back.numpy(), x, atol=1e-3)
+
+
+def test_stft_matches_numpy():
+    from paddle_tpu import signal as S
+    x = np.random.randn(1024).astype(np.float32)
+    spec = S.stft(paddle.to_tensor(x), n_fft=256, hop_length=64,
+                  center=False).numpy()
+    # frame 0 golden vs np.fft.rfft
+    ref0 = np.fft.rfft(x[:256])
+    np.testing.assert_allclose(spec[:, 0], ref0, rtol=1e-4, atol=1e-3)
+
+
+def test_frame_overlap_add_inverse():
+    from paddle_tpu import signal as S
+    x = np.arange(32, dtype=np.float32)
+    fr = S.frame(paddle.to_tensor(x), frame_length=8, hop_length=8)
+    assert fr.shape == [8, 4]
+    back = S.overlap_add(fr, hop_length=8)
+    np.testing.assert_array_equal(back.numpy(), x)
+
+
+def test_send_u_recv_golden():
+    from paddle_tpu import geometric as G
+    x = np.array([[1.0, 2], [3, 4], [5, 6]], np.float32)
+    src = np.array([0, 1, 2, 0])
+    dst = np.array([1, 2, 1, 0])
+    out = G.send_u_recv(paddle.to_tensor(x), src, dst,
+                        reduce_op="sum").numpy()
+    ref = np.zeros_like(x)
+    for s, d in zip(src, dst):
+        ref[d] += x[s]
+    np.testing.assert_allclose(out, ref)
+    out_max = G.send_u_recv(paddle.to_tensor(x), src, dst,
+                            reduce_op="max").numpy()
+    assert out_max[1, 0] == 5.0
+
+
+def test_segment_ops():
+    from paddle_tpu import geometric as G
+    data = np.array([[1.0], [2], [3], [4]], np.float32)
+    ids = np.array([0, 0, 1, 1])
+    np.testing.assert_allclose(
+        G.segment_sum(paddle.to_tensor(data), ids).numpy(), [[3], [7]])
+    np.testing.assert_allclose(
+        G.segment_mean(paddle.to_tensor(data), ids).numpy(), [[1.5], [3.5]])
+    np.testing.assert_allclose(
+        G.segment_max(paddle.to_tensor(data), ids).numpy(), [[2], [4]])
+
+
+def test_send_u_recv_grad():
+    from paddle_tpu import geometric as G
+    x = paddle.to_tensor(np.ones((3, 2), np.float32))
+    x.stop_gradient = False
+    out = G.send_u_recv(x, np.array([0, 1]), np.array([1, 2]))
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 1], [1, 1], [0, 0]])
+
+
+def test_mel_spectrogram_and_mfcc():
+    from paddle_tpu.audio.features import LogMelSpectrogram, MFCC
+    x = paddle.to_tensor(np.random.randn(2, 4000).astype(np.float32))
+    lm = LogMelSpectrogram(sr=8000, n_fft=256, n_mels=32)(x)
+    assert lm.shape[0] == 2 and lm.shape[1] == 32
+    mf = MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=32)(x)
+    assert mf.shape[1] == 13
+
+
+def test_vision_transforms_pipeline():
+    from paddle_tpu.vision import transforms as T
+    img = (np.random.rand(32, 48, 3) * 255).astype(np.uint8)
+    pipe = T.Compose([T.Resize(40), T.CenterCrop(36), T.ToTensor(),
+                      T.Normalize(mean=[0.5] * 3, std=[0.5] * 3)])
+    out = pipe(img)
+    assert out.shape == [3, 36, 36]
+    a = out.numpy()
+    assert a.min() >= -1.001 and a.max() <= 1.001
+
+
+def test_vision_transform_resize_golden():
+    from paddle_tpu.vision import transforms as T
+    img = np.arange(16, dtype=np.float32).reshape(4, 4)
+    out = T.resize(img, (2, 2), interpolation="nearest")
+    np.testing.assert_array_equal(out, [[0, 2], [8, 10]])
+
+
+def test_fake_dataset_loader():
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.vision.datasets import FakeData
+    ds = FakeData(size=32, image_shape=(3, 8, 8), num_classes=4)
+    dl = DataLoader(ds, batch_size=8)
+    xb, yb = next(iter(dl))
+    assert xb.shape == [8, 3, 8, 8]
+    assert int(yb.numpy().max()) < 4
+
+
+def test_mnist_local_format(tmp_path):
+    import gzip
+    from paddle_tpu.vision.datasets import MNIST
+    imgs = (np.arange(3 * 28 * 28) % 255).astype(np.uint8)
+    img_file = tmp_path / "imgs.gz"
+    lbl_file = tmp_path / "lbls.gz"
+    with gzip.open(img_file, "wb") as f:
+        f.write((2051).to_bytes(4, "big") + (3).to_bytes(4, "big")
+                + (28).to_bytes(4, "big") + (28).to_bytes(4, "big")
+                + imgs.tobytes())
+    with gzip.open(lbl_file, "wb") as f:
+        f.write((2049).to_bytes(4, "big") + (3).to_bytes(4, "big")
+                + bytes([1, 2, 3]))
+    ds = MNIST(image_path=str(img_file), label_path=str(lbl_file))
+    assert len(ds) == 3
+    img, label = ds[1]
+    assert img.shape == (28, 28) and label == 2
