@@ -14,7 +14,40 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
+import traceback
+
+
+def _init_devices():
+    """jax.devices() with retry/backoff; falls back to CPU via re-exec.
+
+    The TPU tunnel backend ('axon') can be transiently UNAVAILABLE (round-1
+    BENCH rc=1 was exactly this). Retry a few times; if it never comes up,
+    re-exec this script with JAX_PLATFORMS=cpu so the driver still gets a
+    JSON line (a CPU smoke number with vs_baseline=0) instead of rc=1.
+    """
+    import jax
+
+    last_err = None
+    for attempt in range(4):
+        try:
+            return jax.devices()
+        except Exception as e:  # backend init failure
+            last_err = e
+            wait = 5 * (attempt + 1)
+            print(f"bench: backend init failed (attempt {attempt + 1}/4): "
+                  f"{e}; retrying in {wait}s", file=sys.stderr)
+            time.sleep(wait)
+    if os.environ.get("BENCH_NO_FALLBACK"):
+        raise last_err
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_NO_FALLBACK"] = "1"
+    env.setdefault("BENCH_MODEL", "tiny")
+    print(f"bench: TPU backend unavailable after retries ({last_err}); "
+          f"re-exec on CPU for a smoke number", file=sys.stderr)
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
 # bf16 peak FLOP/s per chip by TPU generation (match order matters:
@@ -39,14 +72,13 @@ def _peak_flops(device) -> float | None:
 
 
 def main():
-    import jax
     import numpy as np
 
     import paddle_tpu as paddle
     import paddle_tpu.optimizer as popt
     from paddle_tpu.models import llama as L
 
-    devs = jax.devices()
+    devs = _init_devices()
     on_tpu = devs[0].platform == "tpu"
     kind = getattr(devs[0], "device_kind", "").lower().replace(" ", "")
     small_hbm = ("lite" in kind) or ("v5e" in kind)  # v5e: 16 GB HBM
@@ -125,4 +157,14 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:
+        # never rc!=0 without a JSON line: emit a diagnostic record instead
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "bench_failed", "value": 0.0,
+            "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "extra": {"error": f"{type(e).__name__}: {e}"[:500]},
+        }))
+        sys.exit(0)
