@@ -118,10 +118,18 @@ def main():
     ids = paddle.to_tensor(
         rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
 
-    # warmup: 2 steps — the first creates optimizer state (widening the
-    # state tree => second trace/compile); steady state begins at step 2
-    for _ in range(2):
+    # warmup until the jit cache stops growing: the state tree widens twice
+    # (optimizer moments, then master weights), each widening = a recompile;
+    # the timed loop must see zero compiles
+    prev_cache = -1
+    warmup = 0
+    while warmup < 6:
         loss = step(ids, ids)
+        warmup += 1
+        cache = getattr(step._compiled, "_cache_size", lambda: None)()
+        if cache is not None and cache == prev_cache and warmup >= 3:
+            break
+        prev_cache = cache
     float(loss.numpy())
 
     t0 = time.perf_counter()
@@ -129,6 +137,8 @@ def main():
         loss = step(ids, ids)
     last = float(loss.numpy())  # blocks until all steps complete
     dt = time.perf_counter() - t0
+    n_compiles_timed = (getattr(step._compiled, "_cache_size",
+                                lambda: None)() or 0) - (prev_cache or 0)
 
     n_chips = len(devs)
     tokens = batch * seq * steps
@@ -151,6 +161,7 @@ def main():
             "mfu": round(mfu, 4), "loss": round(last, 4),
             "batch": batch, "seq": seq, "steps": steps,
             "n_params": n_params, "n_chips": n_chips,
+            "compiles_in_timed_loop": n_compiles_timed,
             "device": getattr(devs[0], "device_kind", devs[0].platform),
         },
     }))

@@ -48,6 +48,31 @@ def _needs_grad(tensors) -> bool:
     return False
 
 
+def _amp_wrap(fn: Callable, name: str) -> Callable:
+    """AMP O1: cast float inputs per the active autocast policy before the
+    op body runs (the tape-level equivalent of the reference's per-ad_func
+    inlined AMP cast, ref eager_gen.py:455 / fluid/eager/amp_utils.h).
+
+    The cast happens INSIDE the op closure, so jax.vjp differentiates
+    through it — cotangents come back in the original input dtypes.
+    """
+    from ..amp import compute_dtype
+    target = compute_dtype(name)
+    if target is None:
+        return fn
+
+    def cast(x):
+        dt = getattr(x, "dtype", None)
+        if dt is not None and jnp.issubdtype(dt, jnp.floating):
+            return jnp.asarray(x).astype(target)
+        return x
+
+    def wrapped(*xs, **kw):
+        return fn(*[cast(x) for x in xs], **kw)
+
+    return wrapped
+
+
 def apply_op(fn: Callable, *args, n_outputs: int = 1, name: str = "",
              **static_kwargs):
     """Run `fn(*arrays, **static_kwargs)` through the tape.
@@ -57,6 +82,7 @@ def apply_op(fn: Callable, *args, n_outputs: int = 1, name: str = "",
     """
     from ..tensor import Tensor  # local import: avoid cycle
 
+    fn = _amp_wrap(fn, name)
     tensor_args: List[Optional[Any]] = []
     datas = []
     for a in args:

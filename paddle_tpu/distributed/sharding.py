@@ -330,8 +330,8 @@ class ShardingPlan:
                 return p_specs[pname]
             return self.param_spec(pname, v)
 
-        def compiled_factory(params, buffers, opt_state, master, step_i, lr,
-                             key, batch):
+        def compiled_factory(params, buffers, opt_state, master,
+                             scaler_state, step_i, lr, key, batch):
             p_specs = {k: self.param_spec(k, v) for k, v in params.items()}
             in_shardings = (
                 {k: NamedSharding(mesh, p_specs[k]) for k in params},
@@ -340,6 +340,7 @@ class ShardingPlan:
                  for k, v in opt_state.items()},
                 {k: NamedSharding(mesh, _master_spec(self, k, v, p_specs))
                  for k, v in master.items()},
+                {k: NamedSharding(mesh, P()) for k in scaler_state},
                 NamedSharding(mesh, P()),
                 NamedSharding(mesh, P()),
                 NamedSharding(mesh, P()),
@@ -354,11 +355,13 @@ class ShardingPlan:
             # (a restored opt_state with masters still pending would make
             # the output tree wider than the inputs)
             if opt_state and master:
-                out_shardings = (NamedSharding(mesh, P()),) + in_shardings[:4]
+                out_shardings = (NamedSharding(mesh, P()),) + \
+                    in_shardings[:5]
             else:
                 out_abs = jax.eval_shape(pure, params, buffers, opt_state,
-                                         master, step_i, lr, key, batch)
-                _, p_abs, b_abs, os_abs, mw_abs = out_abs
+                                         master, scaler_state, step_i, lr,
+                                         key, batch)
+                _, p_abs, b_abs, os_abs, mw_abs, sc_abs = out_abs
                 out_shardings = (
                     NamedSharding(mesh, P()),
                     {k: NamedSharding(mesh, p_specs[k]) for k in p_abs},
@@ -367,6 +370,7 @@ class ShardingPlan:
                      for k, v in os_abs.items()},
                     {k: NamedSharding(mesh, _master_spec(self, k, v, p_specs))
                      for k, v in mw_abs.items()},
+                    {k: NamedSharding(mesh, P()) for k in sc_abs},
                 )
             return jax.jit(pure, in_shardings=in_shardings,
                            out_shardings=out_shardings,
@@ -374,18 +378,20 @@ class ShardingPlan:
 
         cache = {}
 
-        def run(params, buffers, opt_state, master, step_i, lr, key, batch):
+        def run(params, buffers, opt_state, master, scaler_state, step_i,
+                lr, key, batch):
             struct = jax.tree_util.tree_structure(
-                (params, buffers, opt_state, master, batch))
+                (params, buffers, opt_state, master, scaler_state, batch))
             shapes = tuple(
                 (a.shape, str(a.dtype)) for a in
                 jax.tree_util.tree_leaves((params, opt_state, batch)))
             sig = (struct, shapes)
             if sig not in cache:
                 cache[sig] = compiled_factory(params, buffers, opt_state,
-                                              master, step_i, lr, key, batch)
+                                              master, scaler_state, step_i,
+                                              lr, key, batch)
             # place inputs (no-op if already placed)
-            return cache[sig](params, buffers, opt_state, master, step_i, lr,
-                              key, batch)
+            return cache[sig](params, buffers, opt_state, master,
+                              scaler_state, step_i, lr, key, batch)
 
         return run

@@ -148,6 +148,18 @@ class LogNormal(Normal):
         return Tensor(0.5 + 0.5 * math.log(2 * math.pi)
                       + jnp.log(self.scale) + self.loc)
 
+    def cdf(self, value):
+        # P(X <= v) = Phi((log v - loc) / scale); 0 for v <= 0
+        v = to_tensor_like(value)
+        return apply_op(
+            lambda x: jnp.where(
+                x > 0,
+                0.5 * (1 + jax.scipy.special.erf(
+                    (jnp.log(jnp.maximum(x, 1e-38)) - self.loc)
+                    / (self.scale * math.sqrt(2.0)))),
+                0.0),
+            v, name="lognormal_cdf")
+
 
 class Uniform(Distribution):
     def __init__(self, low, high, name=None):

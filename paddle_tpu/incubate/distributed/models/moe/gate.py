@@ -77,7 +77,11 @@ class SwitchGate(_GateBase):
 
 
 class GShardGate(_GateBase):
-    """Top-2 routing with second-expert sampling (ref gshard_gate.py)."""
+    """Top-2 routing with STOCHASTIC second-expert sampling
+    (ref gshard_gate.py: the 2nd expert is drawn proportionally to the
+    residual gate probability, not argmax'd — ADVICE r1 fix). In eval mode
+    (or when no RNG is available) falls back to deterministic argmax.
+    """
 
     top_k = 2
 
@@ -89,8 +93,17 @@ class GShardGate(_GateBase):
         idx1 = jnp.argmax(g, axis=-1)
         p1 = jnp.max(g, axis=-1)
         g2 = g * (1.0 - jax.nn.one_hot(idx1, E, dtype=jnp.float32))
-        idx2 = jnp.argmax(g2, axis=-1)
-        p2 = jnp.max(g2, axis=-1)
+        if self.training:
+            from .....framework import core
+            key = core.next_rng_key()
+            # categorical draw ∝ residual prob via the Gumbel-max trick
+            gumbel = -jnp.log(-jnp.log(
+                jax.random.uniform(key, g2.shape, minval=1e-20, maxval=1.0)))
+            idx2 = jnp.argmax(jnp.log(jnp.maximum(g2, 1e-20)) + gumbel,
+                              axis=-1)
+        else:
+            idx2 = jnp.argmax(g2, axis=-1)
+        p2 = jnp.take_along_axis(g2, idx2[:, None], axis=1)[:, 0]
         denom = jnp.maximum(p1 + p2, 1e-9)
         p1n, p2n = p1 / denom, p2 / denom
 
@@ -105,6 +118,36 @@ class GShardGate(_GateBase):
         return d1 + d2, c1 + c2, _load_balance_loss(g, idx1, E)
 
 
-class NaiveGate(SwitchGate):
-    """ref naive_gate.py — top-k gate without extras; top-1 variant here."""
-    pass
+class NaiveGate(_GateBase):
+    """ref naive_gate.py — plain top-k softmax gate, no balance loss.
+
+    Tokens claim expert slots in k rounds (rank-0 choices queue first),
+    matching the reference's score-ordered dispatch without sorting.
+    """
+
+    def __init__(self, d_model, num_experts, capacity_factor=1.5, top_k=2):
+        super().__init__(d_model, num_experts, capacity_factor)
+        self.top_k = top_k
+
+    def route(self, x_arr, w):
+        T = x_arr.shape[0]
+        E = self.num_experts
+        k = min(self.top_k, E)
+        C = _capacity(T, E, k, self.capacity_factor)
+        g = jax.nn.softmax(self.logits(x_arr, w), axis=-1)      # [T, E]
+        topv, topi = jax.lax.top_k(g, k)                         # [T, k]
+        norm = jnp.maximum(jnp.sum(topv, axis=-1, keepdims=True), 1e-9)
+        topv = topv / norm
+        disp = jnp.zeros((T, E, C), jnp.float32)
+        comb = jnp.zeros((T, E, C), jnp.float32)
+        used = jnp.zeros((E,), jnp.int32)
+        for r in range(k):
+            idx = topi[:, r]
+            e_hot = jax.nn.one_hot(idx, E, dtype=jnp.int32)
+            pos = jnp.cumsum(e_hot, axis=0) - e_hot
+            pos = jnp.sum(pos * e_hot, axis=1) + used[idx]
+            d, c = _one_hot_dispatch(idx, topv[:, r], E, C, pos)
+            disp = disp + d
+            comb = comb + c
+            used = used + jnp.sum(e_hot, axis=0)
+        return disp, comb, jnp.zeros((), jnp.float32)

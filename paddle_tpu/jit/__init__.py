@@ -144,6 +144,7 @@ class TrainStep:
         self.model = model
         self.optimizer = optimizer
         self.step_fn = step_fn
+        self.scaler = scaler
         self.shard = shard
         if shard is not None and hasattr(shard, "attach_model"):
             shard.attach_model(model)
@@ -165,8 +166,10 @@ class TrainStep:
         model = self.model
         opt = self.optimizer
         step_fn = self.step_fn
+        scaler = self.scaler
 
-        def pure(params, buffers, opt_state, master, step_i, lr, key, batch):
+        def pure(params, buffers, opt_state, master, scaler_state, step_i,
+                 lr, key, batch):
             state = {}
             state.update(params)
             state.update(buffers)
@@ -174,6 +177,8 @@ class TrainStep:
             saved_step = opt._step_count
             saved_master = opt._master_weights
             saved_lr = opt._lr
+            saved_scaler = (scaler._get_traced_state()
+                            if scaler is not None else None)
             with model.use_state(state):
                 with core.rng_key_context(key):
                     opt._state = dict(opt_state)
@@ -181,23 +186,35 @@ class TrainStep:
                     opt._master_weights = dict(master)
                     if not hasattr(opt._lr, "step"):
                         opt._lr = lr
+                    if scaler is not None:
+                        scaler._set_traced_state(scaler_state)
                     try:
-                        loss = step_fn(*_tree_box(batch))
-                        loss.backward()
-                        opt.step()
+                        if scaler is not None:
+                            loss = step_fn(*_tree_box(batch))
+                            scaler.scale(loss).backward()
+                            scaler.step(opt)
+                            scaler.update()
+                        else:
+                            loss = step_fn(*_tree_box(batch))
+                            loss.backward()
+                            opt.step()
                         opt.clear_grad()
                         sd = model.state_dict()
                         new_params = {k: sd[k].data for k in params}
                         new_buffers = {k: sd[k].data for k in buffers}
                         new_opt_state = dict(opt._state)
                         new_master = dict(opt._master_weights)
+                        new_scaler = (scaler._get_traced_state()
+                                      if scaler is not None else {})
                     finally:
                         opt._state = saved_state
                         opt._step_count = saved_step
                         opt._master_weights = saved_master
                         opt._lr = saved_lr
+                        if scaler is not None:
+                            scaler._set_traced_state(saved_scaler)
             return (loss.data, new_params, new_buffers, new_opt_state,
-                    new_master)
+                    new_master, new_scaler)
 
         donate = (0, 1, 2, 3) if self._donate else ()
         if self.shard is not None:
@@ -207,6 +224,11 @@ class TrainStep:
 
     def __call__(self, *batch):
         if self._compiled is None:
+            # materialize optimizer state before the first trace: otherwise
+            # the state tree widens after step 1 and the whole step
+            # recompiles (minutes for large models)
+            if hasattr(self.optimizer, "prime"):
+                self.optimizer.prime()
             self._build()
         opt = self.optimizer
         params, buffers = self._capture_state()
@@ -215,10 +237,13 @@ class TrainStep:
         step_i = jnp.asarray(opt._step_count, jnp.int32)
         key = core.next_rng_key()
         batch_arrays = _tree_unbox(batch)
-        loss, new_params, new_buffers, new_opt_state, new_master = \
+        scaler_state = (self.scaler._get_traced_state()
+                        if self.scaler is not None else {})
+        (loss, new_params, new_buffers, new_opt_state, new_master,
+         new_scaler) = \
             self._compiled(params, buffers, dict(opt._state),
-                           dict(opt._master_weights), step_i, lr, key,
-                           batch_arrays)
+                           dict(opt._master_weights), scaler_state, step_i,
+                           lr, key, batch_arrays)
         sd = self.model.state_dict()
         for k, v in new_params.items():
             sd[k].data = v
@@ -226,6 +251,8 @@ class TrainStep:
             sd[k].data = v
         opt._state = dict(new_opt_state)
         opt._master_weights = dict(new_master)
+        if self.scaler is not None:
+            self.scaler._set_traced_state(new_scaler)
         opt._step_count += 1
         if hasattr(opt._lr, "step") and not isinstance(opt._lr, float):
             pass  # LR scheduler stepping is the caller's choice (paddle semantics)

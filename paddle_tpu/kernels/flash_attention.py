@@ -5,8 +5,11 @@ Strategy: use the tuned in-tree Pallas TPU kernel
 (jax.experimental.pallas.ops.tpu.flash_attention) when on TPU and shapes are
 tile-aligned; it implements the same online-softmax blocked algorithm as
 FlashAttention-2 with MXU-shaped (block_q x block_k) tiles and VMEM
-double-buffering. A custom ring-attention kernel for the `sep` axis lives in
-ring_attention.py (reference has NO equivalent — SURVEY §5 long-context).
+double-buffering. Causal masking is handled natively by the kernel (blocks
+above the diagonal are skipped, so causal is FASTER, not gated out), and
+padding masks map onto the kernel's segment-id mechanism. A custom
+ring-attention kernel for the `sep` axis lives in ring_attention.py
+(reference has NO equivalent — SURVEY §5 long-context).
 """
 from __future__ import annotations
 
@@ -16,7 +19,9 @@ import math
 import jax
 import jax.numpy as jnp
 
-_MIN_HEAD_DIM = 128  # lane width; smaller head_dims pad poorly
+# lane width is 128; the kernel pads smaller head dims, profitable down to 64
+_MIN_HEAD_DIM = 64
+_SEQ_ALIGN = 128
 
 
 def _on_tpu() -> bool:
@@ -26,36 +31,63 @@ def _on_tpu() -> bool:
         return False
 
 
-def supported(q_shape, k_shape, no_mask: bool) -> bool:
+def supported(q_shape, k_shape, causal_or_none: bool,
+              has_padding_mask: bool = False) -> bool:
+    """True when flash_attention_bshd will hit the Pallas kernel.
+
+    `causal_or_none`: mask is either causal or absent (anything else —
+    arbitrary additive masks — must go through `bias=`, which we route to
+    the dense path). Padding masks are fine (segment ids).
+    """
+    del has_padding_mask  # handled via segment ids — no longer gated out
     if not _on_tpu():
         return False
-    if not no_mask:
+    if not causal_or_none:
         return False
     B, Sq, H, D = q_shape
     Sk = k_shape[1]
-    # kernel wants seq multiples of the block size and head_dim % 128 == 0
-    return (D % _MIN_HEAD_DIM == 0 and Sq % 128 == 0 and Sk % 128 == 0
-            and q_shape[2] == k_shape[2])
+    # kernel pads D <= 128 up to the lane width; above that it requires an
+    # exact multiple of 128 (so 192/320 must take the dense fallback)
+    d_ok = (D % 64 == 0) if D <= 128 else (D % 128 == 0)
+    return (d_ok and Sq % _SEQ_ALIGN == 0
+            and Sk % _SEQ_ALIGN == 0 and q_shape[2] == k_shape[2])
+
+
+def _block_sizes(Sq, Sk):
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+    bq = min(512, Sq)
+    bk = min(512, Sk)
+    return BlockSizes(
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
+        block_q_dkv=bq, block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale"))
-def flash_attention_bshd(q, k, v, causal=False, scale=None):
-    """[batch, seq, heads, dim] in/out (paddle flash_attn layout)."""
+def flash_attention_bshd(q, k, v, causal=False, scale=None, padding_mask=None):
+    """[batch, seq, heads, dim] in/out (paddle flash_attn layout).
+
+    padding_mask: optional [batch, kv_seq] bool/int array, True/1 = valid
+    token. Lowered to the kernel's segment-id masking (pad tokens get a
+    distinct segment so nothing attends to or from them).
+    """
     from jax.experimental.pallas.ops.tpu.flash_attention import (
-        BlockSizes, flash_attention)
+        SegmentIds, flash_attention)
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     qt = jnp.swapaxes(q, 1, 2)  # BHSD
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     Sq, Sk = qt.shape[2], kt.shape[2]
-    bq = min(512, Sq)
-    bk = min(512, Sk)
-    sizes = BlockSizes(
-        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
-        block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
-        block_q_dkv=bq, block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq,
-    )
-    out = flash_attention(qt, kt, vt, causal=causal, sm_scale=scale,
-                          block_sizes=sizes)
+    seg = None
+    if padding_mask is not None:
+        kv_seg = jnp.where(padding_mask.astype(bool), 1, 0).astype(jnp.int32)
+        if Sq == Sk:
+            q_seg = kv_seg
+        else:
+            q_seg = jnp.ones((q.shape[0], Sq), jnp.int32)
+        seg = SegmentIds(q=q_seg, kv=kv_seg)
+    out = flash_attention(qt, kt, vt, segment_ids=seg, causal=causal,
+                          sm_scale=scale, block_sizes=_block_sizes(Sq, Sk))
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)

@@ -33,6 +33,26 @@ from jax.sharding import PartitionSpec as P
 __all__ = ["ring_attention", "ulysses_attention", "sep_attention"]
 
 
+def _broadcast_kv_heads(q, k, v):
+    """GQA/MQA support: repeat kv heads up to the q head count.
+
+    [B, S, Hq, D] q with [B, S, Hkv, D] k/v is valid when Hq % Hkv == 0
+    (each kv head serves Hq/Hkv query heads); anything else is rejected
+    with a clear error instead of an opaque einsum shape failure.
+    """
+    hq, hkv = q.shape[2], k.shape[2]
+    if k.shape[2] != v.shape[2]:
+        raise ValueError(
+            f"k and v head counts differ: {k.shape[2]} vs {v.shape[2]}")
+    if hq == hkv:
+        return k, v
+    if hq % hkv != 0:
+        raise ValueError(
+            f"GQA needs q heads ({hq}) divisible by kv heads ({hkv})")
+    rep = hq // hkv
+    return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
+
+
 def _block_attn(q, k, v, mask, scale):
     """One blockwise attention round in f32: returns (scores-exp sum stats).
     q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; mask: [Sq, Sk] bool or None."""
@@ -99,6 +119,7 @@ def ring_attention(q, k, v, mesh=None, axis_name: str = "sep",
     the remaining mesh axes stay under GSPMD."""
     from ..distributed.topology import get_mesh
     mesh = mesh or get_mesh()
+    k, v = _broadcast_kv_heads(q, k, v)
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if mesh is None or axis_name not in mesh.axis_names \
@@ -155,6 +176,7 @@ def ulysses_attention(q, k, v, mesh=None, axis_name: str = "sep",
     """DeepSpeed-Ulysses-style SP attention; requires H % sep_degree == 0."""
     from ..distributed.topology import get_mesh
     mesh = mesh or get_mesh()
+    k, v = _broadcast_kv_heads(q, k, v)
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if mesh is None or axis_name not in mesh.axis_names \

@@ -109,6 +109,12 @@ class BertSelfAttention(Layer):
         self.dropout = Dropout(cfg.hidden_dropout_prob)
 
     def forward(self, x, attn_mask=None):
+        """attn_mask: [B, S] validity mask (1 = real token), or None.
+
+        Hits the Pallas flash kernel (padding via segment ids) whenever
+        shapes are tile-aligned and attention-probs dropout is off; the
+        dense fallback applies an additive mask + probs dropout.
+        """
         from ..framework import core
         cfg = self.cfg
         nh, d = cfg.num_attention_heads, cfg.head_dim
@@ -126,16 +132,17 @@ class BertSelfAttention(Layer):
             k = k.reshape(B, S, nh, d)
             v = v.reshape(B, S, nh, d)
             from ..kernels import flash_attention as fa
-            if mask is None and drop_key is None and \
-                    fa.supported(q.shape, k.shape, True):
-                o = fa.flash_attention_bshd(q, k, v, causal=False)
+            if drop_key is None and fa.supported(q.shape, k.shape, True):
+                o = fa.flash_attention_bshd(q, k, v, causal=False,
+                                            padding_mask=mask)
             else:
                 qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
                 kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
                 vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
                 s = qt @ jnp.swapaxes(kt, -1, -2) / math.sqrt(d)
                 if mask is not None:
-                    s = s + mask
+                    s = s + (1.0 - mask[:, None, None, :].astype(jnp.float32)
+                             ) * jnp.finfo(jnp.float32).min
                 p = jax.nn.softmax(s, axis=-1)
                 if drop_key is not None:
                     keep = jax.random.bernoulli(drop_key, 1.0 - attn_p,
@@ -185,12 +192,10 @@ class BertModel(Layer):
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         x = self.embeddings(input_ids, token_type_ids)
-        mask = None
-        if attention_mask is not None:
-            am = to_tensor_like(attention_mask)
-            mask = apply_op(
-                lambda m: (1.0 - m[:, None, None, :].astype(jnp.float32))
-                * jnp.finfo(jnp.float32).min, am, name="bert_mask")
+        # validity mask [B, S] is passed down raw: the flash path lowers it
+        # to segment ids, the dense fallback builds the additive form
+        mask = (to_tensor_like(attention_mask)
+                if attention_mask is not None else None)
         for lyr in self.layers:
             x = lyr(x, mask)
         pooled = F.tanh(self.pooler(x[:, 0]))

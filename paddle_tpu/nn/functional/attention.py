@@ -39,6 +39,29 @@ def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
+def _as_padding_mask(mask, batch, kv_len):
+    """Convert a keep/drop mask that provably varies only along the kv axis
+    to a [B, kv_len] validity mask; None if not convertible.
+
+    Convertible shapes: [kv], [B, kv], [B, 1, kv], [B, 1, 1, kv] — the
+    broadcast dims prove kv-only variation. Only BOOLEAN masks convert:
+    they are pure keep/drop, so segment-id masking is exact. Additive float
+    masks may carry finite biases that segment ids cannot represent, so
+    they always take the dense path.
+    """
+    if mask.dtype != jnp.bool_:
+        return None
+    shape = tuple(mask.shape)
+    ok = (shape == (kv_len,) or shape == (batch, kv_len)
+          or shape == (batch, 1, kv_len) or shape == (batch, 1, 1, kv_len))
+    if not ok:
+        return None
+    flat = mask.reshape(shape[0] if len(shape) > 1 else 1, kv_len)
+    if len(shape) == 1:
+        flat = jnp.broadcast_to(flat, (batch, kv_len))
+    return flat
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
                                  name=None):
@@ -47,14 +70,30 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     scale = 1.0 / math.sqrt(q.shape[-1])
 
     use_pallas = False
+    pad_convertible = False
     try:
         from ...kernels import flash_attention as fa
-        use_pallas = fa.supported(q.shape, k.shape, attn_mask is None)
+        raw_mask = unwrap(attn_mask) if attn_mask is not None else None
+        if raw_mask is not None:
+            pad_convertible = _as_padding_mask(
+                raw_mask, q.shape[0], k.shape[1]) is not None
+        use_pallas = fa.supported(q.shape, k.shape,
+                                  attn_mask is None or pad_convertible)
     except Exception:
         use_pallas = False
 
     if use_pallas and dropout_p == 0.0:
         from ...kernels import flash_attention as fa
+        if attn_mask is not None:
+            B, Sk = q.shape[0], k.shape[1]
+
+            def _flash_masked(a, b, c, m):
+                return fa.flash_attention_bshd(
+                    a, b, c, causal=is_causal, scale=scale,
+                    padding_mask=_as_padding_mask(m, B, Sk))
+
+            return apply_op(_flash_masked, q, k, v, to_tensor_like(attn_mask),
+                            name="flash_attention")
         return apply_op(lambda a, b, c: fa.flash_attention_bshd(
             a, b, c, causal=is_causal, scale=scale), q, k, v,
             name="flash_attention")

