@@ -6,10 +6,11 @@ a real scheduled runtime (see pipeline_parallel.py).
 """
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
 from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .hetero_pipeline import HeteroPipelineParallel  # noqa: F401
 
 __all__ = ["LayerDesc", "PipelineLayer", "SharedLayerDesc",
-           "PipelineParallel", "TensorParallel", "ShardingParallel",
-           "SegmentParallel"]
+           "PipelineParallel", "HeteroPipelineParallel", "TensorParallel",
+           "ShardingParallel", "SegmentParallel"]
 
 
 class _IdentityWrapper:

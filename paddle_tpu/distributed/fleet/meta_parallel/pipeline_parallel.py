@@ -68,24 +68,52 @@ class PipelineParallel:
     refreshed lazily via sync_to_layers() for eval/state_dict.
     """
 
+    def __new__(cls, layers=None, *args, **kwargs):
+        # non-uniform middles route to the heterogeneous-stage engine
+        # (per-stage flat weight buffers + lax.switch bodies)
+        if cls is PipelineParallel and layers is not None \
+                and getattr(layers, "hetero_stages", None):
+            from .hetero_pipeline import HeteroPipelineParallel
+            return HeteroPipelineParallel(layers, *args, **kwargs)
+        return super().__new__(cls)
+
     def __init__(self, layers: PipelineLayer, hcg=None, strategy=None,
-                 num_microbatches: Optional[int] = None):
+                 num_microbatches: Optional[int] = None, vpp_degree: int = 1):
         from ...topology import get_hybrid_communicate_group, get_mesh
         self.pipe = layers
         self.hcg = hcg or get_hybrid_communicate_group()
         self.mesh = (self.hcg.mesh if self.hcg is not None else get_mesh())
         assert self.mesh is not None, "pipeline needs a device mesh"
         self.S = layers.num_stages
+        if strategy is not None and vpp_degree == 1:
+            vpp_degree = strategy.pipeline_configs.get("vpp_degree", 1)
+        self.V = int(vpp_degree)
         self.num_microbatches = num_microbatches or (
             strategy.pipeline_configs.get("accumulate_steps", self.S)
             if strategy is not None else self.S)
+        L = len(layers.blocks)
+        assert self.V >= 1 and L % (self.S * self.V) == 0, (
+            f"{L} blocks not divisible into {self.S} stages x "
+            f"{self.V} virtual chunks")
+        self.Lpc = L // (self.S * self.V)           # layers per chunk
+
+        # VPP cyclic placement: global stage g = v*S + s lives on device s
+        # as chunk v. Stacks are stored DEVICE-MAJOR, [s, v, l] order, so a
+        # plain leading-axis shard over `pp` hands each device its chunks.
+        S, V, Lpc = self.S, self.V, self.Lpc
+        self._perm = np.array(
+            [(v * S + s) * Lpc + l
+             for s in range(S) for v in range(V) for l in range(Lpc)],
+            np.int64)
+        self._inv_perm = np.argsort(self._perm)
 
         self._edge = layers.edge_params()           # name -> Parameter
         self._stacks: Dict[str, Parameter] = {}
         stacked = layers.stacked_block_params()     # name -> [L, ...] array
         for n, arr in stacked.items():
             spec = P(*(("pp",) + (None,) * (arr.ndim - 1)))
-            sharded = jax.device_put(arr, NamedSharding(self.mesh, spec))
+            sharded = jax.device_put(np.asarray(arr)[self._perm],
+                                     NamedSharding(self.mesh, spec))
             p = Parameter(sharded, name=f"pipe_stack::{n}")
             p.pspec = spec
             self._stacks[n] = p
@@ -111,7 +139,7 @@ class PipelineParallel:
 
     def sync_to_layers(self):
         self.pipe.set_stacked_block_params(
-            {n: p.data for n, p in self._stacks.items()})
+            {n: p.data[self._inv_perm] for n, p in self._stacks.items()})
 
     def state_dict(self):
         self.sync_to_layers()
@@ -122,7 +150,8 @@ class PipelineParallel:
         stacked = self.pipe.stacked_block_params()
         for n, arr in stacked.items():
             self._stacks[n].data = jax.device_put(
-                arr, NamedSharding(self.mesh, self._stacks[n].pspec))
+                np.asarray(arr)[self._perm],
+                NamedSharding(self.mesh, self._stacks[n].pspec))
 
     def eval(self):
         self.sync_to_layers()
@@ -139,10 +168,21 @@ class PipelineParallel:
 
     # -- the compiled pipelined loss ----------------------------------------
     def _build_loss_fn(self):
+        """Schedule-driven pipelined loss (FThenB when V==1, interleaved
+        VPP when V>1 — ref pipeline_parallel.py:440, :906).
+
+        One lax.scan over the precomputed tick schedule inside shard_map
+        over `pp`; each tick = one chunk-work per device + one cyclic
+        ppermute. Backward is the AD transpose of the scan — the reverse
+        schedule — so FThenB/interleave semantics carry over to grads.
+        """
+        from .pipeline_schedule import build_interleave_schedule
         pipe = self.pipe
-        S = self.S
-        Lps = pipe.layers_per_stage
+        S, V, Lpc = self.S, self.V, self.Lpc
+        M = self.num_microbatches
         mesh = self.mesh
+        sched = build_interleave_schedule(S, V, M)
+        T = sched.T
         template = pipe.blocks[0] if pipe.blocks else None
         t_named = list(template.named_parameters()) if template else []
         t_objs = [p for _, p in t_named]
@@ -152,11 +192,11 @@ class PipelineParallel:
             with _swap(t_objs, [bp[n] for n in t_names]), core.no_grad_guard():
                 return template(Tensor(h)).data
 
-        def stage_fwd(h, bp_local):
-            # bp_local leaves: [Lps, ...] — scan the per-stage sub-stack
+        def chunk_fwd(h, bp_chunk):
+            # bp_chunk leaves: [Lpc, ...] — scan the chunk's sub-stack
             def step(carry, pl):
                 return block_fwd(carry, pl), None
-            h, _ = jax.lax.scan(step, h, bp_local)
+            h, _ = jax.lax.scan(step, h, bp_chunk)
             return h
 
         def loss_of(out, y):
@@ -164,46 +204,65 @@ class PipelineParallel:
                 val = pipe.loss_fn(Tensor(out), Tensor(y))
             return val.data if isinstance(val, Tensor) else val
 
+        # [T, S] int32 schedule constants, indexed [t][axis_index("pp")]
+        sc = {k: jnp.asarray(getattr(sched, k), jnp.int32)
+              for k in ("ex_act", "ex_v", "ex_m", "store_act", "store_v",
+                        "loss_act")}
+
         def device_body(edge_p, bp_local, x, y):
-            # bp_local: [Lps, ...] — shard_map split the [S*Lps, ...] stacks
+            # bp_local leaves: [V*Lpc, ...] (device-major shard of stacks)
             s = jax.lax.axis_index("pp")
-            M = x.shape[0]
             flat = x.reshape((-1,) + x.shape[2:])
             h0 = _run_layers_functional(pipe.prefix, "prefix", edge_p, flat)
             h0 = h0.reshape((M, x.shape[1]) + h0.shape[1:])
+            bp_chunks = jax.tree_util.tree_map(
+                lambda a: a.reshape((V, Lpc) + a.shape[1:]), bp_local)
 
-            def tick(carry, t):
-                inbound, loss_sum = carry
-                mb = jnp.clip(t - s, 0, M - 1)
+            def tick(carry, sched_row):
+                inb, loss_sum = carry            # inb: [V, mb...]
+                ea = sched_row["ex_act"][s]
+                ev = sched_row["ex_v"][s]
+                em = sched_row["ex_m"][s]
+                sa = sched_row["store_act"][s]
+                sv = sched_row["store_v"][s]
+                la = sched_row["loss_act"][s]
+
                 first_in = jax.lax.dynamic_index_in_dim(
-                    h0, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
-                h_in = jnp.where(s == 0, first_in, inbound)
+                    h0, em, axis=0, keepdims=False)
+                slot_in = jax.lax.dynamic_index_in_dim(
+                    inb, ev, axis=0, keepdims=False)
+                is_g0 = jnp.logical_and(s == 0, ev == 0)
+                h_in = jnp.where(is_g0, first_in, slot_in)
+                bp_chunk = jax.tree_util.tree_map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, ev, axis=0, keepdims=False), bp_chunks)
 
-                def compute(h_in):
-                    out = stage_fwd(h_in, bp_local)
+                def compute(h_in, bp_chunk):
+                    out = chunk_fwd(h_in, bp_chunk)
                     tail = _run_layers_functional(pipe.suffix, "suffix",
                                                   edge_p, out)
-                    yt = jax.lax.dynamic_index_in_dim(y, mb, axis=0,
+                    yt = jax.lax.dynamic_index_in_dim(y, em, axis=0,
                                                       keepdims=False)
-                    mb_loss = loss_of(tail, yt)
-                    return out, mb_loss
+                    return out, loss_of(tail, yt)
 
-                out, mb_loss = jax.checkpoint(compute)(h_in)
-                active = jnp.logical_and(t - s >= 0, t - s < M)
-                is_last = s == S - 1
+                out, mb_loss = jax.checkpoint(compute)(h_in, bp_chunk)
                 loss_sum = loss_sum + jnp.where(
-                    jnp.logical_and(active, is_last),
+                    jnp.logical_and(ea == 1, la == 1),
                     mb_loss.astype(jnp.float32), 0.0)
-                # hand my output to the next stage (last stage's is dropped)
-                nxt = jax.lax.ppermute(
-                    out, "pp", [(i, i + 1) for i in range(S - 1)])
-                return (nxt, loss_sum), None
+                # cyclic handoff: chunk v of device S-1 feeds chunk v+1 of
+                # device 0 (the VPP wrap); receivers store per schedule
+                recv = jax.lax.ppermute(
+                    out, "pp", [(i, (i + 1) % S) for i in range(S)])
+                stored = jax.lax.dynamic_update_index_in_dim(
+                    inb, recv, sv, axis=0)
+                inb = jnp.where(sa == 1, stored, inb)
+                return (inb, loss_sum), None
 
-            T = M + S - 1
-            init = (jnp.zeros_like(h0[0]), jnp.float32(0.0))
-            (_, loss_sum), _ = jax.lax.scan(tick, init, jnp.arange(T))
-            # loss lives on the last stage; psum replicates it over pp
-            return jax.lax.psum(loss_sum / M, "pp") / 1  # noqa: E226
+            init = (jnp.zeros((V,) + h0.shape[1:], h0.dtype),
+                    jnp.float32(0.0))
+            (_, loss_sum), _ = jax.lax.scan(tick, init, sc)
+            # loss lives on the last device; psum replicates it over pp
+            return jax.lax.psum(loss_sum / M, "pp")
 
         stack_spec = jax.tree_util.tree_map(
             lambda p: P(*(("pp",) + (None,) * (p.data.ndim - 1))),

@@ -22,6 +22,7 @@ from typing import Any, Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ....nn.layer.layers import Layer
 from ....tensor import Tensor
@@ -123,14 +124,53 @@ class PipelineLayer(Layer):
                 best_start, best_len = i, j - i
             i = j
         S = self.num_stages
-        if S > 1:
-            if best_len < S or best_len % S:
-                raise ValueError(
+        self.hetero_stages = None
+        if S > 1 and (best_len < S or best_len % S):
+            if best_len >= S:
+                import warnings
+                warnings.warn(
                     f"pipeline middle has {best_len} identical blocks, not "
-                    f"divisible into {S} stages")
+                    f"divisible into {S} stages — falling back to the "
+                    "heterogeneous engine (slower: per-stage switch "
+                    "branches, no VPP). Prefer a block count divisible by "
+                    "num_stages.", stacklevel=3)
+            # non-uniform middle: fall back to heterogeneous per-stage
+            # segmentation (ref pp_layers.py seg_method "param": balance
+            # stages by parameter cost; layers inside a stage may differ)
+            self.prefix = []
+            self.blocks = []
+            self.suffix = []
+            self.hetero_stages = self._segment_hetero(S)
+            return
         self.prefix = self.run_function[:best_start]
         self.blocks = self.run_function[best_start:best_start + best_len]
         self.suffix = self.run_function[best_start + best_len:]
+
+    def _segment_hetero(self, S):
+        """Split run_function into S contiguous groups balancing parameter
+        count (the reference's "param" cost segmentation, pp_layers.py:237).
+        Every stage must be non-empty."""
+        layers = self.run_function
+        n = len(layers)
+        if n < S:
+            raise ValueError(f"{n} layers cannot fill {S} stages")
+        costs = [max(1, sum(int(np.prod(p.shape))
+                            for _, p in lyr.named_parameters()))
+                 for lyr in layers]
+        total = sum(costs)
+        # greedy boundaries at cumulative-cost quantiles, each stage >= 1
+        stages, start, acc = [], 0, 0
+        for s in range(S):
+            target = total * (s + 1) / S
+            end = start + 1
+            acc += costs[start]
+            while end < n - (S - s - 1) and acc + costs[end] / 2 < target:
+                acc += costs[end]
+                end += 1
+            stages.append(layers[start:end])
+            start = end
+        assert start == n and all(stages)
+        return stages
 
     @staticmethod
     def _sig(layer):
