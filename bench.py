@@ -19,6 +19,17 @@ import time
 import traceback
 
 
+def _reexec_cpu(reason: str):
+    """Re-exec this script pinned to CPU for a smoke number (never returns)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_NO_FALLBACK"] = "1"
+    env.setdefault("BENCH_MODEL", "tiny")
+    print(f"bench: {reason}; re-exec on CPU for a smoke number",
+          file=sys.stderr)
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
 def _init_devices():
     """jax.devices() with retry/backoff; falls back to CPU via re-exec.
 
@@ -41,13 +52,7 @@ def _init_devices():
             time.sleep(wait)
     if os.environ.get("BENCH_NO_FALLBACK"):
         raise last_err
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["BENCH_NO_FALLBACK"] = "1"
-    env.setdefault("BENCH_MODEL", "tiny")
-    print(f"bench: TPU backend unavailable after retries ({last_err}); "
-          f"re-exec on CPU for a smoke number", file=sys.stderr)
-    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+    _reexec_cpu(f"TPU backend unavailable after retries ({last_err})")
 
 
 # bf16 peak FLOP/s per chip by TPU generation (match order matters:
@@ -182,14 +187,7 @@ if __name__ == "__main__":
         # jax.devices() succeeded — still fall back to a CPU smoke number
         if ("nable to initialize backend" in str(e)
                 and not os.environ.get("BENCH_NO_FALLBACK")):
-            env = dict(os.environ)
-            env["JAX_PLATFORMS"] = "cpu"
-            env["BENCH_NO_FALLBACK"] = "1"
-            env.setdefault("BENCH_MODEL", "tiny")
-            print("bench: backend died mid-run; re-exec on CPU",
-                  file=sys.stderr)
-            os.execve(sys.executable,
-                      [sys.executable, os.path.abspath(__file__)], env)
+            _reexec_cpu("backend died mid-run")
         # never rc!=0 without a JSON line: emit a diagnostic record instead
         print(json.dumps({
             "metric": "bench_failed", "value": 0.0,

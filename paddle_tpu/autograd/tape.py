@@ -73,6 +73,26 @@ def _amp_wrap(fn: Callable, name: str) -> Callable:
     return wrapped
 
 
+def _check_nan_inf(name: str, outs):
+    """FLAGS_check_nan_inf eager sweep (ref: fluid/eager/nan_inf_utils.h:38
+    — the reference checks every kernel's outputs when the flag is set and
+    aborts naming the op). Concrete (eager) values are checked per op with
+    the op's tape name; traced values can't be branched on — the compiled
+    path checks the step result instead (jit/TrainStep)."""
+    for o in outs:
+        if isinstance(o, jax.core.Tracer):
+            return
+        dt = getattr(o, "dtype", None)
+        if dt is None or not (jnp.issubdtype(dt, jnp.floating)
+                              or jnp.issubdtype(dt, jnp.complexfloating)):
+            continue
+        if not bool(jnp.all(jnp.isfinite(o))):
+            raise FloatingPointError(
+                f"NaN or Inf found in output of op '{name or 'unnamed'}' "
+                f"(shape {getattr(o, 'shape', ())}, dtype {dt}) — "
+                "FLAGS_check_nan_inf is enabled")
+
+
 def apply_op(fn: Callable, *args, n_outputs: int = 1, name: str = "",
              **static_kwargs):
     """Run `fn(*arrays, **static_kwargs)` through the tape.
@@ -102,8 +122,13 @@ def apply_op(fn: Callable, *args, n_outputs: int = 1, name: str = "",
         if not diff_idx:
             record = False
 
+    check = core.get_flag("FLAGS_check_nan_inf", False) not in (
+        False, None, 0, "0", "false", "False", "")
+
     if not record:
         out = fn(*datas, **static_kwargs)
+        if check:
+            _check_nan_inf(name, out if isinstance(out, tuple) else (out,))
         if n_outputs == 1 and not isinstance(out, tuple):
             return Tensor(out, stop_gradient=True)
         return tuple(Tensor(o, stop_gradient=True) for o in out)
@@ -117,6 +142,8 @@ def apply_op(fn: Callable, *args, n_outputs: int = 1, name: str = "",
         return fn(*full, **static_kwargs)
 
     out, vjp_fn = jax.vjp(partial_fn, *[datas[i] for i in diff_idx])
+    if check:
+        _check_nan_inf(name, out if isinstance(out, tuple) else (out,))
 
     diff_inputs = [tensor_args[i] for i in diff_idx]
     if n_outputs == 1 and not isinstance(out, tuple):

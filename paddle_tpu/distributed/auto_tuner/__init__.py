@@ -13,7 +13,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 __all__ = ["AutoTuner", "default_candidates", "prune_by_memory",
-           "prune_by_divisibility"]
+           "prune_by_divisibility", "train_step_trial_fn"]
 
 
 @dataclass
@@ -75,6 +75,57 @@ def prune_by_memory(cands, param_bytes, hbm_bytes_per_chip,
         if need <= hbm_bytes_per_chip * 0.9:
             kept.append(c)
     return kept
+
+
+def train_step_trial_fn(build_model, build_batch, trial_steps=3, warmup=2):
+    """Built-in trial runner: a candidate config becomes a real compiled
+    TrainStep on a mesh with the candidate's axis degrees, timed over
+    `trial_steps` steady-state steps (ref tuner.py:21 — the reference
+    launches a subprocess per trial; single-controller JAX runs them
+    in-process).
+
+    build_model(cfg) -> (model, optimizer, step_fn)  — fresh per trial
+    build_batch(cfg) -> tuple of Tensors fed to the step
+    Returns seconds per step (use metric_mode='min').
+    Candidates with pp_degree > 1 are rejected here (pipeline trials need
+    PipelineParallel; wire a custom trial_fn for those).
+    """
+    import time
+
+    def run(cfg):
+        import jax
+
+        from ..sharding import ShardingPlan
+        from ..topology import HybridCommunicateGroup, set_mesh
+
+        if cfg.get("pp_degree", 1) > 1:
+            raise ValueError("pp trials need a custom trial_fn")
+        from ... import jit as pjit
+        from ..topology import get_mesh
+        saved_mesh = get_mesh()
+        hcg = HybridCommunicateGroup(
+            dp_degree=cfg.get("dp_degree", 1),
+            mp_degree=cfg.get("mp_degree", 1),
+            sharding_degree=cfg.get("sharding_degree", 1))
+        set_mesh(hcg.mesh)
+        try:
+            model, optimizer, step_fn = build_model(cfg)
+            stage = 3 if cfg.get("sharding_degree", 1) > 1 else 0
+            plan = ShardingPlan(hcg.mesh, stage=stage)
+            step = pjit.TrainStep(model, optimizer, step_fn, shard=plan)
+            batch = build_batch(cfg)
+            for _ in range(max(warmup, 1)):   # >=1: compile outside timing
+                loss = step(*batch)
+            float(loss.numpy())
+            t0 = time.perf_counter()
+            for _ in range(trial_steps):
+                loss = step(*batch)
+            float(loss.numpy())
+            return (time.perf_counter() - t0) / trial_steps
+        finally:
+            set_mesh(saved_mesh)
+
+    return run
 
 
 class AutoTuner:

@@ -141,6 +141,7 @@ class HeteroPipelineParallel:
             p.pspec = P("pp", None)
             self._bufs[d] = p
         self._compiled = {}
+        self._layers_stale = False   # buffers were just packed FROM layers
         self.global_rank = 0
 
     # -- paddle-compatible surface ------------------------------------------
@@ -151,9 +152,12 @@ class HeteroPipelineParallel:
         return list(self._bufs.items())
 
     def sync_to_layers(self):
+        if not getattr(self, "_layers_stale", True):
+            return
         for s, m in enumerate(self.metas):
             m.unpack_into_layers(
                 {d: np.asarray(p.data[s]) for d, p in self._bufs.items()})
+        self._layers_stale = False
 
     def state_dict(self):
         self.sync_to_layers()
@@ -166,6 +170,7 @@ class HeteroPipelineParallel:
             self._bufs[d].data = jax.device_put(
                 np.stack([row[d] for row in packed]),
                 NamedSharding(self.mesh, P("pp", None)))
+        self._layers_stale = False
 
     def eval(self):
         self.sync_to_layers()
@@ -351,6 +356,7 @@ class HeteroPipelineParallel:
             self._bufs[d].data = jax.lax.dynamic_update_slice(
                 self._bufs[d].data, saved, (s, off))
         optimizer.clear_grad()
+        self._layers_stale = True
         if lr_scheduler is not None:
             lr_scheduler.step()
         return Tensor(loss)

@@ -1,0 +1,4 @@
+"""fleet.utils (ref: python/paddle/distributed/fleet/utils/)."""
+from . import sequence_parallel_utils  # noqa: F401
+
+__all__ = ["sequence_parallel_utils"]

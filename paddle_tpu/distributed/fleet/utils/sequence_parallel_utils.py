@@ -1,0 +1,157 @@
+"""Megatron-style sequence parallelism composed with tensor parallelism
+(ref: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py:229
+ColumnSequenceParallelLinear, :339 RowSequenceParallelLinear, :191
+register_sequence_parallel_allreduce_hooks; ScatterOp/GatherOp :33,:75).
+
+TPU-native translation: Megatron-SP shards the ACTIVATIONS along the
+sequence dim over the same device group as tensor parallelism (`mp` axis),
+so the layernorm/dropout segments between TP blocks hold S/mp tokens per
+device; entering a column-parallel matmul requires an all-gather of the
+sequence, and leaving a row-parallel matmul emits a reduce-scatter instead
+of the plain TP all-reduce (same total bytes, but the activation memory
+between blocks is 1/mp).
+
+Under GSPMD all four comm ops are DERIVED: these layers annotate the
+sequence dim of their inputs/outputs with `mp` via sharding constraints and
+XLA inserts the all-gather / reduce-scatter pairs during SPMD propagation.
+The reference's hand-written autograd pairs (allgather fwd <-> reduce-
+scatter bwd) fall out of the constraint's transpose. Layout convention is
+[batch, seq, hidden] (this framework's convention; the reference uses
+seq-major [s, b, h] — axis index differs, semantics identical).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ....nn import functional as F
+from ....nn import initializer as I
+from ....nn.layer.layers import Layer
+from ....ops._helpers import to_tensor_like
+from ...sharding import with_partial_annotation
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "scatter", "all_gather", "mark_as_sequence_parallel_parameter",
+    "is_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+    "create_fused_allreduce_gradient_hooks",
+]
+
+_SEQ_AXIS = 1  # [batch, seq, hidden]
+
+
+def scatter(x, axis=_SEQ_AXIS):
+    """Shard the sequence dim over `mp` (ref ScatterOp: split + keep own
+    shard; here a resharding constraint)."""
+    nd = x.ndim
+    spec = [None] * nd
+    spec[axis] = "mp"
+    return with_partial_annotation(x, P(*spec))
+
+
+def all_gather(x, axis=_SEQ_AXIS):
+    """Re-replicate the sequence dim (ref GatherOp / AllGatherOp)."""
+    return with_partial_annotation(x, P(*([None] * x.ndim)))
+
+
+# reference class-style aliases (autograd pairs are implicit here)
+class ScatterOp:
+    apply = staticmethod(scatter)
+
+
+class GatherOp:
+    apply = staticmethod(all_gather)
+
+
+class AllGatherOp:
+    apply = staticmethod(all_gather)
+
+
+class ReduceScatterOp:
+    apply = staticmethod(scatter)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """ref :178 — marks params whose grads the reference must all-reduce
+    over the mp group (layernorm weights acting on seq-sharded acts).
+    Under single-controller GSPMD gradients are global already; kept as a
+    tag for introspection/parity."""
+    parameter.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter):
+    return getattr(parameter, "sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(layer, accumulation_steps=1,
+                                               fuse=False):
+    """ref :191 — no-op under GSPMD (grad allreduce is derived); kept for
+    API parity."""
+    return None
+
+
+def create_fused_allreduce_gradient_hooks(parameters, accumulation_steps=1):
+    return None
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """ref :229. Input arrives sequence-sharded over `mp`; the weight is
+    column-sharded. The all-gather of the sequence before the matmul (and
+    its reduce-scatter transpose in backward) is derived by GSPMD from the
+    input/output constraints."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.pspec = P(None, "mp")
+        self.bias = (self.create_parameter((out_features,), is_bias=True)
+                     if has_bias else None)
+        if self.bias is not None:
+            self.bias.pspec = P("mp")
+
+    def forward(self, x):
+        x = to_tensor_like(x)
+        x = scatter(x)                       # assert/restore seq sharding
+        out = F.linear(x, self.weight, self.bias)
+        nd = out.ndim
+        if self.gather_output:
+            out = with_partial_annotation(out, P(*([None] * nd)))
+        else:
+            spec = [None] * nd
+            spec[-1] = "mp"
+            out = with_partial_annotation(out, P(*spec))
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """ref :339. Input is hidden-sharded (from a column-parallel block);
+    output is REDUCE-SCATTERED along the sequence dim over `mp` instead of
+    all-reduced — the constraint on the output derives exactly that."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.pspec = P("mp", None)
+        self.bias = (self.create_parameter((out_features,), is_bias=True)
+                     if has_bias else None)
+
+    def forward(self, x):
+        x = to_tensor_like(x)
+        nd = x.ndim
+        spec = [None] * nd
+        spec[-1] = "mp"
+        x = with_partial_annotation(x, P(*spec))
+        out = F.linear(x, self.weight, self.bias)
+        return scatter(out)                  # seq-sharded output

@@ -192,17 +192,23 @@ def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
 
 
 def with_partial_annotation(x, spec: P):
-    """with_sharding_constraint inside compiled programs."""
+    """with_sharding_constraint inside compiled programs.
+
+    Routed through the tape (differentiable identity) — constructing a
+    fresh Tensor here would sever the autograd graph and silently zero the
+    gradients of everything upstream (r2 fix).
+    """
     from jax.lax import with_sharding_constraint
     from .topology import get_mesh
     mesh = get_mesh()
     if mesh is None:
         return x
-    data = x.data if isinstance(x, Tensor) else x
-    out = with_sharding_constraint(data, NamedSharding(mesh, spec))
     if isinstance(x, Tensor):
-        return Tensor(out, stop_gradient=x.stop_gradient)
-    return out
+        from ..autograd.tape import apply_op
+        return apply_op(
+            lambda a: with_sharding_constraint(a, NamedSharding(mesh, spec)),
+            x, name="sharding_constraint")
+    return with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
 class ShardingPlan:

@@ -254,6 +254,24 @@ class TrainStep:
         if self.scaler is not None:
             self.scaler._set_traced_state(new_scaler)
         opt._step_count += 1
+        if core.get_flag("FLAGS_check_nan_inf", False) not in (
+                False, None, 0, "0", "false", "False", ""):
+            # compiled-path sweep: values can't be branched on at trace
+            # time, so the check runs on the step RESULT; rerun in eager
+            # mode for per-op localization (tape._check_nan_inf)
+            import numpy as _np
+            if not _np.isfinite(_np.asarray(loss)).all():
+                raise FloatingPointError(
+                    "NaN or Inf in TrainStep loss (FLAGS_check_nan_inf). "
+                    "Rerun the step eagerly (without TrainStep) to get the "
+                    "failing op's name.")
+            bad = [k for k, v in new_params.items()
+                   if jnp.issubdtype(v.dtype, jnp.floating)
+                   and not _np.isfinite(_np.asarray(v)).all()]
+            if bad:
+                raise FloatingPointError(
+                    f"NaN or Inf in updated parameters {bad[:5]} "
+                    "(FLAGS_check_nan_inf)")
         if hasattr(opt._lr, "step") and not isinstance(opt._lr, float):
             pass  # LR scheduler stepping is the caller's choice (paddle semantics)
         return Tensor(loss)

@@ -55,6 +55,11 @@ class LlamaConfig:
     # cutting XLA compile time ~L-fold with identical numerics (and the
     # standard trick for large-L TPU LLMs)
     scan_layers: bool = True
+    # Megatron-style sequence parallelism: residual-stream activations are
+    # sharded along seq over the `mp` axis between TP blocks (ref
+    # fleet/utils/sequence_parallel_utils.py); GSPMD derives the
+    # all-gather/reduce-scatter pairs from the annotations
+    sequence_parallel: bool = False
     dtype: str = "bfloat16"
 
     @property
@@ -166,10 +171,20 @@ class LlamaDecoderLayer(Layer):
                                                      cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg)
         self.use_recompute = cfg.use_recompute
+        self.sequence_parallel = cfg.sequence_parallel
 
     def forward(self, x, position_ids=None):
+        if self.sequence_parallel:
+            from ..distributed.fleet.utils.sequence_parallel_utils import \
+                scatter
+            x = scatter(x)
         h = x + self.self_attn(self.input_layernorm(x), position_ids)
-        return h + self.mlp(self.post_attention_layernorm(h))
+        h = h + self.mlp(self.post_attention_layernorm(h))
+        if self.sequence_parallel:
+            from ..distributed.fleet.utils.sequence_parallel_utils import \
+                scatter
+            h = scatter(h)
+        return h
 
 
 class LlamaModel(Layer):

@@ -36,19 +36,22 @@ def static_int(x):
     return x
 
 
-def make_unary(jfn, name):
-    def op(x, name_arg=None, name=None):
-        return apply_op(jfn, to_tensor_like(x), name=name)
-    op.__name__ = name
-    op.__qualname__ = name
-    op.__doc__ = f"TPU-native `paddle.{name}` (jnp composition)."
+def make_unary(jfn, op_name):
+    # the paddle-API `name=` kwarg (a user label) must NOT shadow the tape
+    # op name — AMP lists and FLAGS_check_nan_inf key off the latter
+    def op(x, name=None):
+        return apply_op(jfn, to_tensor_like(x), name=op_name)
+    op.__name__ = op_name
+    op.__qualname__ = op_name
+    op.__doc__ = f"TPU-native `paddle.{op_name}` (jnp composition)."
     return op
 
 
-def make_binary(jfn, name):
+def make_binary(jfn, op_name):
     def op(x, y, name=None):
-        return apply_op(jfn, to_tensor_like(x), to_tensor_like(y), name=name)
-    op.__name__ = name
-    op.__qualname__ = name
-    op.__doc__ = f"TPU-native `paddle.{name}` (jnp composition)."
+        return apply_op(jfn, to_tensor_like(x), to_tensor_like(y),
+                        name=op_name)
+    op.__name__ = op_name
+    op.__qualname__ = op_name
+    op.__doc__ = f"TPU-native `paddle.{op_name}` (jnp composition)."
     return op

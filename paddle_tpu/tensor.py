@@ -246,7 +246,8 @@ jax.tree_util.register_pytree_node(
 
 class Parameter(Tensor):
     """Trainable tensor (ref: python/paddle/base/framework.py Parameter)."""
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed")
+    __slots__ = ("trainable", "optimize_attr", "regularizer",
+                 "is_distributed", "sequence_parallel")
 
     def __init__(self, data, stop_gradient: bool = False, name: str = "",
                  trainable: bool = True):

@@ -1,0 +1,49 @@
+"""FLAGS_check_nan_inf (VERDICT r1 item 9; ref fluid/eager/nan_inf_utils.h:38)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+
+
+@pytest.fixture
+def nan_flag():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    yield
+    paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_eager_names_the_failing_op(nan_flag):
+    x = paddle.to_tensor(np.array([[-1.0, 2.0]], np.float32))
+    with pytest.raises(FloatingPointError, match="op 'log'"):
+        paddle.log(x)  # log(-1) = nan
+
+
+def test_eager_clean_path_unaffected(nan_flag):
+    m = nn.Linear(4, 2)
+    x = paddle.to_tensor(np.random.randn(3, 4).astype(np.float32))
+    loss = m(x).sum()
+    loss.backward()
+    assert m.weight.grad is not None
+
+
+def test_trainstep_detects_nan_loss(nan_flag):
+    paddle.seed(0)
+    m = nn.Linear(4, 2)
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, o, lambda a, b: F.mse_loss(m(a), b))
+    x = np.random.randn(4, 4).astype(np.float32)
+    y = np.random.randn(4, 2).astype(np.float32)
+    step(paddle.to_tensor(x), paddle.to_tensor(y))  # clean step OK
+    x[0, 0] = np.nan
+    with pytest.raises(FloatingPointError, match="TrainStep"):
+        step(paddle.to_tensor(x), paddle.to_tensor(y))
+
+
+def test_flag_off_no_check():
+    paddle.set_flags({"FLAGS_check_nan_inf": False})
+    x = paddle.to_tensor(np.array([[-1.0]], np.float32))
+    out = paddle.log(x)
+    assert np.isnan(np.asarray(out.numpy())).all()

@@ -1,0 +1,106 @@
+"""Megatron sequence-parallel layers (VERDICT r1 item 5).
+
+Ref parity: fleet/utils/sequence_parallel_utils.py:229 (Column), :339
+(Row), :33/:75 (Scatter/Gather). Numerics must match the TP-only path on
+the CPU mesh — sequence parallelism is a resharding, not an algorithm
+change.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed.fleet.utils.sequence_parallel_utils import (
+    ColumnSequenceParallelLinear, RowSequenceParallelLinear, GatherOp,
+    ScatterOp, mark_as_sequence_parallel_parameter,
+    is_sequence_parallel_parameter)
+from paddle_tpu.distributed.topology import (HybridCommunicateGroup,
+                                             set_mesh)
+
+
+def _mp_mesh(mp=2):
+    hcg = HybridCommunicateGroup(dp_degree=8 // mp, mp_degree=mp)
+    set_mesh(hcg.mesh)
+    return hcg
+
+
+class TestSequenceParallelLinears:
+    def test_column_row_pair_matches_plain(self):
+        """Column-SP -> gelu -> Row-SP == plain Linear -> gelu -> Linear."""
+        _mp_mesh(2)
+        paddle.seed(0)
+        col = ColumnSequenceParallelLinear(16, 32, has_bias=True)
+        row = RowSequenceParallelLinear(32, 16, has_bias=True)
+        ref1 = nn.Linear(16, 32)
+        ref2 = nn.Linear(32, 16)
+        ref1.weight.data = col.weight.data
+        ref1.bias.data = col.bias.data
+        ref2.weight.data = row.weight.data
+        ref2.bias.data = row.bias.data
+        x = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal((2, 8, 16))
+            .astype(np.float32))
+        got = row(F.gelu(col(x))).numpy()
+        want = ref2(F.gelu(ref1(x))).numpy()
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grads_flow_through_annotations(self):
+        """Regression: with_partial_annotation used to sever the tape."""
+        _mp_mesh(2)
+        paddle.seed(1)
+        col = ColumnSequenceParallelLinear(8, 16)
+        row = RowSequenceParallelLinear(16, 8)
+        x = paddle.to_tensor(
+            np.random.default_rng(1).standard_normal((2, 4, 8))
+            .astype(np.float32))
+        loss = row(F.relu(col(x))).sum()
+        loss.backward()
+        for p in [col.weight, col.bias, row.weight, row.bias]:
+            assert p.grad is not None, "annotation severed the tape"
+            assert np.isfinite(np.asarray(p.grad.numpy())).all()
+
+    def test_scatter_gather_roundtrip(self):
+        _mp_mesh(2)
+        x = paddle.to_tensor(
+            np.random.default_rng(2).standard_normal((2, 8, 4))
+            .astype(np.float32))
+        y = GatherOp.apply(ScatterOp.apply(x))
+        np.testing.assert_allclose(np.asarray(y.numpy()),
+                                   np.asarray(x.numpy()))
+
+    def test_mark_parameter(self):
+        lyr = nn.LayerNorm(8)
+        mark_as_sequence_parallel_parameter(lyr.weight)
+        assert is_sequence_parallel_parameter(lyr.weight)
+        assert not is_sequence_parallel_parameter(lyr.bias)
+
+
+class TestLlamaSequenceParallel:
+    def test_llama_sp_matches_tp_only(self):
+        """LLaMA with sequence_parallel=True must match TP-only numerics
+        through a compiled sharded train step."""
+        from paddle_tpu.distributed.sharding import ShardingPlan
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+        losses = {}
+        for sp in (False, True):
+            hcg = _mp_mesh(2)
+            paddle.seed(0)
+            cfg = llama_tiny(use_recompute=False, sequence_parallel=sp)
+            model = LlamaForCausalLM(cfg)
+            o = opt.AdamW(learning_rate=1e-3,
+                          parameters=model.parameters())
+            plan = ShardingPlan(hcg.mesh, stage=0)
+            step = paddle.jit.TrainStep(model, o,
+                                        lambda i, l: model.loss(i, l),
+                                        shard=plan)
+            rng = np.random.default_rng(0)
+            ids = paddle.to_tensor(
+                rng.integers(0, cfg.vocab_size, (4, 64)).astype(np.int32))
+            losses[sp] = [float(step(ids, ids).numpy()) for _ in range(3)]
+        np.testing.assert_allclose(losses[True], losses[False],
+                                   rtol=2e-5, atol=1e-6)
+        assert losses[True][-1] < losses[True][0]
