@@ -41,18 +41,24 @@ def is_grad_enabled():
     return _core.is_grad_enabled()
 
 
-def in_dynamic_mode():
-    return True
-
-
 def disable_static(place=None):
+    from . import static as _static
+    _static._disable()
     return None
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu is dynamic-first; use paddle_tpu.jit.to_static for "
-        "compiled execution (XLA replaces the static-graph executor)")
+    """Switch the tape into program-recording mode (paddle.static shim —
+    ops record into default_main_program and replay via static.Executor,
+    compiled under jit). Dynamic mode + jit.TrainStep remains the
+    recommended path on TPU."""
+    from . import static as _static
+    _static._enable()
+
+
+def in_dynamic_mode():
+    from . import static as _static
+    return not _static.in_static_mode()
 
 
 def device_count():
@@ -88,6 +94,10 @@ from . import geometric   # noqa: F401,E402
 from . import audio       # noqa: F401,E402
 from . import profiler    # noqa: F401,E402
 from . import incubate    # noqa: F401,E402
+from . import inference   # noqa: F401,E402
+from . import text        # noqa: F401,E402
+from . import static      # noqa: F401,E402
+from . import onnx        # noqa: F401,E402
 from .hapi import Model   # noqa: F401,E402
 from .framework.io import load, save  # noqa: F401,E402
 from .nn.layer.layers import Layer  # noqa: F401,E402

@@ -16,12 +16,17 @@ sweep with per-node cotangent buffers (ref: GradTensorHolder).
 """
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 
 from ..framework import core
+
+
+# set to static.record_op by paddle.enable_static(); None in dynamic mode
+_STATIC_RECORDER: Optional[Callable] = None
 
 
 class GradNode:
@@ -125,13 +130,23 @@ def apply_op(fn: Callable, *args, n_outputs: int = 1, name: str = "",
     check = core.get_flag("FLAGS_check_nan_inf", False) not in (
         False, None, 0, "0", "false", "False", "")
 
+    def _maybe_record(outs):
+        if _STATIC_RECORDER is not None:  # set by paddle.enable_static()
+            _STATIC_RECORDER(functools.partial(fn, **static_kwargs)
+                             if static_kwargs else fn,
+                             tensor_args, datas, outs, name)
+
     if not record:
         out = fn(*datas, **static_kwargs)
         if check:
             _check_nan_inf(name, out if isinstance(out, tuple) else (out,))
         if n_outputs == 1 and not isinstance(out, tuple):
-            return Tensor(out, stop_gradient=True)
-        return tuple(Tensor(o, stop_gradient=True) for o in out)
+            t = Tensor(out, stop_gradient=True)
+            _maybe_record((t,))
+            return t
+        res = tuple(Tensor(o, stop_gradient=True) for o in out)
+        _maybe_record(res)
+        return res
 
     diff_set = set(diff_idx)
 
@@ -150,6 +165,7 @@ def apply_op(fn: Callable, *args, n_outputs: int = 1, name: str = "",
         node = GradNode(vjp_fn, diff_inputs, [(out.shape, out.dtype)], name)
         t = Tensor(out, stop_gradient=False)
         t._node, t._out_idx = node, 0
+        _maybe_record((t,))
         return t
     out = tuple(out)
     node = GradNode(vjp_fn, diff_inputs, [(o.shape, o.dtype) for o in out], name)
@@ -162,6 +178,7 @@ def apply_op(fn: Callable, *args, n_outputs: int = 1, name: str = "",
         else:
             t.stop_gradient = True
         res.append(t)
+    _maybe_record(tuple(res))
     return tuple(res)
 
 

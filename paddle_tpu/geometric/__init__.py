@@ -108,3 +108,168 @@ segment_sum = _segment_api("sum")
 segment_mean = _segment_api("mean")
 segment_max = _segment_api("max")
 segment_min = _segment_api("min")
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """ref: geometric/sampling/neighbors.py graph_sample_neighbors — CSC
+    neighbor sampling (host-side: sampling sizes are data-dependent, the
+    reference kernel is also a host-driven op)."""
+    import numpy as np
+
+    from ..framework import core
+    from ..ops._helpers import unwrap
+    from ..tensor import Tensor
+
+    r = np.asarray(unwrap(row))
+    cp = np.asarray(unwrap(colptr))
+    nodes = np.asarray(unwrap(input_nodes)).reshape(-1)
+    rng = np.random.default_rng(int(jax.random.randint(
+        core.next_rng_key(), (), 0, 2 ** 31 - 1)))
+    out_n, out_count, out_eids = [], [], []
+    for n in nodes:
+        beg, end = int(cp[n]), int(cp[n + 1])
+        neigh = r[beg:end]
+        ids = np.arange(beg, end)
+        if 0 < sample_size < len(neigh):
+            pick = rng.choice(len(neigh), size=sample_size, replace=False)
+            neigh, ids = neigh[pick], ids[pick]
+        out_n.append(neigh)
+        out_eids.append(ids)
+        out_count.append(len(neigh))
+    nb = np.concatenate(out_n) if out_n else np.array([], r.dtype)
+    ct = np.array(out_count, np.int32)
+    res = [Tensor(jnp.asarray(nb), stop_gradient=True),
+           Tensor(jnp.asarray(ct), stop_gradient=True)]
+    if return_eids:
+        ev = (np.asarray(unwrap(eids))[np.concatenate(out_eids)]
+              if eids is not None else np.concatenate(out_eids))
+        res.append(Tensor(jnp.asarray(ev), stop_gradient=True))
+    return tuple(res)
+
+
+graph_sample_neighbors = sample_neighbors
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """ref: geometric weighted_sample_neighbors — weight-proportional."""
+    import numpy as np
+
+    from ..framework import core
+    from ..ops._helpers import unwrap
+    from ..tensor import Tensor
+
+    r = np.asarray(unwrap(row))
+    cp = np.asarray(unwrap(colptr))
+    w = np.asarray(unwrap(edge_weight)).astype(np.float64)
+    nodes = np.asarray(unwrap(input_nodes)).reshape(-1)
+    rng = np.random.default_rng(int(jax.random.randint(
+        core.next_rng_key(), (), 0, 2 ** 31 - 1)))
+    out_n, out_count, out_eids = [], [], []
+    for n in nodes:
+        beg, end = int(cp[n]), int(cp[n + 1])
+        neigh = r[beg:end]
+        ids = np.arange(beg, end)
+        if 0 < sample_size < len(neigh):
+            p = w[beg:end]
+            p = p / p.sum() if p.sum() > 0 else None
+            pick = rng.choice(len(neigh), size=sample_size, replace=False,
+                              p=p)
+            neigh, ids = neigh[pick], ids[pick]
+        out_n.append(neigh)
+        out_eids.append(ids)
+        out_count.append(len(neigh))
+    nb = np.concatenate(out_n) if out_n else np.array([], r.dtype)
+    ct = np.array(out_count, np.int32)
+    res = [Tensor(jnp.asarray(nb), stop_gradient=True),
+           Tensor(jnp.asarray(ct), stop_gradient=True)]
+    if return_eids:
+        pos = (np.concatenate(out_eids) if out_eids
+               else np.array([], np.int64))
+        # map CSC positions through user-provided edge ids, like
+        # sample_neighbors does
+        ev = (np.asarray(unwrap(eids))[pos] if eids is not None else pos)
+        res.append(Tensor(jnp.asarray(ev), stop_gradient=True))
+    return tuple(res)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """ref: geometric/reindex.py reindex_graph — compact global node ids
+    into local [0, n) ids over (x | neighbors)."""
+    import numpy as np
+
+    from ..ops._helpers import unwrap
+    from ..tensor import Tensor
+
+    xs = np.asarray(unwrap(x)).reshape(-1)
+    nb = np.asarray(unwrap(neighbors)).reshape(-1)
+    ct = np.asarray(unwrap(count)).reshape(-1)
+    mapping = {}
+    for v in xs:
+        mapping.setdefault(int(v), len(mapping))
+    for v in nb:
+        mapping.setdefault(int(v), len(mapping))
+    reindexed = np.array([mapping[int(v)] for v in nb], np.int64)
+    # edges: src = reindexed neighbor, dst = its center node repeated
+    dst = np.repeat(np.arange(len(xs), dtype=np.int64), ct)
+    nodes = np.array(sorted(mapping, key=mapping.get), np.int64)
+    return (Tensor(jnp.asarray(reindexed), stop_gradient=True),
+            Tensor(jnp.asarray(dst), stop_gradient=True),
+            Tensor(jnp.asarray(nodes), stop_gradient=True))
+
+
+def khop_sampler(row, colptr, input_nodes, sample_sizes, sorted_eids=None,
+                 return_eids=False, name=None):
+    """ref: geometric graph_khop_sampler — multi-hop neighbor sampling.
+
+    Returns (edge_src, edge_dst, sample_index, reindex[, edge_eids]):
+    edges over ALL hops in LOCAL ids, the global-id node list
+    (sample_index, centers first), and the centers' local ids — the
+    mutually-consistent contract a GNN subgraph builder needs."""
+    import numpy as np
+
+    from ..ops._helpers import unwrap
+    from ..tensor import Tensor
+
+    centers = np.asarray(unwrap(input_nodes)).reshape(-1)
+    cur = centers
+    hop_src, hop_dst, hop_eids = [], [], []
+    for k in (sample_sizes if isinstance(sample_sizes, (list, tuple))
+              else [sample_sizes]):
+        res = sample_neighbors(row, colptr, jnp.asarray(cur),
+                               sample_size=int(k), eids=sorted_eids,
+                               return_eids=True)
+        nb = np.asarray(res[0].numpy())
+        ct = np.asarray(res[1].numpy())
+        ei = np.asarray(res[2].numpy())
+        hop_src.append(nb)
+        hop_dst.append(np.repeat(cur, ct))
+        hop_eids.append(ei)
+        cur = np.unique(nb)
+    src = np.concatenate(hop_src) if hop_src else np.array([], np.int64)
+    dst = np.concatenate(hop_dst) if hop_dst else np.array([], np.int64)
+    # one global->local mapping over centers + every sampled node
+    mapping = {}
+    for v in centers:
+        mapping.setdefault(int(v), len(mapping))
+    for v in np.concatenate([dst, src]) if len(src) else []:
+        mapping.setdefault(int(v), len(mapping))
+    loc_src = np.array([mapping[int(v)] for v in src], np.int64)
+    loc_dst = np.array([mapping[int(v)] for v in dst], np.int64)
+    sample_index = np.array(sorted(mapping, key=mapping.get), np.int64)
+    reindex = np.array([mapping[int(v)] for v in centers], np.int64)
+    out = [Tensor(jnp.asarray(loc_src), stop_gradient=True),
+           Tensor(jnp.asarray(loc_dst), stop_gradient=True),
+           Tensor(jnp.asarray(sample_index), stop_gradient=True),
+           Tensor(jnp.asarray(reindex), stop_gradient=True)]
+    if return_eids:
+        out.append(Tensor(jnp.asarray(np.concatenate(hop_eids)),
+                          stop_gradient=True))
+    return tuple(out)
+
+
+graph_khop_sampler = khop_sampler

@@ -116,3 +116,195 @@ def swiglu(x, y=None):
         u, g = jnp.split(a, 2, axis=-1)
         return jax.nn.silu(u) * g
     return apply_op(f, xt, name="swiglu")
+
+
+def masked_multihead_attention(x, cache_kv=None, src_mask=None, *,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, qkv_out_scale=None,
+                               out_shift=None, out_smooth=None, seq_len=1,
+                               rotary_emb_dims=0, use_neox_rotary_style=False,
+                               compute_dtype="default", **kw):
+    """Single-token decode attention over a contiguous KV cache
+    (ref: phi masked_multihead_attention_ / fused_multi_transformer decode
+    mode). x: qkv for ONE step [B, 3*nh*d] or [B, 1, 3, nh, d]-style
+    packed; cache_kv: [2, B, nh, S_max, d] (paddle layout). Returns
+    (out [B, nh*d], updated cache_kv).
+
+    TPU-native: routes through kernels.paged_attention.decode_attention
+    (Pallas paged kernel on TPU, dense fallback elsewhere).
+    """
+    from ....kernels.paged_attention import decode_attention
+    from ....tensor import Tensor
+
+    xv = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    cache = (cache_kv.data if isinstance(cache_kv, Tensor)
+             else jnp.asarray(cache_kv))
+    _, B, nh, S_max, d = cache.shape
+    qkv = xv.reshape(B, 3, nh, d)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    if sequence_lengths is None:
+        raise ValueError("sequence_lengths (tokens already cached) required")
+    sl = (sequence_lengths.data if isinstance(sequence_lengths, Tensor)
+          else jnp.asarray(sequence_lengths)).astype(jnp.int32).reshape(B)
+    if rotary_emb_dims and rotary_emb_dims > 0:
+        # apply RoPE to this step's q/k at their absolute positions
+        from ....kernels.rope import apply_rope
+        qr, kr = apply_rope(q[:, None], k[:, None],
+                            position_ids=sl[:, None], seq_len=S_max)
+        q, k = qr[:, 0], kr[:, 0]
+    # write this step's k/v at position sl
+    oh = jax.nn.one_hot(sl, S_max, dtype=cache.dtype)        # [B, S_max]
+    ck = cache[0] * (1 - oh[:, None, :, None]) + \
+        oh[:, None, :, None] * k[:, :, None, :].astype(cache.dtype)
+    cv = cache[1] * (1 - oh[:, None, :, None]) + \
+        oh[:, None, :, None] * v[:, :, None, :].astype(cache.dtype)
+    # [B, nh, S, d] -> [B, S, nh, d] for the kernel
+    out = decode_attention(q[:, None], jnp.swapaxes(ck, 1, 2),
+                           jnp.swapaxes(cv, 1, 2), sl + 1)
+    new_cache = jnp.stack([ck, cv])
+    return (Tensor(out[:, 0].reshape(B, nh * d), stop_gradient=True),
+            Tensor(new_cache, stop_gradient=True))
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
+                              seq_lens_decoder, seq_lens_this_time,
+                              padding_offsets=None, cum_offsets=None,
+                              cu_seqlens_q=None, cu_seqlens_k=None,
+                              block_tables=None, *, max_seq_len=None,
+                              block_size=16, use_neox_style=False, **kw):
+    """Paged ("block") KV decode attention
+    (ref: phi block_multihead_attention_ — the paged-KV serving kernel).
+    key/value_cache: page pools [num_pages, kvh, block_size, d];
+    block_tables: i32[B, pages_per_seq]. Decode-step path only (one new
+    token per sequence); prefill goes through the flash path.
+    Returns (out [B, nh*d], key_cache, value_cache).
+    """
+    from ....kernels.paged_attention import paged_decode_attention
+    from ....tensor import Tensor
+
+    qv = qkv.data if isinstance(qkv, Tensor) else jnp.asarray(qkv)
+    kc = (key_cache.data if isinstance(key_cache, Tensor)
+          else jnp.asarray(key_cache))
+    vc = (value_cache.data if isinstance(value_cache, Tensor)
+          else jnp.asarray(value_cache))
+    bt = (block_tables.data if isinstance(block_tables, Tensor)
+          else jnp.asarray(block_tables)).astype(jnp.int32)
+    sl = (seq_lens_decoder.data if isinstance(seq_lens_decoder, Tensor)
+          else jnp.asarray(seq_lens_decoder)).astype(jnp.int32).reshape(-1)
+    n_pages, kvh, bs, d = kc.shape
+    B = bt.shape[0]
+    # packed layout is (nh + 2*kvh) heads — NOT 3 equal groups under GQA
+    total_heads = qv.reshape(B, -1, d).shape[1]
+    nh = total_heads - 2 * kvh
+    heads = qv.reshape(B, total_heads, d)
+    q = heads[:, :nh]                                # [B, nh, d]
+    k = heads[:, nh:nh + kvh]                        # [B, kvh, d]
+    v = heads[:, nh + kvh:]                          # [B, kvh, d]
+    # write the new token into its page slot
+    page_of = bt[jnp.arange(B), sl // bs]            # [B]
+    slot_of = sl % bs
+    kc = kc.at[page_of, :, slot_of].set(k.astype(kc.dtype))
+    vc = vc.at[page_of, :, slot_of].set(v.astype(vc.dtype))
+    # pool layout for the kernel: [kvh, pages, bs, d]
+    out = paged_decode_attention(q, jnp.moveaxis(kc, 1, 0),
+                                 jnp.moveaxis(vc, 1, 0), sl + 1, bt)
+    return (Tensor(out.reshape(B, -1), stop_gradient=True),
+            Tensor(kc, stop_gradient=True), Tensor(vc, stop_gradient=True))
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """ref: phi weight_quantize kernel (llm.int8 / weight-only paths).
+    x: [K, N] weights -> (int8 quantized [K, N], per-channel scales [N])."""
+    from ....tensor import Tensor
+    w = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    wf = w.astype(jnp.float32)
+    if algo == "weight_only_int4":
+        qmax = 7.0
+    else:
+        qmax = 127.0
+    scale = jnp.max(jnp.abs(wf), axis=0) / qmax            # [N]
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(wf / scale[None, :]), -qmax - 1, qmax)
+    return (Tensor(q.astype(jnp.int8), stop_gradient=True),
+            Tensor(scale, stop_gradient=True))
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8",
+                      out_dtype="float16", group_size=-1):
+    """ref: phi weight_dequantize kernel."""
+    from ....framework import core
+    from ....tensor import Tensor
+    q = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    s = scale.data if isinstance(scale, Tensor) else jnp.asarray(scale)
+    out = q.astype(jnp.float32) * s[None, :]
+    return Tensor(out.astype(core.convert_dtype(out_dtype)),
+                  stop_gradient=True)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """ref: phi weight_only_linear — activation in bf16/f16, weight int8
+    with per-channel scales. On TPU the dequant fuses into the matmul
+    epilogue (XLA), matching the reference kernel's intent."""
+    from ....autograd.tape import apply_op
+    from ....ops._helpers import to_tensor_like
+
+    xt = to_tensor_like(x)
+    wt = to_tensor_like(weight)
+    st = to_tensor_like(weight_scale)
+    args = [xt, wt, st]
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+
+    def f(a, q, s, *b):
+        w = q.astype(a.dtype) * s.astype(a.dtype)[None, :]
+        out = a @ w
+        if b:
+            out = out + b[0]
+        return out
+
+    return apply_op(f, *args, name="weight_only_linear")
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """ref: phi llm_int8_linear (LLM.int8() mixed decomposition). TPU
+    formulation: the outlier decomposition exists to save int8 tensor-core
+    precision on CUDA; on TPU the bf16 matmul is native, so this lowers to
+    weight_only_linear (numerically stronger than the reference's int8
+    path)."""
+    return weight_only_linear(x, weight, bias, weight_scale)
+
+
+def apply_per_channel_scale(x, scales):
+    """ref: phi apply_per_channel_scale — x * scales over the last dim
+    (smooth-quant activation pre-scaling)."""
+    from ....autograd.tape import apply_op
+    from ....ops._helpers import to_tensor_like
+    return apply_op(lambda a, s: a * s.astype(a.dtype)[None, :],
+                    to_tensor_like(x), to_tensor_like(scales),
+                    name="apply_per_channel_scale")
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """ref: fused_gemm_epilogue kernel (matmul + bias in one pass — XLA
+    fuses the epilogue on TPU natively)."""
+    from ....autograd.tape import apply_op
+    from ....ops._helpers import to_tensor_like
+
+    args = [to_tensor_like(x), to_tensor_like(weight)]
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+
+    def f(a, w, *b):
+        if transpose_weight:
+            w = jnp.swapaxes(w, -1, -2)
+        out = a @ w
+        if b:
+            out = out + b[0]
+        return out
+
+    return apply_op(f, *args, name="fused_linear")
+
+
+fused_gemm_epilogue = fused_linear

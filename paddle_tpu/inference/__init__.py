@@ -1,0 +1,207 @@
+"""paddle.inference — the deployment API (L8).
+
+ref: paddle/fluid/inference/api/analysis_predictor.cc:1280 (Run), :2320
+(ZeroCopyRun), python/paddle/inference/. The reference predictor loads a
+saved Program, runs 159 IR fusion passes, and executes via InterpreterCore
+(optionally TensorRT). TPU-native equivalent: the artifact IS a compiled
+program — `jit.save` serializes StableHLO (jax.export) and the predictor
+replays it through the XLA runtime; the pass pipeline's job (fusion,
+layout, constant folding) is done by XLA at artifact build time, so
+config knobs for IR passes are accepted-and-ignored shims.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor as PTensor
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PrecisionType", "PlaceType", "convert_to_mixed_precision"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"
+    XPU = "xpu"
+    CUSTOM = "custom"
+
+
+class Config:
+    """ref: paddle_infer.Config. Knobs that steer CUDA/TRT specifics are
+    accepted for API compatibility and ignored on TPU (XLA already applies
+    the equivalent optimizations when the artifact was exported)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # paddle convention: prog_file may be the common prefix
+        self.model_path = prog_file
+        self.params_path = params_file
+        self._ir_optim = True
+        self._device = "tpu"
+        self._mem_optim = True
+
+    def set_prog_file(self, p):
+        self.model_path = p
+
+    def set_params_file(self, p):
+        self.params_path = p
+
+    def set_model(self, prog, params=None):
+        self.model_path = prog
+        self.params_path = params
+
+    def model_dir(self):
+        return self.model_path
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=None):
+        self._device = "gpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_xpu(self, *a, **kw):
+        self._device = "xpu"
+
+    def enable_custom_device(self, device_type, device_id=0):
+        self._device = device_type
+
+    def use_gpu(self):
+        return self._device == "gpu"
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def ir_optim(self):
+        return self._ir_optim
+
+    def enable_memory_optim(self, flag=True):
+        self._mem_optim = flag
+
+    def switch_use_feed_fetch_ops(self, flag):
+        pass
+
+    def switch_specify_input_names(self, flag=True):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **kw):
+        pass  # TensorRT has no TPU analog; XLA compiled the artifact
+
+    def enable_mkldnn(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def summary(self):
+        return (f"Config(model={self.model_path}, device={self._device}, "
+                f"ir_optim={self._ir_optim})")
+
+
+class Tensor:
+    """Zero-copy handle (ref paddle_infer.Tensor: copy_from_cpu/copy_to_cpu)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = jnp.asarray(arr)
+
+    def share_external_data(self, arr):
+        self.copy_from_cpu(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else []
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+
+class Predictor:
+    """ref AnalysisPredictor. Wraps a TranslatedLayer (exported StableHLO)
+    or any callable Layer; run() is ZeroCopyRun (device arrays in/out)."""
+
+    def __init__(self, config_or_layer):
+        if isinstance(config_or_layer, Config):
+            from .. import jit
+            path = config_or_layer.model_path
+            if path is None:
+                raise ValueError("Config.model_path not set")
+            if path.endswith(".pdmodel"):
+                path = path[: -len(".pdmodel")]
+            self._layer = jit.load(path)
+            if not callable(self._layer):
+                raise ValueError(
+                    f"no .pdmodel artifact next to {path}; re-export with "
+                    "paddle.jit.save(layer, path, input_spec=[...])")
+        else:
+            self._layer = config_or_layer
+        self._n_inputs = None
+        self._inputs: Dict[str, Tensor] = {}
+        self._outputs: List = []
+
+    def get_input_names(self):
+        exp = getattr(self._layer, "_exported", None)
+        n = (len(exp.in_avals) - len(getattr(self._layer, "_state", {}))
+             if exp is not None else (self._n_inputs or 1))
+        return [f"input_{i}" for i in range(max(n, 1))]
+
+    def get_input_handle(self, name):
+        return self._inputs.setdefault(name, Tensor(name))
+
+    get_input_tensor = get_input_handle
+
+    def run(self, inputs: Optional[list] = None):
+        if inputs is not None:                       # new-style API
+            outs = self._layer(*inputs)
+            return list(outs) if isinstance(outs, (tuple, list)) else [outs]
+        args = [self._inputs[n]._value for n in self.get_input_names()
+                if n in self._inputs]
+        outs = self._layer(*args)
+        outs = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+        self._outputs = outs
+        return True
+
+    def get_output_names(self):
+        return [f"output_{i}" for i in range(max(len(self._outputs), 1))]
+
+    def get_output_handle(self, name):
+        idx = int(name.rsplit("_", 1)[1])
+        t = Tensor(name)
+        out = self._outputs[idx]
+        t._value = out.data if isinstance(out, PTensor) else out
+        return t
+
+    get_output_tensor = get_output_handle
+
+    def try_shrink_memory(self):
+        pass
+
+    def clear_intermediate_tensor(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    """ref: paddle_infer.create_predictor."""
+    return Predictor(config)
+
+
+def convert_to_mixed_precision(*a, **kw):
+    raise NotImplementedError(
+        "mixed-precision artifact conversion: re-export with "
+        "paddle.jit.save under amp.auto_cast instead")

@@ -24,7 +24,8 @@ from ..framework import core
 from ..tensor import Tensor
 
 __all__ = ["to_static", "not_to_static", "TrainStep", "train_step", "save",
-           "load", "ignore_module", "enable_to_static"]
+           "load", "ignore_module", "enable_to_static", "InputSpec",
+           "TranslatedLayer"]
 
 _to_static_enabled = True
 
@@ -58,6 +59,7 @@ class StaticFunction:
                 self._layer = function.__self__
         self._compiled = None
         self._input_spec = input_spec
+        self._fallback = False
 
     def _build(self):
         layer = self._layer
@@ -80,15 +82,33 @@ class StaticFunction:
         self._compiled = compiled
 
     def __call__(self, *args, **kwargs):
-        if not _to_static_enabled:
+        if not _to_static_enabled or self._fallback:
             return self._fn(*args, **kwargs)
         if self._compiled is None:
             self._build()
         state = ({k: t.data for k, t in self._layer.state_dict().items()}
                  if self._layer is not None else {})
         key = core.next_rng_key()
-        out, new_state = self._compiled(state, key,
-                                        _tree_unbox(args), _tree_unbox(kwargs))
+        try:
+            out, new_state = self._compiled(state, key, _tree_unbox(args),
+                                            _tree_unbox(kwargs))
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.NonConcreteBooleanIndexError) as e:
+            # SOT-style graph break (ref jit/sot/: bytecode tracer falls
+            # back to eager when value-dependent Python control flow can't
+            # be captured). Trace-based equivalent: permanently fall back
+            # to eager for this function and warn once.
+            import warnings
+            warnings.warn(
+                f"to_static: data-dependent control flow broke tracing "
+                f"({type(e).__name__}); falling back to eager execution "
+                "for this function (ref SOT graph-break semantics)",
+                stacklevel=2)
+            self._fallback = True
+            return self._fn(*args, **kwargs)
         if self._layer is not None:
             sd = self._layer.state_dict()
             for k, v in new_state.items():
@@ -281,12 +301,105 @@ def train_step(model, optimizer, step_fn, **kw):
     return TrainStep(model, optimizer, step_fn, **kw)
 
 
+class InputSpec:
+    """ref: paddle.static.InputSpec — shape/dtype signature for export."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+
 def save(layer, path, input_spec=None, **configs):
-    """ref: paddle.jit.save — persists state_dict (+ config) for load."""
+    """ref: paddle.jit.save (python/paddle/jit/api.py). Persists BOTH the
+    weights (`path.pdparams`) and, when `input_spec` is given, a serialized
+    StableHLO program (`path.pdmodel` via jax.export) — the TPU-native
+    inference artifact: `jit.load` runs it WITHOUT the model's Python code,
+    like the reference's saved Program + TranslatedLayer."""
     from ..framework import io as fio
     fio.save(layer.state_dict(), path + ".pdparams")
+    if input_spec is None:
+        return
+    from jax import export as jexport
+
+    from ..framework import core
+
+    state = {k: t.data for k, t in layer.state_dict().items()}
+
+    def fwd(state, *inputs):
+        with layer.use_state(state), core.no_grad_guard():
+            out = layer(*_tree_box(list(inputs)))
+        return _tree_unbox(out)
+
+    # dynamic dims (None/-1) export as symbolic shapes so the artifact
+    # accepts any size there (jax.export shape polymorphism)
+    abstract = []
+    for i, s in enumerate(input_spec):
+        dt = core.convert_dtype(getattr(s, "dtype", "float32"))
+        if any(d is None or d == -1 for d in s.shape):
+            dims = ",".join(
+                f"b{i}_{j}" if (d is None or d == -1) else str(d)
+                for j, d in enumerate(s.shape))
+            abstract.append(jax.ShapeDtypeStruct(
+                jexport.symbolic_shape(dims), dt))
+        else:
+            abstract.append(jax.ShapeDtypeStruct(tuple(s.shape), dt))
+    state_abs = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state)
+    try:   # portable artifact when every op lowers for both platforms
+        exp = jexport.export(jax.jit(fwd), platforms=("cpu", "tpu"))(
+            state_abs, *abstract)
+    except Exception as e:
+        import warnings
+        warnings.warn(
+            f"jit.save: multi-platform (cpu+tpu) lowering failed "
+            f"({type(e).__name__}: {str(e)[:200]}); exporting for the "
+            f"current backend only — the artifact will not load on other "
+            "platforms", stacklevel=2)
+        exp = jexport.export(jax.jit(fwd))(state_abs, *abstract)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exp.serialize())
+
+
+class TranslatedLayer:
+    """Runs an exported program without model code (ref: jit/translated_layer)."""
+
+    def __init__(self, exported, state):
+        self._exported = exported
+        self._state = state
+
+    def __call__(self, *inputs):
+        arrs = [x.data if isinstance(x, Tensor) else jnp.asarray(x)
+                for x in inputs]
+        out = self._exported.call(self._state, *arrs)
+        return jax.tree_util.tree_map(
+            lambda a: Tensor(a, stop_gradient=True), out)
+
+    forward = __call__
+
+    def state_dict(self):
+        return {k: Tensor(v, stop_gradient=True)
+                for k, v in self._state.items()}
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("exported inference programs cannot be trained")
 
 
 def load(path, **configs):
+    """paddle.jit.load: with a .pdmodel artifact returns a TranslatedLayer
+    (callable, no model code needed); otherwise the raw state dict."""
+    import os
+
     from ..framework import io as fio
-    return fio.load(path + ".pdparams")
+    state = fio.load(path + ".pdparams")
+    if not os.path.exists(path + ".pdmodel"):
+        return state
+    from jax import export as jexport
+    with open(path + ".pdmodel", "rb") as f:
+        exp = jexport.deserialize(f.read())
+    arrs = {k: (v.data if isinstance(v, Tensor) else jnp.asarray(v))
+            for k, v in state.items()}
+    return TranslatedLayer(exp, arrs)

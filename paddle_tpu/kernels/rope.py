@@ -29,9 +29,14 @@ def _rotate_half(x):
     return jnp.concatenate([-x2, x1], axis=-1)
 
 
-def apply_rope(q, k, position_ids=None, base=10000.0):
-    """q, k: [B, S, H, D] -> rotated (same shapes), f32 math, input dtype out."""
+def apply_rope(q, k, position_ids=None, base=10000.0, seq_len=None):
+    """q, k: [B, S, H, D] -> rotated (same shapes), f32 math, input dtype out.
+
+    seq_len: table length when position_ids may exceed q's length (KV-cache
+    decode, where q holds 1 token at an arbitrary absolute position)."""
     S, D = q.shape[1], q.shape[-1]
+    if position_ids is not None and seq_len is not None:
+        S = int(seq_len)
     cos, sin = _cos_sin_cache(S, D, base, "f32")
     if position_ids is not None:
         cos = jnp.take(cos, position_ids, axis=0)  # [B, S, D]

@@ -307,6 +307,204 @@ class LlamaForCausalLM(Layer):
         lb = M.reshape(labels[:, 1:], [-1])
         return F.cross_entropy(lg, lb, ignore_index=-100)
 
+    # -- decode path (prefill + compiled greedy/sampling scan) --------------
+    def generate(self, input_ids, max_new_tokens=32, max_length=None,
+                 eos_token_id=None, do_sample=False, temperature=1.0,
+                 top_k=0, seed=0, use_cache=True):
+        """KV-cache generation: ONE compiled prefill + ONE compiled decode
+        scan (ref: analysis_predictor Run -> fused_multi_transformer decode;
+        VERDICT r1 item 7). Greedy when do_sample=False. Returns the
+        generated ids [B, max_new_tokens] as a Tensor."""
+        import numpy as np
+
+        cfg = self.cfg
+        ids = input_ids.data if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        ids = ids.astype(jnp.int32)
+        B, T0 = ids.shape
+        if max_length is not None:
+            # total-length cap (paddle/HF semantics)
+            max_new_tokens = min(max_new_tokens, max(int(max_length) - T0, 1))
+        S_max = T0 + max_new_tokens
+        state = {k: t.data for k, t in self.state_dict().items()}
+        L, kvh, d = cfg.num_hidden_layers, cfg.kv_heads, cfg.head_dim
+        cdtype = state["model.embed_tokens"].dtype
+        cache_k = jnp.zeros((L, B, S_max, kvh, d), cdtype)
+        cache_v = jnp.zeros((L, B, S_max, kvh, d), cdtype)
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+
+        # compiled prefill/decode cached per static config
+        sig = (B, T0, S_max, max_new_tokens, do_sample, float(temperature),
+               int(top_k), eos)
+        if not hasattr(self, "_gen_compiled"):
+            self._gen_compiled = {}
+        if sig in self._gen_compiled:
+            prefill, decode = self._gen_compiled[sig]
+            return self._run_generate(prefill, decode, state, ids, cache_k,
+                                      cache_v, max_new_tokens, do_sample,
+                                      temperature, top_k, seed)
+
+        @jax.jit
+        def prefill(state, ids, ck, cv):
+            logits, ck, cv = _forward_with_cache(
+                state, cfg, ids, ck, cv, jnp.zeros((B,), jnp.int32))
+            return logits[:, -1], ck, cv
+
+        @jax.jit
+        def decode(state, first_tok, ck, cv, key):
+            def pick(logits, key):
+                if do_sample:
+                    lg = logits / max(temperature, 1e-6)
+                    if top_k:
+                        kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+                        lg = jnp.where(lg < kth, -jnp.inf, lg)
+                    return jax.random.categorical(key, lg).astype(jnp.int32)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+            def step(carry, _):
+                tok, ck, cv, cur, done, key = carry
+                key, sub = jax.random.split(key)
+                logits, ck, cv = _forward_with_cache(
+                    state, cfg, tok[:, None], ck, cv, cur)
+                nxt = pick(logits[:, -1], sub)
+                nxt = jnp.where(done, eos if eos >= 0 else 0, nxt)
+                done = done | (nxt == eos)
+                return (nxt, ck, cv, cur + 1, done, key), nxt
+
+            # the FIRST sampled token may already be EOS
+            done0 = (first_tok == eos) if eos >= 0 else jnp.zeros((B,), bool)
+            cur0 = jnp.full((B,), T0, jnp.int32)
+            (_, _, _, _, _, _), toks = jax.lax.scan(
+                step, (first_tok, ck, cv, cur0, done0, key),
+                None, length=max_new_tokens - 1)
+            return toks                                  # [N-1, B]
+
+        self._gen_compiled[sig] = (prefill, decode)
+        return self._run_generate(prefill, decode, state, ids, cache_k,
+                                  cache_v, max_new_tokens, do_sample,
+                                  temperature, top_k, seed)
+
+    def _run_generate(self, prefill, decode, state, ids, cache_k, cache_v,
+                      max_new_tokens, do_sample, temperature, top_k, seed):
+        last_logits, cache_k, cache_v = prefill(state, ids, cache_k, cache_v)
+        key = jax.random.PRNGKey(seed)
+        key, sub = jax.random.split(key)
+        if do_sample:
+            lg = last_logits / max(temperature, 1e-6)
+            if top_k:
+                kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+                lg = jnp.where(lg < kth, -jnp.inf, lg)
+            first = jax.random.categorical(sub, lg).astype(jnp.int32)
+        else:
+            first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+        if max_new_tokens == 1:
+            out = first[:, None]
+        else:
+            rest = decode(state, first, cache_k, cache_v, key)
+            out = jnp.concatenate([first[:, None],
+                                   jnp.swapaxes(rest, 0, 1)], axis=1)
+        return Tensor(out, stop_gradient=True)
+
+
+# ---------------------------------------------------------------------------
+# generation: prefill + decode as two compiled functions with a KV cache
+# (ref: the reference's decode path — fused_multi_transformer_op.cu +
+#  masked_multihead_attention / block (paged) multi-head attention kernels,
+#  driven by analysis_predictor Run. TPU-native: the whole greedy loop is
+#  ONE lax.scan inside jit; the cache is a functional carry.)
+# ---------------------------------------------------------------------------
+
+
+def _gather_layer_weights(state, cfg):
+    """Stack per-layer weights [L, ...] from a state dict for lax.scan."""
+    L = cfg.num_hidden_layers
+    names = ["input_layernorm.weight", "self_attn.q_proj", "self_attn.k_proj",
+             "self_attn.v_proj", "self_attn.o_proj",
+             "post_attention_layernorm.weight", "mlp.gate_proj",
+             "mlp.up_proj", "mlp.down_proj"]
+    return {n: jnp.stack([state[f"model.layers.{i}.{n}"] for i in range(L)])
+            for n in names}
+
+
+def _rms(x, w, eps):
+    xf = x.astype(jnp.float32)
+    out = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (out * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _block_with_cache(cfg, h, wl, ck, cv, pos_ids, cache_mask):
+    """One decoder layer over tokens at pos_ids with a KV cache.
+
+    h: [B, T, H]; ck/cv: [B, S_max, kvh, d] (this layer's cache);
+    pos_ids: [B, T] absolute positions; cache_mask: [B, S_max] bool — which
+    cache slots are valid AFTER this step's keys are written.
+    Returns (h_out, ck_new, cv_new).
+    """
+    from ..kernels.rope import apply_rope
+
+    B, T = h.shape[0], h.shape[1]
+    nh, kvh, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    a = _rms(h, wl["input_layernorm.weight"], cfg.rms_norm_eps)
+    q = (a @ wl["self_attn.q_proj"]).reshape(B, T, nh, d)
+    k = (a @ wl["self_attn.k_proj"]).reshape(B, T, kvh, d)
+    v = (a @ wl["self_attn.v_proj"]).reshape(B, T, kvh, d)
+    max_pos = max(cfg.max_position_embeddings, ck.shape[1])
+    q, k = apply_rope(q, k, position_ids=pos_ids, base=cfg.rope_theta,
+                      seq_len=max_pos)
+    # write new keys/values into the cache at their absolute positions
+    oh = jax.nn.one_hot(pos_ids, ck.shape[1], dtype=ck.dtype)  # [B,T,S_max]
+    ck = ck * (1 - oh.sum(1)[:, :, None, None]) + jnp.einsum(
+        "bts,btkd->bskd", oh, k.astype(ck.dtype))
+    cv = cv * (1 - oh.sum(1)[:, :, None, None]) + jnp.einsum(
+        "bts,btkd->bskd", oh, v.astype(cv.dtype))
+    if kvh != nh:
+        rep = nh // kvh
+        kk = jnp.repeat(ck, rep, axis=2)
+        vv = jnp.repeat(cv, rep, axis=2)
+    else:
+        kk, vv = ck, cv
+    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                   kk.astype(jnp.float32)) / math.sqrt(d)
+    causal = pos_ids[:, :, None] >= jnp.arange(ck.shape[1])[None, None, :]
+    valid = causal & cache_mask[:, None, :]          # [B, T, S_max]
+    s = jnp.where(valid[:, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhts,bshd->bthd", p, vv.astype(jnp.float32))
+    o = o.astype(h.dtype).reshape(B, T, nh * d)
+    h = h + o @ wl["self_attn.o_proj"]
+    a2 = _rms(h, wl["post_attention_layernorm.weight"], cfg.rms_norm_eps)
+    up = jax.nn.silu(a2 @ wl["mlp.gate_proj"]) * (a2 @ wl["mlp.up_proj"])
+    return h + up @ wl["mlp.down_proj"], ck, cv
+
+
+def _forward_with_cache(state, cfg, ids, cache_k, cache_v, cur_len):
+    """ids: [B, T] new tokens (T=prompt at prefill, 1 at decode);
+    cache_k/v: [L, B, S_max, kvh, d]; cur_len: [B] int32 tokens already
+    cached. Returns (logits[B, T, V], cache_k, cache_v)."""
+    B, T = ids.shape
+    S_max = cache_k.shape[2]
+    emb = state["model.embed_tokens"]
+    h = jnp.take(emb, ids.astype(jnp.int32), axis=0)
+    pos_ids = cur_len[:, None] + jnp.arange(T)[None, :]          # [B, T]
+    cache_mask = jnp.arange(S_max)[None, :] < (cur_len + T)[:, None]
+    wls = _gather_layer_weights(state, cfg)
+
+    def body(carry, xs):
+        h = carry
+        wl, ck, cv = xs
+        h, ck, cv = _block_with_cache(cfg, h, wl, ck, cv, pos_ids,
+                                      cache_mask)
+        return h, (ck, cv)
+
+    h, (cache_k, cache_v) = jax.lax.scan(
+        body, h, (wls, cache_k, cache_v))
+    h = _rms(h, state["model.norm.weight"], cfg.rms_norm_eps)
+    if "lm_head" in state:
+        logits = h @ state["lm_head"]
+    else:
+        logits = h @ jnp.swapaxes(emb, 0, 1)
+    return logits.astype(jnp.float32), cache_k, cache_v
+
 
 def llama_tiny(**kw):
     return LlamaConfig(vocab_size=1024, hidden_size=256, intermediate_size=688,

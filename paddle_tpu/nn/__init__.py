@@ -11,6 +11,8 @@ from .layer.loss import *         # noqa: F401,F403
 from .layer.container import *    # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
 from .layer.rnn import *          # noqa: F401,F403
+from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
+                   ClipGradByValue)
 
 
 def clip_grad_norm_(parameters, max_norm, norm_type=2.0,

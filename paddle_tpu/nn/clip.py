@@ -1,0 +1,84 @@
+"""Gradient clipping strategy classes (ref: python/paddle/nn/clip.py —
+ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm; consumed by
+Optimizer(grad_clip=...) exactly like the reference).
+
+Pure-jnp formulations, trace-safe: every decision is a jnp.where, so the
+clip runs identically inside a compiled TrainStep."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+class ClipGradBase:
+    def __call__(self, params):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    """ref nn/clip.py ClipGradByValue: elementwise clamp to [min, max]."""
+
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params):
+        for p in params:
+            if p.grad is None or p.stop_gradient:
+                continue
+            p.grad.data = jnp.clip(p.grad.data, self.min, self.max)
+
+    def __repr__(self):
+        return f"ClipGradByValue(min={self.min}, max={self.max})"
+
+
+class ClipGradByNorm(ClipGradBase):
+    """ref nn/clip.py ClipGradByNorm: per-tensor L2 rescale."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params):
+        for p in params:
+            if p.grad is None or p.stop_gradient:
+                continue
+            g = p.grad.data.astype(jnp.float32)
+            n = jnp.sqrt(jnp.sum(g * g))
+            scale = jnp.where(n > self.clip_norm,
+                              self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            p.grad.data = (g * scale).astype(p.grad.data.dtype)
+
+    def __repr__(self):
+        return f"ClipGradByNorm(clip_norm={self.clip_norm})"
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """ref nn/clip.py ClipGradByGlobalNorm: one scale from the global L2
+    norm across every grad (the hybrid-parallel default; under GSPMD the
+    cross-shard reduction is derived automatically)."""
+
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def __call__(self, params):
+        gs = [p.grad.data for p in params
+              if p.grad is not None and not p.stop_gradient]
+        if not gs:
+            return
+        total = jnp.sqrt(sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                             for g in gs))
+        scale = jnp.where(total > self.clip_norm,
+                          self.clip_norm / jnp.maximum(total, 1e-12), 1.0)
+        for p in params:
+            if p.grad is None or p.stop_gradient:
+                continue
+            p.grad.data = (p.grad.data.astype(jnp.float32) * scale).astype(
+                p.grad.data.dtype)
+
+    def __repr__(self):
+        return f"ClipGradByGlobalNorm(clip_norm={self.clip_norm})"

@@ -11,6 +11,7 @@ from ...tensor import Tensor
 from ...ops._helpers import to_tensor_like, unwrap
 
 __all__ = [
+    "margin_cross_entropy",
     "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
     "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
     "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
@@ -409,3 +410,33 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
               fastemit_lambda=0.001, reduction="mean", name=None):
     raise NotImplementedError(
         "rnnt_loss: planned (ref warprnnt dependency; needs a lax.scan DP)")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ref: phi margin_cross_entropy (ArcFace/CosFace-style margins over
+    possibly class-sharded logits; under GSPMD class sharding is an
+    annotation, the math is identical):
+    cos(m1*theta + m2) - m3 applied to the target class, then scaled CE."""
+    lb = unwrap(to_tensor_like(label)).reshape(-1).astype(jnp.int32)
+
+    def f(lg):
+        lg = lg.astype(jnp.float32)   # arccos near ±1 needs f32
+        onehot = jax.nn.one_hot(lb, lg.shape[-1], dtype=lg.dtype)
+        theta = jnp.arccos(jnp.clip(lg, -1.0, 1.0))
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        adj = jnp.where(onehot > 0, target, lg) * scale
+        logp = jax.nn.log_softmax(adj, axis=-1)
+        loss = -jnp.sum(onehot * logp, axis=-1, keepdims=True)
+        return loss, jax.nn.softmax(adj, axis=-1)
+
+    loss, sm = apply_op(f, to_tensor_like(logits), n_outputs=2,
+                        name="margin_cross_entropy")
+    if reduction == "mean":
+        loss = loss.mean()
+    elif reduction == "sum":
+        loss = loss.sum()
+    if return_softmax:
+        return loss, sm
+    return loss

@@ -10,6 +10,7 @@ from ...autograd.tape import apply_op
 from ...ops._helpers import to_tensor_like
 
 __all__ = [
+    "fractional_max_pool2d", "fractional_max_pool3d",
     "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
     "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
     "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
@@ -292,3 +293,112 @@ def _unpool(x, indices, kernel_size, stride, padding, n, output_size):
         out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(out, fidx, flat)
         return out.reshape(*lead, *out_sp)
     return apply_op(f, x, indices, name="max_unpool")
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    """ref: phi fractional_max_pool2d — pseudo-random bin boundaries
+    (deterministic given random_u, matching the reference's u-based
+    sequence)."""
+    import math as _math
+
+    import numpy as np
+
+    from ...framework import core
+    from ...ops._helpers import unwrap as _unwrap
+    from ...tensor import Tensor as _T
+
+    xt = to_tensor_like(x)
+    N, C, H, W = xt.shape
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def edges(inp, out, u):
+        alpha = inp / out
+        idx = np.floor(alpha * (np.arange(out) + u)).astype(np.int64)
+        idx = np.clip(idx, 0, inp - 1)
+        end = np.concatenate([idx[1:], [inp]])
+        return idx, np.maximum(end, idx + 1)
+
+    u = (float(random_u) if random_u is not None
+         else float(jax.random.uniform(core.next_rng_key(), ())))
+    hs, he = edges(H, oh, u)
+    ws, we = edges(W, ow, u)
+
+    def f(a):
+        outs, idxs = [], []
+        for i in range(oh):
+            row, irow = [], []
+            for j in range(ow):
+                patch = a[:, :, hs[i]:he[i], ws[j]:we[j]]
+                ph_, pw_ = patch.shape[-2:]
+                flat = patch.reshape(*patch.shape[:-2], ph_ * pw_)
+                am = jnp.argmax(flat, axis=-1)
+                row.append(flat.max(axis=-1))
+                # global flat H*W index of the max (paddle mask convention)
+                gy = hs[i] + am // pw_
+                gx = ws[j] + am % pw_
+                irow.append(gy * W + gx)
+            outs.append(jnp.stack(row, axis=-1))
+            idxs.append(jnp.stack(irow, axis=-1))
+        return jnp.stack(outs, axis=-2), jnp.stack(idxs, axis=-2)
+
+    out, mask = apply_op(f, xt, n_outputs=2, name="fractional_max_pool2d")
+    if return_mask:
+        return out, mask
+    return out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """ref: phi fractional_max_pool3d."""
+    import numpy as np
+
+    from ...framework import core
+
+    xt = to_tensor_like(x)
+    N, C, D, H, W = xt.shape
+    if isinstance(output_size, int):
+        output_size = (output_size,) * 3
+    od, oh, ow = output_size
+
+    def edges(inp, out, u):
+        alpha = inp / out
+        idx = np.floor(alpha * (np.arange(out) + u)).astype(np.int64)
+        idx = np.clip(idx, 0, inp - 1)
+        end = np.concatenate([idx[1:], [inp]])
+        return idx, np.maximum(end, idx + 1)
+
+    u = (float(random_u) if random_u is not None
+         else float(jax.random.uniform(core.next_rng_key(), ())))
+    ds, de = edges(D, od, u)
+    hs, he = edges(H, oh, u)
+    ws, we = edges(W, ow, u)
+
+    def f(a):
+        outs, idxs = [], []
+        for k in range(od):
+            o2, i2 = [], []
+            for i in range(oh):
+                o1, i1 = [], []
+                for j in range(ow):
+                    patch = a[:, :, ds[k]:de[k], hs[i]:he[i], ws[j]:we[j]]
+                    pd_, ph_, pw_ = patch.shape[-3:]
+                    flat = patch.reshape(*patch.shape[:-3], pd_ * ph_ * pw_)
+                    am = jnp.argmax(flat, axis=-1)
+                    o1.append(flat.max(axis=-1))
+                    gd = ds[k] + am // (ph_ * pw_)
+                    gy = hs[i] + (am // pw_) % ph_
+                    gx = ws[j] + am % pw_
+                    i1.append((gd * H + gy) * W + gx)
+                o2.append(jnp.stack(o1, axis=-1))
+                i2.append(jnp.stack(i1, axis=-1))
+            outs.append(jnp.stack(o2, axis=-2))
+            idxs.append(jnp.stack(i2, axis=-2))
+        return jnp.stack(outs, axis=-3), jnp.stack(idxs, axis=-3)
+
+    out, mask = apply_op(f, xt, n_outputs=2, name="fractional_max_pool3d")
+    if return_mask:
+        return out, mask
+    return out

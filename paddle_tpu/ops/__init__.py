@@ -14,4 +14,5 @@ from .search import *        # noqa: F401,F403
 from .linalg_ops import *    # noqa: F401,F403
 from .random_ops import *    # noqa: F401,F403
 from .einsum_ops import *    # noqa: F401,F403
+from .extra import *         # noqa: F401,F403
 from . import patch_methods  # noqa: F401  (installs Tensor methods/operators)

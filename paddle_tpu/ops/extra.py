@@ -1,0 +1,275 @@
+"""Op-surface tail: the remaining reference YAML forward ops
+(ref: paddle/phi/api/yaml/ops.yaml + legacy_ops.yaml — tracked by
+tools/op_coverage.py; python API anchors cited per op)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply_op
+from ..framework import core
+from ..tensor import Tensor
+from ._helpers import to_tensor_like, unwrap
+
+__all__ = [
+    "add_n", "trace", "reverse", "fill", "fill_diagonal",
+    "fill_diagonal_tensor", "renorm", "clip_by_norm", "check_numerics",
+    "logsigmoid", "bce_loss", "huber_loss", "kldiv_loss", "dirichlet",
+    "top_p_sampling", "gather_tree", "identity_loss", "temporal_shift",
+    "index_select_strided", "tensor_unfold", "view_dtype", "view_shape",
+    "trans_layout", "full_int_array", "segment_pool", "fold",
+]
+
+
+def add_n(inputs, name=None):
+    """ref: python/paddle/tensor/math.py add_n (sum_op)."""
+    ts = [to_tensor_like(t) for t in inputs]
+    return apply_op(lambda *xs: sum(xs[1:], xs[0]), *ts, name="add_n")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    """ref: python/paddle/tensor/math.py trace."""
+    return apply_op(
+        lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+        to_tensor_like(x), name="trace")
+
+
+def reverse(x, axis, name=None):
+    """ref legacy reverse == flip."""
+    from .manipulation import flip
+    return flip(x, axis)
+
+
+def fill(x, value, name=None):
+    """In-place fill (ref fill kernel). Functional under the hood."""
+    t = to_tensor_like(x)
+    t.data = jnp.full_like(t.data, value)
+    return t
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """ref: tensor/manipulation.py fill_diagonal_."""
+    t = to_tensor_like(x)
+
+    def f(a):
+        n = min(a.shape[-2], a.shape[-1])
+        i = jnp.arange(n - abs(offset))
+        r = i + max(-offset, 0)
+        c = i + max(offset, 0)
+        return a.at[..., r, c].set(value)
+
+    return apply_op(f, t, name="fill_diagonal")
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """ref: fill_diagonal_tensor — write tensor y along the diagonal."""
+    t = to_tensor_like(x)
+    yv = to_tensor_like(y)
+
+    def f(a, b):
+        a2 = jnp.moveaxis(a, (dim1, dim2), (-2, -1))
+        n = min(a2.shape[-2], a2.shape[-1])
+        i = jnp.arange(n - abs(offset))
+        r = i + max(-offset, 0)
+        c = i + max(offset, 0)
+        a2 = a2.at[..., r, c].set(b.astype(a.dtype))
+        return jnp.moveaxis(a2, (-2, -1), (dim1, dim2))
+
+    return apply_op(f, t, yv, name="fill_diagonal_tensor")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """ref: tensor/math.py renorm — clamp per-slice p-norm to max_norm."""
+    t = to_tensor_like(x)
+
+    def f(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+    return apply_op(f, t, name="renorm")
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """ref: phi clip_by_norm kernel (nn/clip.py)."""
+    t = to_tensor_like(x)
+
+    def f(a):
+        n = jnp.sqrt(jnp.sum(a.astype(jnp.float32) ** 2))
+        scale = jnp.where(n > max_norm, max_norm / jnp.maximum(n, 1e-12), 1.0)
+        return (a.astype(jnp.float32) * scale).astype(a.dtype)
+
+    return apply_op(f, t, name="clip_by_norm")
+
+
+def check_numerics(x, op_type="", var_name="", message="", stack_height_limit=-1,
+                   output_dir="", name=None):
+    """ref: check_numerics kernel — raises on nan/inf (eager)."""
+    t = to_tensor_like(x)
+    from ..autograd.tape import _check_nan_inf
+    _check_nan_inf(var_name or op_type or "check_numerics", (t.data,))
+    return t
+
+
+def logsigmoid(x, name=None):
+    from ..nn.functional import log_sigmoid
+    return log_sigmoid(x)
+
+
+def bce_loss(input, label, name=None):
+    from ..nn.functional import binary_cross_entropy
+    return binary_cross_entropy(input, label, reduction="none")
+
+
+def huber_loss(input, label, delta=1.0, name=None):
+    """ref: phi huber_loss kernel."""
+    a, b = to_tensor_like(input), to_tensor_like(label)
+
+    def f(x, y):
+        r = jnp.abs(x - y)
+        return jnp.where(r <= delta, 0.5 * r * r,
+                         delta * (r - 0.5 * delta))
+
+    return apply_op(f, a, b, name="huber_loss")
+
+
+def kldiv_loss(x, target, reduction="mean", log_target=False, name=None):
+    from ..nn.functional import kl_div
+    return kl_div(x, target, reduction=reduction)
+
+
+def dirichlet(alpha, name=None):
+    """ref: paddle.distribution dirichlet op — one draw per leading row."""
+    a = unwrap(to_tensor_like(alpha))
+    key = core.next_rng_key()
+    g = jax.random.gamma(key, a)
+    out = g / jnp.sum(g, axis=-1, keepdims=True)
+    return Tensor(out, stop_gradient=True)
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
+                   k=0, mode="truncated", return_top=False, name=None):
+    """ref: phi top_p_sampling — nucleus sampling over last-dim logits.
+    x: [B, V] probabilities or logits; ps: [B] cumulative-probability cap.
+    Returns (values, indices) of the sampled token (paddle signature)."""
+    lg = unwrap(to_tensor_like(x)).astype(jnp.float32)
+    p_cap = jnp.reshape(unwrap(to_tensor_like(ps)).astype(jnp.float32), (-1,))
+    probs = jax.nn.softmax(lg, axis=-1)
+    sort_idx = jnp.argsort(-probs, axis=-1)
+    sort_p = jnp.take_along_axis(probs, sort_idx, axis=-1)
+    cum = jnp.cumsum(sort_p, axis=-1)
+    keep = cum - sort_p < p_cap[:, None]     # always keep the top token
+    filt = jnp.where(keep, sort_p, 0.0)
+    filt = filt / jnp.maximum(filt.sum(-1, keepdims=True), 1e-12)
+    key = (jax.random.PRNGKey(seed) if seed >= 0 else core.next_rng_key())
+    choice = jax.random.categorical(key, jnp.log(jnp.maximum(filt, 1e-12)))
+    idx = jnp.take_along_axis(sort_idx, choice[:, None], axis=-1)
+    val = jnp.take_along_axis(probs, idx, axis=-1)
+    return (Tensor(val, stop_gradient=True),
+            Tensor(idx.astype(jnp.int64), stop_gradient=True))
+
+
+def gather_tree(ids, parents, name=None):
+    """ref: phi gather_tree — reconstruct beam-search paths.
+    ids/parents: [max_time, batch, beam]."""
+    iv = unwrap(to_tensor_like(ids)).astype(jnp.int32)
+    pv = unwrap(to_tensor_like(parents)).astype(jnp.int32)
+    T = iv.shape[0]
+
+    def step(carry, t):
+        beams = carry                       # [batch, beam] current beam ids
+        tok = jnp.take_along_axis(iv[t], beams, axis=1)
+        par = jnp.take_along_axis(pv[t], beams, axis=1)
+        return par, tok
+
+    last = jnp.broadcast_to(jnp.arange(iv.shape[2])[None, :],
+                            iv.shape[1:]).astype(jnp.int32)
+    _, toks = jax.lax.scan(step, last, jnp.arange(T - 1, -1, -1))
+    return Tensor(jnp.flip(toks, axis=0), stop_gradient=True)
+
+
+def identity_loss(x, reduction="none", name=None):
+    t = to_tensor_like(x)
+    red = {0: "sum", 1: "mean", 2: "none",
+           "sum": "sum", "mean": "mean", "none": "none"}[reduction]
+    if red == "none":
+        return apply_op(lambda a: a, t, name="identity_loss")
+    fn = jnp.sum if red == "sum" else jnp.mean
+    return apply_op(lambda a: fn(a), t, name="identity_loss")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """ref: phi temporal_shift kernel (TSM video models)."""
+    t = to_tensor_like(x)
+
+    def f(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        NT, C, H, W = a.shape
+        N = NT // seg_num
+        a = a.reshape(N, seg_num, C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        fwd = jnp.pad(a[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+        bwd = jnp.pad(a[:, :-1, c1:c2],
+                      ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+        keep = a[:, :, c2:]
+        out = jnp.concatenate([fwd, bwd, keep], axis=2).reshape(NT, C, H, W)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply_op(f, t, name="temporal_shift")
+
+
+def index_select_strided(x, index, axis=0, name=None):
+    from .manipulation import index_select
+    return index_select(x, index, axis)
+
+
+def tensor_unfold(x, axis, size, step, name=None):
+    from .manipulation import unfold
+    return unfold(x, axis, size, step)
+
+
+def view_dtype(x, dtype, name=None):
+    from .manipulation import view
+    return view(x, dtype)
+
+
+def view_shape(x, shape, name=None):
+    from .manipulation import view
+    return view(x, shape)
+
+
+def trans_layout(x, perm, name=None):
+    from .manipulation import transpose
+    return transpose(x, perm)
+
+
+def full_int_array(value, dtype="int64", name=None):
+    from .creation import to_tensor
+    return to_tensor(np.asarray(value, core.convert_dtype(dtype)))
+
+
+def segment_pool(x, segment_ids, pooltype="SUM", name=None):
+    """ref: phi segment_pool — dispatches to geometric segment ops."""
+    from .. import geometric as G
+    fn = {"SUM": G.segment_sum, "MEAN": G.segment_mean,
+          "MAX": G.segment_max, "MIN": G.segment_min}[pooltype.upper()]
+    return fn(x, segment_ids)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """ref: nn/functional/fold (col2im, inverse of unfold)."""
+    from ..nn.functional import fold as _fold
+    return _fold(x, output_sizes, kernel_sizes, strides, paddings, dilations)

@@ -1,0 +1,320 @@
+"""paddle.static — static-graph compatibility layer (L3 API parity).
+
+ref: python/paddle/static/ (Program/Executor/program_guard/data) over the
+ProgramDesc + InterpreterCore stack (SURVEY §3.3). TPU-native redesign:
+`enable_static()` flips the tape into RECORDING mode — every op routed
+through autograd.tape.apply_op appends (fn, inputs, outputs) to the current
+Program while executing on placeholder zeros for shape propagation. An
+`Executor.run(feed, fetch_list)` then REPLAYS the recorded DAG as one pure
+function of the feeds, compiled under jax.jit and cached per feed
+signature — the InterpreterCore equivalent is the XLA executable.
+
+Static-mode training (optimizer ops inside the program) is out of scope —
+use the dynamic API + jit.TrainStep, which compiles the full train step
+anyway (the reason the reference needed static mode in the first place).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import core
+from ..jit import InputSpec  # noqa: F401  (paddle.static.InputSpec)
+from ..tensor import Tensor
+
+__all__ = ["Program", "program_guard", "default_main_program",
+           "default_startup_program", "data", "Executor", "InputSpec",
+           "save_inference_model", "load_inference_model", "name_scope",
+           "cpu_places", "cuda_places", "xpu_places", "Variable", "gradients"]
+
+_main_program: Optional["Program"] = None
+_startup_program: Optional["Program"] = None
+
+
+class _OpRecord:
+    __slots__ = ("fn", "in_ids", "const_args", "out_ids", "name")
+
+    def __init__(self, fn, in_ids, const_args, out_ids, name):
+        self.fn = fn
+        self.in_ids = in_ids          # per positional arg: var id or None
+        self.const_args = const_args  # concrete values for non-var args
+        self.out_ids = out_ids
+        self.name = name
+
+
+class Program:
+    """Recorded op list + feed/fetch vars (ref ProgramDesc)."""
+
+    def __init__(self):
+        self.ops: List[_OpRecord] = []
+        self.feeds: Dict[str, int] = {}       # name -> var id
+        self.feed_meta: Dict[str, tuple] = {}  # name -> (shape, dtype)
+        # var registry: id(tensor) -> var id, WITH a strong reference to
+        # each registered Tensor — otherwise CPython id reuse after GC
+        # would alias a new Tensor onto a stale var id (silently wrong
+        # replay). Lifetime == Program lifetime.
+        self.var_ids: Dict[int, int] = {}
+        self._keepalive: List = []
+        self._id = 0
+
+    def register_var(self, t):
+        self.var_ids[id(t)] = id(t)
+        self._keepalive.append(t)
+
+    def var_id(self, t):
+        return self.var_ids.get(id(t))
+
+    def clone(self, for_test=False):
+        return self
+
+    def global_block(self):
+        return self
+
+    def record(self, fn, args_ids, const_args, out_ids, name):
+        self.ops.append(_OpRecord(fn, args_ids, const_args, out_ids, name))
+
+    # -- replay ------------------------------------------------------------
+    def build_callable(self, fetch_ids):
+        feeds = dict(self.feeds)
+        ops = list(self.ops)
+
+        def run(feed_vals: dict):
+            env: Dict[int, jax.Array] = {
+                vid: jnp.asarray(feed_vals[n]) for n, vid in feeds.items()}
+            for op in ops:
+                args = []
+                ci = 0
+                for vid in op.in_ids:
+                    if vid is None:   # leaf (parameter/constant): baked in
+                        args.append(op.const_args[ci])
+                        ci += 1
+                    elif vid in env:
+                        args.append(env[vid])
+                    else:
+                        raise KeyError(
+                            f"op '{op.name}' reads a value produced outside "
+                            "this Program (recorded under a different "
+                            "program_guard?)")
+                out = op.fn(*args)
+                outs = out if isinstance(out, tuple) else (out,)
+                for vid, o in zip(op.out_ids, outs):
+                    env[vid] = o
+            return [env[i] for i in fetch_ids]
+
+        return run
+
+
+class _StaticState:
+    recording = False
+
+
+_state = _StaticState()
+
+
+def in_static_mode():
+    return _state.recording
+
+
+def _enable():
+    global _main_program, _startup_program
+    _state.recording = True
+    from ..autograd import tape
+    tape._STATIC_RECORDER = record_op
+    if _main_program is None:
+        _main_program = Program()
+        _startup_program = Program()
+
+
+def _disable():
+    _state.recording = False
+    from ..autograd import tape
+    tape._STATIC_RECORDER = None
+
+
+def default_main_program():
+    global _main_program
+    if _main_program is None:
+        _main_program = Program()
+    return _main_program
+
+
+def default_startup_program():
+    global _startup_program
+    if _startup_program is None:
+        _startup_program = Program()
+    return _startup_program
+
+
+class program_guard:
+    """ref: static.program_guard — swap the recording target."""
+
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        global _main_program
+        self._saved = _main_program
+        _main_program = self.main
+        return self.main
+
+    def __exit__(self, *a):
+        global _main_program
+        _main_program = self._saved
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """ref: static.data — feed placeholder. Executes as zeros during
+    recording (shape propagation), substituted by the feed at run time."""
+    prog = default_main_program()
+    raw_shape = tuple(shape)
+    shape = tuple(1 if (d is None or d < 0) else d for d in shape)
+    arr = jnp.zeros(shape, core.convert_dtype(dtype))
+    t = Tensor(arr, stop_gradient=True, name=name)
+    prog.feeds[name] = id(t)
+    prog.feed_meta[name] = (tuple(raw_shape), str(dtype))
+    prog.register_var(t)
+    return t
+
+
+def var_id(t):
+    return default_main_program().var_id(t)
+
+
+def record_op(fn, tensor_args, datas, outs, name):
+    """Called by apply_op in static mode."""
+    prog = default_main_program()
+    in_ids, consts = [], []
+    for t, d in zip(tensor_args, datas):
+        vid = prog.var_id(t) if t is not None else None
+        if vid is None:
+            in_ids.append(None)
+            consts.append(d)
+        else:
+            in_ids.append(vid)
+    out_ids = []
+    for o in outs:
+        prog.register_var(o)
+        out_ids.append(id(o))
+    prog.record(fn, in_ids, consts, out_ids, name)
+
+
+class Executor:
+    """ref: base/executor.py Executor — replay compiled under jit."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kw):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_ids = [program.var_id(t) if isinstance(t, Tensor) else t
+                     for t in fetch_list]
+        key = (id(program), len(program.ops), tuple(fetch_ids),
+               tuple(sorted(feed)))
+        if key not in self._cache:
+            runner = program.build_callable(fetch_ids)
+            self._cache[key] = jax.jit(runner)
+        outs = self._cache[key]({k: np.asarray(
+            v.numpy() if isinstance(v, Tensor) else v) for k, v in
+            feed.items()})
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o, stop_gradient=True) for o in outs]
+
+    def close(self):
+        pass
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    raise NotImplementedError(
+        "static-mode gradients: use the dynamic API (loss.backward() / "
+        "paddle.grad), which compiles the whole step under jit.TrainStep")
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None, **kw):
+    """ref: static/io.py save_inference_model — exports the recorded
+    program as a StableHLO artifact (same format as paddle.jit.save)."""
+    from jax import export as jexport
+
+    program = program or default_main_program()
+    fetch_ids = [program.var_id(t) for t in fetch_vars]
+    runner = program.build_callable(fetch_ids)
+    names = [t.name for t in feed_vars]
+
+    def fwd(*arrays):
+        return tuple(runner(dict(zip(names, arrays))))
+
+    from jax import export as _je
+    abstract = []
+    for i, n in enumerate(names):
+        shape, dt = program.feed_meta[n]
+        dt = core.convert_dtype(dt)
+        if any(d is None or (isinstance(d, int) and d < 0) for d in shape):
+            dims = ",".join(f"s{i}_{j}" if (d is None or d < 0) else str(d)
+                            for j, d in enumerate(shape))
+            abstract.append(jax.ShapeDtypeStruct(_je.symbolic_shape(dims),
+                                                 dt))
+        else:
+            abstract.append(jax.ShapeDtypeStruct(tuple(shape), dt))
+    exp = jexport.export(jax.jit(fwd))(*abstract)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exp.serialize())
+    import pickle
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({"feed_names": names}, f)
+
+
+def load_inference_model(path_prefix, executor=None, **kw):
+    from jax import export as jexport
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exp = jexport.deserialize(f.read())
+    import pickle
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        meta = pickle.load(f)
+
+    class _Prog:
+        def __init__(self):
+            self.exported = exp
+
+    def run_shim(feed):
+        return [np.asarray(o) for o in exp.call(*[jnp.asarray(feed[n])
+                                                  for n in meta["feed_names"]])]
+    prog = _Prog()
+    prog.run = run_shim
+    return prog, meta["feed_names"], None
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def cpu_places(device_count=None):
+    return ["cpu"]
+
+
+def cuda_places(device_ids=None):
+    return []
+
+
+def xpu_places(device_ids=None):
+    return []
+
+
+Variable = Tensor

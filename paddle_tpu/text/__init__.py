@@ -1,0 +1,246 @@
+"""paddle.text (ref: python/paddle/text/ — viterbi_decode + ViterbiDecoder
+and the NLP datasets namespace).
+
+The decoder is a real lax.scan dynamic program (compiled, batch-first).
+Dataset classes keep the reference's API; they read from a local
+`data_file` (the reference downloads from servers — this environment has
+no egress, so a missing file raises with instructions instead)."""
+from __future__ import annotations
+
+import os
+import tarfile
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io import Dataset
+from ..nn.layer.layers import Layer
+from ..ops._helpers import to_tensor_like, unwrap
+from ..tensor import Tensor
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "Imikolov",
+           "Movielens", "UCIHousing", "WMT14", "WMT16", "Conll05st"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """ref: python/paddle/text/viterbi_decode.py (phi viterbi_decode).
+
+    potentials: [B, T, N] unary emissions; transition_params: [N, N];
+    lengths: [B]. Returns (scores [B], best paths [B, T] int64).
+    With include_bos_eos_tag the last two tags are BOS/EOS (paddle
+    convention): transitions from BOS start the sequence, to EOS end it.
+    """
+    em = unwrap(to_tensor_like(potentials)).astype(jnp.float32)
+    tr = unwrap(to_tensor_like(transition_params)).astype(jnp.float32)
+    ln = unwrap(to_tensor_like(lengths)).astype(jnp.int32)
+    B, T, N = em.shape
+
+    if include_bos_eos_tag:
+        bos, eos = N - 2, N - 1
+        alpha0 = em[:, 0] + tr[bos][None, :]
+    else:
+        alpha0 = em[:, 0]
+
+    def step(carry, t):
+        alpha, = carry
+        scores = alpha[:, :, None] + tr[None, :, :] + em[:, t][:, None, :]
+        best_prev = jnp.argmax(scores, axis=1)            # [B, N]
+        new_alpha = jnp.max(scores, axis=1)
+        # sequences already finished keep their alpha frozen
+        active = (t < ln)[:, None]
+        new_alpha = jnp.where(active, new_alpha, alpha)
+        bp = jnp.where(active, best_prev,
+                       jnp.broadcast_to(jnp.arange(N)[None, :], (B, N)))
+        return (new_alpha,), bp
+
+    (alpha,), bps = jax.lax.scan(step, (alpha0,), jnp.arange(1, T))
+    if include_bos_eos_tag:
+        alpha = alpha + tr[:, eos][None, :]
+    last_tag = jnp.argmax(alpha, axis=-1)                  # [B]
+    scores = jnp.max(alpha, axis=-1)
+
+    def back(carry, bp):
+        tag = carry
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    # reverse scan: ys[i] = tag at time i+1; final carry = tag at time 0
+    first_tag, later_tags = jax.lax.scan(back, last_tag, bps, reverse=True)
+    path = jnp.concatenate([first_tag[None, :], later_tags], axis=0)  # [T, B]
+    path = jnp.swapaxes(path, 0, 1).astype(jnp.int64)      # [B, T]
+    # mask positions beyond each length with the last valid tag
+    idx = jnp.minimum(jnp.arange(T)[None, :], (ln - 1)[:, None])
+    path = jnp.take_along_axis(path, idx, axis=1)
+    return (Tensor(scores, stop_gradient=True),
+            Tensor(path, stop_gradient=True))
+
+
+class ViterbiDecoder(Layer):
+    """ref: paddle.text.ViterbiDecoder — holds transitions, decodes."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = to_tensor_like(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class _LocalDataset(Dataset):
+    """Shared shell for the reference's downloadable datasets."""
+
+    URL = ""
+
+    def __init__(self, data_file=None, mode="train"):
+        self.mode = mode
+        self.data_file = data_file
+        if data_file is None or not os.path.exists(data_file):
+            src = self.URL or "the paddle dataset mirror"
+            raise FileNotFoundError(
+                f"{type(self).__name__}: pass data_file= pointing at a "
+                f"local copy (the reference downloads from {src}; this "
+                "environment has no network egress)")
+        self._samples: List = []
+        self._load()
+
+    def _load(self):
+        raise NotImplementedError
+
+    def __getitem__(self, i):
+        return self._samples[i]
+
+    def __len__(self):
+        return len(self._samples)
+
+
+class Imdb(_LocalDataset):
+    """ref: text/datasets/imdb.py — sentiment classification."""
+
+    URL = "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz"
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        self.cutoff = cutoff
+        super().__init__(data_file, mode)
+
+    def _load(self):
+        import re
+        pat = re.compile(rf"aclImdb/{self.mode}/(pos|neg)/.*\.txt$")
+        freq = {}
+        docs = []
+        with tarfile.open(self.data_file) as tf:
+            for m in tf.getmembers():
+                if pat.match(m.name):
+                    txt = tf.extractfile(m).read().decode(
+                        "utf-8", "ignore").lower().split()
+                    label = 0 if "/pos/" in m.name else 1
+                    docs.append((txt, label))
+                    for w in txt:
+                        freq[w] = freq.get(w, 0) + 1
+        vocab = {w: i for i, (w, c) in enumerate(
+            sorted(freq.items(), key=lambda kv: -kv[1])) if c >= self.cutoff}
+        self.word_idx = vocab
+        for txt, label in docs:
+            ids = np.array([vocab[w] for w in txt if w in vocab], np.int64)
+            self._samples.append((ids, np.int64(label)))
+
+
+class Imikolov(_LocalDataset):
+    URL = "https://dataset.bj.bcebos.com/imikolov%2Fsimple-examples.tgz"
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        self.data_type = data_type
+        self.window_size = window_size
+        self.min_word_freq = min_word_freq
+        super().__init__(data_file, mode)
+
+    def _load(self):
+        name = {"train": "ptb.train.txt", "test": "ptb.test.txt",
+                "valid": "ptb.valid.txt"}[self.mode]
+        freq = {}
+        lines = []
+        with tarfile.open(self.data_file) as tf:
+            for m in tf.getmembers():
+                if m.name.endswith(name):
+                    for line in tf.extractfile(m).read().decode().split("\n"):
+                        toks = line.strip().split()
+                        lines.append(toks)
+                        for w in toks:
+                            freq[w] = freq.get(w, 0) + 1
+        vocab = {w: i for i, (w, c) in enumerate(sorted(
+            freq.items(), key=lambda kv: -kv[1])) if c >= self.min_word_freq}
+        vocab.setdefault("<unk>", len(vocab))
+        self.word_idx = vocab
+        unk = vocab["<unk>"]
+        for toks in lines:
+            ids = [vocab.get(w, unk) for w in toks]
+            if self.data_type.upper() == "NGRAM":
+                n = self.window_size
+                for i in range(len(ids) - n + 1):
+                    self._samples.append(
+                        tuple(np.int64(t) for t in ids[i:i + n]))
+            else:
+                self._samples.append(np.array(ids, np.int64))
+
+
+class UCIHousing(_LocalDataset):
+    URL = "https://archive.ics.uci.edu/ml/machine-learning-databases/housing/"
+
+    def _load(self):
+        raw = np.loadtxt(self.data_file).astype(np.float32)
+        x, y = raw[:, :-1], raw[:, -1:]
+        x = (x - x.mean(0)) / (x.std(0) + 1e-8)
+        split = int(0.8 * len(x))
+        sl = slice(0, split) if self.mode == "train" else slice(split, None)
+        self._samples = list(zip(x[sl], y[sl]))
+
+
+class Movielens(_LocalDataset):
+    URL = "https://files.grouplens.org/datasets/movielens/ml-1m.zip"
+
+    def _load(self):
+        import zipfile
+        with zipfile.ZipFile(self.data_file) as z:
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in f.read().decode("latin1").split("\n"):
+                    if not line.strip():
+                        continue
+                    u, m, r, _ = line.split("::")
+                    self._samples.append(
+                        (np.int64(u), np.int64(m), np.float32(r)))
+
+
+class WMT14(_LocalDataset):
+    URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt14.tgz"
+
+    def _load(self):
+        name = {"train": "train/train", "test": "test/test",
+                "gen": "gen/gen"}[self.mode]
+        with tarfile.open(self.data_file) as tf:
+            for m in tf.getmembers():
+                if name in m.name:
+                    for line in tf.extractfile(m).read().decode(
+                            "utf-8", "ignore").split("\n"):
+                        parts = line.split("\t")
+                        if len(parts) >= 2:
+                            self._samples.append(
+                                (parts[0].split(), parts[1].split()))
+
+
+class WMT16(WMT14):
+    URL = "http://paddlepaddle.bj.bcebos.com/dataset/wmt_16.tar.gz"
+
+
+class Conll05st(_LocalDataset):
+    URL = "https://dataset.bj.bcebos.com/conll05st%2Fconll05st-tests.tar.gz"
+
+    def _load(self):
+        with tarfile.open(self.data_file) as tf:
+            for m in tf.getmembers():
+                if m.name.endswith(".txt"):
+                    self._samples.append(m.name)

@@ -1,5 +1,6 @@
 """paddle.vision (ref: python/paddle/vision/)."""
 from . import models  # noqa: F401
+from . import ops  # noqa: F401
 
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401

@@ -1,0 +1,551 @@
+"""paddle.vision.ops — detection/vision operators
+(ref: python/paddle/vision/ops.py; kernels phi/kernels/gpu/{nms,roi_align,
+roi_pool,psroi_pool,yolo_box}_kernel.cu, distribute_fpn_proposals).
+
+TPU-native formulations: fixed-shape, mask-based algorithms (no dynamic
+output sizes inside jit — callers get padded/flagged results like the
+reference's RoIs-num variants)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply_op
+from ..ops._helpers import to_tensor_like, unwrap
+from ..tensor import Tensor
+
+__all__ = ["nms", "matrix_nms", "roi_align", "roi_pool", "psroi_pool",
+           "yolo_box", "yolo_loss", "edit_distance",
+           "distribute_fpn_proposals", "box_coder", "generate_proposals",
+           "DeformConv2D", "deform_conv2d", "decode_jpeg"]
+
+
+def _iou_matrix(boxes):
+    """[N, 4] xyxy -> [N, N] IoU."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """ref: vision/ops.py nms. Greedy suppression as a fixed-length scan:
+    boxes processed in score order; each keeps itself iff not suppressed by
+    an earlier kept box. Returns kept indices (score-sorted)."""
+    b = unwrap(to_tensor_like(boxes)).astype(jnp.float32)
+    N = b.shape[0]
+    s = (unwrap(to_tensor_like(scores)).astype(jnp.float32)
+         if scores is not None else jnp.arange(N, 0, -1, dtype=jnp.float32))
+    order = jnp.argsort(-s)
+    bs = b[order]
+    if category_idxs is not None:
+        cat = unwrap(to_tensor_like(category_idxs))[order]
+    else:
+        cat = jnp.zeros((N,), jnp.int32)
+    iou = _iou_matrix(bs)
+    same = cat[:, None] == cat[None, :]
+    sup = (iou > iou_threshold) & same
+
+    def body(keep, i):
+        # suppressed by any earlier KEPT box?
+        earlier = jnp.arange(N) < i
+        dead = jnp.any(sup[i] & earlier & keep)
+        return keep.at[i].set(~dead), None
+
+    keep, _ = jax.lax.scan(body, jnp.zeros((N,), bool), jnp.arange(N))
+    kept_sorted = order[jnp.nonzero(keep, size=N, fill_value=-1)[0]]
+    n_keep = int(jnp.sum(keep))
+    out = np.asarray(kept_sorted)[:n_keep]
+    if top_k is not None:
+        out = out[:top_k]
+    return Tensor(jnp.asarray(out, jnp.int64), stop_gradient=True)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """ref: matrix_nms — soft decay by max-IoU with higher-scored boxes."""
+    b = unwrap(to_tensor_like(bboxes)).astype(jnp.float32)
+    s = unwrap(to_tensor_like(scores)).astype(jnp.float32)
+    # single-image [C, N] scores, [N, 4] boxes (batch handled per image)
+    assert b.ndim == 3 and s.ndim == 3, "expect [B, N, 4] and [B, C, N]"
+    outs, idxs, nums = [], [], []
+    for bi in range(b.shape[0]):
+        per = []
+        for c in range(s.shape[1]):
+            if c == background_label:
+                continue
+            sc = s[bi, c]
+            order = jnp.argsort(-sc)[:nms_top_k]
+            sc_s, bx = sc[order], b[bi][order]
+            iou = jnp.triu(_iou_matrix(bx), k=1)
+            max_iou = jnp.max(iou, axis=0)          # vs higher-scored
+            if use_gaussian:
+                decay = jnp.exp(-(max_iou ** 2) / gaussian_sigma)
+            else:
+                decay = 1.0 - max_iou
+            dec = sc_s * decay
+            m = dec > max(score_threshold, post_threshold)
+            for j in range(bx.shape[0]):
+                if bool(m[j]):
+                    per.append((float(dec[j]), c, bx[j], int(order[j])))
+        per.sort(key=lambda t: -t[0])
+        per = per[:keep_top_k]
+        outs.append(np.array([[c, scv, *np.asarray(box)]
+                              for (scv, c, box, _) in per], np.float32)
+                    .reshape(-1, 6))
+        idxs.append(np.array([i for (_, _, _, i) in per], np.int64))
+        nums.append(len(per))
+    out = Tensor(jnp.asarray(np.concatenate(outs, 0)), stop_gradient=True)
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.concatenate(idxs)),
+                          stop_gradient=True))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.array(nums, np.int32)),
+                          stop_gradient=True))
+    return tuple(res) if len(res) > 1 else out
+
+
+def _bilinear(feat, y, x):
+    """feat [C, H, W]; y/x arbitrary same-shaped coords -> [C, *coords]."""
+    H, W = feat.shape[-2:]
+    y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    ly, lx = y - y0, x - x0
+    y0i, y1i, x0i, x1i = (a.astype(jnp.int32) for a in (y0, y1, x0, x1))
+    v00 = feat[:, y0i, x0i]
+    v01 = feat[:, y0i, x1i]
+    v10 = feat[:, y1i, x0i]
+    v11 = feat[:, y1i, x1i]
+    return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+            + v10 * ly * (1 - lx) + v11 * ly * lx)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """ref: vision/ops.py roi_align / phi roi_align kernel."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    xt = to_tensor_like(x)
+    bx = unwrap(to_tensor_like(boxes)).astype(jnp.float32)
+    bn = np.asarray(unwrap(to_tensor_like(boxes_num)))
+    img_of_box = np.repeat(np.arange(len(bn)), bn)
+    ratio = sampling_ratio if sampling_ratio > 0 else 2
+
+    def f(feat):
+        off = 0.5 if aligned else 0.0
+        outs = []
+        for i in range(bx.shape[0]):
+            fmap = feat[int(img_of_box[i])]
+            x1, y1, x2, y2 = bx[i] * spatial_scale - off
+            rw = jnp.maximum(x2 - x1, 1e-3)
+            rh = jnp.maximum(y2 - y1, 1e-3)
+            bin_h, bin_w = rh / ph, rw / pw
+            gy = (y1 + bin_h * (jnp.arange(ph)[:, None, None, None]
+                                + (jnp.arange(ratio)[None, None, :, None]
+                                   + 0.5) / ratio))
+            gx = (x1 + bin_w * (jnp.arange(pw)[None, :, None, None]
+                                + (jnp.arange(ratio)[None, None, None, :]
+                                   + 0.5) / ratio))
+            gy = jnp.broadcast_to(gy, (ph, pw, ratio, ratio))
+            gx = jnp.broadcast_to(gx, (ph, pw, ratio, ratio))
+            vals = _bilinear(fmap, gy, gx)          # [C, ph, pw, r, r]
+            outs.append(vals.mean(axis=(-2, -1)))
+        return jnp.stack(outs)
+
+    return apply_op(f, xt, name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """ref: vision/ops.py roi_pool (max pooling per bin)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    xt = to_tensor_like(x)
+    bx = unwrap(to_tensor_like(boxes)).astype(jnp.float32)
+    bn = np.asarray(unwrap(to_tensor_like(boxes_num)))
+    img_of_box = np.repeat(np.arange(len(bn)), bn)
+
+    def f(feat):
+        H, W = feat.shape[-2:]
+        outs = []
+        for i in range(bx.shape[0]):
+            fmap = feat[int(img_of_box[i])]
+            x1, y1, x2, y2 = jnp.round(bx[i] * spatial_scale)
+            rh = jnp.maximum(y2 - y1 + 1, 1.0)
+            rw = jnp.maximum(x2 - x1 + 1, 1.0)
+            # dense sampling grid then max per bin (fixed shapes)
+            R = 4
+            gy = y1 + rh / ph * (jnp.arange(ph)[:, None, None, None]
+                                 + jnp.linspace(0, 1, R)[None, None, :, None])
+            gx = x1 + rw / pw * (jnp.arange(pw)[None, :, None, None]
+                                 + jnp.linspace(0, 1, R)[None, None, None, :])
+            gy = jnp.clip(jnp.broadcast_to(gy, (ph, pw, R, R)), 0, H - 1)
+            gx = jnp.clip(jnp.broadcast_to(gx, (ph, pw, R, R)), 0, W - 1)
+            vals = fmap[:, gy.astype(jnp.int32), gx.astype(jnp.int32)]
+            outs.append(vals.max(axis=(-2, -1)))
+        return jnp.stack(outs)
+
+    return apply_op(f, xt, name="roi_pool")
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """ref: vision/ops.py psroi_pool — position-sensitive average pool."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    xt = to_tensor_like(x)
+    C = xt.shape[1]
+    assert C % (ph * pw) == 0, "channels must divide ph*pw"
+    Cout = C // (ph * pw)
+    bx = unwrap(to_tensor_like(boxes)).astype(jnp.float32)
+    bn = np.asarray(unwrap(to_tensor_like(boxes_num)))
+    img_of_box = np.repeat(np.arange(len(bn)), bn)
+
+    def f(feat):
+        H, W = feat.shape[-2:]
+        outs = []
+        for i in range(bx.shape[0]):
+            fmap = feat[int(img_of_box[i])].reshape(Cout, ph, pw, H, W)
+            x1, y1, x2, y2 = bx[i] * spatial_scale
+            rh = jnp.maximum(y2 - y1, 0.1)
+            rw = jnp.maximum(x2 - x1, 0.1)
+            R = 4
+            bins = []
+            gy = y1 + rh / ph * (jnp.arange(ph)[:, None, None, None]
+                                 + jnp.linspace(0, 1, R)[None, None, :, None])
+            gx = x1 + rw / pw * (jnp.arange(pw)[None, :, None, None]
+                                 + jnp.linspace(0, 1, R)[None, None, None, :])
+            gy = jnp.clip(jnp.broadcast_to(gy, (ph, pw, R, R)),
+                          0, H - 1).astype(jnp.int32)
+            gx = jnp.clip(jnp.broadcast_to(gx, (ph, pw, R, R)),
+                          0, W - 1).astype(jnp.int32)
+            # channel group (i, j) reads its own slice at bin (i, j)
+            vals = fmap[:, jnp.arange(ph)[:, None, None, None],
+                        jnp.arange(pw)[None, :, None, None], gy, gx]
+            outs.append(vals.mean(axis=(-2, -1)))
+        return jnp.stack(outs)
+
+    return apply_op(f, xt, name="psroi_pool")
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """ref: vision/ops.py yolo_box — decode YOLOv3 head to boxes+scores."""
+    xv = unwrap(to_tensor_like(x)).astype(jnp.float32)
+    imgs = unwrap(to_tensor_like(img_size)).astype(jnp.float32)
+    na = len(anchors) // 2
+    B, C, H, W = xv.shape
+    an = jnp.asarray(np.array(anchors, np.float32).reshape(na, 2))
+    p = xv.reshape(B, na, -1, H, W)
+    bx = (jax.nn.sigmoid(p[:, :, 0]) * scale_x_y
+          - (scale_x_y - 1) / 2 + jnp.arange(W)[None, None, None, :]) / W
+    by = (jax.nn.sigmoid(p[:, :, 1]) * scale_x_y
+          - (scale_x_y - 1) / 2 + jnp.arange(H)[None, None, :, None]) / H
+    in_w, in_h = W * downsample_ratio, H * downsample_ratio
+    bw = jnp.exp(p[:, :, 2]) * an[None, :, 0, None, None] / in_w
+    bh = jnp.exp(p[:, :, 3]) * an[None, :, 1, None, None] / in_h
+    obj = jax.nn.sigmoid(p[:, :, 4])
+    cls = jax.nn.sigmoid(p[:, :, 5:5 + class_num])
+    scores = obj[:, :, None] * cls
+    img_h = imgs[:, 0][:, None, None, None]
+    img_w = imgs[:, 1][:, None, None, None]
+    x1 = (bx - bw / 2) * img_w
+    y1 = (by - bh / 2) * img_h
+    x2 = (bx + bw / 2) * img_w
+    y2 = (by + bh / 2) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+        x2 = jnp.clip(x2, 0, img_w - 1)
+        y2 = jnp.clip(y2, 0, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(B, -1, 4)
+    mask = obj.reshape(B, -1) > conf_thresh
+    boxes = boxes * mask[..., None]
+    scores = (scores * (obj[:, :, None] > conf_thresh)
+              ).transpose(0, 1, 3, 4, 2).reshape(B, -1, class_num)
+    return (Tensor(boxes, stop_gradient=True),
+            Tensor(scores, stop_gradient=True))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    raise NotImplementedError(
+        "yolo_loss: train YOLO heads with the composed losses "
+        "(bce/iou) — the fused kernel shim is not provided on TPU")
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """ref: phi edit_distance — Levenshtein over id sequences."""
+    a = np.asarray(unwrap(to_tensor_like(input)))
+    b = np.asarray(unwrap(to_tensor_like(label)))
+    if a.ndim == 1:
+        a, b = a[None], b[None]
+    B = a.shape[0]
+    la = (np.asarray(unwrap(to_tensor_like(input_length)))
+          if input_length is not None else np.full(B, a.shape[1]))
+    lb = (np.asarray(unwrap(to_tensor_like(label_length)))
+          if label_length is not None else np.full(B, b.shape[1]))
+    ignored = set(ignored_tokens or ())
+    dists = np.zeros((B, 1), np.float32)
+    for i in range(B):
+        s1 = [t for t in a[i][: int(la[i])] if t not in ignored]
+        s2 = [t for t in b[i][: int(lb[i])] if t not in ignored]
+        m, n = len(s1), len(s2)
+        dp = np.arange(n + 1, dtype=np.int32)
+        for r in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = r
+            for c in range(1, n + 1):
+                dp[c] = min(prev[c] + 1, dp[c - 1] + 1,
+                            prev[c - 1] + (s1[r - 1] != s2[c - 1]))
+        d = float(dp[n])
+        if normalized:
+            d = d / max(n, 1)
+        dists[i, 0] = d
+    return (Tensor(jnp.asarray(dists), stop_gradient=True),
+            Tensor(jnp.asarray(np.stack([la, lb], -1).astype(np.int64)),
+                   stop_gradient=True))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """ref: vision/ops.py distribute_fpn_proposals — assign RoIs to FPN
+    levels by scale."""
+    rois = np.asarray(unwrap(to_tensor_like(fpn_rois)), np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    w = np.maximum(rois[:, 2] - rois[:, 0] + off, 0)
+    h = np.maximum(rois[:, 3] - rois[:, 1] + off, 0)
+    scale = np.sqrt(w * h)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, idxs = [], []
+    for L in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl == L)[0]
+        outs.append(Tensor(jnp.asarray(rois[sel]), stop_gradient=True))
+        idxs.append(sel)
+    restore = np.argsort(np.concatenate(idxs)) if idxs else np.array([])
+    nums = [Tensor(jnp.asarray(np.array([len(i)], np.int32)),
+                   stop_gradient=True) for i in idxs]
+    res_idx = Tensor(jnp.asarray(restore.astype(np.int32)[:, None]),
+                     stop_gradient=True)
+    if rois_num is not None:
+        return outs, res_idx, nums
+    return outs, res_idx
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """ref: phi box_coder kernel."""
+    pb = unwrap(to_tensor_like(prior_box)).astype(jnp.float32)
+    tb = unwrap(to_tensor_like(target_box)).astype(jnp.float32)
+    pbv = (unwrap(to_tensor_like(prior_box_var)).astype(jnp.float32)
+           if prior_box_var is not None else None)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+    if code_type.startswith("encode"):
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw / 2
+        tcy = tb[:, 1] + th / 2
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(tw[:, None] / pw[None, :])
+        dh = jnp.log(th[:, None] / ph[None, :])
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        if pbv is not None:
+            out = out / pbv[None, :, :]
+    else:
+        d = tb if tb.ndim == 3 else tb[:, None, :]
+        if pbv is not None:
+            d = d * pbv[None if axis == 0 else slice(None)]
+        dx, dy, dw, dh = d[..., 0], d[..., 1], d[..., 2], d[..., 3]
+        cx = dx * pw + pcx
+        cy = dy * ph + pcy
+        w = jnp.exp(dw) * pw
+        h = jnp.exp(dh) * ph
+        out = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - norm, cy + h / 2 - norm], axis=-1)
+    return Tensor(out, stop_gradient=True)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """ref: vision/ops.py generate_proposals (RPN). Decode + top-k + NMS."""
+    s = np.asarray(unwrap(to_tensor_like(scores)), np.float32)
+    d = np.asarray(unwrap(to_tensor_like(bbox_deltas)), np.float32)
+    an = np.asarray(unwrap(to_tensor_like(anchors)), np.float32).reshape(-1, 4)
+    var = np.asarray(unwrap(to_tensor_like(variances)), np.float32).reshape(-1, 4)
+    img = np.asarray(unwrap(to_tensor_like(img_size)), np.float32)
+    B = s.shape[0]
+    rois_out, num_out = [], []
+    for bi in range(B):
+        sc = s[bi].transpose(1, 2, 0).reshape(-1)
+        dl = d[bi].transpose(1, 2, 0).reshape(-1, 4)
+        order = np.argsort(-sc)[:pre_nms_top_n]
+        sc, dl2, an2, var2 = sc[order], dl[order], an[order % len(an)], \
+            var[order % len(var)]
+        aw = an2[:, 2] - an2[:, 0]
+        ah = an2[:, 3] - an2[:, 1]
+        acx = an2[:, 0] + aw / 2
+        acy = an2[:, 1] + ah / 2
+        cx = dl2[:, 0] * var2[:, 0] * aw + acx
+        cy = dl2[:, 1] * var2[:, 1] * ah + acy
+        w = np.exp(np.minimum(dl2[:, 2] * var2[:, 2], 10)) * aw
+        h = np.exp(np.minimum(dl2[:, 3] * var2[:, 3], 10)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, img[bi, 1] - 1)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, img[bi, 0] - 1)
+        ok = ((boxes[:, 2] - boxes[:, 0] >= min_size)
+              & (boxes[:, 3] - boxes[:, 1] >= min_size))
+        boxes, sc = boxes[ok], sc[ok]
+        keep = np.asarray(nms(jnp.asarray(boxes), nms_thresh,
+                              jnp.asarray(sc)).numpy())[:post_nms_top_n]
+        rois_out.append(boxes[keep])
+        num_out.append(len(keep))
+    rois = Tensor(jnp.asarray(np.concatenate(rois_out, 0)),
+                  stop_gradient=True)
+    scores_t = Tensor(jnp.asarray(np.array(num_out, np.int32)),
+                      stop_gradient=True)
+    if return_rois_num:
+        return rois, scores_t
+    return rois
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """ref: vision/ops.py deform_conv2d / phi deformable_conv kernel.
+    Gather-based bilinear sampling formulation (v1 when mask is None,
+    v2 'modulated' when mask given)."""
+    xt = to_tensor_like(x)
+    ot = to_tensor_like(offset)
+    wt = to_tensor_like(weight)
+    args = [xt, ot, wt]
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+    if mask is not None:
+        args.append(to_tensor_like(mask))
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dilation = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+
+    def f(xa, off, w, *rest):
+        bias_a = rest[0] if bias is not None else None
+        mask_a = rest[-1] if mask is not None else None
+        B, C, H, W = xa.shape
+        Cout, Cin_g, kh, kw = w.shape
+        sh, sw = stride
+        ph, pw = padding
+        dh, dw = dilation
+        Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        xa = jnp.pad(xa, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        base_y = (jnp.arange(Ho) * sh)[:, None, None, None] + \
+            (jnp.arange(kh) * dh)[None, None, :, None]
+        base_x = (jnp.arange(Wo) * sw)[None, :, None, None] + \
+            (jnp.arange(kw) * dw)[None, None, None, :]
+        off = off.reshape(B, deformable_groups, kh, kw, 2, Ho, Wo)
+        cols = []
+        for b in range(B):
+            per_g = []
+            Cg = C // deformable_groups
+            for g in range(deformable_groups):
+                oy = off[b, g, :, :, 0].transpose(2, 3, 0, 1)
+                ox = off[b, g, :, :, 1].transpose(2, 3, 0, 1)
+                gy = base_y + oy                     # [Ho, Wo, kh, kw]
+                gx = base_x + ox
+                vals = _bilinear(xa[b, g * Cg:(g + 1) * Cg], gy, gx)
+                if mask_a is not None:
+                    mm = mask_a[b].reshape(deformable_groups, kh, kw, Ho, Wo)
+                    vals = vals * mm[g].transpose(3, 4, 0, 1)[None] \
+                        if mm[g].ndim == 4 else vals
+                per_g.append(vals)
+            cols.append(jnp.concatenate(per_g, axis=0))
+        col = jnp.stack(cols)                        # [B, C, Ho, Wo, kh, kw]
+        out = jnp.einsum("bchwkl,ockl->bohw", col,
+                         w.reshape(Cout, Cin_g, kh, kw))
+        if bias_a is not None:
+            out = out + bias_a[None, :, None, None]
+        return out
+
+    return apply_op(f, *args, name="deformable_conv")
+
+
+class DeformConv2D:
+    """Layer wrapper (ref: paddle.vision.ops.DeformConv2D)."""
+
+    def __new__(cls, *args, **kw):
+        from ..nn.layer.layers import Layer
+
+        class _DeformConv2D(Layer):
+            def __init__(self, in_channels, out_channels, kernel_size,
+                         stride=1, padding=0, dilation=1,
+                         deformable_groups=1, groups=1, weight_attr=None,
+                         bias_attr=None):
+                super().__init__()
+                ks = (kernel_size, kernel_size) \
+                    if isinstance(kernel_size, int) else tuple(kernel_size)
+                self.stride, self.padding = stride, padding
+                self.dilation = dilation
+                self.deformable_groups = deformable_groups
+                self.groups = groups
+                self.weight = self.create_parameter(
+                    (out_channels, in_channels // groups, *ks))
+                self.bias = (None if bias_attr is False
+                             else self.create_parameter((out_channels,),
+                                                        is_bias=True))
+
+            def forward(self, x, offset, mask=None):
+                return deform_conv2d(x, offset, self.weight, self.bias,
+                                     self.stride, self.padding,
+                                     self.dilation, self.deformable_groups,
+                                     self.groups, mask)
+
+        return _DeformConv2D(*args, **kw)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """ref: phi decode_jpeg kernel (vision/ops.py). Host-side decode via
+    Pillow (the reference uses nvJPEG on CUDA; decode is a host/IO op on
+    TPU pipelines)."""
+    import io as _io
+
+    from PIL import Image
+
+    raw = bytes(np.asarray(unwrap(to_tensor_like(x)), np.uint8))
+    img = Image.open(_io.BytesIO(raw))
+    if mode not in ("unchanged", ""):
+        img = img.convert({"gray": "L", "rgb": "RGB"}.get(mode, mode.upper()))
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)   # CHW like the reference
+    return Tensor(jnp.asarray(arr), stop_gradient=True)

@@ -1,0 +1,185 @@
+"""Inference/decode milestone tests (VERDICT r1 item 7 / missing #2).
+
+Ref parity: paddle.jit.save/load (python/paddle/jit/api.py), AnalysisPredictor
+(fluid/inference/api/analysis_predictor.cc:1280,:2320), decode kernels
+(fused_multi_transformer_op.cu / masked_multihead_attention).
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+
+class TestGeneration:
+    def _model(self):
+        paddle.seed(0)
+        cfg = llama_tiny(dtype="float32", use_recompute=False)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        return m, cfg
+
+    def test_kv_cache_matches_no_cache_greedy(self):
+        """Compiled prefill+decode must emit IDENTICAL tokens to the
+        no-cache full-forward greedy loop."""
+        m, cfg = self._model()
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(
+            rng.integers(0, cfg.vocab_size, (2, 7)).astype(np.int32))
+        out = np.asarray(m.generate(ids, max_new_tokens=6).numpy())
+        cur = np.asarray(ids.numpy())
+        for step in range(6):
+            logits = np.asarray(m(paddle.to_tensor(cur)).numpy())
+            nxt = np.argmax(logits[:, -1], axis=-1).astype(np.int32)
+            np.testing.assert_array_equal(out[:, step], nxt)
+            cur = np.concatenate([cur, nxt[:, None]], axis=1)
+
+    def test_eos_stops_sequence(self):
+        m, cfg = self._model()
+        rng = np.random.default_rng(1)
+        ids = paddle.to_tensor(
+            rng.integers(0, cfg.vocab_size, (1, 5)).astype(np.int32))
+        base = np.asarray(m.generate(ids, max_new_tokens=5).numpy())
+        eos = int(base[0, 1])  # force EOS at the 2nd generated token
+        out = np.asarray(m.generate(ids, max_new_tokens=5,
+                                    eos_token_id=eos).numpy())
+        assert out[0, 1] == eos
+        assert (out[0, 2:] == eos).all(), "post-EOS must be padded with EOS"
+
+    def test_sampling_deterministic_per_seed(self):
+        m, cfg = self._model()
+        rng = np.random.default_rng(2)
+        ids = paddle.to_tensor(
+            rng.integers(0, cfg.vocab_size, (1, 4)).astype(np.int32))
+        a = np.asarray(m.generate(ids, max_new_tokens=8, do_sample=True,
+                                  top_k=8, seed=7).numpy())
+        b = np.asarray(m.generate(ids, max_new_tokens=8, do_sample=True,
+                                  top_k=8, seed=7).numpy())
+        c = np.asarray(m.generate(ids, max_new_tokens=8, do_sample=True,
+                                  top_k=8, seed=8).numpy())
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)
+
+
+class TestExportedArtifact:
+    def test_save_load_runs_without_model_code(self):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+        m.eval()
+        x = np.random.default_rng(0).standard_normal((2, 8)).astype(np.float32)
+        want = np.asarray(m(paddle.to_tensor(x)).numpy())
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "net")
+            paddle.jit.save(m, path,
+                            input_spec=[paddle.jit.InputSpec((2, 8))])
+            assert os.path.exists(path + ".pdmodel")
+            assert os.path.exists(path + ".pdparams")
+            loaded = paddle.jit.load(path)
+            got = np.asarray(loaded(paddle.to_tensor(x)).numpy())
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        with pytest.raises(RuntimeError):
+            loaded.train()
+
+    def test_predictor_api(self):
+        from paddle_tpu.inference import Config, create_predictor
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        m.eval()
+        x = np.random.default_rng(1).standard_normal((2, 8)).astype(np.float32)
+        want = np.asarray(m(paddle.to_tensor(x)).numpy())
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "net")
+            paddle.jit.save(m, path,
+                            input_spec=[paddle.jit.InputSpec((2, 8))])
+            cfg = Config(path + ".pdmodel")
+            pred = create_predictor(cfg)
+            names = pred.get_input_names()
+            h = pred.get_input_handle(names[0])
+            h.copy_from_cpu(x)
+            assert pred.run()
+            out = pred.get_output_handle(pred.get_output_names()[0])
+            got = out.copy_to_cpu()
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_llama_export_artifact(self):
+        """Export the LLaMA forward itself (decode loop stays model-side)."""
+        paddle.seed(0)
+        cfg = llama_tiny(dtype="float32", use_recompute=False,
+                         scan_layers=False)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        ids = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (1, 8)).astype(np.int32)
+        want = np.asarray(m(paddle.to_tensor(ids)).numpy())
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "llama")
+            paddle.jit.save(m, path,
+                            input_spec=[paddle.jit.InputSpec((1, 8), "int32")])
+            loaded = paddle.jit.load(path)
+            got = np.asarray(loaded(paddle.to_tensor(ids)).numpy())
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+class TestDecodeKernels:
+    def test_decode_attention_matches_dense(self):
+        """paged decode path == straightforward masked attention."""
+        import jax.numpy as jnp
+        from paddle_tpu.kernels.paged_attention import decode_attention
+        rng = np.random.default_rng(0)
+        B, S, H, D = 2, 32, 4, 8
+        q = jnp.asarray(rng.standard_normal((B, 1, H, D)), jnp.float32)
+        ck = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+        cv = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+        cur = 17
+        out = np.asarray(decode_attention(q, ck, cv, cur))
+        # reference
+        s = np.einsum("bhd,bshd->bhs", np.asarray(q[:, 0]), np.asarray(ck))
+        s = s / np.sqrt(D)
+        s[:, :, cur:] = -np.inf
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.einsum("bhs,bshd->bhd", p, np.asarray(cv))
+        np.testing.assert_allclose(out[:, 0], want, rtol=2e-5, atol=2e-5)
+
+    def test_masked_multihead_attention_updates_cache(self):
+        import jax.numpy as jnp
+        import paddle_tpu.incubate.nn.functional as IF
+        rng = np.random.default_rng(1)
+        B, nh, S, d = 2, 2, 16, 8
+        x = paddle.to_tensor(
+            rng.standard_normal((B, 3 * nh * d)).astype(np.float32))
+        cache = paddle.to_tensor(np.zeros((2, B, nh, S, d), np.float32))
+        sl = paddle.to_tensor(np.array([3, 5], np.int32))
+        out, new_cache = IF.masked_multihead_attention(
+            x, cache_kv=cache, sequence_lengths=sl)
+        assert tuple(out.shape) == (B, nh * d)
+        nc = np.asarray(new_cache.numpy())
+        # the new k was written at position sl per batch
+        assert np.abs(nc[0, 0, :, 3]).sum() > 0
+        assert np.abs(nc[0, 1, :, 5]).sum() > 0
+        assert np.abs(nc[0, 0, :, 4]).sum() == 0
+
+    def test_block_multihead_attention_paged(self):
+        import jax.numpy as jnp
+        import paddle_tpu.incubate.nn.functional as IF
+        rng = np.random.default_rng(2)
+        B, nh, d, bs, ppseq = 2, 4, 8, 16, 2
+        n_pages = B * ppseq
+        qkv = paddle.to_tensor(
+            rng.standard_normal((B, 3 * nh * d)).astype(np.float32))
+        kc = paddle.to_tensor(np.zeros((n_pages, nh, bs, d), np.float32))
+        vc = paddle.to_tensor(np.zeros((n_pages, nh, bs, d), np.float32))
+        bt = paddle.to_tensor(
+            np.arange(n_pages, dtype=np.int32).reshape(B, ppseq))
+        sl = paddle.to_tensor(np.array([0, 17], np.int32))
+        out, kc2, vc2 = IF.block_multihead_attention(
+            qkv, kc, vc, None, sl, None, block_tables=bt, block_size=bs)
+        assert tuple(out.shape) == (B, nh * d)
+        assert np.isfinite(np.asarray(out.numpy())).all()
+        # batch 1 wrote into its second page (17 // 16 == 1), slot 1
+        k2 = np.asarray(kc2.numpy())
+        assert np.abs(k2[bt.numpy()[1, 1], :, 1]).sum() > 0

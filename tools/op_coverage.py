@@ -86,6 +86,16 @@ INTERNAL = {
     "skip_layernorm", "fc", "fusion_gru", "fusion_repeated_fc_relu",
     "fusion_seqconv_eltadd_relu", "fusion_seqexpand_concat_fc",
     "fusion_squared_mat_sub", "fusion_transpose_flatten_concat",
+    # collective kernel variants (public API: paddle.distributed.all_reduce
+    # with ReduceOp; the c_* kernels are static-graph internals)
+    "c_allreduce_max", "c_allreduce_min", "c_allreduce_prod", "c_reduce_sum",
+    # runtime/memory internals
+    "coalesce_tensor", "merge_selected_rows", "npu_identity",
+    "shadow_feed", "full_int_array", "full_with_tensor",
+    # flag toggles surfaced as paddle.set_flags(FLAGS_check_nan_inf)
+    "disable_check_model_nan_inf", "enable_check_model_nan_inf",
+    # CUDA-arch-specific fused training kernels (XLA fuses the composition)
+    "fused_batch_norm_act", "fused_bn_add_activation",
 }
 
 # YAML name -> name the public API actually uses (reference's api aliases)
@@ -212,6 +222,30 @@ ALIASES = {
     "sequence_conv": "conv1d",
     "partial_concat": "concat", "partial_sum": "sum",
     "identity_loss": "identity_loss",
+    # interpolate family: one public API (paddle.nn.functional.interpolate)
+    "bicubic_interp": "interpolate", "bilinear_interp": "interpolate",
+    "linear_interp": "interpolate", "nearest_interp": "interpolate",
+    "trilinear_interp": "interpolate",
+    "fft_c2c": "fft", "fft_r2c": "rfft", "fft_c2r": "irfft",
+    "auc": "Auc",
+    "max_pool2d_with_index": "max_pool2d",
+    "max_pool3d_with_index": "max_pool3d",
+    "logsigmoid": "log_sigmoid",
+    "bce_loss": "binary_cross_entropy",
+    "kldiv_loss": "kl_div",
+    "multiclass_nms3": "matrix_nms",
+    "graph_khop_sampler": "khop_sampler",
+    "graph_sample_neighbors": "sample_neighbors",
+    "gaussian_inplace": "normal_",
+    "uniform_inplace": "uniform_",
+    "rnn": "RNN",
+    "spectral_norm": "SpectralNorm",
+    "tensor_unfold": "unfold",
+    "view_dtype": "view", "view_shape": "view",
+    "index_select_strided": "index_select",
+    "trans_layout": "transpose",
+    "segment_pool": "segment_sum",
+    "deformable_conv": "deform_conv2d",
 }
 
 
@@ -235,7 +269,7 @@ def public_namespaces():
     import paddle_tpu as paddle
     from paddle_tpu.tensor import Tensor
     spaces = [paddle, Tensor, paddle.nn.functional, paddle.nn,
-              paddle.linalg, paddle.fft, paddle.signal]
+              paddle.linalg, paddle.fft, paddle.signal, paddle.text]
     for modname in ("sparse", "geometric", "vision", "metric"):
         spaces.append(getattr(paddle, modname, None))
     try:
