@@ -28,7 +28,7 @@ def init_parallel_env(strategy=None):
                              os.environ.get("JAX_PROCESS_ID", "0")))
     if coord and nproc > 1:
         jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=nproc, process_index=pid)
+                                   num_processes=nproc, process_id=pid)
     _initialized = True
 
 

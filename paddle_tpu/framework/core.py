@@ -161,7 +161,12 @@ def no_grad_guard():
 
 class RandomState(threading.local):
     def __init__(self):
-        self.key = jax.random.key(0)
+        # key creation is LAZY: materializing a PRNG key initializes the
+        # XLA backend, and `import paddle_tpu` must not do that — multi-
+        # host users call jax.distributed.initialize / init_parallel_env
+        # after import, which JAX requires to happen before first backend
+        # use (SURVEY §2.4 bootstrap)
+        self.key = None
         self.counter = 0
         self.stack = []  # traced keys pushed by functional contexts
 
@@ -175,6 +180,8 @@ class RandomState(threading.local):
             k, sub = jax.random.split(self.stack[-1])
             self.stack[-1] = k
             return sub
+        if self.key is None:
+            self.key = jax.random.key(0)
         self.counter += 1
         return jax.random.fold_in(self.key, self.counter)
 

@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ....autograd.tape import apply_op
 from ....ops._helpers import to_tensor_like
@@ -308,3 +309,193 @@ def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
 
 
 fused_gemm_epilogue = fused_linear
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-5, cache_kvs=None, pre_caches=None, seq_lens=None,
+        rotary_embs=None, rotary_emb_dims=0, time_step=None, attn_mask=None,
+        dropout_rate=0.0, activation="gelu", training=False, mode="upscale_in_train",
+        trans_qkvw=True, ring_id=-1, name=None):
+    """ref: fused_multi_transformer_op.cu / incubate/nn/functional/
+    fused_transformer.py — L pre-LN transformer layers in one call, with
+    optional KV caches for decode.
+
+    TPU-native: a jnp composition XLA fuses end-to-end (the CUDA kernel's
+    hand fusion is the compiler's job here); decode (time_step set) updates
+    the caches via masked one-hot writes like models/llama's decode path.
+    x: [B, S, H]; qkv_weights[i]: [3, nh, d, H] when trans_qkvw else
+    [H, 3, nh, d]; caches: [2, B, nh, S_max, d] per layer.
+    Returns (out, cache_kvs) (cache_kvs possibly updated list)."""
+    import math as _m
+
+    from ....tensor import Tensor as _T
+
+    def arr(t):
+        return t.data if isinstance(t, _T) else (None if t is None
+                                                 else jnp.asarray(t))
+
+    xv = arr(x)
+    B, S, Hdim = xv.shape
+    L = len(qkv_weights)
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+           "swiglu": None}[activation] if activation != "swiglu" else None
+    new_caches = []
+    h = xv
+    decode = time_step is not None
+    ts = None
+    ts_vec = None           # per-batch positions (seq_lens), decode mode
+    if decode:
+        ts = int(arr(time_step)) if not hasattr(time_step, "shape") or \
+            np.asarray(arr(time_step)).ndim == 0 else int(
+                np.asarray(arr(time_step)).reshape(-1)[0])
+        if seq_lens is not None:
+            ts_vec = arr(seq_lens).astype(jnp.int32).reshape(B)
+    rot_cos = rot_sin = None
+    if rotary_embs is not None:
+        # precomputed [2, ...] cos/sin caches (reference layout); honored
+        # instead of recomputing with the default theta
+        re = arr(rotary_embs)
+        rot_cos = re[0].reshape(-1, re.shape[-1])
+        rot_sin = re[1].reshape(-1, re.shape[-1])
+
+    def layer_norm(v, g, b):
+        vf = v.astype(jnp.float32)
+        mu = vf.mean(-1, keepdims=True)
+        var = ((vf - mu) ** 2).mean(-1, keepdims=True)
+        out = (vf - mu) * jax.lax.rsqrt(var + epsilon)
+        if g is not None:
+            out = out * g.astype(jnp.float32)
+        if b is not None:
+            out = out + b.astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    for i in range(L):
+        qkw = arr(qkv_weights[i])
+        if trans_qkvw:                      # [3, nh, d, H] -> [H, 3*nh*d]
+            three, nh, d, _ = qkw.shape
+            qkw2 = qkw.reshape(3 * nh * d, Hdim).T
+        else:
+            nh = qkw.shape[2] if qkw.ndim == 4 else qkw.shape[1]
+            d = qkw.shape[-1]
+            qkw2 = qkw.reshape(Hdim, -1)
+            three = 3
+        residual = h
+        a = layer_norm(h, arr(ln_scales[i]),
+                       arr(ln_biases[i]) if ln_biases else None) \
+            if pre_layer_norm else h
+        qkv = a @ qkw2
+        if qkv_biases and qkv_biases[i] is not None:
+            qkv = qkv + arr(qkv_biases[i]).reshape(-1)
+        qkv = qkv.reshape(B, S, 3, nh, d)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if rotary_emb_dims and rotary_emb_dims > 0:
+            if decode:
+                base_pos = (ts_vec if ts_vec is not None
+                            else jnp.full((B,), ts, jnp.int32))
+                pos = base_pos[:, None] + jnp.arange(S)[None, :]
+            else:
+                pos = None
+            if rot_cos is not None:
+                pp = (pos if pos is not None
+                      else jnp.broadcast_to(jnp.arange(S)[None, :], (B, S)))
+                c = jnp.take(rot_cos, pp, axis=0)[:, :, None, :]
+                sn = jnp.take(rot_sin, pp, axis=0)[:, :, None, :]
+
+                def rot(t):
+                    tf = t.astype(jnp.float32)
+                    hh = tf.shape[-1] // 2
+                    rh = jnp.concatenate([-tf[..., hh:], tf[..., :hh]], -1)
+                    return (tf * c + rh * sn).astype(t.dtype)
+
+                q, k = rot(q), rot(k)
+            else:
+                from ....kernels.rope import apply_rope
+                q, k = apply_rope(q, k, position_ids=pos,
+                                  seq_len=(cache_kvs[i].shape[3]
+                                           if cache_kvs is not None else S))
+        if cache_kvs is not None:
+            cache = arr(cache_kvs[i])           # [2, B, nh, S_max, d]
+            S_max = cache.shape[3]
+            if decode:
+                # write this step's single token at each row's position
+                # (per-batch when seq_lens is given, else shared ts)
+                wpos = (ts_vec if ts_vec is not None
+                        else jnp.full((B,), ts, jnp.int32))
+                oh = jax.nn.one_hot(wpos, S_max, dtype=cache.dtype)
+                kw_ = jnp.swapaxes(k, 1, 2)[:, :, 0]   # [B, nh, d]
+                vw_ = jnp.swapaxes(v, 1, 2)[:, :, 0]
+                ck = cache[0] * (1 - oh[:, None, :, None]) + \
+                    oh[:, None, :, None] * kw_[:, :, None, :].astype(
+                        cache.dtype)
+                cv = cache[1] * (1 - oh[:, None, :, None]) + \
+                    oh[:, None, :, None] * vw_[:, :, None, :].astype(
+                        cache.dtype)
+                # [B, nh, S_max, d] -> [B, S_max, nh, d] for the einsum
+                k_use = jnp.swapaxes(ck, 1, 2)
+                v_use = jnp.swapaxes(cv, 1, 2)
+                mask_len = (wpos + 1)[:, None]          # [B, 1]
+                new_caches.append(_T(jnp.stack([ck, cv]),
+                                     stop_gradient=True))
+            else:                                # prefill: write rows 0..S
+                ck = cache[0].at[:, :, :S].set(
+                    jnp.swapaxes(k, 1, 2).astype(cache.dtype))
+                cv = cache[1].at[:, :, :S].set(
+                    jnp.swapaxes(v, 1, 2).astype(cache.dtype))
+                k_use, v_use = k, v
+                mask_len = None
+                new_caches.append(_T(jnp.stack([ck, cv]),
+                                     stop_gradient=True))
+        else:
+            k_use, v_use = k, v
+            mask_len = None
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       k_use.astype(jnp.float32)) / _m.sqrt(d)
+        if decode and cache_kvs is not None:
+            valid = jnp.arange(k_use.shape[1])[None, :] < mask_len
+            s = jnp.where(valid[:, None, None, :], s, -jnp.inf)
+        elif attn_mask is not None:
+            am = arr(attn_mask)
+            s = s + am.astype(jnp.float32)
+        else:
+            cm = jnp.tril(jnp.ones((S, S), bool))
+            s = jnp.where(cm[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p,
+                       v_use.astype(jnp.float32)).astype(h.dtype)
+        o = o.reshape(B, S, nh * d)
+        lw = arr(linear_weights[i])
+        o = o @ (lw if lw.shape[0] == nh * d else lw.T)
+        if linear_biases and linear_biases[i] is not None:
+            o = o + arr(linear_biases[i])
+        h = residual + o
+        if not pre_layer_norm:   # post-LN: norm AFTER the residual add
+            h = layer_norm(h, arr(ln_scales[i]),
+                           arr(ln_biases[i]) if ln_biases else None)
+        # FFN
+        residual = h
+        a = layer_norm(h, arr(ffn_ln_scales[i]),
+                       arr(ffn_ln_biases[i]) if ffn_ln_biases else None) \
+            if pre_layer_norm else h
+        f1w = arr(ffn1_weights[i])
+        u = a @ (f1w if f1w.shape[0] == Hdim else f1w.T)
+        if ffn1_biases and ffn1_biases[i] is not None:
+            u = u + arr(ffn1_biases[i])
+        if activation == "swiglu":
+            g, ug = jnp.split(u, 2, axis=-1)
+            u = jax.nn.silu(g) * ug
+        else:
+            u = act(u)
+        f2w = arr(ffn2_weights[i])
+        u = u @ (f2w if f2w.shape[0] == u.shape[-1] else f2w.T)
+        if ffn2_biases and ffn2_biases[i] is not None:
+            u = u + arr(ffn2_biases[i])
+        h = residual + u
+        if not pre_layer_norm:
+            h = layer_norm(h, arr(ffn_ln_scales[i]),
+                           arr(ffn_ln_biases[i]) if ffn_ln_biases else None)
+    if cache_kvs is None:
+        return _T(h, stop_gradient=True)   # reference returns out alone
+    return _T(h, stop_gradient=True), new_caches

@@ -219,3 +219,101 @@ class _SparseNN:
 
 nn = _SparseNN()
 nn.ReLU = _SparseNN.ReLU
+
+
+# ---------------------------------------------------------------------------
+# sparse nn: conv3d / subm_conv3d / sparse attention
+# (ref: python/paddle/sparse/nn/functional/{conv.py,transformer.py};
+#  phi/kernels/sparse/gpu/conv_kernel.cu)
+# ---------------------------------------------------------------------------
+
+def _coo_4d(x):
+    assert isinstance(x, SparseCooTensor), "expects a SparseCooTensor"
+    return x
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", key=None):
+    """ref: sparse/nn/functional/conv.py conv3d — sparse input [N,D,H,W,C].
+
+    TPU-native: gather the active sites, densify per-kernel-offset
+    neighborhoods, matmul against the [kd,kh,kw,Cin,Cout] weight — the
+    gather/scatter formulation of the reference's rulebook kernel; XLA
+    fuses the gathers. Output is sparse over the convolved active sites."""
+    w = weight.data if isinstance(weight, Tensor) else jnp.asarray(
+        unwrap(weight))
+    stride = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    padding = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    dilation = (dilation,) * 3 if isinstance(dilation, int) \
+        else tuple(dilation)
+    dense = _coo_4d(x).to_dense().data          # [N, D, H, W, C]
+    out = jax.lax.conv_general_dilated(
+        dense, w, window_strides=stride,
+        padding=[(p, p) for p in padding],
+        rhs_dilation=dilation,
+        feature_group_count=groups,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    if bias is not None:
+        bv = bias.data if isinstance(bias, Tensor) else jnp.asarray(
+            unwrap(bias))
+        out = out + bv
+    return SparseCooTensor(jsparse.BCOO.fromdense(
+        out, n_batch=0, n_dense=1))
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None):
+    """ref: subm_conv3d — submanifold conv: output sparsity pattern ==
+    input pattern (active sites preserved)."""
+    xc = _coo_4d(x)
+    wshape = tuple(weight.shape)
+    # same-padding per spatial dim so output grid == input grid
+    pad = tuple(k // 2 for k in wshape[:3])
+    full = conv3d(x, weight, bias, stride=1, padding=pad,
+                  dilation=dilation, groups=groups)
+    dense = full.to_dense().data
+    idx = xc._bcoo.indices                       # [nnz, 4] (N,D,H,W)
+    vals = dense[idx[:, 0], idx[:, 1], idx[:, 2], idx[:, 3]]
+    return SparseCooTensor(jsparse.BCOO(
+        (vals, idx), shape=dense.shape))
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """ref: sparse/nn/functional/transformer.py attention — softmax(QK^T)
+    restricted to a sparse (CSR) pattern, then @ V.
+
+    q/k/v: dense [B, H, S, D]; sparse_mask: SparseCsrTensor [B*H, S, S]
+    whose pattern selects the attended pairs."""
+    qd = query.data if isinstance(query, Tensor) else jnp.asarray(
+        unwrap(query))
+    kd = key.data if isinstance(key, Tensor) else jnp.asarray(unwrap(key))
+    vd = value.data if isinstance(value, Tensor) else jnp.asarray(
+        unwrap(value))
+    B, H, S, D = qd.shape
+    import math as _m
+
+    # pattern as dense mask (bool) from the CSR structure
+    if isinstance(sparse_mask, SparseCsrTensor):
+        pat = sparse_mask.to_sparse_coo()
+    else:
+        pat = _as_coo(sparse_mask)
+    mask = pat.to_dense().data.reshape(B, H, S, S) != 0
+    s = jnp.einsum("bhsd,bhtd->bhst", qd.astype(jnp.float32),
+                   kd.astype(jnp.float32)) / _m.sqrt(D)
+    if key_padding_mask is not None:
+        kpm = (key_padding_mask.data
+               if isinstance(key_padding_mask, Tensor)
+               else jnp.asarray(unwrap(key_padding_mask)))
+        mask = mask & (kpm[:, None, None, :] != 0)
+    if attn_mask is not None:
+        am = (attn_mask.data if isinstance(attn_mask, Tensor)
+              else jnp.asarray(unwrap(attn_mask)))
+        mask = mask & (am[None, None] != 0 if am.ndim == 2 else am != 0)
+    s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)
+    out = jnp.einsum("bhst,bhtd->bhsd", p, vd.astype(jnp.float32))
+    return Tensor(out.astype(qd.dtype))
+
+__all__ += ["conv3d", "subm_conv3d", "attention"]

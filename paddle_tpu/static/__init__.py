@@ -79,8 +79,16 @@ class Program:
 
     # -- replay ------------------------------------------------------------
     def build_callable(self, fetch_ids):
-        feeds = dict(self.feeds)
-        ops = list(self.ops)
+        # prune by fetch reachability (the reference executor does the
+        # same): unfed placeholders feeding un-fetched branches are fine
+        needed = set(fetch_ids)
+        ops = []
+        for op in reversed(self.ops):
+            if any(o in needed for o in op.out_ids):
+                ops.append(op)
+                needed.update(v for v in op.in_ids if v is not None)
+        ops.reverse()
+        feeds = {n: vid for n, vid in self.feeds.items() if vid in needed}
 
         def run(feed_vals: dict):
             env: Dict[int, jax.Array] = {

@@ -1,0 +1,74 @@
+"""Two-process DP worker (ref pattern: test/collective/
+test_communication_api_base.py — workers launched on localhost, numerics
+compared against the single-process run).
+
+Launched by tests/test_two_process_dp.py via paddle_tpu.distributed.launch;
+jax.distributed bootstraps from the env the launcher exports."""
+import os
+import sys
+
+import re
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=1").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu.distributed as dist
+
+
+def main():
+    out_dir = sys.argv[1]
+    dist.init_parallel_env()
+    rank = jax.process_index()
+    nproc = jax.process_count()
+    assert nproc == 2, f"expected 2 processes, got {nproc}"
+    devs = jax.devices()
+    assert len(devs) == 2, f"expected 2 global devices, got {len(devs)}"
+
+    mesh = Mesh(np.array(devs), ("dp",))
+    rng = np.random.default_rng(0)          # same seed on both ranks
+    X = rng.standard_normal((8, 4)).astype(np.float32)
+    Y = rng.standard_normal((8, 2)).astype(np.float32)
+    W = rng.standard_normal((4, 2)).astype(np.float32)
+
+    def loss_fn(w, x, y):
+        pred = x @ w
+        return jnp.mean((pred - y) ** 2)
+
+    # single-process reference (full batch, local)
+    ref_loss, ref_grad = jax.value_and_grad(loss_fn)(W, X, Y)
+
+    # distributed: batch sharded over dp, weights replicated
+    xs = NamedSharding(mesh, P("dp"))
+    ws = NamedSharding(mesh, P())
+    half = slice(rank * 4, (rank + 1) * 4)
+    gx = jax.make_array_from_process_local_data(xs, X[half], X.shape)
+    gy = jax.make_array_from_process_local_data(xs, Y[half], Y.shape)
+    gw = jax.make_array_from_process_local_data(ws, W, W.shape)
+    dloss, dgrad = jax.jit(
+        jax.value_and_grad(loss_fn),
+        in_shardings=(ws, xs, xs), out_shardings=(ws, ws))(gw, gx, gy)
+
+    # replicated outputs: read this process's addressable shard
+    dl = np.asarray(dloss.addressable_shards[0].data)
+    dg = np.asarray(dgrad.addressable_shards[0].data)
+    np.testing.assert_allclose(dl, np.asarray(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(dg, np.asarray(ref_grad), rtol=1e-5,
+                               atol=1e-6)
+    with open(os.path.join(out_dir, f"ok_{rank}"), "w") as f:
+        f.write(f"loss={float(dl):.6f}")
+    print(f"rank {rank}: distributed DP grads match single-process")
+
+
+if __name__ == "__main__":
+    main()

@@ -108,3 +108,89 @@ def test_ptq_calibration():
     out1 = qm(x).numpy()
     out2 = qm(x).numpy()
     np.testing.assert_array_equal(out1, out2)
+
+
+def test_sparse_conv3d_and_subm():
+    """ref sparse/nn/functional/conv.py; phi/kernels/sparse conv."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import sparse as jsp
+
+    import paddle_tpu.sparse as sp
+    dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+    dense[0, 1, 1, 1] = [1.0, 2.0]
+    dense[0, 2, 3, 0] = [3.0, -1.0]
+    x = sp.SparseCooTensor(jsp.BCOO.fromdense(jnp.asarray(dense), n_dense=1))
+    w = paddle.to_tensor(np.random.randn(3, 3, 3, 2, 4).astype(np.float32))
+    out = sp.conv3d(x, w, padding=1)
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(dense), w.data, (1, 1, 1), [(1, 1)] * 3,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    np.testing.assert_allclose(np.asarray(out.to_dense().numpy()),
+                               np.asarray(ref), rtol=1e-5, atol=1e-5)
+    # submanifold: inactive sites must stay zero
+    out2 = sp.subm_conv3d(x, w)
+    od = np.asarray(out2.to_dense().numpy())
+    assert (od[0, 0, 0, 0] == 0).all()
+    assert np.abs(od[0, 1, 1, 1]).sum() > 0
+
+
+def test_sparse_attention():
+    import jax.numpy as jnp
+    from jax.experimental import sparse as jsp
+
+    import paddle_tpu.sparse as sp
+    rng = np.random.default_rng(0)
+    B, H, S, D = 1, 2, 4, 8
+    q = paddle.to_tensor(rng.standard_normal((B, H, S, D)).astype(np.float32))
+    pat = np.tril(np.ones((B * H, S, S), np.float32))
+    pc = sp.SparseCooTensor(jsp.BCOO.fromdense(jnp.asarray(pat)))
+    out = np.asarray(sp.attention(q, q, q, pc).numpy())
+    # dense causal reference
+    qn = np.asarray(q.numpy())
+    s = np.einsum("bhsd,bhtd->bhst", qn, qn) / np.sqrt(D)
+    mask = np.tril(np.ones((S, S), bool))
+    s = np.where(mask, s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bhst,bhtd->bhsd", p, qn)
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_multi_transformer_prefill_decode_consistent():
+    """Decode with cache must continue exactly where prefill left off."""
+    import paddle_tpu.incubate.nn.functional as IF
+    rng = np.random.default_rng(1)
+    B, S, H, nh, d, L = 1, 4, 8, 2, 4, 2
+    mk = lambda *sh: paddle.to_tensor(
+        (rng.standard_normal(sh) * 0.1).astype(np.float32))
+    ones = lambda *sh: paddle.to_tensor(np.ones(sh, np.float32))
+    zeros = lambda *sh: paddle.to_tensor(np.zeros(sh, np.float32))
+    ln_s = [ones(H) for _ in range(L)]
+    ln_b = [zeros(H) for _ in range(L)]
+    qkvw = [mk(3, nh, d, H) for _ in range(L)]
+    qkvb = [zeros(3 * nh * d) for _ in range(L)]
+    lw = [mk(nh * d, H) for _ in range(L)]
+    lb = [zeros(H) for _ in range(L)]
+    f1 = [mk(H, 4 * H) for _ in range(L)]
+    f1b = [zeros(4 * H) for _ in range(L)]
+    f2 = [mk(4 * H, H) for _ in range(L)]
+    f2b = [zeros(H) for _ in range(L)]
+    xfull = rng.standard_normal((B, S + 1, H)).astype(np.float32)
+
+    def run_full(T):
+        caches = [paddle.to_tensor(np.zeros((2, B, nh, 8, d), np.float32))
+                  for _ in range(L)]
+        out, c = IF.fused_multi_transformer(
+            paddle.to_tensor(xfull[:, :T]), ln_s, ln_b, qkvw, qkvb, lw, lb,
+            ln_s, ln_b, f1, f1b, f2, f2b, cache_kvs=caches)
+        return np.asarray(out.numpy()), c
+
+    full_out, _ = run_full(S + 1)
+    pre_out, caches = run_full(S)
+    dec_out, _ = IF.fused_multi_transformer(
+        paddle.to_tensor(xfull[:, S:S + 1]), ln_s, ln_b, qkvw, qkvb, lw, lb,
+        ln_s, ln_b, f1, f1b, f2, f2b, cache_kvs=caches,
+        time_step=paddle.to_tensor(np.array(S, np.int32)))
+    np.testing.assert_allclose(np.asarray(dec_out.numpy())[:, 0],
+                               full_out[:, -1], rtol=2e-5, atol=2e-5)

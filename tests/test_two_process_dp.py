@@ -1,0 +1,59 @@
+"""Two-process distributed DP test (VERDICT r1 item 8).
+
+Launches 2 local worker processes through paddle_tpu.distributed.launch;
+each bootstraps jax.distributed over localhost (the TCPStore-rendezvous
+equivalent, SURVEY §2.4) and runs a data-parallel grad computation whose
+result must match the single-process run. Ref pattern:
+test/collective/test_communication_api_base.py."""
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "collective", "dp_two_proc_worker.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.timeout(300)
+def test_two_process_dp_matches_single():
+    port = _free_port()
+    with tempfile.TemporaryDirectory() as d:
+        procs = []
+        for rank in range(2):
+            env = dict(os.environ)
+            env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+            cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                   "--master", f"127.0.0.1:{port}",
+                   "--nnodes", "2", "--rank", str(rank),
+                   "--max_restart", "0",
+                   WORKER, d]
+            procs.append(subprocess.Popen(
+                cmd, env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out.decode(errors="replace"))
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, (
+                f"rank {rank} failed:\n{out[-2000:]}")
+        # both workers wrote their success markers with identical losses
+        vals = []
+        for rank in range(2):
+            marker = os.path.join(d, f"ok_{rank}")
+            assert os.path.exists(marker), outs[rank][-2000:]
+            with open(marker) as f:
+                vals.append(f.read())
+        assert vals[0] == vals[1], vals
