@@ -99,8 +99,12 @@ def main():
     else:
         default_model = "tiny"
     size = os.environ.get("BENCH_MODEL", default_model)
+    # remat trades ~1/3 extra forward FLOPs for activation memory; models
+    # that fit without it should skip it (BENCH_REMAT=1 forces it on)
+    remat_default = size == "7b"
+    remat = bool(int(os.environ.get("BENCH_REMAT", int(remat_default))))
     cfg = {"tiny": L.llama_tiny, "350m": L.llama_350m,
-           "1b": L.llama_1b, "7b": L.llama_7b}[size]()
+           "1b": L.llama_1b, "7b": L.llama_7b}[size](use_recompute=remat)
     # batch must divide evenly over the sharding axis (= all chips)
     batch = int(os.environ.get("BENCH_BATCH",
                                max(4, len(devs)) if on_tpu else 2))

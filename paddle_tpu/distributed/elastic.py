@@ -21,7 +21,8 @@ from typing import Callable, Optional
 
 from . import checkpoint as dck
 
-__all__ = ["ElasticManager", "ELASTIC_EXIT_CODE"]
+__all__ = ["ElasticManager", "ELASTIC_EXIT_CODE",
+           "MembershipManager"]
 
 ELASTIC_EXIT_CODE = 101  # ref manager.py:32 — relaunch-me marker
 
@@ -106,3 +107,136 @@ class ElasticManager:
                 if on_restart is not None:
                     on_restart(restarts)
                 time.sleep(0.1)
+
+
+class MembershipManager:
+    """Heartbeat-TTL membership (ref: fleet/elastic/manager.py:126
+    ElasticManager — etcd-backed node registry with 60s-TTL heartbeats,
+    watch-driven scale events, FAULT_TOLERANCE vs ELASTIC levels).
+
+    TPU-native: etcd is replaced by an authenticated TCP registry on the
+    master (host-side control plane); each node heartbeats
+    `(name, rank, timestamp)`, the master expires entries past the TTL and
+    every node can poll `alive()` / `changed()` to trigger
+    checkpoint-restore resizing. Faulted nodes exit with
+    ELASTIC_EXIT_CODE for the launch CLI's restart loop to relaunch.
+    Endpoint env: PADDLE_ELASTIC_ENDPOINT (distinct from the rpc module's
+    PADDLE_MASTER_ENDPOINT — the two protocols must not share a port).
+    """
+
+    def __init__(self, master_endpoint=None, name=None, rank=0,
+                 ttl: float = 60.0, interval: float = 2.0):
+        import threading
+
+        self.master_endpoint = master_endpoint or os.environ.get(
+            "PADDLE_ELASTIC_ENDPOINT", "127.0.0.1:18814")
+        self.name = name or f"node{rank}"
+        self.rank = rank
+        self.ttl = ttl
+        self.interval = interval
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._beats = {}               # master-side: name -> (rank, t)
+        self._listener = None
+        self._threads = []
+        self._last_view = frozenset()
+
+    @staticmethod
+    def _addr(endpoint):
+        host, port = endpoint.rsplit(":", 1)
+        return (host, int(port))
+
+    _AUTH = b"paddle_tpu_elastic"
+
+    # -- master side --------------------------------------------------------
+    def start_master(self):
+        import threading
+        from multiprocessing.connection import Listener
+
+        self._listener = Listener(self._addr(self.master_endpoint),
+                                  authkey=self._AUTH)
+
+        def serve():
+            while not self._stop.is_set():
+                try:
+                    conn = self._listener.accept()
+                except (OSError, EOFError):
+                    return
+                try:
+                    msg = conn.recv()
+                    if msg[0] == "beat":
+                        _, name, rank = msg
+                        with self._lock:
+                            self._beats[name] = (rank, time.time())
+                        conn.send(("ok", None))
+                    elif msg[0] == "alive":
+                        conn.send(("ok", self._alive_now()))
+                except (OSError, EOFError):
+                    pass
+                finally:
+                    conn.close()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def _alive_now(self):
+        now = time.time()
+        with self._lock:
+            snapshot = dict(self._beats)
+        return {n: r for n, (r, t) in snapshot.items()
+                if now - t <= self.ttl}
+
+    # -- node side ----------------------------------------------------------
+    def start_heartbeat(self):
+        import threading
+        from multiprocessing.connection import Client
+
+        def beat():
+            while not self._stop.is_set():
+                try:
+                    c = Client(self._addr(self.master_endpoint),
+                               authkey=self._AUTH)
+                    c.send(("beat", self.name, self.rank))
+                    c.recv()
+                    c.close()
+                except (OSError, EOFError, ConnectionError):
+                    pass
+                self._stop.wait(self.interval)
+
+        t = threading.Thread(target=beat, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def alive(self):
+        """Poll the membership view {name: rank} (master or any node)."""
+        from multiprocessing.connection import Client
+
+        if self._listener is not None:
+            return self._alive_now()
+        c = Client(self._addr(self.master_endpoint), authkey=self._AUTH)
+        try:
+            c.send(("alive",))
+            status, view = c.recv()
+            return view
+        finally:
+            c.close()
+
+    def changed(self):
+        """True when membership (names AND ranks) differs from the last
+        changed() call — the signal to checkpoint + resize."""
+        view = frozenset(self.alive().items())
+        if view != self._last_view:
+            self._last_view = view
+            return True
+        return False
+
+    def stop(self):
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass

@@ -1,0 +1,215 @@
+"""paddle.distributed.rpc (ref: python/paddle/distributed/rpc/ — brpc-based
+user RPC: init_rpc, rpc_sync/rpc_async, get_worker_info, shutdown).
+
+TPU-native: the reference's brpc service is replaced by Python's
+multiprocessing.connection (authenticated pickle channel) — RPC here is a
+host-side control-plane utility (parameter servers, custom coordination),
+not a tensor fast path, so the collective/ICI stack is unaffected.
+Endpoints rendezvous through the rank-0 registry, mirroring the
+reference's master-based worker discovery."""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+from multiprocessing.connection import Client, Listener
+from typing import Any, Dict, Optional
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+_AUTH = b"paddle_tpu_rpc"
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    endpoint: str           # host:port
+
+
+class _State:
+    def __init__(self):
+        self.me: Optional[WorkerInfo] = None
+        self.workers: Dict[str, WorkerInfo] = {}
+        self.listener = None
+        self.serve_thread = None
+        self.registry_thread = None
+        self.pool = None
+        self.stop = threading.Event()
+
+
+_state = _State()
+
+
+def _addr(endpoint):
+    host, port = endpoint.rsplit(":", 1)
+    return (host, int(port))
+
+
+def _serve_loop(listener):
+    while not _state.stop.is_set():
+        try:
+            conn = listener.accept()
+        except (OSError, EOFError):
+            break
+        _state.pool.submit(_handle, conn)
+
+
+def _handle(conn):
+    try:
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                return
+            kind = msg[0]
+            if kind == "call":
+                _, fn, args, kwargs = msg
+                try:
+                    res = ("ok", fn(*args, **kwargs))
+                except Exception as e:  # errors travel back to the caller
+                    res = ("err", e)
+                try:
+                    conn.send(res)
+                except Exception:
+                    # unpicklable result/exception: send a picklable repr
+                    conn.send(("err", RuntimeError(
+                        f"rpc: remote value not picklable: {res[1]!r}")))
+            elif kind == "register":           # registry (rank 0 only)
+                _, info = msg
+                _state.workers[info.name] = info
+                conn.send(("ok", None))
+            elif kind == "workers":
+                # reply IMMEDIATELY with the current table (holding a pool
+                # thread until world_size register would deadlock for
+                # world_size > pool size); callers poll until complete
+                conn.send(("ok", dict(_state.workers)))
+            elif kind == "bye":
+                conn.send(("ok", None))
+                return
+    finally:
+        conn.close()
+
+
+def init_rpc(name: str, rank: int = None, world_size: int = None,
+             master_endpoint: str = None):
+    """ref: rpc/internal.py init_rpc. master_endpoint: host:port of rank 0's
+    registry (env PADDLE_MASTER_ENDPOINT fallback)."""
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER_ENDPOINT", "127.0.0.1:18813")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    _state.stop.clear()
+    _state.pool = ThreadPoolExecutor(max_workers=8)
+
+    # my serving endpoint: the master endpoint for rank 0, an ephemeral
+    # port otherwise
+    if rank == 0:
+        listener = Listener(_addr(master_endpoint), authkey=_AUTH)
+        my_ep = master_endpoint
+    else:
+        # bind all interfaces; advertise a cross-host-reachable address
+        # (PADDLE_LOCAL_IP overrides; hostname lookup fallback)
+        import socket as _socket
+        listener = Listener(("0.0.0.0", 0), authkey=_AUTH)
+        host = os.environ.get("PADDLE_LOCAL_IP")
+        if not host:
+            try:
+                host = _socket.gethostbyname(_socket.gethostname())
+            except OSError:
+                host = "127.0.0.1"
+        my_ep = "%s:%d" % (host, listener.address[1])
+    _state.listener = listener
+    _state.me = WorkerInfo(name, rank, my_ep)
+    _state.serve_thread = threading.Thread(
+        target=_serve_loop, args=(listener,), daemon=True)
+    _state.serve_thread.start()
+
+    # register with rank 0 and fetch the full worker table
+    deadline = time.time() + 60
+    while True:
+        try:
+            c = Client(_addr(master_endpoint), authkey=_AUTH)
+            break
+        except (ConnectionError, OSError):
+            if time.time() > deadline:
+                raise TimeoutError("rpc: master not reachable")
+            time.sleep(0.05)
+    c.send(("register", _state.me))
+    c.recv()
+    while True:
+        c.send(("workers", world_size))
+        status, table = c.recv()
+        if len(table) >= world_size:
+            break
+        if time.time() > deadline:
+            raise TimeoutError(
+                f"rpc: only {len(table)}/{world_size} workers registered")
+        time.sleep(0.05)
+    c.send(("bye", None))
+    c.recv()
+    c.close()
+    _state.workers = table
+
+
+def get_worker_info(name: str = None) -> WorkerInfo:
+    if name is None:
+        return _state.me
+    return _state.workers[name]
+
+
+def get_all_worker_infos():
+    return list(_state.workers.values())
+
+
+def _call(to: str, fn, args, kwargs):
+    info = _state.workers[to] if to in _state.workers else None
+    if info is None:
+        raise KeyError(f"rpc: unknown worker '{to}'")
+    c = Client(_addr(info.endpoint), authkey=_AUTH)
+    try:
+        c.send(("call", fn, tuple(args or ()), dict(kwargs or {})))
+        status, payload = c.recv()
+        c.send(("bye", None))
+        c.recv()
+    finally:
+        c.close()
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=None):
+    """ref: rpc/rpc.py rpc_sync — run fn(*args, **kwargs) on worker `to`.
+    timeout (seconds): the call is abandoned (TimeoutError) if the worker
+    does not reply in time; the connection is left to the daemon pool."""
+    if timeout is None:
+        return _call(to, fn, args, kwargs)
+    fut = _state.pool.submit(_call, to, fn, args, kwargs)
+    return fut.result(timeout=timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout=None) -> Future:
+    """ref: rpc_async — returns a Future (fut.wait() paddle-style)."""
+    fut = _state.pool.submit(_call, to, fn, args, kwargs)
+    fut.wait = fut.result      # paddle API: fut.wait()
+    return fut
+
+
+def shutdown():
+    _state.stop.set()
+    if _state.listener is not None:
+        try:
+            _state.listener.close()
+        except OSError:
+            pass
+    if _state.pool is not None:
+        _state.pool.shutdown(wait=False)
+    _state.workers.clear()
+    _state.me = None

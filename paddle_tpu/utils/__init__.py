@@ -1,0 +1,26 @@
+"""paddle.utils (ref: python/paddle/utils/)."""
+from . import cpp_extension  # noqa: F401
+
+__all__ = ["cpp_extension"]
+
+
+def try_import(name):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError as e:
+        raise ImportError(f"{name} is required: {e}")
+
+
+def run_check():
+    """ref: paddle.utils.run_check — sanity-check the install."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    y = (x @ x).numpy()
+    assert np.allclose(np.asarray(y), 2 * np.ones((2, 2)))
+    devs = jax.devices()
+    print(f"paddle_tpu is installed successfully! "
+          f"{len(devs)} {devs[0].platform} device(s) available.")

@@ -1,0 +1,162 @@
+"""User-pluggable C++ custom operators
+(ref: python/paddle/utils/cpp_extension/ + paddle/phi/api/ext/
+op_meta_info.h + fluid/framework/custom_operator.cc — the reference
+compiles user C++ against its kernel ABI and registers ops at runtime).
+
+TPU-native seam: the user writes a plain C function over raw buffers
+(`extern "C" void op(const float* in, float* out, const int64_t* shape,
+int ndim)`-style), `load()` compiles it with g++ into a shared object, and
+`CustomOpBuilder` wraps it as a framework op via `jax.pure_callback` — so
+the op composes with jit/grad (custom VJP optional) while the kernel body
+runs native host code. Device-side custom kernels are written in Pallas
+instead (the KPS analog, SURVEY §2.7) — see paddle_tpu/kernels for
+in-tree examples; both plug into the same apply_op tape.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply_op
+from ..ops._helpers import to_tensor_like
+
+__all__ = ["load", "CustomOp", "CppExtension", "CUDAExtension",
+           "BuildExtension", "setup"]
+
+_CACHE_DIR = os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions")
+
+
+def _compile(name: str, sources: Sequence[str], extra_cflags=(),
+             extra_ldflags=(), verbose=False) -> str:
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    blob = "".join(open(s).read() for s in sources) + repr(
+        (tuple(extra_cflags), tuple(extra_ldflags)))
+    tag = hashlib.sha1(blob.encode()).hexdigest()[:12]
+    so = os.path.join(_CACHE_DIR, f"{name}_{tag}.so")
+    if os.path.exists(so):
+        return so
+    cmd = (["g++", "-O2", "-fPIC", "-shared", "-std=c++17"]
+           + list(extra_cflags) + list(sources) + ["-o", so]
+           + list(extra_ldflags))
+    if verbose:
+        print("cpp_extension:", " ".join(cmd))
+    subprocess.run(cmd, check=True, capture_output=not verbose)
+    return so
+
+
+class CustomOp:
+    """A loaded native function exposed as a framework op.
+
+    The C symbol must have the signature
+        void <fn>(const void** inputs, void* output)
+    or be described explicitly via `argtypes`; by default inputs/outputs
+    are passed as raw float32 buffers with a leading int64 element count.
+    Simplest contract (the one `load` wires by default):
+        extern "C" void <fn>(const float* x, float* out, int64_t n);
+    elementwise over n floats. Richer signatures: subclass / pass
+    `call_with` to marshal yourself.
+    """
+
+    def __init__(self, lib: ctypes.CDLL, fn_name: str,
+                 vjp: Optional[Callable] = None,
+                 call_with: Optional[Callable] = None):
+        self._fn = getattr(lib, fn_name)
+        self.name = fn_name
+        self._vjp = vjp
+        if call_with is None:
+            self._fn.argtypes = [ctypes.POINTER(ctypes.c_float),
+                                 ctypes.POINTER(ctypes.c_float),
+                                 ctypes.c_int64]
+            self._fn.restype = None
+
+            def default_call(x):
+                x = np.ascontiguousarray(np.asarray(x, np.float32))
+                out = np.empty_like(x)
+                self._fn(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                         x.size)
+                return out
+
+            self._call = default_call
+        else:
+            self._call = lambda *a: call_with(self._fn, *a)
+
+    def __call__(self, *args):
+        ts = [to_tensor_like(a) for a in args]
+
+        def op(*arrs):
+            flat = jax.pure_callback(
+                self._call,
+                jax.ShapeDtypeStruct(arrs[0].shape, jnp.float32),
+                *arrs, vmap_method="sequential")
+            return flat
+
+        if self._vjp is not None:
+            fwd = jax.custom_vjp(op)
+
+            def f_fwd(*arrs):
+                return op(*arrs), arrs
+
+            def f_bwd(res, g):
+                out = self._vjp(res, g)
+                return out if isinstance(out, tuple) else (out,)
+
+            fwd.defvjp(f_fwd, f_bwd)
+            return apply_op(fwd, *ts, name=f"custom_{self.name}")
+        return apply_op(op, *ts, name=f"custom_{self.name}")
+
+
+class _LoadedModule:
+    def __init__(self, lib, fn_names, vjps=None):
+        self._lib = lib
+        for fn in fn_names:
+            setattr(self, fn,
+                    CustomOp(lib, fn, (vjps or {}).get(fn)))
+
+
+def load(name: str, sources: Sequence[str], functions: Sequence[str],
+         extra_cflags=(), extra_ldflags=(), vjps=None, verbose=False):
+    """ref: cpp_extension.load — compile + import user C++ ops at runtime.
+
+    functions: exported `extern "C"` symbol names to wrap as ops.
+    vjps: optional {fn_name: vjp(residual_args, cotangent) -> grads}.
+    """
+    so = _compile(name, sources, extra_cflags, extra_ldflags, verbose)
+    lib = ctypes.CDLL(so)
+    return _LoadedModule(lib, functions, vjps)
+
+
+# -- setuptools-style entry points (API parity; ref cpp_extension.setup) ----
+
+def CppExtension(sources, *args, **kwargs):
+    return {"sources": list(sources), "kind": "cpp"}
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    raise RuntimeError("CUDA extensions have no TPU analog; write device "
+                       "kernels in Pallas (see paddle_tpu/kernels) and "
+                       "host ops via cpp_extension.load")
+
+
+class BuildExtension:
+    @classmethod
+    def with_options(cls, **kw):
+        return cls
+
+
+def setup(name=None, ext_modules=None, **kw):
+    """Compile-at-setup parity shim: builds each extension into the cache
+    and returns the loaded modules instead of installing a package."""
+    mods = []
+    for ext in ext_modules or []:
+        so = _compile(name or "ext", ext["sources"])
+        mods.append(ctypes.CDLL(so))
+    return mods

@@ -1,0 +1,63 @@
+"""Custom C++ op extension (SURVEY §2.3 'Custom C++/Pallas op extension';
+ref paddle/phi/api/ext/op_meta_info.h + utils/cpp_extension)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+SRC = r"""
+#include <cstdint>
+#include <cmath>
+extern "C" void my_softsign(const float* x, float* out, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) out[i] = x[i] / (1.0f + std::fabs(x[i]));
+}
+extern "C" void my_double(const float* x, float* out, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) out[i] = 2.0f * x[i];
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def ext():
+    from paddle_tpu.utils import cpp_extension
+    with tempfile.TemporaryDirectory() as d:
+        src = os.path.join(d, "ops.cc")
+        with open(src, "w") as f:
+            f.write(SRC)
+
+        def double_vjp(residuals, g):
+            return (2.0 * g,)
+
+        yield cpp_extension.load(
+            "testops", [src], functions=["my_softsign", "my_double"],
+            vjps={"my_double": double_vjp})
+
+
+def test_custom_op_forward(ext):
+    x = np.random.default_rng(0).standard_normal((4, 5)).astype(np.float32)
+    out = np.asarray(ext.my_softsign(paddle.to_tensor(x)).numpy())
+    np.testing.assert_allclose(out, x / (1 + np.abs(x)), rtol=1e-6)
+
+
+def test_custom_op_under_jit(ext):
+    import jax
+    x = np.random.default_rng(1).standard_normal((8,)).astype(np.float32)
+
+    def f(a):
+        return ext.my_softsign(paddle.to_tensor(a)).data
+
+    out = np.asarray(jax.jit(f)(x))
+    np.testing.assert_allclose(out, x / (1 + np.abs(x)), rtol=1e-6)
+
+
+def test_custom_op_with_vjp(ext):
+    x = paddle.to_tensor(
+        np.random.default_rng(2).standard_normal((6,)).astype(np.float32))
+    x.stop_gradient = False
+    loss = ext.my_double(x).sum()
+    loss.backward()
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()),
+                               2.0 * np.ones(6), rtol=1e-6)

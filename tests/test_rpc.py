@@ -1,0 +1,104 @@
+"""paddle.distributed.rpc (SURVEY §2.4 RPC row; ref python/paddle/
+distributed/rpc). Two in-process 'workers' can't share the module-global
+state, so the remote side runs in a subprocess like the reference's tests."""
+import operator
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+WORKER = textwrap.dedent("""
+    import os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, %(repo)r)
+    import paddle_tpu.distributed.rpc as rpc
+    rpc.init_rpc("worker1", rank=1, world_size=2,
+                 master_endpoint=%(ep)r)
+    # stay alive until master says stop (polls a module flag via rpc)
+    t0 = time.time()
+    while time.time() - t0 < 60 and not getattr(rpc, "_quit", False):
+        time.sleep(0.05)
+    rpc.shutdown()
+""")
+
+
+def test_rpc_sync_async_roundtrip():
+    import paddle_tpu.distributed.rpc as rpc
+    ep = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", WORKER % {"repo": REPO, "ep": ep}],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        rpc.init_rpc("master", rank=0, world_size=2, master_endpoint=ep)
+        infos = {w.name for w in rpc.get_all_worker_infos()}
+        assert infos == {"master", "worker1"}
+        # functions must be picklable by qualified name (reference
+        # semantics too): use stdlib/numpy callables
+        assert rpc.rpc_sync("worker1", operator.add, args=(2, 40)) == 42
+        fut = rpc.rpc_async("worker1", operator.mul, args=(6, 7))
+        assert fut.wait() == 42
+        out = rpc.rpc_sync("worker1", np.sum,
+                           args=(np.arange(5, dtype=np.int64),))
+        assert int(out) == 10
+        # errors propagate
+        with pytest.raises(ZeroDivisionError):
+            rpc.rpc_sync("worker1", operator.floordiv, args=(1, 0))
+    finally:
+        rpc.shutdown()
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_membership_heartbeat_expiry():
+    """Elastic membership: heartbeats register nodes; silence past the TTL
+    expires them (ref fleet/elastic/manager.py heartbeat TTL)."""
+    import time
+
+    from paddle_tpu.distributed.elastic import MembershipManager
+    ep = f"127.0.0.1:{_free_port()}"
+    master = MembershipManager(ep, name="node0", rank=0, ttl=1.0,
+                               interval=0.2).start_master()
+    master.start_heartbeat()
+    node1 = MembershipManager(ep, name="node1", rank=1, ttl=1.0,
+                              interval=0.2).start_heartbeat()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if set(master.alive()) == {"node0", "node1"}:
+                break
+            time.sleep(0.1)
+        assert set(master.alive()) == {"node0", "node1"}
+        assert master.changed() is True      # first observation
+        assert master.changed() is False     # stable
+        # node1 dies: TTL expiry removes it
+        node1.stop()
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if set(master.alive()) == {"node0"}:
+                break
+            time.sleep(0.2)
+        assert set(master.alive()) == {"node0"}
+        assert master.changed() is True      # membership shrank
+    finally:
+        node1.stop()
+        master.stop()
