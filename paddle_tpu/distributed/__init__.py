@@ -26,6 +26,7 @@ from .sharding import (  # noqa: F401
     reshard, shard_tensor, to_placements, with_partial_annotation,
 )
 from . import fleet  # noqa: F401
+from . import ps  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import (  # noqa: F401
     load_state_dict, save_state_dict, wait_save)

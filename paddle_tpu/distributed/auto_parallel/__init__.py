@@ -8,8 +8,16 @@ TrainStep+ShardingPlan instead of completion/partitioner/reshard passes."""
 from ..sharding import (  # noqa: F401
     Partial, Placement, ProcessMesh, Replicate, Shard, dtensor_from_fn,
     reshard, shard_tensor)
+from .completion import CompletionReport, complete  # noqa: F401
+from .cost_model import (  # noqa: F401
+    CostEstimate, HardwareSpec, ModelStats, comm_bytes, comm_time,
+    estimate_config_cost, estimate_flops)
 from .engine import Engine, Strategy  # noqa: F401
+from .planner import PlanChoice, Planner  # noqa: F401
 
 __all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
            "shard_tensor", "reshard", "dtensor_from_fn", "Engine",
-           "Strategy"]
+           "Strategy", "complete", "CompletionReport", "ModelStats",
+           "HardwareSpec", "CostEstimate", "comm_bytes", "comm_time",
+           "estimate_flops", "estimate_config_cost", "Planner",
+           "PlanChoice"]

@@ -48,9 +48,104 @@ class Engine:
         self._step = None
         self._mesh = None
 
-    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+    def _model_stats(self):
+        """Derive ModelStats from the wrapped model for the cost model /
+        planner (ref: the static engine reads the same facts off the
+        program)."""
+        from .cost_model import ModelStats
+        n_params = 0
+        try:
+            for _, p in self.model.named_parameters():
+                n_params += int(np.prod(p.shape))
+        except Exception:
+            pass
+        cfg = getattr(self.model, "config", None)
+        get = lambda *names, default=1: next(
+            (getattr(cfg, n) for n in names if cfg and hasattr(cfg, n)),
+            default)
+        return ModelStats(
+            param_count=max(n_params, 1),
+            layers=get("num_hidden_layers", "num_layers", default=1),
+            hidden=get("hidden_size", default=1),
+            heads=get("num_attention_heads", "num_heads", default=1),
+            seq_len=get("max_position_embeddings", "seq_len", default=128),
+            vocab=get("vocab_size", default=32000))
+
+    def plan(self, n_devices=None, global_batch=64, hw=None):
+        """Full-auto mode (ref planner_v2.py): pick (dp, mp, pp, sharding)
+        by the cost model and fold it into this Engine's strategy."""
+        import jax
+
+        from .cost_model import TPU_V4_LIKE
+        from .planner import Planner
+        n = n_devices or len(jax.devices())
+        planner = Planner(n, self._model_stats(), global_batch,
+                          hw=hw or TPU_V4_LIKE)
+        choice = planner.plan()
+        if choice is None:
+            raise RuntimeError(
+                f"planner found no feasible config for {n} devices")
+        c = choice.config
+        s = self.strategy
+        s.dp_degree = c["dp_degree"]
+        s.mp_degree = c["mp_degree"]
+        s.pp_degree = c["pp_degree"]
+        s.sharding_degree = c["sharding_degree"]
+        self._plan_choice = choice
+        return choice
+
+    def cost(self, mode="train", global_batch=64, hw=None):
+        """Estimated (time, memory) of one step under the current strategy
+        (ref engine.py Engine.cost)."""
+        from .cost_model import TPU_V4_LIKE, estimate_config_cost
+        s = self.strategy
+        cfg = dict(dp_degree=max(s.dp_degree, 1), mp_degree=s.mp_degree,
+                   pp_degree=s.pp_degree, sharding_degree=s.sharding_degree,
+                   sharding_stage=s.sharding_stage)
+        return estimate_config_cost(self._model_stats(), cfg, global_batch,
+                                    hw or TPU_V4_LIKE)
+
+    def complete(self, *example_args):
+        """Expose the completion pass on this engine's forward function
+        (ref completion.py Completer): parameters are seeded with the
+        ShardingPlan's specs (TP annotations + ZeRO-3 FSDP decisions),
+        data args with the batch spec, and the report shows what GSPMD
+        propagated onto every remaining tensor."""
+        import jax
+
+        from ...framework import core
+        from ...tensor import Tensor
+        from .completion import complete as _complete
+        if self._step is None:
+            self.prepare()
+        plan = self._plan
+        model = self.model
+        sd = model.state_dict()
+        keys = list(sd.keys())
+        vals = [t.data for t in sd.values()]
+
+        def fwd(params, *xs):
+            state = dict(zip(keys, params))
+            with model.use_state(state), core.no_grad_guard():
+                out = model(*[Tensor(x) for x in xs])
+            return jax.tree.map(
+                lambda t: t.data if isinstance(t, Tensor) else t, out)
+
+        param_specs = [plan.param_spec(k, v) for k, v in zip(keys, vals)]
+        import numpy as _np
+        data = [a.data if isinstance(a, Tensor) else _np.asarray(a)
+                for a in example_args]
+        data_specs = [plan.batch_spec(x) for x in data]
+        return _complete(fwd, (vals, *data), self._mesh,
+                         in_specs=param_specs + data_specs)
+
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train",
+                global_batch=None):
         from ..topology import HybridCommunicateGroup, set_mesh
         s = self.strategy
+        if s.auto_mode == "full" and getattr(self, "_plan_choice",
+                                             None) is None:
+            self.plan(global_batch=global_batch or 64)
         hcg = HybridCommunicateGroup(
             dp_degree=s.dp_degree, mp_degree=s.mp_degree,
             pp_degree=s.pp_degree, sharding_degree=s.sharding_degree)
@@ -68,6 +163,7 @@ class Engine:
             return loss_fn(out, y)
 
         plan = ShardingPlan(self._mesh, stage=s.sharding_stage)
+        self._plan = plan
         self._step = pjit.TrainStep(model, self.optimizer, step_fn,
                                     shard=plan)
         return self
@@ -75,7 +171,7 @@ class Engine:
     def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
             log_freq=10, verbose=0, **kw):
         if self._step is None:
-            self.prepare()
+            self.prepare(global_batch=batch_size)
         from ...io import DataLoader, Dataset
         loader = (train_data if isinstance(train_data, DataLoader)
                   else DataLoader(train_data, batch_size=batch_size,

@@ -13,7 +13,35 @@ from . import mpu  # noqa: F401
 
 __all__ = ["init", "DistributedStrategy", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",
-           "worker_index", "worker_num", "layers", "meta_parallel", "mpu"]
+           "worker_index", "worker_num", "layers", "meta_parallel", "mpu",
+           "UserDefinedRoleMaker", "Role", "is_server", "is_worker"]
+
+
+class Role:
+    """ref: fleet/base/role_maker.py Role enum."""
+    WORKER = 1
+    SERVER = 2
+
+
+class UserDefinedRoleMaker:
+    """ref: fleet/base/role_maker.py UserDefinedRoleMaker — explicit PS
+    topology (server endpoints + this process's role)."""
+
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints=None):
+        self.current_id = current_id
+        self.role = role
+        self._worker_num = worker_num
+        self.server_endpoints = server_endpoints or []
+
+    def is_server(self):
+        return self.role == Role.SERVER
+
+    def is_worker(self):
+        return self.role == Role.WORKER
+
+    def worker_num(self):
+        return self._worker_num
 
 
 class DistributedStrategy:
@@ -40,8 +68,27 @@ _fleet_initialized = False
 _strategy: Optional[DistributedStrategy] = None
 
 
+_role_maker: Optional[UserDefinedRoleMaker] = None
+
+
+def is_server():
+    return _role_maker is not None and _role_maker.is_server()
+
+
+def is_worker():
+    return _role_maker is None or _role_maker.is_worker()
+
+
 def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
-    global _fleet_initialized, _strategy
+    global _fleet_initialized, _strategy, _role_maker
+    if not is_collective:
+        # PS mode (ref fleet.init(role_maker) with a PS role maker):
+        # no mesh/collective bootstrap — tables + pull/push live in
+        # paddle_tpu.distributed.ps; the role maker names this process.
+        _role_maker = role_maker or UserDefinedRoleMaker()
+        _strategy = strategy or DistributedStrategy()
+        _fleet_initialized = True
+        return
     from ..env import init_parallel_env
     init_parallel_env()
     _strategy = strategy or DistributedStrategy()

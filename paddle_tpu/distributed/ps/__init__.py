@@ -1,0 +1,343 @@
+"""Parameter-server training mode (ref: paddle/fluid/distributed/ps/ —
+table/ (MemoryDenseTable, MemorySparseTable), accessors with per-table
+optimizer rules; python/paddle/distributed/fleet PS mode: workers
+pull params / push grads, servers apply updates; the_one_ps.py wires
+tables to a brpc service).
+
+TPU-native position: PS is a HOST-side subsystem — sparse embedding tables
+too big for HBM live in host RAM on server processes, while the dense math
+stays on the TPU mesh. Tables are numpy (host memory by definition);
+transport is an authenticated-pickle channel in the style of
+paddle_tpu.distributed.rpc (kept separate: PS connections are stateful
+and long-lived, rpc's are per-call); update rules (SGD/Adagrad/Adam) mirror the
+reference's accessor rules. Workers can also embed a server in-process
+(single-host async training) — no socket needed.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from multiprocessing.connection import Client, Listener
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["SGDRule", "AdagradRule", "AdamRule", "DenseTable", "SparseTable",
+           "ParameterServer", "PSClient", "run_server"]
+
+_AUTH = b"paddle_tpu_ps"
+
+
+# ---------------- update rules (ref: ps/table/sparse_sgd_rule.cc) ---------
+
+class SGDRule:
+    def __init__(self, learning_rate=0.01):
+        self.lr = learning_rate
+
+    def init_state(self, shape):
+        return {}
+
+    def apply(self, param, grad, state):
+        param -= self.lr * grad
+        return param
+
+
+class AdagradRule:
+    def __init__(self, learning_rate=0.01, epsilon=1e-6):
+        self.lr = learning_rate
+        self.eps = epsilon
+
+    def init_state(self, shape):
+        return {"g2": np.zeros(shape, np.float32)}
+
+    def apply(self, param, grad, state):
+        state["g2"] += grad * grad
+        param -= self.lr * grad / (np.sqrt(state["g2"]) + self.eps)
+        return param
+
+
+class AdamRule:
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8):
+        self.lr, self.b1, self.b2, self.eps = (learning_rate, beta1, beta2,
+                                               epsilon)
+
+    def init_state(self, shape):
+        return {"m": np.zeros(shape, np.float32),
+                "v": np.zeros(shape, np.float32), "t": 0}
+
+    def apply(self, param, grad, state):
+        state["t"] += 1
+        t = state["t"]
+        state["m"] = self.b1 * state["m"] + (1 - self.b1) * grad
+        state["v"] = self.b2 * state["v"] + (1 - self.b2) * grad * grad
+        mhat = state["m"] / (1 - self.b1 ** t)
+        vhat = state["v"] / (1 - self.b2 ** t)
+        param -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
+        return param
+
+
+_RULES = {"sgd": SGDRule, "adagrad": AdagradRule, "adam": AdamRule}
+
+
+def _make_rule(rule):
+    if isinstance(rule, str):
+        return _RULES[rule]()
+    return rule
+
+
+# ---------------- tables (ref: ps/table/memory_dense_table.cc, ----------
+#                  memory_sparse_table.cc)
+
+class DenseTable:
+    """Replicated dense parameter block living on the server."""
+
+    def __init__(self, shape, rule="sgd", initializer=None):
+        self.param = (np.zeros(shape, np.float32) if initializer is None
+                      else np.asarray(initializer(shape), np.float32))
+        self.rule = _make_rule(rule)
+        self.state = self.rule.init_state(self.param.shape)
+        self.lock = threading.Lock()
+
+    def pull(self):
+        with self.lock:
+            return self.param.copy()
+
+    def push(self, grad):
+        grad = np.asarray(grad, np.float32)
+        with self.lock:
+            self.param = self.rule.apply(self.param, grad, self.state)
+
+    def set(self, value):
+        with self.lock:
+            self.param = np.asarray(value, np.float32)
+
+
+class SparseTable:
+    """id -> embedding-row store with lazy row creation (ref
+    MemorySparseTable: rows materialize on first touch, per-row optimizer
+    state)."""
+
+    def __init__(self, emb_dim, rule="sgd", initializer=None, seed=0):
+        self.dim = int(emb_dim)
+        self.rule = _make_rule(rule)
+        self.rows: Dict[int, np.ndarray] = {}
+        self.states: Dict[int, dict] = {}
+        self.lock = threading.Lock()
+        self._rng = np.random.default_rng(seed)
+        self._init = initializer or (
+            lambda shape: (self._rng.standard_normal(shape) * 0.01))
+
+    def _row(self, i: int) -> np.ndarray:
+        r = self.rows.get(i)
+        if r is None:
+            r = np.asarray(self._init((self.dim,)), np.float32)
+            self.rows[i] = r
+            self.states[i] = self.rule.init_state((self.dim,))
+        return r
+
+    def pull(self, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).ravel()
+        with self.lock:
+            return np.stack([self._row(int(i)) for i in ids])
+
+    def push(self, ids, grads):
+        """Duplicate ids accumulate (ref: push_sparse merges by key)."""
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(merged, inv, grads)
+        with self.lock:
+            for j, i in enumerate(uniq):
+                i = int(i)
+                self._row(i)
+                self.rows[i] = self.rule.apply(self.rows[i], merged[j],
+                                               self.states[i])
+
+    def __len__(self):
+        return len(self.rows)
+
+
+# ---------------- server ------------------------------------------------
+
+class ParameterServer:
+    """Holds named tables and services pull/push ops (ref the_one_ps.py
+    TheOnePSRuntime + brpc PsService). Usable in-process (call methods
+    directly) or over a socket via serve()/PSClient."""
+
+    def __init__(self):
+        self.tables: Dict[str, object] = {}
+        self._barrier_lock = threading.Lock()
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._barrier_cv = threading.Condition(self._barrier_lock)
+        self._stop = threading.Event()
+        self._listener = None
+        self._thread = None
+
+    # -- table management
+    def create_dense_table(self, name, shape, rule="sgd", initializer=None):
+        self.tables[name] = DenseTable(shape, rule, initializer)
+        return self.tables[name]
+
+    def create_sparse_table(self, name, emb_dim, rule="sgd",
+                            initializer=None):
+        self.tables[name] = SparseTable(emb_dim, rule, initializer)
+        return self.tables[name]
+
+    # -- ops (the wire protocol dispatches here)
+    def pull_dense(self, table):
+        return self.tables[table].pull()
+
+    def push_dense(self, table, grad):
+        self.tables[table].push(grad)
+
+    def pull_sparse(self, table, ids):
+        return self.tables[table].pull(ids)
+
+    def push_sparse(self, table, ids, grads):
+        self.tables[table].push(ids, grads)
+
+    def barrier(self, n_workers):
+        """Block until n_workers callers arrive (ref barrier_with_table)."""
+        with self._barrier_cv:
+            gen = self._barrier_gen
+            self._barrier_count += 1
+            if self._barrier_count >= n_workers:
+                self._barrier_count = 0
+                self._barrier_gen += 1
+                self._barrier_cv.notify_all()
+                return
+            while self._barrier_gen == gen and not self._stop.is_set():
+                self._barrier_cv.wait(timeout=0.1)
+
+    # -- socket service
+    def serve(self, endpoint: str, n_threads: int = None):
+        """n_threads is accepted for API compat but connections are
+        long-lived (one per worker), so each gets a dedicated daemon
+        thread — a bounded pool would deadlock at barrier() once workers
+        outnumber threads."""
+        host, port = endpoint.rsplit(":", 1)
+        self._listener = Listener((host, int(port)), authkey=_AUTH)
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    conn = self._listener.accept()
+                except (OSError, EOFError):
+                    break
+                threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True).start()
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _handle(self, conn):
+        try:
+            while not self._stop.is_set():
+                op, args = conn.recv()
+                if op == "stop":
+                    conn.send(("ok", None))
+                    self.shutdown()
+                    break
+                try:
+                    out = getattr(self, op)(*args)
+                    conn.send(("ok", out))
+                except Exception as e:  # worker sees the server error
+                    conn.send(("err", repr(e)))
+        except (EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def shutdown(self):
+        self._stop.set()
+        with self._barrier_cv:
+            self._barrier_cv.notify_all()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+
+def run_server(endpoint, build_fn):
+    """Convenience for a server process: build tables, serve until stopped.
+    build_fn(server) registers tables."""
+    ps = ParameterServer()
+    build_fn(ps)
+    ps.serve(endpoint)
+    while not ps._stop.is_set():
+        time.sleep(0.05)
+    return ps
+
+
+# ---------------- worker client -----------------------------------------
+
+class PSClient:
+    """Worker-side handle (ref: fleet PS worker push/pull API). Either
+    wraps an in-process ParameterServer or a socket endpoint."""
+
+    def __init__(self, server: Optional[ParameterServer] = None,
+                 endpoint: Optional[str] = None, retries: int = 50):
+        assert (server is None) != (endpoint is None), \
+            "exactly one of server/endpoint"
+        self._local = server
+        self._conn = None
+        self._lock = threading.Lock()
+        if endpoint is not None:
+            host, port = endpoint.rsplit(":", 1)
+            last = None
+            for _ in range(retries):
+                try:
+                    self._conn = Client((host, int(port)), authkey=_AUTH)
+                    break
+                except (ConnectionError, OSError) as e:
+                    last = e
+                    time.sleep(0.1)
+            if self._conn is None:
+                raise ConnectionError(f"PS at {endpoint} unreachable: {last}")
+
+    def _call(self, op, *args):
+        if self._local is not None:
+            return getattr(self._local, op)(*args)
+        with self._lock:
+            self._conn.send((op, args))
+            status, out = self._conn.recv()
+        if status == "err":
+            raise RuntimeError(f"server error in {op}: {out}")
+        return out
+
+    def pull_dense(self, table):
+        return self._call("pull_dense", table)
+
+    def push_dense(self, table, grad):
+        return self._call("push_dense", table, np.asarray(grad))
+
+    def pull_sparse(self, table, ids):
+        return self._call("pull_sparse", table, np.asarray(ids))
+
+    def push_sparse(self, table, ids, grads):
+        return self._call("push_sparse", table, np.asarray(ids),
+                          np.asarray(grads))
+
+    def barrier(self, n_workers):
+        return self._call("barrier", n_workers)
+
+    def stop_server(self):
+        if self._local is not None:
+            self._local.shutdown()
+            return
+        try:
+            self._call("stop")
+        except (EOFError, OSError, RuntimeError):
+            pass
+
+    def close(self):
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass

@@ -1,0 +1,385 @@
+"""jaxpr -> ONNX graph conversion (ref: python/paddle/onnx/export.py
+delegates to paddle2onnx's program->onnx translator; here the traced
+jaxpr plays the role of the program).
+
+The supported primitive set covers the deployment-typical inference
+graphs (MLP / CNN / attention building blocks); anything outside it
+raises with the primitive named. Composite calls (jit / pjit /
+custom_jvp) are inlined recursively, so library ops like nn.functional
+relu/softmax decompose into their elementwise ONNX form.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from . import proto as pb
+
+
+class _Ctx:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self.names: Dict[int, str] = {}     # id(jax var) -> onnx name
+        self.counter = 0
+        self.const_cache: Dict[tuple, str] = {}
+
+    def fresh(self, hint="t"):
+        self.counter += 1
+        return f"{hint}_{self.counter}"
+
+    def add_const(self, arr: np.ndarray, hint="const"):
+        key = (arr.dtype.str, arr.shape, arr.tobytes())
+        if key in self.const_cache:
+            return self.const_cache[key]
+        name = self.fresh(hint)
+        self.initializers.append(pb.tensor_proto(name, arr))
+        self.const_cache[key] = name
+        return name
+
+    def emit(self, op, inputs, n_out=1, attrs=(), hint=None):
+        outs = [self.fresh(hint or op.lower()) for _ in range(n_out)]
+        self.nodes.append(pb.node_with_attrs(op, inputs, outs, list(attrs)))
+        return outs[0] if n_out == 1 else outs
+
+
+def _name_of(ctx: _Ctx, atom):
+    """jaxpr atom (Var or Literal) -> onnx name."""
+    from jax.extend import core as jcore
+    if isinstance(atom, jcore.Literal):
+        arr = np.asarray(atom.val)
+        if arr.dtype == np.float64:
+            arr = arr.astype(np.float32)
+        if arr.dtype == np.int64 and atom.aval.dtype == np.int32:
+            arr = arr.astype(np.int32)
+        return ctx.add_const(arr)
+    return ctx.names[id(atom)]
+
+
+def _is_zero_literal(atom):
+    from jax.extend import core as jcore
+    return (isinstance(atom, jcore.Literal)
+            and np.ndim(atom.val) == 0 and float(atom.val) == 0.0)
+
+
+def _shape_const(ctx, shape):
+    return ctx.add_const(np.asarray(shape, np.int64), "shape")
+
+
+def _convert_eqn(ctx: _Ctx, eqn):
+    prim = eqn.primitive.name
+    ins = eqn.invars
+    out = eqn.outvars[0]
+
+    def set_out(name):
+        ctx.names[id(out)] = name
+
+    # ---- composite calls: inline ----
+    if prim in ("jit", "pjit", "closed_call", "custom_jvp_call",
+                "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
+                "checkpoint"):
+        inner = (eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+                 or eqn.params.get("fun_jaxpr"))
+        jaxpr = getattr(inner, "jaxpr", inner)
+        consts = getattr(inner, "consts", ())
+        for cv, c in zip(jaxpr.constvars, consts):
+            ctx.names[id(cv)] = ctx.add_const(np.asarray(c))
+        for iv, a in zip(jaxpr.invars, ins):
+            ctx.names[id(iv)] = _name_of(ctx, a)
+        for e in jaxpr.eqns:
+            _convert_eqn(ctx, e)
+        for ov, o in zip(jaxpr.outvars, eqn.outvars):
+            ctx.names[id(o)] = _name_of(ctx, ov)
+        return
+
+    simple = {"add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+              "pow": "Pow", "min": "Min",
+              "exp": "Exp", "log": "Log", "tanh": "Tanh",
+              "logistic": "Sigmoid", "sqrt": "Sqrt", "neg": "Neg",
+              "abs": "Abs", "sign": "Sign", "floor": "Floor",
+              "ceil": "Ceil", "erf": "Erf", "sin": "Sin", "cos": "Cos"}
+
+    if prim == "max":
+        # max(x, 0) is what relu traces to
+        if _is_zero_literal(ins[1]):
+            set_out(ctx.emit("Relu", [_name_of(ctx, ins[0])]))
+            return
+        if _is_zero_literal(ins[0]):
+            set_out(ctx.emit("Relu", [_name_of(ctx, ins[1])]))
+            return
+        set_out(ctx.emit("Max", [_name_of(ctx, a) for a in ins]))
+        return
+
+    if prim in simple:
+        set_out(ctx.emit(simple[prim], [_name_of(ctx, a) for a in ins]))
+        return
+
+    if prim == "rsqrt":
+        s = ctx.emit("Sqrt", [_name_of(ctx, ins[0])])
+        set_out(ctx.emit("Reciprocal", [s]))
+        return
+
+    if prim == "square":
+        n = _name_of(ctx, ins[0])
+        set_out(ctx.emit("Mul", [n, n]))
+        return
+
+    if prim == "erfc":                       # 1 - erf(x)
+        e = ctx.emit("Erf", [_name_of(ctx, ins[0])])
+        one = ctx.add_const(np.asarray(1.0, np.float32))
+        set_out(ctx.emit("Sub", [one, e]))
+        return
+
+    if prim == "erf_inv":
+        raise NotImplementedError("erf_inv has no ONNX mapping")
+
+    if prim == "integer_pow":
+        y = eqn.params["y"]
+        e = ctx.add_const(np.asarray(float(y), np.float32))
+        set_out(ctx.emit("Pow", [_name_of(ctx, ins[0]), e]))
+        return
+
+    if prim == "stop_gradient" or prim == "copy":
+        set_out(ctx.emit("Identity", [_name_of(ctx, ins[0])]))
+        return
+
+    if prim == "convert_element_type":
+        dt = pb.NP_TO_ONNX[np.dtype(eqn.params["new_dtype"])]
+        set_out(ctx.emit("Cast", [_name_of(ctx, ins[0])],
+                         attrs=[pb.attr_int("to", dt)]))
+        return
+
+    if prim == "transpose":
+        perm = list(eqn.params["permutation"])
+        set_out(ctx.emit("Transpose", [_name_of(ctx, ins[0])],
+                         attrs=[pb.attr_ints("perm", perm)]))
+        return
+
+    if prim == "reshape":
+        shape = list(eqn.params["new_sizes"])
+        set_out(ctx.emit("Reshape", [_name_of(ctx, ins[0]),
+                                     _shape_const(ctx, shape)]))
+        return
+
+    if prim == "squeeze":
+        set_out(ctx.emit("Reshape", [_name_of(ctx, ins[0]),
+                                     _shape_const(ctx, out.aval.shape)]))
+        return
+
+    if prim == "broadcast_in_dim":
+        operand = ins[0]
+        src_shape = tuple(operand.aval.shape)
+        bd = tuple(eqn.params["broadcast_dimensions"])
+        target = tuple(eqn.params["shape"])
+        name = _name_of(ctx, operand)
+        mid = [1] * len(target)
+        for i, d in enumerate(bd):
+            mid[d] = src_shape[i]
+        if tuple(mid) != src_shape:
+            name = ctx.emit("Reshape", [name, _shape_const(ctx, mid)])
+        if tuple(mid) != target:
+            name = ctx.emit("Expand", [name, _shape_const(ctx, target)])
+        set_out(name)  # no-op broadcasts alias the operand
+        return
+
+    if prim in ("reduce_sum", "reduce_max", "reduce_min", "reduce_prod"):
+        op = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
+              "reduce_min": "ReduceMin", "reduce_prod": "ReduceProd"}[prim]
+        axes = list(eqn.params["axes"])
+        if op == "ReduceSum":                 # opset 13+: axes as input
+            set_out(ctx.emit(op, [_name_of(ctx, ins[0]),
+                                  ctx.add_const(np.asarray(axes, np.int64),
+                                                "axes")],
+                             attrs=[pb.attr_int("keepdims", 0)]))
+        else:
+            set_out(ctx.emit(op, [_name_of(ctx, ins[0])],
+                             attrs=[pb.attr_ints("axes", axes),
+                                    pb.attr_int("keepdims", 0)]))
+        return
+
+    if prim == "concatenate":
+        axis = int(eqn.params["dimension"])
+        set_out(ctx.emit("Concat", [_name_of(ctx, a) for a in ins],
+                         attrs=[pb.attr_int("axis", axis)]))
+        return
+
+    if prim == "select_n":
+        # select_n(pred, case0, case1): pred==1 -> case1
+        assert len(ins) == 3, "select_n with >2 cases unsupported"
+        p, c0, c1 = (_name_of(ctx, a) for a in ins)
+        set_out(ctx.emit("Where", [p, c1, c0]))
+        return
+
+    if prim in ("gt", "lt", "ge", "le", "eq", "ne"):
+        op = {"gt": "Greater", "lt": "Less", "ge": "GreaterOrEqual",
+              "le": "LessOrEqual", "eq": "Equal", "ne": "Equal"}[prim]
+        o = ctx.emit(op, [_name_of(ctx, a) for a in ins])
+        if prim == "ne":
+            o = ctx.emit("Not", [o])
+        set_out(o)
+        return
+
+    if prim == "dot_general":
+        _convert_dot(ctx, eqn, set_out)
+        return
+
+    if prim == "conv_general_dilated":
+        _convert_conv(ctx, eqn, set_out)
+        return
+
+    if prim == "reduce_window_max":
+        _convert_maxpool(ctx, eqn, set_out)
+        return
+
+    if prim == "gather":
+        _convert_gather(ctx, eqn, set_out)
+        return
+
+    if prim == "iota":
+        dt = eqn.params.get("dtype", np.float32)
+        shape = tuple(eqn.params["shape"])
+        dim = int(eqn.params["dimension"])
+        n = shape[dim]
+        arr = np.arange(n, dtype=dt)
+        view = [1] * len(shape)
+        view[dim] = n
+        arr = np.broadcast_to(arr.reshape(view), shape).copy()
+        set_out(ctx.add_const(arr, "iota"))
+        return
+
+    raise NotImplementedError(
+        f"paddle.onnx.export: primitive '{prim}' is outside the supported "
+        f"export set (MLP/CNN/attention inference graphs); use "
+        f"paddle.jit.save (StableHLO) for full-coverage deployment")
+
+
+def _convert_dot(ctx, eqn, set_out):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars
+    ln, rn = _name_of(ctx, lhs), _name_of(ctx, rhs)
+    lshape, rshape = lhs.aval.shape, rhs.aval.shape
+    if len(lc) != 1 or len(rc) != 1:
+        raise NotImplementedError("dot_general with multiple contracting "
+                                  "dims is not exportable")
+    lrank, rrank = len(lshape), len(rshape)
+    if tuple(lb) != tuple(range(len(lb))) or tuple(rb) != tuple(
+            range(len(rb))):
+        raise NotImplementedError("dot_general batch dims must be leading")
+    # lhs: batch..., free..., contract(last); rhs: batch..., contract, free
+    if lc[0] != lrank - 1:
+        perm = [d for d in range(lrank) if d != lc[0]] + [lc[0]]
+        ln = ctx.emit("Transpose", [ln], attrs=[pb.attr_ints("perm", perm)])
+    want_rc = len(rb)
+    if rc[0] != want_rc:
+        perm = list(range(len(rb))) + [rc[0]] + [
+            d for d in range(len(rb), rrank) if d != rc[0]]
+        rn = ctx.emit("Transpose", [rn], attrs=[pb.attr_ints("perm", perm)])
+    set_out(ctx.emit("MatMul", [ln, rn]))
+
+
+def _conv_pads(padding):
+    # lax padding: [(lo, hi), ...] over spatial dims -> onnx [lo..., hi...]
+    los = [p[0] for p in padding]
+    his = [p[1] for p in padding]
+    return los + his
+
+
+def _convert_conv(ctx, eqn, set_out):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    lhs_spec, rhs_spec, out_spec = dn
+    nd = len(eqn.invars[0].aval.shape)
+    iden = tuple(range(nd))
+    if (tuple(lhs_spec) != iden or tuple(out_spec) != iden
+            or tuple(rhs_spec) != iden):
+        raise NotImplementedError(
+            f"conv export expects NCHW/OIHW layout, got {dn}")
+    if any(d != 1 for d in p.get("lhs_dilation", ())):
+        raise NotImplementedError("transposed conv export not supported")
+    attrs = [
+        pb.attr_ints("strides", list(p["window_strides"])),
+        pb.attr_ints("pads", _conv_pads(p["padding"])),
+        pb.attr_ints("dilations", list(p.get("rhs_dilation",
+                                             [1] * (nd - 2)))),
+        pb.attr_int("group", int(p.get("feature_group_count", 1))),
+    ]
+    set_out(ctx.emit("Conv", [_name_of(ctx, eqn.invars[0]),
+                              _name_of(ctx, eqn.invars[1])], attrs=attrs))
+
+
+def _convert_maxpool(ctx, eqn, set_out):
+    p = eqn.params
+    win = list(p["window_dimensions"])
+    strides = list(p["window_strides"])
+    padding = list(p["padding"])
+    if win[0] != 1 or win[1] != 1:
+        raise NotImplementedError("pooling over batch/channel dims")
+    attrs = [
+        pb.attr_ints("kernel_shape", win[2:]),
+        pb.attr_ints("strides", strides[2:]),
+        pb.attr_ints("pads", _conv_pads(padding[2:])),
+    ]
+    set_out(ctx.emit("MaxPool", [_name_of(ctx, eqn.invars[0])],
+                     attrs=attrs))
+
+
+def _convert_gather(ctx, eqn, set_out):
+    """Embedding-style gather: rows of a [V, D] table by integer ids."""
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    operand, indices = eqn.invars
+    oshape = operand.aval.shape
+    # the jnp.take(table, ids, axis=0) pattern: offset_dims trail,
+    # collapsed_slice_dims == (0,), start_index_map == (0,)
+    if (tuple(dn.collapsed_slice_dims) != (0,)
+            or tuple(dn.start_index_map) != (0,)
+            or tuple(p["slice_sizes"][1:]) != tuple(oshape[1:])):
+        raise NotImplementedError("only embedding-style gather exports")
+    idx = _name_of(ctx, indices)
+    ishape = indices.aval.shape
+    if ishape and ishape[-1] == 1:
+        idx = ctx.emit("Reshape",
+                       [idx, _shape_const(ctx, list(ishape[:-1]))])
+    set_out(ctx.emit("Gather", [_name_of(ctx, operand), idx],
+                     attrs=[pb.attr_int("axis", 0)]))
+
+
+def jaxpr_to_graph(closed_jaxpr, input_names, param_arrays,
+                   graph_name="paddle_tpu"):
+    """closed_jaxpr over (params..., inputs...) -> GraphProto bytes.
+
+    param_arrays: {position_index: (name, np.ndarray)} — these invars
+    become initializers; remaining invars become graph inputs named by
+    input_names in order.
+    """
+    ctx = _Ctx()
+    jaxpr = closed_jaxpr.jaxpr
+    for cv, c in zip(jaxpr.constvars, closed_jaxpr.consts):
+        ctx.names[id(cv)] = ctx.add_const(np.asarray(c))
+
+    graph_inputs = []
+    it_inputs = iter(input_names)
+    for i, iv in enumerate(jaxpr.invars):
+        if i in param_arrays:
+            name, arr = param_arrays[i]
+            ctx.initializers.append(pb.tensor_proto(name, np.asarray(arr)))
+            ctx.names[id(iv)] = name
+        else:
+            name = next(it_inputs)
+            ctx.names[id(iv)] = name
+            graph_inputs.append(pb.value_info(
+                name, pb.NP_TO_ONNX[np.dtype(iv.aval.dtype)],
+                list(iv.aval.shape)))
+
+    for eqn in jaxpr.eqns:
+        _convert_eqn(ctx, eqn)
+
+    graph_outputs = []
+    for i, ov in enumerate(jaxpr.outvars):
+        nm = _name_of(ctx, ov)
+        graph_outputs.append(pb.value_info(
+            nm, pb.NP_TO_ONNX[np.dtype(ov.aval.dtype)],
+            list(ov.aval.shape)))
+    return pb.graph_proto(ctx.nodes, graph_name, ctx.initializers,
+                          graph_inputs, graph_outputs)

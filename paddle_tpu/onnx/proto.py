@@ -1,0 +1,309 @@
+"""Minimal protobuf wire-format writer/reader for ONNX ModelProto.
+
+The image ships no `onnx` package (and the reference itself shells out to
+the external paddle2onnx for this job — python/paddle/onnx/export.py), so
+the exporter emits the wire format directly. Only the fields paddle_tpu
+uses are modeled; field numbers follow onnx/onnx.proto3.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+# ---- ONNX enum values -------------------------------------------------
+
+TENSOR_FLOAT = 1
+TENSOR_UINT8 = 2
+TENSOR_INT8 = 3
+TENSOR_INT32 = 6
+TENSOR_INT64 = 7
+TENSOR_BOOL = 9
+TENSOR_FLOAT16 = 10
+TENSOR_DOUBLE = 11
+TENSOR_BFLOAT16 = 16
+
+ATTR_FLOAT = 1
+ATTR_INT = 2
+ATTR_STRING = 3
+ATTR_TENSOR = 4
+ATTR_FLOATS = 6
+ATTR_INTS = 7
+ATTR_STRINGS = 8
+
+NP_TO_ONNX = {
+    np.dtype(np.float32): TENSOR_FLOAT,
+    np.dtype(np.float64): TENSOR_DOUBLE,
+    np.dtype(np.float16): TENSOR_FLOAT16,
+    np.dtype(np.int32): TENSOR_INT32,
+    np.dtype(np.int64): TENSOR_INT64,
+    np.dtype(np.int8): TENSOR_INT8,
+    np.dtype(np.uint8): TENSOR_UINT8,
+    np.dtype(np.bool_): TENSOR_BOOL,
+}
+ONNX_TO_NP = {v: k for k, v in NP_TO_ONNX.items()}
+
+
+# ---- wire primitives ---------------------------------------------------
+
+def varint(n: int) -> bytes:
+    n &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def tag(field: int, wire_type: int) -> bytes:
+    return varint((field << 3) | wire_type)
+
+
+def f_varint(field: int, v: int) -> bytes:
+    return tag(field, 0) + varint(int(v))
+
+
+def f_bytes(field: int, payload: bytes) -> bytes:
+    return tag(field, 2) + varint(len(payload)) + payload
+
+
+def f_str(field: int, s: str) -> bytes:
+    return f_bytes(field, s.encode("utf-8"))
+
+
+# ---- message builders --------------------------------------------------
+
+def tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    dt = NP_TO_ONNX[arr.dtype]
+    out = b""
+    for d in arr.shape:
+        out += f_varint(1, d)                       # dims
+    out += f_varint(2, dt)                          # data_type
+    out += f_str(8, name)                           # name
+    out += f_bytes(9, arr.tobytes())                # raw_data
+    return out
+
+
+def attr_int(name: str, v: int) -> bytes:
+    return f_str(1, name) + f_varint(3, v) + f_varint(20, ATTR_INT)
+
+
+def attr_float(name: str, v: float) -> bytes:
+    return (f_str(1, name) + tag(2, 5) + struct.pack("<f", v)
+            + f_varint(20, ATTR_FLOAT))
+
+
+def attr_ints(name: str, vs) -> bytes:
+    out = f_str(1, name)
+    for v in vs:
+        out += f_varint(8, v)
+    return out + f_varint(20, ATTR_INTS)
+
+
+def attr_str(name: str, s: str) -> bytes:
+    return f_str(1, name) + f_bytes(4, s.encode()) + f_varint(20, ATTR_STRING)
+
+
+def node_with_attrs(op_type: str, inputs, outputs, attr_payloads,
+                    name: str = "") -> bytes:
+    out = b""
+    for i in inputs:
+        out += f_str(1, i)
+    for o in outputs:
+        out += f_str(2, o)
+    if name:
+        out += f_str(3, name)
+    out += f_str(4, op_type)
+    for a in attr_payloads:
+        out += f_bytes(5, a)
+    return out
+
+
+def value_info(name: str, elem_type: int, shape) -> bytes:
+    dims = b""
+    for d in shape:
+        if isinstance(d, str) or d is None or (isinstance(d, int) and d < 0):
+            dim = f_str(2, str(d) if d is not None else "dyn")
+        else:
+            dim = f_varint(1, d)
+        dims += f_bytes(1, dim)                     # TensorShapeProto.dim
+    tensor_ty = f_varint(1, elem_type) + f_bytes(2, dims)
+    type_proto = f_bytes(1, tensor_ty)              # TypeProto.tensor_type
+    return f_str(1, name) + f_bytes(2, type_proto)
+
+
+def graph_proto(nodes: List[bytes], name: str, initializers: List[bytes],
+                inputs: List[bytes], outputs: List[bytes]) -> bytes:
+    out = b""
+    for n in nodes:
+        out += f_bytes(1, n)
+    out += f_str(2, name)
+    for t in initializers:
+        out += f_bytes(5, t)
+    for vi in inputs:
+        out += f_bytes(11, vi)
+    for vo in outputs:
+        out += f_bytes(12, vo)
+    return out
+
+
+def model_proto(graph: bytes, opset: int = 17,
+                producer: str = "paddle_tpu") -> bytes:
+    opset_id = f_str(1, "") + f_varint(2, opset)
+    return (f_varint(1, 8)                          # ir_version 8
+            + f_str(2, producer)
+            + f_str(3, "0.1")
+            + f_bytes(7, graph)
+            + f_bytes(8, opset_id))
+
+
+# ---- generic reader ----------------------------------------------------
+
+def parse_message(data: bytes) -> Dict[int, List[Tuple[int, object]]]:
+    """Decode one message into {field: [(wire_type, value), ...]}."""
+    fields: Dict[int, List[Tuple[int, object]]] = {}
+    i, n = 0, len(data)
+    while i < n:
+        key, i = _read_varint(data, i)
+        field, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _read_varint(data, i)
+        elif wt == 2:
+            ln, i = _read_varint(data, i)
+            v = data[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = struct.unpack_from("<I", data, i)[0]
+            i += 4
+        elif wt == 1:
+            v = struct.unpack_from("<Q", data, i)[0]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        fields.setdefault(field, []).append((wt, v))
+    return fields
+
+
+def _read_varint(data: bytes, i: int):
+    shift = 0
+    out = 0
+    while True:
+        b = data[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _one(fields, field, default=None):
+    v = fields.get(field)
+    return v[0][1] if v else default
+
+
+def _all(fields, field):
+    return [v for _, v in fields.get(field, [])]
+
+
+def decode_tensor(data: bytes):
+    f = parse_message(data)
+    dims = [int(v) for v in _all(f, 1)]
+    dt = int(_one(f, 2, TENSOR_FLOAT))
+    name = _one(f, 8, b"").decode()
+    raw = _one(f, 9)
+    if raw is not None:
+        arr = np.frombuffer(raw, ONNX_TO_NP[dt]).reshape(dims)
+    else:                                           # float_data/int*_data
+        if dt == TENSOR_FLOAT:
+            vals = [struct.unpack("<f", struct.pack("<I", v))[0]
+                    if wt == 5 else v for wt, v in f.get(4, [])]
+        else:
+            vals = [v for _, v in f.get(7, [])]
+        arr = np.asarray(vals, ONNX_TO_NP[dt]).reshape(dims)
+    return name, arr
+
+
+def decode_attr(data: bytes):
+    f = parse_message(data)
+    name = _one(f, 1, b"").decode()
+    ty = int(_one(f, 20, 0))
+    if ty == ATTR_INT:
+        val = int(_one(f, 3, 0))
+        if val >= 1 << 63:
+            val -= 1 << 64
+    elif ty == ATTR_FLOAT:
+        val = struct.unpack("<f", struct.pack("<I", _one(f, 2, 0)))[0]
+    elif ty == ATTR_INTS:
+        val = [v - (1 << 64) if v >= 1 << 63 else v for v in _all(f, 8)]
+    elif ty == ATTR_STRING:
+        val = _one(f, 4, b"").decode()
+    elif ty == ATTR_TENSOR:
+        val = decode_tensor(_one(f, 5))[1]
+    else:
+        val = None
+    return name, val
+
+
+def decode_node(data: bytes):
+    f = parse_message(data)
+    return {
+        "inputs": [b.decode() for b in _all(f, 1)],
+        "outputs": [b.decode() for b in _all(f, 2)],
+        "name": _one(f, 3, b"").decode(),
+        "op_type": _one(f, 4, b"").decode(),
+        "attrs": dict(decode_attr(a) for a in _all(f, 5)),
+    }
+
+
+def decode_value_info(data: bytes):
+    f = parse_message(data)
+    name = _one(f, 1, b"").decode()
+    shape = []
+    elem = None
+    tp = _one(f, 2)
+    if tp is not None:
+        tpf = parse_message(tp)
+        tt = _one(tpf, 1)
+        if tt is not None:
+            ttf = parse_message(tt)
+            elem = int(_one(ttf, 1, 0)) or None
+            sh = _one(ttf, 2)
+            if sh is not None:
+                for d in _all(parse_message(sh), 1):
+                    df = parse_message(d)
+                    if 1 in df:
+                        shape.append(int(_one(df, 1)))
+                    else:
+                        shape.append(_one(df, 2, b"dyn").decode())
+    return {"name": name, "elem_type": elem, "shape": shape}
+
+
+def decode_graph(data: bytes):
+    f = parse_message(data)
+    return {
+        "nodes": [decode_node(n) for n in _all(f, 1)],
+        "name": _one(f, 2, b"").decode(),
+        "initializers": dict(decode_tensor(t) for t in _all(f, 5)),
+        "inputs": [decode_value_info(v) for v in _all(f, 11)],
+        "outputs": [decode_value_info(v) for v in _all(f, 12)],
+    }
+
+
+def decode_model(data: bytes):
+    f = parse_message(data)
+    opsets = []
+    for o in _all(f, 8):
+        of = parse_message(o)
+        opsets.append((_one(of, 1, b"").decode(), int(_one(of, 2, 0))))
+    return {
+        "ir_version": int(_one(f, 1, 0)),
+        "producer": _one(f, 2, b"").decode(),
+        "graph": decode_graph(_one(f, 7, b"")),
+        "opsets": opsets,
+    }

@@ -1,0 +1,174 @@
+"""Reference numpy evaluator for exported ONNX graphs.
+
+Exists because this image has no `onnx`/onnxruntime to validate against:
+the exporter's tests decode the wire bytes with proto.decode_model and
+execute the graph here, asserting numerical equality with the source
+model. It doubles as paddle.onnx.load — a way to run an exported artifact
+without model code. Covers exactly the exporter's op set.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from . import proto as pb
+
+
+def _np_matmul(a, b):
+    return np.matmul(a, b)
+
+
+def _pool2d(x, kernel, strides, pads, op=np.max, init=-np.inf):
+    n, c, h, w = x.shape
+    kh, kw = kernel
+    sh, sw = strides
+    pt, pl, pbm, pr = pads[0], pads[1], pads[2], pads[3]
+    xp = np.full((n, c, h + pt + pbm, w + pl + pr), init, x.dtype)
+    xp[:, :, pt:pt + h, pl:pl + w] = x
+    oh = (xp.shape[2] - kh) // sh + 1
+    ow = (xp.shape[3] - kw) // sw + 1
+    out = np.empty((n, c, oh, ow), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            out[:, :, i, j] = op(
+                xp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw],
+                axis=(2, 3))
+    return out
+
+
+def _conv2d(x, w, strides, pads, dilations, group):
+    n, cin, h, wd = x.shape
+    cout, cin_g, kh, kw = w.shape
+    sh, sw = strides
+    dh, dw = dilations
+    pt, pl, pbm, pr = pads
+    xp = np.zeros((n, cin, h + pt + pbm, wd + pl + pr), x.dtype)
+    xp[:, :, pt:pt + h, pl:pl + wd] = x
+    ekh, ekw = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+    oh = (xp.shape[2] - ekh) // sh + 1
+    ow = (xp.shape[3] - ekw) // sw + 1
+    out = np.zeros((n, cout, oh, ow), np.float32)
+    cpg_out = cout // group
+    for g in range(group):
+        xs = xp[:, g * cin_g:(g + 1) * cin_g]
+        ws = w[g * cpg_out:(g + 1) * cpg_out]
+        for i in range(oh):
+            for j in range(ow):
+                patch = xs[:, :, i * sh:i * sh + ekh:dh,
+                           j * sw:j * sw + ekw:dw]
+                out[:, g * cpg_out:(g + 1) * cpg_out, i, j] = np.einsum(
+                    "nchw,ochw->no", patch, ws)
+    return out.astype(x.dtype)
+
+
+def run_graph(graph: dict, feeds: Dict[str, np.ndarray]):
+    """Execute a decoded GraphProto dict on numpy feeds."""
+    env: Dict[str, np.ndarray] = dict(graph["initializers"])
+    env.update({k: np.asarray(v) for k, v in feeds.items()})
+
+    for node in graph["nodes"]:
+        op = node["op_type"]
+        a = node["attrs"]
+        x = [env[i] for i in node["inputs"]]
+        if op == "MatMul":
+            y = _np_matmul(x[0], x[1])
+        elif op == "Add":
+            y = x[0] + x[1]
+        elif op == "Sub":
+            y = x[0] - x[1]
+        elif op == "Mul":
+            y = x[0] * x[1]
+        elif op == "Div":
+            y = x[0] / x[1]
+        elif op == "Pow":
+            y = np.power(x[0], x[1])
+        elif op == "Max":
+            y = np.maximum(x[0], x[1])
+        elif op == "Min":
+            y = np.minimum(x[0], x[1])
+        elif op == "Relu":
+            y = np.maximum(x[0], 0)
+        elif op == "Sigmoid":
+            y = 1.0 / (1.0 + np.exp(-x[0]))
+        elif op == "Tanh":
+            y = np.tanh(x[0])
+        elif op == "Exp":
+            y = np.exp(x[0])
+        elif op == "Log":
+            y = np.log(x[0])
+        elif op == "Sqrt":
+            y = np.sqrt(x[0])
+        elif op == "Reciprocal":
+            y = 1.0 / x[0]
+        elif op == "Neg":
+            y = -x[0]
+        elif op == "Abs":
+            y = np.abs(x[0])
+        elif op == "Sign":
+            y = np.sign(x[0])
+        elif op == "Floor":
+            y = np.floor(x[0])
+        elif op == "Ceil":
+            y = np.ceil(x[0])
+        elif op == "Erf":
+            from math import erf
+            y = np.vectorize(erf)(x[0]).astype(x[0].dtype)
+        elif op == "Sin":
+            y = np.sin(x[0])
+        elif op == "Cos":
+            y = np.cos(x[0])
+        elif op == "Identity":
+            y = x[0]
+        elif op == "Cast":
+            y = x[0].astype(pb.ONNX_TO_NP[a["to"]])
+        elif op == "Transpose":
+            y = np.transpose(x[0], a["perm"])
+        elif op == "Reshape":
+            y = x[0].reshape([int(d) for d in x[1]])
+        elif op == "Expand":
+            y = np.broadcast_to(x[0], [int(d) for d in x[1]]).copy()
+        elif op == "Concat":
+            y = np.concatenate(x, axis=a["axis"])
+        elif op == "Where":
+            y = np.where(x[0], x[1], x[2])
+        elif op == "Greater":
+            y = x[0] > x[1]
+        elif op == "Less":
+            y = x[0] < x[1]
+        elif op == "GreaterOrEqual":
+            y = x[0] >= x[1]
+        elif op == "LessOrEqual":
+            y = x[0] <= x[1]
+        elif op == "Equal":
+            y = x[0] == x[1]
+        elif op == "Not":
+            y = ~x[0]
+        elif op == "Gather":
+            y = np.take(x[0], x[1].astype(np.int64), axis=a.get("axis", 0))
+        elif op in ("ReduceSum", "ReduceMax", "ReduceMin", "ReduceProd"):
+            fn = {"ReduceSum": np.sum, "ReduceMax": np.max,
+                  "ReduceMin": np.min, "ReduceProd": np.prod}[op]
+            axes = a.get("axes")
+            if axes is None and len(x) > 1:
+                axes = [int(d) for d in x[1]]
+            y = fn(x[0], axis=tuple(axes),
+                   keepdims=bool(a.get("keepdims", 0)))
+        elif op == "MaxPool":
+            y = _pool2d(x[0], a["kernel_shape"], a["strides"],
+                        a["pads"], op=np.max, init=-np.inf)
+        elif op == "Conv":
+            y = _conv2d(x[0], x[1], a["strides"], a["pads"],
+                        a.get("dilations", [1, 1]), a.get("group", 1))
+            if len(node["inputs"]) > 2:
+                y = y + x[2].reshape(1, -1, 1, 1)
+        else:
+            raise NotImplementedError(f"runtime op {op}")
+        env[node["outputs"][0]] = np.asarray(y)
+
+    return [env[o["name"]] for o in graph["outputs"]]
+
+
+def run_model(model_bytes: bytes, feeds: Dict[str, np.ndarray]):
+    model = pb.decode_model(model_bytes)
+    return run_graph(model["graph"], feeds)

@@ -1,0 +1,196 @@
+"""Auto-parallel completion / cost model / planner (ref:
+python/paddle/distributed/auto_parallel/static/{completion.py,cost/,
+planner_v2.py} and engine.py Engine.cost)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import auto_parallel as ap
+
+
+def _mesh(shape, names):
+    devs = np.asarray(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+class TestCompletion:
+    def test_propagates_seed_annotations(self):
+        mesh = _mesh((2, 4), ("dp", "mp"))
+
+        def step(x, w):
+            return jnp.tanh(x @ w)
+
+        x = jnp.ones((8, 16), jnp.float32)
+        w = jnp.ones((16, 32), jnp.float32)
+        rep = ap.complete(step, (x, w), mesh,
+                          in_specs=[P("dp", None), P(None, "mp")])
+        # seeds preserved
+        assert rep.input_spec(0) == P("dp", None)
+        assert rep.input_spec(1) == P(None, "mp")
+        # propagation: output completed to (dp, mp) — not replicated
+        out = rep.outputs[0]
+        assert not out.replicated
+        assert out.shard_shape == (4, 8)
+        assert rep.annotated_ops > 0
+        assert rep.flops_per_device > 0
+
+    def test_unannotated_defaults_replicate(self):
+        mesh = _mesh((8,), ("dp",))
+
+        def f(x):
+            return x * 2.0
+
+        rep = ap.complete(f, (jnp.ones((4, 4)),), mesh)
+        assert rep.inputs[0].replicated
+        assert rep.outputs[0].replicated
+
+    def test_pytree_args(self):
+        mesh = _mesh((2, 4), ("dp", "mp"))
+
+        def f(params, x):
+            return x @ params["w"] + params["b"]
+
+        params = {"w": jnp.ones((16, 32)), "b": jnp.zeros((32,))}
+        # flattened leaf order: b, w (dict sorts keys)
+        rep = ap.complete(f, (params, jnp.ones((8, 16))), mesh,
+                          in_specs=[P("mp"), P(None, "mp"), P("dp", None)])
+        assert rep.outputs[0].shard_shape == (4, 8)
+
+
+class TestCostModel:
+    def test_estimate_flops_matmul(self):
+        def f(a, b):
+            return a @ b
+
+        a = jnp.ones((64, 128))
+        b = jnp.ones((128, 256))
+        fl = ap.estimate_flops(f, a, b)
+        assert fl == pytest.approx(2 * 64 * 128 * 256, rel=0.01)
+
+    def test_comm_bytes_formulas(self):
+        mb = 1 << 20
+        assert ap.comm_bytes("all_reduce", mb, 1) == 0
+        assert ap.comm_bytes("all_reduce", mb, 4) == pytest.approx(
+            2 * 3 / 4 * mb)
+        assert ap.comm_bytes("all_gather", mb, 4) == pytest.approx(
+            3 / 4 * mb)
+        assert ap.comm_bytes("reduce_scatter", mb, 8) == pytest.approx(
+            7 / 8 * mb)
+        # allreduce = reduce_scatter + all_gather
+        assert ap.comm_bytes("all_reduce", mb, 8) == pytest.approx(
+            ap.comm_bytes("reduce_scatter", mb, 8)
+            + ap.comm_bytes("all_gather", mb, 8))
+
+    def _stats(self):
+        return ap.ModelStats(param_count=10_000_000, layers=4, hidden=256,
+                             heads=8, seq_len=512, vocab=1000)
+
+    def test_memory_shrinks_with_sharding(self):
+        stats = self._stats()
+        base = ap.estimate_config_cost(
+            stats, dict(dp_degree=8, mp_degree=1, pp_degree=1,
+                        sharding_degree=1, micro_batch_size=1), 64)
+        sharded = ap.estimate_config_cost(
+            stats, dict(dp_degree=1, mp_degree=1, pp_degree=1,
+                        sharding_degree=8, sharding_stage=3,
+                        micro_batch_size=1), 64)
+        assert sharded.breakdown["mem_params"] < base.breakdown["mem_params"]
+        assert sharded.breakdown["mem_opt"] < base.breakdown["mem_opt"]
+
+    def test_mp_adds_comm(self):
+        stats = self._stats()
+        dp = ap.estimate_config_cost(
+            stats, dict(dp_degree=8, mp_degree=1, pp_degree=1,
+                        sharding_degree=1, micro_batch_size=1), 64)
+        mp = ap.estimate_config_cost(
+            stats, dict(dp_degree=1, mp_degree=8, pp_degree=1,
+                        sharding_degree=1, micro_batch_size=1), 64)
+        assert "mp_allreduce" in mp.breakdown
+        assert mp.breakdown["mp_allreduce"] > 0
+        assert "mp_allreduce" not in dp.breakdown
+
+
+class TestPlanner:
+    def test_plan_respects_constraints(self):
+        stats = ap.ModelStats(param_count=1_000_000, layers=4, hidden=64,
+                              heads=4, seq_len=128, vocab=100)
+        planner = ap.Planner(8, stats, global_batch=64)
+        choice = planner.plan()
+        assert choice is not None
+        c = choice.config
+        assert (c["dp_degree"] * c["mp_degree"] * c["pp_degree"]
+                * c["sharding_degree"]) == 8
+        assert stats.heads % c["mp_degree"] == 0
+        # small model, cheap dp: planner should not pick heavy mp/pp
+        assert choice.cost.step_time_s > 0
+
+    def test_memory_pressure_forces_model_split(self):
+        # model too big for one chip replica: pure-dp must be infeasible
+        big = ap.ModelStats(param_count=4_000_000_000, layers=32,
+                            hidden=4096, heads=32, seq_len=512)
+        hw = ap.HardwareSpec(hbm_bytes=16e9)
+        planner = ap.Planner(8, big, global_batch=8, hw=hw)
+        ranked = planner.ranking()
+        assert ranked, "planner found nothing feasible"
+        for p in ranked:
+            c = p.config
+            split = (c["mp_degree"] * c["pp_degree"]
+                     * c["sharding_degree"])
+            assert split > 1, f"pure dp should be memory-infeasible: {p}"
+
+    def test_ranking_sorted(self):
+        stats = ap.ModelStats(param_count=1_000_000, layers=4, hidden=64,
+                              heads=4, seq_len=128)
+        ranked = ap.Planner(8, stats, global_batch=64).ranking()
+        times = [p.cost.step_time_s for p in ranked]
+        assert times == sorted(times)
+
+
+class TestEngineIntegration:
+    def _engine(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 4))
+        loss = nn.CrossEntropyLoss()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        return Engine(model=model, loss=loss, optimizer=opt,
+                      strategy=Strategy({"auto_mode": "semi"}))
+
+    def test_engine_cost(self):
+        est = self._engine().cost(global_batch=8)
+        assert est.step_time_s > 0
+        assert est.memory_bytes > 0
+        assert est.fits()
+
+    def test_engine_complete_uses_plan_seeds(self):
+        import numpy as np
+
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+
+        model = nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                              nn.Linear(128, 8))
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        eng = Engine(model=model, loss=nn.CrossEntropyLoss(), optimizer=opt,
+                     strategy=Strategy({"sharding": {"degree": 8,
+                                                     "stage": 3},
+                                        "dp_degree": 1}))
+        eng.prepare()
+        rep = eng.complete(np.ones((8, 64), np.float32))
+        # ZeRO-3: at least one parameter leaf is actually sharded
+        assert any(not p.replicated for p in rep.inputs), rep.summary()
+
+    def test_engine_plan_full_auto(self):
+        eng = self._engine()
+        choice = eng.plan(n_devices=8, global_batch=64)
+        s = eng.strategy
+        assert (s.dp_degree * s.mp_degree * s.pp_degree
+                * s.sharding_degree) == 8
+        assert choice.cost.step_time_s > 0

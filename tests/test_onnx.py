@@ -1,0 +1,106 @@
+"""ONNX export (ref: python/paddle/onnx/export.py). No `onnx` package in
+the image, so validation decodes the emitted protobuf with our own reader
+and executes it on the bundled numpy evaluator, asserting numerical parity
+with the source model."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.onnx import export, load, proto
+from paddle_tpu.tensor import Tensor
+
+
+def _roundtrip(tmp_path, model, xs, atol=1e-5):
+    model.eval()
+    path = export(model, str(tmp_path / "m"),
+                  input_spec=[np.asarray(x) for x in xs])
+    run = load(path)
+    got = run(*[np.asarray(x) for x in xs])
+    want = model(*[Tensor(np.asarray(x)) for x in xs]).numpy()
+    np.testing.assert_allclose(got, want, atol=atol, rtol=1e-4)
+    return path
+
+
+class TestExportMLP:
+    def test_mlp_numerical_roundtrip(self, tmp_path):
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4),
+                          nn.Softmax())
+        x = np.random.default_rng(0).standard_normal((3, 8)).astype(
+            np.float32)
+        path = _roundtrip(tmp_path, m, [x])
+        model = proto.decode_model(open(path, "rb").read())
+        ops = [n["op_type"] for n in model["graph"]["nodes"]]
+        assert "MatMul" in ops and "Relu" in ops
+        # parameters became initializers (2 weights + 2 biases)
+        assert len(model["graph"]["initializers"]) >= 4
+        assert model["graph"]["inputs"][0]["name"] == "x0"
+        assert model["opsets"][0][1] == 17
+
+    def test_activations(self, tmp_path):
+        class M(nn.Layer):
+            def forward(self, x):
+                import paddle_tpu.nn.functional as F
+                return F.sigmoid(x) + paddle.tanh(x) * F.gelu(x)
+
+        x = np.linspace(-2, 2, 12).astype(np.float32).reshape(3, 4)
+        _roundtrip(tmp_path, M(), [x], atol=1e-4)
+
+    def test_layernorm_model(self, tmp_path):
+        m = nn.Sequential(nn.Linear(6, 6), nn.LayerNorm(6))
+        x = np.random.default_rng(1).standard_normal((2, 6)).astype(
+            np.float32)
+        _roundtrip(tmp_path, m, [x], atol=1e-4)
+
+
+class TestExportCNN:
+    def test_conv_bn_pool(self, tmp_path):
+        m = nn.Sequential(nn.Conv2D(3, 4, 3, padding=1),
+                          nn.BatchNorm2D(4), nn.ReLU(), nn.MaxPool2D(2))
+        x = np.random.default_rng(2).standard_normal((2, 3, 8, 8)).astype(
+            np.float32)
+        path = _roundtrip(tmp_path, m, [x], atol=1e-4)
+        ops = [n["op_type"] for n in
+               proto.decode_model(open(path, "rb").read())["graph"]["nodes"]]
+        assert "Conv" in ops and "MaxPool" in ops
+
+    def test_strided_grouped_conv(self, tmp_path):
+        m = nn.Conv2D(4, 8, 3, stride=2, padding=1, groups=2)
+        x = np.random.default_rng(3).standard_normal((1, 4, 9, 9)).astype(
+            np.float32)
+        _roundtrip(tmp_path, m, [x], atol=1e-4)
+
+
+class TestExportEmbedding:
+    def test_embedding_gather(self, tmp_path):
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(10, 4)
+                self.fc = nn.Linear(4, 2)
+
+            def forward(self, ids):
+                return self.fc(self.emb(ids))
+
+        m = M()
+        ids = np.array([[1, 2], [3, 9]], np.int32)
+        m.eval()
+        path = export(m, str(tmp_path / "emb"), input_spec=[ids])
+        got = load(path)(ids)
+        want = m(Tensor(ids)).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+class TestErrors:
+    def test_unsupported_primitive_names_it(self, tmp_path):
+        class M(nn.Layer):
+            def forward(self, x):
+                return paddle.cumsum(x, axis=0)
+
+        with pytest.raises(NotImplementedError, match="primitive"):
+            export(M(), str(tmp_path / "bad"),
+                   input_spec=[np.ones((3, 3), np.float32)])
+
+    def test_missing_input_spec(self, tmp_path):
+        with pytest.raises(ValueError, match="input_spec"):
+            export(nn.Linear(2, 2), str(tmp_path / "x"))
