@@ -31,25 +31,46 @@ def _reexec_cpu(reason: str):
 
 
 def _init_devices():
-    """jax.devices() with retry/backoff; falls back to CPU via re-exec.
+    """jax.devices() with retry/backoff AND a hang watchdog; falls back to
+    CPU via re-exec.
 
     The TPU tunnel backend ('axon') can be transiently UNAVAILABLE (round-1
-    BENCH rc=1 was exactly this). Retry a few times; if it never comes up,
-    re-exec this script with JAX_PLATFORMS=cpu so the driver still gets a
-    JSON line (a CPU smoke number with vs_baseline=0) instead of rc=1.
+    BENCH rc=1 was exactly this) — and worse, a wedged chip claim (e.g. a
+    previous process killed mid-use) makes jax.devices() HANG rather than
+    raise, which no try/except can catch. Init therefore runs on a watcher
+    thread with a deadline; on timeout or repeated failure the script
+    re-execs itself with JAX_PLATFORMS=cpu so the driver still gets a JSON
+    line (a CPU smoke number with vs_baseline=0) instead of rc=1/124.
     """
-    import jax
+    import threading
 
+    deadline = int(os.environ.get("BENCH_INIT_TIMEOUT", "240"))
     last_err = None
     for attempt in range(4):
-        try:
-            return jax.devices()
-        except Exception as e:  # backend init failure
-            last_err = e
-            wait = 5 * (attempt + 1)
-            print(f"bench: backend init failed (attempt {attempt + 1}/4): "
-                  f"{e}; retrying in {wait}s", file=sys.stderr)
-            time.sleep(wait)
+        result = {}
+
+        def init():
+            import jax
+            try:
+                result["devs"] = jax.devices()
+            except Exception as e:
+                result["err"] = e
+
+        th = threading.Thread(target=init, daemon=True)
+        th.start()
+        th.join(timeout=deadline)
+        if th.is_alive():
+            if os.environ.get("BENCH_NO_FALLBACK"):
+                raise TimeoutError(f"backend init hung > {deadline}s")
+            _reexec_cpu(f"TPU backend init hung > {deadline}s "
+                        "(wedged chip claim?)")
+        if "devs" in result:
+            return result["devs"]
+        last_err = result.get("err")
+        wait = 5 * (attempt + 1)
+        print(f"bench: backend init failed (attempt {attempt + 1}/4): "
+              f"{last_err}; retrying in {wait}s", file=sys.stderr)
+        time.sleep(wait)
     if os.environ.get("BENCH_NO_FALLBACK"):
         raise last_err
     _reexec_cpu(f"TPU backend unavailable after retries ({last_err})")
@@ -182,8 +203,29 @@ def main():
     }))
 
 
+def _arm_wall_watchdog():
+    """Whole-run deadline: if compile/execute wedges (remote-compile service
+    stuck, chip claim lost mid-run), raise in the main thread so the
+    diagnostic-JSON path below still emits a line and rc stays 0."""
+    import signal
+
+    budget = int(os.environ.get("BENCH_WALL_TIMEOUT", "3000"))
+
+    def on_alarm(signum, frame):
+        raise TimeoutError(
+            f"bench exceeded BENCH_WALL_TIMEOUT={budget}s "
+            "(wedged compile/executor?)")
+
+    try:
+        signal.signal(signal.SIGALRM, on_alarm)
+        signal.alarm(budget)
+    except (ValueError, OSError):
+        pass  # non-main thread / unsupported platform
+
+
 if __name__ == "__main__":
     try:
+        _arm_wall_watchdog()
         main()
     except Exception as e:
         traceback.print_exc()

@@ -141,13 +141,16 @@ def estimate_config_cost(stats: ModelStats, config: Dict, global_batch: int,
     p_bytes = stats.param_bytes
     grad_bytes = p_bytes  # grads in param dtype
 
-    # data-parallel gradient sync: allreduce over dp; under ZeRO (sh>1)
-    # grads are first scattered over the sharding axis, so the dp
-    # allreduce only moves the 1/sh shard this chip owns
+    # data-parallel gradient sync: allreduce over dp. Under ZeRO-2/3
+    # (stage>=2) grads are first reduce-scattered over the sharding axis,
+    # so the dp allreduce only moves the 1/sh shard this chip owns;
+    # ZeRO-1 shards only optimizer state — grads stay full
     dp_payload = grad_bytes / max(n_model_split, 1)
-    bd["dp_allreduce"] = comm_time("all_reduce", int(dp_payload / sh), dp,
-                                   hw, inter_host_dp)
-    if sh > 1:
+    grads_scattered = sh > 1 and stage >= 2
+    bd["dp_allreduce"] = comm_time(
+        "all_reduce", int(dp_payload / (sh if grads_scattered else 1)),
+        dp, hw, inter_host_dp)
+    if grads_scattered:
         bd["zero_reduce_scatter"] = comm_time(
             "reduce_scatter", int(dp_payload), sh, hw)
         if stage >= 3:

@@ -91,6 +91,10 @@ class Engine:
         s.mp_degree = c["mp_degree"]
         s.pp_degree = c["pp_degree"]
         s.sharding_degree = c["sharding_degree"]
+        # the cost model validated memory under ZeRO-3 semantics for
+        # sharded configs — execute with the same stage
+        if c["sharding_degree"] > 1:
+            s.sharding_stage = c.get("sharding_stage", 3)
         self._plan_choice = choice
         return choice
 

@@ -61,6 +61,10 @@ class DistributedStrategy:
         self.pipeline_configs = {"accumulate_steps": 1}
         self.gradient_merge = False
         self.gradient_merge_configs = {}
+        self.localsgd = False
+        self.localsgd_configs = {}
+        self.dgc = False
+        self.dgc_configs = {}
         self.find_unused_parameters = False
 
 
@@ -81,7 +85,9 @@ def is_worker():
 
 def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
     global _fleet_initialized, _strategy, _role_maker
-    if not is_collective:
+    # an explicit role maker implies PS mode (the reference's
+    # fleet.init(role_maker) semantics, where is_collective defaults False)
+    if role_maker is not None or not is_collective:
         # PS mode (ref fleet.init(role_maker) with a PS role maker):
         # no mesh/collective bootstrap — tables + pull/push live in
         # paddle_tpu.distributed.ps; the role maker names this process.
@@ -144,5 +150,26 @@ def distributed_optimizer(optimizer, strategy=None):
     """ref: fleet/fleet.py distributed_optimizer → HybridParallelOptimizer
     (dygraph_optimizer/hybrid_parallel_optimizer.py:254). TP-aware grad
     clipping is already global under single-controller (grads are logical
-    full tensors), so the wrapper is the optimizer itself."""
+    full tensors); the meta-optimizer strategy flags (ref
+    meta_optimizers/) select the matching wrapper."""
+    s = strategy or _strategy
+    if s is None:
+        return optimizer
+    from .meta_optimizers import (DGCMomentumOptimizer,
+                                  GradientMergeOptimizer, LocalSGDOptimizer)
+    if getattr(s, "dgc", False):
+        cfg = getattr(s, "dgc_configs", {}) or {}
+        optimizer = DGCMomentumOptimizer(
+            optimizer, momentum=cfg.get("momentum", 0.9),
+            rampup_begin_step=cfg.get("rampup_begin_step", 0),
+            sparsity=cfg.get("sparsity", 0.999))
+    if getattr(s, "gradient_merge", False):
+        cfg = getattr(s, "gradient_merge_configs", {}) or {}
+        optimizer = GradientMergeOptimizer(
+            optimizer, k_steps=cfg.get("k_steps", 1),
+            avg=cfg.get("avg", True))
+    if getattr(s, "localsgd", False):
+        cfg = getattr(s, "localsgd_configs", {}) or {}
+        optimizer = LocalSGDOptimizer(optimizer,
+                                      k_steps=cfg.get("k_steps", 1))
     return optimizer

@@ -1,0 +1,144 @@
+"""Meta-optimizers (ref: python/paddle/distributed/fleet/meta_optimizers/ —
+GradientMergeOptimizer, LocalSGDOptimizer, DGCOptimizer; selected by
+DistributedStrategy flags in fleet.distributed_optimizer).
+
+TPU-native: each is an optimizer wrapper over the eager tape/TrainStep
+path. Gradient merge accumulates host-side like the reference's
+@GRAD@MERGED vars; LocalSGD averages parameters across the data-parallel
+world every k steps (collective all_reduce — a no-op single-process,
+where GSPMD already globalizes the batch); DGC does top-k gradient
+sparsification with momentum correction + residual accumulation.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+__all__ = ["GradientMergeOptimizer", "LocalSGDOptimizer",
+           "DGCMomentumOptimizer"]
+
+
+class _Wrapper:
+    """Delegate the Optimizer surface to the inner optimizer."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._parameter_list = inner._parameter_list
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+
+class GradientMergeOptimizer(_Wrapper):
+    """ref: meta_optimizers/gradient_merge_optimizer.py — accumulate k
+    micro-batches of gradients, apply once (avg=True divides by k)."""
+
+    def __init__(self, inner, k_steps: int = 1, avg: bool = True):
+        super().__init__(inner)
+        self.k_steps = int(k_steps)
+        self.avg = avg
+        self._acc = {}
+        self._count = 0
+
+    def step(self):
+        self._count += 1
+        for p in self._parameter_list:
+            if p.grad is None:
+                continue
+            g = p.grad.data if hasattr(p.grad, "data") else p.grad
+            pid = id(p)
+            self._acc[pid] = (g if pid not in self._acc
+                              else self._acc[pid] + g)
+        if self._count < self.k_steps:
+            return  # merged step not yet due
+        scale = 1.0 / self.k_steps if self.avg else 1.0
+        from ...tensor import Tensor
+        for p in self._parameter_list:
+            pid = id(p)
+            if pid in self._acc:
+                p.grad = Tensor(self._acc[pid] * scale)
+        self._inner.step()
+        self._acc.clear()
+        self._count = 0
+
+    def clear_grad(self, set_to_zero=True):
+        # per-micro-batch clear; merged accumulators persist
+        self._inner.clear_grad(set_to_zero)
+
+
+class LocalSGDOptimizer(_Wrapper):
+    """ref: meta_optimizers/localsgd_optimizer.py — run k local steps,
+    then average parameters across the dp world. Under a multi-process
+    launch the averaging is a real cross-host collective; single-process
+    it's the identity (GSPMD covers in-mesh dp)."""
+
+    def __init__(self, inner, k_steps: int = 1):
+        super().__init__(inner)
+        self.k_steps = int(k_steps)
+        self._count = 0
+
+    def step(self):
+        self._inner.step()
+        self._count += 1
+        if self._count % self.k_steps:
+            return
+        from ...framework import core
+        from .. import env
+        world = env.get_world_size()
+        if world <= 1:
+            return
+        from ..collective import all_reduce
+        for p in self._parameter_list:
+            avg = all_reduce(p, op="avg")
+            p.set_value(avg if not hasattr(avg, "data") else avg)
+
+
+class DGCMomentumOptimizer(_Wrapper):
+    """ref: meta_optimizers/dgc_optimizer.py + fluid DGCMomentumOptimizer —
+    Deep Gradient Compression: momentum correction + residual accumulation
+    with top-k sparsification. The dense update uses the inner optimizer's
+    rule on the sparsified gradient."""
+
+    def __init__(self, inner, momentum: float = 0.9,
+                 rampup_begin_step: int = 0, sparsity: float = 0.999):
+        super().__init__(inner)
+        self.momentum = float(momentum)
+        self.rampup_begin_step = int(rampup_begin_step)
+        self.sparsity = float(sparsity)
+        self._u = {}       # velocity (momentum correction)
+        self._e = {}       # residual accumulator
+        self._steps = 0
+
+    def _sparsify(self, e):
+        flat = jnp.abs(e).ravel()
+        k = max(int(flat.size * (1.0 - self.sparsity)), 1)
+        thresh = jnp.sort(flat)[-k]
+        mask = jnp.abs(e) >= thresh
+        return e * mask, mask
+
+    def step(self):
+        self._steps += 1
+        if self._steps <= self.rampup_begin_step:
+            self._inner.step()
+            return
+        from ...tensor import Tensor
+        for p in self._parameter_list:
+            if p.grad is None:
+                continue
+            g = p.grad.data if hasattr(p.grad, "data") else p.grad
+            pid = id(p)
+            u = self._u.get(pid)
+            u = g if u is None else self.momentum * u + g
+            e = self._e.get(pid)
+            e = u if e is None else e + u
+            sparse, mask = self._sparsify(e)
+            self._u[pid] = u * (~mask)      # momentum factor masking
+            self._e[pid] = e * (~mask)      # residual keeps the unsent part
+            p.grad = Tensor(sparse)
+        self._inner.step()

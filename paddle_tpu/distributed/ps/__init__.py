@@ -209,7 +209,11 @@ class ParameterServer:
                 self._barrier_gen += 1
                 self._barrier_cv.notify_all()
                 return
-            while self._barrier_gen == gen and not self._stop.is_set():
+            while self._barrier_gen == gen:
+                if self._stop.is_set():
+                    raise RuntimeError(
+                        "parameter server shut down while waiting at "
+                        "barrier — synchronization not reached")
                 self._barrier_cv.wait(timeout=0.1)
 
     # -- socket service

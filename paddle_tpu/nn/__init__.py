@@ -3,6 +3,7 @@ from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from .layer.layers import Layer, ParamAttr  # noqa: F401
 from .layer.common import *       # noqa: F401,F403
+from .layer.extras import *       # noqa: F401,F403
 from .layer.conv import *         # noqa: F401,F403
 from .layer.norm import *         # noqa: F401,F403
 from .layer.activation import *   # noqa: F401,F403

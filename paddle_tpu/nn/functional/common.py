@@ -12,6 +12,7 @@ from ...tensor import Tensor
 from ...ops._helpers import to_tensor_like, unwrap
 
 __all__ = [
+    "unflatten", "pairwise_distance",
     "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
     "feature_alpha_dropout", "embedding", "one_hot", "label_smooth",
     "interpolate", "upsample", "bilinear", "cosine_similarity",
@@ -317,3 +318,15 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     remap[sampled] = np.arange(len(sampled))
     return (Tensor(jnp.asarray(remap[label_arr])),
             Tensor(jnp.asarray(sampled.astype(np.int64))))
+
+
+def unflatten(x, axis, shape, name=None):
+    """ref: nn/functional/common.py unflatten."""
+    from ...nn.layer.extras import Unflatten
+    return Unflatten(axis, shape)(x)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """ref: nn/functional/distance.py pairwise_distance."""
+    from ...nn.layer.extras import PairwiseDistance
+    return PairwiseDistance(p, epsilon, keepdim)(x, y)

@@ -43,6 +43,28 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
     def f(logits, label, *rest):
         ax = axis % logits.ndim
         n_class = logits.shape[ax]
+        is_soft = soft_label or (label.ndim == logits.ndim
+                                 and label.shape[ax] == n_class
+                                 and jnp.issubdtype(label.dtype,
+                                                    jnp.floating))
+        if (not is_soft and use_softmax and not rest
+                and label_smoothing == 0 and ax == logits.ndim - 1):
+            # big-vocab hard-label fast path: blockwise Pallas kernel, no
+            # [N, V] f32 log-softmax materialization (kernels/cross_entropy)
+            from ...kernels import cross_entropy as _fck
+            if _fck.supported(n_class):
+                lbl = label
+                if lbl.ndim == logits.ndim and lbl.shape[ax] == 1:
+                    lbl = jnp.squeeze(lbl, ax)
+                lbl = lbl.astype(jnp.int32)
+                loss = _fck.fused_cross_entropy(
+                    logits.reshape(-1, n_class), lbl.reshape(-1),
+                    ignore_index).reshape(lbl.shape)
+                if reduction == "mean":
+                    nvalid = jnp.sum((lbl != ignore_index).astype(
+                        jnp.float32))
+                    return jnp.sum(loss) / jnp.maximum(nvalid, 1.0)
+                return _reduce(loss, reduction)
         if use_softmax:
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=ax)
         else:

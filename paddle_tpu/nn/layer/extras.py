@@ -1,0 +1,242 @@
+"""Remaining reference-parity layers (ref: python/paddle/nn/layer/
+common.py Unflatten/PairwiseDistance, loss.py HSigmoidLoss/RNNTLoss,
+pooling.py FractionalMaxPool2D/3D)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...autograd.tape import apply_op
+from ...ops._helpers import to_tensor_like
+from .layers import Layer
+
+__all__ = ["Unflatten", "PairwiseDistance", "HSigmoidLoss", "RNNTLoss",
+           "FractionalMaxPool2D", "FractionalMaxPool3D"]
+
+
+class Unflatten(Layer):
+    """ref: nn/layer/common.py Unflatten — expand dim `axis` into `shape`."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = list(shape)
+
+    def forward(self, x):
+        def f(a):
+            ax = self.axis % a.ndim
+            new = list(a.shape[:ax]) + list(self.shape) \
+                + list(a.shape[ax + 1:])
+            # one -1 entry is inferred
+            if any(d == -1 for d in self.shape):
+                known = int(np.prod([d for d in self.shape if d != -1]))
+                infer = a.shape[ax] // known
+                new = [infer if d == -1 else d for d in new]
+            return a.reshape(new)
+
+        return apply_op(f, to_tensor_like(x), name="unflatten")
+
+
+class PairwiseDistance(Layer):
+    """ref: nn/layer/distance.py PairwiseDistance — p-norm of x - y."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.eps = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        def f(a, b):
+            d = a - b + self.eps
+            return jnp.linalg.norm(d.astype(jnp.float32), ord=self.p,
+                                   axis=-1, keepdims=self.keepdim)
+
+        return apply_op(f, to_tensor_like(x), to_tensor_like(y),
+                        name="pairwise_distance")
+
+
+class HSigmoidLoss(Layer):
+    """ref: nn/layer/loss.py HSigmoidLoss — hierarchical sigmoid over a
+    default complete binary tree (custom-tree mode via path_table is the
+    reference's sparse PS use case; the dense default covers the API)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        assert num_classes >= 2
+        self.num_classes = num_classes
+        self.depth = int(math.ceil(math.log2(num_classes)))
+        n_internal = num_classes - 1
+        self.weight = self.create_parameter(
+            (n_internal, feature_size), attr=weight_attr)
+        self.bias = self.create_parameter((n_internal,), attr=bias_attr,
+                                          is_bias=True)
+        # precompute per-class (node index, left/right code) paths of the
+        # complete tree: class c's path follows the bits of c + num_classes
+        codes = np.zeros((num_classes, self.depth), np.int32)
+        nodes = np.zeros((num_classes, self.depth), np.int32)
+        mask = np.zeros((num_classes, self.depth), np.float32)
+        for c in range(num_classes):
+            # heap-style: leaf id = c + n_internal (1-indexed heap)
+            node = c + num_classes
+            path = []
+            while node > 1:
+                path.append((node // 2, node % 2))
+                node //= 2
+            path.reverse()
+            for d, (n, bit) in enumerate(path[: self.depth]):
+                nodes[c, d] = n - 1          # internal nodes are 1..n_int
+                codes[c, d] = bit
+                mask[c, d] = 1.0
+        self._nodes = jnp.asarray(nodes)
+        self._codes = jnp.asarray(codes)
+        self._mask = jnp.asarray(mask)
+
+    def forward(self, input, label):
+        def f(x, lbl, w, b):
+            lbl = lbl.reshape(-1).astype(jnp.int32)
+            nodes = self._nodes[lbl]          # [B, depth]
+            codes = self._codes[lbl].astype(jnp.float32)
+            mask = self._mask[lbl]
+            wsel = w[nodes]                   # [B, depth, F]
+            bsel = b[nodes]                   # [B, depth]
+            logits = jnp.einsum("bf,bdf->bd", x.astype(jnp.float32),
+                                wsel.astype(jnp.float32)) + bsel
+            # P(bit) = sigmoid(logit) if bit==1 else sigmoid(-logit)
+            sign = 1.0 - 2.0 * codes
+            logp = jax.nn.log_sigmoid(sign * logits) * mask
+            return -jnp.sum(logp, axis=1, keepdims=True)
+
+        return apply_op(f, to_tensor_like(input), to_tensor_like(label),
+                        self.weight, self.bias, name="hsigmoid_loss")
+
+
+def _rnnt_alpha(log_probs, labels, T, U):
+    """log_probs: [T, U+1, V]; labels: [U] — forward variable recursion
+    (Graves 2012). blank assumed index 0."""
+    blank = log_probs[:, :, 0]                       # [T, U+1]
+    lab = jnp.take_along_axis(
+        log_probs[:, :-1, :], labels[None, :, None], axis=2)[:, :, 0]
+    # alpha over the (T, U+1) grid
+    neg = -1e30
+
+    def row(alpha_prev, t):
+        # alpha_prev: [U+1] = alpha[t-1, :]; emit-from-above term
+        from_top = alpha_prev + blank[t - 1]
+
+        def cell(carry, u):
+            left = jnp.where(u > 0, carry + lab[t, u - 1], neg)
+            a = jnp.logaddexp(from_top[u], left)
+            return a, a
+
+        _, alpha_t = jax.lax.scan(cell, neg, jnp.arange(U + 1))
+        return alpha_t, alpha_t
+
+    # t = 0 row: only label emissions along u
+    def cell0(carry, u):
+        a = jnp.where(u == 0, 0.0, carry + lab[0, u - 1])
+        return a, a
+
+    _, alpha0 = jax.lax.scan(cell0, 0.0, jnp.arange(U + 1))
+    alphaT, _ = jax.lax.scan(row, alpha0, jnp.arange(1, T))
+    return -(alphaT[U] + blank[T - 1, U])
+
+
+class RNNTLoss(Layer):
+    """ref: nn/layer/loss.py RNNTLoss (warprnnt there; a lax scan DP
+    here). input: [B, T, U+1, V] log-probs or logits; label: [B, U]."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        assert blank == 0, "this implementation fixes blank=0"
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths=None, label_lengths=None):
+        def f(x, lbl):
+            logp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+            B, T, U1, V = logp.shape
+            if U1 == 1:      # U=0: the only path emits T blanks
+                losses = -jnp.sum(logp[:, :, 0, 0], axis=1)
+            else:
+                losses = jax.vmap(
+                    lambda lp, lb: _rnnt_alpha(lp, lb.astype(jnp.int32),
+                                               T, U1 - 1))(logp, lbl)
+            if self.reduction == "mean":
+                return jnp.mean(losses)
+            if self.reduction == "sum":
+                return jnp.sum(losses)
+            return losses
+
+        return apply_op(f, to_tensor_like(input), to_tensor_like(label),
+                        name="rnnt_loss")
+
+
+def _fractional_indices(in_size, out_size, key):
+    """Pseudo-random increasing pooling boundaries (Graham 2014)."""
+    alpha = in_size / out_size
+    u = jax.random.uniform(key, (), minval=0.0, maxval=1.0)
+    idx = jnp.floor(alpha * (jnp.arange(out_size, dtype=jnp.float32) + u))
+    idx = jnp.clip(idx.astype(jnp.int32), 0, in_size - 1)
+    end = jnp.minimum(idx + jnp.int32(math.ceil(alpha)), in_size)
+    return idx, end
+
+
+class _FractionalMaxPool(Layer):
+    spatial = 2
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = (output_size if isinstance(output_size,
+                                                      (tuple, list))
+                            else (output_size,) * self.spatial)
+        self.random_u = random_u
+
+    def forward(self, x):
+        def f(a):
+            from ...framework import core
+            nd = self.spatial
+            outs = list(self.output_size)
+            spatial = a.shape[-nd:]
+            if self.random_u is not None:
+                us = [self.random_u] * nd
+            else:
+                key = core.next_rng_key()
+                us = jax.random.uniform(key, (nd,)).tolist() \
+                    if not isinstance(key, type(None)) else [0.5] * nd
+            # boundaries per spatial dim (host-computed sizes, traced data)
+            out = a
+            for d in range(nd):
+                axis = a.ndim - nd + d
+                in_sz, out_sz = spatial[d], outs[d]
+                alpha = in_sz / out_sz
+                u = float(us[d]) % 1.0
+                starts = np.minimum(
+                    np.floor(alpha * (np.arange(out_sz) + u)).astype(int),
+                    in_sz - 1)
+                width = int(math.ceil(alpha))
+                ends = np.minimum(starts + width, in_sz)
+                segs = [jnp.max(
+                    jax.lax.slice_in_dim(out, int(s), int(e), axis=axis),
+                    axis=axis, keepdims=True)
+                    for s, e in zip(starts, ends)]
+                out = jnp.concatenate(segs, axis=axis)
+            return out
+
+        return apply_op(f, to_tensor_like(x), name="fractional_max_pool")
+
+
+class FractionalMaxPool2D(_FractionalMaxPool):
+    """ref: nn/layer/pooling.py FractionalMaxPool2D."""
+    spatial = 2
+
+
+class FractionalMaxPool3D(_FractionalMaxPool):
+    """ref: nn/layer/pooling.py FractionalMaxPool3D."""
+    spatial = 3

@@ -58,10 +58,18 @@ def export(layer, path: str, input_spec: Optional[Sequence] = None,
     if hasattr(layer, "eval"):
         layer.eval()
     try:
-        examples = [_example_from_spec(s) for s in input_spec]
+        def _f32(a):
+            a = np.asarray(a)
+            # bf16 has no numpy-native ONNX consumer path here; export the
+            # standard f32 deployment form (weights upcast losslessly)
+            if a.dtype not in proto.NP_TO_ONNX:
+                a = a.astype(np.float32)
+            return a
+
+        examples = [_f32(_example_from_spec(s)) for s in input_spec]
         sd = layer.state_dict()
         keys = list(sd.keys())
-        vals = [np.asarray(t.data) for t in sd.values()]
+        vals = [_f32(t.data) for t in sd.values()]
 
         def fwd(params, *xs):
             state = dict(zip(keys, params))

@@ -11,3 +11,12 @@ from .densenet import (  # noqa: F401
     DenseNet, densenet121, densenet161, densenet169, densenet201,
     densenet264,
 )
+from .classic import (  # noqa: F401
+    AlexNet, GoogLeNet, LeNet, SqueezeNet, alexnet, googlenet,
+    squeezenet1_0, squeezenet1_1,
+)
+from .shufflenetv2 import (  # noqa: F401
+    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+)
+from .inceptionv3 import InceptionV3, inception_v3  # noqa: F401

@@ -1,0 +1,134 @@
+"""InceptionV3 (ref: python/paddle/vision/models/inceptionv3.py — same
+architecture family: A/B/C/D/E inception blocks, TPU-native layers)."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+
+from ...nn import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D,
+                   Dropout, Layer, Linear, MaxPool2D, ReLU, Sequential)
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+def _cbn(cin, cout, k, stride=1, padding=0):
+    return Sequential(
+        Conv2D(cin, cout, k, stride=stride, padding=padding,
+               bias_attr=False),
+        BatchNorm2D(cout), ReLU())
+
+
+class _IncA(Layer):
+    def __init__(self, cin, pool_feat):
+        super().__init__()
+        self.b1 = _cbn(cin, 64, 1)
+        self.b5 = Sequential(_cbn(cin, 48, 1), _cbn(48, 64, 5, padding=2))
+        self.b3 = Sequential(_cbn(cin, 64, 1), _cbn(64, 96, 3, padding=1),
+                             _cbn(96, 96, 3, padding=1))
+        self.bp = Sequential(AvgPool2D(3, 1, padding=1),
+                             _cbn(cin, pool_feat, 1))
+
+    def forward(self, x):
+        return paddle.concat([self.b1(x), self.b5(x), self.b3(x),
+                              self.bp(x)], axis=1)
+
+
+class _IncB(Layer):  # grid reduction
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = _cbn(cin, 384, 3, stride=2)
+        self.b3d = Sequential(_cbn(cin, 64, 1), _cbn(64, 96, 3, padding=1),
+                              _cbn(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return paddle.concat([self.b3(x), self.b3d(x), self.pool(x)],
+                             axis=1)
+
+
+class _IncC(Layer):  # 7x7 factorized
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.b1 = _cbn(cin, 192, 1)
+        self.b7 = Sequential(_cbn(cin, c7, 1),
+                             _cbn(c7, c7, (1, 7), padding=(0, 3)),
+                             _cbn(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = Sequential(_cbn(cin, c7, 1),
+                              _cbn(c7, c7, (7, 1), padding=(3, 0)),
+                              _cbn(c7, c7, (1, 7), padding=(0, 3)),
+                              _cbn(c7, c7, (7, 1), padding=(3, 0)),
+                              _cbn(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = Sequential(AvgPool2D(3, 1, padding=1), _cbn(cin, 192, 1))
+
+    def forward(self, x):
+        return paddle.concat([self.b1(x), self.b7(x), self.b7d(x),
+                              self.bp(x)], axis=1)
+
+
+class _IncD(Layer):  # grid reduction
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = Sequential(_cbn(cin, 192, 1), _cbn(192, 320, 3, stride=2))
+        self.b7 = Sequential(_cbn(cin, 192, 1),
+                             _cbn(192, 192, (1, 7), padding=(0, 3)),
+                             _cbn(192, 192, (7, 1), padding=(3, 0)),
+                             _cbn(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return paddle.concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _IncE(Layer):  # expanded filter bank
+    def __init__(self, cin):
+        super().__init__()
+        self.b1 = _cbn(cin, 320, 1)
+        self.b3_stem = _cbn(cin, 384, 1)
+        self.b3_a = _cbn(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _cbn(384, 384, (3, 1), padding=(1, 0))
+        self.bd_stem = Sequential(_cbn(cin, 448, 1),
+                                  _cbn(448, 384, 3, padding=1))
+        self.bd_a = _cbn(384, 384, (1, 3), padding=(0, 1))
+        self.bd_b = _cbn(384, 384, (3, 1), padding=(1, 0))
+        self.bp = Sequential(AvgPool2D(3, 1, padding=1), _cbn(cin, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.bd_stem(x)
+        return paddle.concat(
+            [self.b1(x), self.b3_a(s), self.b3_b(s),
+             self.bd_a(d), self.bd_b(d), self.bp(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.stem = Sequential(
+            _cbn(3, 32, 3, stride=2), _cbn(32, 32, 3),
+            _cbn(32, 64, 3, padding=1), MaxPool2D(3, 2),
+            _cbn(64, 80, 1), _cbn(80, 192, 3), MaxPool2D(3, 2))
+        self.blocks = Sequential(
+            _IncA(192, 32), _IncA(256, 64), _IncA(288, 64),
+            _IncB(288),
+            _IncC(768, 128), _IncC(768, 160), _IncC(768, 160),
+            _IncC(768, 192),
+            _IncD(768),
+            _IncE(1280), _IncE(2048))
+        self.avgpool = AdaptiveAvgPool2D(1) if with_pool else None
+        if num_classes > 0:
+            self.dropout = Dropout(0.5)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.avgpool is not None:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = paddle.flatten(x, 1)
+            x = self.fc(self.dropout(x))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    assert not pretrained, "no pretrained weights in this environment"
+    return InceptionV3(**kwargs)

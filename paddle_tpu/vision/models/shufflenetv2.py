@@ -1,0 +1,116 @@
+"""ShuffleNetV2 (ref: python/paddle/vision/models/shufflenetv2.py — same
+architecture, TPU-native layers; channel shuffle is a reshape/transpose,
+which XLA folds into the surrounding layout ops)."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+
+from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Layer, Linear,
+                   MaxPool2D, ReLU, Sequential)
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
+           "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0"]
+
+
+def _channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = paddle.reshape(x, [n, groups, c // groups, h, w])
+    x = paddle.transpose(x, [0, 2, 1, 3, 4])
+    return paddle.reshape(x, [n, c, h, w])
+
+
+def _conv_bn(cin, cout, k, stride=1, padding=0, groups=1, act=True):
+    layers = [Conv2D(cin, cout, k, stride=stride, padding=padding,
+                     groups=groups, bias_attr=False), BatchNorm2D(cout)]
+    if act:
+        layers.append(ReLU())
+    return Sequential(*layers)
+
+
+class _InvertedResidual(Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.stride = stride
+        branch = cout // 2
+        if stride == 1:
+            self.branch2 = Sequential(
+                _conv_bn(cin // 2, branch, 1),
+                _conv_bn(branch, branch, 3, stride, 1, groups=branch,
+                         act=False),
+                _conv_bn(branch, branch, 1))
+            self.branch1 = None
+        else:
+            self.branch1 = Sequential(
+                _conv_bn(cin, cin, 3, stride, 1, groups=cin, act=False),
+                _conv_bn(cin, branch, 1))
+            self.branch2 = Sequential(
+                _conv_bn(cin, branch, 1),
+                _conv_bn(branch, branch, 3, stride, 1, groups=branch,
+                         act=False),
+                _conv_bn(branch, branch, 1))
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1 = x[:, :c]
+            x2 = x[:, c:]
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    _stage_repeats = (4, 8, 4)
+    _widths = {
+        0.25: (24, 24, 48, 96, 512),
+        0.5: (24, 48, 96, 192, 1024),
+        1.0: (24, 116, 232, 464, 1024),
+        1.5: (24, 176, 352, 704, 1024),
+        2.0: (24, 244, 488, 976, 2048),
+    }
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        w = self._widths[float(scale)]
+        self.num_classes = num_classes
+        self.conv1 = _conv_bn(3, w[0], 3, 2, 1)
+        self.maxpool = MaxPool2D(3, 2, padding=1)
+        stages = []
+        cin = w[0]
+        for reps, cout in zip(self._stage_repeats, w[1:4]):
+            blocks = [_InvertedResidual(cin, cout, 2)]
+            blocks += [_InvertedResidual(cout, cout, 1)
+                       for _ in range(reps - 1)]
+            stages.append(Sequential(*blocks))
+            cin = cout
+        self.stages = Sequential(*stages)
+        self.conv5 = _conv_bn(cin, w[4], 1)
+        self.avgpool = AdaptiveAvgPool2D(1) if with_pool else None
+        if num_classes > 0:
+            self.fc = Linear(w[4], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.conv5(self.stages(x))
+        if self.avgpool is not None:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = paddle.flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def _make(scale):
+    def f(pretrained=False, **kwargs):
+        assert not pretrained, "no pretrained weights in this environment"
+        return ShuffleNetV2(scale=scale, **kwargs)
+    return f
+
+
+shufflenet_v2_x0_25 = _make(0.25)
+shufflenet_v2_x0_5 = _make(0.5)
+shufflenet_v2_x1_0 = _make(1.0)
+shufflenet_v2_x1_5 = _make(1.5)
+shufflenet_v2_x2_0 = _make(2.0)

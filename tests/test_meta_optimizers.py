@@ -1,0 +1,127 @@
+"""Meta-optimizers (ref: fleet/meta_optimizers/ GradientMerge/LocalSGD/DGC,
+selected by DistributedStrategy in fleet.distributed_optimizer)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.meta_optimizers import (
+    DGCMomentumOptimizer, GradientMergeOptimizer, LocalSGDOptimizer)
+
+
+def _toy():
+    paddle.seed(7)
+    m = nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=m.parameters())
+    return m, opt
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((8, 4)).astype(np.float32)
+    return paddle.to_tensor(X), paddle.to_tensor(
+        (X @ rng.standard_normal((4, 1))).astype(np.float32))
+
+
+class TestGradientMerge:
+    def test_accumulates_k_microbatches(self):
+        X, Y = _data()
+        # merged k=2 with half batches == one full-batch step
+        m1, o1 = _toy()
+        w0 = m1.weight.numpy().copy()
+        gm = GradientMergeOptimizer(o1, k_steps=2, avg=True)
+        for sl in (slice(0, 4), slice(4, 8)):
+            loss = nn.functional.mse_loss(m1(X[sl]), Y[sl])
+            loss.backward()
+            gm.step()
+            gm.clear_grad()
+        w_merged = m1.weight.numpy()
+        assert not np.allclose(w_merged, w0), "merged step must apply"
+
+        m2, o2 = _toy()
+        loss = nn.functional.mse_loss(m2(X), Y)
+        loss.backward()
+        o2.step()
+        np.testing.assert_allclose(w_merged, m2.weight.numpy(), atol=1e-5)
+
+    def test_no_update_before_k(self):
+        m, o = _toy()
+        X, Y = _data()
+        gm = GradientMergeOptimizer(o, k_steps=3)
+        w0 = m.weight.numpy().copy()
+        for _ in range(2):
+            loss = nn.functional.mse_loss(m(X), Y)
+            loss.backward()
+            gm.step()
+            gm.clear_grad()
+        np.testing.assert_array_equal(w0, m.weight.numpy())
+
+
+class TestLocalSGD:
+    def test_single_process_is_inner_step(self):
+        m, o = _toy()
+        X, Y = _data()
+        ls = LocalSGDOptimizer(o, k_steps=2)
+        losses = []
+        for _ in range(6):
+            loss = nn.functional.mse_loss(m(X), Y)
+            loss.backward()
+            ls.step()
+            ls.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+
+class TestDGC:
+    def test_sparsifies_and_keeps_residual(self):
+        m, o = _toy()
+        X, Y = _data()
+        dgc = DGCMomentumOptimizer(o, sparsity=0.75, momentum=0.0)
+        loss = nn.functional.mse_loss(m(X), Y)
+        loss.backward()
+        dgc.step()
+        # weight grad (4 entries, 75% sparsity -> 1 kept): residual holds
+        # the 3 unsent entries
+        wres = np.asarray(dgc._e[id(m.weight)]).ravel()
+        assert (wres != 0).sum() == 3
+        # training still converges
+        for _ in range(300):
+            dgc.clear_grad()
+            loss = nn.functional.mse_loss(m(X), Y)
+            loss.backward()
+            dgc.step()
+        assert float(loss.numpy()) < 0.05
+
+    def test_rampup_dense_steps(self):
+        m, o = _toy()
+        X, Y = _data()
+        dgc = DGCMomentumOptimizer(o, rampup_begin_step=5, sparsity=0.75)
+        loss = nn.functional.mse_loss(m(X), Y)
+        loss.backward()
+        dgc.step()
+        assert not dgc._e  # still dense phase
+
+
+class TestStrategySelection:
+    def test_distributed_optimizer_wraps_by_strategy(self):
+        m, o = _toy()
+        s = fleet.DistributedStrategy()
+        s.gradient_merge = True
+        s.gradient_merge_configs = {"k_steps": 4}
+        wrapped = fleet.distributed_optimizer(o, strategy=s)
+        assert isinstance(wrapped, GradientMergeOptimizer)
+        assert wrapped.k_steps == 4
+
+        s2 = fleet.DistributedStrategy()
+        s2.dgc = True
+        s2.localsgd = True
+        w2 = fleet.distributed_optimizer(_toy()[1], strategy=s2)
+        assert isinstance(w2, LocalSGDOptimizer)
+        assert isinstance(w2._inner, DGCMomentumOptimizer)
+
+    def test_passthrough_without_flags(self):
+        _, o = _toy()
+        assert fleet.distributed_optimizer(
+            o, strategy=fleet.DistributedStrategy()) is o

@@ -1,0 +1,128 @@
+"""Reference-parity tail layers (ref: python/paddle/nn/layer/common.py
+Unflatten, distance.py PairwiseDistance, loss.py HSigmoidLoss/RNNTLoss,
+pooling.py FractionalMaxPool2D/3D)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestUnflatten:
+    def test_basic(self):
+        x = paddle.ones([2, 12, 5])
+        out = nn.Unflatten(1, [3, 4])(x)
+        assert tuple(out.shape) == (2, 3, 4, 5)
+
+    def test_infer_dim(self):
+        x = paddle.ones([2, 12])
+        out = F.unflatten(x, 1, [3, -1])
+        assert tuple(out.shape) == (2, 3, 4)
+
+
+class TestPairwiseDistance:
+    def test_l2_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((4, 8)).astype(np.float32)
+        b = rng.standard_normal((4, 8)).astype(np.float32)
+        got = nn.PairwiseDistance()(paddle.to_tensor(a),
+                                    paddle.to_tensor(b)).numpy()
+        want = np.linalg.norm(a - b + 1e-6, axis=-1)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_l1_keepdim(self):
+        a = paddle.ones([3, 4])
+        b = paddle.zeros([3, 4])
+        got = nn.PairwiseDistance(p=1.0, keepdim=True)(a, b)
+        assert tuple(got.shape) == (3, 1)
+        np.testing.assert_allclose(got.numpy(), 4.0 + 4e-6, rtol=1e-4)
+
+
+class TestHSigmoid:
+    def test_loss_shape_and_grads(self):
+        paddle.seed(0)
+        layer = nn.HSigmoidLoss(feature_size=8, num_classes=6)
+        x = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+            (5, 8)).astype(np.float32))
+        y = paddle.to_tensor(np.array([0, 1, 2, 3, 5]))
+        loss = layer(x, y)
+        assert tuple(loss.shape) == (5, 1)
+        assert np.all(np.asarray(loss.numpy()) > 0)
+        loss.sum().backward()
+        assert layer.weight.grad is not None
+
+    def test_training_separates_classes(self):
+        paddle.seed(0)
+        layer = nn.HSigmoidLoss(feature_size=4, num_classes=4)
+        lin = nn.Linear(2, 4)
+        opt = paddle.optimizer.Adam(
+            learning_rate=0.1,
+            parameters=list(layer.parameters()) + list(lin.parameters()))
+        X = paddle.to_tensor(np.eye(2, dtype=np.float32).repeat(4, 0))
+        y = paddle.to_tensor(np.array([0, 0, 0, 0, 3, 3, 3, 3]))
+        first = None
+        for _ in range(60):
+            loss = layer(lin(X), y).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss.numpy())
+        assert float(loss.numpy()) < first * 0.5
+
+
+class TestRNNT:
+    def test_degenerate_single_path(self):
+        # T=1, U=0: loss = -log P(blank | t0, u0)
+        logits = np.zeros((1, 1, 1, 3), np.float32)
+        logits[0, 0, 0] = [2.0, -1.0, -1.0]
+        lbl = np.zeros((1, 0), np.int64)
+        loss = nn.RNNTLoss(reduction="none")(
+            paddle.to_tensor(logits), paddle.to_tensor(lbl))
+        p = np.exp(2.0) / (np.exp(2.0) + 2 * np.exp(-1.0))
+        np.testing.assert_allclose(float(loss.numpy()[0]), -math.log(p),
+                                   rtol=1e-5)
+
+    def test_uniform_probability_sums_paths(self):
+        # uniform logits: every alignment emits T+U symbols, each prob 1/V;
+        # alignments are interleavings of T-1 blanks + U labels followed by
+        # the mandatory final blank -> C(T-1+U, U) of them
+        T, U, V = 3, 2, 4
+        logits = np.zeros((1, T, U + 1, V), np.float32)
+        lbl = np.ones((1, U), np.int64)
+        loss = float(nn.RNNTLoss(reduction="none")(
+            paddle.to_tensor(logits), paddle.to_tensor(lbl)).numpy()[0])
+        n_paths = math.comb(T - 1 + U, U)
+        want = -(math.log(n_paths) - (T + U) * math.log(V))
+        np.testing.assert_allclose(loss, want, rtol=1e-5)
+
+    def test_gradients_flow(self):
+        import jax.numpy as jnp
+        logits = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (2, 4, 3, 5)).astype(np.float32))
+        logits.stop_gradient = False
+        loss = nn.RNNTLoss()(logits, paddle.to_tensor(
+            np.array([[1, 2], [3, 4]], np.int64)))
+        loss.backward()
+        g = np.asarray(logits.grad.numpy())
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+class TestFractionalMaxPool:
+    def test_output_size_and_upper_bound(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 3, 9, 9)).astype(np.float32)
+        out = nn.FractionalMaxPool2D(output_size=4, random_u=0.3)(
+            paddle.to_tensor(x)).numpy()
+        assert out.shape == (2, 3, 4, 4)
+        assert out.max() <= x.max() + 1e-6
+        # pooled values must come from the input
+        assert np.isin(np.round(out, 5), np.round(x, 5)).all()
+
+    def test_3d(self):
+        x = paddle.ones([1, 2, 8, 8, 8])
+        out = nn.FractionalMaxPool3D(output_size=2, random_u=0.5)(x)
+        assert tuple(out.shape) == (1, 2, 2, 2, 2)
