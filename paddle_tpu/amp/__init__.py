@@ -295,3 +295,6 @@ class GradScaler:
             state.get("scale", self.get_init_loss_scaling()), jnp.float32)
         self._state["good"] = jnp.asarray(state.get("good", 0), jnp.int32)
         self._state["bad"] = jnp.asarray(state.get("bad", 0), jnp.int32)
+
+
+from . import debugging  # noqa: E402,F401  (ref: paddle.amp.debugging)

@@ -1,0 +1,210 @@
+"""paddle.amp.debugging (ref: python/paddle/amp/debugging.py —
+operator-stats collection, tensor checker, accuracy comparison;
+python/paddle/amp/accuracy_compare.py).
+
+TPU-native: the eager tape (autograd/tape.py) exposes an op-observer
+hook; collection counts every op by compute dtype exactly where the
+reference's per-ad_func AMP lists decide casts. The tensor checker
+drives the same FLAGS_check_nan_inf sweep the compiled path uses.
+check_numerics can append per-op stats to a JSONL dump, and
+compare_accuracy diffs two such dumps (fp32 run vs low-precision run).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+from collections import defaultdict
+from enum import Enum
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "check_numerics",
+           "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "compare_accuracy"]
+
+
+class DebugMode(Enum):
+    """ref: debugging.py DebugMode."""
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+# ---------------- operator stats ----------------------------------------
+
+_stats: Optional[dict] = None
+
+
+def _observer(name, outs):
+    if _stats is None:
+        return
+    for t in outs:
+        dt = getattr(getattr(t, "data", t), "dtype", None)
+        if dt is None:
+            continue
+        dt = jnp.dtype(dt)
+        if dt == jnp.float16:
+            bucket = "float16"
+        elif dt == jnp.bfloat16:
+            bucket = "bfloat16"
+        elif dt == jnp.float32:
+            bucket = "float32"
+        else:
+            bucket = "other"
+        _stats[name][bucket] += 1
+
+
+def _install():
+    from ..autograd import tape
+    tape._OP_OBSERVER = _observer
+
+
+def _uninstall():
+    from ..autograd import tape
+    tape._OP_OBSERVER = None
+
+
+def enable_operator_stats_collection():
+    """ref: debugging.py enable_operator_stats_collection — start counting
+    ops per compute dtype."""
+    global _stats
+    _stats = defaultdict(lambda: defaultdict(int))
+    _install()
+
+
+def disable_operator_stats_collection():
+    """Stop collecting and print the table (ref prints the same four
+    dtype columns)."""
+    global _stats
+    _uninstall()
+    stats, _stats = _stats, None
+    if not stats:
+        print("<---- op list ---->\n(no ops recorded)")
+        return {}
+    cols = ["float16", "bfloat16", "float32", "other"]
+    print("<---- op list ---->")
+    print(f"{'op':<28}" + "".join(f"{c:>10}" for c in cols))
+    out = {}
+    for op in sorted(stats):
+        row = [stats[op].get(c, 0) for c in cols]
+        out[op] = dict(zip(cols, row))
+        print(f"{op:<28}" + "".join(f"{v:>10}" for v in row))
+    return out
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """ref: debugging.py collect_operator_stats context manager."""
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+# ---------------- tensor checker ----------------------------------------
+
+class TensorCheckerConfig:
+    """ref: debugging.py TensorCheckerConfig(enable, debug_mode, ...)."""
+
+    def __init__(self, enable=True,
+                 debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    """ref: debugging.py enable_tensor_checker — turns on the per-op
+    NaN/Inf sweep (the tape consumes FLAGS_check_nan_inf).
+    CHECK_NAN_INF_AND_ABORT raises at the first bad op; the other modes
+    warn and continue (the reference's non-abort semantics)."""
+    from ..framework import core
+    if checker_config.enable:
+        abort = checker_config.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT
+        core.set_flags({"FLAGS_check_nan_inf": 1,
+                        "FLAGS_check_nan_inf_warn_only": 0 if abort else 1})
+
+
+def disable_tensor_checker():
+    from ..framework import core
+    core.set_flags({"FLAGS_check_nan_inf": 0,
+                    "FLAGS_check_nan_inf_warn_only": 0})
+
+
+# ---------------- check_numerics + accuracy compare ---------------------
+
+def check_numerics(tensor, op_type="", var_name="", dump_path=None,
+                   raise_on_nan_inf=False):
+    """ref: debugging.py check_numerics — per-tensor stats + optional
+    JSONL dump for compare_accuracy. Returns (num_nan, num_inf, num_zero)
+    as python ints."""
+    a = np.asarray(getattr(tensor, "data", tensor), np.float32)
+    num_nan = int(np.isnan(a).sum())
+    num_inf = int(np.isinf(a).sum())
+    num_zero = int((a == 0).sum())
+    finite = a[np.isfinite(a)]
+    rec = {
+        "op": op_type, "var": var_name,
+        "dtype": str(getattr(getattr(tensor, "data", tensor), "dtype",
+                             "float32")),
+        "num_nan": num_nan, "num_inf": num_inf, "num_zero": num_zero,
+        "min": float(finite.min()) if finite.size else 0.0,
+        "max": float(finite.max()) if finite.size else 0.0,
+        "mean": float(finite.mean()) if finite.size else 0.0,
+    }
+    if dump_path:
+        with open(dump_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    if raise_on_nan_inf and (num_nan or num_inf):
+        raise FloatingPointError(
+            f"[check_numerics] op={op_type} var={var_name}: "
+            f"{num_nan} NaN, {num_inf} Inf")
+    return num_nan, num_inf, num_zero
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1.0, dump_all_ops=False):
+    """ref: amp/accuracy_compare.py compare_accuracy — diff two
+    check_numerics JSONL dumps (typically an fp32 run vs an amp run) and
+    write an (op, var) report of max/mean deltas + nan/inf flags."""
+    def load(p):
+        out = {}
+        with open(p) as f:
+            for line in f:
+                r = json.loads(line)
+                out[(r["op"], r["var"])] = r
+        return out
+
+    a, b = load(dump_path), load(another_dump_path)
+    rows = []
+    for key in sorted(set(a) | set(b)):
+        ra, rb = a.get(key), b.get(key)
+        if ra is None or rb is None:
+            rows.append({"op": key[0], "var": key[1],
+                         "status": "missing_in_" + ("b" if rb is None
+                                                   else "a")})
+            continue
+        max_diff = abs(ra["max"] - rb["max"])
+        mean_diff = abs(ra["mean"] - rb["mean"])
+        flagged = (ra["num_nan"] + rb["num_nan"]
+                   + ra["num_inf"] + rb["num_inf"]) > 0
+        if dump_all_ops or flagged or max_diff > 0 or mean_diff > 0:
+            rows.append({"op": key[0], "var": key[1],
+                         "fp32": {"min": ra["min"], "max": ra["max"],
+                                  "mean": ra["mean"]},
+                         "other": {"min": rb["min"], "max": rb["max"],
+                                   "mean": rb["mean"]},
+                         "max_diff": max_diff, "mean_diff": mean_diff,
+                         "has_nan_inf": flagged})
+    with open(output_filename, "w") as f:
+        json.dump(rows, f, indent=1)
+    return rows

@@ -27,6 +27,8 @@ from ..framework import core
 
 # set to static.record_op by paddle.enable_static(); None in dynamic mode
 _STATIC_RECORDER: Optional[Callable] = None
+# amp.debugging operator-stats hook: called as (op_name, out_tensors)
+_OP_OBSERVER: Optional[Callable] = None
 
 
 class GradNode:
@@ -92,10 +94,16 @@ def _check_nan_inf(name: str, outs):
                               or jnp.issubdtype(dt, jnp.complexfloating)):
             continue
         if not bool(jnp.all(jnp.isfinite(o))):
-            raise FloatingPointError(
+            msg = (
                 f"NaN or Inf found in output of op '{name or 'unnamed'}' "
                 f"(shape {getattr(o, 'shape', ())}, dtype {dt}) — "
                 "FLAGS_check_nan_inf is enabled")
+            # warn-and-continue mode (amp.debugging DebugMode.CHECK_NAN_INF)
+            if core.get_flag("FLAGS_check_nan_inf_warn_only", False):
+                import warnings
+                warnings.warn(msg, RuntimeWarning)
+                continue
+            raise FloatingPointError(msg)
 
 
 def apply_op(fn: Callable, *args, n_outputs: int = 1, name: str = "",
@@ -131,6 +139,8 @@ def apply_op(fn: Callable, *args, n_outputs: int = 1, name: str = "",
         False, None, 0, "0", "false", "False", "")
 
     def _maybe_record(outs):
+        if _OP_OBSERVER is not None:  # amp.debugging op-stats collector
+            _OP_OBSERVER(name, outs)
         if _STATIC_RECORDER is not None:  # set by paddle.enable_static()
             _STATIC_RECORDER(functools.partial(fn, **static_kwargs)
                              if static_kwargs else fn,

@@ -221,6 +221,9 @@ def set_rng_state(state):
 
 _flags: dict = {
     "FLAGS_check_nan_inf": False,
+    # warn-and-continue variant of the nan/inf sweep
+    # (amp.debugging DebugMode.CHECK_NAN_INF / CHECK_ALL)
+    "FLAGS_check_nan_inf_warn_only": False,
     "FLAGS_cudnn_deterministic": False,
     "FLAGS_use_autotune": True,
     "FLAGS_embedding_deterministic": 0,

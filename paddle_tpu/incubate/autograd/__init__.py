@@ -1,0 +1,163 @@
+"""paddle.incubate.autograd (ref: python/paddle/incubate/autograd/
+{functional.py jvp/vjp, primapi.py forward_grad} and
+python/paddle/autograd/autodiff.py jacobian/hessian).
+
+TPU-native: these are direct surfacings of JAX's transforms — jvp is
+jax.jvp (true forward-mode, which the reference emulates with
+double-vjp), vjp is jax.vjp, Jacobian/Hessian lazily materialize via
+jax.jacrev/jax.jacfwd. Functions take and return paddle Tensors.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor import Tensor
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "forward_grad"]
+
+
+def _as_tuple(xs):
+    return tuple(xs) if isinstance(xs, (list, tuple)) else (xs,)
+
+
+def _data(t):
+    return t.data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _pure(func):
+    """Tensor-level func -> array-level func (Tensor is itself a pytree,
+    so strip explicitly rather than via tree.map)."""
+    def strip(o):
+        if isinstance(o, Tensor):
+            return o.data
+        if isinstance(o, (list, tuple)):
+            return type(o)(strip(x) for x in o)
+        return o
+
+    def f(*arrays):
+        return strip(func(*[Tensor(a) for a in arrays]))
+    return f
+
+
+def _wrap(x):
+    return jax.tree.map(lambda a: Tensor(a, stop_gradient=True), x)
+
+
+def jvp(func: Callable, xs, v=None):
+    """ref: incubate/autograd/functional.py jvp(func, xs, v) ->
+    (func_out, jvp_out). True forward-mode (jax.jvp), not the reference's
+    double-backward emulation."""
+    arrays = [_data(t) for t in _as_tuple(xs)]
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        tangents = [_data(t) for t in _as_tuple(v)]
+    out, tangent_out = jax.jvp(_pure(func), arrays, tangents)
+    return _wrap(out), _wrap(tangent_out)
+
+
+def vjp(func: Callable, xs, v=None):
+    """ref: incubate/autograd/functional.py vjp(func, xs, v) ->
+    (func_out, vjp_out)."""
+    arrays = [_data(t) for t in _as_tuple(xs)]
+    out, vjp_fn = jax.vjp(_pure(func), *arrays)
+    if v is None:
+        cot = jax.tree.map(jnp.ones_like, out)
+    else:
+        cot = jax.tree.map(_data, v) if isinstance(v, (list, tuple)) \
+            else _data(v)
+    grads = vjp_fn(cot)
+    grads = grads[0] if len(grads) == 1 else list(grads)
+    return _wrap(out), _wrap(grads)
+
+
+class Jacobian:
+    """ref: python/paddle/autograd/autodiff.py Jacobian — lazy full
+    Jacobian of func at xs; materializes on first access as the flattened
+    [M, N] matrix (multi-input xs concatenate along N — the reference's
+    flattened-view contract). is_batched=True treats axis 0 as a batch
+    and returns [B, M, N] (computed per-sample via vmap, not the O(B^2)
+    cross-batch matrix)."""
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._func = func
+        self._xs = _as_tuple(xs)
+        self._is_batched = is_batched
+        self._mat = None
+
+    def _pure_single(self):
+        return _pure(self._func)
+
+    def _flatten(self, jacs, out_shape):
+        """per-argnum jacobians -> one flattened [M, N] matrix."""
+        import math as _math
+        m = _math.prod(out_shape) if out_shape else 1
+        return jnp.concatenate(
+            [jnp.asarray(j).reshape(m, -1) for j in jacs], axis=-1)
+
+    def _materialize(self):
+        if self._mat is None:
+            arrays = [_data(t) for t in self._xs]
+            fn = self._pure_single()
+            out_shape = tuple(jax.eval_shape(fn, *arrays).shape)
+            argnums = tuple(range(len(arrays)))
+            if self._is_batched:
+                if len(arrays) != 1:
+                    raise NotImplementedError(
+                        "is_batched Jacobian supports a single xs tensor")
+                per_sample = jax.vmap(jax.jacrev(lambda a: fn(a[None])[0]))
+                self._mat = per_sample(arrays[0])
+                if self._mat.ndim == 2:           # scalar-per-sample out
+                    self._mat = self._mat[:, None, :]
+            else:
+                jacs = jax.jacrev(fn, argnums=argnums)(*arrays)
+                self._mat = self._flatten(jacs, out_shape)
+        return self._mat
+
+    def __getitem__(self, idx):
+        return Tensor(jnp.asarray(self._materialize())[idx],
+                      stop_gradient=True)
+
+    @property
+    def shape(self):
+        return tuple(jnp.asarray(self._materialize()).shape)
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self._materialize())
+
+
+class Hessian(Jacobian):
+    """ref: autodiff.py Hessian — func must be scalar-output."""
+
+    def _materialize(self):
+        if self._mat is None:
+            arrays = [_data(t) for t in self._xs]
+
+            def scalar(*a):
+                out = self._pure_single()(*a)
+                return jnp.reshape(out, ())
+
+            h = jax.hessian(scalar,
+                            argnums=tuple(range(len(arrays))))(*arrays)
+            if len(arrays) == 1:
+                n = arrays[0].size
+                self._mat = jnp.asarray(h[0][0]).reshape(n, n)
+            else:
+                # assemble the block matrix over flattened inputs
+                sizes = [a.size for a in arrays]
+                rows = [jnp.concatenate(
+                    [jnp.asarray(h[i][j]).reshape(sizes[i], sizes[j])
+                     for j in range(len(arrays))], axis=1)
+                    for i in range(len(arrays))]
+                self._mat = jnp.concatenate(rows, axis=0)
+        return self._mat
+
+
+def forward_grad(func: Callable, xs, v=None):
+    """ref: primapi.py forward_grad — alias over true forward-mode."""
+    _, tangent = jvp(func, xs, v)
+    return tangent

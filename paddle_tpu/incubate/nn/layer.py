@@ -1,0 +1,337 @@
+"""paddle.incubate.nn fused layer classes (ref: python/paddle/incubate/nn/
+layer/fused_transformer.py — FusedMultiHeadAttention :36,
+FusedFeedForward :391, FusedTransformerEncoderLayer :557,
+FusedLinear, FusedBiasDropoutResidualLayerNorm; fused_dropout_add.py
+FusedDropoutAdd; fused_ec_moe.py FusedEcMoe).
+
+TPU-native: the CUDA side hand-fuses these into single kernels; here each
+layer is a single tape op whose jnp body XLA fuses — same API, compiler
+does the fusion. Attention routes through the Pallas flash kernel when
+eligible (kernels/flash_attention.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.tape import apply_op
+from ...framework import core
+from ...nn import initializer as I
+from ...nn.layer.layers import Layer
+from ...ops._helpers import to_tensor_like
+
+__all__ = ["FusedLinear", "FusedDropoutAdd",
+           "FusedBiasDropoutResidualLayerNorm", "FusedMultiHeadAttention",
+           "FusedFeedForward", "FusedTransformerEncoderLayer", "FusedEcMoe"]
+
+
+def _ln(v, g, b, eps):
+    vf = v.astype(jnp.float32)
+    mu = vf.mean(-1, keepdims=True)
+    var = ((vf - mu) ** 2).mean(-1, keepdims=True)
+    out = (vf - mu) * jax.lax.rsqrt(var + eps)
+    if g is not None:
+        out = out * g.astype(jnp.float32)
+    if b is not None:
+        out = out + b.astype(jnp.float32)
+    return out.astype(v.dtype)
+
+
+def _dropout(x, rate, training):
+    if not training or rate <= 0.0:
+        return x
+    key = core.next_rng_key()
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+class FusedLinear(Layer):
+    """ref: FusedLinear — matmul + bias epilogue in one op."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = (self.create_parameter((out_features,), attr=bias_attr,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+        self.transpose_weight = transpose_weight
+
+    def forward(self, x):
+        from .functional import fused_linear
+        return fused_linear(x, self.weight, self.bias,
+                            transpose_weight=self.transpose_weight)
+
+
+class FusedDropoutAdd(Layer):
+    """ref: fused_dropout_add.py FusedDropoutAdd — dropout(x) + y."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        # reuse the mode-aware functional dropout (upscale_in_train /
+        # downscale_in_infer semantics) rather than a private variant
+        from ...nn import functional as F
+        return F.dropout(x, p=self.p, training=self.training,
+                         mode=self.mode) + y
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """ref: FusedBiasDropoutResidualLayerNorm —
+    LN(residual + dropout(x + bias))."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=weight_attr, default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter((embed_dim,), attr=bias_attr,
+                                             is_bias=True)
+        self.linear_bias = self.create_parameter((embed_dim,), is_bias=True)
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+
+    def forward(self, x, residual):
+        training = self.training
+
+        def f(a, res, b, g, lb):
+            return _ln(res + _dropout(a + b, self.dropout_rate, training),
+                       g, lb, self.epsilon)
+
+        return apply_op(f, to_tensor_like(x), to_tensor_like(residual),
+                        self.linear_bias, self.ln_scale, self.ln_bias,
+                        name="fused_bias_dropout_residual_ln")
+
+
+class FusedMultiHeadAttention(Layer):
+    """ref: fused_transformer.py FusedMultiHeadAttention:36 — pre/post-LN
+    self-attention with a fused [3, nh, d, H] qkv weight, out projection,
+    residual + dropout + LN epilogue."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.embed_dim = embed_dim
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.epsilon = epsilon
+        h, nh, d = embed_dim, num_heads, self.head_dim
+        self.qkv_weight = self.create_parameter((3, nh, d, h),
+                                                attr=qkv_weight_attr)
+        self.qkv_bias = self.create_parameter((3, nh, d),
+                                              attr=qkv_bias_attr,
+                                              is_bias=True)
+        self.linear_weight = self.create_parameter((h, h),
+                                                   attr=linear_weight_attr)
+        self.linear_bias = self.create_parameter((h,),
+                                                 attr=linear_bias_attr,
+                                                 is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            (h,), attr=pre_ln_scale_attr, default_initializer=I.Constant(1.0))
+        self.pre_ln_bias = self.create_parameter((h,), attr=pre_ln_bias_attr,
+                                                 is_bias=True)
+        self.ln_scale = self.create_parameter((h,), attr=ln_scale_attr,
+                                              default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter((h,), attr=ln_bias_attr,
+                                             is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        training = self.training
+        nh, d = self.num_heads, self.head_dim
+        args = [to_tensor_like(query), self.qkv_weight, self.qkv_bias,
+                self.linear_weight, self.linear_bias, self.pre_ln_scale,
+                self.pre_ln_bias, self.ln_scale, self.ln_bias]
+        if attn_mask is not None:
+            args.append(to_tensor_like(attn_mask))
+
+        def f(x, qkvw, qkvb, lw, lb, pg, pb, g, b, *mask):
+            B, S, H = x.shape
+            residual = x
+            a = _ln(x, pg, pb, self.epsilon) if self.normalize_before \
+                else x
+            w2 = qkvw.reshape(3 * nh * d, H).T
+            qkv = (a @ w2 + qkvb.reshape(-1)).reshape(B, S, 3, nh, d)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            from ...kernels import flash_attention as fa
+            # the flash kernel has no dropout hook — only eligible when
+            # attention dropout is inactive, else regularization would
+            # silently differ by shape/platform
+            no_attn_drop = (not training) or self.attn_dropout_rate <= 0.0
+            if (not mask) and no_attn_drop \
+                    and fa.supported(q.shape, k.shape, True):
+                o = fa.flash_attention_bshd(q, k, v, causal=False)
+            else:
+                s = jnp.einsum("bqhd,bkhd->bhqk",
+                               q.astype(jnp.float32),
+                               k.astype(jnp.float32)) / math.sqrt(d)
+                if mask:
+                    s = s + mask[0].astype(jnp.float32)
+                p = jax.nn.softmax(s, axis=-1)
+                p = _dropout(p, self.attn_dropout_rate, training)
+                o = jnp.einsum("bhqk,bkhd->bqhd", p,
+                               v.astype(jnp.float32)).astype(x.dtype)
+            out = o.reshape(B, S, H) @ lw + lb
+            out = residual + _dropout(out, self.dropout_rate, training)
+            if not self.normalize_before:
+                out = _ln(out, g, b, self.epsilon)
+            return out
+
+        return apply_op(f, *args, name="fused_multi_head_attention")
+
+
+class FusedFeedForward(Layer):
+    """ref: fused_transformer.py FusedFeedForward:391 — LN + linear +
+    act + dropout + linear + residual-dropout, one op."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                 else act_dropout_rate)
+        self.activation = activation
+        self.epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            (d_model, dim_feedforward), attr=linear1_weight_attr)
+        self.linear1_bias = self.create_parameter(
+            (dim_feedforward,), attr=linear1_bias_attr, is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            (dim_feedforward, d_model), attr=linear2_weight_attr)
+        self.linear2_bias = self.create_parameter(
+            (d_model,), attr=linear2_bias_attr, is_bias=True)
+        self.ln1_scale = self.create_parameter(
+            (d_model,), attr=ln1_scale_attr, default_initializer=I.Constant(1.0))
+        self.ln1_bias = self.create_parameter((d_model,),
+                                              attr=ln1_bias_attr,
+                                              is_bias=True)
+        self.ln2_scale = self.create_parameter(
+            (d_model,), attr=ln2_scale_attr, default_initializer=I.Constant(1.0))
+        self.ln2_bias = self.create_parameter((d_model,),
+                                              attr=ln2_bias_attr,
+                                              is_bias=True)
+
+    def forward(self, src, cache=None):
+        training = self.training
+        act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}[self.activation]
+
+        def f(x, w1, b1, w2, b2, g1, lb1, g2, lb2):
+            residual = x
+            a = _ln(x, g1, lb1, self.epsilon) if self.normalize_before \
+                else x
+            hmid = _dropout(act(a @ w1 + b1), self.act_dropout_rate,
+                            training)
+            out = residual + _dropout(hmid @ w2 + b2, self.dropout_rate,
+                                      training)
+            if not self.normalize_before:
+                out = _ln(out, g2, lb2, self.epsilon)
+            return out
+
+        return apply_op(f, to_tensor_like(src), self.linear1_weight,
+                        self.linear1_bias, self.linear2_weight,
+                        self.linear2_bias, self.ln1_scale, self.ln1_bias,
+                        self.ln2_scale, self.ln2_bias,
+                        name="fused_feedforward")
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """ref: fused_transformer.py FusedTransformerEncoderLayer:557 —
+    FusedMultiHeadAttention + FusedFeedForward."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(dropout_rate if attn_dropout_rate is None
+                               else attn_dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedEcMoe(Layer):
+    """ref: fused_ec_moe.py FusedEcMoe — expert-choice MoE: each expert
+    picks its top-k tokens (capacity = S*k/E), gelu MLP experts, combine
+    by gate prob. One einsum-dispatched op."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type="gelu",
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[act_type]
+        self.gate_weight = self.create_parameter((hidden_size, num_experts),
+                                                 attr=weight_attr)
+        self.ffn1_weight = self.create_parameter(
+            (num_experts, hidden_size, inter_size), attr=weight_attr)
+        self.ffn1_bias = self.create_parameter((num_experts, inter_size),
+                                               is_bias=True)
+        self.ffn2_weight = self.create_parameter(
+            (num_experts, inter_size, hidden_size), attr=weight_attr)
+        self.ffn2_bias = self.create_parameter((num_experts, hidden_size),
+                                               is_bias=True)
+
+    def forward(self, x, gate=None):
+        """x: [B, S, H]; gate: optional caller-supplied gate logits
+        [B, S, E] (ref FusedEcMoe.forward(x, gate)) — when absent the
+        layer's own gate_weight produces them."""
+        E = self.num_experts
+        act = self.act
+        args = [to_tensor_like(x), self.gate_weight, self.ffn1_weight,
+                self.ffn1_bias, self.ffn2_weight, self.ffn2_bias]
+        if gate is not None:
+            args.append(to_tensor_like(gate))
+
+        def f(xv, gw, w1, b1, w2, b2, *ext_gate):
+            B, S, H = xv.shape
+            T = B * S
+            flat = xv.reshape(T, H)
+            logits = (ext_gate[0].reshape(T, E).astype(jnp.float32)
+                      if ext_gate
+                      else flat.astype(jnp.float32) @ gw.astype(
+                          jnp.float32))
+            scores = jax.nn.softmax(logits, -1)
+            cap = max(T // E, 1)
+            # expert choice: each expert takes its top-`cap` tokens
+            probs, idx = jax.lax.top_k(scores.T, cap)     # [E, cap]
+            tok = jnp.take(flat, idx.reshape(-1), axis=0).reshape(
+                E, cap, H)                                  # [E, cap, H]
+            hmid = act(jnp.einsum("ech,ehm->ecm", tok, w1)
+                       + b1[:, None, :])
+            out = jnp.einsum("ecm,emh->ech", hmid, w2) + b2[:, None, :]
+            out = out * probs[..., None].astype(out.dtype)
+            # scatter-combine back to tokens
+            combined = jnp.zeros((T, H), out.dtype).at[
+                idx.reshape(-1)].add(out.reshape(E * cap, H))
+            return combined.reshape(B, S, H)
+
+        return apply_op(f, *args, name="fused_ec_moe")

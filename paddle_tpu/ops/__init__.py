@@ -6,6 +6,7 @@ Every op is a jnp/lax composition routed through the autograd tape
 selection / data transform / fusion passes.
 """
 from .creation import *      # noqa: F401,F403
+from .tensor_array import *  # noqa: F401,F403
 from .math import *          # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .logic import *         # noqa: F401,F403

@@ -1,0 +1,89 @@
+"""TensorArray ops (ref: paddle/fluid/framework/lod_tensor_array.h
+LoDTensorArray + python/paddle/tensor/array.py — create_array,
+array_write, array_read, array_length).
+
+TPU-native position: the reference's TensorArray exists to serve
+variable-length control flow in the static graph executor. Under JAX that
+role belongs to lax.scan carries with static shapes; the eager API here
+is a real list-backed container for host-side collection (the same way
+dygraph paddle treats a TensorArray as a python list — ref
+python/paddle/tensor/array.py:25 "In dygraph mode, a list of tensors").
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..tensor import Tensor
+from ._helpers import to_tensor_like
+
+__all__ = ["TensorArray", "create_array", "array_write", "array_read",
+           "array_length", "array_pop"]
+
+
+class TensorArray(list):
+    """List of Tensors with the reference's array-op surface."""
+
+    def write(self, i: int, x) -> "TensorArray":
+        return array_write(x, i, array=self)
+
+    def read(self, i: int) -> Tensor:
+        return array_read(self, i)
+
+    def length(self) -> int:
+        return len(self)
+
+    def pop(self, i: int = -1) -> Tensor:
+        return array_pop(self, i)
+
+
+def create_array(dtype="float32", initialized_list=None) -> TensorArray:
+    """ref: array.py create_array."""
+    arr = TensorArray()
+    for t in (initialized_list or ()):
+        arr.append(to_tensor_like(t))
+    return arr
+
+
+def _idx(i) -> int:
+    if isinstance(i, Tensor):
+        return int(i.numpy().reshape(()))
+    return int(i)
+
+
+def array_write(x, i, array: Optional[TensorArray] = None) -> TensorArray:
+    """ref: array.py array_write — write x at index i (appending allowed
+    only at i == len, the reference's constraint)."""
+    if array is None:
+        array = TensorArray()
+    i = _idx(i)
+    x = to_tensor_like(x)
+    if i < 0:
+        raise IndexError(
+            f"array_write index must be >= 0, got {i} (the reference "
+            "constrains writes to 0 <= i <= len)")
+    if i < len(array):
+        array[i] = x
+    elif i == len(array):
+        array.append(x)
+    else:
+        raise IndexError(
+            f"array_write index {i} beyond array length {len(array)} "
+            "(only in-place or append writes allowed)")
+    return array
+
+
+def array_read(array: TensorArray, i) -> Tensor:
+    """ref: array.py array_read."""
+    return array[_idx(i)]
+
+
+def array_length(array: TensorArray) -> Tensor:
+    """ref: array.py array_length — returns an integer scalar Tensor
+    (int32 under JAX's default x32 mode)."""
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(len(array)), stop_gradient=True)
+
+
+def array_pop(array: TensorArray, i=-1) -> Tensor:
+    """ref: manipulation.py array_pop."""
+    return list.pop(array, _idx(i))

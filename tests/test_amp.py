@@ -153,3 +153,54 @@ class TestGradScalerCompiled:
         np.testing.assert_array_equal(w_before, np.asarray(m.weight.numpy()))
         assert scaler.get_init_loss_scaling() == 8.0
         o.clear_grad()
+
+
+class TestAmpDebugging:
+    """ref: python/paddle/amp/debugging.py operator stats + tensor
+    checker + accuracy compare."""
+
+    def test_collect_operator_stats_counts_dtypes(self, capsys):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.amp import debugging as dbg
+
+        m = nn.Linear(4, 4)
+        x = paddle.ones([2, 4])
+        with dbg.collect_operator_stats():
+            m(x)
+            with paddle.amp.auto_cast(level="O1"):
+                m(x)
+        out = capsys.readouterr().out
+        assert "op list" in out
+        assert "linear" in out or "matmul" in out
+
+    def test_check_numerics_and_compare(self, tmp_path):
+        import pytest
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.amp import debugging as dbg
+
+        t = paddle.to_tensor(np.array([1.0, np.inf, 0.0], np.float32))
+        n_nan, n_inf, n_zero = dbg.check_numerics(
+            t, "op_a", "x", dump_path=str(tmp_path / "a.jsonl"))
+        assert (n_nan, n_inf, n_zero) == (0, 1, 1)
+        with pytest.raises(FloatingPointError):
+            dbg.check_numerics(t, "op_a", "x", raise_on_nan_inf=True)
+        t2 = paddle.to_tensor(np.array([1.0, 2.0, 0.0], np.float32))
+        dbg.check_numerics(t2, "op_a", "x",
+                           dump_path=str(tmp_path / "b.jsonl"))
+        rows = dbg.compare_accuracy(str(tmp_path / "a.jsonl"),
+                                    str(tmp_path / "b.jsonl"),
+                                    str(tmp_path / "report.json"))
+        assert rows and rows[0]["has_nan_inf"]
+
+    def test_tensor_checker_flags(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.amp import debugging as dbg
+        from paddle_tpu.framework import core
+
+        dbg.enable_tensor_checker(dbg.TensorCheckerConfig(enable=True))
+        assert core.get_flag("FLAGS_check_nan_inf") == 1
+        dbg.disable_tensor_checker()
+        assert core.get_flag("FLAGS_check_nan_inf") == 0

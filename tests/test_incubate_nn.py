@@ -1,0 +1,235 @@
+"""incubate.nn fused layers + incubate.autograd functional transforms
+(ref: python/paddle/incubate/nn/layer/fused_transformer.py,
+python/paddle/incubate/autograd/functional.py)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import incubate
+
+
+class TestFusedLayers:
+    def test_fused_linear_matches_linear(self):
+        paddle.seed(0)
+        fl = incubate.nn.FusedLinear(8, 4)
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (3, 8)).astype(np.float32))
+        want = (x.numpy() @ fl.weight.numpy()) + fl.bias.numpy()
+        np.testing.assert_allclose(fl(x).numpy(), want, atol=1e-5)
+
+    def test_fused_dropout_add_eval_is_plain_add(self):
+        m = incubate.nn.FusedDropoutAdd(p=0.9)
+        m.eval()
+        x = paddle.ones([4, 4])
+        y = paddle.full([4, 4], 2.0)
+        np.testing.assert_allclose(m(x, y).numpy(), 3.0)
+
+    def test_bias_dropout_residual_ln(self):
+        paddle.seed(0)
+        m = incubate.nn.FusedBiasDropoutResidualLayerNorm(6, dropout_rate=0.0)
+        m.eval()
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((2, 6)).astype(np.float32)
+        r = rng.standard_normal((2, 6)).astype(np.float32)
+        got = m(paddle.to_tensor(x), paddle.to_tensor(r)).numpy()
+        pre = r + x + m.linear_bias.numpy()
+        mu = pre.mean(-1, keepdims=True)
+        var = pre.var(-1, keepdims=True)
+        want = (pre - mu) / np.sqrt(var + 1e-5) * m.ln_scale.numpy() \
+            + m.ln_bias.numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_fused_mha_matches_manual(self):
+        paddle.seed(0)
+        H, nh = 8, 2
+        m = incubate.nn.FusedMultiHeadAttention(
+            H, nh, dropout_rate=0.0, attn_dropout_rate=0.0)
+        m.eval()
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((2, 5, H)).astype(np.float32)
+        got = m(paddle.to_tensor(x)).numpy()
+        # manual: qkv -> sdpa -> out proj -> +residual -> LN
+        d = H // nh
+        w2 = m.qkv_weight.numpy().reshape(3 * H, H).T
+        qkv = (x @ w2 + m.qkv_bias.numpy().reshape(-1)).reshape(
+            2, 5, 3, nh, d)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        s = np.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        o = np.einsum("bhqk,bkhd->bqhd", p, v).reshape(2, 5, H)
+        out = x + (o @ m.linear_weight.numpy() + m.linear_bias.numpy())
+        mu = out.mean(-1, keepdims=True)
+        var = out.var(-1, keepdims=True)
+        want = (out - mu) / np.sqrt(var + 1e-5) * m.ln_scale.numpy() \
+            + m.ln_bias.numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_fused_encoder_layer_trains(self):
+        paddle.seed(0)
+        layer = incubate.nn.FusedTransformerEncoderLayer(
+            16, 4, 32, dropout_rate=0.0)
+        x = paddle.to_tensor(np.random.default_rng(3).standard_normal(
+            (2, 6, 16)).astype(np.float32))
+        out = layer(x)
+        assert tuple(out.shape) == (2, 6, 16)
+        out.sum().backward()
+        missing = [n for n, p in layer.named_parameters()
+                   if not p.stop_gradient and p.grad is None]
+        assert not missing
+
+    def test_fused_ec_moe_shapes_and_grads(self):
+        paddle.seed(0)
+        m = incubate.nn.FusedEcMoe(8, 16, num_experts=4)
+        x = paddle.to_tensor(np.random.default_rng(4).standard_normal(
+            (2, 8, 8)).astype(np.float32))
+        out = m(x)
+        assert tuple(out.shape) == (2, 8, 8)
+        out.sum().backward()
+        assert m.gate_weight.grad is not None
+        assert m.ffn1_weight.grad is not None
+
+
+class TestIncubateAutograd:
+    def test_jvp_matches_directional_derivative(self):
+        from paddle_tpu.incubate.autograd import jvp
+
+        def f(x):
+            return (x * x).sum()
+
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        v = paddle.to_tensor(np.array([1.0, 0.0, 0.0], np.float32))
+        out, tangent = jvp(f, x, v)
+        np.testing.assert_allclose(float(out.numpy()), 14.0)
+        np.testing.assert_allclose(float(tangent.numpy()), 2.0)  # d/dx0
+
+    def test_vjp_matches_grad(self):
+        from paddle_tpu.incubate.autograd import vjp
+
+        def f(x):
+            return (x ** 3).sum()
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        out, grads = vjp(f, x)
+        np.testing.assert_allclose(np.asarray(grads.numpy()),
+                                   [3.0, 12.0], rtol=1e-6)
+
+    def test_jacobian(self):
+        from paddle_tpu.incubate.autograd import Jacobian
+
+        def f(x):
+            import paddle_tpu as paddle
+            return paddle.concat([x * 2, x * x])
+
+        x = paddle.to_tensor(np.array([1.0, 3.0], np.float32))
+        J = Jacobian(f, x)
+        want = np.array([[2.0, 0.0], [0.0, 2.0],
+                         [2.0, 0.0], [0.0, 6.0]], np.float32)
+        np.testing.assert_allclose(J.numpy(), want, rtol=1e-6)
+        assert J.shape == (4, 2)
+
+    def test_hessian(self):
+        from paddle_tpu.incubate.autograd import Hessian
+
+        def f(x):
+            return (x[0] * x[0] * x[1]).sum()
+
+        x = paddle.to_tensor(np.array([2.0, 5.0], np.float32))
+        H = Hessian(f, x)
+        want = np.array([[10.0, 4.0], [4.0, 0.0]], np.float32)
+        np.testing.assert_allclose(H.numpy(), want, rtol=1e-5)
+
+    def test_forward_grad(self):
+        from paddle_tpu.incubate.autograd import forward_grad
+
+        def f(x):
+            return paddle.sin(x)
+
+        x = paddle.to_tensor(np.array([0.0, np.pi / 2], np.float32))
+        t = forward_grad(f, x)
+        np.testing.assert_allclose(np.asarray(t.numpy()), [1.0, 0.0],
+                                   atol=1e-6)
+
+
+class TestReviewFixes:
+    def test_flash_gate_respects_attn_dropout(self):
+        # structural check: with attn dropout active during training, the
+        # dense (dropout-capable) path must be chosen even when flash is
+        # shape-eligible; we just verify train/eval produce different
+        # results under dropout (dense path applied it)
+        paddle.seed(0)
+        m = incubate.nn.FusedMultiHeadAttention(8, 2, dropout_rate=0.0,
+                                                attn_dropout_rate=0.5)
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (1, 4, 8)).astype(np.float32))
+        m.train()
+        a = m(x).numpy()
+        m.eval()
+        b = m(x).numpy()
+        assert not np.allclose(a, b)
+
+    def test_ec_moe_external_gate_changes_routing(self):
+        paddle.seed(0)
+        m = incubate.nn.FusedEcMoe(4, 8, num_experts=2)
+        x = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+            (1, 4, 4)).astype(np.float32))
+        out_default = m(x).numpy()
+        gate = paddle.to_tensor(np.random.default_rng(2).standard_normal(
+            (1, 4, 2)).astype(np.float32) * 5)
+        out_gated = m(x, gate).numpy()
+        assert not np.allclose(out_default, out_gated)
+
+    def test_dropout_add_downscale_in_infer(self):
+        m = incubate.nn.FusedDropoutAdd(p=0.5, mode="downscale_in_infer")
+        m.eval()
+        x = paddle.ones([4])
+        y = paddle.zeros([4])
+        np.testing.assert_allclose(m(x, y).numpy(), 0.5)
+
+    def test_jacobian_multi_input(self):
+        from paddle_tpu.incubate.autograd import Jacobian
+
+        def f(a, b):
+            return a * 2 + b * 3
+
+        a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        b = paddle.to_tensor(np.array([4.0, 5.0], np.float32))
+        J = Jacobian(f, [a, b])
+        want = np.concatenate([np.eye(2) * 2, np.eye(2) * 3], axis=1)
+        np.testing.assert_allclose(J.numpy(), want, rtol=1e-6)
+        assert J.shape == (2, 4)
+
+    def test_jacobian_batched(self):
+        from paddle_tpu.incubate.autograd import Jacobian
+
+        def f(x):
+            return (x * x).sum(axis=-1)
+
+        x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        J = Jacobian(f, x, is_batched=True)
+        got = J.numpy()
+        want = np.array([[[2.0, 4.0]], [[6.0, 8.0]]], np.float32)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_tensor_checker_warn_mode(self):
+        import warnings
+
+        from paddle_tpu.amp import debugging as dbg
+        dbg.enable_tensor_checker(dbg.TensorCheckerConfig(
+            enable=True, debug_mode=dbg.DebugMode.CHECK_NAN_INF))
+        try:
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                t = paddle.to_tensor(np.array([np.inf], np.float32))
+                _ = t * 2  # op output has inf -> warns, no raise
+                assert any("NaN or Inf" in str(x.message) for x in w)
+        finally:
+            dbg.disable_tensor_checker()
+
+    def test_array_write_negative_index_raises(self):
+        a = paddle.create_array(initialized_list=[paddle.ones([1])])
+        with pytest.raises(IndexError, match=">= 0"):
+            paddle.array_write(paddle.zeros([1]), -1, a)

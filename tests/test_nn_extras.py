@@ -126,3 +126,34 @@ class TestFractionalMaxPool:
         x = paddle.ones([1, 2, 8, 8, 8])
         out = nn.FractionalMaxPool3D(output_size=2, random_u=0.5)(x)
         assert tuple(out.shape) == (1, 2, 2, 2, 2)
+
+
+class TestTensorArray:
+    """ref: python/paddle/tensor/array.py create_array/array_write/
+    array_read/array_length."""
+
+    def test_write_read_length(self):
+        a = paddle.create_array()
+        paddle.array_write(paddle.ones([2, 2]), 0, a)
+        a = paddle.array_write(paddle.zeros([2, 2]), paddle.to_tensor(1), a)
+        assert int(paddle.array_length(a).numpy()) == 2
+        np.testing.assert_allclose(paddle.array_read(a, 0).numpy(), 1.0)
+        # overwrite in place
+        paddle.array_write(paddle.full([2, 2], 7.0), 0, a)
+        np.testing.assert_allclose(a.read(0).numpy(), 7.0)
+
+    def test_write_beyond_end_raises(self):
+        a = paddle.create_array()
+        with pytest.raises(IndexError):
+            paddle.array_write(paddle.ones([1]), 5, a)
+
+    def test_pop_and_grad_flow(self):
+        a = paddle.create_array(initialized_list=[paddle.ones([2])])
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        paddle.array_write(x * 3, 1, a)
+        out = paddle.array_read(a, 1).sum()
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 3.0)
+        popped = a.pop()
+        assert int(paddle.array_length(a).numpy()) == 1
