@@ -66,9 +66,14 @@ def vjp(func: Callable, xs, v=None):
     out, vjp_fn = jax.vjp(_pure(func), *arrays)
     if v is None:
         cot = jax.tree.map(jnp.ones_like, out)
+    elif isinstance(v, (list, tuple)):
+        # strip Tensors explicitly (Tensor is itself a pytree — tree.map
+        # would rebuild wrapper nodes and break structure matching)
+        stripped = [_data(t) for t in v]
+        cot = type(v)(stripped) if isinstance(out, (list, tuple)) \
+            else stripped[0]
     else:
-        cot = jax.tree.map(_data, v) if isinstance(v, (list, tuple)) \
-            else _data(v)
+        cot = _data(v)
     grads = vjp_fn(cot)
     grads = grads[0] if len(grads) == 1 else list(grads)
     return _wrap(out), _wrap(grads)

@@ -54,20 +54,15 @@ def _broadcast_kv_heads(q, k, v):
 
 
 def _block_attn(q, k, v, mask, scale):
-    """One blockwise attention round in f32: returns (scores-exp sum stats).
-    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; mask: [Sq, Sk] bool or None."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    if mask is not None:
-        s = jnp.where(mask[None, None], s, -jnp.inf)
-    m = jnp.max(s, axis=-1)                       # [B, H, Sq]
-    # rows with all -inf (fully masked block) contribute nothing
-    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
-    p = jnp.exp(s - m_safe[..., None])
-    p = jnp.where(jnp.isfinite(s), p, 0.0)
-    l = jnp.sum(p, axis=-1)                       # [B, H, Sq]
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    return m_safe, l, o
+    """One blockwise attention round: (m, l, o) stats in f32.
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; mask: [Sq, Sk] bool or None.
+
+    Routed through the Pallas block kernel (kernels/block_attention.py)
+    when shapes are tile-aligned on TPU — the f32 score matrix stays in
+    VMEM; the jnp path covers unaligned/CPU. Fully-masked rows report
+    (m=-1e30, l=0, o=0), which the ring merge treats as empty."""
+    from .block_attention import block_attention_stats
+    return block_attention_stats(q, k, v, mask, scale)
 
 
 def _ring_body(q, k, v, axis_name, causal, scale):

@@ -567,20 +567,31 @@ def _block_with_cache(cfg, h, wl, ck, cv, pos_ids, cache_mask):
         "bts,btkd->bskd", oh, k.astype(ck.dtype))
     cv = cv * (1 - oh.sum(1)[:, :, None, None]) + jnp.einsum(
         "bts,btkd->bskd", oh, v.astype(cv.dtype))
-    if kvh != nh:
-        rep = nh // kvh
-        kk = jnp.repeat(ck, rep, axis=2)
-        vv = jnp.repeat(cv, rep, axis=2)
+    if T == 1:
+        # decode step: paged-KV attention kernel (Pallas on TPU, dense
+        # fallback elsewhere) — block-table layout over the cache pool,
+        # ref block_multihead_attention / masked_multihead_attention
+        from ..kernels.paged_attention import decode_attention
+        lengths = (pos_ids[:, 0] + 1).astype(jnp.int32)  # incl. this token
+        o = decode_attention(q, ck, cv, lengths,
+                             scale=1.0 / math.sqrt(d))
+        o = o.astype(h.dtype).reshape(B, T, nh * d)
     else:
-        kk, vv = ck, cv
-    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
-                   kk.astype(jnp.float32)) / math.sqrt(d)
-    causal = pos_ids[:, :, None] >= jnp.arange(ck.shape[1])[None, None, :]
-    valid = causal & cache_mask[:, None, :]          # [B, T, S_max]
-    s = jnp.where(valid[:, None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhts,bshd->bthd", p, vv.astype(jnp.float32))
-    o = o.astype(h.dtype).reshape(B, T, nh * d)
+        if kvh != nh:
+            rep = nh // kvh
+            kk = jnp.repeat(ck, rep, axis=2)
+            vv = jnp.repeat(cv, rep, axis=2)
+        else:
+            kk, vv = ck, cv
+        s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                       kk.astype(jnp.float32)) / math.sqrt(d)
+        causal = pos_ids[:, :, None] >= jnp.arange(
+            ck.shape[1])[None, None, :]
+        valid = causal & cache_mask[:, None, :]      # [B, T, S_max]
+        s = jnp.where(valid[:, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhts,bshd->bthd", p, vv.astype(jnp.float32))
+        o = o.astype(h.dtype).reshape(B, T, nh * d)
     h = h + o @ wl["self_attn.o_proj"]
     a2 = _rms(h, wl["post_attention_layernorm.weight"], cfg.rms_norm_eps)
     up = jax.nn.silu(a2 @ wl["mlp.gate_proj"]) * (a2 @ wl["mlp.up_proj"])

@@ -233,3 +233,13 @@ class TestReviewFixes:
         a = paddle.create_array(initialized_list=[paddle.ones([1])])
         with pytest.raises(IndexError, match=">= 0"):
             paddle.array_write(paddle.zeros([1]), -1, a)
+
+    def test_vjp_list_cotangent(self):
+        from paddle_tpu.incubate.autograd import vjp
+
+        def f(x):
+            return (x ** 2).sum()
+
+        x = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+        out, g = vjp(f, x, v=[paddle.to_tensor(np.float32(1.0))])
+        np.testing.assert_allclose(np.asarray(g.numpy()), [4.0, 6.0])
