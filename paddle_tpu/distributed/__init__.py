@@ -27,6 +27,9 @@ from .sharding import (  # noqa: F401
 )
 from . import fleet  # noqa: F401
 from . import ps  # noqa: F401
+from . import communication  # noqa: F401
+from . import watchdog  # noqa: F401
+from .communication import stream  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import (  # noqa: F401
     load_state_dict, save_state_dict, wait_save)

@@ -1,0 +1,10 @@
+"""paddle.distributed.communication (ref: python/paddle/distributed/
+communication/ — the op-level API re-exported at paddle.distributed top
+level, plus the `stream` variants)."""
+from ..collective import (  # noqa: F401
+    ReduceOp, all_gather, all_reduce, alltoall, barrier, broadcast,
+    reduce, reduce_scatter, scatter)
+from . import stream  # noqa: F401
+
+__all__ = ["stream", "ReduceOp", "all_reduce", "all_gather", "broadcast",
+           "reduce", "reduce_scatter", "alltoall", "scatter", "barrier"]

@@ -1,0 +1,47 @@
+"""paddle.distributed.communication.stream (ref: python/paddle/
+distributed/communication/stream/*.py — collective variants taking
+sync_op / use_calc_stream).
+
+TPU-native: XLA exposes no user-visible streams; dispatch is async and
+ordering is the compiler's job (SURVEY §2.4 TPU mapping), so the stream
+variants are the same collectives with the scheduling knobs accepted for
+API compatibility. sync_op=False returns a completed no-op task whose
+wait() is immediate — matching semantics, since the result array is
+already a future under JAX's async dispatch."""
+from __future__ import annotations
+
+from .. import collective as C
+
+__all__ = ["all_reduce", "all_gather", "broadcast", "reduce",
+           "reduce_scatter", "alltoall", "scatter"]
+
+
+class _DoneTask:
+    """ref: the returned task of async stream ops (task.wait())."""
+
+    def __init__(self, result=None):
+        self.result = result
+
+    def wait(self):
+        return self.result
+
+    def is_completed(self):
+        return True
+
+
+def _wrap(fn):
+    def op(*args, sync_op=True, use_calc_stream=False, **kw):
+        out = fn(*args, **kw)
+        return out if sync_op else _DoneTask(out)
+    op.__name__ = fn.__name__
+    op.__doc__ = fn.__doc__
+    return op
+
+
+all_reduce = _wrap(C.all_reduce)
+all_gather = _wrap(C.all_gather)
+broadcast = _wrap(C.broadcast)
+reduce = _wrap(C.reduce)
+reduce_scatter = _wrap(C.reduce_scatter)
+alltoall = _wrap(C.alltoall)
+scatter = _wrap(C.scatter)

@@ -1,0 +1,153 @@
+"""Collective desync watchdog (ref: phi/core/distributed/
+comm_task_manager.cc CommTaskManager — a monitor thread that times every
+in-flight NCCL task and warns/aborts when one exceeds
+FLAGS_comm_timeout, catching rank desyncs and hangs).
+
+TPU-native: there are no per-collective launches to time — a whole
+compiled step is the scheduling unit, and a desynced/preempted peer
+manifests as the step (or the jax.distributed barrier) never returning.
+The watchdog therefore times *steps*: wrap the step callable (or use the
+context manager), and a daemon monitor fires if completion doesn't land
+within the timeout — logging the stage name, elapsed time, and rank, and
+optionally aborting the process so the launch layer's elastic restart
+(distributed/elastic.py) can take over, exactly the role the reference's
+abort path plays."""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+import warnings
+from typing import Callable, Optional
+
+__all__ = ["CommWatchdog", "watch", "watched_step"]
+
+_DEFAULT_TIMEOUT = float(os.environ.get("FLAGS_comm_timeout", "1800"))
+
+
+class CommWatchdog:
+    """Times named critical sections; fires on overrun.
+
+    on_timeout: 'warn' (log and keep waiting) or 'abort' (os._exit(101) —
+    the reference's faulted-worker exit code, which the elastic launch
+    layer treats as relaunch-me)."""
+
+    FAULT_EXIT_CODE = 101          # ref: fleet/elastic/manager.py:32
+
+    def __init__(self, timeout: float = _DEFAULT_TIMEOUT,
+                 on_timeout: str = "warn",
+                 logger: Optional[Callable[[str], None]] = None):
+        self.timeout = timeout
+        self.on_timeout = on_timeout
+        self._log = logger or (lambda msg: warnings.warn(
+            msg, RuntimeWarning))
+        self._lock = threading.Lock()
+        self._active = {}          # (name, token) -> start time
+        self._fired = set()
+        self._token = 0
+        self._stop = threading.Event()
+        self._thread = None
+        self.timeouts = 0          # observable for tests/telemetry
+
+    # -- monitor ----------------------------------------------------------
+    def _ensure_monitor(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(min(self.timeout / 10.0, 5.0)):
+            now = time.monotonic()
+            with self._lock:
+                overdue = [(key, now - t0)
+                           for key, t0 in self._active.items()
+                           if now - t0 > self.timeout
+                           and key not in self._fired]
+                for key, _ in overdue:
+                    self._fired.add(key)
+            for (name, _tok), elapsed in overdue:
+                self.timeouts += 1
+                rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+                msg = (f"[CommWatchdog] step '{name}' has not completed "
+                       f"after {elapsed:.0f}s (timeout {self.timeout:.0f}s) "
+                       f"on rank {rank} — likely peer desync, preemption, "
+                       "or a hung collective")
+                self._log(msg)
+                if self.on_timeout == "abort":
+                    os._exit(self.FAULT_EXIT_CODE)
+
+    # -- section API -------------------------------------------------------
+    @contextlib.contextmanager
+    def section(self, name: str = "step"):
+        self._ensure_monitor()
+        with self._lock:
+            self._token += 1
+            key = (name, self._token)   # unique: concurrent/nested same-
+            self._active[key] = time.monotonic()  # name sections tracked
+        try:                                      # independently
+            yield
+        finally:
+            with self._lock:
+                self._active.pop(key, None)
+                self._fired.discard(key)
+
+    def wrap(self, fn: Callable, name: Optional[str] = None) -> Callable:
+        """Wrap a step callable so every invocation is watched."""
+        label = name or getattr(fn, "__name__", "step")
+
+        def watched(*args, **kwargs):
+            with self.section(label):
+                out = fn(*args, **kwargs)
+                # block so the watchdog sees true completion, not async
+                # dispatch (a hung collective otherwise "returns" a future)
+                try:
+                    import jax
+                except ImportError:
+                    return out
+                # runtime errors (failed collective, OOM) must propagate —
+                # only a missing jax is ignorable
+                jax.block_until_ready(
+                    out.data if hasattr(out, "data") else out)
+                return out
+
+        watched.__name__ = f"watched_{label}"
+        return watched
+
+    def shutdown(self):
+        self._stop.set()
+
+
+_global: Optional[CommWatchdog] = None
+
+
+def watch(timeout: Optional[float] = None, on_timeout: Optional[str] = None):
+    """Module-level singleton accessor (ref CommTaskManager::GetInstance).
+    Explicitly passed settings update the live instance — later callers
+    are not silently stuck with the first caller's configuration."""
+    global _global
+    if _global is None:
+        _global = CommWatchdog(
+            timeout=timeout if timeout is not None else _DEFAULT_TIMEOUT,
+            on_timeout=on_timeout or "warn")
+    else:
+        if timeout is not None:
+            _global.timeout = timeout
+        if on_timeout is not None:
+            _global.on_timeout = on_timeout
+    return _global
+
+
+def _reset_global():  # test hook
+    global _global
+    if _global is not None:
+        _global.shutdown()
+    _global = None
+
+
+def watched_step(fn: Callable, timeout: Optional[float] = None,
+                 on_timeout: Optional[str] = None) -> Callable:
+    """Convenience: wrap a TrainStep/step function with the global
+    watchdog."""
+    return watch(timeout, on_timeout).wrap(fn)

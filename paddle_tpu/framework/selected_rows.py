@@ -1,0 +1,94 @@
+"""SelectedRows (ref: paddle/phi/core/selected_rows.h — the sparse
+row-slice gradient container used by embedding/sparse-parameter updates,
+exposed as base.framework.core.eager.SelectedRows).
+
+TPU-native position: XLA gradients are dense (scatter-add fuses into the
+update), so SelectedRows is not on the hot path here — it exists as the
+interchange format: PS sparse push/pull (distributed/ps) and user code
+porting reference sparse-grad handling. rows/value/height semantics match
+the reference: `value[i]` is the gradient slice for row id `rows[i]`;
+duplicate ids are allowed and merge by summation (ref
+phi/kernels/funcs/selected_rows_functor.h MergeAdd)."""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SelectedRows"]
+
+
+class SelectedRows:
+    def __init__(self, rows: Sequence[int] = (), height: int = 0,
+                 value=None):
+        self._rows = list(int(r) for r in rows)
+        self._height = int(height)
+        self._value = value
+
+    # -- reference accessor surface --------------------------------------
+    def rows(self):
+        return list(self._rows)
+
+    def set_rows(self, rows):
+        self._rows = list(int(r) for r in rows)
+
+    def height(self):
+        return self._height
+
+    def set_height(self, h):
+        self._height = int(h)
+
+    def get_tensor(self):
+        return self._value
+
+    def set_tensor(self, value):
+        self._value = value
+
+    @property
+    def numel(self):
+        if self._value is None:
+            return 0
+        # shape metadata only — never a device-to-host transfer
+        return int(np.prod(getattr(self._value, "shape", ())))
+
+    def sync_index(self):  # ref API; nothing async here
+        pass
+
+    def has_rows(self):
+        return bool(self._rows)
+
+    # -- conversions ------------------------------------------------------
+    @classmethod
+    def from_dense_gradient(cls, grad, ids, height=None):
+        """Build from a dense embedding gradient + the ids that were
+        looked up: keeps only the touched rows."""
+        g = jnp.asarray(getattr(grad, "data", grad))
+        ids = np.asarray(getattr(ids, "data", ids)).ravel().astype(int)
+        uniq = np.unique(ids)
+        return cls(rows=uniq.tolist(),
+                   height=height or g.shape[0],
+                   value=jnp.take(g, jnp.asarray(uniq), axis=0))
+
+    def merge_rows(self):
+        """MergeAdd: collapse duplicate row ids by summation (ref
+        selected_rows_functor.h MergeAdd)."""
+        if not self._rows:
+            return self
+        rows = np.asarray(self._rows)
+        uniq, inv = np.unique(rows, return_inverse=True)
+        v = jnp.asarray(self._value)
+        merged = jnp.zeros((len(uniq),) + v.shape[1:], v.dtype)
+        merged = merged.at[jnp.asarray(inv)].add(v)
+        return SelectedRows(uniq.tolist(), self._height, merged)
+
+    def to_dense(self):
+        """Scatter back to the full [height, ...] dense tensor."""
+        assert self._value is not None and self._height > 0
+        v = jnp.asarray(self._value)
+        out = jnp.zeros((self._height,) + v.shape[1:], v.dtype)
+        return out.at[jnp.asarray(self._rows)].add(v)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self._height}, "
+                f"rows={self._rows[:8]}{'...' if len(self._rows) > 8 else ''})")

@@ -141,3 +141,126 @@ def test_launch_arg_parsing():
     assert env["JAX_PROCESS_ID"] == "2"
     assert args.script == "train.py"
     assert args.script_args == ["--lr", "0.1"]
+
+
+class TestSelectedRowsAndStream:
+    """ref: phi/core/selected_rows.h + distributed/communication/stream."""
+
+    def test_selected_rows_roundtrip_and_merge(self):
+        import jax.numpy as jnp
+
+        sr = paddle.SelectedRows(rows=[1, 3, 1], height=5,
+                                 value=jnp.ones((3, 2)))
+        assert sr.height() == 5 and sr.has_rows()
+        merged = sr.merge_rows()
+        assert merged.rows() == [1, 3]
+        dense = np.asarray(merged.to_dense())
+        assert dense.shape == (5, 2)
+        np.testing.assert_allclose(dense[1], 2.0)   # duplicate id summed
+        np.testing.assert_allclose(dense[3], 1.0)
+        np.testing.assert_allclose(dense[0], 0.0)
+
+    def test_from_dense_gradient(self):
+        import jax.numpy as jnp
+
+        grad = jnp.arange(10.0).reshape(5, 2)
+        sr = paddle.SelectedRows.from_dense_gradient(grad, np.array([4, 2]))
+        assert sr.rows() == [2, 4]
+        np.testing.assert_allclose(np.asarray(sr.get_tensor())[0], [4., 5.])
+
+    def test_stream_namespace(self):
+        from paddle_tpu.distributed import stream
+
+        t = stream.all_reduce(paddle.ones([2]), sync_op=False)
+        assert t.is_completed()
+        np.testing.assert_allclose(np.asarray(t.wait().numpy()), 1.0)
+        gathered = []
+        stream.all_gather(gathered, paddle.ones([2]), sync_op=True)
+        assert len(gathered) >= 1
+
+
+class TestCommWatchdog:
+    """ref: phi/core/distributed/comm_task_manager.cc — desync watchdog."""
+
+    def test_fast_step_no_fire(self):
+        from paddle_tpu.distributed.watchdog import CommWatchdog
+
+        wd = CommWatchdog(timeout=5.0)
+        stepped = []
+        fn = wd.wrap(lambda: stepped.append(1) or paddle.ones([2]),
+                     name="fast")
+        fn()
+        assert stepped and wd.timeouts == 0
+        wd.shutdown()
+
+    def test_hung_step_fires_warning(self):
+        import threading
+        import time
+
+        from paddle_tpu.distributed.watchdog import CommWatchdog
+
+        msgs = []
+        wd = CommWatchdog(timeout=0.3, logger=msgs.append)
+        release = threading.Event()
+
+        def hung():
+            with wd.section("hung_step"):
+                release.wait(timeout=10)
+
+        t = threading.Thread(target=hung, daemon=True)
+        t.start()
+        deadline = time.time() + 5
+        while not msgs and time.time() < deadline:
+            time.sleep(0.05)
+        release.set()
+        t.join(timeout=5)
+        wd.shutdown()
+        assert msgs and "hung_step" in msgs[0] and wd.timeouts >= 1
+
+    def test_section_cleanup_on_exception(self):
+        from paddle_tpu.distributed.watchdog import CommWatchdog
+
+        wd = CommWatchdog(timeout=60)
+        with pytest.raises(ValueError):
+            with wd.section("boom"):
+                raise ValueError("x")
+        assert not wd._active
+        wd.shutdown()
+
+    def test_watch_updates_settings_and_concurrent_sections(self):
+        import threading
+        import time as _time
+
+        from paddle_tpu.distributed import watchdog as W
+
+        W._reset_global()
+        wd1 = W.watch(timeout=100)
+        wd2 = W.watch(timeout=0.3, on_timeout="warn")
+        assert wd1 is wd2 and wd2.timeout == 0.3
+        W._reset_global()
+
+        # concurrent same-name sections tracked independently: A finishing
+        # must not unmonitor B
+        msgs = []
+        wd = W.CommWatchdog(timeout=0.3, logger=msgs.append)
+        release_b = threading.Event()
+
+        def quick():
+            with wd.section("step"):
+                pass
+
+        def hung():
+            with wd.section("step"):
+                release_b.wait(timeout=10)
+
+        tb = threading.Thread(target=hung, daemon=True)
+        tb.start()
+        _time.sleep(0.05)
+        quick()                      # A enters and exits while B runs
+        deadline = _time.time() + 5
+        while not msgs and _time.time() < deadline:
+            _time.sleep(0.05)
+        release_b.set()
+        tb.join(timeout=5)
+        wd.shutdown()
+        assert msgs, "hung concurrent section was unmonitored"
