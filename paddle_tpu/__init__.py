@@ -20,6 +20,7 @@ from .framework.core import (  # noqa: F401
 )
 from .tensor import Parameter, Tensor  # noqa: F401
 from .framework.selected_rows import SelectedRows  # noqa: F401
+from .framework.string_tensor import StringTensor  # noqa: F401
 from .ops import *  # noqa: F401,F403
 from .ops import creation as _creation
 from .autograd import enable_grad, grad, no_grad, set_grad_enabled  # noqa: F401

@@ -107,9 +107,12 @@ class CommWatchdog:
                 except ImportError:
                     return out
                 # runtime errors (failed collective, OOM) must propagate —
-                # only a missing jax is ignorable
-                jax.block_until_ready(
-                    out.data if hasattr(out, "data") else out)
+                # only a missing jax is ignorable. Unwrap Tensor wrappers
+                # everywhere in the structure: block_until_ready silently
+                # skips unknown leaf types, which would let a hung step
+                # slip past the watchdog.
+                jax.block_until_ready(jax.tree.map(
+                    lambda t: t.data if hasattr(t, "data") else t, out))
                 return out
 
         watched.__name__ = f"watched_{label}"

@@ -45,8 +45,9 @@ class SelectedRows:
     def set_tensor(self, value):
         self._value = value
 
-    @property
     def numel(self):
+        """Method, matching the reference accessor surface (rows(),
+        height(), numel() are all calls there)."""
         if self._value is None:
             return 0
         # shape metadata only — never a device-to-host transfer

@@ -1,0 +1,79 @@
+"""StringTensor (ref: paddle/phi/core/string_tensor.h — pstring-element
+tensor; kernels paddle/phi/kernels/strings/strings_lower_upper_kernel.h,
+strings_empty_kernel.cc expose empty/lower/upper).
+
+TPU-native position: strings never touch the accelerator (the reference's
+string kernels are CPU-only too); this is a host-side numpy-unicode
+container feeding tokenizers/data pipelines, with the reference's tiny op
+surface (empty/empty_like/lower/upper)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "strings_empty", "strings_empty_like",
+           "strings_lower", "strings_upper"]
+
+
+class StringTensor:
+    def __init__(self, data=None, name=""):
+        if data is None:
+            data = []
+        self._data = np.asarray(data, dtype=object)
+        self.name = name
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        return out if isinstance(out, str) else StringTensor(out)
+
+    def __len__(self):
+        return len(self._data)
+
+    def __eq__(self, other):
+        o = other._data if isinstance(other, StringTensor) else other
+        return bool(np.array_equal(self._data, np.asarray(o, object)))
+
+    # whole-container equality above would otherwise null __hash__ and
+    # make instances unusable as dict keys
+    __hash__ = object.__hash__
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"
+
+
+def strings_empty(shape):
+    """ref: strings_empty_kernel — uninitialized (here: empty-string)
+    tensor of the given shape."""
+    return StringTensor(np.full(tuple(shape), "", dtype=object))
+
+
+def strings_empty_like(x: StringTensor):
+    return strings_empty(x.shape)
+
+
+def _map(x, fn):
+    flat = [fn(s) for s in np.asarray(x._data, object).ravel()]
+    return StringTensor(np.asarray(flat, object).reshape(x.shape))
+
+
+def strings_lower(x: StringTensor, use_utf8_encoding: bool = True):
+    """ref: strings_lower_upper_kernel StringLower (utf8-aware via
+    python's str.lower)."""
+    return _map(x, str.lower)
+
+
+def strings_upper(x: StringTensor, use_utf8_encoding: bool = True):
+    return _map(x, str.upper)

@@ -397,10 +397,64 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
 
 
 def hsigmoid_loss(input, label, num_classes, weight, bias=None,
-                  path_table=None, path_code=None, is_sparse=False, name=None):
-    # default complete-binary-tree hierarchical softmax
-    raise NotImplementedError(
-        "hsigmoid_loss: planned (rarely used; ref loss.py::hsigmoid_loss)")
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """ref: loss.py::hsigmoid_loss — hierarchical sigmoid over the default
+    complete binary tree; weight: [num_classes-1, feature], bias:
+    [num_classes-1] (custom path_table/path_code not supported — the
+    reference's custom-tree mode serves its sparse PS path)."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "custom path_table/path_code trees are not supported; the "
+            "default complete-binary-tree mode covers the dense API")
+    nodes, codes, mask = _hsig_paths(int(num_classes))
+    args = [to_tensor_like(input), to_tensor_like(label),
+            to_tensor_like(weight)]
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+
+    def f(x, lbl, w, *b):
+        lbl = lbl.reshape(-1).astype(jnp.int32)
+        nsel = jnp.asarray(nodes)[lbl]
+        csel = jnp.asarray(codes)[lbl].astype(jnp.float32)
+        msel = jnp.asarray(mask)[lbl]
+        wsel = w[nsel]                    # [B, depth, F]
+        logits = jnp.einsum("bf,bdf->bd", x.astype(jnp.float32),
+                            wsel.astype(jnp.float32))
+        if b:
+            logits = logits + b[0][nsel]
+        sign = 1.0 - 2.0 * csel
+        logp = jax.nn.log_sigmoid(sign * logits) * msel
+        return -jnp.sum(logp, axis=1, keepdims=True)
+
+    return apply_op(f, *args, name="hsigmoid_loss")
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=64)
+def _hsig_paths(num_classes):
+    """Per-class (internal-node index, left/right bit, valid mask) paths
+    of the complete binary tree (heap numbering). Cached — rebuilding a
+    100k-class table per step would dominate the loss itself."""
+    import math as _m
+    depth = int(_m.ceil(_m.log2(max(num_classes, 2))))
+    codes = np.zeros((num_classes, depth), np.int32)
+    nodes = np.zeros((num_classes, depth), np.int32)
+    mask = np.zeros((num_classes, depth), np.float32)
+    for c in range(num_classes):
+        node = c + num_classes
+        path = []
+        while node > 1:
+            path.append((node // 2, node % 2))
+            node //= 2
+        path.reverse()
+        for d, (n, bit) in enumerate(path[:depth]):
+            nodes[c, d] = n - 1
+            codes[c, d] = bit
+            mask[c, d] = 1.0
+    return nodes, codes, mask
 
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
@@ -430,8 +484,42 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
 def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
               fastemit_lambda=0.001, reduction="mean", name=None):
-    raise NotImplementedError(
-        "rnnt_loss: planned (ref warprnnt dependency; needs a lax.scan DP)")
+    """ref: loss.py::rnnt_loss (warprnnt there; a lax.scan forward-variable
+    DP here — nn/layer/extras.py). input: [B, T, U+1, V] logits; label:
+    [B, U]; lengths select each sample's (T_i, U_i) readout."""
+    if blank != 0:
+        raise NotImplementedError("this implementation fixes blank=0")
+    if fastemit_lambda:
+        import warnings
+        warnings.warn(
+            "rnnt_loss: fastemit_lambda is accepted for API parity but "
+            "the FastEmit regularization term is not implemented — the "
+            "returned value is the plain RNNT NLL", UserWarning)
+    from ..layer.extras import _rnnt_alpha
+
+    args = [to_tensor_like(input), to_tensor_like(label),
+            to_tensor_like(input_lengths), to_tensor_like(label_lengths)]
+
+    def f(x, lbl, il, ll):
+        logp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+        B, T, U1, V = logp.shape
+        il = il.reshape(-1)
+        if U1 == 1:      # U=0: the only path emits t_len blanks
+            t_mask = jnp.arange(T)[None, :] < il[:, None]
+            losses = -jnp.sum(logp[:, :, 0, 0] * t_mask, axis=1)
+        else:
+            losses = jax.vmap(
+                lambda lp, lb, ti, ui: _rnnt_alpha(
+                    lp, lb.astype(jnp.int32), T, U1 - 1,
+                    t_len=ti.astype(jnp.int32), u_len=ui.astype(jnp.int32))
+            )(logp, lbl, il, ll.reshape(-1))
+        if reduction == "mean":
+            return jnp.mean(losses)
+        if reduction == "sum":
+            return jnp.sum(losses)
+        return losses
+
+    return apply_op(f, *args, name="rnnt_loss")
 
 
 def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,

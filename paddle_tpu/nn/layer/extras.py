@@ -76,23 +76,10 @@ class HSigmoidLoss(Layer):
             (n_internal, feature_size), attr=weight_attr)
         self.bias = self.create_parameter((n_internal,), attr=bias_attr,
                                           is_bias=True)
-        # precompute per-class (node index, left/right code) paths of the
-        # complete tree: class c's path follows the bits of c + num_classes
-        codes = np.zeros((num_classes, self.depth), np.int32)
-        nodes = np.zeros((num_classes, self.depth), np.int32)
-        mask = np.zeros((num_classes, self.depth), np.float32)
-        for c in range(num_classes):
-            # heap-style: leaf id = c + n_internal (1-indexed heap)
-            node = c + num_classes
-            path = []
-            while node > 1:
-                path.append((node // 2, node % 2))
-                node //= 2
-            path.reverse()
-            for d, (n, bit) in enumerate(path[: self.depth]):
-                nodes[c, d] = n - 1          # internal nodes are 1..n_int
-                codes[c, d] = bit
-                mask[c, d] = 1.0
+        # per-class (node index, left/right code, mask) paths of the
+        # complete tree — shared with the functional form
+        from ..functional.loss import _hsig_paths
+        nodes, codes, mask = _hsig_paths(num_classes)
         self._nodes = jnp.asarray(nodes)
         self._codes = jnp.asarray(codes)
         self._mask = jnp.asarray(mask)
@@ -116,13 +103,13 @@ class HSigmoidLoss(Layer):
                         self.weight, self.bias, name="hsigmoid_loss")
 
 
-def _rnnt_alpha(log_probs, labels, T, U):
-    """log_probs: [T, U+1, V]; labels: [U] — forward variable recursion
-    (Graves 2012). blank assumed index 0."""
+def _rnnt_alpha_grid(log_probs, labels, T, U):
+    """log_probs: [T, U+1, V]; labels: [U] — forward-variable recursion
+    (Graves 2012), blank index 0. Returns the full alpha grid [T, U+1]
+    so variable (T_i, U_i) readouts can index it."""
     blank = log_probs[:, :, 0]                       # [T, U+1]
     lab = jnp.take_along_axis(
         log_probs[:, :-1, :], labels[None, :, None], axis=2)[:, :, 0]
-    # alpha over the (T, U+1) grid
     neg = -1e30
 
     def row(alpha_prev, t):
@@ -143,8 +130,19 @@ def _rnnt_alpha(log_probs, labels, T, U):
         return a, a
 
     _, alpha0 = jax.lax.scan(cell0, 0.0, jnp.arange(U + 1))
-    alphaT, _ = jax.lax.scan(row, alpha0, jnp.arange(1, T))
-    return -(alphaT[U] + blank[T - 1, U])
+    _, rows = jax.lax.scan(row, alpha0, jnp.arange(1, T))
+    return jnp.concatenate([alpha0[None], rows], axis=0)  # [T, U+1]
+
+
+def _rnnt_alpha(log_probs, labels, T, U, t_len=None, u_len=None):
+    """Negative log-likelihood; t_len/u_len (traced scalars) support
+    variable-length readout — paths use exactly u_len labels and t_len
+    time steps, ending with the mandatory blank at (t_len-1, u_len)."""
+    alpha = _rnnt_alpha_grid(log_probs, labels, T, U)
+    blank = log_probs[:, :, 0]
+    ti = (T - 1) if t_len is None else (t_len - 1)
+    ui = U if u_len is None else u_len
+    return -(alpha[ti, ui] + blank[ti, ui])
 
 
 class RNNTLoss(Layer):

@@ -264,3 +264,26 @@ class TestCommWatchdog:
         tb.join(timeout=5)
         wd.shutdown()
         assert msgs, "hung concurrent section was unmonitored"
+
+
+class TestStringTensor:
+    """ref: phi/core/string_tensor.h + kernels/strings lower/upper."""
+
+    def test_roundtrip_and_case_ops(self):
+        from paddle_tpu.framework.string_tensor import (strings_lower,
+                                                        strings_upper)
+
+        st = paddle.StringTensor([["Hello", "WORLD"], ["ümlaut", "ok"]])
+        assert st.shape == (2, 2) and st.size == 4
+        low = strings_lower(st)
+        up = strings_upper(st)
+        assert low.tolist() == [["hello", "world"], ["ümlaut", "ok"]]
+        assert up[0][1] == "WORLD" and up[1][0] == "ÜMLAUT"
+
+    def test_empty(self):
+        from paddle_tpu.framework.string_tensor import (strings_empty,
+                                                        strings_empty_like)
+
+        e = strings_empty((3,))
+        assert e.tolist() == ["", "", ""]
+        assert strings_empty_like(e).shape == (3,)

@@ -157,3 +157,40 @@ class TestTensorArray:
         np.testing.assert_allclose(x.grad.numpy(), 3.0)
         popped = a.pop()
         assert int(paddle.array_length(a).numpy()) == 1
+
+
+class TestFunctionalForms:
+    """Functional hsigmoid_loss / rnnt_loss (ref: nn/functional/loss.py)."""
+
+    def test_functional_hsigmoid_matches_layer(self):
+        paddle.seed(0)
+        layer = nn.HSigmoidLoss(feature_size=6, num_classes=5)
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (4, 6)).astype(np.float32))
+        y = paddle.to_tensor(np.array([0, 2, 3, 4]))
+        want = layer(x, y).numpy()
+        got = F.hsigmoid_loss(x, y, 5, layer.weight, layer.bias).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_functional_rnnt_with_lengths(self):
+        # per-sample lengths: sample 0 uses the full grid, sample 1 a
+        # shorter prefix -- the shorter readout must equal a standalone
+        # run on the truncated input
+        rng = np.random.default_rng(1)
+        B, T, U, V = 2, 4, 2, 5
+        logits = rng.standard_normal((B, T, U + 1, V)).astype(np.float32)
+        labels = rng.integers(1, V, (B, U)).astype(np.int64)
+        il = np.array([T, 3], np.int64)
+        ll = np.array([U, 1], np.int64)
+        losses = F.rnnt_loss(paddle.to_tensor(logits),
+                             paddle.to_tensor(labels),
+                             paddle.to_tensor(il), paddle.to_tensor(ll),
+                             reduction="none").numpy()
+        short = nn.RNNTLoss(reduction="none")(
+            paddle.to_tensor(logits[1:2, :3, :2]),
+            paddle.to_tensor(labels[1:2, :1])).numpy()
+        np.testing.assert_allclose(losses[1], short[0], rtol=1e-5)
+        full = nn.RNNTLoss(reduction="none")(
+            paddle.to_tensor(logits[0:1]),
+            paddle.to_tensor(labels[0:1])).numpy()
+        np.testing.assert_allclose(losses[0], full[0], rtol=1e-5)
