@@ -8,7 +8,10 @@ vs_baseline is MFU relative to the A100+NCCL parity target (BASELINE.json):
 A100 LLaMA pretraining lands at ~50% MFU with a tuned Megatron-style stack,
 so vs_baseline = our_MFU / 0.50 (>= 1.0 means we beat the baseline).
 
-Env knobs: BENCH_MODEL (tiny|350m|1b|7b), BENCH_BATCH, BENCH_SEQ, BENCH_STEPS.
+Env knobs: BENCH_MODEL (tiny|350m|1b|7b for LLaMA — BASELINE config 3 —
+plus bert|ernie|resnet50|unet for BASELINE configs 2/4/1/5),
+BENCH_BATCH, BENCH_SEQ, BENCH_IMG, BENCH_STEPS, BENCH_INIT_TIMEOUT,
+BENCH_WALL_TIMEOUT.
 """
 from __future__ import annotations
 
@@ -97,6 +100,149 @@ def _peak_flops(device) -> float | None:
     return None
 
 
+def _time_steps(step, args, steps):
+    """Warmup until the jit cache stops growing, then time `steps`."""
+    import time as _time
+    prev_cache = -1
+    warmup = 0
+    while warmup < 6:
+        loss = step(*args)
+        warmup += 1
+        cache = getattr(step._compiled, "_cache_size", lambda: None)()
+        if cache is not None and cache == prev_cache and warmup >= 3:
+            break
+        prev_cache = cache
+    float(loss.numpy())
+    t0 = _time.perf_counter()
+    for _ in range(steps):
+        loss = step(*args)
+    last = float(loss.numpy())
+    dt = _time.perf_counter() - t0
+    n_compiles = (getattr(step._compiled, "_cache_size",
+                          lambda: None)() or 0) - (prev_cache or 0)
+    return dt, last, n_compiles
+
+
+def _measured_fwd_flops(model, *example):
+    """XLA's own flop count of the model forward (used where no closed
+    formula exists — ResNet/UNet); train step ~ 3x forward."""
+    import jax
+
+    from paddle_tpu.framework import core
+    from paddle_tpu.tensor import Tensor
+
+    state = {k: t.data for k, t in model.state_dict().items()}
+
+    def fwd(state, *xs):
+        with model.use_state(state), core.no_grad_guard():
+            out = model(*[Tensor(x) for x in xs])
+            return out.data if isinstance(out, Tensor) else out[0].data
+
+    try:
+        ca = jax.jit(fwd).lower(state, *example).cost_analysis() or {}
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return float(ca.get("flops", 0.0) or 0.0)
+    except Exception:
+        return 0.0
+
+
+def _bench_other(size, devs, on_tpu):
+    """BASELINE.md configs 1/2/4/5 (ResNet-50 / BERT / ERNIE / UNet);
+    config 3 (LLaMA) is the default path in main()."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as popt
+
+    rng = np.random.default_rng(0)
+    paddle.seed(0)
+    steps = int(os.environ.get("BENCH_STEPS", 8 if on_tpu else 2))
+
+    if size in ("bert", "ernie"):
+        if size == "bert":
+            from paddle_tpu.models.bert import (BertForMaskedLM as ctor,
+                                                bert_base, bert_tiny)
+            cfg = bert_base() if on_tpu else bert_tiny()
+        else:
+            from paddle_tpu.models.ernie import (
+                ErnieForPretraining as ctor, ernie_base, ernie_tiny)
+            cfg = ernie_base() if on_tpu else ernie_tiny()
+        model = ctor(cfg)
+        B = int(os.environ.get("BENCH_BATCH", 16 if on_tpu else 2))
+        S = int(os.environ.get("BENCH_SEQ", 512 if on_tpu else 64))
+        ids = paddle.to_tensor(
+            rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32))
+        step_fn = lambda i, l: model.loss(i, l)
+        args = (ids, ids)
+        items = B * S
+        unit = "tokens/s/chip"
+        n_params = sum(int(np.prod(t.shape)) for t in model.parameters())
+        flops_per_step = (6 * n_params + 12 * cfg.num_hidden_layers
+                          * cfg.hidden_size * S) * items
+    elif size == "resnet50":
+        from paddle_tpu.vision.models import resnet50
+        model = resnet50(num_classes=1000)
+        B = int(os.environ.get("BENCH_BATCH", 64 if on_tpu else 2))
+        HW = int(os.environ.get("BENCH_IMG", 224 if on_tpu else 64))
+        img = paddle.to_tensor(
+            rng.standard_normal((B, 3, HW, HW)).astype(np.float32))
+        lbl = paddle.to_tensor(rng.integers(0, 1000, (B,)).astype(np.int32))
+        step_fn = lambda x, y: nn.functional.cross_entropy(model(x), y)
+        args = (img, lbl)
+        items = B
+        unit = "images/s/chip"
+        flops_per_step = 3.0 * _measured_fwd_flops(model, img.data)
+    elif size == "unet":
+        from paddle_tpu.models.unet import UNet2DConditionModel, unet_tiny
+        if on_tpu:
+            model = UNet2DConditionModel(
+                block_out_channels=(128, 256, 512, 512),
+                cross_attention_dim=512, sample_size=32)
+        else:
+            model = UNet2DConditionModel(unet_tiny())
+        cfgm = model.cfg
+        B = int(os.environ.get("BENCH_BATCH", 8 if on_tpu else 1))
+        sz = cfgm.sample_size
+        x = paddle.to_tensor(rng.standard_normal(
+            (B, cfgm.in_channels, sz, sz)).astype(np.float32))
+        t = paddle.to_tensor(rng.integers(0, 1000, (B,)).astype(np.int32))
+        ctx = paddle.to_tensor(rng.standard_normal(
+            (B, 16, cfgm.cross_attention_dim)).astype(np.float32))
+        noise = paddle.to_tensor(rng.standard_normal(
+            x.shape).astype(np.float32))
+        step_fn = lambda x, t, c, n: nn.functional.mse_loss(
+            model(x, t, c), n)
+        args = (x, t, ctx, noise)
+        items = B
+        unit = "images/s/chip"
+        flops_per_step = 3.0 * _measured_fwd_flops(
+            model, x.data, t.data, ctx.data)
+    else:
+        raise ValueError(f"unknown BENCH_MODEL {size}")
+
+    opt = popt.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                     weight_decay=0.01)
+    step = paddle.jit.TrainStep(model, opt, step_fn)
+    dt, last, n_compiles = _time_steps(step, args, steps)
+
+    n_chips = len(devs)
+    rate = items * steps / dt / n_chips
+    peak = _peak_flops(devs[0])
+    mfu = (flops_per_step * steps / dt / n_chips / peak) if peak else 0.0
+    print(json.dumps({
+        "metric": f"{size}_train_{unit.replace('/s/chip', '')}_per_sec_per_chip",
+        "value": round(rate, 2), "unit": unit,
+        "vs_baseline": round(mfu / 0.50, 4) if peak else 0.0,
+        "extra": {"mfu": round(mfu, 4), "loss": round(last, 4),
+                  "steps": steps, "n_chips": n_chips,
+                  "compiles_in_timed_loop": n_compiles,
+                  "device": getattr(devs[0], "device_kind",
+                                    devs[0].platform)},
+    }))
+
+
 def main():
     import numpy as np
 
@@ -120,6 +266,10 @@ def main():
     else:
         default_model = "tiny"
     size = os.environ.get("BENCH_MODEL", default_model)
+    if size in ("bert", "ernie", "resnet50", "unet"):
+        # BASELINE.md configs 1/2/4/5 — measurement harness parity
+        _bench_other(size, devs, on_tpu)
+        return
     # remat trades ~1/3 extra forward FLOPs for activation memory; models
     # that fit without it should skip it (BENCH_REMAT=1 forces it on)
     remat_default = size == "7b"
@@ -154,27 +304,10 @@ def main():
     ids = paddle.to_tensor(
         rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
 
-    # warmup until the jit cache stops growing: the state tree widens twice
-    # (optimizer moments, then master weights), each widening = a recompile;
-    # the timed loop must see zero compiles
-    prev_cache = -1
-    warmup = 0
-    while warmup < 6:
-        loss = step(ids, ids)
-        warmup += 1
-        cache = getattr(step._compiled, "_cache_size", lambda: None)()
-        if cache is not None and cache == prev_cache and warmup >= 3:
-            break
-        prev_cache = cache
-    float(loss.numpy())
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(ids, ids)
-    last = float(loss.numpy())  # blocks until all steps complete
-    dt = time.perf_counter() - t0
-    n_compiles_timed = (getattr(step._compiled, "_cache_size",
-                                lambda: None)() or 0) - (prev_cache or 0)
+    # warmup-until-cache-stable + timing shared with _bench_other: the
+    # state tree widens twice (moments, then master weights), each
+    # widening = a recompile; the timed loop must see zero compiles
+    dt, last, n_compiles_timed = _time_steps(step, (ids, ids), steps)
 
     n_chips = len(devs)
     tokens = batch * seq * steps

@@ -114,4 +114,17 @@ class PyLayer:
 
 
 __all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
-           "set_grad_enabled", "PyLayer", "PyLayerContext"]
+           "set_grad_enabled", "PyLayer", "PyLayerContext", "jacobian", "hessian"]
+
+
+def jacobian(func, xs, is_batched=False):
+    """ref: python/paddle/autograd/autodiff.py::jacobian — function-based
+    lazy Jacobian (see incubate.autograd.Jacobian)."""
+    from ..incubate.autograd import Jacobian
+    return Jacobian(func, xs, is_batched=is_batched)
+
+
+def hessian(func, xs):
+    """ref: autodiff.py::hessian — function-based lazy Hessian."""
+    from ..incubate.autograd import Hessian
+    return Hessian(func, xs)

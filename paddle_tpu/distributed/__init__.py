@@ -26,6 +26,7 @@ from .sharding import (  # noqa: F401
     reshard, shard_tensor, to_placements, with_partial_annotation,
 )
 from . import fleet  # noqa: F401
+from .fleet.utils.recompute import recompute  # noqa: F401
 from . import ps  # noqa: F401
 from . import communication  # noqa: F401
 from . import watchdog  # noqa: F401

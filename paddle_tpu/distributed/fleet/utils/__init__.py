@@ -1,4 +1,5 @@
 """fleet.utils (ref: python/paddle/distributed/fleet/utils/)."""
 from . import sequence_parallel_utils  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
 
-__all__ = ["sequence_parallel_utils"]
+__all__ = ["sequence_parallel_utils", "recompute", "recompute_sequential"]

@@ -1,0 +1,91 @@
+"""Activation recompute / checkpointing (ref: python/paddle/distributed/
+fleet/utils/recompute.py — recompute(function, *args) re-runs the
+function's forward during backward instead of storing activations;
+recompute_sequential applies it per segment).
+
+TPU-native: `jax.checkpoint` IS this feature at the XLA level. A Layer's
+parameters are closure state the tape can't see, so the wrapper runs the
+Layer functionally (use_state, the same pattern as jit.save): parameters
+become explicit tape args, the whole segment body is one checkpointed op,
+and grads flow to both inputs and parameters while the segment's
+intermediate activations are rematerialized on backward.
+preserve_rng_state is inherent — the tape threads RNG keys functionally,
+so the recomputed forward sees identical randomness."""
+from __future__ import annotations
+
+import jax
+
+from ....autograd.tape import apply_op
+from ....framework import core
+from ....tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, use_reentrant: bool = True,
+              preserve_rng_state: bool = True, **kwargs):
+    """ref: fleet/utils/recompute.py::recompute(function, *args).
+    `function` is typically a Layer (its parameters get gradients); a
+    plain callable works too when it only closes over constants."""
+    tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    inputs = [args[i] for i in tensor_pos]
+
+    is_layer = hasattr(function, "state_dict") and hasattr(function,
+                                                           "use_state")
+    if is_layer:
+        sd = function.state_dict()
+        keys = list(sd.keys())
+        param_tensors = list(sd.values())
+    else:
+        keys, param_tensors = [], []
+    n_params = len(param_tensors)
+
+    def arr_fn(*arrays):
+        p_arrays = arrays[:n_params]
+        in_arrays = arrays[n_params:]
+        it = iter(in_arrays)
+        call_args = [Tensor(next(it)) if i in tensor_pos else a
+                     for i, a in enumerate(args)]
+
+        def run():
+            out = function(*call_args, **kwargs)
+            if isinstance(out, Tensor):
+                return out.data
+            if isinstance(out, (list, tuple)):
+                return tuple(o.data if isinstance(o, Tensor) else o
+                             for o in out)
+            return out
+
+        if is_layer:
+            # functional state + no inner tape: the OUTER vjp over this
+            # op differentiates params and inputs together
+            with function.use_state(dict(zip(keys, p_arrays))), \
+                    core.no_grad_guard():
+                return run()
+        with core.no_grad_guard():
+            return run()
+
+    ckpt = jax.checkpoint(arr_fn)
+    datas = [t.data for t in param_tensors] + [t.data for t in inputs]
+    out_aval = jax.eval_shape(arr_fn, *datas)
+    n_out = len(out_aval) if isinstance(out_aval, tuple) else 1
+    return apply_op(ckpt, *param_tensors, *inputs, n_outputs=n_out,
+                    name="recompute")
+
+
+def recompute_sequential(ctx: dict, functions, *args, **kwargs):
+    """ref: recompute_sequential — run a Sequential's sublayers in
+    `segments` chunks, each chunk one recomputed segment."""
+    from ....nn import Sequential
+
+    segments = int((ctx or {}).get("segments", 1))
+    layers = list(functions)
+    per = max(len(layers) // max(segments, 1), 1)
+    chunks = [layers[i:i + per] for i in range(0, len(layers), per)]
+
+    out = args
+    for chunk in chunks:
+        seg = chunk[0] if len(chunk) == 1 else Sequential(*chunk)
+        res = recompute(seg, *out, **kwargs)
+        out = res if isinstance(res, tuple) else (res,)
+    return out if len(out) > 1 else out[0]

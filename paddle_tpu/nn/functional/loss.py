@@ -407,6 +407,10 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         raise NotImplementedError(
             "custom path_table/path_code trees are not supported; the "
             "default complete-binary-tree mode covers the dense API")
+    if is_sparse:
+        raise NotImplementedError(
+            "is_sparse=True (sparse row-wise weight updates) is the "
+            "reference's PS path; gradients here are dense")
     nodes, codes, mask = _hsig_paths(int(num_classes))
     args = [to_tensor_like(input), to_tensor_like(label),
             to_tensor_like(weight)]
@@ -415,9 +419,9 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
 
     def f(x, lbl, w, *b):
         lbl = lbl.reshape(-1).astype(jnp.int32)
-        nsel = jnp.asarray(nodes)[lbl]
-        csel = jnp.asarray(codes)[lbl].astype(jnp.float32)
-        msel = jnp.asarray(mask)[lbl]
+        nsel = nodes[lbl]
+        csel = codes[lbl].astype(jnp.float32)
+        msel = mask[lbl]
         wsel = w[nsel]                    # [B, depth, F]
         logits = jnp.einsum("bf,bdf->bd", x.astype(jnp.float32),
                             wsel.astype(jnp.float32))
@@ -436,8 +440,9 @@ import functools as _functools
 @_functools.lru_cache(maxsize=64)
 def _hsig_paths(num_classes):
     """Per-class (internal-node index, left/right bit, valid mask) paths
-    of the complete binary tree (heap numbering). Cached — rebuilding a
-    100k-class table per step would dominate the loss itself."""
+    of the complete binary tree (heap numbering), as DEVICE arrays.
+    Cached — rebuilding/re-uploading a 100k-class table per step would
+    dominate the loss itself."""
     import math as _m
     depth = int(_m.ceil(_m.log2(max(num_classes, 2))))
     codes = np.zeros((num_classes, depth), np.int32)
@@ -454,7 +459,7 @@ def _hsig_paths(num_classes):
             nodes[c, d] = n - 1
             codes[c, d] = bit
             mask[c, d] = 1.0
-    return nodes, codes, mask
+    return jnp.asarray(nodes), jnp.asarray(codes), jnp.asarray(mask)
 
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,

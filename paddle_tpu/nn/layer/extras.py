@@ -156,6 +156,20 @@ class RNNTLoss(Layer):
         self.reduction = reduction
 
     def forward(self, input, label, input_lengths=None, label_lengths=None):
+        if input_lengths is not None or label_lengths is not None:
+            # padded batches: delegate to the length-aware functional form
+            from ..functional.loss import rnnt_loss as _f_rnnt
+            import numpy as _np
+            B = input.shape[0]
+            T = input.shape[1]
+            U = input.shape[2] - 1
+            il = input_lengths if input_lengths is not None else \
+                _np.full((B,), T, _np.int64)
+            ll = label_lengths if label_lengths is not None else \
+                _np.full((B,), U, _np.int64)
+            return _f_rnnt(input, label, il, ll, blank=0,
+                           fastemit_lambda=0.0, reduction=self.reduction)
+
         def f(x, lbl):
             logp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
             B, T, U1, V = logp.shape

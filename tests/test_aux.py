@@ -287,3 +287,54 @@ class TestStringTensor:
         e = strings_empty((3,))
         assert e.tolist() == ["", "", ""]
         assert strings_empty_like(e).shape == (3,)
+
+
+class TestRecompute:
+    """ref: fleet/utils/recompute.py — activation checkpointing."""
+
+    def test_grads_match_plain_forward(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.fleet.utils import recompute
+
+        paddle.seed(0)
+        block = nn.Sequential(nn.Linear(6, 12), nn.Tanh(), nn.Linear(12, 6))
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (3, 6)).astype(np.float32))
+
+        out_plain = block(x)
+        out_plain.sum().backward()
+        g_plain = {n: p.grad.numpy().copy()
+                   for n, p in block.named_parameters()}
+        for p in block.parameters():
+            p.grad = None
+
+        out_rc = recompute(block, x)
+        np.testing.assert_allclose(out_rc.numpy(), out_plain.numpy(),
+                                   atol=1e-6)
+        out_rc.sum().backward()
+        for n, p in block.named_parameters():
+            np.testing.assert_allclose(p.grad.numpy(), g_plain[n],
+                                       atol=1e-5, err_msg=n)
+
+    def test_recompute_sequential_segments(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.fleet.utils import recompute_sequential
+
+        paddle.seed(1)
+        layers = [nn.Linear(4, 4), nn.ReLU(), nn.Linear(4, 4)]
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        out = recompute_sequential({"segments": 2}, layers, x)
+        want = x
+        for l in layers:
+            want = l(want)
+        np.testing.assert_allclose(out.numpy(), want.numpy(), atol=1e-6)
+
+    def test_autograd_jacobian_alias(self):
+        J = paddle.autograd.jacobian(lambda x: x * 3,
+                                     paddle.to_tensor(
+                                         np.ones(2, np.float32)))
+        np.testing.assert_allclose(J.numpy(), np.eye(2) * 3)
+        H = paddle.autograd.hessian(lambda x: (x ** 2).sum(),
+                                    paddle.to_tensor(
+                                        np.ones(2, np.float32)))
+        np.testing.assert_allclose(H.numpy(), np.eye(2) * 2)
