@@ -11,10 +11,12 @@ Two regimes, mirroring SURVEY §5's TPU mapping:
 """
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..tensor import Tensor
 from ..ops._helpers import to_tensor_like, unwrap
@@ -132,16 +134,191 @@ def barrier(group=None):
         pass
 
 
+# ---- host-level point-to-point (ref: communication/send.py, recv.py ->
+# ProcessGroup::Send/Recv). Device-fast p2p lives inside compiled
+# programs as lax.ppermute (the pipeline schedules); the eager API here
+# is a host-side authenticated-pickle channel between ranks — correct
+# semantics for the control-plane uses eager send/recv actually serves
+# (boundary tensors in tests, orchestration), with the perf caveat
+# documented.
+
+_p2p_listener = None
+_p2p_inbox = None
+
+
+def _p2p_auth() -> bytes:
+    """Per-job secret: multiprocessing.connection deserializes pickles
+    after HMAC auth, so a constant key in public source would hand RCE to
+    anything that can reach the port. The launcher should set
+    PADDLE_P2P_AUTHKEY; otherwise the key is derived from the job's
+    master endpoint + uid (not guessable from source alone)."""
+    secret = os.environ.get("PADDLE_P2P_AUTHKEY")
+    if secret:
+        return secret.encode()
+    seed = (os.environ.get("PADDLE_MASTER", "")
+            + os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+            + str(os.getuid() if hasattr(os, "getuid") else 0))
+    import hashlib
+    return hashlib.sha256(("paddle_tpu_p2p:" + seed).encode()).digest()
+
+
+def _p2p_port(rank: int) -> int:
+    base = int(os.environ.get("PADDLE_P2P_BASE_PORT", "29900"))
+    return base + rank
+
+
+def _p2p_host(rank: int) -> str:
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    parts = eps.split(",") if eps else []
+    if rank < len(parts):
+        return parts[rank].rsplit(":", 1)[0]
+    return "127.0.0.1"
+
+
+def _env_rank() -> int:
+    """Launcher-env rank (host channel is independent of jax.distributed)."""
+    v = os.environ.get("PADDLE_TRAINER_ID")
+    return int(v) if v is not None else jax.process_index()
+
+
+def _env_world() -> int:
+    v = os.environ.get("PADDLE_TRAINERS_NUM")
+    return int(v) if v is not None else jax.process_count()
+
+
+def _ensure_p2p_server():
+    """Lazily start this rank's listener + receiver thread."""
+    global _p2p_listener, _p2p_inbox
+    if _p2p_listener is not None:
+        return
+    import queue
+    import threading
+    from multiprocessing.connection import Listener
+
+    _p2p_inbox = queue.Queue()
+    # bind this rank's configured interface (loopback unless the launcher
+    # published endpoints) — never wildcard
+    _p2p_listener = Listener((_p2p_host(_env_rank()),
+                              _p2p_port(_env_rank())),
+                             authkey=_p2p_auth())
+
+    def loop():
+        while True:
+            try:
+                conn = _p2p_listener.accept()
+            except (OSError, EOFError):
+                return
+
+            def drain(c=conn):
+                try:
+                    while True:
+                        _p2p_inbox.put(c.recv())
+                except (EOFError, OSError):
+                    c.close()
+
+            threading.Thread(target=drain, daemon=True).start()
+
+    threading.Thread(target=loop, daemon=True).start()
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "point-to-point send/recv exist only inside shard_map pipelines "
-        "(ppermute); use paddle_tpu.distributed.fleet pipeline APIs")
+    """ref: paddle.distributed.send — eager host-channel p2p (see note
+    above; in-program p2p is lax.ppermute via the pipeline schedules)."""
+    import time as _time
+    from multiprocessing.connection import Client
+
+    if _env_world() <= 1:
+        raise RuntimeError("send() needs a multi-process launch "
+                           "(world_size > 1)")
+    _ensure_p2p_server()          # so peers can reach this rank too
+    arr = np.asarray(unwrap(tensor))
+    last = None
+    for _ in range(100):
+        try:
+            conn = Client((_p2p_host(dst), _p2p_port(dst)),
+                          authkey=_p2p_auth())
+            conn.send((_env_rank(), arr))
+            conn.close()
+            return
+        except (ConnectionError, OSError) as e:
+            last = e
+            _time.sleep(0.1)
+    raise ConnectionError(f"send to rank {dst} failed: {last}")
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "point-to-point send/recv exist only inside shard_map pipelines "
-        "(ppermute); use paddle_tpu.distributed.fleet pipeline APIs")
+    """ref: paddle.distributed.recv — blocks for a message from `src`
+    and copies it into `tensor` (returned)."""
+    if _env_world() <= 1:
+        raise RuntimeError("recv() needs a multi-process launch "
+                           "(world_size > 1)")
+    _ensure_p2p_server()
+    import queue as _queue
+    deferred = []
+    try:
+        while True:
+            try:
+                sender, arr = _p2p_inbox.get(timeout=float(
+                    os.environ.get("PADDLE_P2P_TIMEOUT", "120")))
+            except _queue.Empty:
+                raise TimeoutError(
+                    f"recv(src={src}) timed out after "
+                    f"PADDLE_P2P_TIMEOUT — peer desync or dead sender")
+            if src is None or sender == src:
+                break
+            deferred.append((sender, arr))  # out-of-order: keep for later
+    finally:
+        for item in deferred:               # never drop other ranks' data
+            _p2p_inbox.put(item)
+    out = jnp.asarray(arr)
+    if isinstance(tensor, Tensor):
+        tensor.data = out.reshape(tensor.data.shape).astype(
+            tensor.data.dtype)
+        return tensor
+    return Tensor(out)
+
+
+class _P2PTask:
+    """ref: the waitable task isend/irecv return (task.wait())."""
+
+    def __init__(self, thread, box):
+        self._thread = thread
+        self._box = box
+
+    def wait(self):
+        self._thread.join()
+        if "err" in self._box:
+            raise self._box["err"]
+        return self._box.get("out")
+
+    def is_completed(self):
+        return not self._thread.is_alive()
+
+
+def _async(fn, *args, **kw):
+    import threading
+    box = {}
+
+    def run():
+        try:
+            box["out"] = fn(*args, **kw)
+        except Exception as e:
+            box["err"] = e
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    return _P2PTask(th, box)
+
+
+def isend(tensor, dst=0, group=None):
+    """ref: paddle.distributed.isend — returns a waitable task."""
+    return _async(send, tensor, dst=dst, group=group)
+
+
+def irecv(tensor, src=0, group=None):
+    """ref: paddle.distributed.irecv — returns a waitable task; the
+    received data lands in `tensor` (also task.wait()'s return)."""
+    return _async(recv, tensor, src=src, group=group)
 
 
 def new_group(ranks=None, backend=None, timeout=None):

@@ -29,6 +29,12 @@ def recompute(function, *args, use_reentrant: bool = True,
     plain callable works too when it only closes over constants."""
     tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
     inputs = [args[i] for i in tensor_pos]
+    for k, v in kwargs.items():
+        if isinstance(v, Tensor) and not v.stop_gradient:
+            raise ValueError(
+                f"recompute: differentiable Tensor kwarg '{k}' would be "
+                "closed over and receive no gradient — pass it "
+                "positionally")
 
     is_layer = hasattr(function, "state_dict") and hasattr(function,
                                                            "use_state")
@@ -80,7 +86,8 @@ def recompute_sequential(ctx: dict, functions, *args, **kwargs):
 
     segments = int((ctx or {}).get("segments", 1))
     layers = list(functions)
-    per = max(len(layers) // max(segments, 1), 1)
+    n_seg = max(min(segments, len(layers)), 1)
+    per = -(-len(layers) // n_seg)        # ceil: at most `segments` chunks
     chunks = [layers[i:i + per] for i in range(0, len(layers), per)]
 
     out = args

@@ -154,6 +154,7 @@ class RNNTLoss(Layer):
         super().__init__()
         assert blank == 0, "this implementation fixes blank=0"
         self.reduction = reduction
+        self.fastemit_lambda = fastemit_lambda
 
     def forward(self, input, label, input_lengths=None, label_lengths=None):
         if input_lengths is not None or label_lengths is not None:
@@ -168,7 +169,8 @@ class RNNTLoss(Layer):
             ll = label_lengths if label_lengths is not None else \
                 _np.full((B,), U, _np.int64)
             return _f_rnnt(input, label, il, ll, blank=0,
-                           fastemit_lambda=0.0, reduction=self.reduction)
+                           fastemit_lambda=self.fastemit_lambda,
+                           reduction=self.reduction)
 
         def f(x, lbl):
             logp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)

@@ -1,0 +1,41 @@
+"""Worker for the eager send/recv p2p test: rank 0 sends a tensor to
+rank 1 (and receives an ack tensor back)."""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import distributed as dist  # noqa: E402
+
+
+def main():
+    out_dir = sys.argv[1]
+    base_port = sys.argv[2]
+    os.environ["PADDLE_P2P_BASE_PORT"] = base_port
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    payload = np.arange(6, dtype=np.float32).reshape(2, 3)
+    if rank == 0:
+        dist.collective.send(paddle.to_tensor(payload * 10), dst=1)
+        ack = dist.collective.recv(paddle.zeros([2, 3]), src=1)
+        got = np.asarray(ack.numpy())
+        assert np.allclose(got, payload * 10 + 1), got
+    else:
+        buf = paddle.zeros([2, 3])
+        dist.collective.recv(buf, src=0)
+        got = np.asarray(buf.numpy())
+        assert np.allclose(got, payload * 10), got
+        dist.collective.send(paddle.to_tensor(got + 1), dst=0)
+
+    with open(os.path.join(out_dir, f"p2p_ok_{rank}"), "w") as f:
+        f.write("ok")
+
+
+if __name__ == "__main__":
+    main()

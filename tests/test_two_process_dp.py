@@ -57,3 +57,42 @@ def test_two_process_dp_matches_single():
             with open(marker) as f:
                 vals.append(f.read())
         assert vals[0] == vals[1], vals
+
+
+def _free_port_pair():
+    """Two consecutive free ports (rank r binds base+r)."""
+    for _ in range(50):
+        base = _free_port()
+        try:
+            s = socket.socket()
+            s.bind(("127.0.0.1", base + 1))
+            s.close()
+            return base
+        except OSError:
+            continue
+    raise RuntimeError("no consecutive free port pair found")
+
+
+@pytest.mark.timeout(120)
+def test_two_process_send_recv():
+    """Eager host-channel p2p (paddle.distributed.send/recv)."""
+    base_port = _free_port_pair()
+    worker = os.path.join(REPO, "tests", "collective", "p2p_worker.py")
+    with tempfile.TemporaryDirectory() as d:
+        procs = []
+        for rank in range(2):
+            env = dict(os.environ)
+            env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+            env["PADDLE_TRAINER_ID"] = str(rank)
+            env["PADDLE_TRAINERS_NUM"] = "2"
+            procs.append(subprocess.Popen(
+                [sys.executable, worker, d, str(base_port)],
+                env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=90)
+            outs.append(out.decode(errors="replace"))
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {rank} failed:\n{out[-2000:]}"
+            assert os.path.exists(os.path.join(d, f"p2p_ok_{rank}"))
