@@ -155,11 +155,27 @@ def _p2p_auth() -> bytes:
     secret = os.environ.get("PADDLE_P2P_AUTHKEY")
     if secret:
         return secret.encode()
-    seed = (os.environ.get("PADDLE_MASTER", "")
-            + os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
-            + str(os.getuid() if hasattr(os, "getuid") else 0))
-    import hashlib
-    return hashlib.sha256(("paddle_tpu_p2p:" + seed).encode()).digest()
+    job = (os.environ.get("PADDLE_MASTER", "")
+           + os.environ.get("PADDLE_TRAINER_ENDPOINTS", ""))
+    if job:
+        import hashlib
+        return hashlib.sha256(("paddle_tpu_p2p:" + job).encode()).digest()
+    # bare local runs: a same-user secret file (0600) — other local users
+    # cannot read it, unlike anything derivable from uid/source
+    import secrets
+    path = os.path.join(os.path.expanduser("~"), ".paddle_tpu_p2p_key")
+    try:
+        with open(path, "rb") as f:
+            key = f.read()
+        if len(key) >= 16:
+            return key
+    except OSError:
+        pass
+    key = secrets.token_bytes(32)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "wb") as f:
+        f.write(key)
+    return key
 
 
 def _p2p_port(rank: int) -> int:
@@ -187,15 +203,19 @@ def _env_world() -> int:
 
 
 def _ensure_p2p_server():
-    """Lazily start this rank's listener + receiver thread."""
+    """Lazily start this rank's listener + receiver thread. Messages are
+    routed into PER-SENDER FIFO queues at drain time, so concurrent
+    recv() calls for different sources neither steal each other's
+    messages nor reorder a single sender's stream."""
     global _p2p_listener, _p2p_inbox
     if _p2p_listener is not None:
         return
+    import collections
     import queue
     import threading
     from multiprocessing.connection import Listener
 
-    _p2p_inbox = queue.Queue()
+    _p2p_inbox = collections.defaultdict(queue.Queue)
     # bind this rank's configured interface (loopback unless the launcher
     # published endpoints) — never wildcard
     _p2p_listener = Listener((_p2p_host(_env_rank()),
@@ -212,7 +232,8 @@ def _ensure_p2p_server():
             def drain(c=conn):
                 try:
                     while True:
-                        _p2p_inbox.put(c.recv())
+                        sender, arr = c.recv()
+                        _p2p_inbox[int(sender)].put(arr)
                 except (EOFError, OSError):
                     c.close()
 
@@ -233,7 +254,11 @@ def send(tensor, dst=0, group=None, sync_op=True):
     _ensure_p2p_server()          # so peers can reach this rank too
     arr = np.asarray(unwrap(tensor))
     last = None
-    for _ in range(100):
+    # retry until the peer's (lazily started) listener is up, bounded by
+    # the same timeout the receive side honors
+    deadline = _time.monotonic() + float(
+        os.environ.get("PADDLE_P2P_TIMEOUT", "120"))
+    while _time.monotonic() < deadline:
         try:
             conn = Client((_p2p_host(dst), _p2p_port(dst)),
                           authkey=_p2p_auth())
@@ -254,22 +279,31 @@ def recv(tensor, src=0, group=None, sync_op=True):
                            "(world_size > 1)")
     _ensure_p2p_server()
     import queue as _queue
-    deferred = []
-    try:
-        while True:
-            try:
-                sender, arr = _p2p_inbox.get(timeout=float(
-                    os.environ.get("PADDLE_P2P_TIMEOUT", "120")))
-            except _queue.Empty:
-                raise TimeoutError(
-                    f"recv(src={src}) timed out after "
-                    f"PADDLE_P2P_TIMEOUT — peer desync or dead sender")
-            if src is None or sender == src:
-                break
-            deferred.append((sender, arr))  # out-of-order: keep for later
-    finally:
-        for item in deferred:               # never drop other ranks' data
-            _p2p_inbox.put(item)
+    import time as _time
+    timeout = float(os.environ.get("PADDLE_P2P_TIMEOUT", "120"))
+    if src is not None:
+        try:
+            arr = _p2p_inbox[int(src)].get(timeout=timeout)
+        except _queue.Empty:
+            raise TimeoutError(
+                f"recv(src={src}) timed out after {timeout}s — peer "
+                "desync or dead sender")
+    else:
+        # any-source: poll the per-sender queues round-robin
+        deadline = _time.monotonic() + timeout
+        arr = None
+        while arr is None:
+            for q in list(_p2p_inbox.values()):
+                try:
+                    arr = q.get_nowait()
+                    break
+                except _queue.Empty:
+                    continue
+            if arr is None:
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"recv(src=None) timed out after {timeout}s")
+                _time.sleep(0.005)
     out = jnp.asarray(arr)
     if isinstance(tensor, Tensor):
         tensor.data = out.reshape(tensor.data.shape).astype(

@@ -40,6 +40,12 @@ def _parse(argv):
     p.add_argument("--devices", default=None,
                    help="visible TPU chips, e.g. '0,1,2,3'")
     p.add_argument("--elastic_level", type=int, default=0)
+    p.add_argument("--auto_tuner_json", default=None,
+                   help="ref distributed/launch + auto_tuner: JSON config "
+                        "driving a launch-level grid search — each pruned "
+                        "candidate config is run once as a trial (env "
+                        "PADDLE_AUTO_TUNER_CONFIG), ranked by the metric "
+                        "the script writes to PADDLE_AUTO_TUNER_METRIC_FILE")
     p.add_argument("script", help="training script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -59,9 +65,79 @@ def _bootstrap_env(args):
     return env
 
 
+def _auto_tune(args, env):
+    """Launch-level auto-tuning (ref: distributed/auto_tuner/tuner.py:21 —
+    the reference relaunches the training job once per candidate config
+    and keeps the best): candidates come from the mesh-factorization
+    generator + divisibility pruning; each trial runs `script` once with
+    the candidate as PADDLE_AUTO_TUNER_CONFIG; the script reports its
+    metric (e.g. step time) by writing a float to
+    PADDLE_AUTO_TUNER_METRIC_FILE. Returns the winning config (also
+    exported to the final training env)."""
+    import json
+    import tempfile
+
+    from ..auto_tuner import default_candidates, prune_by_divisibility
+
+    if args.nnodes > 1:
+        # each node tuning independently on noisy local metrics would pick
+        # divergent configs and desync the mesh at the first collective;
+        # tune single-node, then pass the winner explicitly
+        raise SystemExit(
+            "--auto_tuner_json is single-node: run the sweep with "
+            "--nnodes 1, then launch multi-node with the chosen config "
+            "in PADDLE_AUTO_TUNER_CONFIG")
+    with open(args.auto_tuner_json) as f:
+        spec = json.load(f)
+    n_dev = int(spec.get("n_devices", args.nnodes))
+    cands = default_candidates(
+        n_dev, max_mp=spec.get("max_mp", 8), max_pp=spec.get("max_pp", 8))
+    cands = prune_by_divisibility(
+        cands, hidden_size=spec.get("hidden_size"),
+        num_heads=spec.get("num_heads"),
+        num_layers=spec.get("num_layers"),
+        global_batch=spec.get("global_batch"))
+    max_trials = int(spec.get("max_trials", len(cands)))
+    mode = spec.get("metric_mode", "min")
+    results = []
+    for cfg in cands[:max_trials]:
+        with tempfile.NamedTemporaryFile("r", suffix=".metric",
+                                         delete=False) as mf:
+            metric_path = mf.name
+        trial_env = dict(env)
+        trial_env["PADDLE_AUTO_TUNER_CONFIG"] = json.dumps(cfg)
+        trial_env["PADDLE_AUTO_TUNER_METRIC_FILE"] = metric_path
+        cmd = [sys.executable, args.script] + args.script_args
+        proc = subprocess.Popen(cmd, env=trial_env)
+        rc = proc.wait()
+        metric = None
+        if rc == 0:
+            try:
+                with open(metric_path) as f:
+                    metric = float(f.read().strip())
+            except (OSError, ValueError):
+                pass
+        os.unlink(metric_path)
+        results.append((cfg, metric))
+        print(f"auto_tuner trial {cfg}: rc={rc} metric={metric}",
+              file=sys.stderr)
+    ok = [(c, m) for c, m in results if m is not None]
+    if not ok:
+        print("auto_tuner: no successful trial; launching with defaults",
+              file=sys.stderr)
+        return None
+    best = (max if mode == "max" else min)(ok, key=lambda cm: cm[1])[0]
+    print(f"auto_tuner: best config {best}", file=sys.stderr)
+    env["PADDLE_AUTO_TUNER_CONFIG"] = json.dumps(best)
+    env.pop("PADDLE_AUTO_TUNER_METRIC_FILE", None)
+    return best
+
+
 def launch(argv=None):
     args = _parse(argv if argv is not None else sys.argv[1:])
     env = _bootstrap_env(args)
+    if args.auto_tuner_json:
+        _auto_tune(args, env)
     cmd = [sys.executable, args.script] + args.script_args
     restarts = 0
     while True:

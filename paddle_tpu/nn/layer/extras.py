@@ -154,7 +154,12 @@ class RNNTLoss(Layer):
         super().__init__()
         assert blank == 0, "this implementation fixes blank=0"
         self.reduction = reduction
-        self.fastemit_lambda = fastemit_lambda
+        if fastemit_lambda:
+            import warnings
+            warnings.warn(
+                "RNNTLoss: fastemit_lambda is accepted for API parity but "
+                "the FastEmit term is not implemented — losses are the "
+                "plain RNNT NLL on every path", UserWarning)
 
     def forward(self, input, label, input_lengths=None, label_lengths=None):
         if input_lengths is not None or label_lengths is not None:
@@ -168,9 +173,10 @@ class RNNTLoss(Layer):
                 _np.full((B,), T, _np.int64)
             ll = label_lengths if label_lengths is not None else \
                 _np.full((B,), U, _np.int64)
+            # both layer paths compute the plain NLL (ctor warned about
+            # fastemit once); lambda=0.0 keeps the functional quiet
             return _f_rnnt(input, label, il, ll, blank=0,
-                           fastemit_lambda=self.fastemit_lambda,
-                           reduction=self.reduction)
+                           fastemit_lambda=0.0, reduction=self.reduction)
 
         def f(x, lbl):
             logp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)

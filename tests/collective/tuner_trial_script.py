@@ -1,0 +1,19 @@
+"""Trial script for the launch-level auto-tuner test: reports a synthetic
+step-time metric minimized at mp=2 (so the tuner must pick it), then
+on the final (post-tuning) launch writes the chosen config."""
+import json
+import os
+import sys
+
+cfg = json.loads(os.environ.get("PADDLE_AUTO_TUNER_CONFIG", "{}"))
+metric_file = os.environ.get("PADDLE_AUTO_TUNER_METRIC_FILE")
+if metric_file:
+    # synthetic cost: best at mp=2, pp=1, micro=1
+    cost = (abs(cfg.get("mp_degree", 1) - 2) * 10
+            + (cfg.get("pp_degree", 1) - 1) * 5
+            + cfg.get("micro_batch_size", 1))
+    with open(metric_file, "w") as f:
+        f.write(str(float(cost)))
+else:
+    with open(sys.argv[1], "w") as f:
+        json.dump(cfg, f)

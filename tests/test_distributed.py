@@ -77,3 +77,38 @@ def test_graft_entry_dryrun():
     out = jax.jit(fn)(*args)
     assert out.shape[0] == 2
     g.dryrun_multichip(8)
+
+
+class TestLaunchAutoTuner:
+    """ref: distributed/auto_tuner launch-level grid search (tuner.py:21
+    relaunch-per-candidate) via `launch --auto_tuner_json`."""
+
+    def test_tuner_picks_best_config_and_exports_it(self, tmp_path):
+        import json
+        import os
+        import subprocess
+        import sys
+
+        spec = {"n_devices": 4, "num_heads": 4, "hidden_size": 64,
+                "num_layers": 4, "global_batch": 8, "max_trials": 20,
+                "metric_mode": "min", "max_mp": 2, "max_pp": 1}
+        spec_path = tmp_path / "tuner.json"
+        spec_path.write_text(json.dumps(spec))
+        out_path = tmp_path / "chosen.json"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = os.path.join(repo, "tests", "collective",
+                              "tuner_trial_script.py")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        rc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--auto_tuner_json", str(spec_path), "--max_restart", "0",
+             script, str(out_path)],
+            env=env, cwd=repo, capture_output=True, text=True, timeout=300)
+        assert rc.returncode == 0, rc.stderr[-2000:]
+        chosen = json.loads(out_path.read_text())
+        # synthetic cost is minimized at mp=2, pp=1, micro=1
+        assert chosen["mp_degree"] == 2, chosen
+        assert chosen["pp_degree"] == 1, chosen
+        assert chosen["micro_batch_size"] == 1, chosen
+        assert "best config" in rc.stderr
