@@ -161,21 +161,38 @@ def _p2p_auth() -> bytes:
         import hashlib
         return hashlib.sha256(("paddle_tpu_p2p:" + job).encode()).digest()
     # bare local runs: a same-user secret file (0600) — other local users
-    # cannot read it, unlike anything derivable from uid/source
+    # cannot read it, unlike anything derivable from uid/source. Creation
+    # is atomic (temp + rename) and creation races settle by re-reading,
+    # so concurrent ranks always converge on ONE key and a live
+    # listener's key is never clobbered.
     import secrets
+    import tempfile
     path = os.path.join(os.path.expanduser("~"), ".paddle_tpu_p2p_key")
-    try:
-        with open(path, "rb") as f:
-            key = f.read()
-        if len(key) >= 16:
-            return key
-    except OSError:
-        pass
-    key = secrets.token_bytes(32)
-    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
-    with os.fdopen(fd, "wb") as f:
-        f.write(key)
-    return key
+    for _ in range(10):
+        try:
+            with open(path, "rb") as f:
+                key = f.read()
+            if len(key) >= 16:
+                return key
+        except OSError:
+            pass
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                   prefix=".p2p_key_")
+        try:
+            os.fchmod(fd, 0o600)
+            with os.fdopen(fd, "wb") as f:
+                f.write(secrets.token_bytes(32))
+            # O_EXCL-style: only create if absent; losers re-read winner's
+            try:
+                os.link(tmp, path)
+            except FileExistsError:
+                pass
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    raise RuntimeError(f"could not establish p2p key file at {path}")
 
 
 def _p2p_port(rank: int) -> int:
@@ -210,12 +227,24 @@ def _ensure_p2p_server():
     global _p2p_listener, _p2p_inbox
     if _p2p_listener is not None:
         return
-    import collections
     import queue
     import threading
     from multiprocessing.connection import Listener
 
-    _p2p_inbox = collections.defaultdict(queue.Queue)
+    class _SenderQueues(dict):
+        """Lock-guarded per-sender queues: a drain thread and a recv
+        thread racing on the same new sender must converge on ONE
+        Queue (a bare defaultdict miss is not atomic)."""
+
+        _lock = threading.Lock()
+
+        def __missing__(self, k):
+            with self._lock:
+                if k not in self:
+                    dict.__setitem__(self, k, queue.Queue())
+                return dict.__getitem__(self, k)
+
+    _p2p_inbox = _SenderQueues()
     # bind this rank's configured interface (loopback unless the launcher
     # published endpoints) — never wildcard
     _p2p_listener = Listener((_p2p_host(_env_rank()),
@@ -265,7 +294,10 @@ def send(tensor, dst=0, group=None, sync_op=True):
             conn.send((_env_rank(), arr))
             conn.close()
             return
-        except (ConnectionError, OSError) as e:
+        except (ConnectionError, OSError,
+                __import__("multiprocessing").AuthenticationError) as e:
+            # AuthenticationError can be transient too: a peer mid-way
+            # through creating the shared key file
             last = e
             _time.sleep(0.1)
     raise ConnectionError(f"send to rank {dst} failed: {last}")

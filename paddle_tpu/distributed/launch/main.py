@@ -89,7 +89,14 @@ def _auto_tune(args, env):
             "in PADDLE_AUTO_TUNER_CONFIG")
     with open(args.auto_tuner_json) as f:
         spec = json.load(f)
-    n_dev = int(spec.get("n_devices", args.nnodes))
+    if "n_devices" not in spec:
+        # the launcher must not touch jax (a wedged accelerator backend
+        # would hang it), so there is no safe default — require it
+        raise SystemExit(
+            "auto_tuner spec must set 'n_devices' (the mesh size to "
+            "factorize); a silent 1-device default would sweep only "
+            "trivial configs")
+    n_dev = int(spec["n_devices"])
     cands = default_candidates(
         n_dev, max_mp=spec.get("max_mp", 8), max_pp=spec.get("max_pp", 8))
     cands = prune_by_divisibility(

@@ -494,7 +494,9 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
     [B, U]; lengths select each sample's (T_i, U_i) readout."""
     if blank != 0:
         raise NotImplementedError("this implementation fixes blank=0")
-    if fastemit_lambda:
+    if fastemit_lambda not in (0, 0.0, 0.001):
+        # FastEmit is NOT implemented; warn only for explicitly tuned
+        # values (the API-parity default would spam every call)
         import warnings
         warnings.warn(
             "rnnt_loss: fastemit_lambda is accepted for API parity but "

@@ -154,12 +154,17 @@ class RNNTLoss(Layer):
         super().__init__()
         assert blank == 0, "this implementation fixes blank=0"
         self.reduction = reduction
-        if fastemit_lambda:
+        self.fastemit_lambda = fastemit_lambda  # stored for introspection
+        # FastEmit is NOT implemented (losses are the plain RNNT NLL on
+        # every path); warn only when the user explicitly tuned lambda
+        # away from the API-parity default — warning on every default
+        # construction would just spam logs
+        if fastemit_lambda not in (0, 0.0, 0.001):
             import warnings
             warnings.warn(
                 "RNNTLoss: fastemit_lambda is accepted for API parity but "
                 "the FastEmit term is not implemented — losses are the "
-                "plain RNNT NLL on every path", UserWarning)
+                "plain RNNT NLL", UserWarning)
 
     def forward(self, input, label, input_lengths=None, label_lengths=None):
         if input_lengths is not None or label_lengths is not None:
