@@ -174,6 +174,12 @@ def _p2p_auth() -> bytes:
                 key = f.read()
             if len(key) >= 16:
                 return key
+            # short/corrupt file (killed writer, disk-full): self-heal by
+            # removing it so the link below can install a fresh key
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
         except OSError:
             pass
         fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
@@ -275,6 +281,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     """ref: paddle.distributed.send — eager host-channel p2p (see note
     above; in-program p2p is lax.ppermute via the pipeline schedules)."""
     import time as _time
+    from multiprocessing import AuthenticationError
     from multiprocessing.connection import Client
 
     if _env_world() <= 1:
@@ -294,8 +301,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
             conn.send((_env_rank(), arr))
             conn.close()
             return
-        except (ConnectionError, OSError,
-                __import__("multiprocessing").AuthenticationError) as e:
+        except (ConnectionError, OSError, AuthenticationError) as e:
             # AuthenticationError can be transient too: a peer mid-way
             # through creating the shared key file
             last = e

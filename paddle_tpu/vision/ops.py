@@ -20,7 +20,9 @@ from ..tensor import Tensor
 __all__ = ["nms", "matrix_nms", "roi_align", "roi_pool", "psroi_pool",
            "yolo_box", "yolo_loss", "edit_distance",
            "distribute_fpn_proposals", "box_coder", "generate_proposals",
-           "DeformConv2D", "deform_conv2d", "decode_jpeg"]
+           "DeformConv2D", "deform_conv2d", "decode_jpeg", "prior_box",
+           "read_file", "RoIAlign", "RoIPool", "PSRoIPool",
+           "ConvNormActivation"]
 
 
 def _iou_matrix(boxes):
@@ -287,9 +289,149 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
 def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
               ignore_thresh, downsample_ratio, gt_score=None,
               use_label_smooth=True, scale_x_y=1.0, name=None):
-    raise NotImplementedError(
-        "yolo_loss: train YOLO heads with the composed losses "
-        "(bce/iou) — the fused kernel shim is not provided on TPU")
+    """ref: vision/ops.py yolo_loss (phi yolo_loss kernel) — YOLOv3
+    training loss for one detection scale, fully vectorized (one-hot
+    scatter assignment, no data-dependent Python control flow).
+
+    x: [N, mask_num*(5+class_num), H, W] raw head output;
+    gt_box: [N, B, 4] (cx, cy, w, h) relative to the image;
+    gt_label: [N, B] int (< 0 or zero-area boxes = padding);
+    anchors: flat (w, h) pairs over ALL scales; anchor_mask: this scale's
+    anchor indices. Returns per-sample loss [N]:
+      xy  : sigmoid BCE against the in-cell fractional offset
+      wh  : L1 against log(gt / anchor)   (both weighted 2 - w*h)
+      obj : BCE, negatives with best-IoU > ignore_thresh excluded
+      cls : per-class BCE (optionally label-smoothed)
+    """
+    from ..autograd.tape import apply_op
+
+    na_all = len(anchors) // 2
+    an_all = np.asarray(anchors, np.float32).reshape(na_all, 2)
+    mask_idx = np.asarray(anchor_mask, np.int64)
+    M = len(mask_idx)
+    smooth = (min(1.0 / class_num, 1.0 / 40.0)
+              if use_label_smooth and class_num > 1 else 0.0)
+
+    args = [to_tensor_like(x), to_tensor_like(gt_box),
+            to_tensor_like(gt_label)]
+    if gt_score is not None:
+        args.append(to_tensor_like(gt_score))
+
+    def f(xv, gtb, gtl, *rest):
+        xv = xv.astype(jnp.float32)
+        gtb = gtb.astype(jnp.float32)
+        gtl = gtl.astype(jnp.int32)
+        N, C, H, W = xv.shape
+        Bn = gtb.shape[1]
+        in_w = W * downsample_ratio
+        in_h = H * downsample_ratio
+        score = (rest[0].astype(jnp.float32) if rest
+                 else jnp.ones((N, Bn), jnp.float32))
+
+        p = xv.reshape(N, M, 5 + class_num, H, W)
+        tx, ty = p[:, :, 0], p[:, :, 1]
+        tw, th = p[:, :, 2], p[:, :, 3]
+        tobj = p[:, :, 4]
+        tcls = p[:, :, 5:]
+
+        # ---- gt -> (anchor slot, cell) assignment ----
+        gw, gh = gtb[..., 2], gtb[..., 3]
+        valid = (gtl >= 0) & (gw > 0) & (gh > 0)          # [N, B]
+        # best anchor over ALL anchors by wh-IoU at the input resolution
+        gw_px = gw * in_w
+        gh_px = gh * in_h
+        inter = (jnp.minimum(gw_px[..., None], an_all[:, 0])
+                 * jnp.minimum(gh_px[..., None], an_all[:, 1]))
+        union = (gw_px * gh_px)[..., None] \
+            + an_all[:, 0] * an_all[:, 1] - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)
+        slot_oh = (best[..., None] == jnp.asarray(mask_idx))   # [N,B,M]
+        on_scale = valid & jnp.any(slot_oh, axis=-1)
+        slot = jnp.argmax(slot_oh, axis=-1)                    # [N, B]
+
+        gi = jnp.clip((gtb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gtb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+        tx_t = gtb[..., 0] * W - gi
+        ty_t = gtb[..., 1] * H - gj
+        aw = jnp.asarray(an_all)[jnp.asarray(mask_idx)][slot]  # [N,B,2]
+        tw_t = jnp.log(jnp.maximum(gw_px / jnp.maximum(aw[..., 0], 1e-9),
+                                   1e-9))
+        th_t = jnp.log(jnp.maximum(gh_px / jnp.maximum(aw[..., 1], 1e-9),
+                                   1e-9))
+        box_w = 2.0 - gw * gh                                  # [N, B]
+
+        # scatter per-gt targets into the [N, M, H, W] grid
+        n_idx = jnp.broadcast_to(jnp.arange(N)[:, None], (N, Bn))
+
+        def scat(values, base=0.0):
+            out = jnp.full((N, M, H, W), base, jnp.float32)
+            return out.at[n_idx, slot, gj, gi].set(
+                jnp.where(on_scale, values, base), mode="drop")
+
+        obj_t = scat(score)
+        assigned = scat(jnp.ones((N, Bn), jnp.float32)) > 0
+        w_box = scat(box_w)
+
+        def bce(logit, target):
+            return jnp.maximum(logit, 0) - logit * target + \
+                jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+        loss_xy = w_box * (bce(tx, scat(tx_t)) + bce(ty, scat(ty_t)))
+        loss_wh = w_box * (jnp.abs(tw - scat(tw_t))
+                           + jnp.abs(th - scat(th_t)))
+        loss_xy = jnp.where(assigned, loss_xy, 0.0)
+        loss_wh = jnp.where(assigned, loss_wh, 0.0)
+
+        # ---- objectness with ignore mask ----
+        # decode predicted boxes (relative) and IoU against every gt
+        bx = (jax.nn.sigmoid(tx) * scale_x_y - (scale_x_y - 1) / 2
+              + jnp.arange(W)[None, None, None, :]) / W
+        by = (jax.nn.sigmoid(ty) * scale_x_y - (scale_x_y - 1) / 2
+              + jnp.arange(H)[None, None, :, None]) / H
+        man = an_all[mask_idx]
+        bw = jnp.exp(jnp.clip(tw, -10, 10)) \
+            * jnp.asarray(man)[None, :, 0, None, None] / in_w
+        bh = jnp.exp(jnp.clip(th, -10, 10)) \
+            * jnp.asarray(man)[None, :, 1, None, None] / in_h
+
+        def corners(cx, cy, w, h):
+            return cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2
+
+        px1, py1, px2, py2 = corners(bx[..., None], by[..., None],
+                                     bw[..., None], bh[..., None])
+        gx1, gy1, gx2, gy2 = corners(
+            gtb[..., 0][:, None, None, None, :],
+            gtb[..., 1][:, None, None, None, :],
+            gw[:, None, None, None, :], gh[:, None, None, None, :])
+        iw = jnp.maximum(jnp.minimum(px2, gx2) - jnp.maximum(px1, gx1), 0)
+        ih = jnp.maximum(jnp.minimum(py2, gy2) - jnp.maximum(py1, gy1), 0)
+        inter_p = iw * ih
+        union_p = (px2 - px1) * (py2 - py1) \
+            + (gx2 - gx1) * (gy2 - gy1) - inter_p
+        iou = inter_p / jnp.maximum(union_p, 1e-9)
+        iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+        best_iou = jnp.max(iou, axis=-1)                 # [N, M, H, W]
+        ignore = (best_iou > ignore_thresh) & (~assigned)
+
+        loss_obj = jnp.where(ignore, 0.0, bce(tobj, obj_t))
+
+        # ---- class ----
+        lbl_safe = jnp.clip(gtl, 0, class_num - 1)
+        oh_cls = jax.nn.one_hot(lbl_safe, class_num) \
+            * (1.0 - 2.0 * smooth) + smooth
+        cls_scat = jnp.full((N, M, H, W, class_num), smooth, jnp.float32
+                            ).at[n_idx, slot, gj, gi].set(
+            jnp.where(on_scale[..., None], oh_cls, smooth), mode="drop")
+        loss_cls = jnp.where(
+            assigned[..., None],
+            bce(jnp.moveaxis(tcls, 2, -1), cls_scat), 0.0)
+
+        per_sample = (loss_xy.sum((1, 2, 3)) + loss_wh.sum((1, 2, 3))
+                      + loss_obj.sum((1, 2, 3))
+                      + loss_cls.sum((1, 2, 3, 4)))
+        return per_sample
+
+    return apply_op(f, *args, name="yolo_loss")
 
 
 def edit_distance(input, label, normalized=True, ignored_tokens=None,
@@ -549,3 +691,125 @@ def decode_jpeg(x, mode="unchanged", name=None):
     else:
         arr = arr.transpose(2, 0, 1)   # CHW like the reference
     return Tensor(jnp.asarray(arr), stop_gradient=True)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """ref: vision/ops.py prior_box (SSD anchor generation, phi prior_box
+    kernel). input: [N, C, H, W] feature map; image: [N, C, HI, WI].
+    Returns (boxes [H, W, P, 4], variances [H, W, P, 4]) normalized."""
+    feat = unwrap(to_tensor_like(input))
+    img = unwrap(to_tensor_like(image))
+    H, W = feat.shape[2], feat.shape[3]
+    img_h, img_w = float(img.shape[2]), float(img.shape[3])
+    step_h = steps[1] or img_h / H
+    step_w = steps[0] or img_w / W
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    whs = []  # (w, h) pixel sizes per prior
+    for i, ms in enumerate(min_sizes):
+        ms = float(ms)
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                big = math.sqrt(ms * float(max_sizes[i]))
+                whs.append((big, big))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+            if max_sizes:
+                big = math.sqrt(ms * float(max_sizes[i]))
+                whs.append((big, big))
+    P = len(whs)
+    wh = np.asarray(whs, np.float32)
+
+    cx = (np.arange(W, dtype=np.float32) + offset) * step_w
+    cy = (np.arange(H, dtype=np.float32) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)                     # [H, W]
+    boxes = np.empty((H, W, P, 4), np.float32)
+    boxes[..., 0] = (cxg[..., None] - wh[:, 0] / 2) / img_w
+    boxes[..., 1] = (cyg[..., None] - wh[:, 1] / 2) / img_h
+    boxes[..., 2] = (cxg[..., None] + wh[:, 0] / 2) / img_w
+    boxes[..., 3] = (cyg[..., None] + wh[:, 1] / 2) / img_h
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          (H, W, P, 4)).copy()
+    return (Tensor(jnp.asarray(boxes), stop_gradient=True),
+            Tensor(jnp.asarray(var), stop_gradient=True))
+
+
+def read_file(filename, name=None):
+    """ref: vision/ops.py read_file — file bytes as a uint8 tensor."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)),
+                  stop_gradient=True)
+
+
+def _roi_layer(fn, doc):
+    from ..nn.layer.layers import Layer
+
+    class _RoILayer(Layer):
+        def __init__(self, output_size, spatial_scale=1.0):
+            super().__init__()
+            self.output_size = output_size
+            self.spatial_scale = spatial_scale
+
+        def forward(self, x, boxes, boxes_num):
+            return fn(x, boxes, boxes_num, self.output_size,
+                      self.spatial_scale)
+
+    _RoILayer.__doc__ = doc
+    return _RoILayer
+
+
+# real nn.Layer subclasses (composable into Layer trees / Sequential,
+# matching the reference's Layer-based wrappers)
+RoIAlign = _roi_layer(roi_align, "ref: vision/ops.py RoIAlign (Layer).")
+RoIAlign.__name__ = "RoIAlign"
+RoIPool = _roi_layer(roi_pool, "ref: vision/ops.py RoIPool (Layer).")
+RoIPool.__name__ = "RoIPool"
+PSRoIPool = _roi_layer(psroi_pool, "ref: vision/ops.py PSRoIPool (Layer).")
+PSRoIPool.__name__ = "PSRoIPool"
+
+
+class ConvNormActivation:
+    """ref: vision/ops.py ConvNormActivation — Conv2D + norm + activation
+    building block (a Sequential factory here)."""
+
+    _DEFAULT = object()   # sentinel: None must mean "no norm/activation"
+
+    def __new__(cls, in_channels, out_channels, kernel_size=3, stride=1,
+                padding=None, groups=1, norm_layer=_DEFAULT,
+                activation_layer=_DEFAULT, dilation=1, bias=None):
+        from ..nn import BatchNorm2D, Conv2D, ReLU, Sequential
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        if norm_layer is cls._DEFAULT:
+            norm_layer = BatchNorm2D
+        if activation_layer is cls._DEFAULT:
+            activation_layer = ReLU
+        if bias is None:
+            bias = norm_layer is None
+        layers = [Conv2D(in_channels, out_channels, kernel_size,
+                         stride=stride, padding=padding, groups=groups,
+                         dilation=dilation,
+                         bias_attr=None if bias else False)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        return Sequential(*layers)
