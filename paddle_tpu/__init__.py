@@ -10,7 +10,7 @@ Structural map vs the reference (see SURVEY.md):
 """
 from __future__ import annotations
 
-__version__ = "0.1.0"
+from .version import full_version as __version__  # noqa: E402
 
 from .framework import core as _core
 from .framework.core import (  # noqa: F401

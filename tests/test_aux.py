@@ -338,3 +338,15 @@ class TestRecompute:
                                     paddle.to_tensor(
                                         np.ones(2, np.float32)))
         np.testing.assert_allclose(H.numpy(), np.eye(2) * 2)
+
+
+class TestVersion:
+    """ref: python/paddle/version generated module."""
+
+    def test_version_surface(self):
+        import paddle_tpu.version as v
+
+        assert paddle.__version__ == v.full_version
+        assert v.cuda() == "False" and v.cinn() == "False"
+        assert v.tpu() == "True"
+        v.show()
