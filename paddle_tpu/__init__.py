@@ -22,6 +22,9 @@ from .tensor import Parameter, Tensor  # noqa: F401
 from .framework.selected_rows import SelectedRows  # noqa: F401
 from .framework.string_tensor import StringTensor  # noqa: F401
 from .ops import *  # noqa: F401,F403
+from .distributed.parallel import DataParallel  # noqa: F401
+from .nn.layer.layers import ParamAttr  # noqa: F401
+from .nn.functional.common import unflatten  # noqa: F401
 from .ops import creation as _creation
 from .autograd import enable_grad, grad, no_grad, set_grad_enabled  # noqa: F401
 

@@ -16,4 +16,23 @@ from .linalg_ops import *    # noqa: F401,F403
 from .random_ops import *    # noqa: F401,F403
 from .einsum_ops import *    # noqa: F401,F403
 from .extra import *         # noqa: F401,F403
+from .tail import *          # noqa: F401,F403
+
+# generated in-place `<op>_` variants over everything defined above
+from . import inplace as _inplace
+_generated_inplace = _inplace.install(globals())
+globals().update(_generated_inplace)
+
+# install them (and the method-shaped tail ops) as Tensor methods too —
+# the reference exposes both spellings (paddle.tanh_(t) and t.tanh_())
+from ..tensor import Tensor as _Tensor
+for _n, _f in _generated_inplace.items():
+    if not hasattr(_Tensor, _n):
+        setattr(_Tensor, _n, _f)
+for _n in ("frexp", "sgn", "index_fill", "multigammaln",
+           "cumulative_trapezoid", "tolist"):
+    if not hasattr(_Tensor, _n):
+        setattr(_Tensor, _n, globals()[_n])
+del _Tensor, _n, _f
+
 from . import patch_methods  # noqa: F401  (installs Tensor methods/operators)

@@ -1,0 +1,82 @@
+"""In-place op variants (ref: python/paddle/tensor/* `<op>_` functions —
+paddle's dygraph inplace API, e.g. math.py tanh_ / manipulation.py
+scatter_).
+
+TPU-native position: XLA arrays are immutable; "in-place" in the eager
+tape means REBINDING the Tensor's underlying array (donation/aliasing
+inside compiled steps is XLA's job). That preserves the API contract the
+reference documents — the input tensor object itself now holds the
+result — including paddle's restriction that inplace ops on tensors that
+require grad inside autograd regions are the caller's responsibility.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+__all__: list = []  # populated by _install below
+
+# base-op name -> generated `<name>_`
+_INPLACE_BASES = [
+    "abs", "acos", "addmm", "atan", "bitwise_and", "bitwise_left_shift",
+    "bitwise_not", "bitwise_or", "bitwise_right_shift", "bitwise_xor",
+    "cast", "ceil", "clip", "copysign", "cos", "cumprod", "cumsum",
+    "digamma", "divide", "equal", "erf", "exp", "expm1", "fill",
+    "floor", "floor_divide", "floor_mod", "frac", "gammainc", "gammaincc",
+    "gammaln", "gcd", "greater_equal", "greater_than", "hypot", "i0",
+    "lcm", "ldexp", "less_equal", "less_than", "lgamma", "log", "log10",
+    "log2", "logical_and", "logical_not", "logical_or", "logical_xor",
+    "logit", "masked_fill", "masked_scatter", "mod", "multigammaln",
+    "multiply", "nan_to_num", "neg", "not_equal", "polygamma", "pow",
+    "reciprocal", "remainder", "renorm", "round", "rsqrt", "scale",
+    "scatter", "sigmoid", "sin", "sinh", "sqrt", "square", "subtract",
+    "t", "tan", "tanh", "transpose", "tril", "triu", "trunc", "uniform",
+    "add", "flatten", "reshape", "squeeze", "unsqueeze",
+    "index_fill",
+]
+
+
+def _make(base: Callable, name: str):
+    def op_(x, *args, **kwargs):
+        out = base(x, *args, **kwargs)
+        # rebind: the input tensor object now holds the result (dtype may
+        # change, e.g. comparison inplace variants — same as the reference
+        # dygraph behavior)
+        x.data = out.data
+        x.stop_gradient = getattr(out, "stop_gradient", x.stop_gradient)
+        return x
+
+    op_.__name__ = name
+    op_.__doc__ = (f"In-place variant of `{base.__name__}` "
+                   f"(ref: paddle.{base.__name__}_). Rebinds the input "
+                   "tensor's array to the result.")
+    return op_
+
+
+def install(namespace: Dict) -> Dict[str, Callable]:
+    """Generate `<op>_` for every available base op in `namespace`;
+    the caller installs the returned map as module globals AND Tensor
+    methods."""
+    out = {}
+
+    base_where = namespace.get("where")
+    if base_where is not None:
+        def where_(condition, x=None, y=None):
+            """In-place where: mutates X (ref tensor/search.py where_ —
+            'inplaced with input x'), NOT the condition tensor."""
+            out_t = base_where(condition, x, y)
+            x.data = out_t.data
+            x.stop_gradient = getattr(out_t, "stop_gradient",
+                                      x.stop_gradient)
+            return x
+
+        out["where_"] = where_
+    for base_name in _INPLACE_BASES:
+        base = namespace.get(base_name)
+        if base is None or not callable(base):
+            continue
+        name = base_name + "_"
+        if name in namespace:      # a hand-written variant wins
+            continue
+        out[name] = _make(base, name)
+    __all__.extend(out.keys())
+    return out

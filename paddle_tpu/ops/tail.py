@@ -1,0 +1,291 @@
+"""Top-level API tail (ref: python/paddle/__init__.py exports with no
+existing equivalent here: finfo/iinfo/dtype, shape/rank/tolist,
+broadcast_shape, combinations, pdist, cumulative_trapezoid, frexp, sgn,
+multigammaln, index_fill, is_* dtype queries, batch, flops, places,
+LazyGuard, rng-state accessors)."""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply_op
+from ..tensor import Tensor
+from ._helpers import to_tensor_like, unwrap
+
+__all__ = ["finfo", "iinfo", "dtype", "shape", "rank", "tolist",
+           "broadcast_shape", "combinations", "pdist",
+           "cumulative_trapezoid", "frexp", "sgn", "multigammaln",
+           "index_fill", "is_complex",
+           "is_floating_point", "is_integer", "batch", "flops",
+           "CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "LazyGuard",
+           "disable_signal_handler", "get_rng_state", "set_rng_state",
+           "get_cuda_rng_state", "set_cuda_rng_state", "check_shape",
+           "summary"]
+
+dtype = jnp.dtype  # ref: paddle.dtype
+
+
+def finfo(dt):
+    """ref: paddle.finfo — float type limits."""
+    return jnp.finfo(dt)
+
+
+def iinfo(dt):
+    """ref: paddle.iinfo — integer type limits."""
+    return jnp.iinfo(dt)
+
+
+def shape(x):
+    """ref: paddle.shape — runtime shape as an int tensor."""
+    return Tensor(jnp.asarray(unwrap(to_tensor_like(x)).shape),
+                  stop_gradient=True)
+
+
+def rank(x):
+    """ref: paddle.rank."""
+    return Tensor(jnp.asarray(unwrap(to_tensor_like(x)).ndim),
+                  stop_gradient=True)
+
+
+def tolist(x):
+    """ref: paddle.tolist."""
+    return np.asarray(unwrap(to_tensor_like(x))).tolist()
+
+
+def broadcast_shape(x_shape, y_shape):
+    """ref: paddle.broadcast_shape."""
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """ref: paddle.combinations — r-combinations of a 1-D tensor."""
+    import itertools
+
+    arr = unwrap(to_tensor_like(x))
+    n = arr.shape[0]
+    gen = (itertools.combinations_with_replacement(range(n), r)
+           if with_replacement else itertools.combinations(range(n), r))
+    idx = np.asarray(list(gen), np.int64).reshape(-1, r)
+    return Tensor(arr[jnp.asarray(idx)], stop_gradient=True)
+
+
+def pdist(x, p=2.0, name=None):
+    """ref: paddle.pdist — condensed pairwise distances of [N, D]."""
+    def f(a):
+        af = a if jnp.issubdtype(a.dtype, jnp.floating) \
+            else a.astype(jnp.float32)
+        diff = af[:, None, :] - af[None, :, :]
+        if p == 2.0:
+            sq = (diff ** 2).sum(-1)
+            # exact 0 for duplicate rows, grad-safe sqrt elsewhere
+            d = jnp.where(sq > 0,
+                          jnp.sqrt(jnp.where(sq > 0, sq, 1.0)), 0.0)
+        else:
+            d = (jnp.abs(diff) ** p).sum(-1) ** (1.0 / p)
+        n = a.shape[0]
+        iu = jnp.triu_indices(n, k=1)
+        return d[iu]
+
+    return apply_op(f, to_tensor_like(x), name="pdist")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """ref: paddle.cumulative_trapezoid — x and dx are mutually
+    exclusive; 1-D x broadcasts against n-D y along `axis` (the
+    reference's supported shapes)."""
+    if x is not None and dx is not None:
+        raise ValueError("cumulative_trapezoid: pass either x or dx, "
+                         "not both (reference contract)")
+    args = [to_tensor_like(y)]
+    if x is not None:
+        args.append(to_tensor_like(x))
+
+    def f(yv, *rest):
+        yv = yv.astype(jnp.float32)
+        ax = axis % yv.ndim
+        y0 = jax.lax.slice_in_dim(yv, 0, yv.shape[ax] - 1, axis=ax)
+        y1 = jax.lax.slice_in_dim(yv, 1, yv.shape[ax], axis=ax)
+        if rest:
+            xv = rest[0].astype(jnp.float32)
+            if xv.ndim == 1 and yv.ndim > 1:
+                d = jnp.diff(xv)
+                view = [1] * yv.ndim
+                view[ax] = d.shape[0]
+                d = d.reshape(view)
+            else:
+                xax = axis % xv.ndim
+                d = jnp.diff(xv, axis=xax)
+        else:
+            d = dx if dx is not None else 1.0
+        return jnp.cumsum((y0 + y1) / 2.0 * d, axis=ax)
+
+    return apply_op(f, *args, name="cumulative_trapezoid")
+
+
+def frexp(x, name=None):
+    """ref: paddle.frexp -> (mantissa, exponent)."""
+    def f(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(jnp.float32)
+
+    return apply_op(f, to_tensor_like(x), n_outputs=2, name="frexp")
+
+
+def sgn(x, name=None):
+    """ref: paddle.sgn — sign for reals, unit phasor for complex."""
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0.0 + 0.0j, a / mag)
+        return jnp.sign(a)
+
+    return apply_op(f, to_tensor_like(x), name="sgn")
+
+
+def multigammaln(x, p, name=None):
+    """ref: paddle.multigammaln — log multivariate gamma."""
+    def f(a):
+        af = a.astype(jnp.float32)
+        const = p * (p - 1) / 4.0 * _math.log(_math.pi)
+        terms = sum(jax.scipy.special.gammaln(af - i / 2.0)
+                    for i in range(p))
+        return const + terms
+
+    return apply_op(f, to_tensor_like(x), name="multigammaln")
+
+
+def index_fill(x, index, axis, value, name=None):
+    """ref: paddle.index_fill — fill rows/slices at `index` along axis."""
+    def f(a, idx):
+        moved = jnp.moveaxis(a, axis, 0)
+        filled = moved.at[idx.astype(jnp.int32)].set(value)
+        return jnp.moveaxis(filled, 0, axis)
+
+    return apply_op(f, to_tensor_like(x), to_tensor_like(index),
+                    name="index_fill")
+
+
+def is_complex(x):
+    return jnp.issubdtype(unwrap(to_tensor_like(x)).dtype,
+                          jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(unwrap(to_tensor_like(x)).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(unwrap(to_tensor_like(x)).dtype, jnp.integer)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """ref: paddle.batch — wrap a sample reader into a batch reader."""
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """ref: paddle.flops — model forward FLOPs; measured by XLA's own
+    cost analysis of the traced forward (exact, not a per-layer table)."""
+    from ..framework import core
+
+    state = {k: t.data for k, t in net.state_dict().items()}
+    x = jnp.zeros(tuple(input_size), jnp.float32)
+
+    def fwd(state, xv):
+        with net.use_state(state), core.no_grad_guard():
+            out = net(Tensor(xv))
+            return out.data if isinstance(out, Tensor) else out[0].data
+
+    ca = jax.jit(fwd).lower(state, x).cost_analysis() or {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    total = int(ca.get("flops", 0) or 0)
+    if print_detail:
+        print(f"Total FLOPs: {total}")
+    return total
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """ref: paddle.summary — delegate to hapi Model.summary; a concrete
+    `input` tensor supplies the shape when input_size is absent."""
+    from ..hapi import Model
+
+    if input_size is None and input is not None:
+        input_size = tuple(unwrap(to_tensor_like(input)).shape)
+    return Model(net).summary(input_size=input_size, dtype=dtypes)
+
+
+# ---- places (ref: paddle.CPUPlace / CUDAPlace — device handles; under
+# one-controller JAX a place is just a device lookup) ----
+
+class CPUPlace:
+    def __repr__(self):
+        return "Place(cpu)"
+
+    def __eq__(self, o):
+        return isinstance(o, CPUPlace)
+
+
+class CUDAPlace:
+    """Accepted for API compat; maps to the accelerator device."""
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place(accelerator:{self.device_id})"
+
+
+class CUDAPinnedPlace:
+    def __repr__(self):
+        return "Place(pinned)"
+
+
+class LazyGuard:
+    """ref: paddle.LazyGuard — defers parameter materialization. Param
+    init here is already cheap functional jnp init on trace; the guard is
+    a documented no-op context."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def disable_signal_handler():
+    """ref: paddle.disable_signal_handler — no custom handlers here."""
+
+
+def check_shape(x):  # legacy debugging helper
+    return shape(x)
+
+
+def get_rng_state(device=None):
+    """ref: paddle.get_rng_state."""
+    from ..framework import core
+
+    return core.get_rng_state()
+
+
+def set_rng_state(state, device=None):
+    from ..framework import core
+
+    core.set_rng_state(state)
+
+
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
