@@ -35,9 +35,22 @@ _INPLACE_BASES = [
 ]
 
 
+# ops whose inplace form legitimately changes the view shape
+_SHAPE_CHANGING = {"reshape", "flatten", "squeeze", "unsqueeze", "t",
+                   "transpose", "cast"}
+
+
 def _make(base: Callable, name: str):
+    allow_reshape = base.__name__ in _SHAPE_CHANGING
+
     def op_(x, *args, **kwargs):
         out = base(x, *args, **kwargs)
+        if not allow_reshape and tuple(out.data.shape) != tuple(
+                x.data.shape):
+            raise ValueError(
+                f"{name}: in-place result shape {tuple(out.data.shape)} "
+                f"differs from input {tuple(x.data.shape)} — the "
+                "reference rejects broadcast-enlarging inplace ops")
         # rebind: the input tensor object now holds the result (dtype may
         # change, e.g. comparison inplace variants — same as the reference
         # dygraph behavior)

@@ -83,6 +83,10 @@ def pdist(x, p=2.0, name=None):
             # exact 0 for duplicate rows, grad-safe sqrt elsewhere
             d = jnp.where(sq > 0,
                           jnp.sqrt(jnp.where(sq > 0, sq, 1.0)), 0.0)
+        elif p == float("inf"):
+            d = jnp.abs(diff).max(-1)                # Chebyshev
+        elif p == 0.0:
+            d = (jnp.abs(diff) > 0).sum(-1).astype(af.dtype)  # Hamming
         else:
             d = (jnp.abs(diff) ** p).sum(-1) ** (1.0 / p)
         n = a.shape[0]
@@ -104,12 +108,15 @@ def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
         args.append(to_tensor_like(x))
 
     def f(yv, *rest):
-        yv = yv.astype(jnp.float32)
+        if not jnp.issubdtype(yv.dtype, jnp.floating):
+            yv = yv.astype(jnp.float32)   # preserve f64 inputs as-is
         ax = axis % yv.ndim
         y0 = jax.lax.slice_in_dim(yv, 0, yv.shape[ax] - 1, axis=ax)
         y1 = jax.lax.slice_in_dim(yv, 1, yv.shape[ax], axis=ax)
         if rest:
-            xv = rest[0].astype(jnp.float32)
+            xv = rest[0]
+            if not jnp.issubdtype(xv.dtype, jnp.floating):
+                xv = xv.astype(jnp.float32)
             if xv.ndim == 1 and yv.ndim > 1:
                 d = jnp.diff(xv)
                 view = [1] * yv.ndim
@@ -148,7 +155,8 @@ def sgn(x, name=None):
 def multigammaln(x, p, name=None):
     """ref: paddle.multigammaln — log multivariate gamma."""
     def f(a):
-        af = a.astype(jnp.float32)
+        af = a if jnp.issubdtype(a.dtype, jnp.floating) \
+            else a.astype(jnp.float32)
         const = p * (p - 1) / 4.0 * _math.log(_math.pi)
         terms = sum(jax.scipy.special.gammaln(af - i / 2.0)
                     for i in range(p))
@@ -248,10 +256,22 @@ class CUDAPlace:
     def __repr__(self):
         return f"Place(accelerator:{self.device_id})"
 
+    def __eq__(self, o):
+        return isinstance(o, CUDAPlace) and o.device_id == self.device_id
+
+    def __hash__(self):
+        return hash(("cuda_place", self.device_id))
+
 
 class CUDAPinnedPlace:
     def __repr__(self):
         return "Place(pinned)"
+
+    def __eq__(self, o):
+        return isinstance(o, CUDAPinnedPlace)
+
+    def __hash__(self):
+        return hash("pinned_place")
 
 
 class LazyGuard:

@@ -138,3 +138,23 @@ class TestReviewFixes:
         np.testing.assert_allclose(got, [[2.0, 4.0]] * 2)
         with pytest.raises(ValueError, match="either x or dx"):
             paddle.cumulative_trapezoid(y, x=x, dx=1.0)
+
+    def test_pdist_inf_and_zero_norms(self):
+        x = paddle.to_tensor(np.array([[0.0, 0.0], [3.0, 4.0]], np.float32))
+        assert float(paddle.pdist(x, p=float("inf")).numpy()[0]) == 4.0
+        assert float(paddle.pdist(x, p=0.0).numpy()[0]) == 2.0
+
+    def test_inplace_rejects_broadcast_enlargement(self):
+        x = paddle.to_tensor(np.zeros(2, np.float32))
+        y = paddle.to_tensor(np.zeros((3, 2), np.float32))
+        with pytest.raises(ValueError, match="differs from input"):
+            paddle.add_(x, y)
+        # shape-changing inplace ops stay legal
+        t = paddle.to_tensor(np.zeros((2, 3), np.float32))
+        paddle.reshape_(t, [3, 2])
+        assert tuple(t.numpy().shape) == (3, 2)
+
+    def test_places_equality(self):
+        assert paddle.CUDAPlace(0) == paddle.CUDAPlace(0)
+        assert paddle.CUDAPlace(0) != paddle.CUDAPlace(1)
+        assert paddle.CUDAPinnedPlace() == paddle.CUDAPinnedPlace()
