@@ -45,12 +45,14 @@ def _make(base: Callable, name: str):
 
     def op_(x, *args, **kwargs):
         out = base(x, *args, **kwargs)
-        if not allow_reshape and tuple(out.data.shape) != tuple(
-                x.data.shape):
+        # reject broadcast ENLARGEMENT (more elements than x) — numel
+        # comparison still permits legal view changes like cumsum_'s
+        # axis=None flatten
+        if not allow_reshape and out.data.size > x.data.size:
             raise ValueError(
                 f"{name}: in-place result shape {tuple(out.data.shape)} "
-                f"differs from input {tuple(x.data.shape)} — the "
-                "reference rejects broadcast-enlarging inplace ops")
+                f"broadcast-enlarges input {tuple(x.data.shape)} — the "
+                "reference rejects shape-growing inplace ops")
         # rebind: the input tensor object now holds the result (dtype may
         # change, e.g. comparison inplace variants — same as the reference
         # dygraph behavior)

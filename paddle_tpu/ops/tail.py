@@ -246,6 +246,9 @@ class CPUPlace:
     def __eq__(self, o):
         return isinstance(o, CPUPlace)
 
+    def __hash__(self):
+        return hash("cpu_place")
+
 
 class CUDAPlace:
     """Accepted for API compat; maps to the accelerator device."""
