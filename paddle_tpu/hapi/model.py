@@ -186,11 +186,101 @@ class Model:
         return self.network.parameters()
 
     def summary(self, input_size=None, dtype=None):
-        n_params = sum(int(np.prod(p.shape))
-                       for p in self.network.parameters())
-        print(f"Model: {type(self.network).__name__}, "
-              f"params: {n_params:,}")
-        return {"total_params": n_params}
+        """ref: hapi/model_summary.py summary — per-layer table with
+        parameter counts (+ output shapes when input_size is given, via
+        shape-only tracing: jax.eval_shape runs no FLOPs)."""
+        net = self.network
+        out_shapes = {}
+        if input_size is not None:
+            out_shapes = self._trace_output_shapes(net, input_size, dtype)
+
+        rows = []
+        # include_self: a leaf network (or root-held params) must get a
+        # row too — each row counts only the layer's OWN direct params
+        for name, layer in net.named_sublayers(include_self=True):
+            own = [p for _, p in layer._parameters.items()
+                   if p is not None] if hasattr(layer, "_parameters") else []
+            n_own = sum(int(np.prod(p.shape)) for p in own)
+            if name == "" and n_own == 0 and len(rows) == 0 and                     list(net.named_sublayers(include_self=False)):
+                continue          # composite root with no direct params
+            rows.append((name or type(net).__name__.lower(),
+                         type(layer).__name__,
+                         out_shapes.get(name, "-"), n_own))
+
+        total = sum(int(np.prod(p.shape)) for p in net.parameters())
+        trainable_total = sum(int(np.prod(p.shape))
+                              for p in net.parameters()
+                              if not p.stop_gradient)
+        hdr = (f"{'Layer (type)':<42}{'Output Shape':<20}"
+               f"{'Params':>12}")
+        line = "-" * len(hdr)
+        print(line)
+        print(hdr)
+        print(line)
+        for name, tname, oshape, n_own in rows:
+            label = f"{name} ({tname})"
+            print(f"{label:<42}{str(oshape):<20}{n_own:>12,}")
+        print(line)
+        print(f"Total params: {total:,}")
+        print(f"Trainable params: {trainable_total:,}")
+        print(f"Non-trainable params: {total - trainable_total:,}")
+        print(line)
+        return {"total_params": total,
+                "trainable_params": trainable_total}
+
+    @staticmethod
+    def _trace_output_shapes(net, input_size, dtype):
+        """Per-sublayer output shapes via forward hooks under
+        jax.eval_shape (abstract trace — no compute)."""
+        import contextlib
+
+        import jax
+
+        from ..framework import core
+        from ..tensor import Tensor as T
+
+        shapes = {}
+        handles = []
+
+        def make_hook(name):
+            def hook(layer, inputs, output):
+                out = output[0] if isinstance(output, (tuple, list)) \
+                    else output
+                if isinstance(out, T):
+                    shapes[name] = tuple(out.data.shape)
+                return output
+            return hook
+
+        for name, layer in net.named_sublayers(include_self=True):
+            reg = getattr(layer, "register_forward_post_hook", None)
+            if reg is not None:
+                handles.append(reg(make_hook(name)))
+        try:
+            if isinstance(dtype, (list, tuple)):
+                dtype = dtype[0] if dtype else None
+            dt = np.dtype(dtype) if dtype else np.float32
+            # multi-input: a list/tuple of shape tuples (reference API)
+            if (isinstance(input_size, (list, tuple)) and input_size
+                    and isinstance(input_size[0], (list, tuple))):
+                xs = [jax.ShapeDtypeStruct(tuple(sh), dt)
+                      for sh in input_size]
+            else:
+                xs = [jax.ShapeDtypeStruct(tuple(input_size), dt)]
+            state = {k: t.data for k, t in net.state_dict().items()}
+
+            def fwd(state, *xvs):
+                with net.use_state(state), core.no_grad_guard():
+                    out = net(*[T(xv) for xv in xvs])
+                return out.data if isinstance(out, T) else out
+
+            jax.eval_shape(fwd, state, *xs)
+        except Exception:
+            pass  # shapes stay partial; the table still prints params
+        finally:
+            for h in handles:
+                with contextlib.suppress(Exception):
+                    (h.remove() if hasattr(h, "remove") else None)
+        return shapes
 
     # -- helpers ------------------------------------------------------------
     @staticmethod

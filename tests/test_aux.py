@@ -350,3 +350,25 @@ class TestVersion:
         assert v.cuda() == "False" and v.cinn() == "False"
         assert v.tpu() == "True"
         v.show()
+
+
+class TestSummaryTable:
+    """ref: hapi/model_summary.py — per-layer table with output shapes."""
+
+    def test_summary_shapes_and_counts(self, capsys):
+        import paddle_tpu.nn as nn
+
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        info = paddle.summary(m, (2, 8))
+        out = capsys.readouterr().out
+        assert "Linear" in out and "(2, 16)" in out and "(2, 4)" in out
+        assert info["total_params"] == 8 * 16 + 16 + 16 * 4 + 4
+        assert info["trainable_params"] == info["total_params"]
+
+    def test_summary_without_input_size(self, capsys):
+        import paddle_tpu.nn as nn
+
+        info = paddle.summary(nn.Linear(4, 2))
+        out = capsys.readouterr().out
+        assert "Total params" in out
+        assert info["total_params"] == 10
