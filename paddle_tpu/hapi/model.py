@@ -201,13 +201,18 @@ class Model:
             own = [p for _, p in layer._parameters.items()
                    if p is not None] if hasattr(layer, "_parameters") else []
             n_own = sum(int(np.prod(p.shape)) for p in own)
-            if name == "" and n_own == 0 and len(rows) == 0 and                     list(net.named_sublayers(include_self=False)):
+            has_children = any(
+                True for _ in net.named_sublayers(include_self=False))
+            if name == "" and n_own == 0 and has_children:
                 continue          # composite root with no direct params
             rows.append((name or type(net).__name__.lower(),
                          type(layer).__name__,
                          out_shapes.get(name, "-"), n_own))
 
+        # net.parameters() dedupes tied weights by id; flag when rows
+        # necessarily double-count them so the table is self-explaining
         total = sum(int(np.prod(p.shape)) for p in net.parameters())
+        row_sum = sum(r[3] for r in rows)
         trainable_total = sum(int(np.prod(p.shape))
                               for p in net.parameters()
                               if not p.stop_gradient)
@@ -221,6 +226,9 @@ class Model:
             label = f"{name} ({tname})"
             print(f"{label:<42}{str(oshape):<20}{n_own:>12,}")
         print(line)
+        if row_sum > total:
+            print(f"(shared parameters counted once in totals; "
+                  f"per-layer rows sum to {row_sum:,})")
         print(f"Total params: {total:,}")
         print(f"Trainable params: {trainable_total:,}")
         print(f"Non-trainable params: {total - trainable_total:,}")
@@ -256,16 +264,19 @@ class Model:
             if reg is not None:
                 handles.append(reg(make_hook(name)))
         try:
+            # multi-input: a list/tuple of shape tuples (reference API),
+            # with per-input dtypes honored
+            multi = (isinstance(input_size, (list, tuple)) and input_size
+                     and isinstance(input_size[0], (list, tuple)))
+            in_shapes = list(input_size) if multi else [input_size]
             if isinstance(dtype, (list, tuple)):
-                dtype = dtype[0] if dtype else None
-            dt = np.dtype(dtype) if dtype else np.float32
-            # multi-input: a list/tuple of shape tuples (reference API)
-            if (isinstance(input_size, (list, tuple)) and input_size
-                    and isinstance(input_size[0], (list, tuple))):
-                xs = [jax.ShapeDtypeStruct(tuple(sh), dt)
-                      for sh in input_size]
+                dts = [np.dtype(d) if d else np.float32 for d in dtype]
+                dts += [np.float32] * (len(in_shapes) - len(dts))
             else:
-                xs = [jax.ShapeDtypeStruct(tuple(input_size), dt)]
+                dts = [np.dtype(dtype) if dtype
+                       else np.float32] * len(in_shapes)
+            xs = [jax.ShapeDtypeStruct(tuple(sh), dt)
+                  for sh, dt in zip(in_shapes, dts)]
             state = {k: t.data for k, t in net.state_dict().items()}
 
             def fwd(state, *xvs):
@@ -274,8 +285,12 @@ class Model:
                 return out.data if isinstance(out, T) else out
 
             jax.eval_shape(fwd, state, *xs)
-        except Exception:
-            pass  # shapes stay partial; the table still prints params
+        except Exception as e:
+            import warnings
+            warnings.warn(
+                f"summary: output-shape trace failed ({type(e).__name__}: "
+                f"{str(e)[:200]}); table shows parameter counts only",
+                RuntimeWarning)
         finally:
             for h in handles:
                 with contextlib.suppress(Exception):

@@ -215,3 +215,81 @@ class TestGradientGoldens:
                                    atol=1e-5, rtol=1e-4)
         np.testing.assert_allclose(pw.grad.numpy(), tw.grad.numpy(),
                                    atol=1e-5, rtol=1e-4)
+
+
+class TestRecurrentGoldens:
+    """LSTM/GRU/RNN vs torch — gate layouts and bias conventions are the
+    classic divergence spot (paddle and torch share i,f,g,o order)."""
+
+    def _copy_cell(self, pc, tc):
+        with torch.no_grad():
+            tc.weight_ih.copy_(_t(pc.weight_ih.numpy()))
+            tc.weight_hh.copy_(_t(pc.weight_hh.numpy()))
+            tc.bias_ih.copy_(_t(pc.bias_ih.numpy()))
+            tc.bias_hh.copy_(_t(pc.bias_hh.numpy()))
+
+    def test_lstm_cell(self):
+        paddle.seed(0)
+        pc = nn.LSTMCell(6, 8)
+        tc = torch.nn.LSTMCell(6, 8)
+        self._copy_cell(pc, tc)
+        x = RNG.standard_normal((3, 6)).astype(np.float32)
+        h0 = RNG.standard_normal((3, 8)).astype(np.float32)
+        c0 = RNG.standard_normal((3, 8)).astype(np.float32)
+        out, (h, c) = pc(paddle.to_tensor(x),
+                         (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+        th, tcs = tc(_t(x), (_t(h0), _t(c0)))
+        np.testing.assert_allclose(h.numpy(), th.detach().numpy(),
+                                   atol=1e-5, rtol=1e-4)
+        np.testing.assert_allclose(c.numpy(), tcs.detach().numpy(),
+                                   atol=1e-5, rtol=1e-4)
+
+    def test_gru_cell(self):
+        paddle.seed(1)
+        pc = nn.GRUCell(5, 7)
+        tc = torch.nn.GRUCell(5, 7)
+        self._copy_cell(pc, tc)
+        x = RNG.standard_normal((2, 5)).astype(np.float32)
+        h0 = RNG.standard_normal((2, 7)).astype(np.float32)
+        out, h = pc(paddle.to_tensor(x), paddle.to_tensor(h0))
+        th = tc(_t(x), _t(h0))
+        np.testing.assert_allclose(h.numpy(), th.detach().numpy(),
+                                   atol=1e-5, rtol=1e-4)
+
+    def test_lstm_layer_sequence(self):
+        paddle.seed(2)
+        pl = nn.LSTM(4, 6)                      # batch-first paddle layout
+        tl = torch.nn.LSTM(4, 6, batch_first=True)
+        cell = pl.rnns[0].cell
+        with torch.no_grad():
+            tl.weight_ih_l0.copy_(_t(cell.weight_ih.numpy()))
+            tl.weight_hh_l0.copy_(_t(cell.weight_hh.numpy()))
+            tl.bias_ih_l0.copy_(_t(cell.bias_ih.numpy()))
+            tl.bias_hh_l0.copy_(_t(cell.bias_hh.numpy()))
+        x = RNG.standard_normal((2, 5, 4)).astype(np.float32)
+        out, states = pl(paddle.to_tensor(x))
+        # paddle returns per-layer [(h, c)] lists; single layer here
+        h, c = states[0] if isinstance(states, list) else states
+        tout, (th, tcs) = tl(_t(x))
+        np.testing.assert_allclose(out.numpy(), tout.detach().numpy(),
+                                   atol=1e-5, rtol=1e-4)
+        np.testing.assert_allclose(h.numpy(), th.detach().numpy()[0],
+                                   atol=1e-5, rtol=1e-4)
+        np.testing.assert_allclose(c.numpy(), tcs.detach().numpy()[0],
+                                   atol=1e-5, rtol=1e-4)
+
+    def test_embedding_and_gather_grads(self):
+        paddle.seed(3)
+        pe = nn.Embedding(10, 4)
+        te = torch.nn.Embedding(10, 4)
+        with torch.no_grad():
+            te.weight.copy_(_t(pe.weight.numpy()))
+        ids = np.array([[1, 2, 2], [0, 9, 1]], np.int64)
+        out = pe(paddle.to_tensor(ids))
+        tout = te(_t(ids))
+        np.testing.assert_allclose(out.numpy(), tout.detach().numpy(),
+                                   atol=1e-6)
+        out.sum().backward()
+        tout.sum().backward()
+        np.testing.assert_allclose(pe.weight.grad.numpy(),
+                                   te.weight.grad.numpy(), atol=1e-5)
