@@ -1,0 +1,82 @@
+"""Shared per-job authkey derivation for host-side pickle channels.
+
+multiprocessing.connection deserializes pickles after HMAC auth, so a
+constant key in public source would hand RCE to anything that can reach
+the port (ref hazard: paddle/fluid/distributed uses brpc with its own
+auth; our host channels must supply an equivalent). Every channel
+(collective p2p, parameter server, rpc, elastic) derives its key here:
+
+1. an explicit env var set by the launcher (strongest, per-job),
+2. else a digest of ONE job-identity env var + a namespace tag (not
+   guessable from source alone). Exactly one var is used — the FIRST
+   set among PADDLE_MASTER, PADDLE_TRAINER_ENDPOINTS,
+   PADDLE_PSERVERS_IP_PORT_LIST — never a concatenation, because
+   different processes of one job may legitimately see different
+   SUBSETS of these (a PS server launched with only the pserver list
+   must still derive the same key as a trainer that has all three).
+   Launchers must publish the highest-priority var to every process.
+3. else — bare local runs — a same-user 0600 secret file (one file per
+   namespace, so channels stay key-isolated even in this mode),
+   created atomically so concurrent ranks converge on ONE key.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["derive_authkey"]
+
+# priority order of the job-identity vars; see module docstring
+_JOB_VARS = ("PADDLE_MASTER", "PADDLE_TRAINER_ENDPOINTS",
+             "PADDLE_PSERVERS_IP_PORT_LIST")
+
+
+def derive_authkey(env_var: str, namespace: str) -> bytes:
+    secret = os.environ.get(env_var)
+    if secret:
+        return secret.encode()
+    for var in _JOB_VARS:
+        job = os.environ.get(var, "")
+        if job:
+            import hashlib
+            return hashlib.sha256(
+                (f"paddle_tpu_{namespace}:{var}={job}").encode()).digest()
+    # Bare local runs: a same-user secret file (0600) — other local users
+    # cannot read it, unlike anything derivable from uid/source. Creation
+    # is atomic (temp + hard link) and creation races settle by
+    # re-reading, so concurrent ranks always converge on ONE key and a
+    # live listener's key is never clobbered.
+    import secrets
+    import tempfile
+    path = os.path.join(os.path.expanduser("~"),
+                        f".paddle_tpu_{namespace}_key")
+    for _ in range(10):
+        try:
+            with open(path, "rb") as f:
+                key = f.read()
+            if len(key) >= 16:
+                return key
+            # short/corrupt file (killed writer, disk-full): self-heal by
+            # removing it so the link below can install a fresh key
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        except OSError:
+            pass
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                   prefix=".p2p_key_")
+        try:
+            os.fchmod(fd, 0o600)
+            with os.fdopen(fd, "wb") as f:
+                f.write(secrets.token_bytes(32))
+            # O_EXCL-style: only create if absent; losers re-read winner's
+            try:
+                os.link(tmp, path)
+            except FileExistsError:
+                pass
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    raise RuntimeError(f"could not establish authkey file at {path}")

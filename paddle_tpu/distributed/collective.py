@@ -12,6 +12,7 @@ Two regimes, mirroring SURVEY §5's TPU mapping:
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional
 
 import jax
@@ -147,58 +148,11 @@ _p2p_inbox = None
 
 
 def _p2p_auth() -> bytes:
-    """Per-job secret: multiprocessing.connection deserializes pickles
-    after HMAC auth, so a constant key in public source would hand RCE to
-    anything that can reach the port. The launcher should set
-    PADDLE_P2P_AUTHKEY; otherwise the key is derived from the job's
-    master endpoint + uid (not guessable from source alone)."""
-    secret = os.environ.get("PADDLE_P2P_AUTHKEY")
-    if secret:
-        return secret.encode()
-    job = (os.environ.get("PADDLE_MASTER", "")
-           + os.environ.get("PADDLE_TRAINER_ENDPOINTS", ""))
-    if job:
-        import hashlib
-        return hashlib.sha256(("paddle_tpu_p2p:" + job).encode()).digest()
-    # bare local runs: a same-user secret file (0600) — other local users
-    # cannot read it, unlike anything derivable from uid/source. Creation
-    # is atomic (temp + rename) and creation races settle by re-reading,
-    # so concurrent ranks always converge on ONE key and a live
-    # listener's key is never clobbered.
-    import secrets
-    import tempfile
-    path = os.path.join(os.path.expanduser("~"), ".paddle_tpu_p2p_key")
-    for _ in range(10):
-        try:
-            with open(path, "rb") as f:
-                key = f.read()
-            if len(key) >= 16:
-                return key
-            # short/corrupt file (killed writer, disk-full): self-heal by
-            # removing it so the link below can install a fresh key
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
-        except OSError:
-            pass
-        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
-                                   prefix=".p2p_key_")
-        try:
-            os.fchmod(fd, 0o600)
-            with os.fdopen(fd, "wb") as f:
-                f.write(secrets.token_bytes(32))
-            # O_EXCL-style: only create if absent; losers re-read winner's
-            try:
-                os.link(tmp, path)
-            except FileExistsError:
-                pass
-        finally:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-    raise RuntimeError(f"could not establish p2p key file at {path}")
+    """Per-job secret (see distributed/_auth.py for the full scheme):
+    PADDLE_P2P_AUTHKEY, else derived from the job's published endpoints,
+    else a same-user 0600 key file."""
+    from paddle_tpu.distributed._auth import derive_authkey
+    return derive_authkey("PADDLE_P2P_AUTHKEY", "p2p")
 
 
 def _p2p_port(rank: int) -> int:
@@ -223,6 +177,16 @@ def _env_rank() -> int:
 def _env_world() -> int:
     v = os.environ.get("PADDLE_TRAINERS_NUM")
     return int(v) if v is not None else jax.process_count()
+
+
+def _listener_closed(listener) -> bool:
+    """True once Listener.close() ran (its socket fd is gone). Touches
+    multiprocessing internals, but those have been stable for a decade
+    and the fallback (treat as closed) only stops the accept loop."""
+    try:
+        return listener._listener._socket.fileno() == -1
+    except Exception:
+        return True
 
 
 def _ensure_p2p_server():
@@ -258,11 +222,23 @@ def _ensure_p2p_server():
                              authkey=_p2p_auth())
 
     def loop():
+        lst = _p2p_listener
         while True:
             try:
-                conn = _p2p_listener.accept()
-            except (OSError, EOFError):
-                return
+                conn = lst.accept()
+            except Exception:
+                # Exception TYPE can't separate "listener closed" from a
+                # per-connection handshake failure: a peer that drops
+                # mid-handshake (port scan, stale key) surfaces as
+                # AuthenticationError / EOFError / ConnectionResetError
+                # (an OSError). One bad peer must NOT kill the accept
+                # loop, so decide by the listener socket itself.
+                if _listener_closed(lst):
+                    return
+                # brief backoff: a persistent accept error that is NOT a
+                # closed listener (e.g. fd exhaustion) must not busy-spin
+                time.sleep(0.02)
+                continue
 
             def drain(c=conn):
                 try:

@@ -146,7 +146,12 @@ class MembershipManager:
         host, port = endpoint.rsplit(":", 1)
         return (host, int(port))
 
-    _AUTH = b"paddle_tpu_elastic"
+    @property
+    def _AUTH(self) -> bytes:
+        """Per-job secret (distributed/_auth.py) — never a source
+        constant (pickle channel = RCE to anyone holding the key)."""
+        from paddle_tpu.distributed._auth import derive_authkey
+        return derive_authkey("PADDLE_ELASTIC_AUTHKEY", "elastic")
 
     # -- master side --------------------------------------------------------
     def start_master(self):

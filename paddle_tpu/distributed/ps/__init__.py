@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import threading
 import time
+from multiprocessing import AuthenticationError
 from multiprocessing.connection import Client, Listener
 from typing import Dict, Optional
 
@@ -25,7 +26,21 @@ import numpy as np
 __all__ = ["SGDRule", "AdagradRule", "AdamRule", "DenseTable", "SparseTable",
            "ParameterServer", "PSClient", "run_server"]
 
-_AUTH = b"paddle_tpu_ps"
+def _auth() -> bytes:
+    """Per-job secret (distributed/_auth.py): PADDLE_PS_AUTHKEY, else
+    derived from the job's published endpoints, else a same-user 0600
+    key file — never a source-code constant (pickle channel = RCE to
+    anyone holding the key)."""
+    from paddle_tpu.distributed._auth import derive_authkey
+    return derive_authkey("PADDLE_PS_AUTHKEY", "ps")
+
+
+# explicit service surface: the wire protocol may only invoke these —
+# getattr on arbitrary client-supplied names would expose every method
+# (and attribute!) of the server object to the network
+_SERVICE_OPS = frozenset({
+    "pull_dense", "push_dense", "pull_sparse", "push_sparse", "barrier",
+})
 
 
 # ---------------- update rules (ref: ps/table/sparse_sgd_rule.cc) ---------
@@ -223,14 +238,23 @@ class ParameterServer:
         thread — a bounded pool would deadlock at barrier() once workers
         outnumber threads."""
         host, port = endpoint.rsplit(":", 1)
-        self._listener = Listener((host, int(port)), authkey=_AUTH)
+        self._listener = Listener((host, int(port)), authkey=_auth())
 
         def loop():
+            from paddle_tpu.distributed.collective import _listener_closed
             while not self._stop.is_set():
                 try:
                     conn = self._listener.accept()
-                except (OSError, EOFError):
-                    break
+                except Exception:
+                    # a failed handshake (AuthenticationError / EOFError /
+                    # ConnectionResetError from a port scan or wrong key)
+                    # must not stop service; only a closed listener does.
+                    # Exception type alone can't tell them apart — check
+                    # the listener socket.
+                    if _listener_closed(self._listener):
+                        break
+                    time.sleep(0.02)  # no busy-spin on persistent errors
+                    continue
                 threading.Thread(target=self._handle, args=(conn,),
                                  daemon=True).start()
 
@@ -247,6 +271,8 @@ class ParameterServer:
                     self.shutdown()
                     break
                 try:
+                    if op not in _SERVICE_OPS:
+                        raise ValueError(f"unknown PS op {op!r}")
                     out = getattr(self, op)(*args)
                     conn.send(("ok", out))
                 except Exception as e:  # worker sees the server error
@@ -296,9 +322,11 @@ class PSClient:
             last = None
             for _ in range(retries):
                 try:
-                    self._conn = Client((host, int(port)), authkey=_AUTH)
+                    self._conn = Client((host, int(port)), authkey=_auth())
                     break
-                except (ConnectionError, OSError) as e:
+                except (ConnectionError, OSError, AuthenticationError) as e:
+                    # AuthenticationError can be transient: a peer midway
+                    # through creating the shared key file
                     last = e
                     time.sleep(0.1)
             if self._conn is None:

@@ -21,7 +21,11 @@ from typing import Any, Dict, Optional
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
 
-_AUTH = b"paddle_tpu_rpc"
+def _AUTH() -> bytes:
+    """Per-job secret (distributed/_auth.py) — never a source constant
+    (authenticated-pickle channel = RCE to anyone holding the key)."""
+    from paddle_tpu.distributed._auth import derive_authkey
+    return derive_authkey("PADDLE_RPC_AUTHKEY", "rpc")
 
 
 @dataclass
@@ -111,13 +115,13 @@ def init_rpc(name: str, rank: int = None, world_size: int = None,
     # my serving endpoint: the master endpoint for rank 0, an ephemeral
     # port otherwise
     if rank == 0:
-        listener = Listener(_addr(master_endpoint), authkey=_AUTH)
+        listener = Listener(_addr(master_endpoint), authkey=_AUTH())
         my_ep = master_endpoint
     else:
         # bind all interfaces; advertise a cross-host-reachable address
         # (PADDLE_LOCAL_IP overrides; hostname lookup fallback)
         import socket as _socket
-        listener = Listener(("0.0.0.0", 0), authkey=_AUTH)
+        listener = Listener(("0.0.0.0", 0), authkey=_AUTH())
         host = os.environ.get("PADDLE_LOCAL_IP")
         if not host:
             try:
@@ -135,7 +139,7 @@ def init_rpc(name: str, rank: int = None, world_size: int = None,
     deadline = time.time() + 60
     while True:
         try:
-            c = Client(_addr(master_endpoint), authkey=_AUTH)
+            c = Client(_addr(master_endpoint), authkey=_AUTH())
             break
         except (ConnectionError, OSError):
             if time.time() > deadline:
@@ -172,7 +176,7 @@ def _call(to: str, fn, args, kwargs):
     info = _state.workers[to] if to in _state.workers else None
     if info is None:
         raise KeyError(f"rpc: unknown worker '{to}'")
-    c = Client(_addr(info.endpoint), authkey=_AUTH)
+    c = Client(_addr(info.endpoint), authkey=_AUTH())
     try:
         c.send(("call", fn, tuple(args or ()), dict(kwargs or {})))
         status, payload = c.recv()

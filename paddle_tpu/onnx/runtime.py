@@ -152,8 +152,9 @@ def run_graph(graph: dict, feeds: Dict[str, np.ndarray]):
             axes = a.get("axes")
             if axes is None and len(x) > 1:
                 axes = [int(d) for d in x[1]]
-            y = fn(x[0], axis=tuple(axes),
-                   keepdims=bool(a.get("keepdims", 0)))
+            # onnx defaults: omitted axes = reduce ALL dims; keepdims = 1
+            y = fn(x[0], axis=None if axes is None else tuple(axes),
+                   keepdims=bool(a.get("keepdims", 1)))
         elif op == "MaxPool":
             y = _pool2d(x[0], a["kernel_shape"], a["strides"],
                         a["pads"], op=np.max, init=-np.inf)
