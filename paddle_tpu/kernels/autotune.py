@@ -1,0 +1,174 @@
+"""Kernel block-size autotuning with a persisted cache
+(ref: paddle/phi/kernels/autotune/cache.cc + auto_tune_base.h — the
+reference keys tuned kernel configs by shape signature and caches them
+process-wide; here the cache also persists across processes as JSON so
+one sweep serves every later run on the same device kind).
+
+Design for the TPU tunnel: a single kernel launch costs ~4 ms of relay
+latency, so candidates are timed by running the op inside one jitted
+`lax.scan` loop (amortizes launch overhead) and synchronized with a
+host transfer (`float(x)`), which is the only reliable barrier over the
+tunnel. Sweeps run only when explicitly enabled (PADDLE_AUTOTUNE=1) or
+when `sweep=True` is passed — never silently during training; cached
+winners are consulted unconditionally.
+
+Layered lookup:
+  1. in-process memo
+  2. user cache file (PADDLE_AUTOTUNE_CACHE, default
+     ~/.paddle_tpu_autotune.json) — written by sweeps
+  3. shipped defaults (kernels/autotune_defaults.json) — curated
+     winners measured on real hardware, committed to the repo
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence
+
+__all__ = ["lookup", "record", "autotune", "cache_key", "device_kind"]
+
+_lock = threading.Lock()
+_memo: Dict[str, Any] = {}
+_user_cache: Optional[Dict[str, Any]] = None
+_defaults: Optional[Dict[str, Any]] = None
+
+_DEFAULTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "autotune_defaults.json")
+
+
+def _user_cache_path() -> str:
+    return os.environ.get(
+        "PADDLE_AUTOTUNE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".paddle_tpu_autotune.json"))
+
+
+def device_kind() -> str:
+    """Normalized device tag the cache is keyed under ('cpu' off-TPU)."""
+    try:
+        import jax
+        d = jax.devices()[0]
+        if d.platform != "tpu":
+            return d.platform
+        return getattr(d, "device_kind", "tpu").lower().replace(" ", "")
+    except Exception:
+        return "cpu"
+
+
+def cache_key(kernel: str, **shape_attrs) -> str:
+    """Stable key: kernel name + sorted shape/config attrs + device kind.
+    Keep attrs coarse (powers of two already quantize naturally) so one
+    sweep covers one (kernel, shape-class, device) point."""
+    parts = [kernel, device_kind()]
+    parts += [f"{k}={shape_attrs[k]}" for k in sorted(shape_attrs)]
+    return ":".join(parts)
+
+
+def _load(path: str) -> Dict[str, Any]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def lookup(key: str):
+    """Best-known config for `key`, or None. Never sweeps."""
+    global _user_cache, _defaults
+    with _lock:
+        if key in _memo:
+            return _memo[key]
+        if _user_cache is None:
+            _user_cache = _load(_user_cache_path())
+        if _defaults is None:
+            _defaults = _load(_DEFAULTS_PATH)
+        for store in (_user_cache, _defaults):
+            if key in store:
+                _memo[key] = store[key]["best"]
+                return _memo[key]
+    return None
+
+
+def record(key: str, best, timings_ms: Optional[Dict[str, float]] = None):
+    """Persist a sweep winner to the user cache (atomic rename)."""
+    global _user_cache
+    path = _user_cache_path()
+    with _lock:
+        if _user_cache is None:
+            _user_cache = _load(path)
+        _user_cache[key] = {"best": best}
+        if timings_ms:
+            _user_cache[key]["timings_ms"] = {
+                k: round(v, 4) for k, v in timings_ms.items()}
+        _memo[key] = best
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(_user_cache, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+
+def _time_candidate(fn: Callable[[], Any], iters: int) -> float:
+    """Median-of-3 wall time (ms per iteration) of a jitted loop."""
+    import time
+
+    import jax
+    fn()  # compile + warm
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = fn()
+        # host transfer is the only reliable sync over the axon tunnel
+        jax.tree_util.tree_map(
+            lambda x: float(x.reshape(-1)[0]) if hasattr(x, "reshape") else x,
+            out)
+        times.append((time.perf_counter() - t0) / iters)
+    times.sort()
+    return times[1] * 1e3
+
+
+def sweeps_enabled() -> bool:
+    return os.environ.get("PADDLE_AUTOTUNE", "0") == "1"
+
+
+def autotune(key: str, candidates: Sequence[Any],
+             make_fn: Callable[[Any], Optional[Callable[[], Any]]],
+             default: Any, iters: int = 8, sweep: Optional[bool] = None):
+    """Return the best config for `key`.
+
+    make_fn(candidate) returns a zero-arg callable running the op with
+    that config (typically a jitted lax.scan loop of `iters` steps), or
+    None / raises to skip the candidate. Cached winners are returned
+    without running anything UNLESS sweep=True is passed explicitly
+    (tools re-tuning after a kernel change must be able to re-measure);
+    sweep=None means "sweep only if PADDLE_AUTOTUNE=1 and nothing is
+    cached". Sweeps run only on a real accelerator (interpret-mode
+    timings are meaningless), and a sweep where every candidate failed
+    records NOTHING — the default must not masquerade as a winner.
+    """
+    forced = sweep is True
+    hit = lookup(key)
+    if hit is not None and not forced:
+        return hit
+    if sweep is None:
+        sweep = sweeps_enabled()
+    if not sweep or device_kind() == "cpu":
+        return hit if hit is not None else default
+    timings: Dict[str, float] = {}
+    best, best_t = default, float("inf")
+    for cand in candidates:
+        try:
+            fn = make_fn(cand)
+            if fn is None:
+                continue
+            t = _time_candidate(fn, iters)
+        except Exception:
+            continue  # candidate doesn't compile/fit — skip
+        timings[str(cand)] = t
+        if t < best_t:
+            best, best_t = cand, t
+    if timings:
+        record(key, best, timings)
+    return best

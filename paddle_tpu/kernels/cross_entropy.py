@@ -98,7 +98,14 @@ def _bwd_kernel(x_ref, lbl_ref, m_ref, l_ref, g_ref, dx_ref,
     dx_ref[...] = dx.astype(dx_ref.dtype)
 
 
-def _block_sizes(n, v):
+def _block_sizes(n, v, blocks=None):
+    """Token/vocab block sizes: explicit override (sweeps), else the
+    autotune cache winner for this (N, V) class, else the heuristic."""
+    if blocks is None:
+        from . import autotune
+        blocks = autotune.lookup(autotune.cache_key("fused_ce", N=n, V=v))
+    if blocks is not None:
+        return min(blocks[0], n), min(blocks[1], v)
     bn = 256 if n >= 256 else max(8, n)
     bv = 2048 if v >= 2048 else v
     return bn, bv
@@ -116,17 +123,18 @@ def _pallas_common(n, v, bn, bv):
     return pl, pltpu, grid, x_spec, row_spec, params
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def fused_cross_entropy(logits, labels, ignore_index=-100):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def fused_cross_entropy(logits, labels, ignore_index=-100, blocks=None):
     """Per-token CE loss [N] f32 from logits [N, V] + labels [N] int.
-    ignore_index rows get loss 0 (caller divides by the valid count)."""
-    loss, _ = _fwd(logits, labels, ignore_index)
+    ignore_index rows get loss 0 (caller divides by the valid count).
+    blocks: optional (bn, bv) override used by autotune sweeps."""
+    loss, _ = _fwd(logits, labels, ignore_index, blocks)
     return loss
 
 
-def _fwd(logits, labels, ignore_index):
+def _fwd(logits, labels, ignore_index, blocks=None):
     n, v = logits.shape
-    bn, bv = _block_sizes(n, v)
+    bn, bv = _block_sizes(n, v, blocks)
     pl, pltpu, grid, x_spec, row_spec, params = _pallas_common(n, v, bn, bv)
     lbl2 = labels.astype(jnp.int32).reshape(n, 1)
     kern = functools.partial(_fwd_kernel, v_total=v, bv=bv,
@@ -145,14 +153,14 @@ def _fwd(logits, labels, ignore_index):
     return loss[:, 0], (logits, lbl2, m, l)
 
 
-def _fwd_rule(logits, labels, ignore_index):
-    return _fwd(logits, labels, ignore_index)
+def _fwd_rule(logits, labels, ignore_index, blocks=None):
+    return _fwd(logits, labels, ignore_index, blocks)
 
 
-def _bwd_rule(ignore_index, res, g):
+def _bwd_rule(ignore_index, blocks, res, g):
     logits, lbl2, m, l = res
     n, v = logits.shape
-    bn, bv = _block_sizes(n, v)
+    bn, bv = _block_sizes(n, v, blocks)
     pl, pltpu, grid, x_spec, row_spec, params = _pallas_common(n, v, bn, bv)
     kern = functools.partial(_bwd_kernel, v_total=v, bv=bv,
                              ignore_index=ignore_index)
@@ -169,3 +177,34 @@ def _bwd_rule(ignore_index, res, g):
 
 
 fused_cross_entropy.defvjp(_fwd_rule, _bwd_rule)
+
+
+def sweep_block_sizes(N=8192, V=32000, dtype=jnp.bfloat16,
+                      candidates=None, iters=8, resweep=False):
+    """On-chip (bn, bv) sweep for the fused-CE kernel; winners persist in
+    the autotune cache (ref: phi/kernels/autotune/cache.cc). Tunes the
+    training shape: fwd + bwd under grad."""
+    from . import autotune
+
+    if candidates is None:
+        candidates = [(bn, bv)
+                      for bn in (128, 256, 512) if bn <= N
+                      for bv in (1024, 2048, 4096, 8192) if bv <= V]
+    key = autotune.cache_key("fused_ce", N=N, V=V)
+    kq = jax.random.split(jax.random.PRNGKey(0), 2)
+    logits = jax.random.normal(kq[0], (N, V), dtype)
+    labels = jax.random.randint(kq[1], (N,), 0, V)
+
+    def make_fn(cand):
+        def body(c, _):
+            f = lambda x: fused_cross_entropy(x, labels, -100,
+                                              tuple(cand)).sum()
+            return c + jax.grad(f)(logits).astype(jnp.float32).sum(), None
+
+        return jax.jit(lambda: jax.lax.scan(
+            body, jnp.float32(0), None, length=iters)[0])
+
+    return autotune.autotune(
+        key, candidates, make_fn, default=list(_block_sizes(N, V)),
+        iters=iters,
+        sweep=True if (resweep or autotune.lookup(key) is None) else None)

@@ -1,15 +1,22 @@
 """Flash attention on TPU (ref: phi/kernels/gpu/flash_attn_kernel.cu +
-third_party flashattn — re-designed for TPU, not ported).
+third_party flashattn — re-designed for TPU, not ported; the reference
+kernel's MQA/GQA + bias support is matched here, flash_attn_kernel.cu
+accepts num_heads_k != num_heads and an attn additive mask).
 
-Strategy: use the tuned in-tree Pallas TPU kernel
-(jax.experimental.pallas.ops.tpu.flash_attention) when on TPU and shapes are
-tile-aligned; it implements the same online-softmax blocked algorithm as
-FlashAttention-2 with MXU-shaped (block_q x block_k) tiles and VMEM
-double-buffering. Causal masking is handled natively by the kernel (blocks
-above the diagonal are skipped, so causal is FASTER, not gated out), and
-padding masks map onto the kernel's segment-id mechanism. A custom
-ring-attention kernel for the `sep` axis lives in ring_attention.py
-(reference has NO equivalent — SURVEY §5 long-context).
+Three routes, all Pallas:
+- MHA (q_heads == kv_heads): the tuned in-tree TPU flash kernel
+  (jax.experimental.pallas.ops.tpu.flash_attention) — online-softmax
+  MXU-shaped tiles, native causal block skipping, segment-id padding
+  masks, and an additive-bias operand (`ab`) for arbitrary masks.
+- GQA/MQA causal/full without bias: the splash kernel in MQA mode,
+  vmapped over kv heads with q grouped [kv_heads, group, Sq, D] — no
+  materialized kv repeat, and block-sparse causal skipping.
+- GQA with bias: kv heads broadcast to q heads (autodiff sums the kv
+  grads over the group), then the MHA route — still the flash kernel,
+  never the O(S^2) dense fallback.
+
+Block sizes come from the autotune cache (kernels/autotune.py) when a
+sweep has recorded a winner for the shape class, else a 512 heuristic.
 """
 from __future__ import annotations
 
@@ -32,31 +39,43 @@ def _on_tpu() -> bool:
 
 
 def supported(q_shape, k_shape, causal_or_none: bool,
-              has_padding_mask: bool = False) -> bool:
-    """True when flash_attention_bshd will hit the Pallas kernel.
+              has_padding_mask: bool = False,
+              has_bias: bool = False) -> bool:
+    """True when flash_attention_bshd will hit a Pallas kernel.
 
-    `causal_or_none`: mask is either causal or absent (anything else —
-    arbitrary additive masks — must go through `bias=`, which we route to
-    the dense path). Padding masks are fine (segment ids).
+    `causal_or_none`: mask is either causal or absent. Arbitrary
+    additive masks route through `bias=` (the kernel's ab operand), so
+    pass has_bias=True for those instead of returning False. Padding
+    masks map to segment ids. GQA/MQA (q_heads a multiple of kv_heads)
+    is first-class.
     """
-    del has_padding_mask  # handled via segment ids — no longer gated out
+    del has_padding_mask  # handled via segment ids — never gated out
     if not _on_tpu():
         return False
-    if not causal_or_none:
-        return False
-    B, Sq, H, D = q_shape
+    if not causal_or_none and not has_bias:
+        return False  # non-causal non-bias masks must come in as bias
+    B, Sq, Hq, D = q_shape
+    Hk = k_shape[2]
     Sk = k_shape[1]
     # kernel pads D <= 128 up to the lane width; above that it requires an
     # exact multiple of 128 (so 192/320 must take the dense fallback)
     d_ok = (D % 64 == 0) if D <= 128 else (D % 128 == 0)
-    return (d_ok and Sq % _SEQ_ALIGN == 0
-            and Sk % _SEQ_ALIGN == 0 and q_shape[2] == k_shape[2])
+    return (d_ok and Sq % _SEQ_ALIGN == 0 and Sk % _SEQ_ALIGN == 0
+            and Hq % Hk == 0)
 
 
-def _block_sizes(Sq, Sk):
+def _block_sizes(Sq, Sk, D, causal, blocks=None):
+    """Flash BlockSizes: explicit override (sweeps), else the autotune
+    cache winner for this shape class, else 512-square."""
     from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
-    bq = min(512, Sq)
-    bk = min(512, Sk)
+
+    from . import autotune
+    if blocks is None:
+        default = (min(512, Sq), min(512, Sk))
+        key = autotune.cache_key("flash", Sq=Sq, Sk=Sk, D=D,
+                                 causal=int(causal))
+        blocks = autotune.lookup(key) or default
+    bq, bk = min(blocks[0], Sq), min(blocks[1], Sk)
     return BlockSizes(
         block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
         block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
@@ -64,13 +83,72 @@ def _block_sizes(Sq, Sk):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale"))
-def flash_attention_bshd(q, k, v, causal=False, scale=None, padding_mask=None):
+def _splash_block_sizes(Sq, Sk, D, blocks=None):
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk)
+
+    from . import autotune
+    if blocks is None:
+        default = (min(512, Sq), min(512, Sk))
+        key = autotune.cache_key("splash", Sq=Sq, Sk=Sk, D=D)
+        blocks = autotune.lookup(key) or default
+    bq, bk = min(blocks[0], Sq), min(blocks[1], Sk)
+    return sk.BlockSizes(block_q=bq, block_kv=bk, block_kv_compute=bk,
+                         block_q_dkv=bq, block_kv_dkv=bk,
+                         block_kv_dkv_compute=bk,
+                         block_q_dq=bq, block_kv_dq=bk)
+
+
+def _splash_gqa(qt, kt, vt, causal, scale, padding_mask, interpret=False,
+                blocks=None):
+    """GQA via splash MQA mode: qt [B, Hq, Sq, D], kt/vt [B, Hk, Sk, D].
+    No kv repeat materializes; the group dim rides the kernel's q-head
+    axis (is_mqa=True shares one kv head across it)."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk)
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_mask as sm)
+
+    B, Hq, Sq, D = qt.shape
+    Hk, Sk = kt.shape[1], kt.shape[2]
+    group = Hq // Hk
+    mask_cls = sm.CausalMask((Sq, Sk)) if causal else sm.FullMask((Sq, Sk))
+    mask = sm.MultiHeadMask([mask_cls] * group)
+    kernel = sk.make_splash_mqa_single_device(
+        mask, block_sizes=_splash_block_sizes(Sq, Sk, D, blocks),
+        interpret=interpret)
+    # splash takes pre-scaled q and no sm_scale argument
+    qg = (qt * scale).reshape(B, Hk, group, Sq, D)
+    seg = None
+    if padding_mask is not None:
+        kv_seg = jnp.where(padding_mask.astype(bool), 1, 0).astype(jnp.int32)
+        q_seg = kv_seg if Sq == Sk else jnp.ones((B, Sq), jnp.int32)
+        seg = sk.SegmentIds(q=q_seg, kv=kv_seg)
+    # vmap over batch, then kv heads (q grouped per kv head)
+    run = jax.vmap(  # batch
+        jax.vmap(kernel, in_axes=(0, 0, 0, None)),  # kv heads
+        in_axes=(0, 0, 0, 0))
+    out = run(qg, kt, vt, seg)  # [B, Hk, group, Sq, D]
+    return out.reshape(B, Hq, Sq, D)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "interpret", "blocks"))
+def flash_attention_bshd(q, k, v, causal=False, scale=None,
+                         padding_mask=None, bias=None, interpret=False,
+                         blocks=None):
     """[batch, seq, heads, dim] in/out (paddle flash_attn layout).
 
     padding_mask: optional [batch, kv_seq] bool/int array, True/1 = valid
-    token. Lowered to the kernel's segment-id masking (pad tokens get a
-    distinct segment so nothing attends to or from them).
+    token — lowered to segment-id masking. bias: optional additive mask
+    broadcastable to [batch, heads, Sq, Sk] — streamed blockwise through
+    the kernel's ab operand (never a dense-softmax fallback). The kernel
+    requires ab at FULL [B, H, Sq, Sk] f32, so a broadcast-narrow bias
+    is materialized here; that matches the dense path's score-matrix
+    footprint while keeping flash compute, but pure kv padding should
+    come in as padding_mask (segment ids), not bias. GQA/MQA (q heads a
+    multiple of kv heads) is handled without materializing a kv repeat
+    when bias is None.
     """
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         SegmentIds, flash_attention)
@@ -79,15 +157,82 @@ def flash_attention_bshd(q, k, v, causal=False, scale=None, padding_mask=None):
     qt = jnp.swapaxes(q, 1, 2)  # BHSD
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    Sq, Sk = qt.shape[2], kt.shape[2]
+    B, Hq, Sq, D = qt.shape
+    Hk, Sk = kt.shape[1], kt.shape[2]
+
+    if Hq != Hk and bias is None:
+        out = _splash_gqa(qt, kt, vt, causal, scale, padding_mask,
+                          interpret=interpret, blocks=blocks)
+        return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+    if Hq != Hk:
+        # bias path needs the MHA kernel: broadcast kv over the group
+        # (cheap reshape-broadcast; autodiff reduces kv grads over it)
+        group = Hq // Hk
+        kt = jnp.broadcast_to(kt[:, :, None], (B, Hk, group, Sk, D)
+                              ).reshape(B, Hq, Sk, D)
+        vt = jnp.broadcast_to(vt[:, :, None], (B, Hk, group, Sk, D)
+                              ).reshape(B, Hq, Sk, D)
+
     seg = None
     if padding_mask is not None:
         kv_seg = jnp.where(padding_mask.astype(bool), 1, 0).astype(jnp.int32)
-        if Sq == Sk:
-            q_seg = kv_seg
-        else:
-            q_seg = jnp.ones((q.shape[0], Sq), jnp.int32)
+        q_seg = kv_seg if Sq == Sk else jnp.ones((B, Sq), jnp.int32)
         seg = SegmentIds(q=q_seg, kv=kv_seg)
-    out = flash_attention(qt, kt, vt, segment_ids=seg, causal=causal,
-                          sm_scale=scale, block_sizes=_block_sizes(Sq, Sk))
+    ab = None
+    if bias is not None:
+        ab = jnp.broadcast_to(bias.astype(jnp.float32),
+                              (B, Hq, Sq, Sk))
+    out = flash_attention(qt, kt, vt, ab=ab, segment_ids=seg, causal=causal,
+                          sm_scale=scale,
+                          block_sizes=_block_sizes(Sq, Sk, D, causal,
+                                                   blocks))
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def sweep_block_sizes(Sq=2048, Sk=2048, D=128, H=16, B=4, causal=True,
+                      kv_heads=None, dtype=jnp.bfloat16, candidates=None,
+                      iters=8, resweep=False):
+    """On-chip block-size sweep; winners persist in the autotune cache
+    (ref: phi/kernels/autotune/cache.cc). Run from bench tooling with
+    PADDLE_AUTOTUNE=1, never during training. kv_heads != H tunes the
+    splash GQA route (its own cache key) — the route a GQA model will
+    actually take. resweep=True re-measures over a cached winner."""
+    from . import autotune
+
+    if candidates is None:
+        candidates = [(bq, bk)
+                      for bq in (256, 512, 1024) if bq <= Sq
+                      for bk in (256, 512, 1024) if bk <= Sk]
+    Hk = kv_heads or H
+    if Hk != H:
+        key = autotune.cache_key("splash", Sq=Sq, Sk=Sk, D=D)
+    else:
+        key = autotune.cache_key("flash", Sq=Sq, Sk=Sk, D=D,
+                                 causal=int(causal))
+    kq = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq[0], (B, Sq, H, D), dtype)
+    k = jax.random.normal(kq[1], (B, Sk, Hk, D), dtype)
+    v = jax.random.normal(kq[2], (B, Sk, Hk, D), dtype)
+
+    def make_fn(cand):
+        bq, bk = cand
+        if Sq % bq or Sk % bk:
+            return None
+
+        def body(c, _):
+            # grad-through to tune fwd+bwd together (training shape);
+            # blocks as a static arg forces a fresh trace per candidate
+            f = lambda q_: flash_attention_bshd(
+                q_, k, v, causal=causal,
+                blocks=(bq, bk)).astype(jnp.float32).sum()
+            return c + jax.grad(f)(q).astype(jnp.float32).sum(), None
+
+        loop = jax.jit(lambda: jax.lax.scan(
+            body, jnp.float32(0), None, length=iters)[0])
+        return loop
+
+    return autotune.autotune(
+        key, candidates, make_fn,
+        default=[min(512, Sq), min(512, Sk)], iters=iters,
+        sweep=True if (resweep or autotune.lookup(key) is None) else None)

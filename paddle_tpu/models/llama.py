@@ -126,12 +126,14 @@ class LlamaAttention(Layer):
             from ..kernels import flash_attention as fa
             from ..kernels.rope import apply_rope
             q, k = apply_rope(q, k, base=cfg.rope_theta)
-            if kvh != nh:  # GQA: broadcast kv heads
+            # GQA/MQA is native in the kernel wrapper (splash MQA mode —
+            # no materialized kv repeat); dense fallback broadcasts
+            if fa.supported(q.shape, k.shape, True):
+                return fa.flash_attention_bshd(q, k, v, causal=True)
+            if kvh != nh:
                 rep = nh // kvh
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
-            if fa.supported(q.shape, k.shape, True):
-                return fa.flash_attention_bshd(q, k, v, causal=True)
             return _sdpa(q, k, v)
 
         if cfg.fuse_attention_qkv:

@@ -62,6 +62,17 @@ def _as_padding_mask(mask, batch, kv_len):
     return flat
 
 
+def _bias_broadcastable(mask_shape, q_shape, k_shape) -> bool:
+    """mask broadcastable to [B, H, Sq, Sk] (numpy rules, trailing dims)."""
+    target = (q_shape[0], q_shape[2], q_shape[1], k_shape[1])
+    if len(mask_shape) > 4:
+        return False
+    for got, want in zip(reversed(mask_shape), reversed(target)):
+        if got != 1 and got != want:
+            return False
+    return True
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
                                  name=None):
@@ -71,28 +82,45 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
     use_pallas = False
     pad_convertible = False
+    bias_route = False
     try:
         from ...kernels import flash_attention as fa
         raw_mask = unwrap(attn_mask) if attn_mask is not None else None
         if raw_mask is not None:
             pad_convertible = _as_padding_mask(
                 raw_mask, q.shape[0], k.shape[1]) is not None
-        use_pallas = fa.supported(q.shape, k.shape,
-                                  attn_mask is None or pad_convertible)
+            # anything broadcastable to [B, H, Sq, Sk] that is NOT a pure
+            # kv padding mask rides the kernel's additive-bias operand —
+            # never a silent dense fallback (ref flash_attn_kernel.cu
+            # accepts an attn_mask tensor the same way)
+            bias_route = (not pad_convertible and raw_mask.ndim <= 4
+                          and _bias_broadcastable(
+                              raw_mask.shape, q.shape, k.shape))
+        use_pallas = fa.supported(
+            q.shape, k.shape, attn_mask is None or pad_convertible,
+            has_bias=bias_route)
     except Exception:
         use_pallas = False
 
     if use_pallas and dropout_p == 0.0:
         from ...kernels import flash_attention as fa
-        if attn_mask is not None:
-            B, Sk = q.shape[0], k.shape[1]
-
+        B, Sk = q.shape[0], k.shape[1]
+        if attn_mask is not None and pad_convertible:
             def _flash_masked(a, b, c, m):
                 return fa.flash_attention_bshd(
                     a, b, c, causal=is_causal, scale=scale,
                     padding_mask=_as_padding_mask(m, B, Sk))
 
             return apply_op(_flash_masked, q, k, v, to_tensor_like(attn_mask),
+                            name="flash_attention")
+        if attn_mask is not None:  # bias route
+            def _flash_bias(a, b, c, m):
+                bias = (jnp.where(m, 0.0, -1e30).astype(jnp.float32)
+                        if m.dtype == jnp.bool_ else m)
+                return fa.flash_attention_bshd(
+                    a, b, c, causal=is_causal, scale=scale, bias=bias)
+
+            return apply_op(_flash_bias, q, k, v, to_tensor_like(attn_mask),
                             name="flash_attention")
         return apply_op(lambda a, b, c: fa.flash_attention_bshd(
             a, b, c, causal=is_causal, scale=scale), q, k, v,

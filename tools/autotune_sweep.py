@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""On-chip kernel block-size sweep (ref: paddle/phi/kernels/autotune/ —
+the reference tunes kernel configs at runtime and caches them; here the
+sweep is an explicit tool run on the real chip, and winners persist in
+the autotune cache consulted by every later run).
+
+Usage (on TPU):
+    PADDLE_AUTOTUNE=1 python tools/autotune_sweep.py [--model 350m|1b|7b]
+
+Sweeps the flash-attention and fused-CE kernels at the bench shapes of
+the chosen model config, prints winners + timings, and leaves them in
+PADDLE_AUTOTUNE_CACHE (default ~/.paddle_tpu_autotune.json). Copy the
+result into paddle_tpu/kernels/autotune_defaults.json to ship it.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="350m",
+                    choices=["350m", "1b", "7b"])
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--resweep", action="store_true",
+                    help="re-measure even over a cached winner")
+    args = ap.parse_args()
+
+    os.environ.setdefault("PADDLE_AUTOTUNE", "1")
+
+    import jax
+    if jax.devices()[0].platform != "tpu":
+        print("not on TPU — sweep timings would be meaningless; aborting",
+              file=sys.stderr)
+        return 1
+
+    from paddle_tpu.kernels import autotune
+    from paddle_tpu.kernels import cross_entropy as ce
+    from paddle_tpu.kernels import flash_attention as fa
+    from paddle_tpu.models import llama as L
+
+    cfg = {"350m": L.llama_350m, "1b": L.llama_1b, "7b": L.llama_7b}[
+        args.model]()
+    S, B = args.seq, args.batch
+    H, D = cfg.num_attention_heads, cfg.head_dim
+    results = {}
+
+    best = fa.sweep_block_sizes(Sq=S, Sk=S, D=D, H=H, B=B, causal=True,
+                                resweep=args.resweep)
+    results[f"flash S={S} D={D}"] = best
+    print("flash winner:", best, flush=True)
+
+    if cfg.kv_heads != H:  # GQA config: tune the splash route it takes
+        best = fa.sweep_block_sizes(Sq=S, Sk=S, D=D, H=H, B=B, causal=True,
+                                    kv_heads=cfg.kv_heads,
+                                    resweep=args.resweep)
+        results[f"splash S={S} D={D}"] = best
+        print("splash winner:", best, flush=True)
+
+    best = ce.sweep_block_sizes(N=B * S, V=cfg.vocab_size,
+                                resweep=args.resweep)
+    results[f"fused_ce N={B*S} V={cfg.vocab_size}"] = best
+    print("fused_ce winner:", best, flush=True)
+
+    print(json.dumps({"device": autotune.device_kind(),
+                      "winners": results}))
+    print(f"cache: {os.environ.get('PADDLE_AUTOTUNE_CACHE') or '~/.paddle_tpu_autotune.json'}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
