@@ -132,7 +132,7 @@ class PipelineLayer(Layer):
                     f"pipeline middle has {best_len} identical blocks, not "
                     f"divisible into {S} stages — falling back to the "
                     "heterogeneous engine (slower: per-stage switch "
-                    "branches, no VPP). Prefer a block count divisible by "
+                    "branches). Prefer a block count divisible by "
                     "num_stages.", stacklevel=3)
             # non-uniform middle: fall back to heterogeneous per-stage
             # segmentation (ref pp_layers.py seg_method "param": balance

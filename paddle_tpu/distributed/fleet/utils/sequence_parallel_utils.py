@@ -42,18 +42,34 @@ __all__ = [
 _SEQ_AXIS = 1  # [batch, seq, hidden]
 
 
+def _act_spec(shape, seq_axis=None):
+    """Full activation layout: batch over the data axes (those dividing
+    the batch size — eager constraints require divisibility), seq over
+    `mp` (when seq_axis given), rest replicated. Fully specified so the
+    checkpointed backward reshards along the SAME layout instead of
+    triggering GSPMD's replicate-everything fallback (driver dryrun
+    '[SPMD] Involuntary full rematerialization' warning)."""
+    from ...sharding import data_axes_for
+    nd = len(shape)
+    spec = [None] * nd
+    if nd > 0:
+        da = data_axes_for(int(shape[0]))
+        if da:
+            spec[0] = da
+    if seq_axis is not None:
+        spec[seq_axis] = "mp"
+    return P(*spec)
+
+
 def scatter(x, axis=_SEQ_AXIS):
     """Shard the sequence dim over `mp` (ref ScatterOp: split + keep own
     shard; here a resharding constraint)."""
-    nd = x.ndim
-    spec = [None] * nd
-    spec[axis] = "mp"
-    return with_partial_annotation(x, P(*spec))
+    return with_partial_annotation(x, _act_spec(x.shape, seq_axis=axis))
 
 
 def all_gather(x, axis=_SEQ_AXIS):
     """Re-replicate the sequence dim (ref GatherOp / AllGatherOp)."""
-    return with_partial_annotation(x, P(*([None] * x.ndim)))
+    return with_partial_annotation(x, _act_spec(x.shape))
 
 
 # reference class-style aliases (autograd pairs are implicit here)
@@ -122,9 +138,9 @@ class ColumnSequenceParallelLinear(Layer):
         out = F.linear(x, self.weight, self.bias)
         nd = out.ndim
         if self.gather_output:
-            out = with_partial_annotation(out, P(*([None] * nd)))
+            out = with_partial_annotation(out, _act_spec(out.shape))
         else:
-            spec = [None] * nd
+            spec = list(_act_spec(out.shape))
             spec[-1] = "mp"
             out = with_partial_annotation(out, P(*spec))
         return out
@@ -149,8 +165,7 @@ class RowSequenceParallelLinear(Layer):
 
     def forward(self, x):
         x = to_tensor_like(x)
-        nd = x.ndim
-        spec = [None] * nd
+        spec = list(_act_spec(x.shape))
         spec[-1] = "mp"
         x = with_partial_annotation(x, P(*spec))
         out = F.linear(x, self.weight, self.bias)

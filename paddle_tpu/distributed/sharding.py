@@ -191,6 +191,38 @@ def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
     return shard_tensor(t, mesh, placements)
 
 
+def data_axes() -> tuple:
+    """Mesh axes that carry the batch dim of activations (dp + the
+    ZeRO sharding axis, which is data-parallel for activations). Used to
+    FULLY pin activation layouts at resharding boundaries — a partial
+    constraint (batch dim None) lets GSPMD invent a different layout in
+    the checkpointed backward and fall into 'involuntary full
+    rematerialization' at the boundary collective."""
+    from .topology import get_mesh
+    mesh = get_mesh()
+    if mesh is None:
+        return ()
+    return tuple(a for a in ("dp", "sharding")
+                 if a in mesh.axis_names and mesh.shape[a] > 1)
+
+
+def data_axes_for(dim_size: int, mesh=None) -> tuple:
+    """data_axes() greedily restricted to axes whose running product
+    still divides `dim_size` — sharding constraints applied EAGERLY
+    (outside jit) and jit in_shardings hard-require divisibility."""
+    from .topology import get_mesh
+    mesh = mesh if mesh is not None else get_mesh()
+    if mesh is None:
+        return ()
+    axes, prod = [], 1
+    for a in ("dp", "sharding"):
+        if a in mesh.axis_names and mesh.shape[a] > 1 \
+                and dim_size % (prod * mesh.shape[a]) == 0:
+            axes.append(a)
+            prod *= mesh.shape[a]
+    return tuple(axes)
+
+
 def with_partial_annotation(x, spec: P):
     """with_sharding_constraint inside compiled programs.
 
