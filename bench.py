@@ -108,12 +108,17 @@ def _init_devices():
     import threading
 
     # the helper gate only applies when the axon tunnel backend is in
-    # play (sitecustomize pins jax_platforms to "axon,cpu"); a plain
-    # CPU/GPU host must just init normally
+    # play: pinned via jax_platforms (sitecustomize sets "axon,cpu"), or
+    # auto-detectable with platforms unset (the plugin registers itself
+    # whenever PALLAS_AXON_POOL_IPS is exported). A plain CPU/GPU host
+    # must just init normally.
     import jax
     platforms = (jax.config.jax_platforms
                  or os.environ.get("JAX_PLATFORMS", "") or "")
-    if ("axon" in platforms and not os.environ.get("BENCH_NO_FALLBACK")
+    axon_in_play = ("axon" in platforms
+                    or (not platforms
+                        and bool(os.environ.get("PALLAS_AXON_POOL_IPS"))))
+    if (axon_in_play and not os.environ.get("BENCH_NO_FALLBACK")
             and not _helper_alive()):
         _emit_stale_or_cpu(
             "axon compile helper (127.0.0.1:8083) is down — TPU compiles "

@@ -14,10 +14,16 @@ from .cost_model import (  # noqa: F401
     estimate_config_cost, estimate_flops)
 from .engine import Engine, Strategy  # noqa: F401
 from .planner import PlanChoice, Planner  # noqa: F401
+from .spmd_rules import (  # noqa: F401
+    DistAttr, elementwise_rule, embedding_rule, flash_attention_rule,
+    layer_norm_rule, matmul_rule, reduction_rule, reshard_cost_bytes,
+    softmax_rule)
 
 __all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
            "shard_tensor", "reshard", "dtensor_from_fn", "Engine",
            "Strategy", "complete", "CompletionReport", "ModelStats",
            "HardwareSpec", "CostEstimate", "comm_bytes", "comm_time",
            "estimate_flops", "estimate_config_cost", "Planner",
-           "PlanChoice"]
+           "PlanChoice", "DistAttr", "matmul_rule", "embedding_rule",
+           "layer_norm_rule", "flash_attention_rule", "elementwise_rule",
+           "reduction_rule", "softmax_rule", "reshard_cost_bytes"]

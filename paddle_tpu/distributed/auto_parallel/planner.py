@@ -74,3 +74,42 @@ class Planner:
     def plan(self) -> Optional[PlanChoice]:
         ranked = self.ranking()
         return ranked[0] if ranked else None
+
+    def measure_rank(self, measure_fn, top_k: int = 3,
+                     repeats: int = 2) -> List[PlanChoice]:
+        """Measure the estimator's top-k candidates with REAL step times
+        and re-rank by measurement (ref: tuner/parallel_tuner.py — the
+        reference also falls back to running trials because estimates
+        cannot fully order close candidates).
+
+        measure_fn(config) -> step-seconds for one config (the caller
+        builds the mesh/TrainStep and times a post-compile step), or
+        raises/returns None to disqualify it. The measured time is
+        stored on each PlanChoice as .measured_s; the returned list is
+        ordered by it."""
+        ranked = self.ranking()[:top_k]
+        out = []
+        for choice in ranked:
+            times = []
+            for _ in range(repeats):
+                try:
+                    t = measure_fn(dict(choice.config))
+                except Exception:
+                    t = None
+                if t is None:
+                    times = []
+                    break
+                times.append(float(t))
+            if not times:
+                continue
+            choice.measured_s = min(times)
+            out.append(choice)
+        out.sort(key=lambda p: p.measured_s)
+        return out
+
+    def plan_measured(self, measure_fn, top_k: int = 3) -> Optional[PlanChoice]:
+        """Best candidate by MEASURED step time (estimator prunes to
+        top_k, measurement decides). Falls back to plan() if nothing
+        measures successfully."""
+        measured = self.measure_rank(measure_fn, top_k=top_k)
+        return measured[0] if measured else self.plan()

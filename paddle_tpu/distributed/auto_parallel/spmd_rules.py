@@ -1,0 +1,243 @@
+"""Per-op SPMD sharding-propagation rules
+(ref: paddle/phi/infermeta/spmd_rules/ — matmul.cc, embedding.cc,
+flash_attention.cc, layer_norm.cc; rules.h registry. The reference
+infers output TensorDistAttrs from input dims_mappings and resolves
+conflicts; tests in test/auto_parallel/spmd_rules/).
+
+TPU-native role: GSPMD performs propagation inside XLA at compile time,
+but the PLANNER needs shardings *before* compiling — to price resharding,
+detect partial-sums (pending allreduces), and rank plans. These rules are
+that compile-free propagation layer: pure functions from input DistAttrs
+to (resolved input attrs, output attrs), mirroring the reference's
+InferForward contract.
+
+DistAttr model (matches the reference's TensorDistAttr essentials):
+  dims_mapping[i] = mesh-axis NAME sharding tensor dim i, or None
+  partial        = set of mesh-axis names over which values are
+                   partial-sums awaiting an all_reduce
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Set, Tuple
+
+__all__ = ["DistAttr", "matmul_rule", "embedding_rule", "layer_norm_rule",
+           "flash_attention_rule", "elementwise_rule", "reduction_rule",
+           "softmax_rule", "reshard_cost_bytes"]
+
+
+@dataclass
+class DistAttr:
+    """Sharding of one tensor over named mesh axes."""
+    dims_mapping: List[Optional[str]]
+    partial: Set[str] = field(default_factory=set)
+
+    @classmethod
+    def replicated(cls, ndim: int) -> "DistAttr":
+        return cls([None] * ndim)
+
+    @property
+    def ndim(self):
+        return len(self.dims_mapping)
+
+    def axis(self, i) -> Optional[str]:
+        return self.dims_mapping[i]
+
+    def used_axes(self) -> Set[str]:
+        return {a for a in self.dims_mapping if a is not None} | self.partial
+
+    def __repr__(self):
+        dm = ",".join(a or "-" for a in self.dims_mapping)
+        p = f" partial={sorted(self.partial)}" if self.partial else ""
+        return f"DistAttr[{dm}]{p}"
+
+
+def _merge(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    """Resolve one dim's sharding across two tensors (ref
+    ShardingMergeForTensors): equal wins, one-sided wins, conflict
+    resolves to the FIRST operand's choice (the reference picks by
+    higher sharding count; first-operand is our deterministic tiebreak)."""
+    if a == b:
+        return a
+    if a is None:
+        return b
+    return a
+
+
+def matmul_rule(x: DistAttr, y: DistAttr,
+                trans_x: bool = False, trans_y: bool = False
+                ) -> Tuple[Tuple[DistAttr, DistAttr], DistAttr]:
+    """[..., m, k] @ [..., k, n] -> [..., m, n]
+    (ref: phi/infermeta/spmd_rules/matmul.cc MatmulInferSpmd).
+
+    Rules: batch dims merge elementwise; m follows x, n follows y; a
+    k-dim sharded identically on both sides contracts into a PARTIAL
+    output over that axis (the pending allreduce the planner prices);
+    conflicting k shardings resolve to x's (y is resharded).
+    """
+    xm = list(x.dims_mapping)
+    ym = list(y.dims_mapping)
+    if trans_x:
+        xm[-1], xm[-2] = xm[-2], xm[-1]
+    if trans_y:
+        ym[-1], ym[-2] = ym[-2], ym[-1]
+    nb = max(len(xm), len(ym)) - 2          # broadcast batch dims
+    xb = [None] * (nb - (len(xm) - 2)) + xm[:-2]
+    yb = [None] * (nb - (len(ym) - 2)) + ym[:-2]
+    batch = [_merge(a, b) for a, b in zip(xb, yb)]
+    m, n = xm[-2], ym[-1]
+    k = _merge(xm[-1], ym[-2])
+    # an axis cannot shard two different output dims: later claimants
+    # (m vs batch, n vs batch/m, k vs all) fall back to replicated
+    used = set(a for a in batch if a is not None)
+    if m in used:
+        m = None
+    used |= {m} - {None}
+    if n in used:
+        n = None
+    if k in used or k == n:
+        k = None
+    out = DistAttr(batch + [m, n],
+                   partial=({k} if k is not None else set())
+                   | x.partial | y.partial)
+    rx = DistAttr(xb + [m, k])
+    ry = DistAttr(yb + [k, n])
+    if trans_x:
+        rx.dims_mapping[-1], rx.dims_mapping[-2] = \
+            rx.dims_mapping[-2], rx.dims_mapping[-1]
+    if trans_y:
+        ry.dims_mapping[-1], ry.dims_mapping[-2] = \
+            ry.dims_mapping[-2], ry.dims_mapping[-1]
+    return (rx, ry), out
+
+
+def embedding_rule(table: DistAttr, ids: DistAttr
+                   ) -> Tuple[Tuple[DistAttr, DistAttr], DistAttr]:
+    """table [V, H], ids [...] -> out [..., H]
+    (ref: spmd_rules/embedding.cc EmbeddingInferSpmd).
+
+    Row-parallel table (vocab dim sharded, mp VocabParallelEmbedding):
+    out is PARTIAL over that axis (each shard contributes masked rows,
+    allreduce pending). Column-parallel table: out hidden dim sharded.
+    ids shardings propagate to the leading out dims."""
+    v_ax, h_ax = table.dims_mapping
+    out_dm = list(ids.dims_mapping) + [h_ax]
+    partial = set(table.partial) | set(ids.partial)
+    if v_ax is not None:
+        partial.add(v_ax)
+    return (DistAttr(list(table.dims_mapping)),
+            DistAttr(list(ids.dims_mapping))), DistAttr(out_dm, partial)
+
+
+def layer_norm_rule(x: DistAttr, begin_norm_axis: Optional[int] = None
+                    ) -> Tuple[DistAttr, DistAttr]:
+    """Normalized dims must be unsharded; leading dims propagate
+    (ref: spmd_rules/layer_norm.cc LayerNormInferSpmd)."""
+    if begin_norm_axis is None:
+        begin_norm_axis = x.ndim - 1
+    dm = [a if i < begin_norm_axis else None
+          for i, a in enumerate(x.dims_mapping)]
+    rx = DistAttr(dm, set(x.partial))
+    return rx, DistAttr(list(dm), set(x.partial))
+
+
+def flash_attention_rule(q: DistAttr, k: DistAttr, v: DistAttr,
+                         sep_axis: Optional[str] = None
+                         ) -> Tuple[Tuple[DistAttr, DistAttr, DistAttr],
+                                    DistAttr]:
+    """[B, S, H, D] q/k/v -> out [B, S, H, D]
+    (ref: spmd_rules/flash_attention.cc FlashAttInferSpmd).
+
+    batch and heads dims shard freely (merged across q/k/v); head_dim
+    must be replicated; the kv sequence dim must be replicated UNLESS it
+    is the ring-attention `sep` axis (sequence parallelism handled by the
+    ring schedule, exceeding the reference, which forbids seq sharding).
+    q's seq dim may stay sharded over sep as well."""
+    b = _merge(_merge(q.axis(0), k.axis(0)), v.axis(0))
+    h = _merge(_merge(q.axis(2), k.axis(2)), v.axis(2))
+    if h == b:
+        h = None
+    sq = q.axis(1) if q.axis(1) == sep_axis else None
+    sk = k.axis(1) if k.axis(1) == sep_axis else None
+    rq = DistAttr([b, sq, h, None])
+    rk = DistAttr([b, sk, h, None])
+    rv = DistAttr([b, sk, h, None])
+    out = DistAttr([b, sq, h, None],
+                   set(q.partial) | set(k.partial) | set(v.partial))
+    return (rq, rk, rv), out
+
+
+def elementwise_rule(*xs: DistAttr) -> Tuple[Tuple[DistAttr, ...], DistAttr]:
+    """Broadcast elementwise: dims merge right-aligned
+    (ref: spmd_rules/elementwise.cc)."""
+    nd = max(x.ndim for x in xs)
+    dm: List[Optional[str]] = [None] * nd
+    for x in xs:
+        off = nd - x.ndim
+        for i, a in enumerate(x.dims_mapping):
+            dm[off + i] = _merge(dm[off + i], a)
+    partial = set().union(*(x.partial for x in xs))
+    rs = tuple(DistAttr(dm[nd - x.ndim:], set(x.partial)) for x in xs)
+    return rs, DistAttr(dm, partial)
+
+
+def reduction_rule(x: DistAttr, axes: Sequence[int], keepdim: bool = False
+                   ) -> Tuple[DistAttr, DistAttr]:
+    """Reducing a sharded dim makes the output PARTIAL over its axis
+    (ref: spmd_rules/reduction.cc)."""
+    axes = {a % x.ndim for a in axes}
+    partial = set(x.partial)
+    out_dm = []
+    for i, a in enumerate(x.dims_mapping):
+        if i in axes:
+            if a is not None:
+                partial.add(a)
+            if keepdim:
+                out_dm.append(None)
+        else:
+            out_dm.append(a)
+    return DistAttr(list(x.dims_mapping), set(x.partial)), \
+        DistAttr(out_dm, partial)
+
+
+def softmax_rule(x: DistAttr, axis: int = -1) -> Tuple[DistAttr, DistAttr]:
+    """Softmax dim must be unsharded (ref: spmd_rules/softmax.cc)."""
+    ax = axis % x.ndim
+    dm = [a if i != ax else None for i, a in enumerate(x.dims_mapping)]
+    rx = DistAttr(dm, set(x.partial))
+    return rx, DistAttr(list(dm), set(x.partial))
+
+
+def reshard_cost_bytes(src: DistAttr, dst: DistAttr, shape: Sequence[int],
+                       mesh_shape: dict, elem_bytes: int = 2) -> float:
+    """Bytes each chip moves to convert src->dst sharding of a tensor
+    (the planner's resharding price; ref reshard cost in base_cost.py).
+
+    partial->replicated: allreduce (2(n-1)/n of local payload);
+    sharded->replicated: allgather; replicated->sharded: free (slice);
+    sharded->differently-sharded: all_to_all approximation."""
+    total = float(elem_bytes)
+    for s in shape:
+        total *= s
+
+    def nshards(attr):
+        n = 1
+        for a in attr.dims_mapping:
+            if a is not None:
+                n *= mesh_shape.get(a, 1)
+        return max(n, 1)
+
+    cost = 0.0
+    for ax in src.partial - dst.partial:
+        n = mesh_shape.get(ax, 1)
+        if n > 1:
+            cost += 2.0 * (n - 1) / n * total / nshards(src)
+    if src.dims_mapping != dst.dims_mapping:
+        n_src, n_dst = nshards(src), nshards(dst)
+        if n_dst == 1 and n_src > 1:          # gather
+            cost += (n_src - 1) / n_src * total
+        elif n_src == 1:                       # slice locally
+            cost += 0.0
+        else:                                  # resharding exchange
+            cost += total / max(min(n_src, n_dst), 1)
+    return cost

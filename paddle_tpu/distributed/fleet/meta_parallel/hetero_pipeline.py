@@ -364,8 +364,12 @@ class HeteroPipelineParallel:
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         x, y = data
-        xa = x.data if isinstance(x, Tensor) else jnp.asarray(x)
-        ya = y.data if isinstance(y, Tensor) else jnp.asarray(y)
+        # host numpy unless already a (possibly global) jax array: on a
+        # multi-process mesh jit places numpy per in_shardings, but a
+        # committed single-local-device array cannot be resharded onto
+        # devices other processes own
+        xa = x.data if isinstance(x, Tensor) else np.asarray(x)
+        ya = y.data if isinstance(y, Tensor) else np.asarray(y)
         M = self.num_microbatches
         assert xa.shape[0] % M == 0
         mb = xa.shape[0] // M
@@ -373,7 +377,9 @@ class HeteroPipelineParallel:
         ym = ya.reshape((M, mb) + ya.shape[1:])
         fn = self._get_compiled(xm.shape, ym.shape, xa.dtype)
         bufs = {d: p.data for d, p in self._bufs.items()}
-        loss, g = fn(bufs, xm, ym)
+        from .pipeline_parallel import _globalize
+        rep = NamedSharding(self.mesh, P())
+        loss, g = fn(bufs, _globalize(xm, rep), _globalize(ym, rep))
         # tied weights: symmetrize grads across every region of the group
         for group in self._tied_groups:
             total = None

@@ -43,6 +43,24 @@ def _data_axes(mesh, mb_size):
     return data_axes_for(mb_size, mesh=mesh)
 
 
+def _globalize(arr, sharding):
+    """Batch input -> global jax.Array in `sharding`. In multi-process
+    runs jit refuses non-replicated shardings on numpy AND cannot
+    reshard an array committed to one local device (the result of
+    paddle.to_tensor) onto devices other processes own — so both cases
+    rebuild the array shard-by-shard from the host value (every rank
+    holds the full batch, as all ranks consume the same seeded data).
+    Already-global arrays pass through untouched."""
+    if isinstance(arr, jax.Array):
+        spans_mesh = len(arr.sharding.device_set) > 1
+        if jax.process_count() == 1 or spans_mesh:
+            return arr
+        arr = np.asarray(arr)      # single-device committed: rebuild
+    a = np.asarray(arr)
+    return jax.make_array_from_callback(a.shape, sharding,
+                                        lambda idx: a[idx])
+
+
 @contextlib.contextmanager
 def _swap(params, arrays):
     saved = [p.data for p in params]
@@ -342,19 +360,27 @@ class PipelineParallel:
             # matching the pinned carrier spec inside the body
             data_axes = _data_axes(mesh, xshape[1])
             data_spec = P(*((None, data_axes) if data_axes else ()))
-            self._compiled[key] = jax.jit(
+            jitted = jax.jit(
                 vg,
                 in_shardings=(edge_shard, stack_shard,
                               NamedSharding(mesh, data_spec),
                               NamedSharding(mesh, data_spec)),
             )
+            self._compiled[key] = (jitted, NamedSharding(mesh, data_spec))
         return self._compiled[key]
+
+    def _globalize(self, arr, sharding):
+        return _globalize(arr, sharding)
 
     # -- training entry (ref pipeline_parallel.py train_batch) ---------------
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         x, y = data
-        xa = x.data if isinstance(x, Tensor) else jnp.asarray(x)
-        ya = y.data if isinstance(y, Tensor) else jnp.asarray(y)
+        # host numpy unless already a (possibly global) jax array: on a
+        # multi-process mesh jit places numpy per in_shardings, but a
+        # committed single-local-device array cannot be resharded onto
+        # devices other processes own
+        xa = x.data if isinstance(x, Tensor) else np.asarray(x)
+        ya = y.data if isinstance(y, Tensor) else np.asarray(y)
         M = self.num_microbatches
         assert xa.shape[0] % M == 0, (
             f"batch {xa.shape[0]} not divisible into {M} microbatches")
@@ -362,10 +388,12 @@ class PipelineParallel:
         xm = xa.reshape((M, mb) + xa.shape[1:])
         ym = ya.reshape((M, mb) + ya.shape[1:])
 
-        fn = self._get_compiled(xm.shape, ym.shape)
+        fn, data_sharding = self._get_compiled(xm.shape, ym.shape)
         edge_arr = {k: p.data for k, p in self._edge.items()}
         stack_arr = {k: p.data for k, p in self._stacks.items()}
-        loss, (g_edge, g_stack) = fn(edge_arr, stack_arr, xm, ym)
+        loss, (g_edge, g_stack) = fn(edge_arr, stack_arr,
+                                     self._globalize(xm, data_sharding),
+                                     self._globalize(ym, data_sharding))
 
         # tied weights appear under several edge keys (SharedLayerDesc):
         # accumulate partial grads per Parameter object, don't overwrite

@@ -191,25 +191,16 @@ def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
     return shard_tensor(t, mesh, placements)
 
 
-def data_axes() -> tuple:
-    """Mesh axes that carry the batch dim of activations (dp + the
-    ZeRO sharding axis, which is data-parallel for activations). Used to
-    FULLY pin activation layouts at resharding boundaries — a partial
-    constraint (batch dim None) lets GSPMD invent a different layout in
-    the checkpointed backward and fall into 'involuntary full
-    rematerialization' at the boundary collective."""
-    from .topology import get_mesh
-    mesh = get_mesh()
-    if mesh is None:
-        return ()
-    return tuple(a for a in ("dp", "sharding")
-                 if a in mesh.axis_names and mesh.shape[a] > 1)
-
-
 def data_axes_for(dim_size: int, mesh=None) -> tuple:
-    """data_axes() greedily restricted to axes whose running product
-    still divides `dim_size` — sharding constraints applied EAGERLY
-    (outside jit) and jit in_shardings hard-require divisibility."""
+    """Mesh axes that carry the batch dim of activations (dp + the ZeRO
+    sharding axis, which is data-parallel for activations), greedily
+    restricted to axes whose running product divides `dim_size` —
+    sharding constraints applied EAGERLY (outside jit) and jit
+    in_shardings hard-require divisibility. Used to FULLY pin activation
+    layouts at resharding boundaries: a partial constraint (batch dim
+    None) lets GSPMD invent a different layout in the checkpointed
+    backward and fall into 'involuntary full rematerialization' at the
+    boundary collective."""
     from .topology import get_mesh
     mesh = mesh if mesh is not None else get_mesh()
     if mesh is None:
@@ -353,6 +344,45 @@ class ShardingPlan:
             return P()
         return P(self.data_axes if len(self.data_axes) > 1
                  else self.data_axes[0])
+
+    # -- multi-host entry ----------------------------------------------------
+    def materialize(self, model, optimizer=None):
+        """Place every model array (and primed optimizer state) as a
+        GLOBAL jax.Array in its planned sharding. Required before
+        TrainStep on a multi-PROCESS mesh: eagerly created params are
+        committed to one local device, and jit cannot implicitly
+        reshard a single-device array onto devices other processes own.
+        device_put from host numpy (same value on every process, as all
+        ranks seed identically) is the documented multi-host path.
+        Harmless on single-process meshes (it just places arrays).
+        Ref: fleet sharding init broadcast (group_sharded stage init)."""
+        from ..tensor import Parameter
+        self.attach_model(model)
+        p_specs = {}
+        for name, t in model.state_dict().items():
+            arr = np.asarray(t.data)
+            is_param = isinstance(t, Parameter) and not t.stop_gradient
+            spec = self.param_spec(name, arr) if is_param else P()
+            t.data = jax.device_put(arr, NamedSharding(self.mesh, spec))
+            if is_param:
+                p_specs[name] = spec
+        if optimizer is not None:
+            if hasattr(optimizer, "prime"):
+                optimizer.prime()
+            for k, v in list(optimizer._state.items()):
+                arr = np.asarray(v)
+                optimizer._state[k] = jax.device_put(
+                    arr, NamedSharding(self.mesh,
+                                       self.opt_spec(k, arr, p_specs)))
+            for k, v in list(getattr(optimizer, "_master_weights",
+                                     {}).items()):
+                arr = np.asarray(v)
+                pname = getattr(self, "_pid_to_name", {}).get(k, "")
+                spec = (p_specs.get(pname)
+                        or self.param_spec(pname, arr))
+                optimizer._master_weights[k] = jax.device_put(
+                    arr, NamedSharding(self.mesh, spec))
+        return self
 
     # -- TrainStep hook ------------------------------------------------------
     def compile_train_step(self, pure, donate):

@@ -190,6 +190,10 @@ class TrainStep:
 
         def pure(params, buffers, opt_state, master, scaler_state, step_i,
                  lr, key, batch):
+            # key travels as raw uint32 key-data (host numpy — typed PRNG
+            # keys are committed device arrays, which a multi-process
+            # mesh jit cannot accept); rewrap to a typed key here
+            key = jax.random.wrap_key_data(key)
             state = {}
             state.update(params)
             state.update(buffers)
@@ -252,10 +256,15 @@ class TrainStep:
             self._build()
         opt = self.optimizer
         params, buffers = self._capture_state()
-        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        # host scalars, not committed device arrays: on a multi-PROCESS
+        # mesh jit can place numpy inputs into replicated shardings but
+        # cannot reshard a single-local-device jax array onto devices it
+        # does not own
+        import numpy as _np
+        lr = _np.float32(opt.get_lr())
         # opt.step() inside the compiled fn performs the +1 itself
-        step_i = jnp.asarray(opt._step_count, jnp.int32)
-        key = core.next_rng_key()
+        step_i = _np.int32(opt._step_count)
+        key = _np.asarray(jax.random.key_data(core.next_rng_key()))
         batch_arrays = _tree_unbox(batch)
         scaler_state = (self.scaler._get_traced_state()
                         if self.scaler is not None else {})

@@ -1,0 +1,72 @@
+"""Multi-process checkpoint worker: phase A (2 procs, sharding=2) saves a
+sharded state dict — each process writes only its addressable shards;
+phase B (2 procs, mp=2 — a DIFFERENT topology) loads with reshard and
+verifies values (ref: test/auto_parallel reshard-on-load tests)."""
+import os
+import re
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=1").strip()
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                               save_state_dict)
+from paddle_tpu.distributed.topology import HybridCommunicateGroup
+
+
+def main():
+    out_dir, phase = sys.argv[1], sys.argv[2]
+    dist.init_parallel_env()
+    rank = jax.process_index()
+    assert jax.process_count() == 2
+
+    W = np.arange(64, dtype=np.float32).reshape(8, 8)
+    B = np.arange(8, dtype=np.float32) * 0.5
+    ckpt = os.path.join(out_dir, "ckpt")
+
+    if phase == "save":
+        hcg = HybridCommunicateGroup(dp_degree=1, sharding_degree=2)
+        w = jax.device_put(W, NamedSharding(hcg.mesh, P("sharding", None)))
+        b = jax.device_put(B, NamedSharding(hcg.mesh, P()))
+        os.makedirs(ckpt, exist_ok=True)
+        save_state_dict({"w": paddle.Tensor(w), "b": paddle.Tensor(b)}, ckpt)
+        # every process must contribute its shard file before the barrier
+        # marker is written
+        with open(os.path.join(out_dir, f"saved_{rank}"), "w") as f:
+            f.write("ok")
+    else:
+        hcg = HybridCommunicateGroup(dp_degree=1, mp_degree=2)
+        tgt_w = jax.device_put(np.zeros_like(W),
+                               NamedSharding(hcg.mesh, P(None, "mp")))
+        tgt_b = jax.device_put(np.zeros_like(B),
+                               NamedSharding(hcg.mesh, P("mp")))
+        out = load_state_dict({"w": paddle.Tensor(tgt_w),
+                               "b": paddle.Tensor(tgt_b)}, ckpt)
+        # replicate to host for value checks
+        wv = np.asarray(jax.jit(
+            lambda a: a,
+            out_shardings=NamedSharding(hcg.mesh, P()))(out["w"].data))
+        bv = np.asarray(jax.jit(
+            lambda a: a,
+            out_shardings=NamedSharding(hcg.mesh, P()))(out["b"].data))
+        np.testing.assert_array_equal(wv, W)
+        np.testing.assert_array_equal(bv, B)
+        assert out["w"].data.sharding.spec == P(None, "mp")
+        with open(os.path.join(out_dir, f"loaded_{rank}"), "w") as f:
+            f.write("ok")
+    print(f"rank {rank}: ckpt {phase} ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""Elastic e2e worker (ref: fleet/elastic/manager.py FAULT_TOLERANCE —
+node dies -> TTL expiry -> relaunch -> checkpoint resume).
+
+Launched (2 ranks) via paddle_tpu.distributed.launch --max_restart 1.
+Rank 0 additionally runs the MembershipManager master and logs membership
+transitions; both ranks heartbeat and run a checkpointed counter-training
+loop through ElasticManager. The TEST kills rank 1's worker process
+mid-run; the launcher relaunches it; the relaunched incarnation must
+RESUME from the persisted step (not step 0), and rank 0 must observe the
+membership dip (TTL expiry) and recovery."""
+import os
+import re
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=1").strip()
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from paddle_tpu.distributed.elastic import ElasticManager, MembershipManager
+
+TTL = 1.2
+BEAT = 0.3
+
+
+def main():
+    out_dir = sys.argv[1]
+    master_ep = sys.argv[2]
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    os.environ.setdefault("PADDLE_ELASTIC_ENDPOINT", master_ep)
+
+    # pid file so the test can kill THIS incarnation of rank 1
+    with open(os.path.join(out_dir, f"pid_{rank}"), "w") as f:
+        f.write(str(os.getpid()))
+
+    mm = MembershipManager(master_endpoint=master_ep, rank=rank,
+                           ttl=TTL, interval=BEAT)
+    if rank == 0:
+        mm.start_master()
+        time.sleep(0.3)
+    else:
+        time.sleep(0.6)     # let the master bind first
+    mm.start_heartbeat()
+
+    ckpt = os.path.join(out_dir, f"elastic_ckpt_{rank}")
+    em = ElasticManager(ckpt_dir=ckpt, save_interval=1, max_restarts=0)
+
+    def make_state():
+        import paddle_tpu as paddle
+        w = paddle.to_tensor(np.zeros(4, np.float32))
+        return {"w": w}
+
+    started_at = {}
+
+    def train_step(state, step):
+        if not started_at:
+            started_at["step"] = step
+            # record where this incarnation resumed from
+            with open(os.path.join(out_dir,
+                                   f"resume_{rank}_{os.getpid()}"),
+                      "w") as f:
+                f.write(str(step))
+        state["w"].data = state["w"].data + 1.0
+        time.sleep(0.35)
+        return float(step)
+
+    total = 20 if rank == 1 else 14
+
+    if rank == 0:
+        # membership monitor: log 2 -> 1 -> 2 transitions while training
+        import threading
+        events = []
+
+        def watch():
+            last = None
+            while len(events) < 4 and not mm._stop.is_set():
+                n = len(mm.alive())
+                if n != last:
+                    events.append(f"{time.time():.1f}:{n}")
+                    with open(os.path.join(out_dir, "membership_log"),
+                              "w") as f:
+                        f.write("\n".join(events))
+                    last = n
+                time.sleep(0.3)
+
+        threading.Thread(target=watch, daemon=True).start()
+
+    em.run(make_state, train_step, total_steps=total)
+    with open(os.path.join(out_dir, f"done_{rank}_{os.getpid()}"), "w") as f:
+        f.write("ok")
+    # rank 0 keeps the master up until rank 1 finishes (or timeout)
+    if rank == 0:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if any(n.startswith("done_1") for n in os.listdir(out_dir)):
+                break
+            time.sleep(0.3)
+    mm.stop()
+    print(f"rank {rank} pid {os.getpid()} done")
+
+
+if __name__ == "__main__":
+    main()

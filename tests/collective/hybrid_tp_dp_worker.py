@@ -1,0 +1,107 @@
+"""4-process TP x DP worker (ref pattern: test/collective/fleet/
+hybrid_parallel_mp_model.py — hybrid loss must match single-process).
+
+Each process owns 1 CPU device; mesh is dp=2 x mp=2. The model uses
+Column/RowParallelLinear (mpu TP layouts) trained through the compiled
+TrainStep under a ShardingPlan; ShardingPlan.materialize() places
+params/opt state as GLOBAL arrays (the multi-host entry). Losses over 3
+steps must match the eager single-process run bit-for-tolerance."""
+import os
+import re
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=1").strip()
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as popt
+
+
+class TPNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        from paddle_tpu.distributed.fleet.layers.mpu import (
+            ColumnParallelLinear, RowParallelLinear)
+        self.col = ColumnParallelLinear(8, 16, gather_output=False)
+        self.row = RowParallelLinear(16, 4, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.row(F.relu(self.col(x)))
+
+
+def run_steps(model, opt_, X, Y, steps, step=None):
+    losses = []
+    for _ in range(steps):
+        if step is None:
+            loss = F.mse_loss(model(X), Y)
+            loss.backward()
+            opt_.step()
+            opt_.clear_grad()
+        else:
+            loss = step(X, Y)
+        losses.append(float(np.asarray(loss.data)))
+    return losses
+
+
+def main():
+    out_dir = sys.argv[1]
+    dist.init_parallel_env()
+    rank = jax.process_index()
+    assert jax.process_count() == 4 and len(jax.devices()) == 4
+
+    rng = np.random.default_rng(0)
+    Xn = rng.standard_normal((8, 8)).astype(np.float32)
+    Yn = rng.standard_normal((8, 4)).astype(np.float32)
+
+    # eager single-process reference FIRST (no mesh set yet: TP layers'
+    # sharding annotations are identity without a mesh)
+    paddle.seed(0)
+    ref = TPNet()
+    oref = popt.SGD(learning_rate=0.05, parameters=ref.parameters())
+    ref_losses = run_steps(ref, oref, paddle.to_tensor(Xn),
+                           paddle.to_tensor(Yn), 3)
+
+    # distributed: dp=2 x mp=2 over the 4 processes
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed.sharding import ShardingPlan
+    from paddle_tpu.distributed.topology import (HybridCommunicateGroup,
+                                                 set_mesh)
+    hcg = HybridCommunicateGroup(dp_degree=2, mp_degree=2)
+    set_mesh(hcg.mesh)
+    paddle.seed(0)                 # identical init on every rank
+    model = TPNet()
+    opt_ = popt.SGD(learning_rate=0.05, parameters=model.parameters())
+    plan = ShardingPlan(hcg.mesh, stage=0)
+    plan.materialize(model, opt_)
+    step = paddle.jit.TrainStep(model, opt_,
+                                lambda x, y: F.mse_loss(model(x), y),
+                                shard=plan)
+    # batch as a GLOBAL array sharded over dp (each process contributes
+    # its dp-group's quarter... all ranks hold the full batch, so build
+    # from the full value replicated-compatible)
+    xg = jax.device_put(Xn, NamedSharding(hcg.mesh, P(("dp",))))
+    yg = jax.device_put(Yn, NamedSharding(hcg.mesh, P(("dp",))))
+    got = run_steps(None, None, paddle.Tensor(xg), paddle.Tensor(yg), 3,
+                    step=step)
+
+    np.testing.assert_allclose(got, ref_losses, rtol=1e-4, atol=1e-6)
+    with open(os.path.join(out_dir, f"tpdp_ok_{rank}"), "w") as f:
+        f.write(",".join(f"{v:.6f}" for v in got))
+    print(f"rank {rank}: TPxDP losses match single-process: {got}")
+
+
+if __name__ == "__main__":
+    main()

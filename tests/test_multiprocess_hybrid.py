@@ -1,0 +1,144 @@
+"""Multi-process hybrid-parallel test tree (VERDICT r2 item 4; ref
+pattern: test/collective/test_communication_api_base.py +
+test/collective/fleet/hybrid_parallel_*):
+
+- 4-process TP x DP: TrainStep losses equal the single-process run
+- 4-process PP x DP: compiled pipeline loss equals sequential
+- 2-process checkpoint: sharded save -> reshard-on-load across a
+  DIFFERENT topology (sharding=2 saved, mp=2 loaded)
+- elastic e2e: kill a worker mid-run; heartbeat TTL expiry is observed,
+  the launcher relaunches it, and it RESUMES from the checkpoint
+"""
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COLL = os.path.join(REPO, "tests", "collective")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch(nnodes, worker, args, extra_env=None, max_restart=0):
+    port = _free_port()
+    procs = []
+    for rank in range(nnodes):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        if extra_env:
+            env.update(extra_env)
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--master", f"127.0.0.1:{port}",
+               "--nnodes", str(nnodes), "--rank", str(rank),
+               "--max_restart", str(max_restart),
+               worker] + args
+        procs.append(subprocess.Popen(
+            cmd, env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    return procs
+
+
+def _wait_all(procs, timeout):
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=timeout)
+        outs.append(out.decode(errors="replace"))
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+    return outs
+
+
+@pytest.mark.timeout(300)
+def test_four_process_tp_dp_matches_single():
+    with tempfile.TemporaryDirectory() as d:
+        procs = _launch(4, os.path.join(COLL, "hybrid_tp_dp_worker.py"), [d])
+        outs = _wait_all(procs, timeout=270)
+        vals = []
+        for rank in range(4):
+            marker = os.path.join(d, f"tpdp_ok_{rank}")
+            assert os.path.exists(marker), outs[rank][-3000:]
+            with open(marker) as f:
+                vals.append(f.read())
+        assert len(set(vals)) == 1, vals  # identical losses on every rank
+
+
+@pytest.mark.timeout(300)
+def test_four_process_pp_dp_matches_sequential():
+    with tempfile.TemporaryDirectory() as d:
+        procs = _launch(4, os.path.join(COLL, "hybrid_pp_dp_worker.py"), [d])
+        outs = _wait_all(procs, timeout=270)
+        vals = []
+        for rank in range(4):
+            marker = os.path.join(d, f"ppdp_ok_{rank}")
+            assert os.path.exists(marker), outs[rank][-3000:]
+            with open(marker) as f:
+                vals.append(f.read())
+        assert len(set(vals)) == 1, vals  # same loss AND grad summary
+
+
+@pytest.mark.timeout(300)
+def test_multiprocess_ckpt_save_then_reshard_load():
+    with tempfile.TemporaryDirectory() as d:
+        worker = os.path.join(COLL, "ckpt_reshard_worker.py")
+        outs = _wait_all(_launch(2, worker, [d, "save"]), timeout=120)
+        for rank in range(2):
+            assert os.path.exists(os.path.join(d, f"saved_{rank}")), \
+                outs[rank][-3000:]
+        # phase B: different topology (mp=2), fresh processes
+        outs = _wait_all(_launch(2, worker, [d, "load"]), timeout=120)
+        for rank in range(2):
+            assert os.path.exists(os.path.join(d, f"loaded_{rank}")), \
+                outs[rank][-3000:]
+
+
+@pytest.mark.timeout(300)
+def test_elastic_kill_worker_ttl_relaunch_resume():
+    with tempfile.TemporaryDirectory() as d:
+        ep = f"127.0.0.1:{_free_port()}"
+        worker = os.path.join(COLL, "elastic_worker.py")
+        procs = _launch(2, worker, [d, ep], max_restart=1)
+        # wait for rank 1's worker to make progress, then SIGKILL it
+        pid_file = os.path.join(d, "pid_1")
+        deadline = time.time() + 60
+        while not os.path.exists(pid_file) and time.time() < deadline:
+            time.sleep(0.2)
+        assert os.path.exists(pid_file), "rank 1 worker never started"
+        time.sleep(2.5)          # let it checkpoint a few steps
+        with open(pid_file) as f:
+            victim = int(f.read())
+        os.unlink(pid_file)      # relaunched incarnation rewrites it
+        os.kill(victim, signal.SIGKILL)
+        outs = _wait_all(procs, timeout=240)
+
+        # (a) relaunched incarnation resumed from a step > 0
+        resumes = sorted(n for n in os.listdir(d) if n.startswith("resume_1_"))
+        assert len(resumes) >= 2, (resumes, outs[1][-3000:])
+        steps = sorted(int(open(os.path.join(d, n)).read())
+                       for n in resumes)
+        assert steps[0] == 0 and steps[-1] > 0, steps
+
+        # (b) rank 0 observed the membership dip (TTL expiry) + recovery
+        log_path = os.path.join(d, "membership_log")
+        assert os.path.exists(log_path), outs[0][-3000:]
+        counts = [int(line.rsplit(":", 1)[1])
+                  for line in open(log_path).read().splitlines()]
+        assert 2 in counts, counts
+        i2 = counts.index(2)
+        assert any(c < 2 for c in counts[i2:]), \
+            f"no TTL-expiry dip observed after full membership: {counts}"
+
+        # (c) both ranks completed
+        assert any(n.startswith("done_0") for n in os.listdir(d))
+        assert any(n.startswith("done_1") for n in os.listdir(d))
