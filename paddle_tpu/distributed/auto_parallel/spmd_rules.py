@@ -100,8 +100,10 @@ def matmul_rule(x: DistAttr, y: DistAttr,
     out = DistAttr(batch + [m, n],
                    partial=({k} if k is not None else set())
                    | x.partial | y.partial)
-    rx = DistAttr(xb + [m, k])
-    ry = DistAttr(yb + [k, n])
+    # resolved input attrs keep the OPERAND's rank (drop broadcast
+    # padding), so consumers can align them dim-by-dim with the tensor
+    rx = DistAttr(xb[nb - (len(xm) - 2):] + [m, k])
+    ry = DistAttr(yb[nb - (len(ym) - 2):] + [k, n])
     if trans_x:
         rx.dims_mapping[-1], rx.dims_mapping[-2] = \
             rx.dims_mapping[-2], rx.dims_mapping[-1]
@@ -121,11 +123,18 @@ def embedding_rule(table: DistAttr, ids: DistAttr
     allreduce pending). Column-parallel table: out hidden dim sharded.
     ids shardings propagate to the leading out dims."""
     v_ax, h_ax = table.dims_mapping
+    used = set(a for a in ids.dims_mapping if a is not None)
+    # one axis cannot shard two output dims (or shard a dim AND carry a
+    # partial): ids' shardings win, the table resharded
+    if h_ax in used:
+        h_ax = None
+    if v_ax in used or (v_ax is not None and v_ax == h_ax):
+        v_ax = None
     out_dm = list(ids.dims_mapping) + [h_ax]
     partial = set(table.partial) | set(ids.partial)
     if v_ax is not None:
         partial.add(v_ax)
-    return (DistAttr(list(table.dims_mapping)),
+    return (DistAttr([v_ax, h_ax]),
             DistAttr(list(ids.dims_mapping))), DistAttr(out_dm, partial)
 
 
@@ -159,6 +168,10 @@ def flash_attention_rule(q: DistAttr, k: DistAttr, v: DistAttr,
         h = None
     sq = q.axis(1) if q.axis(1) == sep_axis else None
     sk = k.axis(1) if k.axis(1) == sep_axis else None
+    if sq in (b, h):    # an axis cannot shard two dims
+        sq = None
+    if sk in (b, h):
+        sk = None
     rq = DistAttr([b, sq, h, None])
     rk = DistAttr([b, sk, h, None])
     rv = DistAttr([b, sk, h, None])

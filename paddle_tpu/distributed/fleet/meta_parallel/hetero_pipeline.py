@@ -368,14 +368,18 @@ class HeteroPipelineParallel:
         # multi-process mesh jit places numpy per in_shardings, but a
         # committed single-local-device array cannot be resharded onto
         # devices other processes own
-        xa = x.data if isinstance(x, Tensor) else np.asarray(x)
-        ya = y.data if isinstance(y, Tensor) else np.asarray(y)
+        from .pipeline_parallel import _as_microbatches
+        # keep jax arrays (possibly global) as-is; anything else (lists,
+        # numpy) normalizes through numpy so .shape/.dtype reads work
+        xa = x.data if isinstance(x, Tensor) else (
+            x if isinstance(x, jax.Array) else np.asarray(x))
+        ya = y.data if isinstance(y, Tensor) else (
+            y if isinstance(y, jax.Array) else np.asarray(y))
         M = self.num_microbatches
         assert xa.shape[0] % M == 0
-        mb = xa.shape[0] // M
-        xm = xa.reshape((M, mb) + xa.shape[1:])
-        ym = ya.reshape((M, mb) + ya.shape[1:])
-        fn = self._get_compiled(xm.shape, ym.shape, xa.dtype)
+        xm = _as_microbatches(xa, M)
+        ym = _as_microbatches(ya, M)
+        fn = self._get_compiled(tuple(xm.shape), tuple(ym.shape), xa.dtype)
         bufs = {d: p.data for d, p in self._bufs.items()}
         from .pipeline_parallel import _globalize
         rep = NamedSharding(self.mesh, P())

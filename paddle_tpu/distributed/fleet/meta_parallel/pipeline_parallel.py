@@ -46,19 +46,36 @@ def _data_axes(mesh, mb_size):
 def _globalize(arr, sharding):
     """Batch input -> global jax.Array in `sharding`. In multi-process
     runs jit refuses non-replicated shardings on numpy AND cannot
-    reshard an array committed to one local device (the result of
-    paddle.to_tensor) onto devices other processes own — so both cases
-    rebuild the array shard-by-shard from the host value (every rank
-    holds the full batch, as all ranks consume the same seeded data).
-    Already-global arrays pass through untouched."""
+    reshard an array committed only to this process's devices (the
+    result of paddle.to_tensor) onto devices other processes own — both
+    cases rebuild the array shard-by-shard from the host value (every
+    rank holds the full batch, as all ranks consume the same seeded
+    data). Arrays already spanning other processes pass through."""
     if isinstance(arr, jax.Array):
-        spans_mesh = len(arr.sharding.device_set) > 1
-        if jax.process_count() == 1 or spans_mesh:
+        if jax.process_count() == 1 or not arr.is_fully_addressable:
             return arr
-        arr = np.asarray(arr)      # single-device committed: rebuild
+        arr = np.asarray(arr)      # locally-committed: rebuild globally
     a = np.asarray(arr)
     return jax.make_array_from_callback(a.shape, sharding,
                                         lambda idx: a[idx])
+
+
+@functools.lru_cache(maxsize=64)
+def _jit_reshape(shape):
+    # cached per target shape: a fresh lambda per call would never hit
+    # the jit cache and retrace every training step
+    return jax.jit(lambda t: t.reshape(shape))
+
+
+def _as_microbatches(x, M):
+    """[B, ...] batch -> [M, B/M, ...]: host path for numpy / local
+    arrays; jit-reshape for global arrays (eager ops on non-addressable
+    arrays are disallowed)."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        shape = (M, x.shape[0] // M) + tuple(x.shape[1:])
+        return _jit_reshape(shape)(x)
+    a = np.asarray(x)
+    return a.reshape((M, a.shape[0] // M) + a.shape[1:])
 
 
 @contextlib.contextmanager
@@ -375,20 +392,20 @@ class PipelineParallel:
     # -- training entry (ref pipeline_parallel.py train_batch) ---------------
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         x, y = data
-        # host numpy unless already a (possibly global) jax array: on a
-        # multi-process mesh jit places numpy per in_shardings, but a
-        # committed single-local-device array cannot be resharded onto
-        # devices other processes own
-        xa = x.data if isinstance(x, Tensor) else np.asarray(x)
-        ya = y.data if isinstance(y, Tensor) else np.asarray(y)
+        # keep jax arrays (possibly global) as-is; anything else (lists,
+        # numpy) normalizes through numpy so .shape/.dtype reads work
+        xa = x.data if isinstance(x, Tensor) else (
+            x if isinstance(x, jax.Array) else np.asarray(x))
+        ya = y.data if isinstance(y, Tensor) else (
+            y if isinstance(y, jax.Array) else np.asarray(y))
         M = self.num_microbatches
         assert xa.shape[0] % M == 0, (
             f"batch {xa.shape[0]} not divisible into {M} microbatches")
-        mb = xa.shape[0] // M
-        xm = xa.reshape((M, mb) + xa.shape[1:])
-        ym = ya.reshape((M, mb) + ya.shape[1:])
+        xm = _as_microbatches(xa, M)
+        ym = _as_microbatches(ya, M)
 
-        fn, data_sharding = self._get_compiled(xm.shape, ym.shape)
+        fn, data_sharding = self._get_compiled(tuple(xm.shape),
+                                               tuple(ym.shape))
         edge_arr = {k: p.data for k, p in self._edge.items()}
         stack_arr = {k: p.data for k, p in self._stacks.items()}
         loss, (g_edge, g_stack) = fn(edge_arr, stack_arr,

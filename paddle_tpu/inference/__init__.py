@@ -97,6 +97,16 @@ class Config:
     def enable_tensorrt_engine(self, *a, **kw):
         pass  # TensorRT has no TPU analog; XLA compiled the artifact
 
+    def enable_mkldnn_int8(self, *a, **kw):
+        """ref AnalysisConfig::EnableMkldnnInt8 — int8 inference. The
+        TPU-native int8 path is weight-only PTQ consumed by the serving
+        engine (inference.serving.quantize_state_int8 /
+        ContinuousBatchingEngine(quantize='int8'))."""
+        self._int8 = True
+
+    def mkldnn_int8_enabled(self):
+        return getattr(self, "_int8", False)
+
     def enable_mkldnn(self):
         pass
 
@@ -199,6 +209,10 @@ class Predictor:
 def create_predictor(config: Config) -> Predictor:
     """ref: paddle_infer.create_predictor."""
     return Predictor(config)
+
+
+from .serving import (ContinuousBatchingEngine,  # noqa: E402,F401
+                      GenerationRequest, quantize_state_int8)
 
 
 def convert_to_mixed_precision(*a, **kw):

@@ -60,6 +60,7 @@ class StaticFunction:
         self._compiled = None
         self._input_spec = input_spec
         self._fallback = False
+        self._sot = None
 
     def _build(self):
         layer = self._layer
@@ -84,6 +85,16 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if not _to_static_enabled or self._fallback:
             return self._fn(*args, **kwargs)
+        if self._sot is not None:     # split at a recorded graph break
+            from .sot import SotCaptureError
+            try:
+                return self._sot(*args, **kwargs)
+            except SotCaptureError:
+                # machinery failure (guard thrash, non-replayable op) —
+                # user exceptions propagate unchanged
+                self._sot = None
+                self._fallback = True
+                return self._fn(*args, **kwargs)
         if self._compiled is None:
             self._build()
         state = ({k: t.data for k, t in self._layer.state_dict().items()}
@@ -97,18 +108,27 @@ class StaticFunction:
                 jax.errors.TracerIntegerConversionError,
                 jax.errors.TracerArrayConversionError,
                 jax.errors.NonConcreteBooleanIndexError) as e:
-            # SOT-style graph break (ref jit/sot/: bytecode tracer falls
-            # back to eager when value-dependent Python control flow can't
-            # be captured). Trace-based equivalent: permanently fall back
-            # to eager for this function and warn once.
+            # SOT graph break (ref jit/sot/opcode_executor.py): split at
+            # the unsupported construct and stitch compiled fragments
+            # around the host-side value pull instead of de-optimizing
+            # the whole function to eager. Guarded specializations
+            # re-capture when the pulled value takes the other branch.
+            from .sot import SotCaptureError, SubgraphProgram
             import warnings
             warnings.warn(
-                f"to_static: data-dependent control flow broke tracing "
-                f"({type(e).__name__}); falling back to eager execution "
-                "for this function (ref SOT graph-break semantics)",
-                stacklevel=2)
-            self._fallback = True
-            return self._fn(*args, **kwargs)
+                f"to_static: data-dependent control flow broke whole-"
+                f"function tracing ({type(e).__name__}); splitting into "
+                "compiled sub-graph fragments at the break (ref SOT "
+                "graph-break semantics)", stacklevel=2)
+            self._sot = SubgraphProgram(self._fn, self._layer)
+            try:
+                return self._sot(*args, **kwargs)
+            except SotCaptureError:
+                # not replayable (rng/state mutation in capture):
+                # permanent eager fallback, as before round 3
+                self._sot = None
+                self._fallback = True
+                return self._fn(*args, **kwargs)
         if self._layer is not None:
             sd = self._layer.state_dict()
             for k, v in new_state.items():

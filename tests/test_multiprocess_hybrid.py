@@ -52,12 +52,20 @@ def _launch(nnodes, worker, args, extra_env=None, max_restart=0):
 
 def _wait_all(procs, timeout):
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=timeout)
-        outs.append(out.decode(errors="replace"))
-    for rank, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
-    return outs
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out.decode(errors="replace"))
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+        return outs
+    finally:
+        # a hung/failed rank must not orphan the others (they hold the
+        # coordinator port and would wedge later multi-process tests)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
 
 
 @pytest.mark.timeout(300)

