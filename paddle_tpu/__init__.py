@@ -109,3 +109,27 @@ from .nn.layer.layers import Layer  # noqa: F401,E402
 
 # paddle.nn.functional-style alias
 randn_like = lambda x, dtype=None: _creation.zeros_like(x) .normal_()  # noqa: E731
+
+# paddle.tensor submodule namespace (ref: python/paddle/tensor/__init__.py
+# re-exports the op surface under paddle.tensor.<fn> and per-group
+# submodules paddle.tensor.math/creation/...): alias every public op from
+# the ops package onto the `tensor` module object so
+# `paddle.tensor.add is paddle.add`, plus the group submodules.
+from . import tensor as _tensor_mod  # noqa: E402
+from .ops import (creation as _t_creation, einsum_ops as _t_einsum,  # noqa: E402
+                  linalg_ops as _t_linalg, logic as _t_logic,
+                  manipulation as _t_manip, math as _t_math,
+                  random_ops as _t_random, reduction as _t_reduction,
+                  search as _t_search)
+
+for _grp_name, _grp in (("creation", _t_creation), ("math", _t_math),
+                        ("manipulation", _t_manip), ("logic", _t_logic),
+                        ("search", _t_search), ("random", _t_random),
+                        ("linalg", _t_linalg), ("einsum", _t_einsum),
+                        ("stat", _t_reduction)):
+    if not hasattr(_tensor_mod, _grp_name):
+        setattr(_tensor_mod, _grp_name, _grp)
+    for _n in getattr(_grp, "__all__", []):
+        if not hasattr(_tensor_mod, _n):
+            setattr(_tensor_mod, _n, getattr(_grp, _n))
+del _grp_name, _grp, _n

@@ -99,11 +99,35 @@ def _check_nan_inf(name: str, outs):
                 f"(shape {getattr(o, 'shape', ())}, dtype {dt}) — "
                 "FLAGS_check_nan_inf is enabled")
             # warn-and-continue mode (amp.debugging DebugMode.CHECK_NAN_INF)
-            if core.get_flag("FLAGS_check_nan_inf_warn_only", False):
+            if core.get_bool_flag("FLAGS_check_nan_inf_warn_only"):
                 import warnings
                 warnings.warn(msg, RuntimeWarning)
                 continue
             raise FloatingPointError(msg)
+
+
+def _with_op_context(e: Exception, name: str, datas) -> Exception:
+    """FLAGS_call_stack_level consumer (ref phi enforce error summary):
+    level >= 1 annotates op failures with the op name and operand
+    shapes; level 0 re-raises untouched (terse mode)."""
+    level = core.get_flag("FLAGS_call_stack_level", 1)
+    try:
+        level = int(level)
+    except (TypeError, ValueError):
+        level = 1
+    if level <= 0 or getattr(e, "_op_context_added", False):
+        return e
+    shapes = []
+    for d in datas:
+        shp = getattr(d, "shape", None)
+        shapes.append(tuple(shp) if shp is not None else type(d).__name__)
+    note = f"[operator < {name or 'unnamed'} > error] operands: {shapes}"
+    try:
+        e.add_note(note)
+        e._op_context_added = True
+    except Exception:
+        pass
+    return e
 
 
 def apply_op(fn: Callable, *args, n_outputs: int = 1, name: str = "",
@@ -135,8 +159,7 @@ def apply_op(fn: Callable, *args, n_outputs: int = 1, name: str = "",
         if not diff_idx:
             record = False
 
-    check = core.get_flag("FLAGS_check_nan_inf", False) not in (
-        False, None, 0, "0", "false", "False", "")
+    check = core.get_bool_flag("FLAGS_check_nan_inf")
 
     def _maybe_record(outs):
         if _OP_OBSERVER is not None:  # amp.debugging op-stats collector
@@ -147,7 +170,10 @@ def apply_op(fn: Callable, *args, n_outputs: int = 1, name: str = "",
                              tensor_args, datas, outs, name)
 
     if not record:
-        out = fn(*datas, **static_kwargs)
+        try:
+            out = fn(*datas, **static_kwargs)
+        except Exception as e:
+            raise _with_op_context(e, name, datas)
         if check:
             _check_nan_inf(name, out if isinstance(out, tuple) else (out,))
         if n_outputs == 1 and not isinstance(out, tuple):
@@ -166,7 +192,10 @@ def apply_op(fn: Callable, *args, n_outputs: int = 1, name: str = "",
             full[i] = v
         return fn(*full, **static_kwargs)
 
-    out, vjp_fn = jax.vjp(partial_fn, *[datas[i] for i in diff_idx])
+    try:
+        out, vjp_fn = jax.vjp(partial_fn, *[datas[i] for i in diff_idx])
+    except Exception as e:
+        raise _with_op_context(e, name, datas)
     if check:
         _check_nan_inf(name, out if isinstance(out, tuple) else (out,))
 

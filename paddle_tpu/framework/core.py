@@ -220,18 +220,94 @@ def set_rng_state(state):
 # ---------------------------------------------------------------------------
 
 _flags: dict = {
+    # -- debugging (consumed by autograd/tape.py + jit TrainStep) ------
     "FLAGS_check_nan_inf": False,
     # warn-and-continue variant of the nan/inf sweep
     # (amp.debugging DebugMode.CHECK_NAN_INF / CHECK_ALL)
     "FLAGS_check_nan_inf_warn_only": False,
+    # 0 = raise on nan/inf, 1 = warn only (alias view of the above,
+    # matching the reference's numeric level knob)
+    "FLAGS_check_nan_inf_level": 0,
+    # exception verbosity of tape op errors: 0 terse, >=1 full op
+    # context (consumed by tape._op_error)
+    "FLAGS_call_stack_level": 1,
+    # -- determinism (consumed below in _apply_flag) -------------------
     "FLAGS_cudnn_deterministic": False,
-    "FLAGS_use_autotune": True,
+    "FLAGS_cpu_deterministic": False,
     "FLAGS_embedding_deterministic": 0,
+    # -- autotune (consumed by kernels/autotune.sweeps_enabled) --------
+    "FLAGS_use_autotune": True,
+    "FLAGS_cudnn_exhaustive_search": False,     # alias: force sweeps
+    # -- numerics (consumed in _apply_flag -> jax matmul precision) ----
+    "FLAGS_gemm_use_half_precision_compute_type": True,
+    # -- profiling / logging (consumed by jit.TrainStep) ---------------
+    "FLAGS_benchmark": False,          # print per-step wall time
+    "FLAGS_log_memory_stats": False,   # print device memory after step
+    # -- executor/memory behavior (consumed by jit.TrainStep) ----------
+    "FLAGS_max_inplace_grad_add": 0,   # >0 enables buffer donation
+    "FLAGS_eager_delete_tensor_gb": 0.0,  # <0 disables donation
+    # -- allocator knobs: mapped onto XLA client env at set time; only
+    # effective before backend init (documented XLA seam) --------------
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_gpu_memory_limit_mb": 0,
+    # -- API-compat registry (accepted + queryable; the machinery they
+    # steer is XLA-internal on TPU) -------------------------------------
+    "FLAGS_conv_workspace_size_limit": 512,
+    "FLAGS_cudnn_batchnorm_spatial_persistent": False,
+    "FLAGS_enable_cublas_tensor_op_math": True,
+    "FLAGS_use_system_allocator": False,
+    "FLAGS_use_pinned_memory": True,
+    "FLAGS_init_allocated_mem": False,
+    "FLAGS_initial_cpu_memory_in_mb": 500,
+    "FLAGS_memory_fraction_of_eager_deletion": 1.0,
+    "FLAGS_fast_eager_deletion_mode": True,
+    "FLAGS_use_mkldnn": False,
+    "FLAGS_enable_pir_api": True,
+    "FLAGS_new_executor_serial_run": False,
+    "FLAGS_low_precision_op_list": 0,
+    "FLAGS_print_model_stats": False,
+    "FLAGS_sync_nccl_allreduce": True,
+    "FLAGS_fuse_parameter_memory_size": -1,
+    "FLAGS_rpc_deadline": 180000,
+    "FLAGS_apply_pass_to_program": False,
 }
 
 
+def _apply_flag(key, value):
+    """Side effects of flags that steer global backends (the reference
+    applies these in phi::SetFlag handlers)."""
+    if key in ("FLAGS_cudnn_deterministic", "FLAGS_cpu_deterministic"):
+        # NOTE: XLA_FLAGS is read at backend INIT — setting this after
+        # the first jax computation affects only later-spawned backends
+        # (same limitation as the reference's cudnn flag after ctx init)
+        flags = os.environ.get("XLA_FLAGS", "")
+        tok = "--xla_gpu_deterministic_ops=true"
+        if value and tok not in flags:
+            os.environ["XLA_FLAGS"] = (flags + " " + tok).strip()
+        elif not value and tok in flags:
+            os.environ["XLA_FLAGS"] = flags.replace(tok, "").strip()
+    elif key == "FLAGS_gemm_use_half_precision_compute_type":
+        try:
+            jax.config.update("jax_default_matmul_precision",
+                              "default" if value else "highest")
+        except Exception:
+            pass
+    elif key == "FLAGS_fraction_of_gpu_memory_to_use":
+        os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] = str(value)
+    elif key == "FLAGS_allocator_strategy":
+        # auto_growth -> on-demand allocation; naive_best_fit -> XLA
+        # preallocation (only effective before backend init)
+        os.environ["XLA_PYTHON_CLIENT_PREALLOCATE"] = (
+            "false" if value == "auto_growth" else "true")
+    elif key == "FLAGS_check_nan_inf_level":
+        _flags["FLAGS_check_nan_inf_warn_only"] = bool(int(value) >= 1)
+
+
 def set_flags(flags: dict):
-    _flags.update(flags)
+    for k, v in flags.items():
+        _flags[k] = v
+        _apply_flag(k, v)
 
 
 def get_flags(keys):
@@ -245,3 +321,14 @@ def get_flag(key, default=None):
     if env is not None:
         return env
     return _flags.get(key, default)
+
+
+_FALSY = (False, None, 0, 0.0, "0", "false", "False", "", "off", "OFF")
+
+
+def get_bool_flag(key, default=False) -> bool:
+    """Boolean view of a flag: env-set flags arrive as STRINGS, so
+    bool('0') would invert every kill switch — normalize here (single
+    place; every boolean flag consumer must use this)."""
+    v = get_flag(key, default)
+    return v not in _FALSY

@@ -260,7 +260,11 @@ class TrainStep:
             return (loss.data, new_params, new_buffers, new_opt_state,
                     new_master, new_scaler)
 
-        donate = (0, 1, 2, 3) if self._donate else ()
+        # FLAGS_eager_delete_tensor_gb < 0 disables buffer donation (the
+        # reference's eager-deletion kill switch maps to donation here)
+        flag_gb = core.get_flag("FLAGS_eager_delete_tensor_gb", 0.0)
+        donate_ok = self._donate and float(flag_gb or 0.0) >= 0.0
+        donate = (0, 1, 2, 3) if donate_ok else ()
         if self.shard is not None:
             self._compiled = self.shard.compile_train_step(pure, donate)
         else:
@@ -288,6 +292,10 @@ class TrainStep:
         batch_arrays = _tree_unbox(batch)
         scaler_state = (self.scaler._get_traced_state()
                         if self.scaler is not None else {})
+        bench = core.get_bool_flag("FLAGS_benchmark")
+        if bench:
+            import time as _time
+            _t0 = _time.perf_counter()
         (loss, new_params, new_buffers, new_opt_state, new_master,
          new_scaler) = \
             self._compiled(params, buffers, dict(opt._state),
@@ -303,8 +311,23 @@ class TrainStep:
         if self.scaler is not None:
             self.scaler._set_traced_state(new_scaler)
         opt._step_count += 1
-        if core.get_flag("FLAGS_check_nan_inf", False) not in (
-                False, None, 0, "0", "false", "False", ""):
+        if bench:
+            import sys as _sys
+            jax.block_until_ready(loss)
+            print(f"TrainStep[{opt._step_count}]: "
+                  f"{(_time.perf_counter() - _t0) * 1e3:.2f} ms",
+                  file=_sys.stderr)
+        if core.get_bool_flag("FLAGS_log_memory_stats"):
+            import sys as _sys
+            from ..device import cuda as _dev
+            try:
+                print(f"TrainStep[{opt._step_count}] memory: "
+                      f"in_use={_dev.memory_allocated()} "
+                      f"peak={_dev.max_memory_allocated()}",
+                      file=_sys.stderr)
+            except Exception:
+                pass
+        if core.get_bool_flag("FLAGS_check_nan_inf"):
             # compiled-path sweep: values can't be branched on at trace
             # time, so the check runs on the step RESULT; rerun in eager
             # mode for per-op localization (tape._check_nan_inf)

@@ -73,7 +73,15 @@ def _load(path: str) -> Dict[str, Any]:
 
 
 def lookup(key: str):
-    """Best-known config for `key`, or None. Never sweeps."""
+    """Best-known config for `key`, or None. Never sweeps.
+    FLAGS_use_autotune=False disables tuned configs entirely (heuristic
+    defaults only — the reference's global autotune kill switch)."""
+    try:
+        from ..framework import core
+        if not core.get_bool_flag("FLAGS_use_autotune", True):
+            return None
+    except Exception:
+        pass
     global _user_cache, _defaults
     with _lock:
         if key in _memo:
@@ -130,7 +138,15 @@ def _time_candidate(fn: Callable[[], Any], iters: int) -> float:
 
 
 def sweeps_enabled() -> bool:
-    return os.environ.get("PADDLE_AUTOTUNE", "0") == "1"
+    if os.environ.get("PADDLE_AUTOTUNE", "0") == "1":
+        return True
+    try:  # flag consumers (ref FLAGS_use_autotune / exhaustive search)
+        from ..framework import core
+        if not core.get_bool_flag("FLAGS_use_autotune", True):
+            return False
+        return core.get_bool_flag("FLAGS_cudnn_exhaustive_search")
+    except Exception:
+        return False
 
 
 def autotune(key: str, candidates: Sequence[Any],

@@ -1,0 +1,168 @@
+"""Decoding API: BeamSearchDecoder + dynamic_decode
+(ref: python/paddle/nn/decode.py — Decoder contract {initialize, step,
+finalize}, BeamSearchDecoder's beam expansion/scoring/pruning, and
+dynamic_decode's loop with early finish; gather_tree backtracks the
+beams).
+
+TPU-native shape discipline: beams ride a folded [batch*beam, ...] batch
+through the user's cell (one MXU matmul per step for ALL beams), scores/
+pruning are top-k over [batch, beam*vocab] — exactly the reference's
+_expand/_merge batch-beams trick — and the time loop is a bounded
+Python loop with host-side early exit (the per-step compute is still
+compiled; a data-dependent while under jit would forbid early exit)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import apply_op
+from ..ops._helpers import to_tensor_like, unwrap
+from ..tensor import Tensor
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Decoder:
+    """Abstract decode contract CONSUMED BY dynamic_decode (ref decode.py
+    Decoder, adapted to this engine's beam bookkeeping):
+
+      initialize(inits) -> (tokens, state)
+      step(time, tokens, state) -> (next_tokens, parent_idx, state,
+                                    finished)   # parent_idx: source beam
+      finalize(step_tokens, step_parents, final_state) -> outputs
+
+    Custom decoders must implement THIS contract; dynamic_decode drives
+    exactly these signatures (BeamSearchDecoder is the shipped impl)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, tokens, state):
+        raise NotImplementedError
+
+    def finalize(self, step_tokens, step_parents, final_state):
+        raise NotImplementedError
+
+
+class BeamSearchDecoder(Decoder):
+    """ref decode.py:BeamSearchDecoder. cell: an RNNCell-like layer
+    (LSTMCell/GRUCell/SimpleRNNCell); embedding_fn maps token ids to cell
+    inputs; output_fn (e.g. the vocab projection Linear) maps cell output
+    to logits."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- batch-beam folding (ref _expand_to_beam_size / _merge_batch_beams)
+    def _expand(self, x):
+        a = unwrap(to_tensor_like(x))
+        a = jnp.repeat(a[:, None], self.beam_size, axis=1)
+        return a.reshape((-1,) + a.shape[2:])
+
+    def initialize(self, initial_cell_states):
+        states = jax.tree_util.tree_map(
+            lambda s: self._expand(s), initial_cell_states,
+            is_leaf=lambda v: isinstance(v, Tensor))
+        nbatch = None
+        for leaf in jax.tree_util.tree_leaves(states):
+            nbatch = leaf.shape[0] // self.beam_size
+            break
+        tokens = jnp.full((nbatch, self.beam_size), self.start_token,
+                          jnp.int32)
+        # beam 0 active, others -inf so step 1 expands ONE beam per batch
+        log_probs = jnp.tile(
+            jnp.array([[0.0] + [-1e9] * (self.beam_size - 1)], jnp.float32),
+            (nbatch, 1))
+        finished = jnp.zeros((nbatch, self.beam_size), bool)
+        return tokens, (states, log_probs, finished)
+
+    def step(self, time, tokens, state):
+        cell_states, log_probs, finished = state
+        nbatch, beam = tokens.shape
+        flat_tok = tokens.reshape(-1)
+        if self.embedding_fn is not None:
+            inp = self.embedding_fn(Tensor(flat_tok))
+        else:
+            inp = Tensor(flat_tok[:, None].astype(jnp.float32))
+        out, new_states = self.cell(inp, jax.tree_util.tree_map(
+            lambda a: Tensor(a), cell_states,
+            is_leaf=lambda v: not isinstance(v, (tuple, list))))
+        logits = self.output_fn(out) if self.output_fn is not None else out
+        lv = unwrap(logits).astype(jnp.float32)
+        vocab = lv.shape[-1]
+        step_lp = jax.nn.log_softmax(lv, axis=-1).reshape(
+            nbatch, beam, vocab)
+        # finished beams only extend with end_token at score 0
+        eos_only = jnp.full((vocab,), -1e9,
+                            jnp.float32).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[:, :, None], eos_only[None, None],
+                            step_lp)
+        total = log_probs[:, :, None] + step_lp          # [nb, beam, V]
+        flat = total.reshape(nbatch, beam * vocab)
+        top_lp, top_idx = jax.lax.top_k(flat, beam)      # [nb, beam]
+        src_beam = (top_idx // vocab).astype(jnp.int32)
+        next_tok = (top_idx % vocab).astype(jnp.int32)
+        # gather parent beams' states
+        flat_src = (jnp.arange(nbatch)[:, None] * beam
+                    + src_beam).reshape(-1)
+        new_states = jax.tree_util.tree_map(
+            lambda a: unwrap(a)[flat_src], new_states,
+            is_leaf=lambda v: isinstance(v, Tensor))
+        new_finished = (jnp.take_along_axis(finished, src_beam, axis=1)
+                        | (next_tok == self.end_token))
+        return (next_tok, src_beam,
+                (new_states, top_lp, new_finished), new_finished)
+
+    def finalize(self, step_tokens, step_parents, final_state):
+        """Backtrack beams with gather_tree (ref decode.py finalize)."""
+        from ..ops.extra import gather_tree
+        ids = jnp.stack(step_tokens)                 # [T, nb, beam]
+        parents = jnp.stack(step_parents)
+        return gather_tree(Tensor(ids.astype(jnp.int32)),
+                           Tensor(parents))
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=64,
+                   output_time_major=False, return_length=False, **kwargs):
+    """ref decode.py:dynamic_decode — run decoder.step until every beam
+    finishes or max_step_num; returns (outputs, final_states) with
+    outputs [batch, beam, T] token paths for BeamSearchDecoder (time-
+    major [T, batch, beam] when output_time_major)."""
+    tokens, state = decoder.initialize(inits)
+    step_tokens, step_parents = [], []
+    lengths = None
+    for t in range(int(max_step_num)):
+        next_tok, src_beam, state, finished = decoder.step(
+            t, tokens, state)
+        step_tokens.append(next_tok)
+        step_parents.append(src_beam)
+        fin_np = np.asarray(finished)
+        src_np = np.asarray(src_beam)
+        if lengths is None:
+            lengths = np.full(fin_np.shape, 0, np.int64)
+        # beams are REORDERED by top-k each step: carry lengths through
+        # the same parent gather the decoder applied to its state
+        lengths = np.take_along_axis(lengths, src_np, axis=1)
+        lengths = np.where((lengths == 0) & fin_np, t + 1, lengths)
+        tokens = next_tok
+        if bool(fin_np.all()):
+            break
+    lengths = np.where(lengths == 0, len(step_tokens), lengths)
+    out = decoder.finalize(step_tokens, step_parents, state)
+    ov = unwrap(out)                                  # [T, nb, beam]
+    if not output_time_major:
+        ov = jnp.transpose(ov, (1, 2, 0))             # [nb, beam, T]
+    result = Tensor(ov, stop_gradient=True)
+    if return_length:
+        return result, state, Tensor(jnp.asarray(lengths),
+                                     stop_gradient=True)
+    return result, state

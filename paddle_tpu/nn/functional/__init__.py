@@ -11,3 +11,8 @@ from .vision import *      # noqa: F401,F403
 # a few aliases paddle exposes at the functional root
 from ...ops.math import sigmoid as _sig  # noqa: F401
 from .common import linear, embedding, one_hot  # noqa: F401
+
+# breadth tail (VERDICT r2 item 8): reference nn.functional surface
+from ...ops.manipulation import pad  # noqa: F401,E402
+from ...ops.extra import (gather_tree, sequence_mask,  # noqa: F401,E402
+                          temporal_shift)

@@ -14,7 +14,9 @@ from ...tensor import Tensor
 from ...ops._helpers import to_tensor_like, unwrap
 
 __all__ = [
-    "relu", "relu_", "relu6", "elu", "elu_", "selu", "celu", "gelu", "silu",
+    "relu", "relu_", "relu6", "elu", "elu_", "selu", "selu_", "celu", "celu_",
+    "gelu", "silu", "silu_", "sigmoid_", "leaky_relu_", "hardswish_",
+    "hardsigmoid_", "hardtanh_", "mish_", "softsign_", "thresholded_relu_",
     "swish", "sigmoid", "hardsigmoid", "hardswish", "hardtanh", "hardshrink",
     "softshrink", "tanhshrink", "leaky_relu", "prelu", "rrelu", "log_sigmoid",
     "maxout", "softmax", "softmax_", "log_softmax", "softplus", "softsign",
@@ -205,3 +207,47 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
             return onehot + y - jax.lax.stop_gradient(y)
         return y
     return apply_op(f, x, name="gumbel_softmax")
+
+
+def sigmoid_(x, name=None):
+    return x._inplace_from(sigmoid(x))
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    return x._inplace_from(leaky_relu(x, negative_slope))
+
+
+def hardswish_(x, name=None):
+    return x._inplace_from(hardswish(x))
+
+
+def hardsigmoid_(x, slope=0.1666667, offset=0.5, name=None):
+    return x._inplace_from(hardsigmoid(x, slope, offset))
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    return x._inplace_from(hardtanh(x, min, max))
+
+
+def celu_(x, alpha=1.0, name=None):
+    return x._inplace_from(celu(x, alpha))
+
+
+def mish_(x, name=None):
+    return x._inplace_from(mish(x))
+
+
+def silu_(x, name=None):
+    return x._inplace_from(silu(x))
+
+
+def selu_(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return x._inplace_from(selu(x, scale, alpha))
+
+
+def softsign_(x, name=None):
+    return x._inplace_from(softsign(x))
+
+
+def thresholded_relu_(x, threshold=1.0, name=None):
+    return x._inplace_from(thresholded_relu(x, threshold))

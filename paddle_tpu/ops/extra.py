@@ -19,6 +19,7 @@ __all__ = [
     "fill_diagonal_tensor", "renorm", "clip_by_norm", "check_numerics",
     "logsigmoid", "bce_loss", "huber_loss", "kldiv_loss", "dirichlet",
     "top_p_sampling", "gather_tree", "identity_loss", "temporal_shift",
+    "sequence_mask",
     "index_select_strided", "tensor_unfold", "view_dtype", "view_shape",
     "trans_layout", "full_int_array", "segment_pool", "fold",
 ]
@@ -273,3 +274,26 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
     """ref: nn/functional/fold (col2im, inverse of unfold)."""
     from ..nn.functional import fold as _fold
     return _fold(x, output_sizes, kernel_sizes, strides, paddings, dilations)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """ref: paddle.nn.functional.sequence_mask (phi sequence_mask op):
+    lengths [..., ] -> mask [..., maxlen] with 1 where position < length.
+    maxlen=None uses x.max() — that makes the OUTPUT SHAPE data-
+    dependent, so under jit pass an explicit maxlen (graph-break
+    semantics otherwise: the value is pulled to the host)."""
+    t = to_tensor_like(x)
+    if maxlen is None:
+        maxlen = int(np.asarray(unwrap(t)).max())
+
+    # canonicalize int64 -> int32 quietly (x64 mode is off by default;
+    # an astype(int64) would warn-and-truncate per call)
+    out_dt = jnp.int32 if str(dtype) in ("int64", "long") else jnp.dtype(dtype)
+
+    def f(lens):
+        pos = jnp.arange(int(maxlen))
+        m = pos[None, :] < lens.reshape(-1, 1)
+        m = m.reshape(tuple(lens.shape) + (int(maxlen),))
+        return m.astype(out_dt)
+
+    return apply_op(f, t, name="sequence_mask")
