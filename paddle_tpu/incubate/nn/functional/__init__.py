@@ -311,6 +311,39 @@ def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
 fused_gemm_epilogue = fused_linear
 
 
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu", name=None):
+    """ref: fused_gemm_epilogue with an activation epilogue
+    (phi/kernels/fusion/gpu/fused_gemm_epilogue_kernel.cu — matmul +
+    bias + relu/gelu in one kernel pass). TPU-native: expressed as one
+    traced op so XLA fuses the bias+activation into the GEMM's output
+    epilogue on the MXU; the custom VJP the reference hand-writes
+    (fused_linear_param_grad_add) falls out of jax.vjp."""
+    from ....autograd.tape import apply_op
+    from ....ops._helpers import to_tensor_like
+
+    acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "none": lambda a: a,
+            "": lambda a: a}
+    if activation not in acts:
+        raise ValueError(f"unsupported epilogue activation {activation!r}")
+    act = acts[activation]
+    args = [to_tensor_like(x), to_tensor_like(y)]
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+
+    def f(a, w, *b):
+        if trans_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if trans_y:
+            w = jnp.swapaxes(w, -1, -2)
+        out = a @ w
+        if b:
+            out = out + b[0]
+        return act(out)
+
+    return apply_op(f, *args, name="fused_linear_activation")
+
+
 def fused_multi_transformer(
         x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
         linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,

@@ -243,3 +243,25 @@ class TestReviewFixes:
         x = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
         out, g = vjp(f, x, v=[paddle.to_tensor(np.float32(1.0))])
         np.testing.assert_allclose(np.asarray(g.numpy()), [4.0, 6.0])
+
+
+def test_fused_linear_activation_epilogue():
+    """ref fused_gemm_epilogue: matmul + bias + activation in one op,
+    grads via vjp (the reference's fused_linear_param_grad_add)."""
+    import paddle_tpu.incubate.nn.functional as IF
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    w = paddle.to_tensor(rng.standard_normal((8, 6)).astype(np.float32))
+    b = paddle.to_tensor(rng.standard_normal((6,)).astype(np.float32))
+    x.stop_gradient = False
+    w.stop_gradient = False
+    out = IF.fused_linear_activation(x, w, b, activation="gelu")
+    import jax
+    ref = jax.nn.gelu(np.asarray(x.numpy()) @ np.asarray(w.numpy())
+                      + np.asarray(b.numpy()))
+    np.testing.assert_allclose(np.asarray(out.numpy()), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    out.sum().backward()
+    assert x.grad is not None and w.grad is not None
+    with pytest.raises(ValueError):
+        IF.fused_linear_activation(x, w, activation="swishish")
