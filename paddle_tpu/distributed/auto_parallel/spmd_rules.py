@@ -254,3 +254,32 @@ def reshard_cost_bytes(src: DistAttr, dst: DistAttr, shape: Sequence[int],
         else:                                  # resharding exchange
             cost += total / max(min(n_src, n_dst), 1)
     return cost
+
+
+# ---------------- rule registry (ref: spmd_rules/rules.h SpmdRuleMap) ----
+_FORWARD_RULES = {
+    "matmul": matmul_rule,
+    "embedding": embedding_rule,
+    "layer_norm": layer_norm_rule,
+    "flash_attention": flash_attention_rule,
+    "elementwise": elementwise_rule,
+    "reduction": reduction_rule,
+    "softmax": softmax_rule,
+}
+
+
+def infer_forward(op_kind: str, *attrs, **kwargs):
+    """Dispatch an op's forward SPMD rule by name (ref
+    phi::distributed::SpmdRuleFactory — the planner/completion layer
+    queries rules per op kind). Returns (resolved_input_attrs,
+    output_attr(s))."""
+    try:
+        rule = _FORWARD_RULES[op_kind]
+    except KeyError:
+        raise ValueError(
+            f"no SPMD rule registered for op kind {op_kind!r}; "
+            f"known: {sorted(_FORWARD_RULES)}") from None
+    return rule(*attrs, **kwargs)
+
+
+__all__ += ["infer_forward"]

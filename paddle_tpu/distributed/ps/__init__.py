@@ -373,3 +373,117 @@ class PSClient:
                 self._conn.close()
             except OSError:
                 pass
+
+
+class SSDSparseTable(SparseTable):
+    """Disk-extended sparse table (ref: ps/table/ssd_sparse_table.cc —
+    hot rows in memory, cold rows spilled to an on-disk KV store so the
+    embedding table can exceed host RAM; the reference uses RocksDB).
+
+    TPU-native/host-side: an LRU of `cache_rows` hot rows in memory;
+    colder rows (values + optimizer state) live in per-shard .npz files
+    keyed by id hash. Eviction happens on insert past capacity; reads
+    fault rows back in and refresh recency.
+    """
+
+    def __init__(self, emb_dim, rule="sgd", initializer=None, seed=0,
+                 path=None, cache_rows=100_000, shards=64):
+        import os
+        import tempfile
+        super().__init__(emb_dim, rule, initializer, seed)
+        self.path = path or tempfile.mkdtemp(prefix="paddle_tpu_ssd_")
+        os.makedirs(self.path, exist_ok=True)
+        self.cache_rows = int(cache_rows)
+        self.n_shards = int(shards)
+        self._lru: Dict[int, None] = {}     # ordered dict as LRU
+        self._on_disk: set = set()
+
+    # -- disk shard helpers -------------------------------------------------
+    def _shard_file(self, i: int) -> str:
+        import os
+        return os.path.join(self.path, f"shard_{i % self.n_shards}.npz")
+
+    def _load_shard(self, f):
+        import os
+        if not os.path.exists(f):
+            return {}
+        # plain numeric arrays only — allow_pickle would turn a tampered
+        # shard file into code execution
+        with np.load(f, allow_pickle=False) as z:
+            return {k: z[k] for k in z.files}
+
+    def _spill_many(self, victims):
+        """Write a batch of rows (+ states) to their shard files: one
+        read-modify-write per TOUCHED shard, not per row — a full cold
+        scan would otherwise rewrite every shard once per eviction."""
+        by_shard: Dict[str, list] = {}
+        for i in victims:
+            by_shard.setdefault(self._shard_file(i), []).append(i)
+        for f, ids in by_shard.items():
+            data = self._load_shard(f)
+            for i in ids:
+                data[f"r{i}"] = self.rows.pop(i)
+                st = self.states.pop(i, None)
+                if st:
+                    for k, v in st.items():
+                        data[f"s{i}:{k}"] = np.asarray(v)
+                self._on_disk.add(i)
+                self._lru.pop(i, None)
+            np.savez(f, **data)
+
+    def _spill(self, i: int):
+        self._spill_many([i])
+
+    def _fault_in(self, i: int):
+        f = self._shard_file(i)
+        data = self._load_shard(f)
+        self.rows[i] = np.asarray(data[f"r{i}"], np.float32)
+        st = {}
+        for k in data:
+            if k.startswith(f"s{i}:"):
+                v = data[k]
+                st[k.split(":", 1)[1]] = (v.item() if v.ndim == 0 else v)
+        self.states[i] = st or self.rule.init_state((self.dim,))
+        self._on_disk.discard(i)
+
+    def _touch(self, i: int):
+        self._lru.pop(i, None)
+        self._lru[i] = None
+        if len(self._lru) > self.cache_rows:
+            # evict in one batch down to 7/8 capacity so sequential cold
+            # scans amortize shard rewrites instead of evicting per row
+            n_evict = len(self._lru) - (self.cache_rows * 7 // 8)
+            it = iter(self._lru)
+            self._spill_many([next(it) for _ in range(n_evict)])
+
+    def _row(self, i: int) -> np.ndarray:
+        if i in self._on_disk:
+            self._fault_in(i)
+        r = super()._row(i)
+        self._touch(i)
+        return r
+
+    def __len__(self):
+        return len(self.rows) + len(self._on_disk)
+
+
+class GeoSGDRule(SGDRule):
+    """Geometric-SGD async rule (ref: ps/table/sparse_geo_table.cc +
+    fleet GeoSGD mode): workers train LOCALLY for k steps and
+    periodically push the parameter DELTA; the server blends deltas
+    (delta / trainer_count) into the global table instead of applying
+    raw gradients — tolerating stale, bursty updates."""
+
+    def __init__(self, learning_rate=1.0, trainer_count=1):
+        super().__init__(learning_rate)
+        self.trainer_count = max(1, int(trainer_count))
+
+    def apply(self, param, delta, state):
+        # `delta` is (local_param - global_param), NOT a gradient
+        param += self.lr * np.asarray(delta, np.float32) \
+            / self.trainer_count
+        return param
+
+
+_RULES["geo_sgd"] = GeoSGDRule
+__all__ += ["SSDSparseTable", "GeoSGDRule"]

@@ -290,22 +290,9 @@ def apply_per_channel_scale(x, scales):
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
     """ref: fused_gemm_epilogue kernel (matmul + bias in one pass — XLA
     fuses the epilogue on TPU natively)."""
-    from ....autograd.tape import apply_op
-    from ....ops._helpers import to_tensor_like
-
-    args = [to_tensor_like(x), to_tensor_like(weight)]
-    if bias is not None:
-        args.append(to_tensor_like(bias))
-
-    def f(a, w, *b):
-        if transpose_weight:
-            w = jnp.swapaxes(w, -1, -2)
-        out = a @ w
-        if b:
-            out = out + b[0]
-        return out
-
-    return apply_op(f, *args, name="fused_linear")
+    return fused_linear_activation(x, weight, bias,
+                                   trans_y=transpose_weight,
+                                   activation="none", name=name)
 
 
 fused_gemm_epilogue = fused_linear

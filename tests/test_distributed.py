@@ -74,8 +74,10 @@ def test_graft_entry_dryrun():
     import __graft_entry__ as g
     import jax
     fn, args = g.entry()
-    out = jax.jit(fn)(*args)
-    assert out.shape[0] == 2
+    logits, loss = jax.jit(fn)(*args)
+    assert logits.shape[0] == 2
+    import numpy as np
+    assert np.isfinite(float(loss))   # fused-CE kernel smoke ran
     g.dryrun_multichip(8)
 
 

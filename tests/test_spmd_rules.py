@@ -257,3 +257,15 @@ class TestMeasuredPlanner:
         finalist_cfgs = [tuple(sorted(c.config.items()))
                          for c in planner.ranking()[:4]]
         assert tuple(sorted(measured[0].config.items())) in finalist_cfgs
+
+
+class TestRuleRegistry:
+    def test_dispatch_by_op_kind(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            infer_forward)
+        x = DistAttr(["dp", None])
+        w = DistAttr([None, "mp"])
+        (rx, rw), out = infer_forward("matmul", x, w)
+        assert out.dims_mapping == ["dp", "mp"]
+        with pytest.raises(ValueError, match="no SPMD rule"):
+            infer_forward("conv3d_transpose", x, w)
