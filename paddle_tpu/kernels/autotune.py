@@ -118,6 +118,25 @@ def record(key: str, best, timings_ms: Optional[Dict[str, float]] = None):
             pass
 
 
+def forget(key: str):
+    """Drop a cache entry (memo + user file) — sweep repair path."""
+    global _user_cache
+    path = _user_cache_path()
+    with _lock:
+        _memo.pop(key, None)
+        if _user_cache is None:
+            _user_cache = _load(path)
+        if key in _user_cache:
+            _user_cache.pop(key)
+            tmp = path + ".tmp"
+            try:
+                with open(tmp, "w") as f:
+                    json.dump(_user_cache, f, indent=1, sort_keys=True)
+                os.replace(tmp, path)
+            except OSError:
+                pass
+
+
 def _time_candidate(fn: Callable[[], Any], iters: int) -> float:
     """Median-of-3 wall time (ms per iteration) of a jitted loop."""
     import time

@@ -32,7 +32,15 @@ _NEG = -1e30
 _FORCE_PALLAS = False
 
 
-def _block_size(s: int) -> int:
+def _block_size(s: int, which: str = "q") -> int:
+    """Largest dividing block <= 512, overridable by an autotune-cache
+    winner for this sequence-length class (kernels/autotune.py)."""
+    from . import autotune
+    hit = autotune.lookup(autotune.cache_key("block_attn", S=s))
+    if hit:
+        b = hit[0] if which == "q" else hit[-1]
+        if s % b == 0:
+            return b
     for b in (512, 256, 128):
         if s % b == 0:
             return b
@@ -61,8 +69,8 @@ def _pallas_fwd(q, k, v, mask, scale):
 
     N, Sq, D = q.shape
     Sk = k.shape[1]
-    bq = _block_size(Sq)     # exact divisors — no dropped tail blocks
-    bk = _block_size(Sk)
+    bq = _block_size(Sq, "q")   # exact divisors — no dropped tail blocks
+    bk = _block_size(Sk, "k")
     grid = (N, Sq // bq, Sk // bk)
     use_mask = mask is not None
     if not use_mask:

@@ -236,3 +236,48 @@ def sweep_block_sizes(Sq=2048, Sk=2048, D=128, H=16, B=4, causal=True,
         key, candidates, make_fn,
         default=[min(512, Sq), min(512, Sk)], iters=iters,
         sweep=True if (resweep or autotune.lookup(key) is None) else None)
+
+
+def packed_supported(total_q, total_k, n_heads_q, n_heads_k, D) -> bool:
+    """Varlen PACKED route eligibility (ref flash_attn_varlen /
+    flash_attn_unpadded kernel): the packed total length pads up to the
+    128 alignment, so any total works on TPU; only head-dim rules and
+    MHA (packed GQA falls back) gate it."""
+    if not _on_tpu():
+        return False
+    d_ok = (D % 64 == 0) if D <= 128 else (D % 128 == 0)
+    return d_ok and n_heads_q == n_heads_k
+
+
+def flash_attention_packed(q, k, v, seg_q, seg_kv, causal=False,
+                           scale=None):
+    """Packed-varlen flash attention: q/k/v [total, H, D] holding many
+    sequences back-to-back; seg_q/seg_kv int32 [total] sequence ids
+    (1-based; 0 = padding). Runs the flash kernel with batch 1 and
+    segment-id masking — cross-sequence attention is masked by segment,
+    and GLOBAL causal + segments equals per-sequence causal because
+    packing preserves intra-sequence order (valid for self-attention
+    layouts where q and kv share the packing).
+    """
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        SegmentIds, flash_attention)
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    Tq, H, D = q.shape
+    Tk = k.shape[0]
+    pad_q = (-Tq) % _SEQ_ALIGN
+    pad_k = (-Tk) % _SEQ_ALIGN
+    qp = jnp.pad(q, ((0, pad_q), (0, 0), (0, 0)))
+    kp = jnp.pad(k, ((0, pad_k), (0, 0), (0, 0)))
+    vp = jnp.pad(v, ((0, pad_k), (0, 0), (0, 0)))
+    sq = jnp.pad(seg_q.astype(jnp.int32), (0, pad_q))   # pad -> seg 0
+    sk = jnp.pad(seg_kv.astype(jnp.int32), (0, pad_k))
+    qt = jnp.swapaxes(qp, 0, 1)[None]     # [1, H, T, D]
+    kt = jnp.swapaxes(kp, 0, 1)[None]
+    vt = jnp.swapaxes(vp, 0, 1)[None]
+    out = flash_attention(
+        qt, kt, vt, segment_ids=SegmentIds(q=sq[None], kv=sk[None]),
+        causal=causal, sm_scale=scale,
+        block_sizes=_block_sizes(qt.shape[2], kt.shape[2], D, causal))
+    out = jnp.swapaxes(out[0], 0, 1)[:Tq]
+    return out.astype(q.dtype)

@@ -146,18 +146,61 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
+def _packed_segments(cu, total):
+    """cu_seqlens [n+1] -> per-token segment ids [total], 1-BASED so the
+    kernel's alignment padding (segment 0) can never attend to or from a
+    real sequence (segment equality is the kernel's mask)."""
+    return jnp.cumsum(jnp.zeros(total, jnp.int32)
+                      .at[cu[1:-1]].add(1)) + 1
+
+
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
                         causal=False, return_softmax=False,
                         fixed_seed_offset=None, rng_name="", training=True,
                         name=None):
-    """Varlen flash attention: segment-masked dense fallback
-    (ref: flash_attn_unpadded; a Pallas varlen kernel is on the roadmap)."""
+    """Varlen flash attention over PACKED sequences
+    (ref: flash_attn_unpadded / flash_attn_varlen kernel).
+
+    TPU route: the Pallas flash kernel with batch 1 + per-token SEGMENT
+    IDS built from cu_seqlens — cross-sequence attention is segment-
+    masked, and global causal + packing order equals per-sequence causal
+    when q/kv share the packing (self-attention). Dense fallback
+    otherwise (CPU, GQA packing, mismatched q/kv packings under causal).
+    """
     q = to_tensor_like(query)   # [total_q, H, D]
     k = to_tensor_like(key)
     v = to_tensor_like(value)
     cq = unwrap(cu_seqlens_q)
     ck = unwrap(cu_seqlens_k)
+
+    from ...kernels import flash_attention as fa
+    causal_ok = True
+    if causal:
+        # causal packing only valid when q/kv pack identically; under
+        # jit the offsets may be tracers (host-uncomparable) — object
+        # identity (the standard self-attention call) still decides
+        if cq is ck or cu_seqlens_q is cu_seqlens_k:
+            causal_ok = True
+        else:
+            try:
+                import numpy as _np
+                causal_ok = _np.array_equal(_np.asarray(cq),
+                                            _np.asarray(ck))
+            except Exception:
+                causal_ok = False
+    # dropout is inert outside training — don't let an inference call
+    # with a configured dropout fall to the O(total^2) dense path
+    if ((dropout == 0.0 or not training) and causal_ok
+            and fa.packed_supported(q.shape[0], k.shape[0],
+                                    q.shape[1], k.shape[1], q.shape[2])):
+        def fk(qq, kk, vv):
+            return fa.flash_attention_packed(
+                qq, kk, vv, _packed_segments(cq, qq.shape[0]),
+                _packed_segments(ck, kk.shape[0]), causal=causal,
+                scale=scale)
+
+        return apply_op(fk, q, k, v, name="flash_attn_unpadded"), None
 
     def f(qq, kk, vv):
         total_q = qq.shape[0]

@@ -311,3 +311,87 @@ class TestAutotuneCache:
         b = fused_cross_entropy(logits, labels, -100, (64, 96))
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-5, rtol=1e-5)
+
+
+class TestVarlenPacked:
+    """flash_attn_unpadded's TPU route: packed sequences via batch-1
+    flash kernel + segment ids (VERDICT parity: flash_attn_varlen)."""
+
+    def test_packed_supported_gating(self, fake_tpu):
+        assert fa.packed_supported(300, 300, 8, 8, 64)   # pads to 384
+        assert not fa.packed_supported(300, 300, 8, 4, 64)  # packed GQA
+        assert not fa.packed_supported(300, 300, 8, 8, 48)  # head dim
+
+    def test_inference_dropout_still_routes_to_kernel(self, fake_tpu):
+        """dropout is inert when training=False — the gate must not
+        push inference calls onto the O(total^2) dense path."""
+        import paddle_tpu.nn.functional as F
+
+        def fwd(q):
+            cu = jnp.array([0, 128, 256], jnp.int32)
+            out, _ = F.flash_attn_unpadded(
+                paddle.to_tensor(q), paddle.to_tensor(q),
+                paddle.to_tensor(q), cu, cu, 128, 128, scale=0.125,
+                dropout=0.1, causal=True, training=False)
+            return out.data
+
+        q = jnp.zeros((256, 4, 64), jnp.bfloat16)
+        txt = _export_tpu(fwd, q)
+        assert "tpu_custom_call" in txt
+
+    def test_unpadded_lowers_to_pallas(self, fake_tpu):
+        import paddle_tpu.nn.functional as F
+
+        def fwd(q, k, v):
+            cu = jnp.array([0, 100, 250], jnp.int32)
+            out, _ = F.flash_attn_unpadded(
+                paddle.to_tensor(q), paddle.to_tensor(k),
+                paddle.to_tensor(v), cu_seqlens_q=cu, cu_seqlens_k=cu,
+                max_seqlen_q=150, max_seqlen_k=150, scale=0.125,
+                causal=True)
+            return out.data
+
+        q = jnp.zeros((250, 4, 64), jnp.bfloat16)
+        txt = _export_tpu(fwd, q, q, q)
+        assert "tpu_custom_call" in txt, "varlen fell to the dense path"
+
+    def test_packed_segment_ids_construction(self):
+        """The segment-id builder feeding the kernel: 1-BASED real
+        segments with boundaries exactly at cu_seqlens, so the kernel's
+        alignment padding (segment 0 after jnp.pad) can never attend a
+        real sequence. A dropped '+1' would alias the first sequence
+        with padding and ship wrong attention undetected (the kernel
+        itself only runs on-chip)."""
+        from paddle_tpu.nn.functional.attention import _packed_segments
+        seg = np.asarray(_packed_segments(
+            jnp.array([0, 4, 10], jnp.int32), 10))
+        np.testing.assert_array_equal(
+            seg, [1, 1, 1, 1, 2, 2, 2, 2, 2, 2])
+        assert seg.min() >= 1          # 0 reserved for padding
+        padded = np.asarray(jnp.pad(jnp.asarray(seg), (0, 6)))
+        assert (padded[10:] == 0).all()
+
+    def test_packed_dense_fallback_semantics(self):
+        """CPU check of the DENSE fallback on the same packing (the
+        kernel path's numerics are validated on-chip)."""
+        import paddle_tpu.nn.functional as F
+        rng = np.random.default_rng(0)
+        total, H, D = 10, 2, 8
+        q = paddle.to_tensor(rng.standard_normal(
+            (total, H, D)).astype(np.float32))
+        cu = jnp.array([0, 4, 10], jnp.int32)
+        out, _ = F.flash_attn_unpadded(q, q, q, cu, cu, 6, 6,
+                                       scale=1.0 / np.sqrt(D), causal=True)
+        ov = np.asarray(out.numpy())
+        # manually: each sequence attends only itself, causally
+        qq = np.asarray(q.numpy())
+        for (s, e) in ((0, 4), (4, 10)):
+            seg = qq[s:e]
+            sc = np.einsum("qhd,khd->hqk", seg, seg) / np.sqrt(D)
+            L = e - s
+            mask = np.tril(np.ones((L, L), bool))
+            sc = np.where(mask[None], sc, -np.inf)
+            p = np.exp(sc - sc.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            ref = np.einsum("hqk,khd->qhd", p, seg)
+            np.testing.assert_allclose(ov[s:e], ref, rtol=1e-5, atol=1e-5)
