@@ -55,11 +55,19 @@ def _addr(endpoint):
 
 
 def _serve_loop(listener):
+    from ..collective import _listener_closed
     while not _state.stop.is_set():
         try:
             conn = listener.accept()
-        except (OSError, EOFError):
-            break
+        except Exception:
+            # a peer dropping mid-handshake (port scan, stale key)
+            # raises AuthenticationError/EOFError/ConnectionResetError —
+            # none of which may kill the service; only listener closure
+            # ends the loop (same hardening as collective/ps channels)
+            if _listener_closed(listener):
+                break
+            time.sleep(0.02)
+            continue
         _state.pool.submit(_handle, conn)
 
 
@@ -135,16 +143,10 @@ def init_rpc(name: str, rank: int = None, world_size: int = None,
         target=_serve_loop, args=(listener,), daemon=True)
     _state.serve_thread.start()
 
-    # register with rank 0 and fetch the full worker table
+    # register with rank 0 and fetch the full worker table (shared
+    # retry helper — same hardening as worker-to-worker calls)
     deadline = time.time() + 60
-    while True:
-        try:
-            c = Client(_addr(master_endpoint), authkey=_AUTH())
-            break
-        except (ConnectionError, OSError):
-            if time.time() > deadline:
-                raise TimeoutError("rpc: master not reachable")
-            time.sleep(0.05)
+    c = _connect_with_retry(_addr(master_endpoint), 60.0)
     c.send(("register", _state.me))
     c.recv()
     while True:
@@ -172,11 +174,42 @@ def get_all_worker_infos():
     return list(_state.workers.values())
 
 
+def _connect_with_retry(addr, timeout_s: float):
+    """Cross-host transport hardening shared by the registry connect and
+    worker calls: transient failures (peer restarting, SYN drop) retry
+    with exponential backoff up to `timeout_s`. AuthenticationError is
+    retried only briefly (2s — the mid-keyfile-creation race window); a
+    persistent key mismatch must fail FAST with its real type, not hang
+    the full window disguised as unreachability."""
+    from multiprocessing import AuthenticationError
+    start = time.time()
+    deadline = start + timeout_s
+    wait = 0.05
+    while True:
+        try:
+            return Client(addr, authkey=_AUTH())
+        except AuthenticationError:
+            if time.time() > start + 2.0:
+                raise
+        except (ConnectionError, OSError) as e:
+            if time.time() > deadline:
+                raise ConnectionError(
+                    f"rpc: endpoint {addr} unreachable after "
+                    f"{timeout_s:.0f}s: {e}") from e
+        time.sleep(wait)
+        wait = min(wait * 2, 1.0)
+
+
 def _call(to: str, fn, args, kwargs):
     info = _state.workers[to] if to in _state.workers else None
     if info is None:
         raise KeyError(f"rpc: unknown worker '{to}'")
-    c = Client(_addr(info.endpoint), authkey=_AUTH())
+    # short default: these retries run on the SHARED thread pool that
+    # also serves inbound calls — a dead peer must not starve it for
+    # long (raise PADDLE_RPC_CONNECT_TIMEOUT for flaky networks)
+    c = _connect_with_retry(
+        _addr(info.endpoint),
+        float(os.environ.get("PADDLE_RPC_CONNECT_TIMEOUT", "5")))
     try:
         c.send(("call", fn, tuple(args or ()), dict(kwargs or {})))
         status, payload = c.recv()

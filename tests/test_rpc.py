@@ -102,3 +102,27 @@ def test_membership_heartbeat_expiry():
     finally:
         node1.stop()
         master.stop()
+
+
+def test_serve_loop_survives_handshake_failure():
+    """A port scan / wrong-key peer must not kill the rpc service
+    (cross-host transport hardening, round 3)."""
+    import socket
+    import time as _time
+
+    import paddle_tpu.distributed.rpc as rpc
+    os.environ["PADDLE_RPC_AUTHKEY"] = "rpc-test-key"
+    os.environ["PADDLE_MASTER_ENDPOINT"] = "127.0.0.1:29771"
+    try:
+        rpc.init_rpc("solo", rank=0, world_size=1,
+                     master_endpoint="127.0.0.1:29771")
+        for _ in range(3):           # handshake-dropping scans
+            s = socket.create_connection(("127.0.0.1", 29771))
+            s.close()
+        _time.sleep(0.3)
+        # service still answers a real call
+        assert rpc.rpc_sync("solo", operator.add, args=(2, 3)) == 5
+    finally:
+        rpc.shutdown()
+        os.environ.pop("PADDLE_RPC_AUTHKEY", None)
+        os.environ.pop("PADDLE_MASTER_ENDPOINT", None)
