@@ -125,3 +125,24 @@ class TestInt8PTQ:
         # int8 per-channel weight-only: argmax token agreement on >= 4/5
         agree = sum(a == b for a, b in zip(fp_out, q8_out))
         assert agree >= 4, (fp_out, q8_out)
+
+
+class TestGQAServing:
+    def test_gqa_model_serves_and_matches_generate(self):
+        """GQA config through the engine: the ragged decode path's
+        kv-head handling must match the model's own generate."""
+        paddle.seed(3)
+        cfg = LlamaConfig(vocab_size=96, hidden_size=64,
+                          intermediate_size=128, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=128,
+                          use_recompute=False)
+        model = LlamaForCausalLM(cfg)
+        prompt = [7, 21, 3]
+        ref = _reference_generate(model, prompt, 5)
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                       prefill_buckets=(8,))
+        eng.add_request(GenerationRequest(prompt, max_new_tokens=5))
+        while eng.has_work:
+            eng.step()
+        assert eng.finished[0].output == ref
