@@ -519,3 +519,314 @@ def fused_multi_transformer(
     if cache_kvs is None:
         return _T(h, stop_gradient=True)   # reference returns out alone
     return _T(h, stop_gradient=True), new_caches
+
+
+# ---------------------------------------------------------------------------
+# functional variants of the fused-transformer surface (ref: incubate/nn/
+# functional/__init__.py __all__ — fused_multi_head_attention,
+# fused_feedforward, fused_matmul_bias, fused_dropout_add,
+# fused_bias_dropout_residual_layer_norm, fused_ec_moe,
+# variable_length_memory_efficient_attention). The CUDA side hand-fuses
+# each into one kernel; here each is ONE tape op whose jnp body XLA
+# fuses, with attention routed through the Pallas flash kernel when
+# eligible — identical policy to the layer classes in ../layer.py.
+# ---------------------------------------------------------------------------
+
+def _dropout_mode(x, rate, training, mode):
+    """paddle dropout conventions: upscale_in_train (default, what
+    layer._dropout implements) vs downscale_in_infer (identity in train,
+    scale by (1-p) at infer)."""
+    from ..layer import _dropout
+    if mode == "downscale_in_infer":
+        if not training:
+            return x * (1.0 - rate)
+        if rate <= 0.0:
+            return x
+        key = jax.random.key(0)  # replaced below by tape rng
+        from ....framework import core as _core
+        mask = jax.random.bernoulli(_core.next_rng_key(), 1.0 - rate,
+                                    x.shape)
+        return jnp.where(mask, x, 0.0).astype(x.dtype)
+    return _dropout(x, rate, training)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """ref: fused_matmul_bias.py:21 — matmul + bias epilogue in one op."""
+    return fused_linear_activation(x, y, bias, trans_x=transpose_x,
+                                   trans_y=transpose_y, activation="none",
+                                   name=name)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """ref: fused_dropout_add.py:22 — dropout(x) + y in one op."""
+    from ....autograd.tape import apply_op
+    from ....ops._helpers import to_tensor_like
+
+    def f(a, b):
+        return _dropout_mode(a, p, training, mode) + b
+
+    return apply_op(f, to_tensor_like(x), to_tensor_like(y),
+                    name="fused_dropout_add")
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """ref: fused_transformer.py:323 — LN(residual + dropout(x + bias))."""
+    from ..layer import _ln
+    from ....autograd.tape import apply_op
+    from ....ops._helpers import to_tensor_like
+
+    args = [to_tensor_like(x), to_tensor_like(residual)]
+    opt = [bias, ln_scale, ln_bias]
+    present = [a is not None for a in opt]
+    args += [to_tensor_like(a) for a in opt if a is not None]
+
+    def f(a, res, *rest):
+        it = iter(rest)
+        b = next(it) if present[0] else None
+        g = next(it) if present[1] else None
+        lb = next(it) if present[2] else None
+        h = a if b is None else a + b
+        return _ln(res + _dropout_mode(h, dropout_rate, training, mode),
+                   g, lb, ln_epsilon)
+
+    return apply_op(f, *args, name="fused_bias_dropout_residual_ln")
+
+
+def fused_multi_head_attention(
+        x, qkv_weight, linear_weight, pre_layer_norm=False,
+        pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
+        pre_ln_epsilon=1e-5, qkv_bias=None, linear_bias=None, cache_kv=None,
+        attn_mask=None, dropout_rate=0.5, attn_dropout_rate=0.5,
+        ln_epsilon=1e-5, training=True, mode="upscale_in_train", ring_id=-1,
+        add_residual=True, num_heads=-1, transpose_qkv_wb=False, name=None):
+    """ref: fused_transformer.py:514 fused_multi_head_attention —
+    self-attention with packed qkv weight [3, nh, d, H] (or [H, 3*H]
+    when transpose_qkv_wb), pre/post-LN, residual + dropout epilogue.
+    Attention itself routes through the Pallas flash kernel when the
+    mask/dropout configuration allows (same policy as
+    FusedMultiHeadAttention in ../layer.py)."""
+    import math as _math
+
+    from ..layer import _ln
+    from ....autograd.tape import apply_op
+    from ....kernels import flash_attention as fa
+    from ....ops._helpers import to_tensor_like
+
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention cache_kv: use "
+            "incubate.nn.functional.masked_multihead_attention for the "
+            "cached decode step (paged-KV kernel path)")
+    opt = [qkv_bias, linear_bias, pre_ln_scale, pre_ln_bias, ln_scale,
+           ln_bias, attn_mask]
+    present = [a is not None for a in opt]
+    args = [to_tensor_like(x), to_tensor_like(qkv_weight),
+            to_tensor_like(linear_weight)]
+    args += [to_tensor_like(a) for a in opt if a is not None]
+
+    def f(xv, qkvw, lw, *rest):
+        it = iter(rest)
+        qb = next(it) if present[0] else None
+        lb = next(it) if present[1] else None
+        pg = next(it) if present[2] else None
+        pb = next(it) if present[3] else None
+        g = next(it) if present[4] else None
+        b = next(it) if present[5] else None
+        mask = next(it) if present[6] else None
+        B, S, H = xv.shape
+        if transpose_qkv_wb:
+            nh = int(num_heads)
+            assert nh > 0, "num_heads required with transpose_qkv_wb"
+            d = H // nh
+            w2 = qkvw                                  # [H, 3H]
+        else:
+            _, nh, d, _ = qkvw.shape
+            w2 = qkvw.reshape(3 * nh * d, H).T
+        residual = xv
+        a = _ln(xv, pg, pb, pre_ln_epsilon) if pre_layer_norm else xv
+        qkv = a @ w2
+        if qb is not None:
+            qkv = qkv + qb.reshape(-1)
+        qkv = qkv.reshape(B, S, 3, nh, d)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        no_drop = (not training) or attn_dropout_rate <= 0.0
+        if mask is None and no_drop and fa.supported(q.shape, k.shape,
+                                                     True):
+            o = fa.flash_attention_bshd(q, k, v, causal=False)
+        else:
+            s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                           k.astype(jnp.float32)) / _math.sqrt(d)
+            if mask is not None:
+                s = s + mask.astype(jnp.float32)
+            p = jax.nn.softmax(s, axis=-1)
+            p = _dropout_mode(p, attn_dropout_rate, training, mode)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p,
+                           v.astype(jnp.float32)).astype(xv.dtype)
+        out = o.reshape(B, S, H) @ lw
+        if lb is not None:
+            out = out + lb
+        out = _dropout_mode(out, dropout_rate, training, mode)
+        if add_residual:
+            out = residual + out
+        if not pre_layer_norm:
+            out = _ln(out, g, b, ln_epsilon)
+        return out
+
+    return apply_op(f, *args, name="fused_multi_head_attention")
+
+
+def fused_feedforward(
+        x, linear1_weight, linear2_weight, linear1_bias=None,
+        linear2_bias=None, ln1_scale=None, ln1_bias=None, ln2_scale=None,
+        ln2_bias=None, dropout1_rate=0.5, dropout2_rate=0.5,
+        activation="relu", ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+        pre_layer_norm=False, training=True, mode="upscale_in_train",
+        ring_id=-1, add_residual=True, name=None):
+    """ref: fused_transformer.py:36 fused_feedforward —
+    residual + dropout2(linear2(dropout1(act(linear1(LN? x))))), LN
+    placement per pre_layer_norm."""
+    from ..layer import _ln
+    from ....autograd.tape import apply_op
+    from ....ops._helpers import to_tensor_like
+
+    act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}[activation]
+    opt = [linear1_bias, linear2_bias, ln1_scale, ln1_bias, ln2_scale,
+           ln2_bias]
+    present = [a is not None for a in opt]
+    args = [to_tensor_like(x), to_tensor_like(linear1_weight),
+            to_tensor_like(linear2_weight)]
+    args += [to_tensor_like(a) for a in opt if a is not None]
+
+    def f(xv, w1, w2, *rest):
+        it = iter(rest)
+        b1 = next(it) if present[0] else None
+        b2 = next(it) if present[1] else None
+        g1 = next(it) if present[2] else None
+        lb1 = next(it) if present[3] else None
+        g2 = next(it) if present[4] else None
+        lb2 = next(it) if present[5] else None
+        residual = xv
+        a = _ln(xv, g1, lb1, ln1_epsilon) if pre_layer_norm else xv
+        h = a @ w1
+        if b1 is not None:
+            h = h + b1
+        h = _dropout_mode(act(h), dropout1_rate, training, mode)
+        out = h @ w2
+        if b2 is not None:
+            out = out + b2
+        out = _dropout_mode(out, dropout2_rate, training, mode)
+        if add_residual:
+            out = residual + out
+        if not pre_layer_norm:
+            out = _ln(out, g2, lb2, ln2_epsilon)
+        return out
+
+    return apply_op(f, *args, name="fused_feedforward")
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu", name=None):
+    """ref: fused_ec_moe.py:18 — expert-choice MoE over caller-supplied
+    gate logits [B, S, E]; expert weights [e, d, f] / [e, f, d]."""
+    from ....autograd.tape import apply_op
+    from ....ops._helpers import to_tensor_like
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[act_type]
+
+    def f(xv, gl, w1, b1, w2, b2):
+        B, S, H = xv.shape
+        E = gl.shape[-1]
+        T = B * S
+        flat = xv.reshape(T, H)
+        scores = jax.nn.softmax(gl.reshape(T, E).astype(jnp.float32), -1)
+        cap = max(T // E, 1)
+        probs, idx = jax.lax.top_k(scores.T, cap)        # [E, cap]
+        tok = jnp.take(flat, idx.reshape(-1), axis=0).reshape(E, cap, H)
+        b1v = b1.reshape(E, 1, -1)
+        b2v = b2.reshape(E, 1, -1)
+        hmid = act(jnp.einsum("ech,ehm->ecm", tok, w1) + b1v)
+        out = jnp.einsum("ecm,emh->ech", hmid, w2) + b2v
+        out = out * probs[..., None].astype(out.dtype)
+        flat_out = jnp.zeros((T, H), out.dtype).at[idx.reshape(-1)].add(
+            out.reshape(E * cap, H))
+        return flat_out.reshape(B, S, H).astype(xv.dtype)
+
+    return apply_op(f, to_tensor_like(x), to_tensor_like(gate),
+                    to_tensor_like(bmm0_weight), to_tensor_like(bmm0_bias),
+                    to_tensor_like(bmm1_weight), to_tensor_like(bmm1_bias),
+                    name="fused_ec_moe")
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, pre_cache_length=0, name=None):
+    """ref: variable_length_memory_efficient_attention.py:28 (cutlass
+    memory-efficient attention) — [B, nh, S, D] layout with per-batch
+    valid lengths. TPU-native: per-length masked attention in one op;
+    the memory-efficient tiling is the flash kernel's job when shapes
+    allow, else a fused-XLA dense body."""
+    import math as _math
+
+    from ....autograd.tape import apply_op
+    from ....kernels import flash_attention as fa
+    from ....ops._helpers import to_tensor_like
+
+    args = [to_tensor_like(query), to_tensor_like(key),
+            to_tensor_like(value), to_tensor_like(seq_lens),
+            to_tensor_like(kv_seq_lens)]
+    has_mask = mask is not None
+    if has_mask:
+        args.append(to_tensor_like(mask))
+
+    def f(q, k, v, ql, kl, *m):
+        B, nh, Sq, D = q.shape
+        Sk = k.shape[2]
+        sc = scale if scale is not None else 1.0 / _math.sqrt(D)
+        ql_ = ql.reshape(B)
+        # kv layout: [pre_cache | variable tokens] — cache positions are
+        # always valid, token validity is governed by kv_seq_lens
+        kl_ = kl.reshape(B) + int(pre_cache_length)
+        if (not m) and not causal and fa.supported(
+                (B, Sq, nh, D), (B, Sk, k.shape[1], D), True):
+            # lengths ride the kernel's segment ids as a padding mask
+            pm = jnp.arange(Sk)[None, :] < kl_[:, None]
+            o = fa.flash_attention_bshd(
+                jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                jnp.swapaxes(v, 1, 2), causal=False, scale=sc,
+                padding_mask=pm)
+            o = jnp.swapaxes(o, 1, 2)
+        else:
+            s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                           k.astype(jnp.float32)) * sc
+            valid = (jnp.arange(Sk)[None, :] < kl_[:, None])[:, None, None]
+            if causal:
+                cm = (jnp.arange(Sk)[None, :]
+                      <= jnp.arange(Sq)[:, None])[None, None]
+                valid = valid & cm
+            s = jnp.where(valid, s, -1e30)
+            if m:
+                s = s + m[0].astype(jnp.float32)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+        # queries beyond their length are don't-care; zero them for
+        # deterministic output
+        qvalid = (jnp.arange(Sq)[None, :] < ql_[:, None])[:, None, :, None]
+        return jnp.where(qvalid, o, 0.0).astype(q.dtype)
+
+    return apply_op(f, *args, name="variable_length_mem_efficient_attn")
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size,
+                     name=None):
+    """ref: block_multihead_attention blha_get_max_len helper."""
+    from ....autograd.tape import apply_op
+    from ....ops._helpers import to_tensor_like
+
+    return apply_op(
+        lambda a, b: (jnp.max(a), jnp.max(b)),
+        to_tensor_like(seq_lens_encoder), to_tensor_like(seq_lens_decoder),
+        n_outputs=2, name="blha_get_max_len")

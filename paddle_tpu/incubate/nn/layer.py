@@ -154,47 +154,19 @@ class FusedMultiHeadAttention(Layer):
 
     def forward(self, query, key=None, value=None, attn_mask=None,
                 cache=None):
-        training = self.training
-        nh, d = self.num_heads, self.head_dim
-        args = [to_tensor_like(query), self.qkv_weight, self.qkv_bias,
-                self.linear_weight, self.linear_bias, self.pre_ln_scale,
-                self.pre_ln_bias, self.ln_scale, self.ln_bias]
-        if attn_mask is not None:
-            args.append(to_tensor_like(attn_mask))
-
-        def f(x, qkvw, qkvb, lw, lb, pg, pb, g, b, *mask):
-            B, S, H = x.shape
-            residual = x
-            a = _ln(x, pg, pb, self.epsilon) if self.normalize_before \
-                else x
-            w2 = qkvw.reshape(3 * nh * d, H).T
-            qkv = (a @ w2 + qkvb.reshape(-1)).reshape(B, S, 3, nh, d)
-            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            from ...kernels import flash_attention as fa
-            # the flash kernel has no dropout hook — only eligible when
-            # attention dropout is inactive, else regularization would
-            # silently differ by shape/platform
-            no_attn_drop = (not training) or self.attn_dropout_rate <= 0.0
-            if (not mask) and no_attn_drop \
-                    and fa.supported(q.shape, k.shape, True):
-                o = fa.flash_attention_bshd(q, k, v, causal=False)
-            else:
-                s = jnp.einsum("bqhd,bkhd->bhqk",
-                               q.astype(jnp.float32),
-                               k.astype(jnp.float32)) / math.sqrt(d)
-                if mask:
-                    s = s + mask[0].astype(jnp.float32)
-                p = jax.nn.softmax(s, axis=-1)
-                p = _dropout(p, self.attn_dropout_rate, training)
-                o = jnp.einsum("bhqk,bkhd->bqhd", p,
-                               v.astype(jnp.float32)).astype(x.dtype)
-            out = o.reshape(B, S, H) @ lw + lb
-            out = residual + _dropout(out, self.dropout_rate, training)
-            if not self.normalize_before:
-                out = _ln(out, g, b, self.epsilon)
-            return out
-
-        return apply_op(f, *args, name="fused_multi_head_attention")
+        # single source of truth: the functional variant (same op body,
+        # flash-eligibility policy and all — review r3 dedup)
+        from .functional import fused_multi_head_attention
+        return fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self.epsilon, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, cache_kv=cache,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
 
 
 class FusedFeedForward(Layer):
@@ -288,6 +260,7 @@ class FusedEcMoe(Layer):
                  weight_attr=None, bias_attr=None):
         super().__init__()
         self.num_experts = num_experts
+        self.act_type = act_type
         self.act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[act_type]
         self.gate_weight = self.create_parameter((hidden_size, num_experts),
                                                  attr=weight_attr)
@@ -303,35 +276,15 @@ class FusedEcMoe(Layer):
     def forward(self, x, gate=None):
         """x: [B, S, H]; gate: optional caller-supplied gate logits
         [B, S, E] (ref FusedEcMoe.forward(x, gate)) — when absent the
-        layer's own gate_weight produces them."""
-        E = self.num_experts
-        act = self.act
-        args = [to_tensor_like(x), self.gate_weight, self.ffn1_weight,
-                self.ffn1_bias, self.ffn2_weight, self.ffn2_bias]
-        if gate is not None:
-            args.append(to_tensor_like(gate))
-
-        def f(xv, gw, w1, b1, w2, b2, *ext_gate):
-            B, S, H = xv.shape
-            T = B * S
-            flat = xv.reshape(T, H)
-            logits = (ext_gate[0].reshape(T, E).astype(jnp.float32)
-                      if ext_gate
-                      else flat.astype(jnp.float32) @ gw.astype(
-                          jnp.float32))
-            scores = jax.nn.softmax(logits, -1)
-            cap = max(T // E, 1)
-            # expert choice: each expert takes its top-`cap` tokens
-            probs, idx = jax.lax.top_k(scores.T, cap)     # [E, cap]
-            tok = jnp.take(flat, idx.reshape(-1), axis=0).reshape(
-                E, cap, H)                                  # [E, cap, H]
-            hmid = act(jnp.einsum("ech,ehm->ecm", tok, w1)
-                       + b1[:, None, :])
-            out = jnp.einsum("ecm,emh->ech", hmid, w2) + b2[:, None, :]
-            out = out * probs[..., None].astype(out.dtype)
-            # scatter-combine back to tokens
-            combined = jnp.zeros((T, H), out.dtype).at[
-                idx.reshape(-1)].add(out.reshape(E * cap, H))
-            return combined.reshape(B, S, H)
-
-        return apply_op(f, *args, name="fused_ec_moe")
+        layer's own gate_weight produces them. Delegates to the
+        functional variant (single op body — review r3 dedup)."""
+        from .functional import fused_ec_moe
+        xt = to_tensor_like(x)
+        if gate is None:
+            gate = apply_op(
+                lambda a, w: a.astype(jnp.float32)
+                @ w.astype(jnp.float32),
+                xt, self.gate_weight, name="ec_moe_gate")
+        return fused_ec_moe(xt, gate, self.ffn1_weight, self.ffn1_bias,
+                            self.ffn2_weight, self.ffn2_bias,
+                            self.act_type)

@@ -265,3 +265,142 @@ def test_fused_linear_activation_epilogue():
     assert x.grad is not None and w.grad is not None
     with pytest.raises(ValueError):
         IF.fused_linear_activation(x, w, activation="swishish")
+
+
+class TestFusedFunctionalVariants:
+    """Functional variants of the fused-transformer surface (round 3;
+    ref incubate/nn/functional __all__)."""
+
+    def test_fused_matmul_bias(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((3, 5)).astype(np.float32))
+        w = paddle.to_tensor(rng.standard_normal((5, 4)).astype(np.float32))
+        b = paddle.to_tensor(rng.standard_normal((4,)).astype(np.float32))
+        out = IF.fused_matmul_bias(x, w, b)
+        np.testing.assert_allclose(
+            np.asarray(out.numpy()),
+            np.asarray(x.numpy()) @ np.asarray(w.numpy())
+            + np.asarray(b.numpy()), rtol=1e-5)
+
+    def test_fused_dropout_add_eval_is_plain_add(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        y = paddle.to_tensor(np.full((4, 4), 2.0, np.float32))
+        out = IF.fused_dropout_add(x, y, p=0.5, training=False)
+        np.testing.assert_allclose(np.asarray(out.numpy()), 3.0)
+
+    def test_fused_bias_dropout_residual_ln(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        rng = np.random.default_rng(1)
+        x = paddle.to_tensor(rng.standard_normal((2, 3, 8))
+                             .astype(np.float32))
+        res = paddle.to_tensor(rng.standard_normal((2, 3, 8))
+                               .astype(np.float32))
+        g = paddle.to_tensor(np.ones(8, np.float32))
+        b = paddle.to_tensor(np.zeros(8, np.float32))
+        out = IF.fused_bias_dropout_residual_layer_norm(
+            x, res, ln_scale=g, ln_bias=b, dropout_rate=0.0,
+            training=False)
+        h = np.asarray(x.numpy()) + np.asarray(res.numpy())
+        mu = h.mean(-1, keepdims=True)
+        ref = (h - mu) / np.sqrt(((h - mu) ** 2).mean(-1, keepdims=True)
+                                 + 1e-5)
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fused_mha_matches_layer(self):
+        """The functional must agree with the FusedMultiHeadAttention
+        layer given the same weights (dropout off)."""
+        import paddle_tpu.incubate.nn as inn
+        import paddle_tpu.incubate.nn.functional as IF
+        paddle.seed(0)
+        lyr = inn.FusedMultiHeadAttention(
+            embed_dim=16, num_heads=4, dropout_rate=0.0,
+            attn_dropout_rate=0.0, normalize_before=True)
+        lyr.eval()
+        rng = np.random.default_rng(2)
+        x = paddle.to_tensor(rng.standard_normal((2, 6, 16))
+                             .astype(np.float32))
+        want = np.asarray(lyr(x).numpy())
+        got = IF.fused_multi_head_attention(
+            x, lyr.qkv_weight, lyr.linear_weight, pre_layer_norm=True,
+            pre_ln_scale=lyr.pre_ln_scale, pre_ln_bias=lyr.pre_ln_bias,
+            ln_scale=lyr.ln_scale, ln_bias=lyr.ln_bias,
+            qkv_bias=lyr.qkv_bias, linear_bias=lyr.linear_bias,
+            dropout_rate=0.0, attn_dropout_rate=0.0, training=False)
+        np.testing.assert_allclose(np.asarray(got.numpy()), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fused_feedforward_pre_ln(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        rng = np.random.default_rng(3)
+        x = paddle.to_tensor(rng.standard_normal((2, 4, 8))
+                             .astype(np.float32))
+        w1 = paddle.to_tensor(rng.standard_normal((8, 16))
+                              .astype(np.float32))
+        w2 = paddle.to_tensor(rng.standard_normal((16, 8))
+                              .astype(np.float32))
+        g = paddle.to_tensor(np.ones(8, np.float32))
+        b = paddle.to_tensor(np.zeros(8, np.float32))
+        out = IF.fused_feedforward(
+            x, w1, w2, ln1_scale=g, ln1_bias=b, dropout1_rate=0.0,
+            dropout2_rate=0.0, pre_layer_norm=True, training=False)
+        xv = np.asarray(x.numpy())
+        mu = xv.mean(-1, keepdims=True)
+        ln = (xv - mu) / np.sqrt(((xv - mu) ** 2).mean(-1, keepdims=True)
+                                 + 1e-5)
+        ref = xv + np.maximum(ln @ np.asarray(w1.numpy()), 0.0) \
+            @ np.asarray(w2.numpy())
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_variable_length_attention_masks_lengths(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        rng = np.random.default_rng(4)
+        B, H, S, D = 2, 2, 6, 8
+        q = paddle.to_tensor(rng.standard_normal((B, H, S, D))
+                             .astype(np.float32))
+        k = paddle.to_tensor(rng.standard_normal((B, H, S, D))
+                             .astype(np.float32))
+        v = paddle.to_tensor(rng.standard_normal((B, H, S, D))
+                             .astype(np.float32))
+        lens = paddle.to_tensor(np.array([4, 6], np.int32))
+        out = IF.variable_length_memory_efficient_attention(
+            q, k, v, lens, lens)
+        ov = np.asarray(out.numpy())
+        # rows past the query length are zeroed
+        assert np.allclose(ov[0, :, 4:], 0.0)
+        # batch-0 output must not depend on k/v past length 4
+        kv2 = np.asarray(k.numpy()).copy()
+        kv2[0, :, 4:] = 999.0
+        out2 = IF.variable_length_memory_efficient_attention(
+            q, paddle.to_tensor(kv2), v, lens, lens)
+        np.testing.assert_allclose(ov[0, :, :4],
+                                   np.asarray(out2.numpy())[0, :, :4],
+                                   rtol=1e-5)
+
+    def test_fused_ec_moe_shapes(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        rng = np.random.default_rng(5)
+        B, S, H, F_, E = 2, 8, 8, 16, 4
+        x = paddle.to_tensor(rng.standard_normal((B, S, H))
+                             .astype(np.float32))
+        gate = paddle.to_tensor(rng.standard_normal((B, S, E))
+                                .astype(np.float32))
+        w1 = paddle.to_tensor(rng.standard_normal((E, H, F_))
+                              .astype(np.float32) * 0.1)
+        b1 = paddle.to_tensor(np.zeros((E, 1, F_), np.float32))
+        w2 = paddle.to_tensor(rng.standard_normal((E, F_, H))
+                              .astype(np.float32) * 0.1)
+        b2 = paddle.to_tensor(np.zeros((E, 1, H), np.float32))
+        out = IF.fused_ec_moe(x, gate, w1, b1, w2, b2, "gelu")
+        assert tuple(out.shape) == (B, S, H)
+        assert np.isfinite(np.asarray(out.numpy())).all()
+
+    def test_blha_get_max_len(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        enc = paddle.to_tensor(np.array([3, 9, 5], np.int32))
+        dec = paddle.to_tensor(np.array([1, 2, 7], np.int32))
+        me, md = IF.blha_get_max_len(enc, dec, 3)
+        assert int(me.numpy()) == 9 and int(md.numpy()) == 7
