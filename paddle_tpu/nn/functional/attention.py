@@ -16,7 +16,7 @@ from ...framework import core
 from ...ops._helpers import to_tensor_like, unwrap
 
 __all__ = ["scaled_dot_product_attention", "flash_attention",
-           "flash_attn_unpadded", "sdp_kernel"]
+           "flash_attn_unpadded", "sdp_kernel", "sparse_attention"]
 
 
 def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale):
@@ -238,3 +238,57 @@ class sdp_kernel:
 
     def __exit__(self, *a):
         return False
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """ref: nn/functional/sparse_attention.py:19 — attention restricted
+    to a CSR-expressed sparsity pattern. q/k/v: [B, H, S, D];
+    offset [B, H, S+1], columns [B, H, nnz] describe the per-row
+    attended columns. The masked-softmax body is shared with
+    paddle_tpu.sparse.attention (_masked_attention_core); this wrapper
+    adds the CSR->bool-pattern decode and the differentiable tape op."""
+    import numpy as _np
+
+    q = to_tensor_like(query)
+    k = to_tensor_like(key)
+    v = to_tensor_like(value)
+    B, H, S, D = q.shape
+    # the sparsity pattern is static STRUCTURE (host metadata, like the
+    # reference's CSR descriptors): materialize the [B, H, S, S] bool
+    # mask once on the host
+    off = _np.asarray(unwrap(to_tensor_like(sparse_csr_offset))
+                      ).reshape(B, H, S + 1)
+    cols = _np.asarray(unwrap(to_tensor_like(sparse_csr_columns))
+                       ).reshape(B, H, -1)
+    pat = _np.zeros((B, H, S, S), bool)
+    counts = _np.diff(off, axis=-1)                  # [B, H, S]
+    rows = _np.repeat(_np.tile(_np.arange(S), B * H).reshape(B, H, S),
+                      counts.reshape(-1),
+                      axis=None)                     # flat row per nnz
+    bh = _np.repeat(_np.arange(B * H), counts.reshape(B * H, -1).sum(-1))
+    pat.reshape(B * H, S, S)[bh, rows, cols.reshape(-1)] = True
+
+    extra = []
+    kp_present = key_padding_mask is not None
+    am_present = attn_mask is not None
+    if kp_present:
+        extra.append(to_tensor_like(key_padding_mask))
+    if am_present:
+        extra.append(to_tensor_like(attn_mask))
+
+    def f(qd, kd, vd, *rest):
+        it = iter(rest)
+        mask = jnp.asarray(pat)
+        if kp_present:
+            kpm = next(it)
+            mask = mask & (kpm[:, None, None, :] != 0)
+        if am_present:
+            am = next(it)
+            mask = mask & (am[None, None] != 0 if am.ndim == 2
+                           else am != 0)
+        from ...sparse import _masked_attention_core
+        return _masked_attention_core(qd, kd, vd, mask)
+
+    return apply_op(f, q, k, v, *extra, name="sparse_attention")

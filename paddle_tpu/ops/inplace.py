@@ -31,7 +31,7 @@ _INPLACE_BASES = [
     "scatter", "sigmoid", "sin", "sinh", "sqrt", "square", "subtract",
     "t", "tan", "tanh", "transpose", "tril", "triu", "trunc", "uniform",
     "add", "flatten", "reshape", "squeeze", "unsqueeze",
-    "index_fill",
+    "index_fill", "index_add", "index_put",
 ]
 
 

@@ -278,6 +278,21 @@ def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
         (vals, idx), shape=dense.shape))
 
 
+def _masked_attention_core(qd, kd, vd, mask):
+    """softmax(QK^T/sqrt(d)) restricted to bool `mask` [B,H,S,S], then
+    @ V — shared by sparse.attention and nn.functional.sparse_attention
+    (one body, no drift)."""
+    import math as _m
+    D = qd.shape[-1]
+    s = jnp.einsum("bhsd,bhtd->bhst", qd.astype(jnp.float32),
+                   kd.astype(jnp.float32)) / _m.sqrt(D)
+    s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)
+    out = jnp.einsum("bhst,bhtd->bhsd", p, vd.astype(jnp.float32))
+    return out.astype(qd.dtype)
+
+
 def attention(query, key, value, sparse_mask, key_padding_mask=None,
               attn_mask=None, name=None):
     """ref: sparse/nn/functional/transformer.py attention — softmax(QK^T)
@@ -299,8 +314,6 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
     else:
         pat = _as_coo(sparse_mask)
     mask = pat.to_dense().data.reshape(B, H, S, S) != 0
-    s = jnp.einsum("bhsd,bhtd->bhst", qd.astype(jnp.float32),
-                   kd.astype(jnp.float32)) / _m.sqrt(D)
     if key_padding_mask is not None:
         kpm = (key_padding_mask.data
                if isinstance(key_padding_mask, Tensor)
@@ -310,10 +323,6 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
         am = (attn_mask.data if isinstance(attn_mask, Tensor)
               else jnp.asarray(unwrap(attn_mask)))
         mask = mask & (am[None, None] != 0 if am.ndim == 2 else am != 0)
-    s = jnp.where(mask, s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    p = jnp.where(jnp.isnan(p), 0.0, p)
-    out = jnp.einsum("bhst,bhtd->bhsd", p, vd.astype(jnp.float32))
-    return Tensor(out.astype(qd.dtype))
+    return Tensor(_masked_attention_core(qd, kd, vd, mask))
 
 __all__ += ["conv3d", "subm_conv3d", "attention"]

@@ -395,3 +395,21 @@ class TestVarlenPacked:
             p /= p.sum(-1, keepdims=True)
             ref = np.einsum("hqk,khd->qhd", p, seg)
             np.testing.assert_allclose(ov[s:e], ref, rtol=1e-5, atol=1e-5)
+
+
+def test_functional_sparse_attention_csr_pattern():
+    """F.sparse_attention (ref nn/functional/sparse_attention.py):
+    CSR offset/columns restrict the attended pairs; a diagonal pattern
+    reduces attention to identity over V."""
+    import paddle_tpu.nn.functional as F
+    rng = np.random.default_rng(0)
+    B, H, S, D = 1, 2, 4, 8
+    q = paddle.to_tensor(rng.standard_normal((B, H, S, D))
+                         .astype(np.float32))
+    off = paddle.to_tensor(
+        np.tile(np.arange(0, S + 1, dtype=np.int64), (B, H, 1)))
+    cols = paddle.to_tensor(
+        np.tile(np.arange(S, dtype=np.int64), (B, H, 1)))
+    out = F.sparse_attention(q, q, q, off, cols)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(q.numpy()), atol=1e-6)
