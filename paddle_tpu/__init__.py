@@ -102,6 +102,8 @@ from . import incubate    # noqa: F401,E402
 from . import inference   # noqa: F401,E402
 from . import text        # noqa: F401,E402
 from . import static      # noqa: F401,E402
+from . import utils       # noqa: F401,E402
+from .hapi import callbacks  # noqa: F401,E402
 from . import onnx        # noqa: F401,E402
 from .hapi import Model   # noqa: F401,E402
 from .framework.io import load, save  # noqa: F401,E402

@@ -244,3 +244,16 @@ class Conll05st(_LocalDataset):
             for m in tf.getmembers():
                 if m.name.endswith(".txt"):
                     self._samples.append(m.name)
+
+
+# paddle.text.datasets submodule view (ref python/paddle/text/datasets/)
+import sys as _sys
+import types as _types
+
+datasets = _types.ModuleType(__name__ + ".datasets")
+for _n in ("Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"):
+    if _n in globals():
+        setattr(datasets, _n, globals()[_n])
+_sys.modules[datasets.__name__] = datasets
+del _sys, _types, _n

@@ -1,7 +1,44 @@
 """paddle.utils (ref: python/paddle/utils/)."""
 from . import cpp_extension  # noqa: F401
+from . import dlpack  # noqa: F401
 
-__all__ = ["cpp_extension"]
+__all__ = ["cpp_extension", "dlpack", "run_check", "try_import",
+           "deprecated", "require_version"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """ref: utils/deprecated.py — decorator emitting DeprecationWarning."""
+    def decorate(fn):
+        import functools
+        import warnings
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            msg = (f"API {fn.__name__} is deprecated since {since or '?'}"
+                   + (f"; use {update_to} instead" if update_to else "")
+                   + (f". Reason: {reason}" if reason else ""))
+            if level > 0:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*a, **kw)
+        return wrapper
+    return decorate
+
+
+def require_version(min_version, max_version=None):
+    """ref: utils/__init__.py require_version — gate on paddle version."""
+    from .. import __version__ as cur
+
+    def norm(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    if norm(cur) < norm(min_version):
+        raise Exception(
+            f"version {cur} < required minimum {min_version}")
+    if max_version is not None and norm(cur) > norm(max_version):
+        raise Exception(
+            f"version {cur} > allowed maximum {max_version}")
+    return True
 
 
 def try_import(name):

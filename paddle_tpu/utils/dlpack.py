@@ -1,0 +1,47 @@
+"""paddle.utils.dlpack (ref: python/paddle/utils/dlpack.py — to_dlpack/
+from_dlpack over the DLPack capsule protocol). TPU-native: jax arrays
+speak DLPack natively; host/CPU interop goes through jax.dlpack."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+class _CapsuleShim:
+    """Adapter for RAW DLPack capsules (e.g. torch.utils.dlpack.to_dlpack
+    output): modern consumers require the __dlpack__ protocol, which a
+    bare capsule lacks. A capsule carries no queryable device, so this
+    assumes host/CPU — the only portable cross-framework handoff."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, stream=None):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # (kDLCPU, 0)
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack capsule (zero-copy where the backend allows).
+    Any __dlpack__-protocol consumer (torch.from_dlpack, np.from_dlpack)
+    can also ingest the Tensor's array directly."""
+    data = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    return data.__dlpack__()
+
+
+def from_dlpack(x):
+    """Tensor / external __dlpack__ array (torch, numpy, cupy) / raw
+    DLPack capsule -> Tensor."""
+    from jax.dlpack import from_dlpack as _fd
+    if isinstance(x, Tensor):
+        return Tensor(x.data, stop_gradient=True)
+    if hasattr(x, "__dlpack__"):
+        return Tensor(_fd(x), stop_gradient=True)
+    # raw capsule (assumed host-resident; see _CapsuleShim)
+    return Tensor(_fd(_CapsuleShim(x)), stop_gradient=True)

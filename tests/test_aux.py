@@ -372,3 +372,47 @@ class TestSummaryTable:
         out = capsys.readouterr().out
         assert "Total params" in out
         assert info["total_params"] == 10
+
+
+class TestUtilsTail:
+    """paddle.utils dlpack/deprecated/require_version + namespace
+    attachments (round 3)."""
+
+    def test_dlpack_torch_interop(self):
+        import torch
+
+        import paddle_tpu as paddle
+        t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        tor = torch.utils.dlpack.from_dlpack(
+            paddle.utils.dlpack.to_dlpack(t))
+        np.testing.assert_array_equal(tor.numpy(), t.numpy())
+        back = paddle.utils.dlpack.from_dlpack(torch.arange(4.0))
+        np.testing.assert_array_equal(np.asarray(back.numpy()),
+                                      [0, 1, 2, 3])
+        # raw torch capsule
+        cap = torch.utils.dlpack.to_dlpack(torch.ones(3))
+        np.testing.assert_array_equal(
+            np.asarray(paddle.utils.dlpack.from_dlpack(cap).numpy()),
+            np.ones(3))
+
+    def test_deprecated_and_require_version(self):
+        import warnings
+
+        import paddle_tpu as paddle
+        paddle.utils.require_version("0.0.0")
+        with pytest.raises(Exception):
+            paddle.utils.require_version("999.0.0")
+
+        @paddle.utils.deprecated(update_to="paddle.new", since="2.6")
+        def oldfn():
+            return 7
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert oldfn() == 7
+        assert any(issubclass(x.category, DeprecationWarning) for x in w)
+
+    def test_namespace_attachments(self):
+        import paddle_tpu as paddle
+        assert hasattr(paddle, "utils") and hasattr(paddle, "callbacks")
+        from paddle_tpu.text.datasets import Imdb  # noqa: F401
