@@ -1,5 +1,6 @@
 """Pallas block-attention kernel with softmax stats — the per-round
-compute of ring attention (kernels/ring_attention.py).
+compute of ring attention (kernels/ring_attention.py) and the per-chunk
+compute of the chunked-bias flash path (kernels/flash_attention.py).
 
 The ring schedule needs UNNORMALIZED per-block results (m, l, o) so
 rounds can merge online; the in-tree flash kernel only returns the
@@ -13,6 +14,11 @@ differentiable through lax.scan.
 
 Layout: q [B, Sq, H, D], k/v [B, Sk, H, D] -> m, l [B, H, Sq] f32 and
 o [B, Sq, H, D] f32 (unnormalized); `mask` is an optional [Sq, Sk] bool.
+`bias` is an optional ADDITIVE [B, H, Sq, Sk] f32 operand (the chunked
+slice of an attention bias — alibi, relative-position, padding): entries
+<= _NEG/2 are treated as masked (their p is zeroed exactly, so a fully
+masked row yields l=0, o=0 like the boolean mask path). bias is
+differentiable — the VJP returns ds for it.
 Fully-masked rows yield (m=-1e30, l=0, o=0), which the ring merge treats
 as an empty contribution.
 """
@@ -61,9 +67,10 @@ def supported(q_shape, k_shape) -> bool:
             and q_shape[2] == k_shape[2])
 
 
-def _pallas_fwd(q, k, v, mask, scale):
-    """q [N, Sq, D]; k/v [N, Sk, D]; mask [Sq, Sk] bool or None, with
-    N = B*H folded into the grid's leading parallel dim."""
+def _pallas_fwd(q, k, v, mask, scale, bias=None, interpret=None):
+    """q [N, Sq, D]; k/v [N, Sk, D]; mask [Sq, Sk] bool or None;
+    bias [N, Sq, Sk] f32 or None, with N = B*H folded into the grid's
+    leading parallel dim."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -75,8 +82,11 @@ def _pallas_fwd(q, k, v, mask, scale):
     use_mask = mask is not None
     if not use_mask:
         mask = jnp.ones((bq, bk), jnp.bool_)
+    use_bias = bias is not None
+    if not use_bias:
+        bias = jnp.zeros((1, bq, bk), jnp.float32)
 
-    def kern(q_ref, k_ref, v_ref, mask_ref, m_out, l_out, o_out,
+    def kern(q_ref, k_ref, v_ref, mask_ref, bias_ref, m_out, l_out, o_out,
              m_s, l_s, o_s):
         j = pl.program_id(2)
         nk = pl.num_programs(2)
@@ -91,7 +101,12 @@ def _pallas_fwd(q, k, v, mask, scale):
         kb = k_ref[0].astype(jnp.float32)          # [bk, D]
         vb = v_ref[0].astype(jnp.float32)
         mb = mask_ref[...]
-        s = jnp.where(mb, (qb @ kb.T) * scale, _NEG)
+        s = (qb @ kb.T) * scale
+        if use_bias:
+            s = s + bias_ref[0]
+            # bias-masked entries (<= _NEG/2) count as invalid
+            mb = mb & (bias_ref[0] > 0.5 * _NEG)
+        s = jnp.where(mb, s, _NEG)
 
         m_prev = m_s[...]                          # [bq, 1]
         bm = jnp.max(s, axis=1, keepdims=True)
@@ -110,11 +125,15 @@ def _pallas_fwd(q, k, v, mask, scale):
             l_out[0] = l_s[...]
             o_out[0] = o_s[...]
 
-    interpret = not _on_tpu()
+    if interpret is None:
+        interpret = not _on_tpu()
     params = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
     mask_spec = (pl.BlockSpec((bq, bk), lambda n, i, j: (i, j)) if use_mask
                  else pl.BlockSpec((bq, bk), lambda n, i, j: (0, 0)))
+    bias_spec = (pl.BlockSpec((1, bq, bk), lambda n, i, j: (n, i, j))
+                 if use_bias
+                 else pl.BlockSpec((1, bq, bk), lambda n, i, j: (0, 0, 0)))
     m, l, o = pl.pallas_call(
         kern, grid=grid,
         in_specs=[
@@ -122,6 +141,7 @@ def _pallas_fwd(q, k, v, mask, scale):
             pl.BlockSpec((1, bk, D), lambda n, i, j: (n, j, 0)),
             pl.BlockSpec((1, bk, D), lambda n, i, j: (n, j, 0)),
             mask_spec,
+            bias_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, bq, 1), lambda n, i, j: (n, i, 0)),
@@ -138,77 +158,107 @@ def _pallas_fwd(q, k, v, mask, scale):
                         pltpu.VMEM((bq, D), jnp.float32)],
         compiler_params=None if interpret else params,
         interpret=interpret,
-    )(q, k, v, mask)
+    )(q, k, v, mask, bias)
     return m[..., 0], l[..., 0], o
 
 
-def _dense_stats(q, k, v, mask, scale):
+def _apply_bias_mask(s, mask, bias):
+    """Shared score assembly: additive bias, then boolean/threshold mask.
+    Returns (s, valid) with valid broadcast to s's shape."""
+    valid = jnp.ones(s.shape, bool) if mask is None else \
+        jnp.broadcast_to(mask[None, None], s.shape)
+    if bias is not None:
+        s = s + bias
+        valid = valid & (bias > 0.5 * _NEG)
+    return jnp.where(valid, s, _NEG), valid
+
+
+def _dense_stats(q, k, v, mask, scale, bias=None):
     """jnp reference path: same contract, used for unaligned shapes."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
-    if mask is not None:
-        s = jnp.where(mask[None, None], s, _NEG)
+    s, valid = _apply_bias_mask(s, mask, bias)
     m = jnp.max(s, axis=-1)
-    p = jnp.exp(s - m[..., None])
-    if mask is not None:
-        p = jnp.where(mask[None, None], p, 0.0)
+    p = jnp.where(valid, jnp.exp(s - m[..., None]), 0.0)
     l = jnp.sum(p, axis=-1)
     o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     return m, l, o
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def block_attention_stats(q, k, v, mask, scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 6))
+def block_attention_stats(q, k, v, mask, scale, bias=None, use_pallas=None):
     """(m [B,H,Sq], l [B,H,Sq], o [B,Sq,H,D] f32, unnormalized) for one
-    ring round. Differentiable in q/k/v; mask is non-differentiable."""
-    return _stats_fwd_impl(q, k, v, mask, scale)
+    ring round / bias chunk. Differentiable in q/k/v/bias; mask is
+    non-differentiable. use_pallas: None = auto (real TPU + aligned),
+    True/False forces the route (the chunked-bias caller decides once
+    per call site so cross-platform lowering tests can pin it)."""
+    return _stats_fwd_impl(q, k, v, mask, scale, bias, use_pallas)
 
 
-def _stats_fwd_impl(q, k, v, mask, scale):
+def _stats_fwd_impl(q, k, v, mask, scale, bias=None, use_pallas=None):
     B, Sq, H, D = q.shape
-    if supported(q.shape, k.shape) and (_on_tpu() or _FORCE_PALLAS):
+    explicit = use_pallas is True
+    if use_pallas is None:
+        use_pallas = supported(q.shape, k.shape) and (_on_tpu()
+                                                      or _FORCE_PALLAS)
+    if use_pallas and supported(q.shape, k.shape):
+        # an EXPLICIT True (lowering tests / the TPU bias route) compiles
+        # the real Mosaic kernel even when tracing off-chip; the
+        # _FORCE_PALLAS auto route keeps the interpreter for CPU CI
+        interpret = None if not explicit else False
         fold = lambda x: jnp.swapaxes(x, 1, 2).reshape(
             B * H, x.shape[1], D)
-        m, l, o = _pallas_fwd(fold(q), fold(k), fold(v), mask, scale)
+        bias_f = None
+        if bias is not None:
+            bias_f = jnp.broadcast_to(
+                bias.astype(jnp.float32),
+                (B, H, Sq, k.shape[1])).reshape(B * H, Sq, k.shape[1])
+        m, l, o = _pallas_fwd(fold(q), fold(k), fold(v), mask, scale,
+                              bias_f, interpret=interpret)
         m = m.reshape(B, H, Sq)
         l = l.reshape(B, H, Sq)
         o = jnp.swapaxes(o.reshape(B, H, Sq, D), 1, 2)
         return m, l, o
-    return _dense_stats(q, k, v, mask, scale)
+    return _dense_stats(q, k, v, mask, scale, bias)
 
 
-def _stats_fwd(q, k, v, mask, scale):
-    out = _stats_fwd_impl(q, k, v, mask, scale)
+def _stats_fwd(q, k, v, mask, scale, bias, use_pallas):
+    out = _stats_fwd_impl(q, k, v, mask, scale, bias, use_pallas)
     m = out[0]
-    return out, (q, k, v, mask, m)
+    return out, (q, k, v, mask, bias, m)
 
 
-def _stats_bwd(scale, res, cts):
+def _stats_bwd(scale, use_pallas, res, cts):
     """Analytic VJP with m treated as stop-gradient (the merged, final
     attention output is invariant to the stabilizer):
       dp[q,k] = do[q]·v[k] + dl[q];  ds = p * dp
-      dq = ds k * scale; dk = ds^T q * scale; dv = p^T do.
-    p is recomputed from the saved m — one [Sq, Sk] block per ring round,
-    never the full sequence."""
-    q, k, v, mask, m = res
+      dq = ds k * scale; dk = ds^T q * scale; dv = p^T do; dbias = ds.
+    p is recomputed from the saved m — one [Sq, Sk] block per ring round
+    / bias chunk, never the full sequence."""
+    q, k, v, mask, bias, m = res
     ct_m, ct_l, ct_o = cts
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
     s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
-    if mask is not None:
-        s = jnp.where(mask[None, None], s, _NEG)
-    p = jnp.exp(s - m[..., None])
-    if mask is not None:
-        p = jnp.where(mask[None, None], p, 0.0)
+    s, valid = _apply_bias_mask(s, mask, bias)
+    p = jnp.where(valid, jnp.exp(s - m[..., None]), 0.0)
     do = ct_o.astype(jnp.float32)                       # [B,Sq,H,D]
     dp = jnp.einsum("bqhd,bkhd->bhqk", do, vf) + ct_l[..., None]
     ds = p * dp
     dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf) * scale
     dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
     dv = jnp.einsum("bhqk,bqhd->bkhd", p, do)
+    dbias = None
+    if bias is not None:
+        # reduce ds over the broadcast dims of the given bias shape
+        dbias = ds
+        for ax in range(4):
+            if bias.shape[ax] == 1 and ds.shape[ax] != 1:
+                dbias = dbias.sum(axis=ax, keepdims=True)
+        dbias = dbias.astype(bias.dtype)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-            None)
+            None, dbias)
 
 
 block_attention_stats.defvjp(_stats_fwd, _stats_bwd)

@@ -165,8 +165,10 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     TPU route: the Pallas flash kernel with batch 1 + per-token SEGMENT
     IDS built from cu_seqlens — cross-sequence attention is segment-
     masked, and global causal + packing order equals per-sequence causal
-    when q/kv share the packing (self-attention). Dense fallback
-    otherwise (CPU, GQA packing, mismatched q/kv packings under causal).
+    when q/kv share the packing (self-attention). Packed GQA rides the
+    splash kernel's MQA mode with the same segment ids (no kv repeat).
+    Dense fallback otherwise (CPU, mismatched q/kv packings under
+    causal).
     """
     q = to_tensor_like(query)   # [total_q, H, D]
     k = to_tensor_like(key)
@@ -205,6 +207,10 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     def f(qq, kk, vv):
         total_q = qq.shape[0]
         total_k = kk.shape[0]
+        if qq.shape[1] != kk.shape[1]:       # GQA dense fallback
+            rep = qq.shape[1] // kk.shape[1]
+            kk = jnp.repeat(kk, rep, axis=1)
+            vv = jnp.repeat(vv, rep, axis=1)
         seg_q = jnp.cumsum(
             jnp.zeros(total_q, jnp.int32).at[cq[1:-1]].add(1))
         seg_k = jnp.cumsum(

@@ -258,6 +258,158 @@ class TestGQAAndBiasRouting:
         assert "tpu_custom_call" in txt, "bias mask fell to the dense path"
 
 
+class TestChunkedBias:
+    """VERDICT r3 #3a/#3c: additive-bias attention must stream the bias
+    CHUNKWISE — never an O(B*H*Sq*Sk) f32 buffer — and GQA+bias must not
+    materialize a full-sequence kv repeat."""
+
+    def _dense_ref(self, q, k, v, bias, causal, scale):
+        Hq, Hk = q.shape[2], k.shape[2]
+        if Hq != Hk:
+            k = jnp.repeat(k, Hq // Hk, axis=2)
+            v = jnp.repeat(v, Hq // Hk, axis=2)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        s = s + jnp.broadcast_to(bias, s.shape)
+        if causal:
+            Sq, Sk = q.shape[1], k.shape[1]
+            cm = jnp.arange(Sq)[:, None] >= jnp.arange(Sk)[None, :]
+            s = jnp.where(cm[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+
+    def test_alibi_matches_dense_reference_gqa(self):
+        """Parametric alibi bias, GQA, causal, chunked — fwd + grads
+        against the dense reference."""
+        B, Sq, Hq, Hk, D = 1, 64, 4, 2, 16
+        ks = jax.random.split(jax.random.PRNGKey(5), 3)
+        q = jax.random.normal(ks[0], (B, Sq, Hq, D))
+        k = jax.random.normal(ks[1], (B, Sq, Hk, D))
+        v = jax.random.normal(ks[2], (B, Sq, Hk, D))
+        slopes = jnp.array([0.25, 0.5, 1.0, 2.0], jnp.float32)
+        scale = 1.0 / np.sqrt(D)
+
+        def f(q, k, v):
+            o = fa.flash_attention_biased(q, k, v, "alibi", slopes,
+                                          causal=True, scale=scale,
+                                          chunk=16, use_pallas=False)
+            return (o.astype(jnp.float32) ** 2).sum()
+
+        def fref(q, k, v):
+            dist = (jnp.arange(Sq)[:, None]
+                    - jnp.arange(Sq)[None, :]).astype(jnp.float32)
+            bias = -slopes[None, :, None, None] * dist[None, None]
+            o = self._dense_ref(q, k, v, bias, True, scale)
+            return (o ** 2).sum()
+
+        v1, g1 = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+        v2, g2 = jax.value_and_grad(fref, argnums=(0, 1, 2))(q, k, v)
+        assert abs(float(v1) - float(v2)) < 1e-3 * max(1.0, abs(float(v2)))
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-3)
+
+    def test_rel_table_bias_table_grads(self):
+        """Learned relative-position table: grads must flow to the table
+        through the chunked gather (T5-style bias is trainable)."""
+        B, S, H, D, R = 1, 32, 2, 16, 4
+        ks = jax.random.split(jax.random.PRNGKey(7), 4)
+        q = jax.random.normal(ks[0], (B, S, H, D))
+        k = jax.random.normal(ks[1], (B, S, H, D))
+        v = jax.random.normal(ks[2], (B, S, H, D))
+        table = jax.random.normal(ks[3], (H, 2 * R + 1)) * 0.1
+        scale = 1.0 / np.sqrt(D)
+
+        def f(table):
+            o = fa.flash_attention_biased(q, k, v, "rel_table", (table, R),
+                                          causal=False, scale=scale,
+                                          chunk=8, use_pallas=False)
+            return (o.astype(jnp.float32) ** 2).sum()
+
+        def fref(table):
+            idx = jnp.clip(jnp.arange(S)[None, :] - jnp.arange(S)[:, None],
+                           -R, R) + R
+            bias = jnp.take(table, idx, axis=1)[None]       # [1, H, S, S]
+            o = self._dense_ref(q, k, v, bias, False, scale)
+            return (o ** 2).sum()
+
+        v1, g1 = jax.value_and_grad(f)(table)
+        v2, g2 = jax.value_and_grad(fref)(table)
+        assert abs(float(v1) - float(v2)) < 1e-3 * max(1.0, abs(float(v2)))
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   atol=1e-4, rtol=1e-3)
+
+    def test_dense_bias_and_padding_chunked(self):
+        """A narrow [B, 1, 1, Sk] additive bias + per-batch padding mask
+        through the chunked route vs dense reference."""
+        B, S, H, D = 2, 48, 2, 16
+        ks = jax.random.split(jax.random.PRNGKey(9), 4)
+        q = jax.random.normal(ks[0], (B, S, H, D))
+        k = jax.random.normal(ks[1], (B, S, H, D))
+        v = jax.random.normal(ks[2], (B, S, H, D))
+        bias = jax.random.normal(ks[3], (B, 1, 1, S)) * 0.5
+        pad = jnp.arange(S)[None, :] < jnp.array([[40], [48]])[:, 0, None]
+        scale = 1.0 / np.sqrt(D)
+        out = fa.flash_attention_biased(q, k, v, "dense", bias,
+                                        causal=True, scale=scale,
+                                        chunk=16, padding_mask=pad,
+                                        use_pallas=False)
+        full = bias + jnp.where(pad[:, None, None, :], 0.0, -1e30)
+        want = self._dense_ref(q, k, v, full, True, scale)
+        # padded q rows are don't-care; compare valid rows only
+        wq = pad[:, :, None, None]
+        np.testing.assert_allclose(
+            np.asarray(out * wq), np.asarray(want.astype(out.dtype) * wq),
+            atol=1e-4, rtol=1e-3)
+
+    def test_no_full_score_buffer_in_hlo(self):
+        """The 'done' bar: compile a long-seq bias config and assert the
+        optimized HLO holds NO [B, H, Sq, Sk] f32 buffer (the dense
+        reference provably contains one, validating the detector)."""
+        B, S, H, D, C = 1, 512, 4, 64, 128
+        q = jnp.zeros((B, S, H, D), jnp.bfloat16)
+        slopes = jnp.ones((H,), jnp.float32)
+        scale = 0.125
+
+        def chunked(q, k, v):
+            return fa.flash_attention_biased(q, k, v, "alibi", slopes,
+                                             causal=True, scale=scale,
+                                             chunk=C, use_pallas=False)
+
+        def dense(q, k, v):
+            dist = (jnp.arange(S)[:, None]
+                    - jnp.arange(S)[None, :]).astype(jnp.float32)
+            bias = -slopes[None, :, None, None] * dist[None, None]
+            return self._dense_ref(q, k, v, bias, True, scale)
+
+        score_shape = f"f32[{B},{H},{S},{S}]"
+        txt_d = jax.jit(dense).lower(q, q, q).compile().as_text()
+        assert score_shape in txt_d, "detector sanity: dense must have it"
+        txt_c = jax.jit(chunked).lower(q, q, q).compile().as_text()
+        assert score_shape not in txt_c, \
+            "chunked-bias path materialized the full score-shaped buffer"
+        # ... including under grad (the remat'd backward)
+        g = jax.jit(jax.grad(lambda a, b, c:
+                             chunked(a, b, c).astype(jnp.float32).sum(),
+                             argnums=(0, 1, 2)))
+        txt_g = g.lower(q, q, q).compile().as_text()
+        assert score_shape not in txt_g, \
+            "chunked-bias backward materialized the full score buffer"
+
+    def test_bshd_bias_routes_chunked(self):
+        """flash_attention_bshd(bias=...) on CPU must produce the same
+        numbers as the old dense semantics (routing swap is invisible)."""
+        B, S, H, D = 1, 32, 2, 16
+        ks = jax.random.split(jax.random.PRNGKey(11), 4)
+        q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+        bias = jax.random.normal(ks[3], (1, 1, S, S)) * 0.3
+        out = fa.flash_attention_bshd(q, q, q, causal=False, bias=bias)
+        want = self._dense_ref(q, q, q, bias, False, 1.0 / np.sqrt(D))
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(want.astype(out.dtype)),
+                                   atol=1e-4, rtol=1e-3)
+
+
 class TestAutotuneCache:
     def test_lookup_record_roundtrip(self, tmp_path, monkeypatch):
         from paddle_tpu.kernels import autotune
@@ -319,8 +471,57 @@ class TestVarlenPacked:
 
     def test_packed_supported_gating(self, fake_tpu):
         assert fa.packed_supported(300, 300, 8, 8, 64)   # pads to 384
-        assert not fa.packed_supported(300, 300, 8, 4, 64)  # packed GQA
+        assert fa.packed_supported(300, 300, 8, 4, 64)   # packed GQA (r4)
+        assert fa.packed_supported(300, 300, 8, 1, 64)   # packed MQA
+        assert not fa.packed_supported(300, 300, 6, 4, 64)  # non-divisible
         assert not fa.packed_supported(300, 300, 8, 8, 48)  # head dim
+
+    def test_packed_gqa_lowers_to_pallas(self, fake_tpu):
+        """VERDICT r3 #3b: a GQA model served with packed varlen must hit
+        Mosaic, not silently take the dense path."""
+        import paddle_tpu.nn.functional as F
+
+        def fwd(q, k, v):
+            cu = jnp.array([0, 128, 256], jnp.int32)
+            out, _ = F.flash_attn_unpadded(
+                paddle.to_tensor(q), paddle.to_tensor(k),
+                paddle.to_tensor(v), cu_seqlens_q=cu, cu_seqlens_k=cu,
+                max_seqlen_q=128, max_seqlen_k=128, scale=0.125,
+                causal=True)
+            return out.data
+
+        q = jnp.zeros((256, 8, 64), jnp.bfloat16)
+        kv = jnp.zeros((256, 2, 64), jnp.bfloat16)
+        txt = _export_tpu(fwd, q, kv, kv)
+        assert "tpu_custom_call" in txt, "packed GQA fell to the dense path"
+
+    def test_packed_gqa_dense_fallback_semantics(self):
+        """CPU numerics of the packed GQA dense fallback: each sequence
+        attends itself causally with grouped kv heads."""
+        import paddle_tpu.nn.functional as F
+        rng = np.random.default_rng(3)
+        total, Hq, Hk, D = 10, 4, 2, 8
+        q = paddle.to_tensor(rng.standard_normal(
+            (total, Hq, D)).astype(np.float32))
+        k = paddle.to_tensor(rng.standard_normal(
+            (total, Hk, D)).astype(np.float32))
+        v = paddle.to_tensor(rng.standard_normal(
+            (total, Hk, D)).astype(np.float32))
+        cu = jnp.array([0, 4, 10], jnp.int32)
+        out, _ = F.flash_attn_unpadded(q, k, v, cu, cu, 6, 6,
+                                       scale=1.0 / np.sqrt(D), causal=True)
+        ov = np.asarray(out.numpy())
+        qq, kk, vv = (np.asarray(t.numpy()) for t in (q, k, v))
+        kk = np.repeat(kk, Hq // Hk, axis=1)
+        vv = np.repeat(vv, Hq // Hk, axis=1)
+        for (s, e) in ((0, 4), (4, 10)):
+            sc = np.einsum("qhd,khd->hqk", qq[s:e], kk[s:e]) / np.sqrt(D)
+            L = e - s
+            sc = np.where(np.tril(np.ones((L, L), bool))[None], sc, -np.inf)
+            p = np.exp(sc - sc.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            want = np.einsum("hqk,khd->qhd", p, vv[s:e])
+            np.testing.assert_allclose(ov[s:e], want, atol=1e-5, rtol=1e-5)
 
     def test_inference_dropout_still_routes_to_kernel(self, fake_tpu):
         """dropout is inert when training=False — the gate must not

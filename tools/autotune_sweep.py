@@ -117,6 +117,37 @@ def main():
     results[f"block_attn S={Ssh}"] = best
     print("block_attn winner:", best, flush=True)
 
+    # chunked-bias flash (alibi/rel-pos route): tune the KV chunk size —
+    # larger chunks amortize merge overhead, smaller bound the per-chunk
+    # bias footprint (kernels/flash_attention.flash_attention_biased)
+    cb_key = autotune.cache_key("chunked_bias", Sk=S, D=D)
+
+    def make_cb(cand):
+        c = cand[0]
+        if S % c:
+            return None
+        kq = jax.random.split(jax.random.PRNGKey(1), 3)
+        q = jax.random.normal(kq[0], (B, S, H, D), jnp.bfloat16)
+        k = jax.random.normal(kq[1], (B, S, H, D), jnp.bfloat16)
+        v = jax.random.normal(kq[2], (B, S, H, D), jnp.bfloat16)
+        slopes = jnp.full((H,), 0.5, jnp.float32)
+
+        def body(carry, _):
+            f = lambda q_: fa.flash_attention_biased(
+                q_, k, v, "alibi", slopes, causal=True, chunk=c,
+                use_pallas=True).astype(jnp.float32).sum()
+            return carry + jax.grad(f)(q).astype(jnp.float32).sum(), None
+
+        return jax.jit(lambda: jax.lax.scan(
+            body, jnp.float32(0), None, length=4)[0])
+
+    best = autotune.autotune(
+        cb_key, [(256,), (512,), (1024,)], make_cb, default=[512],
+        sweep=True if (args.resweep or autotune.lookup(cb_key) is None)
+        else None)
+    results[f"chunked_bias S={S} D={D}"] = best
+    print("chunked_bias winner:", best, flush=True)
+
     print(json.dumps({"device": autotune.device_kind(),
                       "winners": results}))
     print(f"cache: {os.environ.get('PADDLE_AUTOTUNE_CACHE') or '~/.paddle_tpu_autotune.json'}")
