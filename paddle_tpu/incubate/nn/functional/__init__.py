@@ -348,16 +348,26 @@ def fused_multi_transformer(
     the caches via masked one-hot writes like models/llama's decode path.
     x: [B, S, H]; qkv_weights[i]: [3, nh, d, H] when trans_qkvw else
     [H, 3, nh, d]; caches: [2, B, nh, S_max, d] per layer.
+
+    Weight-only int8 (ref: fused_multi_transformer_int8_op.cu): any weight
+    in qkv/linear/ffn1/ffn2_weights may be an `(int8, scale)` pair (the
+    serving PTQ layout, inference.serving.quantize_state_int8); dequant
+    happens in-trace so XLA fuses it into the matmul operand read.
     Returns (out, cache_kvs) (cache_kvs possibly updated list)."""
     import math as _m
 
     from ....tensor import Tensor as _T
 
     def arr(t):
+        if isinstance(t, tuple) and len(t) == 2:
+            # weight-only int8: (q_int8, scale) -> activation dtype
+            qv = t[0].data if isinstance(t[0], _T) else jnp.asarray(t[0])
+            sc = t[1].data if isinstance(t[1], _T) else jnp.asarray(t[1])
+            return (qv.astype(jnp.float32) * sc).astype(xv.dtype)
         return t.data if isinstance(t, _T) else (None if t is None
                                                  else jnp.asarray(t))
 
-    xv = arr(x)
+    xv = x.data if isinstance(x, _T) else jnp.asarray(x)
     B, S, Hdim = xv.shape
     L = len(qkv_weights)
     act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
