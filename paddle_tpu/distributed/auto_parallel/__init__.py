@@ -14,10 +14,14 @@ from .cost_model import (  # noqa: F401
     estimate_config_cost, estimate_flops)
 from .engine import Engine, Strategy  # noqa: F401
 from .planner import PlanChoice, Planner  # noqa: F401
+from .propagation import (  # noqa: F401
+    PropagationReport, Propagator, graph_reshard_bytes, propagate_jaxpr)
 from .spmd_rules import (  # noqa: F401
-    DistAttr, elementwise_rule, embedding_rule, flash_attention_rule,
-    layer_norm_rule, matmul_rule, reduction_rule, reshard_cost_bytes,
-    softmax_rule)
+    DistAttr, concat_rule, cross_entropy_rule, elementwise_rule,
+    embedding_rule, flash_attention_rule, fused_rope_rule, layer_norm_rule,
+    matmul_rule, reduction_rule, register_rule, reshape_rule,
+    reshard_cost_bytes, scatter_rule, slice_rule, softmax_rule, split_rule,
+    transpose_rule)
 
 __all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
            "shard_tensor", "reshard", "dtensor_from_fn", "Engine",
@@ -26,4 +30,8 @@ __all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
            "estimate_flops", "estimate_config_cost", "Planner",
            "PlanChoice", "DistAttr", "matmul_rule", "embedding_rule",
            "layer_norm_rule", "flash_attention_rule", "elementwise_rule",
-           "reduction_rule", "softmax_rule", "reshard_cost_bytes"]
+           "reduction_rule", "softmax_rule", "transpose_rule",
+           "reshape_rule", "concat_rule", "split_rule", "slice_rule",
+           "cross_entropy_rule", "fused_rope_rule", "scatter_rule",
+           "register_rule", "reshard_cost_bytes", "Propagator",
+           "PropagationReport", "propagate_jaxpr", "graph_reshard_bytes"]

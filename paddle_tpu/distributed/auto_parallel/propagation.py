@@ -1,0 +1,302 @@
+"""Whole-graph SPMD propagation: run the per-op rules over a jaxpr
+(ref: the reference's completion pass —
+python/paddle/distributed/auto_parallel/static/completion.py
+`complete_forward_annotation`, which walks the Program and applies
+phi/infermeta/spmd_rules per op; rules.h SpmdRuleFactory dispatch).
+
+TPU-native role: GSPMD does the real propagation inside XLA, but the
+planner needs whole-graph sharding decisions and reshard prices BEFORE
+compiling. This pass walks jaxpr equations, dispatches each primitive to
+a spmd_rules rule, records every forced reshard (resolved input attr !=
+incoming attr) with its byte cost, and reports output attrs — which the
+agreement tests then compare against GSPMD's actual compiled decisions
+(completion.complete)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from .spmd_rules import (DistAttr, concat_rule, elementwise_rule,
+                         reduction_rule, reshape_rule, reshard_cost_bytes,
+                         slice_rule, softmax_rule, transpose_rule)
+
+__all__ = ["Propagator", "PropagationReport", "propagate_jaxpr",
+           "graph_reshard_bytes"]
+
+# unary/binary/n-ary elementwise primitives: right-aligned broadcast merge
+_ELEMENTWISE = {
+    "add", "sub", "mul", "div", "rem", "max", "min", "pow", "atan2",
+    "and", "or", "xor", "shift_left", "shift_right_logical",
+    "shift_right_arithmetic", "eq", "ne", "lt", "le", "gt", "ge",
+    "neg", "sign", "floor", "ceil", "round", "exp", "exp2", "expm1",
+    "log", "log1p", "tanh", "logistic", "sqrt", "rsqrt", "cbrt", "abs",
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
+    "erf", "erfc", "erf_inv", "integer_pow", "not", "is_finite",
+    "select_n", "clamp", "nextafter", "real", "imag", "conj",
+    "convert_element_type", "stop_gradient", "copy", "square",
+}
+
+_REDUCE = {"reduce_sum": True, "reduce_max": False, "reduce_min": False,
+           "reduce_prod": False, "reduce_and": False, "reduce_or": False,
+           "argmax": False, "argmin": False}
+
+
+@dataclass
+class _Reshard:
+    op: str
+    src: DistAttr
+    dst: DistAttr
+    shape: Tuple[int, ...]
+    bytes: float
+
+
+@dataclass
+class PropagationReport:
+    """Completed whole-graph annotation + reshard bill."""
+    out_attrs: List[DistAttr]
+    env_size: int
+    reshards: List[_Reshard] = field(default_factory=list)
+    unknown_prims: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def total_reshard_bytes(self) -> float:
+        return sum(r.bytes for r in self.reshards)
+
+    def summary(self) -> str:
+        lines = [f"{self.env_size} vars annotated; "
+                 f"{len(self.reshards)} reshards "
+                 f"({self.total_reshard_bytes / 1e6:.2f} MB)"]
+        for r in self.reshards:
+            lines.append(f"  {r.op}: {r.src} -> {r.dst} {r.shape} "
+                         f"{r.bytes / 1e6:.2f} MB")
+        if self.unknown_prims:
+            lines.append(f"  unknown prims (replicated out): "
+                         f"{self.unknown_prims}")
+        return "\n".join(lines)
+
+
+class Propagator:
+    """Rule-based sharding propagation over one closed jaxpr."""
+
+    def __init__(self, mesh_shape: Dict[str, int], elem_bytes: int = 2):
+        self.mesh_shape = dict(mesh_shape)
+        self.elem_bytes = elem_bytes
+        self.reshards: List[_Reshard] = []
+        self.unknown: Dict[str, int] = {}
+
+    # -- helpers ------------------------------------------------------------
+
+    def _reshard(self, op: str, src: DistAttr, dst: DistAttr, aval):
+        if src.dims_mapping == dst.dims_mapping and src.partial == dst.partial:
+            return
+        shape = tuple(getattr(aval, "shape", ()) or ())
+        cost = reshard_cost_bytes(src, dst, shape, self.mesh_shape,
+                                  self.elem_bytes)
+        self.reshards.append(_Reshard(op, src, dst, shape, cost))
+
+    def _read(self, env, a) -> DistAttr:
+        from jax.extend.core import Literal
+        if isinstance(a, Literal):
+            return DistAttr.replicated(len(getattr(a.val, "shape", ())))
+        return env[a]
+
+    # -- the walk -----------------------------------------------------------
+
+    def run(self, jaxpr, in_attrs: Sequence[DistAttr],
+            const_attrs: Optional[Sequence[DistAttr]] = None
+            ) -> List[DistAttr]:
+        env: Dict[Any, DistAttr] = {}
+        for v, a in zip(jaxpr.invars, in_attrs):
+            assert a.ndim == len(v.aval.shape), (
+                f"attr rank {a.ndim} != var rank {len(v.aval.shape)}")
+            env[v] = a
+        for i, v in enumerate(jaxpr.constvars):
+            env[v] = (const_attrs[i] if const_attrs is not None
+                      else DistAttr.replicated(len(v.aval.shape)))
+        for eqn in jaxpr.eqns:
+            self._eqn(eqn, env)
+        return [self._read(env, v) for v in jaxpr.outvars]
+
+    def _eqn(self, eqn, env):
+        name = eqn.primitive.name
+        ins = [self._read(env, a) for a in eqn.invars]
+        avals = [a.aval for a in eqn.invars]
+        out_avals = [v.aval for v in eqn.outvars]
+
+        # nested jaxprs (pjit, remat, custom_vjp/jvp, closed_call)
+        inner = None
+        for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+            if key in eqn.params:
+                inner = eqn.params[key]
+                break
+        if inner is not None and name not in ("scan", "while", "cond"):
+            ij = inner.jaxpr if hasattr(inner, "jaxpr") else inner
+            sub = Propagator(self.mesh_shape, self.elem_bytes)
+            outs = sub.run(ij, ins[:len(ij.invars)])
+            self.reshards.extend(sub.reshards)
+            for k, v in sub.unknown.items():
+                self.unknown[k] = self.unknown.get(k, 0) + v
+            for v, a in zip(eqn.outvars, outs):
+                env[v] = a
+            return
+
+        if name == "dot_general":
+            out = self._dot_general(eqn, ins, avals)
+        elif name in _ELEMENTWISE:
+            rs, out = elementwise_rule(*ins)
+            for a, r, av in zip(ins, rs, avals):
+                self._reshard(name, a, r, av)
+        elif name in _REDUCE:
+            rx, out = reduction_rule(ins[0], eqn.params["axes"])
+            self._reshard(name, ins[0], rx, avals[0])
+        elif name == "broadcast_in_dim":
+            bd = eqn.params["broadcast_dimensions"]
+            dm: List[Optional[str]] = [None] * len(out_avals[0].shape)
+            for i, d in enumerate(bd):
+                if avals[0].shape[i] == out_avals[0].shape[d]:
+                    dm[d] = ins[0].dims_mapping[i]
+            out = DistAttr(dm, set(ins[0].partial))
+        elif name == "reshape":
+            rx, out = reshape_rule(ins[0], avals[0].shape,
+                                   out_avals[0].shape, self.mesh_shape)
+            self._reshard(name, ins[0], rx, avals[0])
+        elif name == "transpose":
+            _, out = transpose_rule(ins[0], eqn.params["permutation"])
+        elif name == "squeeze":
+            cut = set(eqn.params["dimensions"])
+            out = DistAttr([a for i, a in enumerate(ins[0].dims_mapping)
+                            if i not in cut], set(ins[0].partial))
+        elif name == "expand_dims":
+            add = set(eqn.params["dimensions"])
+            dm = list(ins[0].dims_mapping)
+            for d in sorted(add):
+                dm.insert(d, None)
+            out = DistAttr(dm, set(ins[0].partial))
+        elif name == "concatenate":
+            rs, out = concat_rule(ins, eqn.params["dimension"])
+            for a, r, av in zip(ins, rs, avals):
+                self._reshard(name, a, r, av)
+        elif name == "slice":
+            full = [
+                i for i in range(len(avals[0].shape))
+                if not (eqn.params["start_indices"][i] == 0
+                        and eqn.params["limit_indices"][i]
+                        == avals[0].shape[i]
+                        and (eqn.params["strides"] is None
+                             or eqn.params["strides"][i] == 1))]
+            rx, out = slice_rule(ins[0], full) if full else (
+                ins[0], DistAttr(list(ins[0].dims_mapping),
+                                 set(ins[0].partial)))
+            if full:
+                self._reshard(name, ins[0], rx, avals[0])
+        elif name in ("dynamic_slice", "dynamic_update_slice"):
+            x = ins[0]
+            ref_shape = avals[0].shape
+            upd_shape = (out_avals[0].shape if name == "dynamic_slice"
+                         else eqn.invars[1].aval.shape)
+            cut = [i for i in range(len(ref_shape))
+                   if upd_shape[i] != ref_shape[i]]
+            rx, out_x = slice_rule(x, cut) if cut else (
+                x, DistAttr(list(x.dims_mapping), set(x.partial)))
+            self._reshard(name, x, rx, avals[0])
+            out = (DistAttr(list(out_x.dims_mapping), set(out_x.partial))
+                   if name == "dynamic_update_slice"
+                   else DistAttr([out_x.dims_mapping[i] if i not in cut
+                                  else None
+                                  for i in range(len(upd_shape))],
+                                 set(out_x.partial)))
+        elif name == "softmax":  # jax lowers via exp/reduce; kept for compat
+            _, out = softmax_rule(ins[0])
+        elif name == "iota":
+            out = DistAttr.replicated(len(out_avals[0].shape))
+        else:
+            # unknown primitive: conservative replicated outputs (the
+            # reference's completion also defaults unannotated ops) —
+            # counted so tests can assert coverage over real models
+            self.unknown[name] = self.unknown.get(name, 0) + 1
+            for v in eqn.outvars:
+                env[v] = DistAttr.replicated(len(v.aval.shape))
+            return
+
+        outs = [out] if isinstance(out, DistAttr) else list(out)
+        for v, a in zip(eqn.outvars, outs):
+            env[v] = a
+
+    def _dot_general(self, eqn, ins, avals) -> DistAttr:
+        """Generalized matmul rule over dot_general dimension numbers
+        (ref: spmd_rules/matmul.cc, generalized the way GSPMD sees it)."""
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        xa, ya = ins
+        x_free = [i for i in range(xa.ndim) if i not in lc and i not in lb]
+        y_free = [i for i in range(ya.ndim) if i not in rc and i not in rb]
+        used: set = set()
+        rx = list(xa.dims_mapping)
+        ry = list(ya.dims_mapping)
+
+        def claim(ax):
+            if ax is None or ax in used:
+                return None
+            used.add(ax)
+            return ax
+
+        from .spmd_rules import _merge
+        batch = []
+        for i, j in zip(lb, rb):
+            batch.append(claim(_merge(xa.dims_mapping[i],
+                                      ya.dims_mapping[j])))
+            rx[i] = batch[-1]
+            ry[j] = batch[-1]
+        xf = []
+        for i in x_free:
+            xf.append(claim(xa.dims_mapping[i]))
+            rx[i] = xf[-1]
+        yf = []
+        for j in y_free:
+            yf.append(claim(ya.dims_mapping[j]))
+            ry[j] = yf[-1]
+        partial = set(xa.partial) | set(ya.partial)
+        for i, j in zip(lc, rc):
+            k = _merge(xa.dims_mapping[i], ya.dims_mapping[j])
+            k = claim(k)
+            rx[i] = k
+            ry[j] = k
+            if k is not None:
+                partial.add(k)
+        self._reshard("dot_general", xa, DistAttr(rx), avals[0])
+        self._reshard("dot_general", ya, DistAttr(ry), avals[1])
+        return DistAttr(batch + xf + yf, partial)
+
+
+def propagate_jaxpr(fn, example_args, in_attrs: Sequence[DistAttr],
+                    mesh_shape: Dict[str, int], elem_bytes: int = 2
+                    ) -> PropagationReport:
+    """Trace `fn` and propagate shardings through its whole jaxpr."""
+    closed = jax.make_jaxpr(fn)(*example_args)
+    prop = Propagator(mesh_shape, elem_bytes)
+    flat_attrs = list(in_attrs)
+    outs = prop.run(closed.jaxpr, flat_attrs)
+    return PropagationReport(out_attrs=outs,
+                             env_size=len(closed.jaxpr.eqns),
+                             reshards=prop.reshards,
+                             unknown_prims=prop.unknown)
+
+
+def graph_reshard_bytes(fn, example_args, in_attrs, mesh_shape,
+                        elem_bytes: int = 2) -> float:
+    """The planner's whole-graph communication price for one candidate
+    sharding (VERDICT r3 #4: price the full graph, not isolated ops):
+    total bytes moved by the reshards + pending-partial allreduces the
+    rules predict for this annotation."""
+    rep = propagate_jaxpr(fn, example_args, in_attrs, mesh_shape,
+                          elem_bytes)
+    cost = rep.total_reshard_bytes
+    # unresolved partials at the outputs pay their allreduce here
+    closed = jax.make_jaxpr(fn)(*example_args)
+    for attr, v in zip(rep.out_attrs, closed.jaxpr.outvars):
+        if attr.partial:
+            dst = DistAttr(list(attr.dims_mapping))
+            cost += reshard_cost_bytes(attr, dst, v.aval.shape,
+                                       mesh_shape, elem_bytes)
+    return cost

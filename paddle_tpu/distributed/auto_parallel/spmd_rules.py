@@ -23,7 +23,10 @@ from typing import List, Optional, Sequence, Set, Tuple
 
 __all__ = ["DistAttr", "matmul_rule", "embedding_rule", "layer_norm_rule",
            "flash_attention_rule", "elementwise_rule", "reduction_rule",
-           "softmax_rule", "reshard_cost_bytes"]
+           "softmax_rule", "transpose_rule", "reshape_rule", "concat_rule",
+           "split_rule", "slice_rule", "cross_entropy_rule",
+           "fused_rope_rule", "scatter_rule", "register_rule",
+           "reshard_cost_bytes"]
 
 
 @dataclass
@@ -221,6 +224,214 @@ def softmax_rule(x: DistAttr, axis: int = -1) -> Tuple[DistAttr, DistAttr]:
     return rx, DistAttr(list(dm), set(x.partial))
 
 
+def transpose_rule(x: DistAttr, perm: Sequence[int]
+                   ) -> Tuple[DistAttr, DistAttr]:
+    """Permutation carries the dims_mapping with it
+    (ref: spmd_rules/transpose.cc TransposeInferSpmd)."""
+    rx = DistAttr(list(x.dims_mapping), set(x.partial))
+    return rx, DistAttr([x.dims_mapping[p] for p in perm], set(x.partial))
+
+
+def _reshape_groups(src: Sequence[int], dst: Sequence[int]):
+    """Factor src/dst shapes into aligned groups with equal products
+    (the reference's dim_trans machinery, reshape.cc InferTargetShape).
+    Trailing/exhausted dims (necessarily unit-sized) group with an empty
+    other side — e.g. (4,) -> (4, 1) yields ([0],[0]), ([],[1])."""
+    groups = []
+    i = j = 0
+    while i < len(src) or j < len(dst):
+        if i >= len(src):                    # trailing dst 1-dims
+            groups.append(([], list(range(j, len(dst)))))
+            break
+        if j >= len(dst):                    # trailing src 1-dims
+            groups.append((list(range(i, len(src))), []))
+            break
+        si, sj = [i], [j]
+        ps, pd = src[i], dst[j]
+        i += 1
+        j += 1
+        while ps != pd:
+            if ps < pd:
+                if i >= len(src):
+                    raise ValueError(
+                        f"reshape {tuple(src)} -> {tuple(dst)}: sizes "
+                        "do not factor")
+                ps *= src[i]
+                si.append(i)
+                i += 1
+            else:
+                if j >= len(dst):
+                    raise ValueError(
+                        f"reshape {tuple(src)} -> {tuple(dst)}: sizes "
+                        "do not factor")
+                pd *= dst[j]
+                sj.append(j)
+                j += 1
+        groups.append((si, sj))
+    return groups
+
+
+def reshape_rule(x: DistAttr, src_shape: Sequence[int],
+                 dst_shape: Sequence[int],
+                 mesh_shape: Optional[dict] = None
+                 ) -> Tuple[DistAttr, DistAttr]:
+    """ref: spmd_rules/reshape.cc ReshapeInferSpmd. Shapes factor into
+    aligned groups; within a group only the LEADING src dim's sharding
+    survives (later-sharded dims would interleave shards) and lands on
+    the leading dst dim — if its size stays divisible by the mesh axis
+    (checked when mesh_shape is given). 1-sized and passthrough dims map
+    directly."""
+    # normalize -1 in dst
+    src_shape = list(src_shape)
+    dst_shape = list(dst_shape)
+    if -1 in dst_shape:
+        total = 1
+        for s in src_shape:
+            total *= s
+        known = 1
+        for d in dst_shape:
+            if d != -1:
+                known *= d
+        dst_shape[dst_shape.index(-1)] = total // max(known, 1)
+    rx_dm = list(x.dims_mapping)
+    out_dm: List[Optional[str]] = [None] * len(dst_shape)
+    for si, sj in _reshape_groups(src_shape, dst_shape):
+        if not si or not sj:
+            continue       # trailing unit dims: nothing to carry
+        lead = si[0]
+        ax = x.dims_mapping[lead]
+        # later src dims of a merged group must come in unsharded
+        for s in si[1:]:
+            rx_dm[s] = None
+        if ax is None:
+            continue
+        d0 = sj[0]
+        if mesh_shape is not None and \
+                dst_shape[d0] % max(mesh_shape.get(ax, 1), 1):
+            rx_dm[lead] = None      # indivisible: reshard input instead
+            continue
+        out_dm[d0] = ax
+    return DistAttr(rx_dm, set(x.partial)), \
+        DistAttr(out_dm, set(x.partial))
+
+
+def concat_rule(xs: Sequence[DistAttr], axis: int
+                ) -> Tuple[Tuple[DistAttr, ...], DistAttr]:
+    """ref: spmd_rules/concat.cc ConcatInferSpmd: non-concat dims merge
+    across operands; the concat dim must be replicated (shard boundaries
+    would interleave sections)."""
+    nd = xs[0].ndim
+    ax = axis % nd
+    dm: List[Optional[str]] = [None] * nd
+    for x in xs:
+        for i, a in enumerate(x.dims_mapping):
+            if i != ax:
+                dm[i] = _merge(dm[i], a)
+    dm[ax] = None
+    partial = set().union(*(x.partial for x in xs))
+    rs = tuple(DistAttr(list(dm), set(x.partial)) for x in xs)
+    return rs, DistAttr(dm, partial)
+
+
+def split_rule(x: DistAttr, axis: int, n_sections: int
+               ) -> Tuple[DistAttr, List[DistAttr]]:
+    """ref: spmd_rules/split.cc SplitInferSpmd: the split dim must be
+    replicated; every section inherits the remaining mapping."""
+    ax = axis % x.ndim
+    dm = [a if i != ax else None for i, a in enumerate(x.dims_mapping)]
+    rx = DistAttr(dm, set(x.partial))
+    return rx, [DistAttr(list(dm), set(x.partial))
+                for _ in range(n_sections)]
+
+
+def slice_rule(x: DistAttr, axes: Sequence[int]
+               ) -> Tuple[DistAttr, DistAttr]:
+    """ref: spmd_rules/slice.cc SliceInferSpmd: dims being sliced must be
+    replicated (a strided/offset subrange crosses shard boundaries);
+    other dims propagate. `axes` = the dims actually sliced (callers drop
+    full-range dims, which stay sharded)."""
+    cut = {a % x.ndim for a in axes}
+    dm = [a if i not in cut else None
+          for i, a in enumerate(x.dims_mapping)]
+    rx = DistAttr(dm, set(x.partial))
+    return rx, DistAttr(list(dm), set(x.partial))
+
+
+def cross_entropy_rule(logits: DistAttr, label: DistAttr, axis: int = -1
+                       ) -> Tuple[Tuple[DistAttr, DistAttr],
+                                  Tuple[DistAttr, DistAttr]]:
+    """ref: spmd_rules/cross_entropy_with_softmax.cc. Batch dims merge
+    between logits and label. A SHARDED class (softmax) dim is legal —
+    it is exactly the mp ParallelCrossEntropy pattern (mpu
+    ParallelCrossEntropy): softmax_out keeps the class sharding and the
+    loss is PARTIAL over that axis (per-shard max/sum awaiting the
+    allreduce the planner prices). Returns ((r_logits, r_label),
+    (softmax_out, loss))."""
+    ax = axis % logits.ndim
+    batch = [a for i, a in enumerate(logits.dims_mapping) if i != ax]
+    if label.ndim == logits.ndim:
+        # one-hot / soft labels: dims align with logits, drop class dim
+        lb = [a for i, a in enumerate(label.dims_mapping) if i != ax]
+    else:
+        # sparse labels have NO class dim — their dims already map onto
+        # logits' batch dims in order (code-review r4: filtering by
+        # index == ax here dropped a legitimate label sharding)
+        lb = list(label.dims_mapping)
+    merged = [_merge(a, b) for a, b in zip(batch, lb + [None] * (
+        len(batch) - len(lb)))]
+    cls_ax = logits.axis(ax)
+    if cls_ax in merged:
+        cls_ax = None
+    lg_dm = list(merged)
+    lg_dm.insert(ax, cls_ax)
+    r_logits = DistAttr(lg_dm, set(logits.partial))
+    lab_dm = list(merged)[:label.ndim - (1 if label.ndim == logits.ndim
+                                         else 0)]
+    if label.ndim == logits.ndim:           # one-hot / soft labels
+        lab_dm.insert(ax, None)
+    r_label = DistAttr(lab_dm, set(label.partial))
+    softmax_out = DistAttr(lg_dm, set(logits.partial))
+    loss_partial = set(logits.partial) | set(label.partial)
+    if cls_ax is not None:
+        loss_partial.add(cls_ax)
+    loss = DistAttr(merged, loss_partial)
+    return (r_logits, r_label), (softmax_out, loss)
+
+
+def fused_rope_rule(q: DistAttr, k: Optional[DistAttr] = None
+                    ) -> Tuple[Tuple[DistAttr, ...], Tuple[DistAttr, ...]]:
+    """ref: spmd_rules/fused_rope.cc FusedRopeInferSpmd: rotary embedding
+    rotates within the head_dim (last dim) — it must be replicated;
+    batch/seq/heads shard freely and q/k propagate independently (no
+    cross-merge: they never interact inside the op)."""
+    outs = []
+    resolved = []
+    for t in (q, k):
+        if t is None:
+            continue
+        dm = list(t.dims_mapping)
+        dm[-1] = None
+        resolved.append(DistAttr(dm, set(t.partial)))
+        outs.append(DistAttr(list(dm), set(t.partial)))
+    return tuple(resolved), tuple(outs)
+
+
+def scatter_rule(x: DistAttr, index: DistAttr, updates: DistAttr
+                 ) -> Tuple[Tuple[DistAttr, DistAttr, DistAttr], DistAttr]:
+    """ref: spmd_rules/scatter.cc ScatterInferSpmd: writes land on
+    data-dependent rows, so dim 0 of x/updates (and index) must be
+    replicated; trailing dims merge between x and updates and propagate."""
+    nd = x.ndim
+    tail = [_merge(x.dims_mapping[i], updates.dims_mapping[i])
+            for i in range(1, nd)]
+    rx = DistAttr([None] + tail, set(x.partial))
+    rupd = DistAttr([None] + tail, set(updates.partial))
+    ridx = DistAttr([None] * index.ndim, set(index.partial))
+    out = DistAttr([None] + tail,
+                   set(x.partial) | set(updates.partial))
+    return (rx, ridx, rupd), out
+
+
 def reshard_cost_bytes(src: DistAttr, dst: DistAttr, shape: Sequence[int],
                        mesh_shape: dict, elem_bytes: int = 2) -> float:
     """Bytes each chip moves to convert src->dst sharding of a tensor
@@ -265,7 +476,26 @@ _FORWARD_RULES = {
     "elementwise": elementwise_rule,
     "reduction": reduction_rule,
     "softmax": softmax_rule,
+    "transpose": transpose_rule,
+    "reshape": reshape_rule,
+    "concat": concat_rule,
+    "split": split_rule,
+    "slice": slice_rule,
+    "cross_entropy": cross_entropy_rule,
+    "fused_rope": fused_rope_rule,
+    "scatter": scatter_rule,
 }
+
+
+def register_rule(op_kind: str, fn=None):
+    """Register a custom SPMD rule (ref: SpmdRuleFactory registration —
+    REGISTER_SPMD_RULE). Usable as a decorator."""
+    def deco(f):
+        _FORWARD_RULES[op_kind] = f
+        return f
+    if fn is not None:
+        return deco(fn)
+    return deco
 
 
 def infer_forward(op_kind: str, *attrs, **kwargs):

@@ -269,3 +269,131 @@ class TestRuleRegistry:
         assert out.dims_mapping == ["dp", "mp"]
         with pytest.raises(ValueError, match="no SPMD rule"):
             infer_forward("conv3d_transpose", x, w)
+
+
+class TestNewRuleFamilies:
+    """Round-4 rule breadth (VERDICT r3 #4; ref
+    phi/infermeta/spmd_rules/{reshape,transpose,concat,slice,
+    cross_entropy_with_softmax,fused_rope,scatter}.cc + split)."""
+
+    def test_transpose_carries_mapping(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            transpose_rule)
+        x = DistAttr(["dp", None, "mp", None])
+        _, out = transpose_rule(x, (0, 2, 1, 3))
+        assert out.dims_mapping == ["dp", "mp", None, None]
+
+    def test_reshape_merge_keeps_leading_shard(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            reshape_rule)
+        # [B, S, H] -> [B*S, H]: leading dim of the merged group keeps dp
+        x = DistAttr(["dp", None, "mp"])
+        rx, out = reshape_rule(x, (4, 8, 16), (32, 16),
+                               mesh_shape={"dp": 2, "mp": 2})
+        assert out.dims_mapping == ["dp", "mp"]
+        # a sharding on the NON-leading dim of a merge group drops
+        x2 = DistAttr([None, "dp", "mp"])
+        rx2, out2 = reshape_rule(x2, (4, 8, 16), (32, 16),
+                                 mesh_shape={"dp": 2, "mp": 2})
+        assert out2.dims_mapping == [None, "mp"]
+        assert rx2.dims_mapping == [None, None, "mp"]  # input resharded
+
+    def test_reshape_split_leading_dst(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            reshape_rule)
+        # [B*S, H] -> [B, S, H]: shard follows the leading dst dim
+        x = DistAttr(["dp", "mp"])
+        _, out = reshape_rule(x, (32, 16), (4, 8, 16),
+                              mesh_shape={"dp": 2, "mp": 2})
+        assert out.dims_mapping == ["dp", None, "mp"]
+
+    def test_reshape_indivisible_reshards_input(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            reshape_rule)
+        # dst leading dim 3 not divisible by mesh axis 2 -> input unshards
+        x = DistAttr(["dp", None])
+        rx, out = reshape_rule(x, (6, 4), (3, 8), mesh_shape={"dp": 2})
+        assert out.dims_mapping == [None, None]
+        assert rx.dims_mapping == [None, None]
+
+    def test_concat_dim_replicated_others_merge(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            concat_rule)
+        a = DistAttr(["dp", "mp"])
+        b = DistAttr(["dp", None])
+        (ra, rb), out = concat_rule([a, b], axis=1)
+        assert out.dims_mapping == ["dp", None]
+        assert ra.dims_mapping == ["dp", None]
+
+    def test_split_dim_replicated(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            split_rule)
+        x = DistAttr(["dp", "mp", None])
+        rx, outs = split_rule(x, axis=1, n_sections=4)
+        assert len(outs) == 4
+        assert all(o.dims_mapping == ["dp", None, None] for o in outs)
+        assert rx.dims_mapping == ["dp", None, None]
+
+    def test_slice_cut_dims_replicated(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            slice_rule)
+        x = DistAttr(["dp", "mp", "sep"])
+        rx, out = slice_rule(x, axes=[1])
+        assert out.dims_mapping == ["dp", None, "sep"]
+
+    def test_cross_entropy_parallel_class_dim(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            cross_entropy_rule)
+        # ParallelCrossEntropy: logits [B, V] with V sharded over mp
+        logits = DistAttr(["dp", "mp"])
+        label = DistAttr(["dp"])
+        (rl, rlb), (softmax_out, loss) = cross_entropy_rule(logits, label)
+        assert softmax_out.dims_mapping == ["dp", "mp"]
+        assert loss.dims_mapping == ["dp"]
+        assert loss.partial == {"mp"}          # pending allreduce
+        assert rlb.dims_mapping == ["dp"]
+
+    def test_cross_entropy_sparse_label_nonlast_axis(self):
+        """Sparse labels have no class dim: with axis=1, label [B, T]
+        dims map onto logits' batch dims IN ORDER — the 'sp' sharding on
+        T must survive the merge (code-review r4 fix)."""
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            cross_entropy_rule)
+        logits = DistAttr([None, None, "sp"])      # [B, V, T], axis=1
+        label = DistAttr([None, "sp"])             # [B, T]
+        (rl, rlb), (softmax_out, loss) = cross_entropy_rule(
+            logits, label, axis=1)
+        assert loss.dims_mapping == [None, "sp"]
+        assert rlb.dims_mapping == [None, "sp"]
+
+    def test_fused_rope_head_dim_replicated(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            fused_rope_rule)
+        q = DistAttr(["dp", "sep", "mp", "mp2"])
+        k = DistAttr(["dp", None, "mp", None])
+        (rq, rk), (oq, ok) = fused_rope_rule(q, k)
+        assert oq.dims_mapping == ["dp", "sep", "mp", None]
+        assert ok.dims_mapping == ["dp", None, "mp", None]
+
+    def test_scatter_dim0_replicated_tail_merges(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            scatter_rule)
+        x = DistAttr(["dp", None])
+        idx = DistAttr([None])
+        upd = DistAttr([None, "mp"])
+        (rx, ridx, rupd), out = scatter_rule(x, idx, upd)
+        assert out.dims_mapping == [None, "mp"]
+        assert rx.dims_mapping == [None, "mp"]
+
+    def test_registry_has_all_families(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            _FORWARD_RULES, register_rule)
+        for kind in ("transpose", "reshape", "concat", "split", "slice",
+                     "cross_entropy", "fused_rope", "scatter"):
+            assert kind in _FORWARD_RULES, kind
+
+        @register_rule("my_custom_op")
+        def my_rule(x):
+            return x, x
+
+        assert _FORWARD_RULES.pop("my_custom_op") is my_rule
