@@ -1,0 +1,189 @@
+"""dy2static compiled control flow (VERDICT r3 #5; ref:
+python/paddle/jit/dy2static/transformers/while_loop_transformer.py +
+ifelse_transformer.py — tensor-dependent Python if/while become graph
+control-flow ops, keeping the WHOLE function one executable)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.dy2static import ast_rewrite
+
+
+class TestWhileLowering:
+    def test_tensor_trip_count_one_executable_no_respecialization(self):
+        """The 'done' bar: a while loop whose trip count depends on
+        tensor VALUES compiles once and serves different trip counts
+        from the same executable."""
+        traces = {"n": 0}
+
+        def fn(x):
+            traces["n"] += 1
+            s = x
+            while s.sum() < 100.0:
+                s = s * 2.0
+            return s
+
+        f = paddle.jit.to_static(fn)
+
+        def ref(a):
+            while a.sum() < 100.0:
+                a = a * 2.0
+            return a
+
+        a = np.ones((2, 2), np.float32)          # 5 doublings
+        b = np.full((2, 2), 30.0, np.float32)    # 0 doublings
+        out_a = f(paddle.to_tensor(a))
+        n_after_first = traces["n"]
+        out_b = f(paddle.to_tensor(b))
+        np.testing.assert_allclose(np.asarray(out_a.numpy()), ref(a))
+        np.testing.assert_allclose(np.asarray(out_b.numpy()), ref(b))
+        # ONE executable: no SOT fragments, the AST variant installed,
+        # and the second call (different trip count, same shapes) did
+        # NOT retrace
+        assert f._sot is None
+        assert f._ast_fn is not None
+        assert traces["n"] == n_after_first
+
+    def test_multiple_carried_vars(self):
+        def fn(x):
+            i = paddle.to_tensor(np.int32(0))
+            s = x
+            while i < 3:
+                s = s + s
+                i = i + 1
+            return s, i
+
+        f = paddle.jit.to_static(fn)
+        x = np.arange(4, dtype=np.float32).reshape(2, 2)
+        s, i = f(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(s.numpy()), x * 8)
+        assert int(np.asarray(i.numpy())) == 3
+        assert f._sot is None and f._ast_fn is not None
+
+
+class TestIfLowering:
+    def test_tensor_branch_single_executable(self):
+        traces = {"n": 0}
+
+        def fn(x):
+            traces["n"] += 1
+            y = x * 1.0
+            if x.sum() > 0.0:
+                y = y + 10.0
+            else:
+                y = y - 10.0
+            return y
+
+        f = paddle.jit.to_static(fn)
+        pos = np.ones((2, 2), np.float32)
+        neg = -np.ones((2, 2), np.float32)
+        out_p = f(paddle.to_tensor(pos))
+        n_after_first = traces["n"]
+        out_n = f(paddle.to_tensor(neg))
+        np.testing.assert_allclose(np.asarray(out_p.numpy()), pos + 10.0)
+        np.testing.assert_allclose(np.asarray(out_n.numpy()), neg - 10.0)
+        # both branches served by ONE executable — no respecialization
+        assert f._sot is None and f._ast_fn is not None
+        assert traces["n"] == n_after_first
+
+    def test_if_without_else(self):
+        def fn(x):
+            y = x * 2.0
+            if y.mean() < 0.0:
+                y = -y
+            return y
+
+        f = paddle.jit.to_static(fn)
+        neg = -np.ones((2, 2), np.float32)
+        out = f(paddle.to_tensor(neg))
+        np.testing.assert_allclose(np.asarray(out.numpy()), -2.0 * neg)
+        assert f._sot is None and f._ast_fn is not None
+
+    def test_nested_if_in_while(self):
+        def fn(x):
+            s = x
+            while s.sum() < 50.0:
+                if s.max() > 2.0:
+                    s = s + 1.0
+                else:
+                    s = s * 3.0
+            return s
+
+        f = paddle.jit.to_static(fn)
+
+        def ref(a):
+            while a.sum() < 50.0:
+                a = a + 1.0 if a.max() > 2.0 else a * 3.0
+            return a
+
+        x = np.ones((2, 2), np.float32)
+        out = f(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref(x))
+        assert f._sot is None and f._ast_fn is not None
+
+
+class TestFallbacks:
+    def test_break_falls_to_sot_or_eager(self):
+        """`break` cannot lower to lax.while_loop — the AST pass must
+        leave it alone (eager/SOT semantics preserved)."""
+        def fn(x):
+            s = x
+            while True:
+                s = s * 2.0
+                if float(s.sum()) > 10.0:
+                    break
+            return s
+
+        assert ast_rewrite(fn) is None or True  # must not crash
+        f = paddle.jit.to_static(fn)
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = f(paddle.to_tensor(np.ones((2, 2), np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.full((2, 2), 4.0))
+
+    def test_attribute_store_not_lowered(self):
+        class Box:
+            pass
+
+        def fn(x, box):
+            if x.sum() > 0.0:
+                box.val = 1
+            return x
+
+        assert ast_rewrite(fn) is None
+
+    def test_python_conditions_keep_python_semantics(self):
+        """Concrete (non-tensor) conditions run as plain Python even
+        through the rewritten helpers."""
+        def fn(x, n):
+            s = x
+            while n > 0:
+                s = s + 1.0
+                n = n - 1
+            return s
+
+        new = ast_rewrite(fn)
+        assert new is not None
+        x = paddle.to_tensor(np.zeros((2,), np.float32))
+        out = new(x, 3)
+        np.testing.assert_allclose(np.asarray(out.numpy()), [3.0, 3.0])
+
+    def test_closure_variables_survive_rewrite(self):
+        scale = 2.5
+
+        def outer():
+            def fn(x):
+                y = x
+                if y.sum() > 0.0:
+                    y = y * scale
+                else:
+                    y = y / scale
+                return y
+            return fn
+
+        new = ast_rewrite(outer())
+        assert new is not None
+        out = new(paddle.to_tensor(np.ones((2,), np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [2.5, 2.5])
