@@ -1,0 +1,96 @@
+"""2-process MoE expert-parallel (ep) worker (VERDICT r3 #6: the ep
+axis was only verified in-process; ref pattern: test/collective/fleet/).
+
+Mesh ep=2 over 2 single-device processes: expert weights shard over ep
+(each process holds 2 of 4 experts) and the dispatch/combine einsums
+become cross-process all-to-alls under GSPMD. TrainStep losses must
+match the single-process eager run."""
+import os
+import re
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=1").strip()
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as popt
+from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+
+class MoENet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        # switch gate: deterministic top-1 routing, so eager and the
+        # compiled distributed step see IDENTICAL dispatch (gshard's
+        # stochastic 2nd expert draws from rng streams that legitimately
+        # differ between the two execution modes)
+        self.moe = MoELayer(16, 32, num_experts=4, gate="switch")
+        self.head = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.head(self.moe(x))
+
+
+def main():
+    out_dir = sys.argv[1]
+    dist.init_parallel_env()
+    rank = jax.process_index()
+    assert jax.process_count() == 2 and len(jax.devices()) == 2
+
+    rng = np.random.default_rng(5)
+    Xn = rng.standard_normal((8, 16)).astype(np.float32)
+    Yn = rng.standard_normal((8, 4)).astype(np.float32)
+
+    def loss_of(model, xb, yb):
+        return F.mse_loss(model(xb), yb) + 0.01 * model.moe.aux_loss
+
+    # single-process eager reference FIRST (no mesh: pspec inert)
+    paddle.seed(11)
+    ref = MoENet()
+    oref = popt.SGD(learning_rate=0.05, parameters=ref.parameters())
+    ref_losses = []
+    for _ in range(3):
+        loss = loss_of(ref, paddle.to_tensor(Xn), paddle.to_tensor(Yn))
+        loss.backward()
+        oref.step()
+        oref.clear_grad()
+        ref_losses.append(float(np.asarray(loss.data)))
+
+    from paddle_tpu.distributed.sharding import ShardingPlan
+    from paddle_tpu.distributed.topology import (HybridCommunicateGroup,
+                                                 set_mesh)
+    hcg = HybridCommunicateGroup(dp_degree=1, ep_degree=2)
+    set_mesh(hcg.mesh)
+    paddle.seed(11)
+    model = MoENet()
+    opt_ = popt.SGD(learning_rate=0.05, parameters=model.parameters())
+    plan = ShardingPlan(hcg.mesh, stage=0, shard_min_size=1)
+    plan.materialize(model, opt_)
+    step = paddle.jit.TrainStep(model, opt_,
+                                lambda x, y: loss_of(model, x, y),
+                                shard=plan)
+    got = []
+    for _ in range(3):
+        loss = step(paddle.to_tensor(Xn), paddle.to_tensor(Yn))
+        got.append(float(np.asarray(loss.data)))
+
+    np.testing.assert_allclose(got, ref_losses, rtol=1e-4, atol=1e-6)
+    with open(os.path.join(out_dir, f"moe_ok_{rank}"), "w") as f:
+        f.write(",".join(f"{v:.6f}" for v in got))
+    print(f"rank {rank}: 2-process MoE(ep=2) losses match single-process")
+
+
+if __name__ == "__main__":
+    main()

@@ -96,6 +96,56 @@ def test_four_process_pp_dp_matches_sequential():
         assert len(set(vals)) == 1, vals  # same loss AND grad summary
 
 
+@pytest.mark.timeout(420)
+def test_eight_process_tp_pp_dp_matches_sequential():
+    """2x2x2 mesh over 8 processes: dp reduction + mp allreduce + pp
+    ppermute all cross process boundaries in ONE compiled step
+    (VERDICT r3 #6)."""
+    with tempfile.TemporaryDirectory() as d:
+        procs = _launch(8, os.path.join(COLL, "hybrid_tp_pp_dp_worker.py"),
+                        [d])
+        outs = _wait_all(procs, timeout=400)
+        vals = []
+        for rank in range(8):
+            marker = os.path.join(d, f"tpppdp_ok_{rank}")
+            assert os.path.exists(marker), outs[rank][-3000:]
+            with open(marker) as f:
+                vals.append(f.read())
+        assert len(set(vals)) == 1, vals
+
+
+@pytest.mark.timeout(300)
+def test_two_process_ring_attention_sep():
+    """sep axis in subprocesses: ring ppermute rounds cross process
+    boundaries and must match the dense reference (VERDICT r3 #6)."""
+    with tempfile.TemporaryDirectory() as d:
+        procs = _launch(2, os.path.join(COLL, "ring_sep_worker.py"), [d])
+        outs = _wait_all(procs, timeout=270)
+        vals = []
+        for rank in range(2):
+            marker = os.path.join(d, f"ring_ok_{rank}")
+            assert os.path.exists(marker), outs[rank][-3000:]
+            with open(marker) as f:
+                vals.append(f.read())
+        assert len(set(vals)) == 1, vals
+
+
+@pytest.mark.timeout(300)
+def test_two_process_moe_ep_matches_single():
+    """ep axis in subprocesses: expert dispatch all-to-alls cross
+    process boundaries; losses match single-process (VERDICT r3 #6)."""
+    with tempfile.TemporaryDirectory() as d:
+        procs = _launch(2, os.path.join(COLL, "moe_ep_worker.py"), [d])
+        outs = _wait_all(procs, timeout=270)
+        vals = []
+        for rank in range(2):
+            marker = os.path.join(d, f"moe_ok_{rank}")
+            assert os.path.exists(marker), outs[rank][-3000:]
+            with open(marker) as f:
+                vals.append(f.read())
+        assert len(set(vals)) == 1, vals
+
+
 @pytest.mark.timeout(300)
 def test_multiprocess_ckpt_save_then_reshard_load():
     with tempfile.TemporaryDirectory() as d:
