@@ -6,40 +6,119 @@ the port (ref hazard: paddle/fluid/distributed uses brpc with its own
 auth; our host channels must supply an equivalent). Every channel
 (collective p2p, parameter server, rpc, elastic) derives its key here:
 
-1. an explicit env var set by the launcher (strongest, per-job),
-2. else a digest of ONE job-identity env var + a namespace tag (not
-   guessable from source alone). Exactly one var is used — the FIRST
-   set among PADDLE_MASTER, PADDLE_TRAINER_ENDPOINTS,
-   PADDLE_PSERVERS_IP_PORT_LIST — never a concatenation, because
-   different processes of one job may legitimately see different
-   SUBSETS of these (a PS server launched with only the pserver list
-   must still derive the same key as a trainer that has all three).
-   Launchers must publish the highest-priority var to every process.
-3. else — bare local runs — a same-user 0600 secret file (one file per
+1. an explicit per-channel env var set by the operator (strongest),
+2. else PADDLE_JOB_AUTHKEY — a RANDOM per-job secret the launcher
+   generates for single-node jobs and distributes to every role
+   (launch/main.py); namespaced per channel by digest,
+3. else a digest of ONE job-identity env var + a namespace tag. Exactly
+   one var is used — the FIRST set among PADDLE_MASTER,
+   PADDLE_TRAINER_ENDPOINTS, PADDLE_PSERVERS_IP_PORT_LIST — never a
+   concatenation, because different processes of one job may
+   legitimately see different SUBSETS of these (a PS server launched
+   with only the pserver list must still derive the same key as a
+   trainer that has all three). Launchers must publish the
+   highest-priority var to every process.
+4. else — bare local runs — a same-user 0600 secret file (one file per
    namespace, so channels stay key-isolated even in this mode),
    created atomically so concurrent ranks converge on ONE key.
+
+SECURITY (advisor r3, medium): tiers 3 and 4 are computable by anyone
+who can observe the endpoint lists (process args, logs, conn metadata),
+so a listener BINDING A NON-LOOPBACK INTERFACE refuses to fall back to
+them — callers pass `bind_host` and get a RuntimeError directing them
+to set the explicit secret (or PADDLE_ALLOW_DERIVED_AUTHKEY=1 to
+accept the risk with a loud warning). Loopback-only channels keep the
+convenient fallbacks.
 """
 from __future__ import annotations
 
 import os
 
-__all__ = ["derive_authkey"]
+__all__ = ["derive_authkey", "authkey_source"]
 
 # priority order of the job-identity vars; see module docstring
 _JOB_VARS = ("PADDLE_MASTER", "PADDLE_TRAINER_ENDPOINTS",
              "PADDLE_PSERVERS_IP_PORT_LIST")
 
+# NOTE: "" is NOT loopback — binding "" means INADDR_ANY (all
+# interfaces), the same exposure as "0.0.0.0"
+_LOOPBACK = ("127.0.0.1", "localhost", "::1", "0:0:0:0:0:0:0:1")
 
-def derive_authkey(env_var: str, namespace: str) -> bytes:
+_warned = set()
+
+
+def _digest(namespace: str, tag: str, value: str) -> bytes:
+    import hashlib
+    return hashlib.sha256(
+        (f"paddle_tpu_{namespace}:{tag}={value}").encode()).digest()
+
+
+def authkey_source(env_var: str) -> str:
+    """Human-readable description of where this channel's key comes
+    from — appended to AuthenticationError handling so a key MISMATCH
+    (two roles seeing different job-var subsets) is diagnosable instead
+    of a bare auth failure (advisor r3, low)."""
+    if os.environ.get(env_var):
+        return f"explicit {env_var}"
+    if os.environ.get("PADDLE_JOB_AUTHKEY"):
+        return "launcher-distributed PADDLE_JOB_AUTHKEY"
+    for var in _JOB_VARS:
+        if os.environ.get(var):
+            return (f"derived from {var} (roles seeing a different "
+                    f"subset of {'/'.join(_JOB_VARS)} derive DIFFERENT "
+                    f"keys — export {env_var} or PADDLE_JOB_AUTHKEY to "
+                    "every role)")
+    return "same-user key file (~/.paddle_tpu_*_key)"
+
+
+def _guard_exposed(env_var: str, namespace: str, bind_host: str,
+                   fallback: str):
+    """Non-loopback listener + guessable fallback: refuse (or warn once
+    when explicitly overridden)."""
+    if os.environ.get("PADDLE_ALLOW_DERIVED_AUTHKEY"):
+        key = (namespace, bind_host)
+        if key not in _warned:
+            _warned.add(key)
+            import warnings
+            warnings.warn(
+                f"paddle_tpu.{namespace}: listener on {bind_host!r} is "
+                f"using a {fallback} authkey that a network-adjacent "
+                "observer who knows the job endpoints can compute; "
+                f"set {env_var} (or PADDLE_JOB_AUTHKEY) to a random "
+                "per-job secret for network-exposed channels",
+                RuntimeWarning, stacklevel=3)
+        return
+    raise RuntimeError(
+        f"paddle_tpu.{namespace}: refusing to bind {bind_host!r} with a "
+        f"{fallback} authkey — it is computable from non-secret job "
+        f"metadata. Set {env_var} (or PADDLE_JOB_AUTHKEY) to a random "
+        "per-job secret (the launcher exports one automatically for "
+        "single-node jobs), or set PADDLE_ALLOW_DERIVED_AUTHKEY=1 to "
+        "accept the risk.")
+
+
+def derive_authkey(env_var: str, namespace: str,
+                   bind_host: str | None = None) -> bytes:
+    """bind_host: pass the listener's bind address when deriving a key
+    for a LISTENER; non-loopback binds require an explicit secret (tier
+    1/2). Client-side derivations (connect) omit it."""
     secret = os.environ.get(env_var)
     if secret:
         return secret.encode()
+    job = os.environ.get("PADDLE_JOB_AUTHKEY")
+    if job:
+        return _digest(namespace, "job", job)
+    exposed = (bind_host is not None
+               and bind_host.strip().lower() not in _LOOPBACK)
     for var in _JOB_VARS:
-        job = os.environ.get(var, "")
-        if job:
-            import hashlib
-            return hashlib.sha256(
-                (f"paddle_tpu_{namespace}:{var}={job}").encode()).digest()
+        val = os.environ.get(var, "")
+        if val:
+            if exposed:
+                _guard_exposed(env_var, namespace, bind_host,
+                               f"{var}-derived")
+            return _digest(namespace, var, val)
+    if exposed:
+        _guard_exposed(env_var, namespace, bind_host, "key-file")
     # Bare local runs: a same-user secret file (0600) — other local users
     # cannot read it, unlike anything derivable from uid/source. Creation
     # is atomic (temp + hard link) and creation races settle by

@@ -145,14 +145,17 @@ def barrier(group=None):
 
 _p2p_listener = None
 _p2p_inbox = None
+_p2p_shutdown = None      # threading.Event set by _shutdown_p2p()
 
 
-def _p2p_auth() -> bytes:
+def _p2p_auth(bind_host=None) -> bytes:
     """Per-job secret (see distributed/_auth.py for the full scheme):
-    PADDLE_P2P_AUTHKEY, else derived from the job's published endpoints,
-    else a same-user 0600 key file."""
+    PADDLE_P2P_AUTHKEY, else the launcher's PADDLE_JOB_AUTHKEY, else
+    derived from the job's published endpoints, else a same-user 0600
+    key file. Listeners pass their bind host: non-loopback binds refuse
+    the derivable fallbacks (advisor r3, medium)."""
     from paddle_tpu.distributed._auth import derive_authkey
-    return derive_authkey("PADDLE_P2P_AUTHKEY", "p2p")
+    return derive_authkey("PADDLE_P2P_AUTHKEY", "p2p", bind_host=bind_host)
 
 
 def _p2p_port(rank: int) -> int:
@@ -180,13 +183,36 @@ def _env_world() -> int:
 
 
 def _listener_closed(listener) -> bool:
-    """True once Listener.close() ran (its socket fd is gone). Touches
-    multiprocessing internals, but those have been stable for a decade
-    and the fallback (treat as closed) only stops the accept loop."""
+    """True once the listener is intentionally closed. The explicit
+    shutdown Event — attached to the LISTENER OBJECT, so the PS/RPC
+    accept loops that share this helper for their own listeners are
+    never poisoned by p2p teardown — is authoritative (advisor r3:
+    internals-probing alone would misread any transient accept error as
+    closure if those internals changed); the socket-fileno probe is the
+    SECONDARY signal, and on probe failure the accept loop keeps
+    running — an unexpected exception shape must not silently kill it."""
+    ev = getattr(listener, "_paddle_shutdown", None)
+    if ev is not None and ev.is_set():
+        return True
     try:
         return listener._listener._socket.fileno() == -1
     except Exception:
-        return True
+        return False
+
+
+def _shutdown_p2p():
+    """Close this rank's p2p listener (tests / process teardown): set the
+    explicit closure flag FIRST so the accept loop exits cleanly."""
+    global _p2p_listener, _p2p_inbox
+    if _p2p_shutdown is not None:
+        _p2p_shutdown.set()
+    if _p2p_listener is not None:
+        try:
+            _p2p_listener.close()
+        except OSError:
+            pass
+    _p2p_listener = None
+    _p2p_inbox = None
 
 
 def _ensure_p2p_server():
@@ -194,12 +220,14 @@ def _ensure_p2p_server():
     routed into PER-SENDER FIFO queues at drain time, so concurrent
     recv() calls for different sources neither steal each other's
     messages nor reorder a single sender's stream."""
-    global _p2p_listener, _p2p_inbox
+    global _p2p_listener, _p2p_inbox, _p2p_shutdown
     if _p2p_listener is not None:
         return
     import queue
     import threading
     from multiprocessing.connection import Listener
+
+    _p2p_shutdown = threading.Event()
 
     class _SenderQueues(dict):
         """Lock-guarded per-sender queues: a drain thread and a recv
@@ -217,15 +245,19 @@ def _ensure_p2p_server():
     _p2p_inbox = _SenderQueues()
     # bind this rank's configured interface (loopback unless the launcher
     # published endpoints) — never wildcard
-    _p2p_listener = Listener((_p2p_host(_env_rank()),
-                              _p2p_port(_env_rank())),
-                             authkey=_p2p_auth())
+    _bind = _p2p_host(_env_rank())
+    _p2p_listener = Listener((_bind, _p2p_port(_env_rank())),
+                             authkey=_p2p_auth(bind_host=_bind))
+    _p2p_listener._paddle_shutdown = _p2p_shutdown
 
     def loop():
         lst = _p2p_listener
         while True:
             try:
                 conn = lst.accept()
+                from paddle_tpu.distributed._net import \
+                    enable_nodelay
+                enable_nodelay(conn)
             except Exception:
                 # Exception TYPE can't separate "listener closed" from a
                 # per-connection handshake failure: a peer that drops
@@ -282,6 +314,11 @@ def send(tensor, dst=0, group=None, sync_op=True):
             # through creating the shared key file
             last = e
             _time.sleep(0.1)
+    if isinstance(last, AuthenticationError):
+        from paddle_tpu.distributed._auth import authkey_source
+        raise ConnectionError(
+            f"send to rank {dst} failed: {last} (p2p authkey: "
+            f"{authkey_source('PADDLE_P2P_AUTHKEY')})")
     raise ConnectionError(f"send to rank {dst} failed: {last}")
 
 
@@ -384,4 +421,10 @@ def wait(tensor, group=None, use_calc_stream=True):
 
 
 def destroy_process_group(group=None):
-    pass
+    """ref: paddle.distributed.destroy_process_group. Tears down this
+    rank's host-side p2p channel (the explicit-closure Event makes the
+    accept loop exit cleanly — the shutdown signal _listener_closed
+    treats as authoritative); mesh-axis 'groups' have no teardown, they
+    are names over the global mesh."""
+    if group is None:
+        _shutdown_p2p()

@@ -153,20 +153,46 @@ class MembershipManager:
         from paddle_tpu.distributed._auth import derive_authkey
         return derive_authkey("PADDLE_ELASTIC_AUTHKEY", "elastic")
 
+    @property
+    def _AUTH_LISTEN(self) -> bytes:
+        """Listener-side key: passes the bind host so non-loopback
+        masters refuse derivable fallbacks (advisor r3, medium)."""
+        from paddle_tpu.distributed._auth import derive_authkey
+        return derive_authkey("PADDLE_ELASTIC_AUTHKEY", "elastic",
+                              bind_host=self._addr(self.master_endpoint)[0])
+
     # -- master side --------------------------------------------------------
     def start_master(self):
         import threading
         from multiprocessing.connection import Listener
 
         self._listener = Listener(self._addr(self.master_endpoint),
-                                  authkey=self._AUTH)
+                                  authkey=self._AUTH_LISTEN)
 
         def serve():
             while not self._stop.is_set():
                 try:
                     conn = self._listener.accept()
-                except (OSError, EOFError):
-                    return
+                    from paddle_tpu.distributed._net import \
+                        enable_nodelay
+                    enable_nodelay(conn)
+                except Exception:
+                    # one failed handshake (AuthenticationError from a
+                    # port scan / stale key) must NOT kill the heartbeat
+                    # thread — dead heartbeats would TTL-expire every
+                    # worker and trigger a spurious cluster relaunch.
+                    # Only an intentional stop or a DEAD listener exits
+                    # (without the fd probe a dead listener would spin
+                    # at ~50 accept-errors/s forever).
+                    if self._stop.is_set():
+                        return
+                    try:
+                        if self._listener._listener._socket.fileno() == -1:
+                            return
+                    except Exception:
+                        pass
+                    time.sleep(0.02)
+                    continue
                 try:
                     msg = conn.recv()
                     if msg[0] == "beat":

@@ -62,6 +62,14 @@ def _bootstrap_env(args):
     # paddle-compat env names, read by ParallelEnv (env.py)
     env["PADDLE_TRAINER_ID"] = str(args.rank)
     env["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
+    # single-node jobs: generate a RANDOM per-job channel secret and
+    # distribute it to every spawned role (advisor r3, medium — the
+    # endpoint-derived fallback keys are computable by an observer).
+    # Multi-node jobs can't agree on a random key without a secure
+    # channel: the operator must export PADDLE_JOB_AUTHKEY themselves.
+    if args.nnodes == 1 and "PADDLE_JOB_AUTHKEY" not in env:
+        import secrets
+        env["PADDLE_JOB_AUTHKEY"] = secrets.token_hex(32)
     return env
 
 

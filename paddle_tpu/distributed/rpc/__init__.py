@@ -21,11 +21,13 @@ from typing import Any, Dict, Optional
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
 
-def _AUTH() -> bytes:
+def _AUTH(bind_host=None) -> bytes:
     """Per-job secret (distributed/_auth.py) — never a source constant
-    (authenticated-pickle channel = RCE to anyone holding the key)."""
+    (authenticated-pickle channel = RCE to anyone holding the key).
+    Listeners pass bind_host: non-loopback binds refuse the derivable
+    fallbacks (advisor r3, medium)."""
     from paddle_tpu.distributed._auth import derive_authkey
-    return derive_authkey("PADDLE_RPC_AUTHKEY", "rpc")
+    return derive_authkey("PADDLE_RPC_AUTHKEY", "rpc", bind_host=bind_host)
 
 
 @dataclass
@@ -59,6 +61,8 @@ def _serve_loop(listener):
     while not _state.stop.is_set():
         try:
             conn = listener.accept()
+            from paddle_tpu.distributed._net import enable_nodelay
+            enable_nodelay(conn)
         except Exception:
             # a peer dropping mid-handshake (port scan, stale key)
             # raises AuthenticationError/EOFError/ConnectionResetError —
@@ -122,20 +126,30 @@ def init_rpc(name: str, rank: int = None, world_size: int = None,
 
     # my serving endpoint: the master endpoint for rank 0, an ephemeral
     # port otherwise
+    mhost = _addr(master_endpoint)[0]
+    local_job = mhost.strip().lower() in ("127.0.0.1", "localhost", "::1")
     if rank == 0:
-        listener = Listener(_addr(master_endpoint), authkey=_AUTH())
+        listener = Listener(_addr(master_endpoint),
+                            authkey=_AUTH(bind_host=mhost))
         my_ep = master_endpoint
     else:
-        # bind all interfaces; advertise a cross-host-reachable address
-        # (PADDLE_LOCAL_IP overrides; hostname lookup fallback)
+        # a loopback master means a single-host job: bind loopback too
+        # (no wildcard exposure). Cross-host jobs bind all interfaces and
+        # advertise a reachable address (PADDLE_LOCAL_IP overrides;
+        # hostname lookup fallback) — the authkey guard then requires an
+        # explicit per-job secret.
         import socket as _socket
-        listener = Listener(("0.0.0.0", 0), authkey=_AUTH())
+        bind = "127.0.0.1" if local_job else "0.0.0.0"
+        listener = Listener((bind, 0), authkey=_AUTH(bind_host=bind))
         host = os.environ.get("PADDLE_LOCAL_IP")
         if not host:
-            try:
-                host = _socket.gethostbyname(_socket.gethostname())
-            except OSError:
+            if local_job:
                 host = "127.0.0.1"
+            else:
+                try:
+                    host = _socket.gethostbyname(_socket.gethostname())
+                except OSError:
+                    host = "127.0.0.1"
         my_ep = "%s:%d" % (host, listener.address[1])
     _state.listener = listener
     _state.me = WorkerInfo(name, rank, my_ep)
@@ -187,10 +201,16 @@ def _connect_with_retry(addr, timeout_s: float):
     wait = 0.05
     while True:
         try:
-            return Client(addr, authkey=_AUTH())
-        except AuthenticationError:
+            c = Client(addr, authkey=_AUTH())
+            from paddle_tpu.distributed._net import enable_nodelay
+            enable_nodelay(c)
+            return c
+        except AuthenticationError as e:
             if time.time() > start + 2.0:
-                raise
+                from paddle_tpu.distributed._auth import authkey_source
+                raise AuthenticationError(
+                    f"{e or 'digest mismatch'} (rpc authkey: "
+                    f"{authkey_source('PADDLE_RPC_AUTHKEY')})") from e
         except (ConnectionError, OSError) as e:
             if time.time() > deadline:
                 raise ConnectionError(
