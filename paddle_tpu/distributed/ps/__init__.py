@@ -26,13 +26,15 @@ import numpy as np
 __all__ = ["SGDRule", "AdagradRule", "AdamRule", "DenseTable", "SparseTable",
            "ParameterServer", "PSClient", "run_server"]
 
-def _auth() -> bytes:
+def _auth(bind_host=None) -> bytes:
     """Per-job secret (distributed/_auth.py): PADDLE_PS_AUTHKEY, else
-    derived from the job's published endpoints, else a same-user 0600
-    key file — never a source-code constant (pickle channel = RCE to
-    anyone holding the key)."""
+    the launcher's PADDLE_JOB_AUTHKEY, else derived from the job's
+    published endpoints, else a same-user 0600 key file — never a
+    source-code constant (pickle channel = RCE to anyone holding the
+    key). Listeners pass bind_host: non-loopback binds refuse the
+    derivable fallbacks (advisor r3, medium)."""
     from paddle_tpu.distributed._auth import derive_authkey
-    return derive_authkey("PADDLE_PS_AUTHKEY", "ps")
+    return derive_authkey("PADDLE_PS_AUTHKEY", "ps", bind_host=bind_host)
 
 
 # explicit service surface: the wire protocol may only invoke these —
@@ -238,13 +240,17 @@ class ParameterServer:
         thread — a bounded pool would deadlock at barrier() once workers
         outnumber threads."""
         host, port = endpoint.rsplit(":", 1)
-        self._listener = Listener((host, int(port)), authkey=_auth())
+        self._listener = Listener((host, int(port)),
+                                  authkey=_auth(bind_host=host))
 
         def loop():
             from paddle_tpu.distributed.collective import _listener_closed
             while not self._stop.is_set():
                 try:
                     conn = self._listener.accept()
+                    from paddle_tpu.distributed._net import \
+                        enable_nodelay
+                    enable_nodelay(conn)
                 except Exception:
                     # a failed handshake (AuthenticationError / EOFError /
                     # ConnectionResetError from a port scan or wrong key)
@@ -322,7 +328,11 @@ class PSClient:
             last = None
             for _ in range(retries):
                 try:
-                    self._conn = Client((host, int(port)), authkey=_auth())
+                    self._conn = Client((host, int(port)),
+                                        authkey=_auth())
+                    from paddle_tpu.distributed._net import \
+                        enable_nodelay
+                    enable_nodelay(self._conn)
                     break
                 except (ConnectionError, OSError, AuthenticationError) as e:
                     # AuthenticationError can be transient: a peer midway
@@ -330,7 +340,13 @@ class PSClient:
                     last = e
                     time.sleep(0.1)
             if self._conn is None:
-                raise ConnectionError(f"PS at {endpoint} unreachable: {last}")
+                hint = ""
+                if isinstance(last, AuthenticationError):
+                    from paddle_tpu.distributed._auth import authkey_source
+                    hint = (" (ps authkey: "
+                            f"{authkey_source('PADDLE_PS_AUTHKEY')})")
+                raise ConnectionError(
+                    f"PS at {endpoint} unreachable: {last}{hint}")
 
     def _call(self, op, *args):
         if self._local is not None:
@@ -434,9 +450,9 @@ class SSDSparseTable(SparseTable):
     def _spill(self, i: int):
         self._spill_many([i])
 
-    def _fault_in(self, i: int):
-        f = self._shard_file(i)
-        data = self._load_shard(f)
+    def _restore_row(self, i: int, data: dict):
+        """Rebuild rows[i]/states[i] from a loaded shard dict — the ONE
+        copy of the on-disk encoding (r{i} value, s{i}:<k> states)."""
         self.rows[i] = np.asarray(data[f"r{i}"], np.float32)
         st = {}
         for k in data:
@@ -445,6 +461,9 @@ class SSDSparseTable(SparseTable):
                 st[k.split(":", 1)[1]] = (v.item() if v.ndim == 0 else v)
         self.states[i] = st or self.rule.init_state((self.dim,))
         self._on_disk.discard(i)
+
+    def _fault_in(self, i: int):
+        self._restore_row(i, self._load_shard(self._shard_file(i)))
 
     def _touch(self, i: int):
         self._lru.pop(i, None)
@@ -455,6 +474,32 @@ class SSDSparseTable(SparseTable):
             n_evict = len(self._lru) - (self.cache_rows * 7 // 8)
             it = iter(self._lru)
             self._spill_many([next(it) for _ in range(n_evict)])
+
+    def _fault_many(self, ids):
+        """Batch fault-in grouped by shard: a 256-id pull touching 16
+        shards costs 16 shard loads, not 256 (the same amortization
+        _spill_many gives the write side)."""
+        need = [int(i) for i in ids if int(i) in self._on_disk]
+        if not need:
+            return
+        by_shard: Dict[str, list] = {}
+        for i in need:
+            by_shard.setdefault(self._shard_file(i), []).append(i)
+        for f, rows in by_shard.items():
+            data = self._load_shard(f)
+            for i in rows:
+                self._restore_row(i, data)
+
+    def pull(self, ids) -> np.ndarray:
+        with self.lock:
+            self._fault_many(np.unique(np.asarray(ids, np.int64)))
+        return super().pull(ids)     # re-takes the lock; per-row _row
+                                     # fault-in covers eviction races
+
+    def push(self, ids, grads):
+        with self.lock:
+            self._fault_many(np.unique(np.asarray(ids, np.int64)))
+        return super().push(ids, grads)
 
     def _row(self, i: int) -> np.ndarray:
         if i in self._on_disk:

@@ -1,0 +1,40 @@
+"""Standalone PS server process (VERDICT r3 #8: a PS run with the
+server in a SEPARATE process over TCP — the closest single-machine
+equivalent of the reference's multi-host brpc PS deployment).
+
+argv: endpoint out_dir. Serves one dense table + one SSD sparse table
+until a client calls stop."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from paddle_tpu.distributed.ps import (SGDRule, SSDSparseTable,
+                                       ParameterServer)
+
+
+def main():
+    endpoint, out_dir = sys.argv[1], sys.argv[2]
+    ps = ParameterServer()
+    # lr=1.0: the worker scales its own step size into the pushed grad
+    ps.create_dense_table("w", (8,), rule=SGDRule(1.0),
+                          initializer=lambda sh: np.zeros(sh, np.float32))
+    # SSD table with a tiny cache so the spill path runs cross-process
+    ps.tables["emb"] = SSDSparseTable(
+        4, rule="sgd", path=os.path.join(out_dir, "ssd"), cache_rows=8,
+        shards=4)
+    ps.serve(endpoint)
+    with open(os.path.join(out_dir, "server_up"), "w") as f:
+        f.write(endpoint)
+    import time
+    while not ps._stop.is_set():
+        time.sleep(0.05)
+    with open(os.path.join(out_dir, "server_done"), "w") as f:
+        f.write("ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Parameter-server benchmark (VERDICT r3 #8: 'decide and bound' — the
+bounded Python PS gets a MEASURED characterization so its limits are a
+recorded fact, not a guess; ref: the reference's brpc PS is benchmarked
+by its own CI, fluid/distributed/ps/).
+
+Measures host-side table throughput (the PS is a host component — CPU
+numbers are its real numbers):
+  - dense pull/push (SGD apply)
+  - in-memory sparse pull/push (row-hash table)
+  - SSD sparse pull/push at a cache size forcing disk spill (LRU +
+    per-shard npz faulting)
+  - socket round-trip pull/push (authenticated pickle channel)
+
+Writes benchmarks/PS_BENCH.json and prints one JSON line per metric.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.distributed.ps import (DenseTable, ParameterServer,
+                                       PSClient, SparseTable,
+                                       SSDSparseTable)
+
+
+def _time_ops(fn, iters):
+    fn()  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_dense(dim=4096, iters=200):
+    t = DenseTable((dim,), rule="sgd")
+    g = np.ones(dim, np.float32)
+
+    pull = _time_ops(lambda: t.pull(), iters)
+    push = _time_ops(lambda: t.push(g), iters)
+    return {"dense_pull_us": pull * 1e6, "dense_push_us": push * 1e6,
+            "dim": dim}
+
+
+def bench_sparse(emb_dim=64, batch_ids=256, vocab=100_000, iters=100):
+    t = SparseTable(emb_dim, rule="adagrad")
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, batch_ids)
+    g = rng.standard_normal((batch_ids, emb_dim)).astype(np.float32)
+
+    pull = _time_ops(lambda: t.pull(ids), iters)
+    push = _time_ops(lambda: t.push(ids, g), iters)
+    return {"sparse_pull_rows_per_s": batch_ids / pull,
+            "sparse_push_rows_per_s": batch_ids / push,
+            "emb_dim": emb_dim, "batch_ids": batch_ids}
+
+
+def bench_ssd(emb_dim=64, batch_ids=256, vocab=8_000, cache_rows=1_000,
+              iters=10):
+    """cache_rows << vocab so most batches fault rows from disk — the
+    spill path is what this measures."""
+    with tempfile.TemporaryDirectory() as d:
+        t = SSDSparseTable(emb_dim, rule="adagrad", path=d,
+                           cache_rows=cache_rows, shards=16)
+        rng = np.random.default_rng(1)
+        # populate beyond cache: force spill
+        for start in range(0, vocab, batch_ids):
+            ids = np.arange(start, min(start + batch_ids, vocab))
+            t.push(ids, np.zeros((len(ids), emb_dim), np.float32))
+
+        def rand_pull():
+            t.pull(rng.integers(0, vocab, batch_ids))
+
+        def rand_push():
+            ids = rng.integers(0, vocab, batch_ids)
+            t.push(ids, np.ones((batch_ids, emb_dim), np.float32))
+
+        pull = _time_ops(rand_pull, iters)
+        push = _time_ops(rand_push, iters)
+        return {"ssd_pull_rows_per_s": batch_ids / pull,
+                "ssd_push_rows_per_s": batch_ids / push,
+                "cache_rows": cache_rows, "vocab": vocab,
+                "emb_dim": emb_dim}
+
+
+def bench_socket(dim=4096, iters=100):
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    ep = f"127.0.0.1:{port}"
+    ps = ParameterServer()
+    ps.create_dense_table("w", (dim,), rule="sgd")
+    ps.serve(ep)
+    try:
+        c = PSClient(endpoint=ep)
+        g = np.ones(dim, np.float32)
+        pull = _time_ops(lambda: c.pull_dense("w"), iters)
+        push = _time_ops(lambda: c.push_dense("w", g), iters)
+        c.close()
+    finally:
+        ps.shutdown()
+    return {"socket_pull_us": pull * 1e6, "socket_push_us": push * 1e6,
+            "socket_dense_mbps": dim * 4 / pull / 1e6, "dim": dim}
+
+
+def main():
+    out = {
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "note": ("host-side Python PS characterization; the reference's "
+                 "brpc/RocksDB PS targets ~100x these rates — see README "
+                 "'Parameter-server scope'"),
+    }
+    out.update(bench_dense())
+    out.update(bench_sparse())
+    out.update(bench_ssd())
+    out.update(bench_socket())
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks", "PS_BENCH.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
