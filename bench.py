@@ -51,6 +51,7 @@ def _emit_stale_or_cpu(reason: str):
     eligible — a wedged bert run must not report a llama number.
     Never returns."""
     want = os.environ.get("BENCH_MODEL")
+    max_age_days = float(os.environ.get("BENCH_STALE_MAX_AGE_DAYS", "14"))
     if not os.environ.get("BENCH_NO_STALE"):
         for path in (_LAST_GOOD,
                      os.path.join(os.path.dirname(_LAST_GOOD),
@@ -70,11 +71,34 @@ def _emit_stale_or_cpu(reason: str):
             elif not (metric.startswith("llama_350m")
                       or metric.startswith("llama_1b")):
                 continue
+            # age gate (advisor r3): repeated wedged sessions must not
+            # re-report one old number forever — past the age limit the
+            # record is noise, fall through to the CPU smoke line
+            measured = rec.get("extra", {}).get("measured_at")
+            if measured:
+                try:
+                    import calendar
+                    # timestamp is UTC ("Z"): parse with timegm, not the
+                    # local-time mktime
+                    age_s = time.time() - calendar.timegm(
+                        time.strptime(measured, "%Y-%m-%dT%H:%M:%SZ"))
+                    if age_s > max_age_days * 86400:
+                        print(f"bench: last-good artifact {path} is "
+                              f"{age_s / 86400:.1f} days old (> "
+                              f"{max_age_days}); refusing stale re-emit",
+                              file=sys.stderr)
+                        continue
+                except ValueError:
+                    pass
             rec.setdefault("extra", {})
             rec["extra"]["stale"] = True
             rec["extra"]["stale_reason"] = (
                 f"{reason}; re-emitting last verified on-chip "
                 f"measurement from {os.path.basename(path)}")
+            # suffix the metric so consumers reading metric/value alone
+            # cannot mistake a re-emit for a fresh run (advisor r3)
+            if not rec["metric"].endswith("_stale"):
+                rec["metric"] = rec["metric"] + "_stale"
             print(f"bench: {reason}; emitting stale last-good on-chip "
                   f"artifact {path}", file=sys.stderr)
             print(json.dumps(rec))

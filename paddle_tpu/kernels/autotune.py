@@ -97,25 +97,53 @@ def lookup(key: str):
     return None
 
 
-def record(key: str, best, timings_ms: Optional[Dict[str, float]] = None):
-    """Persist a sweep winner to the user cache (atomic rename)."""
-    global _user_cache
-    path = _user_cache_path()
-    with _lock:
-        if _user_cache is None:
-            _user_cache = _load(path)
-        _user_cache[key] = {"best": best}
-        if timings_ms:
-            _user_cache[key]["timings_ms"] = {
-                k: round(v, 4) for k, v in timings_ms.items()}
-        _memo[key] = best
+def _update_file(path: str, mutate) -> Dict[str, Any]:
+    """Cross-PROCESS-safe read-modify-write of the user cache (advisor
+    r3: two parallel sweep processes sharing PADDLE_AUTOTUNE_CACHE must
+    not drop each other's winners): an fcntl flock serializes
+    reload -> mutate -> atomic replace; where flock is unavailable the
+    reload-merge still shrinks the race to the write itself (instead of
+    trusting a stale in-memory snapshot)."""
+    lock_path = path + ".lock"
+    lf = None
+    try:
+        lf = open(lock_path, "a+")
+        import fcntl
+        fcntl.flock(lf, fcntl.LOCK_EX)
+    except (OSError, ImportError):
+        pass
+    try:
+        disk = _load(path)
+        out = mutate(disk)
         tmp = path + ".tmp"
         try:
             with open(tmp, "w") as f:
-                json.dump(_user_cache, f, indent=1, sort_keys=True)
+                json.dump(out, f, indent=1, sort_keys=True)
             os.replace(tmp, path)
         except OSError:
             pass
+        return out
+    finally:
+        if lf is not None:
+            lf.close()       # releases the flock
+
+
+def record(key: str, best, timings_ms: Optional[Dict[str, float]] = None):
+    """Persist a sweep winner to the user cache (merge-on-write under an
+    OS-level lock, atomic rename)."""
+    global _user_cache
+    path = _user_cache_path()
+    entry: Dict[str, Any] = {"best": best}
+    if timings_ms:
+        entry["timings_ms"] = {k: round(v, 4)
+                               for k, v in timings_ms.items()}
+    with _lock:
+        def mutate(disk):
+            disk[key] = entry
+            return disk
+
+        _user_cache = _update_file(path, mutate)
+        _memo[key] = best
 
 
 def forget(key: str):
@@ -124,17 +152,12 @@ def forget(key: str):
     path = _user_cache_path()
     with _lock:
         _memo.pop(key, None)
-        if _user_cache is None:
-            _user_cache = _load(path)
-        if key in _user_cache:
-            _user_cache.pop(key)
-            tmp = path + ".tmp"
-            try:
-                with open(tmp, "w") as f:
-                    json.dump(_user_cache, f, indent=1, sort_keys=True)
-                os.replace(tmp, path)
-            except OSError:
-                pass
+
+        def mutate(disk):
+            disk.pop(key, None)
+            return disk
+
+        _user_cache = _update_file(path, mutate)
 
 
 def _time_candidate(fn: Callable[[], Any], iters: int) -> float:
