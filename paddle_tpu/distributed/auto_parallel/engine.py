@@ -109,39 +109,85 @@ class Engine:
         return estimate_config_cost(self._model_stats(), cfg, global_batch,
                                     hw or TPU_V4_LIKE)
 
+    def _flat_forward(self, example_args):
+        """Shared scaffolding for complete()/propagate() (ONE copy of
+        the model-flattening + fwd-closure convention, so the rule-based
+        report and the GSPMD ground truth can never diverge on state
+        handling): returns (keys, vals, data, fwd) with
+        fwd(*params_then_data) pure."""
+        import jax
+
+        from ...framework import core
+        from ...tensor import Tensor as _T
+        model = self.model
+        sd = model.state_dict()
+        keys = list(sd.keys())
+        vals = [t.data for t in sd.values()]
+        data = [a.data if isinstance(a, _T) else np.asarray(a)
+                for a in example_args]
+
+        def fwd(*flat):
+            params = flat[:len(keys)]
+            xs = flat[len(keys):]
+            state = dict(zip(keys, params))
+            with model.use_state(state), core.no_grad_guard():
+                out = model(*[_T(x) for x in xs])
+            return jax.tree.map(
+                lambda t: t.data if isinstance(t, _T) else t, out)
+
+        return keys, vals, data, fwd
+
     def complete(self, *example_args):
         """Expose the completion pass on this engine's forward function
         (ref completion.py Completer): parameters are seeded with the
         ShardingPlan's specs (TP annotations + ZeRO-3 FSDP decisions),
         data args with the batch spec, and the report shows what GSPMD
         propagated onto every remaining tensor."""
-        import jax
-
-        from ...framework import core
-        from ...tensor import Tensor
         from .completion import complete as _complete
         if self._step is None:
             self.prepare()
         plan = self._plan
-        model = self.model
-        sd = model.state_dict()
-        keys = list(sd.keys())
-        vals = [t.data for t in sd.values()]
-
-        def fwd(params, *xs):
-            state = dict(zip(keys, params))
-            with model.use_state(state), core.no_grad_guard():
-                out = model(*[Tensor(x) for x in xs])
-            return jax.tree.map(
-                lambda t: t.data if isinstance(t, Tensor) else t, out)
-
+        keys, vals, data, fwd = self._flat_forward(example_args)
         param_specs = [plan.param_spec(k, v) for k, v in zip(keys, vals)]
-        import numpy as _np
-        data = [a.data if isinstance(a, Tensor) else _np.asarray(a)
-                for a in example_args]
         data_specs = [plan.batch_spec(x) for x in data]
-        return _complete(fwd, (vals, *data), self._mesh,
+        return _complete(fwd, (*vals, *data), self._mesh,
                          in_specs=param_specs + data_specs)
+
+    def propagate(self, *example_args):
+        """Rule-based whole-graph propagation under this engine's plan —
+        the COMPILE-FREE counterpart of complete() (ref completion.py
+        Completer.complete_forward_annotation): DistAttrs are seeded
+        from the ShardingPlan's parameter/batch specs, the spmd rules
+        walk the model's entire jaxpr, and the report carries every
+        predicted reshard with its byte price plus pending partials.
+        complete() then shows what GSPMD ACTUALLY chose — the agreement
+        tests pin the two together."""
+        from .propagation import propagate_jaxpr
+        from .spmd_rules import DistAttr
+        if self._step is None:
+            self.prepare()
+        plan = self._plan
+        keys, vals, data, fwd = self._flat_forward(example_args)
+        mesh_shape = dict(self._mesh.shape)
+
+        def spec_to_attr(spec, ndim):
+            names = list(spec) if spec is not None else []
+            dm = []
+            for i in range(ndim):
+                e = names[i] if i < len(names) else None
+                if isinstance(e, (tuple, list)):
+                    tok = "+".join(e)
+                    mesh_shape.setdefault(tok, int(np.prod(
+                        [self._mesh.shape[a] for a in e])))
+                    dm.append(tok)
+                else:
+                    dm.append(e)
+            return DistAttr(dm)
+
+        attrs = [spec_to_attr(plan.param_spec(k, v), v.ndim)
+                 for k, v in zip(keys, vals)]
+        attrs += [spec_to_attr(plan.batch_spec(x), x.ndim) for x in data]
+        return propagate_jaxpr(fwd, (*vals, *data), attrs, mesh_shape)
 
     def prepare(self, inputs_spec=None, labels_spec=None, mode="train",
                 global_batch=None):

@@ -131,7 +131,10 @@ class Propagator:
             if key in eqn.params:
                 inner = eqn.params[key]
                 break
-        if inner is not None and name not in ("scan", "while", "cond"):
+        if name == "scan" and inner is not None:
+            self._scan(eqn, ins, env, inner)
+            return
+        if inner is not None and name not in ("while", "cond"):
             ij = inner.jaxpr if hasattr(inner, "jaxpr") else inner
             sub = Propagator(self.mesh_shape, self.elem_bytes)
             outs = sub.run(ij, ins[:len(ij.invars)])
@@ -178,6 +181,14 @@ class Propagator:
             rs, out = concat_rule(ins, eqn.params["dimension"])
             for a, r, av in zip(ins, rs, avals):
                 self._reshard(name, a, r, av)
+        elif name == "split":
+            from .spmd_rules import split_rule
+            rx, outs_attrs = split_rule(ins[0], eqn.params["axis"],
+                                        len(eqn.outvars))
+            self._reshard(name, ins[0], rx, avals[0])
+            for v, a in zip(eqn.outvars, outs_attrs):
+                env[v] = a
+            return
         elif name == "slice":
             full = [
                 i for i in range(len(avals[0].shape))
@@ -209,6 +220,15 @@ class Propagator:
                                  set(out_x.partial)))
         elif name == "softmax":  # jax lowers via exp/reduce; kept for compat
             _, out = softmax_rule(ins[0])
+        elif name == "pad":
+            cfg = eqn.params["padding_config"]
+            dm = [a if lo == 0 and hi == 0 and inner == 0 else None
+                  for a, (lo, hi, inner) in zip(ins[0].dims_mapping, cfg)]
+            rx = DistAttr(dm, set(ins[0].partial))
+            self._reshard(name, ins[0], rx, avals[0])
+            out = DistAttr(list(dm), set(ins[0].partial))
+        elif name == "gather":
+            out = self._gather(eqn, ins, avals, out_avals)
         elif name == "iota":
             out = DistAttr.replicated(len(out_avals[0].shape))
         else:
@@ -223,6 +243,79 @@ class Propagator:
         outs = [out] if isinstance(out, DistAttr) else list(out)
         for v, a in zip(eqn.outvars, outs):
             env[v] = a
+
+    def _scan(self, eqn, ins, env, inner):
+        """lax.scan (the stacked-layer pattern): propagate the body to a
+        FIXPOINT on the carry — a carry position whose sharding changes
+        across one iteration is widened to the meet (replicated where
+        they disagree), exactly how the reference's completion iterates
+        a while-body. xs lose their leading scan dim on the way in; ys
+        gain a replicated leading dim on the way out."""
+        ij = inner.jaxpr if hasattr(inner, "jaxpr") else inner
+        nc = eqn.params.get("num_consts", 0)
+        nk = eqn.params.get("num_carry", 0)
+        consts = ins[:nc]
+        carry = list(ins[nc:nc + nk])
+        xs = ins[nc + nk:]
+        xs_body = [DistAttr(list(a.dims_mapping[1:]), set(a.partial))
+                   for a in xs]
+        outs = None
+        sub = None
+        for _ in range(8):                      # monotone: terminates
+            sub = Propagator(self.mesh_shape, self.elem_bytes)
+            outs = sub.run(ij, list(consts) + carry + xs_body)
+            new_carry = outs[:nk]
+            widened = []
+            stable = True
+            for old, new in zip(carry, new_carry):
+                dm = [a if a == b else None
+                      for a, b in zip(old.dims_mapping, new.dims_mapping)]
+                if dm != old.dims_mapping:
+                    stable = False
+                widened.append(DistAttr(dm, set(old.partial)
+                                        | set(new.partial)))
+            carry = widened
+            if stable:
+                break
+        # keep the LAST iteration's reshard bill + unknowns whether or
+        # not the fixpoint converged — a non-converged scan must not
+        # report zero cost / zero unknowns (that would pass coverage
+        # gates vacuously)
+        if sub is not None:
+            self.reshards.extend(sub.reshards)
+            for k, v in sub.unknown.items():
+                self.unknown[k] = self.unknown.get(k, 0) + v
+        ys = [DistAttr([None] + list(a.dims_mapping), set(a.partial))
+              for a in outs[nk:]]
+        for v, a in zip(eqn.outvars, list(carry) + ys):
+            env[v] = a
+
+    def _gather(self, eqn, ins, avals, out_avals) -> DistAttr:
+        """Embedding-style gather (jnp.take along axis 0 — the pattern
+        model embeddings and rope cos/sin lookups lower to) maps to the
+        embedding rule; other gather shapes fall back to replicated."""
+        from .spmd_rules import embedding_rule
+        dn = eqn.params.get("dimension_numbers")
+        slice_sizes = eqn.params.get("slice_sizes")
+        x, idx = ins[0], ins[1]
+        table_aval = avals[0]
+        if (dn is not None and slice_sizes is not None
+                and tuple(dn.collapsed_slice_dims) == (0,)
+                and tuple(dn.start_index_map) == (0,)
+                and slice_sizes[0] == 1
+                and tuple(slice_sizes[1:]) == tuple(table_aval.shape[1:])
+                and x.ndim == 2):
+            # idx attrs: gather indices carry a trailing size-1 coord dim
+            idx_dm = list(idx.dims_mapping)
+            if len(idx_dm) and eqn.invars[1].aval.shape[-1] == 1:
+                idx_dm = idx_dm[:-1]
+            (rt, _), out = embedding_rule(x, DistAttr(idx_dm,
+                                                      set(idx.partial)))
+            self._reshard("gather", x, rt, table_aval)
+            return out
+        self.unknown[eqn.primitive.name] = \
+            self.unknown.get(eqn.primitive.name, 0) + 1
+        return DistAttr.replicated(len(out_avals[0].shape))
 
     def _dot_general(self, eqn, ins, avals) -> DistAttr:
         """Generalized matmul rule over dot_general dimension numbers

@@ -25,8 +25,13 @@ __all__ = ["DistAttr", "matmul_rule", "embedding_rule", "layer_norm_rule",
            "flash_attention_rule", "elementwise_rule", "reduction_rule",
            "softmax_rule", "transpose_rule", "reshape_rule", "concat_rule",
            "split_rule", "slice_rule", "cross_entropy_rule",
-           "fused_rope_rule", "scatter_rule", "register_rule",
-           "reshard_cost_bytes"]
+           "fused_rope_rule", "scatter_rule", "squeeze_rule",
+           "unsqueeze_rule", "flatten_rule", "stack_rule", "tile_rule",
+           "triu_rule", "where_rule", "cast_rule", "scale_rule",
+           "pow_rule", "full_like_rule", "numel_rule", "rms_norm_rule",
+           "replicated_rule", "default_data_parallel_rule",
+           "optimizer_rule", "fused_linear_param_grad_add_rule",
+           "register_rule", "reshard_cost_bytes"]
 
 
 @dataclass
@@ -432,6 +437,197 @@ def scatter_rule(x: DistAttr, index: DistAttr, updates: DistAttr
     return (rx, ridx, rupd), out
 
 
+def squeeze_rule(x: DistAttr, axes: Sequence[int]
+                 ) -> Tuple[DistAttr, DistAttr]:
+    """ref: spmd_rules/squeeze.cc — removed unit dims drop from the
+    mapping; everything else carries."""
+    cut = {a % x.ndim for a in axes}
+    rx = DistAttr(list(x.dims_mapping), set(x.partial))
+    out = DistAttr([a for i, a in enumerate(x.dims_mapping)
+                    if i not in cut], set(x.partial))
+    return rx, out
+
+
+def unsqueeze_rule(x: DistAttr, axes: Sequence[int]
+                   ) -> Tuple[DistAttr, DistAttr]:
+    """ref: spmd_rules/unsqueeze.cc — inserted unit dims are replicated."""
+    nd_out = x.ndim + len(axes)
+    add = sorted(a % nd_out for a in axes)
+    dm = list(x.dims_mapping)
+    for a in add:
+        dm.insert(a, None)
+    rx = DistAttr(list(x.dims_mapping), set(x.partial))
+    return rx, DistAttr(dm, set(x.partial))
+
+
+def flatten_rule(x: DistAttr, src_shape: Sequence[int],
+                 start_axis: int = 0, stop_axis: int = -1,
+                 mesh_shape: Optional[dict] = None
+                 ) -> Tuple[DistAttr, DistAttr]:
+    """ref: spmd_rules/flatten.cc — a reshape that merges
+    [start_axis, stop_axis]; reuses the reshape factor-group logic."""
+    nd = x.ndim
+    s = start_axis % nd
+    e = stop_axis % nd
+    merged = 1
+    for d in src_shape[s:e + 1]:
+        merged *= d
+    dst = list(src_shape[:s]) + [merged] + list(src_shape[e + 1:])
+    return reshape_rule(x, src_shape, dst, mesh_shape)
+
+
+def stack_rule(xs: Sequence[DistAttr], axis: int
+               ) -> Tuple[Tuple[DistAttr, ...], DistAttr]:
+    """ref: spmd_rules/stack.cc — operand dims merge; the NEW stacked
+    dim is replicated."""
+    nd = xs[0].ndim
+    dm: List[Optional[str]] = [None] * nd
+    for x in xs:
+        for i, a in enumerate(x.dims_mapping):
+            dm[i] = _merge(dm[i], a)
+    partial = set().union(*(x.partial for x in xs))
+    rs = tuple(DistAttr(list(dm), set(x.partial)) for x in xs)
+    ax = axis % (nd + 1)
+    out = list(dm)
+    out.insert(ax, None)
+    return rs, DistAttr(out, partial)
+
+
+def tile_rule(x: DistAttr, repeat_times: Sequence[int]
+              ) -> Tuple[DistAttr, DistAttr]:
+    """ref: spmd_rules/tile.cc — a dim repeated more than once cannot
+    stay sharded (copies would interleave across shards); repeat-1 dims
+    carry. Repeats align to TRAILING dims (paddle promotes a short
+    repeat_times by prepending 1s); extra leading repeats add replicated
+    dims."""
+    extra = len(repeat_times) - x.ndim
+    reps = ([1] * (-extra) + list(repeat_times) if extra < 0
+            else list(repeat_times))
+    rx_dm = list(x.dims_mapping)
+    out_dm: List[Optional[str]] = [None] * max(extra, 0)
+    for i, a in enumerate(x.dims_mapping):
+        r = reps[max(extra, 0) + i]
+        if r == 1:
+            out_dm.append(a)
+        else:
+            out_dm.append(None)
+            rx_dm[i] = None
+    return DistAttr(rx_dm, set(x.partial)), DistAttr(out_dm, set(x.partial))
+
+
+def triu_rule(x: DistAttr) -> Tuple[DistAttr, DistAttr]:
+    """ref: spmd_rules/triu.cc — the masked last two dims must be
+    replicated; batch dims carry."""
+    dm = list(x.dims_mapping)
+    dm[-1] = None
+    dm[-2] = None
+    rx = DistAttr(dm, set(x.partial))
+    return rx, DistAttr(list(dm), set(x.partial))
+
+
+def where_rule(cond: DistAttr, x: DistAttr, y: DistAttr
+               ) -> Tuple[Tuple[DistAttr, DistAttr, DistAttr], DistAttr]:
+    """ref: spmd_rules/where.cc — ternary broadcast elementwise."""
+    return elementwise_rule(cond, x, y)
+
+
+def cast_rule(x: DistAttr) -> Tuple[DistAttr, DistAttr]:
+    """ref: spmd_rules/cast.cc — identity propagation."""
+    rx = DistAttr(list(x.dims_mapping), set(x.partial))
+    return rx, DistAttr(list(x.dims_mapping), set(x.partial))
+
+
+# scale/pow are unary elementwise: identity mapping (ref scale.cc, pow.cc)
+scale_rule = cast_rule
+pow_rule = cast_rule
+
+
+def full_like_rule(x: DistAttr) -> Tuple[DistAttr, DistAttr]:
+    """ref: spmd_rules/full_like.cc — output shape follows x, values are
+    constant, so the mapping carries but any PARTIAL state drops (a
+    constant is not a pending sum)."""
+    rx = DistAttr(list(x.dims_mapping), set(x.partial))
+    return rx, DistAttr(list(x.dims_mapping))
+
+
+def numel_rule(x: DistAttr) -> Tuple[DistAttr, DistAttr]:
+    """ref: spmd_rules/numel.cc — scalar metadata output, replicated."""
+    return DistAttr(list(x.dims_mapping), set(x.partial)), DistAttr([])
+
+
+def rms_norm_rule(x: DistAttr) -> Tuple[DistAttr, DistAttr]:
+    """ref: spmd_rules/rms_norm.cc — like layer_norm: the normalized
+    (last) dim must be replicated, leading dims carry."""
+    return layer_norm_rule(x)
+
+
+def replicated_rule(*xs: DistAttr) -> Tuple[Tuple[DistAttr, ...],
+                                            DistAttr]:
+    """ref: spmd_rules/replicated.cc — the conservative fallback for
+    un-ruled ops: everything replicated."""
+    rs = tuple(DistAttr.replicated(x.ndim) for x in xs)
+    return rs, DistAttr.replicated(xs[0].ndim if xs else 0)
+
+
+def default_data_parallel_rule(*xs: DistAttr
+                               ) -> Tuple[Tuple[DistAttr, ...], DistAttr]:
+    """ref: spmd_rules/default_data_parallel.cc — the other fallback:
+    dim 0 keeps a MERGED batch sharding, everything else replicated."""
+    b = None
+    for x in xs:
+        if x.ndim:
+            b = _merge(b, x.dims_mapping[0])
+    rs = tuple(DistAttr([b] + [None] * (x.ndim - 1)) if x.ndim
+               else DistAttr([]) for x in xs)
+    out_nd = xs[0].ndim if xs else 0
+    return rs, (DistAttr([b] + [None] * (out_nd - 1)) if out_nd
+                else DistAttr([]))
+
+
+def optimizer_rule(param: DistAttr, grad: DistAttr,
+                   *moments: DistAttr
+                   ) -> Tuple[Tuple[DistAttr, ...], Tuple[DistAttr, ...]]:
+    """ref: spmd_rules/optimizer.cc (AdamInferSpmd family) — param,
+    grad, and every moment must share ONE sharding (merged dim-by-dim;
+    grads still PARTIAL must be reduced before the update, so partial
+    never propagates into the new param/moments)."""
+    dm = list(param.dims_mapping)
+    for t in (grad,) + tuple(moments):
+        for i, a in enumerate(t.dims_mapping):
+            dm[i] = _merge(dm[i], a)
+    shared = lambda: DistAttr(list(dm))
+    resolved = tuple([shared() for _ in range(2 + len(moments))])
+    outs = tuple([shared() for _ in range(1 + len(moments))])
+    return resolved, outs
+
+
+def fused_linear_param_grad_add_rule(
+        x: DistAttr, dout: DistAttr, dweight: Optional[DistAttr] = None
+        ) -> Tuple[Tuple[DistAttr, ...], DistAttr]:
+    """ref: spmd_rules/fused_linear_param_grad_add.cc — the fused
+    weight-grad: dW = x^T @ dout (+ running dW). Contraction runs over
+    every leading dim; a shared sharded leading axis becomes PARTIAL on
+    the output, the trailing (K from x, N from dout) dims carry."""
+    lead = None
+    for i in range(x.ndim - 1):
+        lead = _merge(lead, x.dims_mapping[i])
+    for i in range(dout.ndim - 1):
+        lead = _merge(lead, dout.dims_mapping[i])
+    k = x.dims_mapping[-1]
+    n = dout.dims_mapping[-1]
+    if k == lead:
+        k = None
+    if n in (lead, k):
+        n = None
+    rx = DistAttr([lead] * (x.ndim - 1) + [k])
+    rd = DistAttr([lead] * (dout.ndim - 1) + [n])
+    partial = {lead} if lead is not None else set()
+    out = DistAttr([k, n], partial | (set(dweight.partial)
+                                      if dweight else set()))
+    resolved = (rx, rd) + ((DistAttr([k, n]),) if dweight else ())
+    return resolved, out
+
+
 def reshard_cost_bytes(src: DistAttr, dst: DistAttr, shape: Sequence[int],
                        mesh_shape: dict, elem_bytes: int = 2) -> float:
     """Bytes each chip moves to convert src->dst sharding of a tensor
@@ -484,6 +680,25 @@ _FORWARD_RULES = {
     "cross_entropy": cross_entropy_rule,
     "fused_rope": fused_rope_rule,
     "scatter": scatter_rule,
+    # round-4 tail: full parity with the reference registry
+    # (phi/infermeta/spmd_rules/: 31 rule families)
+    "squeeze": squeeze_rule,
+    "unsqueeze": unsqueeze_rule,
+    "flatten": flatten_rule,
+    "stack": stack_rule,
+    "tile": tile_rule,
+    "triu": triu_rule,
+    "where": where_rule,
+    "cast": cast_rule,
+    "scale": scale_rule,
+    "pow": pow_rule,
+    "full_like": full_like_rule,
+    "numel": numel_rule,
+    "rms_norm": rms_norm_rule,
+    "replicated": replicated_rule,
+    "default_data_parallel": default_data_parallel_rule,
+    "optimizer": optimizer_rule,
+    "fused_linear_param_grad_add": fused_linear_param_grad_add_rule,
 }
 
 

@@ -186,3 +186,125 @@ class TestPlannerGraphRanking:
         assert all(hasattr(c, "graph_bytes") for c in ranked)
         assert all(ranked[i].graph_time_s <= ranked[i + 1].graph_time_s
                    for i in range(len(ranked) - 1))
+
+
+class TestPropagateGatherPad:
+    def test_embedding_gather_maps_to_embedding_rule(self):
+        """jnp.take(table, ids, axis=0) — the embedding pattern — must
+        propagate like the embedding rule: column-sharded table carries
+        its hidden sharding; vocab-sharded table emits a partial."""
+        table = jnp.zeros((64, 16))
+        ids = jnp.zeros((4, 8), jnp.int32)
+
+        def emb(t, i):
+            return jnp.take(t, i, axis=0)
+
+        rep = propagate_jaxpr(emb, (table, ids),
+                              [DistAttr([None, "mp"]),
+                               DistAttr(["dp", None])], MESH_SHAPE)
+        (out,) = rep.out_attrs
+        assert out.dims_mapping == ["dp", None, "mp"]
+        assert rep.unknown_prims == {}
+
+        rep2 = propagate_jaxpr(emb, (table, ids),
+                               [DistAttr(["mp", None]),
+                                DistAttr(["dp", None])], MESH_SHAPE)
+        assert rep2.out_attrs[0].partial == {"mp"}
+
+    def test_pad_unshards_padded_dims(self):
+        x = jnp.zeros((8, 16))
+
+        def f(x):
+            return jnp.pad(x, ((0, 0), (1, 1)))
+
+        rep = propagate_jaxpr(f, (x,), [DistAttr(["dp", "mp"])],
+                              MESH_SHAPE)
+        (out,) = rep.out_attrs
+        assert out.dims_mapping == ["dp", None]
+        assert rep.unknown_prims == {}
+
+
+class TestPropagateScanAndWholeModel:
+    def test_scan_fixpoint_stacked_layers(self):
+        """lax.scan over stacked [L, H, F] weights (the model pattern):
+        the dp carry sharding must survive the fixpoint and the per-layer
+        row/col shardings must produce the partial."""
+        h = jnp.zeros((8, 16))
+        w_up = jnp.zeros((3, 16, 32))
+        w_down = jnp.zeros((3, 32, 16))
+
+        def stack(h, w_up, w_down):
+            def body(h, ws):
+                wu, wd = ws
+                return h + jnp.maximum(h @ wu, 0.0) @ wd, ()
+            out, _ = jax.lax.scan(body, h, (w_up, w_down))
+            return out
+
+        rep = propagate_jaxpr(
+            stack, (h, w_up, w_down),
+            [DistAttr(["dp", None]), DistAttr([None, None, "mp"]),
+             DistAttr([None, "mp", None])], MESH_SHAPE)
+        (out,) = rep.out_attrs
+        assert out.dims_mapping[0] == "dp"
+        assert rep.unknown_prims == {}
+
+    def test_whole_llama_forward_propagates(self):
+        """The full tiny-llama forward (embedding gather + scan over
+        decoder layers + norm + lm head) propagates with NO unknown
+        primitives, keeping the dp batch sharding end to end."""
+        import paddle_tpu as paddle
+        from paddle_tpu.framework import core
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+        from paddle_tpu.tensor import Tensor
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny(use_recompute=False))
+        model.eval()
+        keys = sorted(model.state_dict())
+        state_vals = [model.state_dict()[k].data for k in keys]
+
+        def fwd(ids, *vals):
+            state = dict(zip(keys, vals))
+            with model.use_state(state), core.no_grad_guard():
+                return model(Tensor(ids)).data
+
+        ids = jnp.zeros((4, 16), jnp.int32)
+        attrs = [DistAttr(["dp", None])] + [
+            DistAttr.replicated(v.ndim) for v in state_vals]
+        rep = propagate_jaxpr(fwd, (ids, *state_vals), attrs, MESH_SHAPE)
+        assert rep.unknown_prims == {}, rep.unknown_prims
+        (out,) = rep.out_attrs
+        assert out.dims_mapping[0] == "dp", out
+
+
+class TestEnginePropagate:
+    def test_engine_propagate_whole_model(self):
+        """Engine.propagate: rule-based whole-model annotation under the
+        engine's own ShardingPlan specs — no unknown primitives, dp
+        batch preserved, and stage-3 FSDP params produce a priced
+        reshard bill (the allgathers GSPMD will insert)."""
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny(use_recompute=False))
+        model.eval()
+        eng = Engine(
+            model=model,
+            loss=lambda out, y: F.cross_entropy(
+                out.reshape([-1, out.shape[-1]]), y.reshape([-1])),
+            optimizer=opt.AdamW(learning_rate=1e-3,
+                                parameters=model.parameters()),
+            strategy=Strategy({"dp_degree": 2, "mp_degree": 1,
+                               "sharding": {"degree": 4, "stage": 3}}))
+        eng.prepare()
+        ids = np.zeros((8, 16), np.int32)
+        rep = eng.propagate(paddle.to_tensor(ids))
+        assert rep.unknown_prims == {}, rep.unknown_prims
+        (out,) = rep.out_attrs
+        assert out.dims_mapping[0] is not None      # batch stays sharded
+        # FSDP param shards force allgather-style reshards: priced > 0
+        assert rep.total_reshard_bytes > 0

@@ -397,3 +397,136 @@ class TestNewRuleFamilies:
             return x, x
 
         assert _FORWARD_RULES.pop("my_custom_op") is my_rule
+
+
+class TestRegistryParityTail:
+    """Round-4 tail: the remaining reference rule families — the
+    registry now covers every file in phi/infermeta/spmd_rules/."""
+
+    def test_squeeze_unsqueeze(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            squeeze_rule, unsqueeze_rule)
+        x = DistAttr(["dp", None, "mp"])
+        _, out = squeeze_rule(x, axes=[1])
+        assert out.dims_mapping == ["dp", "mp"]
+        _, out2 = unsqueeze_rule(out, axes=[1])
+        assert out2.dims_mapping == ["dp", None, "mp"]
+
+    def test_flatten_merges_like_reshape(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            flatten_rule)
+        x = DistAttr(["dp", None, "mp"])
+        _, out = flatten_rule(x, (4, 8, 16), start_axis=0, stop_axis=1,
+                              mesh_shape={"dp": 2, "mp": 2})
+        assert out.dims_mapping == ["dp", "mp"]
+
+    def test_stack_new_dim_replicated(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            stack_rule)
+        a = DistAttr(["dp", "mp"])
+        b = DistAttr(["dp", None])
+        (ra, rb), out = stack_rule([a, b], axis=0)
+        assert out.dims_mapping == [None, "dp", "mp"]
+        assert ra.dims_mapping == ["dp", "mp"]
+
+    def test_tile_repeated_dims_unshard(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            tile_rule)
+        x = DistAttr(["dp", "mp"])
+        rx, out = tile_rule(x, (1, 3))
+        assert out.dims_mapping == ["dp", None]
+        assert rx.dims_mapping == ["dp", None]
+        # leading broadcast repeats add replicated dims
+        _, out2 = tile_rule(x, (2, 1, 1))
+        assert out2.dims_mapping == [None, "dp", "mp"]
+
+    def test_triu_masks_last_two(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            triu_rule)
+        x = DistAttr(["dp", "mp", "sep"])
+        _, out = triu_rule(x)
+        assert out.dims_mapping == ["dp", None, None]
+
+    def test_where_broadcast_merge(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            where_rule)
+        c = DistAttr([None, "mp"])
+        x = DistAttr(["dp", None])
+        y = DistAttr([None, None])
+        _, out = where_rule(c, x, y)
+        assert out.dims_mapping == ["dp", "mp"]
+
+    def test_cast_scale_pow_identity_full_like_drops_partial(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            cast_rule, full_like_rule, numel_rule, pow_rule, scale_rule)
+        x = DistAttr(["dp", None], partial={"mp"})
+        for rule in (cast_rule, scale_rule, pow_rule):
+            _, out = rule(x)
+            assert out.dims_mapping == ["dp", None]
+            assert out.partial == {"mp"}
+        _, out = full_like_rule(x)
+        assert out.partial == set()        # constants are not pending sums
+        _, out = numel_rule(x)
+        assert out.dims_mapping == []
+
+    def test_rms_norm_last_dim_replicated(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            rms_norm_rule)
+        x = DistAttr(["dp", "sep", "mp"])
+        _, out = rms_norm_rule(x)
+        assert out.dims_mapping == ["dp", "sep", None]
+
+    def test_fallback_rules(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            default_data_parallel_rule, replicated_rule)
+        a = DistAttr(["dp", "mp"])
+        b = DistAttr([None, "mp", None])
+        rs, out = replicated_rule(a, b)
+        assert all(all(d is None for d in r.dims_mapping) for r in rs)
+        rs, out = default_data_parallel_rule(a, b)
+        assert rs[0].dims_mapping == ["dp", None]
+        assert rs[1].dims_mapping == ["dp", None, None]
+        assert out.dims_mapping == ["dp", None]
+
+    def test_optimizer_rule_merges_all_states(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            optimizer_rule)
+        p = DistAttr(["sharding", None])
+        g = DistAttr([None, None], partial={"dp"})
+        m = DistAttr(["sharding", None])
+        v = DistAttr([None, None])
+        resolved, outs = optimizer_rule(p, g, m, v)
+        for r in resolved:
+            assert r.dims_mapping == ["sharding", None]
+        for o in outs:
+            assert o.dims_mapping == ["sharding", None]
+            assert o.partial == set()      # grads reduced before update
+
+    def test_fused_linear_param_grad_add(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            fused_linear_param_grad_add_rule)
+        # x [B, S, K] dp on batch; dout [B, S, N] dp on batch ->
+        # dW [K, N] PARTIAL over dp (the data-parallel weight grad)
+        x = DistAttr(["dp", None, None])
+        dout = DistAttr(["dp", None, "mp"])
+        (rx, rd), out = fused_linear_param_grad_add_rule(x, dout)
+        assert out.dims_mapping == [None, "mp"]
+        assert out.partial == {"dp"}
+
+    def test_registry_covers_reference_families(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            _FORWARD_RULES)
+        # every rule-family file in phi/infermeta/spmd_rules/ (31) maps
+        # to a registered kind here (elementwise covers cast-family
+        # arithmetics; matmul covers the dist matmul)
+        want = {"cast", "concat", "cross_entropy",
+                "default_data_parallel", "elementwise", "embedding",
+                "flash_attention", "flatten", "full_like",
+                "fused_linear_param_grad_add", "fused_rope",
+                "layer_norm", "matmul", "numel", "optimizer", "pow",
+                "reduction", "replicated", "reshape", "rms_norm",
+                "scale", "scatter", "slice", "softmax", "split",
+                "squeeze", "stack", "tile", "transpose", "triu",
+                "unsqueeze", "where"}
+        missing = want - set(_FORWARD_RULES)
+        assert not missing, missing
