@@ -24,7 +24,7 @@ from typing import Dict, Optional
 import numpy as np
 
 __all__ = ["SGDRule", "AdagradRule", "AdamRule", "DenseTable", "SparseTable",
-           "ParameterServer", "PSClient", "run_server"]
+           "NativeSparseTable", "ParameterServer", "PSClient", "run_server"]
 
 def _auth(bind_host=None) -> bytes:
     """Per-job secret (distributed/_auth.py): PADDLE_PS_AUTHKEY, else
@@ -176,6 +176,92 @@ class SparseTable:
         return len(self.rows)
 
 
+class NativeSparseTable:
+    """C++ contiguous-arena sparse table (ref: the reference's
+    MemorySparseTable is C++, ps/table/memory_sparse_table.cc) — same
+    pull/push contract as SparseTable, backed by
+    ps/_native/table.cpp via ctypes: id->row hash over one float arena,
+    duplicate-id merge, fused SGD/Adagrad/Adam rules, binary snapshots.
+
+    Raises RuntimeError at construction when no C++ toolchain is
+    available (callers choose the Python table instead)."""
+
+    _RULE_IDS = {"sgd": 0, "adagrad": 1, "adam": 2}
+
+    def __init__(self, emb_dim, rule="sgd", seed=0):
+        from . import _native
+        self._lib = _native.load()
+        if self._lib is None:
+            raise RuntimeError("native PS table unavailable "
+                               "(no C++ toolchain)")
+        self.dim = int(emb_dim)
+        self.rule = _make_rule(rule)
+        # EXACT types only: a subclass (GeoSGDRule blends deltas with
+        # param += lr*delta) has different semantics than the fused C++
+        # update — silently degrading it to SGD would invert updates.
+        # Raising keeps such rules on the Python table via the fallback.
+        if type(self.rule) is AdamRule:
+            self._rule_id = 2
+            self._params = (self.rule.lr, self.rule.b1, self.rule.b2,
+                            self.rule.eps)
+        elif type(self.rule) is AdagradRule:
+            self._rule_id = 1
+            self._params = (self.rule.lr, self.rule.eps, 0.0, 0.0)
+        elif type(self.rule) is SGDRule:
+            self._rule_id = 0
+            self._params = (self.rule.lr, 0.0, 0.0, 0.0)
+        else:
+            raise RuntimeError(
+                f"native PS table has no fused rule for "
+                f"{type(self.rule).__name__}; use the Python table")
+        self._h = self._lib.pst_create(self.dim, self._rule_id, int(seed))
+        if not self._h:
+            raise RuntimeError("pst_create failed")
+
+    def _ids(self, ids):
+        import ctypes
+        arr = np.ascontiguousarray(np.asarray(ids, np.int64).ravel())
+        return arr, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+    def pull(self, ids) -> np.ndarray:
+        import ctypes
+        arr, ptr = self._ids(ids)
+        out = np.empty((len(arr), self.dim), np.float32)
+        self._lib.pst_pull(self._h, ptr, len(arr),
+                           out.ctypes.data_as(
+                               ctypes.POINTER(ctypes.c_float)))
+        return out
+
+    def push(self, ids, grads):
+        import ctypes
+        arr, ptr = self._ids(ids)
+        g = np.ascontiguousarray(
+            np.asarray(grads, np.float32).reshape(len(arr), self.dim))
+        self._lib.pst_push(
+            self._h, ptr, len(arr),
+            g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            *[float(p) for p in self._params])
+
+    def save(self, path: str):
+        if self._lib.pst_save(self._h, path.encode()) != 0:
+            raise OSError(f"native table save failed: {path}")
+
+    def load(self, path: str):
+        if self._lib.pst_load(self._h, path.encode()) != 0:
+            raise OSError(f"native table load failed: {path}")
+
+    def __len__(self):
+        return int(self._lib.pst_len(self._h))
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.pst_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
 # ---------------- server ------------------------------------------------
 
 class ParameterServer:
@@ -199,7 +285,16 @@ class ParameterServer:
         return self.tables[name]
 
     def create_sparse_table(self, name, emb_dim, rule="sgd",
-                            initializer=None):
+                            initializer=None, backend="python"):
+        """backend='native' uses the C++ arena table (no custom
+        initializer support — rows init deterministically from the
+        seed); falls back to Python when the toolchain is missing."""
+        if backend == "native" and initializer is None:
+            try:
+                self.tables[name] = NativeSparseTable(emb_dim, rule)
+                return self.tables[name]
+            except RuntimeError:
+                pass
         self.tables[name] = SparseTable(emb_dim, rule, initializer)
         return self.tables[name]
 

@@ -1,0 +1,240 @@
+// Native sparse-embedding table for the parameter server
+// (ref: paddle/fluid/distributed/ps/table/memory_sparse_table.cc — the
+// reference's PS tables are C++ with contiguous row storage and fused
+// per-row optimizer rules; this is the TPU-framework's host-side
+// equivalent behind a ctypes ABI, replacing the pure-Python row dict
+// for throughput-sensitive deployments).
+//
+// Design: id -> row index hash map over a contiguous float arena
+// (rows + optimizer slots), duplicate-id gradient merging before the
+// rule applies (matching the Python SparseTable semantics), fused
+// SGD/Adagrad/Adam updates, deterministic per-(seed,id,col) row init,
+// and a flat binary snapshot for save/load. One mutex per table:
+// callers batch, so the lock is per-batch, not per-row.
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int RULE_SGD = 0;
+constexpr int RULE_ADAGRAD = 1;
+constexpr int RULE_ADAM = 2;
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+// deterministic N(0, 0.01) init per (seed, id, col) via Box-Muller
+inline float init_val(uint64_t seed, int64_t id, int col) {
+  uint64_t h = splitmix64(seed ^ splitmix64((uint64_t)id * 2654435761ULL
+                                            + (uint64_t)col));
+  uint64_t h2 = splitmix64(h);
+  double u1 = ((h >> 11) + 1.0) * (1.0 / 9007199254740993.0);   // (0,1)
+  double u2 = (h2 >> 11) * (1.0 / 9007199254740992.0);          // [0,1)
+  double z = std::sqrt(-2.0 * std::log(u1)) *
+             std::cos(2.0 * M_PI * u2);
+  return (float)(z * 0.01);
+}
+
+struct Table {
+  int dim;
+  int rule;
+  uint64_t seed;
+  std::unordered_map<int64_t, int64_t> index;  // id -> row number
+  std::vector<int64_t> ids;                    // row number -> id
+  std::vector<float> rows;                     // [n, dim]
+  std::vector<float> s1;                       // adagrad g2 / adam m
+  std::vector<float> s2;                       // adam v
+  std::vector<int64_t> steps;                  // adam t (per row)
+  std::mutex mu;
+
+  int n_slots() const {
+    return rule == RULE_ADAGRAD ? 1 : (rule == RULE_ADAM ? 2 : 0);
+  }
+
+  int64_t row_of(int64_t id) {
+    auto it = index.find(id);
+    if (it != index.end()) return it->second;
+    int64_t r = (int64_t)ids.size();
+    index.emplace(id, r);
+    ids.push_back(id);
+    rows.resize(rows.size() + dim);
+    float* p = rows.data() + r * dim;
+    for (int c = 0; c < dim; ++c) p[c] = init_val(seed, id, c);
+    if (n_slots() >= 1) s1.resize(s1.size() + dim, 0.0f);
+    if (n_slots() >= 2) s2.resize(s2.size() + dim, 0.0f);
+    if (rule == RULE_ADAM) steps.push_back(0);
+    return r;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pst_create(int dim, int rule, uint64_t seed) {
+  if (dim <= 0 || rule < 0 || rule > 2) return nullptr;
+  Table* t = new Table();
+  t->dim = dim;
+  t->rule = rule;
+  t->seed = seed;
+  return t;
+}
+
+void pst_destroy(void* h) { delete (Table*)h; }
+
+int64_t pst_len(void* h) {
+  Table* t = (Table*)h;
+  std::lock_guard<std::mutex> g(t->mu);
+  return (int64_t)t->ids.size();
+}
+
+void pst_pull(void* h, const int64_t* ids, int64_t n, float* out) {
+  Table* t = (Table*)h;
+  std::lock_guard<std::mutex> g(t->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t r = t->row_of(ids[i]);
+    std::memcpy(out + i * t->dim, t->rows.data() + r * t->dim,
+                sizeof(float) * t->dim);
+  }
+}
+
+// grads [n, dim]; duplicate ids MERGE before one rule application
+// (matching the Python SparseTable / reference push_sparse semantics).
+// p1..p4: sgd(lr) | adagrad(lr, eps) | adam(lr, b1, b2, eps)
+void pst_push(void* h, const int64_t* ids, int64_t n, const float* grads,
+              float p1, float p2, float p3, float p4) {
+  Table* t = (Table*)h;
+  std::lock_guard<std::mutex> g(t->mu);
+  const int dim = t->dim;
+  // merge duplicates: id -> accumulated grad (order-preserving rows)
+  std::unordered_map<int64_t, int64_t> uniq;
+  std::vector<int64_t> order;
+  std::vector<float> acc;
+  uniq.reserve((size_t)n * 2);
+  for (int64_t i = 0; i < n; ++i) {
+    auto it = uniq.find(ids[i]);
+    int64_t slot;
+    if (it == uniq.end()) {
+      slot = (int64_t)order.size();
+      uniq.emplace(ids[i], slot);
+      order.push_back(ids[i]);
+      acc.resize(acc.size() + dim, 0.0f);
+    } else {
+      slot = it->second;
+    }
+    float* a = acc.data() + slot * dim;
+    const float* gsrc = grads + i * dim;
+    for (int c = 0; c < dim; ++c) a[c] += gsrc[c];
+  }
+  for (size_t u = 0; u < order.size(); ++u) {
+    int64_t r = t->row_of(order[u]);
+    float* w = t->rows.data() + r * dim;
+    const float* gv = acc.data() + (int64_t)u * dim;
+    if (t->rule == RULE_SGD) {
+      const float lr = p1;
+      for (int c = 0; c < dim; ++c) w[c] -= lr * gv[c];
+    } else if (t->rule == RULE_ADAGRAD) {
+      const float lr = p1, eps = p2;
+      float* g2 = t->s1.data() + r * dim;
+      for (int c = 0; c < dim; ++c) {
+        g2[c] += gv[c] * gv[c];
+        w[c] -= lr * gv[c] / (std::sqrt(g2[c]) + eps);
+      }
+    } else {  // adam
+      const float lr = p1, b1 = p2, b2 = p3, eps = p4;
+      float* m = t->s1.data() + r * dim;
+      float* v = t->s2.data() + r * dim;
+      int64_t step = ++t->steps[r];
+      const float c1 = 1.0f - std::pow(b1, (float)step);
+      const float c2 = 1.0f - std::pow(b2, (float)step);
+      for (int c = 0; c < dim; ++c) {
+        m[c] = b1 * m[c] + (1.0f - b1) * gv[c];
+        v[c] = b2 * v[c] + (1.0f - b2) * gv[c] * gv[c];
+        w[c] -= lr * (m[c] / c1) / (std::sqrt(v[c] / c2) + eps);
+      }
+    }
+  }
+}
+
+// flat binary snapshot: magic, dim, rule, n, then ids / rows / slots
+int pst_save(void* h, const char* path) {
+  Table* t = (Table*)h;
+  std::lock_guard<std::mutex> g(t->mu);
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  const uint64_t magic = 0x70737462UL;  // "pstb"
+  uint64_t dim = (uint64_t)t->dim, rule = (uint64_t)t->rule;
+  uint64_t n = (uint64_t)t->ids.size();
+  int ok = 1;
+  ok &= std::fwrite(&magic, 8, 1, f) == 1;
+  ok &= std::fwrite(&dim, 8, 1, f) == 1;
+  ok &= std::fwrite(&rule, 8, 1, f) == 1;
+  ok &= std::fwrite(&t->seed, 8, 1, f) == 1;
+  ok &= std::fwrite(&n, 8, 1, f) == 1;
+  if (n) {
+    ok &= std::fwrite(t->ids.data(), 8, n, f) == n;
+    ok &= std::fwrite(t->rows.data(), 4, n * dim, f) == n * dim;
+    if (t->n_slots() >= 1)
+      ok &= std::fwrite(t->s1.data(), 4, n * dim, f) == n * dim;
+    if (t->n_slots() >= 2)
+      ok &= std::fwrite(t->s2.data(), 4, n * dim, f) == n * dim;
+    if (t->rule == RULE_ADAM)
+      ok &= std::fwrite(t->steps.data(), 8, n, f) == n;
+  }
+  std::fclose(f);
+  return ok ? 0 : -1;
+}
+
+int pst_load(void* h, const char* path) {
+  Table* t = (Table*)h;
+  std::lock_guard<std::mutex> g(t->mu);
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  uint64_t magic = 0, dim = 0, rule = 0, seed = 0, n = 0;
+  int ok = 1;
+  ok &= std::fread(&magic, 8, 1, f) == 1 && magic == 0x70737462UL;
+  ok &= std::fread(&dim, 8, 1, f) == 1;
+  ok &= std::fread(&rule, 8, 1, f) == 1;
+  ok &= std::fread(&seed, 8, 1, f) == 1;
+  ok &= std::fread(&n, 8, 1, f) == 1;
+  if (!ok || (int)dim != t->dim || (int)rule != t->rule) {
+    std::fclose(f);
+    return -1;
+  }
+  t->seed = seed;
+  // reset ALL state arenas up front: an n==0 snapshot must not leave
+  // stale optimizer slots behind for rows created after the load
+  t->ids.assign(n, 0);
+  t->rows.assign(n * dim, 0.0f);
+  t->s1.assign(t->n_slots() >= 1 ? n * dim : 0, 0.0f);
+  t->s2.assign(t->n_slots() >= 2 ? n * dim : 0, 0.0f);
+  t->steps.assign(t->rule == RULE_ADAM ? n : 0, 0);
+  if (n) {
+    ok &= std::fread(t->ids.data(), 8, n, f) == n;
+    ok &= std::fread(t->rows.data(), 4, n * dim, f) == n * dim;
+    if (t->n_slots() >= 1)
+      ok &= std::fread(t->s1.data(), 4, n * dim, f) == n * dim;
+    if (t->n_slots() >= 2)
+      ok &= std::fread(t->s2.data(), 4, n * dim, f) == n * dim;
+    if (t->rule == RULE_ADAM)
+      ok &= std::fread(t->steps.data(), 8, n, f) == n;
+  }
+  std::fclose(f);
+  if (!ok) return -1;
+  t->index.clear();
+  t->index.reserve(n * 2);
+  for (uint64_t r = 0; r < n; ++r) t->index.emplace(t->ids[r], (int64_t)r);
+  return 0;
+}
+
+}  // extern "C"

@@ -62,6 +62,24 @@ def bench_sparse(emb_dim=64, batch_ids=256, vocab=100_000, iters=100):
             "emb_dim": emb_dim, "batch_ids": batch_ids}
 
 
+def bench_native(emb_dim=64, batch_ids=256, vocab=100_000, iters=100):
+    """C++ arena table vs the Python row-dict (same shapes as
+    bench_sparse — the speedup is the native-table headline)."""
+    try:
+        from paddle_tpu.distributed.ps import NativeSparseTable
+        t = NativeSparseTable(emb_dim, rule="adagrad")
+    except (ImportError, RuntimeError):
+        return {"native_available": False}
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, batch_ids)
+    g = rng.standard_normal((batch_ids, emb_dim)).astype(np.float32)
+    pull = _time_ops(lambda: t.pull(ids), iters)
+    push = _time_ops(lambda: t.push(ids, g), iters)
+    return {"native_available": True,
+            "native_pull_rows_per_s": batch_ids / pull,
+            "native_push_rows_per_s": batch_ids / push}
+
+
 def bench_ssd(emb_dim=64, batch_ids=256, vocab=8_000, cache_rows=1_000,
               iters=10):
     """cache_rows << vocab so most batches fault rows from disk — the
@@ -120,6 +138,7 @@ def main():
     }
     out.update(bench_dense())
     out.update(bench_sparse())
+    out.update(bench_native())
     out.update(bench_ssd())
     out.update(bench_socket())
     path = os.path.join(os.path.dirname(os.path.dirname(
