@@ -276,19 +276,38 @@ class SubgraphProgram:
         self.last_path = None          # 'fragments' | 'capture'
 
     # -- signatures ---------------------------------------------------------
+    @staticmethod
+    def _flatten(args, kwargs):
+        """Tensor is itself a registered pytree node — flatten WITHOUT
+        is_leaf would descend into it, yielding raw arrays that (a) miss
+        the Tensor checks below (inputs silently frozen as consts) and
+        (b) get repr()'d into the signature: full array printing per
+        call plus a fresh capture+compile for every distinct input VALUE
+        (measured 123x call overhead before this fix)."""
+        return jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda v: isinstance(v, Tensor))
+
     def _sig(self, args, kwargs):
-        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        leaves, treedef = self._flatten(args, kwargs)
         sig = [str(treedef)]
         for leaf in leaves:
             if isinstance(leaf, Tensor):
                 sig.append(("T", tuple(leaf.shape), str(leaf.data.dtype)))
+            elif isinstance(leaf, (jax.Array, np.ndarray)):
+                # raw arrays are captured as CONSTS (frozen values), so
+                # the signature must fingerprint the value — cheap hash,
+                # never repr (which truncates AND prints element-wise)
+                import hashlib
+                arr = np.asarray(leaf)
+                sig.append(("A", arr.shape, str(arr.dtype),
+                            hashlib.sha1(arr.tobytes()).hexdigest()))
             else:
                 sig.append(("P", repr(leaf)))
         return tuple(sig)
 
     def _arg_leaves(self, args, kwargs):
         out = {}
-        leaves, _ = jax.tree_util.tree_flatten((args, kwargs))
+        leaves, _ = self._flatten(args, kwargs)
         for i, leaf in enumerate(leaves):
             if isinstance(leaf, Tensor):
                 out[(i,)] = leaf.data
@@ -302,7 +321,7 @@ class SubgraphProgram:
     # -- capture ------------------------------------------------------------
     def _capture(self, args, kwargs):
         arg_ids = {}
-        leaves, _ = jax.tree_util.tree_flatten((args, kwargs))
+        leaves, _ = self._flatten(args, kwargs)
         for i, leaf in enumerate(leaves):
             if isinstance(leaf, Tensor):
                 arg_ids[id(leaf)] = (i,)

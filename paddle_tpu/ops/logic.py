@@ -15,8 +15,14 @@ __all__ = [
 
 
 def _cmp(fn):
+    # through the tape (apply_op), NOT a bare Tensor(...) construction:
+    # bypassing the tape makes comparisons invisible to the static
+    # Program recorder and to SOT fragment capture — both would then
+    # freeze the comparison RESULT as a constant and replay stale
+    # branches when inputs change (round-4 capture-soundness fix)
     def op(x, y, name=None):
-        return Tensor(fn(unwrap(x), unwrap(y)))
+        from ..autograd import tape
+        return tape.apply_op(fn, x, y, name=fn.__name__)
     return op
 
 
@@ -32,21 +38,29 @@ logical_xor = _cmp(jnp.logical_xor)
 
 
 def logical_not(x, out=None, name=None):
-    return Tensor(jnp.logical_not(unwrap(x)))
+    from ..autograd import tape
+    return tape.apply_op(jnp.logical_not, x, name="logical_not")
 
 
 def equal_all(x, y, name=None):
-    return Tensor(jnp.array_equal(unwrap(x), unwrap(y)))
+    from ..autograd import tape
+    return tape.apply_op(jnp.array_equal, x, y, name="equal_all")
 
 
 def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
-    return Tensor(jnp.allclose(unwrap(x), unwrap(y), rtol=float(rtol),
-                               atol=float(atol), equal_nan=equal_nan))
+    from ..autograd import tape
+    return tape.apply_op(
+        lambda a, b: jnp.allclose(a, b, rtol=float(rtol),
+                                  atol=float(atol), equal_nan=equal_nan),
+        x, y, name="allclose")
 
 
 def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
-    return Tensor(jnp.isclose(unwrap(x), unwrap(y), rtol=float(rtol),
-                              atol=float(atol), equal_nan=equal_nan))
+    from ..autograd import tape
+    return tape.apply_op(
+        lambda a, b: jnp.isclose(a, b, rtol=float(rtol),
+                                 atol=float(atol), equal_nan=equal_nan),
+        x, y, name="isclose")
 
 
 def is_tensor(x):
