@@ -1,59 +1,37 @@
 """ctypes bindings for the native sparse-table engine (table.cpp)
 (ref: ps/table/memory_sparse_table.cc — the reference PS tables are
-C++; this loader mirrors io/_native's build-on-first-use pattern).
-
-Builds libpstable.so with g++ on first use (cached next to the source);
-returns None when no toolchain is available — the PS then stays on the
-pure-Python row-dict tables."""
+C++). Uses the shared build-on-first-use loader
+(utils/_native_build.py); returns None when no toolchain is available —
+the PS then stays on the pure-Python row-dict tables."""
 from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import threading
 
 _HERE = os.path.dirname(__file__)
 _SRC = os.path.join(_HERE, "table.cpp")
 _SO = os.path.join(_HERE, "libpstable.so")
-_lock = threading.Lock()
-_lib = None
-_tried = False
 
 
-def _build():
-    cmd = ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _SO]
-    subprocess.run(cmd, check=True, capture_output=True)
+def _configure(lib):
+    c = ctypes
+    lib.pst_create.restype = c.c_void_p
+    lib.pst_create.argtypes = [c.c_int, c.c_int, c.c_uint64]
+    lib.pst_destroy.argtypes = [c.c_void_p]
+    lib.pst_len.restype = c.c_int64
+    lib.pst_len.argtypes = [c.c_void_p]
+    lib.pst_pull.argtypes = [c.c_void_p, c.POINTER(c.c_int64),
+                             c.c_int64, c.POINTER(c.c_float)]
+    lib.pst_push.argtypes = [c.c_void_p, c.POINTER(c.c_int64),
+                             c.c_int64, c.POINTER(c.c_float),
+                             c.c_float, c.c_float, c.c_float, c.c_float]
+    lib.pst_save.restype = c.c_int
+    lib.pst_save.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pst_load.restype = c.c_int
+    lib.pst_load.argtypes = [c.c_void_p, c.c_char_p]
 
 
 def load():
     """Returns the ctypes lib or None."""
-    global _lib, _tried
-    with _lock:
-        if _lib is not None or _tried:
-            return _lib
-        _tried = True
-        try:
-            if not os.path.exists(_SO) or (
-                    os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-                _build()
-            lib = ctypes.CDLL(_SO)
-        except Exception:
-            return None
-        c = ctypes
-        lib.pst_create.restype = c.c_void_p
-        lib.pst_create.argtypes = [c.c_int, c.c_int, c.c_uint64]
-        lib.pst_destroy.argtypes = [c.c_void_p]
-        lib.pst_len.restype = c.c_int64
-        lib.pst_len.argtypes = [c.c_void_p]
-        lib.pst_pull.argtypes = [c.c_void_p, c.POINTER(c.c_int64),
-                                 c.c_int64, c.POINTER(c.c_float)]
-        lib.pst_push.argtypes = [c.c_void_p, c.POINTER(c.c_int64),
-                                 c.c_int64, c.POINTER(c.c_float),
-                                 c.c_float, c.c_float, c.c_float,
-                                 c.c_float]
-        lib.pst_save.restype = c.c_int
-        lib.pst_save.argtypes = [c.c_void_p, c.c_char_p]
-        lib.pst_load.restype = c.c_int
-        lib.pst_load.argtypes = [c.c_void_p, c.c_char_p]
-        _lib = lib
-        return _lib
+    from ....utils._native_build import build_and_load
+    return build_and_load(_SRC, _SO, configure=_configure)

@@ -81,13 +81,15 @@ struct Table {
 
 extern "C" {
 
-void* pst_create(int dim, int rule, uint64_t seed) {
+void* pst_create(int dim, int rule, uint64_t seed) try {
   if (dim <= 0 || rule < 0 || rule > 2) return nullptr;
   Table* t = new Table();
   t->dim = dim;
   t->rule = rule;
   t->seed = seed;
   return t;
+} catch (...) {
+  return nullptr;
 }
 
 void pst_destroy(void* h) { delete (Table*)h; }
@@ -98,7 +100,7 @@ int64_t pst_len(void* h) {
   return (int64_t)t->ids.size();
 }
 
-void pst_pull(void* h, const int64_t* ids, int64_t n, float* out) {
+void pst_pull(void* h, const int64_t* ids, int64_t n, float* out) try {
   Table* t = (Table*)h;
   std::lock_guard<std::mutex> g(t->mu);
   for (int64_t i = 0; i < n; ++i) {
@@ -106,13 +108,14 @@ void pst_pull(void* h, const int64_t* ids, int64_t n, float* out) {
     std::memcpy(out + i * t->dim, t->rows.data() + r * t->dim,
                 sizeof(float) * t->dim);
   }
+} catch (...) {
 }
 
 // grads [n, dim]; duplicate ids MERGE before one rule application
 // (matching the Python SparseTable / reference push_sparse semantics).
 // p1..p4: sgd(lr) | adagrad(lr, eps) | adam(lr, b1, b2, eps)
 void pst_push(void* h, const int64_t* ids, int64_t n, const float* grads,
-              float p1, float p2, float p3, float p4) {
+              float p1, float p2, float p3, float p4) try {
   Table* t = (Table*)h;
   std::lock_guard<std::mutex> g(t->mu);
   const int dim = t->dim;
@@ -164,10 +167,11 @@ void pst_push(void* h, const int64_t* ids, int64_t n, const float* grads,
       }
     }
   }
+} catch (...) {
 }
 
 // flat binary snapshot: magic, dim, rule, n, then ids / rows / slots
-int pst_save(void* h, const char* path) {
+int pst_save(void* h, const char* path) try {
   Table* t = (Table*)h;
   std::lock_guard<std::mutex> g(t->mu);
   FILE* f = std::fopen(path, "wb");
@@ -193,9 +197,17 @@ int pst_save(void* h, const char* path) {
   }
   std::fclose(f);
   return ok ? 0 : -1;
+} catch (...) {
+  return -1;
 }
 
-int pst_load(void* h, const char* path) {
+// STAGED load: everything reads into temporaries and commits only on
+// full success — a truncated/corrupt snapshot must never leave the
+// table with an index pointing past a shrunken arena (heap OOB on the
+// next pull). The on-disk row count is validated against the actual
+// file size before any allocation, and the whole body is exception-
+// guarded: C++ exceptions must not cross the C ABI into ctypes.
+int pst_load(void* h, const char* path) try {
   Table* t = (Table*)h;
   std::lock_guard<std::mutex> g(t->mu);
   FILE* f = std::fopen(path, "rb");
@@ -211,30 +223,49 @@ int pst_load(void* h, const char* path) {
     std::fclose(f);
     return -1;
   }
-  t->seed = seed;
-  // reset ALL state arenas up front: an n==0 snapshot must not leave
-  // stale optimizer slots behind for rows created after the load
-  t->ids.assign(n, 0);
-  t->rows.assign(n * dim, 0.0f);
-  t->s1.assign(t->n_slots() >= 1 ? n * dim : 0, 0.0f);
-  t->s2.assign(t->n_slots() >= 2 ? n * dim : 0, 0.0f);
-  t->steps.assign(t->rule == RULE_ADAM ? n : 0, 0);
+  // size sanity: header-claimed n must match what the file can hold
+  long data_start = std::ftell(f);
+  std::fseek(f, 0, SEEK_END);
+  long fsize = std::ftell(f);
+  std::fseek(f, data_start, SEEK_SET);
+  uint64_t per_row = 8 + 4 * dim * (1 + (uint64_t)t->n_slots())
+                     + (t->rule == RULE_ADAM ? 8 : 0);
+  if (n > 0 && (fsize < data_start
+                || (uint64_t)(fsize - data_start) < n * per_row)) {
+    std::fclose(f);
+    return -1;
+  }
+  std::vector<int64_t> ids(n, 0);
+  std::vector<float> rows(n * dim, 0.0f);
+  std::vector<float> s1(t->n_slots() >= 1 ? n * dim : 0, 0.0f);
+  std::vector<float> s2(t->n_slots() >= 2 ? n * dim : 0, 0.0f);
+  std::vector<int64_t> steps(t->rule == RULE_ADAM ? n : 0, 0);
   if (n) {
-    ok &= std::fread(t->ids.data(), 8, n, f) == n;
-    ok &= std::fread(t->rows.data(), 4, n * dim, f) == n * dim;
+    ok &= std::fread(ids.data(), 8, n, f) == n;
+    ok &= std::fread(rows.data(), 4, n * dim, f) == n * dim;
     if (t->n_slots() >= 1)
-      ok &= std::fread(t->s1.data(), 4, n * dim, f) == n * dim;
+      ok &= std::fread(s1.data(), 4, n * dim, f) == n * dim;
     if (t->n_slots() >= 2)
-      ok &= std::fread(t->s2.data(), 4, n * dim, f) == n * dim;
+      ok &= std::fread(s2.data(), 4, n * dim, f) == n * dim;
     if (t->rule == RULE_ADAM)
-      ok &= std::fread(t->steps.data(), 8, n, f) == n;
+      ok &= std::fread(steps.data(), 8, n, f) == n;
   }
   std::fclose(f);
   if (!ok) return -1;
-  t->index.clear();
-  t->index.reserve(n * 2);
-  for (uint64_t r = 0; r < n; ++r) t->index.emplace(t->ids[r], (int64_t)r);
+  std::unordered_map<int64_t, int64_t> index;
+  index.reserve(n * 2);
+  for (uint64_t r = 0; r < n; ++r) index.emplace(ids[r], (int64_t)r);
+  // commit
+  t->seed = seed;
+  t->ids.swap(ids);
+  t->rows.swap(rows);
+  t->s1.swap(s1);
+  t->s2.swap(s2);
+  t->steps.swap(steps);
+  t->index.swap(index);
   return 0;
+} catch (...) {
+  return -1;
 }
 
 }  // extern "C"

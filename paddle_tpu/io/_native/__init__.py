@@ -1,61 +1,43 @@
 """ctypes bindings for the native data-feed engine (batcher.cpp).
 
-Builds libbatcher.so on first import with g++ (cached next to the source);
+Uses the shared build-on-first-use loader (utils/_native_build.py);
 falls back to None when no toolchain is available — DataLoader then uses
 the pure-Python path."""
 from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 
 _HERE = os.path.dirname(__file__)
 _SRC = os.path.join(_HERE, "batcher.cpp")
 _SO = os.path.join(_HERE, "libbatcher.so")
-_lock = threading.Lock()
-_lib = None
-_tried = False
-
-
-def _build():
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", _SRC, "-o", _SO]
-    subprocess.run(cmd, check=True, capture_output=True)
 
 
 def load():
     """Returns the ctypes lib or None."""
-    global _lib, _tried
-    with _lock:
-        if _lib is not None or _tried:
-            return _lib
-        _tried = True
-        try:
-            if not os.path.exists(_SO) or (
-                    os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-                _build()
-            lib = ctypes.CDLL(_SO)
-        except Exception:
-            return None
-        lib.parallel_collate.argtypes = [
-            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64,
-            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int]
-        lib.queue_create.restype = ctypes.c_void_p
-        lib.queue_create.argtypes = [ctypes.c_int64]
-        lib.queue_push.restype = ctypes.c_int
-        lib.queue_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
-                                   ctypes.c_int64, ctypes.c_int64]
-        lib.queue_next_size.restype = ctypes.c_int64
-        lib.queue_next_size.argtypes = [ctypes.c_void_p]
-        lib.queue_pop.restype = ctypes.c_int64
-        lib.queue_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
-                                  ctypes.c_int64]
-        lib.queue_size.restype = ctypes.c_int64
-        lib.queue_size.argtypes = [ctypes.c_void_p]
-        lib.queue_close.argtypes = [ctypes.c_void_p]
-        lib.queue_destroy.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return _lib
+    from ...utils._native_build import build_and_load
+    return build_and_load(_SRC, _SO, configure=_configure)
+
+
+def _configure(lib):
+    lib.parallel_collate.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_void_p, ctypes.c_int]
+    lib.queue_create.restype = ctypes.c_void_p
+    lib.queue_create.argtypes = [ctypes.c_int64]
+    lib.queue_push.restype = ctypes.c_int
+    lib.queue_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                               ctypes.c_int64, ctypes.c_int64]
+    lib.queue_next_size.restype = ctypes.c_int64
+    lib.queue_next_size.argtypes = [ctypes.c_void_p]
+    lib.queue_pop.restype = ctypes.c_int64
+    lib.queue_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                              ctypes.c_int64]
+    lib.queue_size.restype = ctypes.c_int64
+    lib.queue_size.argtypes = [ctypes.c_void_p]
+    lib.queue_close.argtypes = [ctypes.c_void_p]
+    lib.queue_destroy.argtypes = [ctypes.c_void_p]
 
 
 def collate_stack(arrays, out=None, threads: int = 0):

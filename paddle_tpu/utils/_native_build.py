@@ -1,0 +1,45 @@
+"""Shared build-on-first-use loader for the native (C++) runtime pieces
+(io/_native batcher, distributed/ps/_native table — ONE copy of the
+lock/latch/mtime/g++ convention, so fixes like compile-race handling or
+flag changes apply everywhere).
+
+Builds `src` into `so` with g++ when missing or stale; returns the
+ctypes CDLL, or None when no toolchain is available (callers fall back
+to their pure-Python paths)."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, Optional
+
+_lock = threading.Lock()
+_cache: dict = {}        # so path -> (lib | None)
+
+
+def build_and_load(src: str, so: str,
+                   configure: Optional[Callable] = None,
+                   flags=("-O3", "-shared", "-fPIC", "-pthread")):
+    """configure(lib) sets argtypes/restypes after a successful load.
+    The result (including failure) is latched per `so` path."""
+    with _lock:
+        if so in _cache:
+            return _cache[so]
+        lib = None
+        try:
+            if not os.path.exists(so) or (
+                    os.path.getmtime(so) < os.path.getmtime(src)):
+                # atomic install: a concurrent builder in another
+                # process must never dlopen a half-written .so
+                tmp = so + f".tmp.{os.getpid()}"
+                subprocess.run(["g++", *flags, src, "-o", tmp],
+                               check=True, capture_output=True)
+                os.replace(tmp, so)
+            lib = ctypes.CDLL(so)
+            if configure is not None:
+                configure(lib)
+        except Exception:
+            lib = None
+        _cache[so] = lib
+        return lib
