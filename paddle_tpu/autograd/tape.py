@@ -201,9 +201,17 @@ def apply_op(fn: Callable, *args, n_outputs: int = 1, name: str = "",
 
     diff_inputs = [tensor_args[i] for i in diff_idx]
     if n_outputs == 1 and not isinstance(out, tuple):
-        node = GradNode(vjp_fn, diff_inputs, [(out.shape, out.dtype)], name)
-        t = Tensor(out, stop_gradient=False)
-        t._node, t._out_idx = node, 0
+        # integer/bool outputs (observer ops: isnan, argmax, comparisons)
+        # carry no grad — same guard as the multi-output path below;
+        # attaching a node would pin vjp residuals on every mask/index
+        if jnp.issubdtype(out.dtype, jnp.floating) or \
+                jnp.issubdtype(out.dtype, jnp.complexfloating):
+            node = GradNode(vjp_fn, diff_inputs,
+                            [(out.shape, out.dtype)], name)
+            t = Tensor(out, stop_gradient=False)
+            t._node, t._out_idx = node, 0
+        else:
+            t = Tensor(out, stop_gradient=True)
         _maybe_record((t,))
         return t
     out = tuple(out)

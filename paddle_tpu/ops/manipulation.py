@@ -385,6 +385,11 @@ def where(condition, x=None, y=None, name=None):
 
 
 def nonzero(x, as_tuple=False):
+    # HOST op by nature: the output SHAPE depends on the values, so it
+    # cannot trace into jit / record into a static Program (same class:
+    # histogram/histogramdd/bincount auto-range). Deliberately not
+    # tape-routed — using it inside to_static triggers the concrete-
+    # value graph break, which is the correct behavior.
     arr = np.asarray(unwrap(x))
     nz = np.nonzero(arr)
     if as_tuple:
@@ -713,20 +718,28 @@ def tensordot(x, y, axes=2, name=None):
 def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
     side = "right" if right else "left"
     d = jnp.int32 if out_int32 else core.convert_dtype("int64")
-    return Tensor(jnp.searchsorted(unwrap(sorted_sequence), unwrap(x),
-                                   side=side).astype(d))
+    return apply_op(
+        lambda ss, xx: jnp.searchsorted(ss, xx, side=side).astype(d),
+        to_tensor_like(sorted_sequence), to_tensor_like(x),
+        name="bucketize")
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
     side = "right" if right else "left"
-    ss, v = unwrap(sorted_sequence), unwrap(values)
-    if ss.ndim == 1:
-        out = jnp.searchsorted(ss, v, side=side)
-    else:
-        out = jax.vmap(lambda s, x: jnp.searchsorted(s, x, side=side))(
-            ss.reshape(-1, ss.shape[-1]), v.reshape(-1, v.shape[-1])
-        ).reshape(v.shape)
-    return Tensor(out.astype(jnp.int32))
+    # paddle returns int64 unless out_int32 (matching bucketize above)
+    d = jnp.int32 if out_int32 else core.convert_dtype("int64")
+
+    def f(ss, v):
+        if ss.ndim == 1:
+            out = jnp.searchsorted(ss, v, side=side)
+        else:
+            out = jax.vmap(lambda s, x: jnp.searchsorted(s, x, side=side))(
+                ss.reshape(-1, ss.shape[-1]), v.reshape(-1, v.shape[-1])
+            ).reshape(v.shape)
+        return out.astype(d)
+
+    return apply_op(f, to_tensor_like(sorted_sequence),
+                    to_tensor_like(values), name="searchsorted")
 
 
 def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):

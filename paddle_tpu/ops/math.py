@@ -196,16 +196,9 @@ def logcumsumexp(x, axis=None, dtype=None, name=None):
     return apply_op(f, to_tensor_like(x))
 
 
-def isfinite(x, name=None):
-    return Tensor(jnp.isfinite(unwrap(x)))
-
-
-def isinf(x, name=None):
-    return Tensor(jnp.isinf(unwrap(x)))
-
-
-def isnan(x, name=None):
-    return Tensor(jnp.isnan(unwrap(x)))
+isfinite = make_unary(jnp.isfinite, "isfinite")
+isinf = make_unary(jnp.isinf, "isinf")
+isnan = make_unary(jnp.isnan, "isnan")
 
 
 def increment(x, value=1.0, name=None):
@@ -246,8 +239,7 @@ def exp2(x, name=None):
     return apply_op(jnp.exp2, to_tensor_like(x))
 
 
-def signbit(x, name=None):
-    return Tensor(jnp.signbit(unwrap(x)))
+signbit = make_unary(jnp.signbit, "signbit")
 
 
 def sinc(x, name=None):

@@ -148,16 +148,23 @@ def logsumexp(x, axis=None, keepdim=False, name=None):
 
 
 def all(x, axis=None, keepdim=False, name=None):
-    return Tensor(jnp.all(unwrap(x), axis=_axes(axis), keepdims=keepdim))
+    ax = _axes(axis)
+    return apply_op(lambda a: jnp.all(a, axis=ax, keepdims=keepdim),
+                    to_tensor_like(x), name="all")
 
 
 def any(x, axis=None, keepdim=False, name=None):
-    return Tensor(jnp.any(unwrap(x), axis=_axes(axis), keepdims=keepdim))
+    ax = _axes(axis)
+    return apply_op(lambda a: jnp.any(a, axis=ax, keepdims=keepdim),
+                    to_tensor_like(x), name="any")
 
 
 def count_nonzero(x, axis=None, keepdim=False, name=None):
-    return Tensor(jnp.count_nonzero(unwrap(x), axis=_axes(axis),
-                                    keepdims=keepdim).astype(jnp.int64))
+    ax = _axes(axis)
+    return apply_op(
+        lambda a: jnp.count_nonzero(a, axis=ax,
+                                    keepdims=keepdim).astype(jnp.int64),
+        to_tensor_like(x), name="count_nonzero")
 
 
 def mode(x, axis=-1, keepdim=False, name=None):

@@ -15,20 +15,26 @@ __all__ = ["argmax", "argmin", "argsort", "sort", "topk", "kthvalue"]
 
 def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
     d = core.convert_dtype(dtype)
-    out = jnp.argmax(unwrap(x), axis=axis, keepdims=keepdim if axis is not None else False)
-    return Tensor(out.astype(d))
+    kd = keepdim if axis is not None else False
+    return apply_op(
+        lambda a: jnp.argmax(a, axis=axis, keepdims=kd).astype(d),
+        to_tensor_like(x), name="argmax")
 
 
 def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
     d = core.convert_dtype(dtype)
-    out = jnp.argmin(unwrap(x), axis=axis, keepdims=keepdim if axis is not None else False)
-    return Tensor(out.astype(d))
+    kd = keepdim if axis is not None else False
+    return apply_op(
+        lambda a: jnp.argmin(a, axis=axis, keepdims=kd).astype(d),
+        to_tensor_like(x), name="argmin")
 
 
 def argsort(x, axis=-1, descending=False, stable=False, name=None):
-    a = unwrap(x)
-    out = jnp.argsort(-a if descending else a, axis=axis, stable=stable or descending)
-    return Tensor(out.astype(jnp.int64))
+    return apply_op(
+        lambda a: jnp.argsort(-a if descending else a, axis=axis,
+                              stable=stable or descending
+                              ).astype(jnp.int64),
+        to_tensor_like(x), name="argsort")
 
 
 def sort(x, axis=-1, descending=False, stable=False, name=None):

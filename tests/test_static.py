@@ -146,3 +146,24 @@ def test_to_static_still_compiles_clean_fns():
     b = fn(xp)
     assert calls["n"] == 1, "clean fn must stay compiled (traced once)"
     np.testing.assert_allclose(np.asarray(a.numpy()), np.asarray(b.numpy()))
+
+
+def test_observer_ops_record_into_program(static_mode):
+    """Comparisons and observer ops (isnan/all/argmax) must RECORD into
+    the program — the round-4 soundness fix: previously they bypassed
+    the tape and their results were baked as constants, so a different
+    feed silently replayed stale branches."""
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data("x", [4], "float32")
+        gt = x > 0.0
+        n_pos = paddle.sum(gt.astype("float32"))
+        am = paddle.argmax(x)
+    exe = paddle.static.Executor()
+    a = np.array([1.0, -1.0, 2.0, -2.0], np.float32)
+    b = np.array([-1.0, -1.0, -3.0, 5.0], np.float32)
+    na, ia = exe.run(prog, feed={"x": a}, fetch_list=[n_pos, am])
+    nb, ib = exe.run(prog, feed={"x": b}, fetch_list=[n_pos, am])
+    assert float(na) == 2.0 and int(ia) == 2
+    # the old frozen-constant bug would return (2.0, 2) again here
+    assert float(nb) == 1.0 and int(ib) == 3
