@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""On-chip serving smoke (runbook step 5): drive the paged-pool
+continuous-batching engine on the real TPU and print one JSON line with
+decode tokens/s — the first hardware number for the round-4 KV pool.
+
+Usage (on TPU, helper alive): python tools/serving_onchip_smoke.py
+Env: SMOKE_MODEL (tiny|350m, default 350m on TPU), SMOKE_BATCH,
+SMOKE_SEQ, SMOKE_TICKS.
+
+Safety: probes the axon compile helper first (dead helper = hang), arms
+a wall watchdog, and never kills a TPU-touching process (exits via the
+watchdog instead)."""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def helper_alive() -> bool:
+    s = socket.socket()
+    s.settimeout(3)
+    try:
+        s.connect(("127.0.0.1", int(os.environ.get("AXON_COMPILE_PORT",
+                                                   "8083"))))
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+def main():
+    if os.environ.get("JAX_PLATFORMS") != "cpu" and not helper_alive():
+        print(json.dumps({"metric": "serving_smoke_skipped", "value": 0.0,
+                          "unit": "tokens/s",
+                          "extra": {"reason": "compile helper down"}}))
+        return 0
+    budget = int(os.environ.get("SMOKE_WALL_TIMEOUT", "1800"))
+    signal.signal(signal.SIGALRM, lambda *_: (_ for _ in ()).throw(
+        TimeoutError(f"serving smoke exceeded {budget}s")))
+    signal.alarm(budget)
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import (ContinuousBatchingEngine,
+                                      GenerationRequest)
+    from paddle_tpu.models import llama as L
+
+    import jax
+    on_tpu = jax.devices()[0].platform == "tpu"
+    size = os.environ.get("SMOKE_MODEL", "350m" if on_tpu else "tiny")
+    cfg = {"tiny": L.llama_tiny, "350m": L.llama_350m}[size](
+        use_recompute=False)
+    B = int(os.environ.get("SMOKE_BATCH", 8 if on_tpu else 2))
+    S = int(os.environ.get("SMOKE_SEQ", 512 if on_tpu else 64))
+    ticks = int(os.environ.get("SMOKE_TICKS", 64 if on_tpu else 8))
+
+    paddle.seed(0)
+    model = L.LlamaForCausalLM(cfg)
+    # pool at half the dense equivalent: the round-4 memory claim runs
+    # on hardware, not just the CPU test
+    ppseq = S // 16
+    eng = ContinuousBatchingEngine(model, max_batch=B, max_seq=S,
+                                   prefill_buckets=(32, 64, 128),
+                                   total_pages=(B * ppseq) // 2 + 1)
+    rng = np.random.default_rng(0)
+    for i in range(B):
+        eng.add_request(GenerationRequest(
+            list(rng.integers(1, cfg.vocab_size, 16)),
+            max_new_tokens=ticks + 8))
+    for _ in range(3):                       # admission + compile
+        eng.step()
+    produced0 = sum(s.produced for s in eng.slots if not s.free)
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        eng.step()
+    dt = time.perf_counter() - t0
+    produced1 = sum(s.produced for s in eng.slots if not s.free) + sum(
+        len(r.output) for r in eng.finished)
+    rate = (produced1 - produced0) / dt
+    print(json.dumps({
+        "metric": f"serving_decode_tokens_per_s_{size}",
+        "value": round(rate, 2), "unit": "tokens/s",
+        "extra": {"batch": B, "max_seq": S, "ticks": ticks,
+                  "pool_pages": eng.pool.n_pages,
+                  "kv_pool_bytes": eng.kv_cache_bytes,
+                  "dense_equiv_bytes": eng.dense_equivalent_bytes,
+                  "preemptions": eng.preemptions,
+                  "device": str(jax.devices()[0].device_kind
+                                if on_tpu else "cpu")}}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
