@@ -261,3 +261,41 @@ class TestGQAServing:
         while eng.has_work:
             eng.step()
         assert eng.finished[0].output == ref
+
+
+class TestBatchedAdmission:
+    def test_group_admission_one_prefill_call_exact_parity(self):
+        """Same-bucket requests admitted in one tick share ONE batched
+        prefill (compile cache keyed (bucket, k)) and still produce the
+        exact isolated-greedy outputs."""
+        model = _tiny_model()
+        eng = ContinuousBatchingEngine(model, max_batch=4, max_seq=64,
+                                       prefill_buckets=(8,))
+        reqs = [GenerationRequest([i + 2, 2 * i + 1], max_new_tokens=5)
+                for i in range(4)]
+        for r in reqs:
+            eng.add_request(r)
+        eng.step()                        # one tick admits all four
+        assert all(not s.free for s in eng.slots)
+        # one batched compile: (bucket=8, k=4) — not four (8, 1) entries
+        assert set(eng._compiled_prefill) == {(8, 4)}, \
+            set(eng._compiled_prefill)
+        while eng.has_work:
+            eng.step()
+        for r in reqs:
+            assert r.output == _reference_generate(model, r.prompt, 5), \
+                r.prompt
+
+    def test_mixed_buckets_group_separately(self):
+        model = _tiny_model()
+        eng = ContinuousBatchingEngine(model, max_batch=4, max_seq=64,
+                                       prefill_buckets=(8, 16))
+        eng.add_request(GenerationRequest([1, 2], max_new_tokens=3))
+        eng.add_request(GenerationRequest(list(range(1, 13)),
+                                          max_new_tokens=3))
+        eng.step()
+        assert (8, 1) in eng._compiled_prefill
+        assert (16, 1) in eng._compiled_prefill
+        while eng.has_work:
+            eng.step()
+        assert len(eng.finished) == 2

@@ -36,10 +36,17 @@ def helper_alive() -> bool:
 
 
 def main():
-    if os.environ.get("JAX_PLATFORMS") != "cpu" and not helper_alive():
+    # the helper gate only applies when the axon tunnel backend is in
+    # play (same detection as bench.py) — a plain CPU box must run the
+    # CPU smoke path, not read a bogus "helper down" skip
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    axon_in_play = ("axon" in platforms
+                    or (not platforms
+                        and bool(os.environ.get("PALLAS_AXON_POOL_IPS"))))
+    if axon_in_play and not helper_alive():
         print(json.dumps({"metric": "serving_smoke_skipped", "value": 0.0,
                           "unit": "tokens/s",
-                          "extra": {"reason": "compile helper down"}}))
+                          "extra": {"reason": "axon compile helper down"}}))
         return 0
     budget = int(os.environ.get("SMOKE_WALL_TIMEOUT", "1800"))
     signal.signal(signal.SIGALRM, lambda *_: (_ for _ in ()).throw(
