@@ -21,6 +21,7 @@ Layered lookup:
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
@@ -160,6 +161,60 @@ def forget(key: str):
         _user_cache = _update_file(path, mutate)
 
 
+class _CandidateTimeout(Exception):
+    """A candidate blew its wall budget (lost tunnel compile, wedged
+    executor) — skip it; never let one candidate stall the sweep."""
+
+
+@contextlib.contextmanager
+def _candidate_deadline():
+    """SIGALRM-armed context for one candidate's compile+measure. A
+    remote-compile request over the axon tunnel can be silently dropped
+    (observed r4: the CE sweep's first candidate blocked 40+ min on a
+    Python socket wait); a per-candidate wall budget turns that into a
+    skipped candidate. Main-thread only — elsewhere it degrades to a
+    no-op. Limitation: SIGALRM only interrupts Python-level waits; a
+    block inside jaxlib's C++ client fires the handler only when the C
+    call returns, so pair sweeps with a process-level watchdog (bench.py
+    _arm_wall_watchdog) for full coverage."""
+    import signal
+
+    if not hasattr(signal, "SIGALRM"):
+        yield  # no-op where SIGALRM doesn't exist (Windows)
+        return
+    try:
+        budget = int(os.environ.get(
+            "PADDLE_AUTOTUNE_CANDIDATE_TIMEOUT", "300"))
+    except ValueError:
+        import sys
+        print("autotune: malformed PADDLE_AUTOTUNE_CANDIDATE_TIMEOUT "
+              f"{os.environ['PADDLE_AUTOTUNE_CANDIDATE_TIMEOUT']!r}; "
+              "using 300", file=sys.stderr)
+        budget = 300
+    if (budget <= 0 or threading.current_thread()
+            is not threading.main_thread()):
+        yield
+        return
+
+    def on_alarm(signum, frame):
+        raise _CandidateTimeout()
+
+    import time as _time
+    old_handler = signal.signal(signal.SIGALRM, on_alarm)
+    armed_at = _time.monotonic()
+    prev_remaining = signal.alarm(budget)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old_handler)
+        if prev_remaining:
+            # an outer whole-run watchdog (bench.py) was armed: re-arm
+            # what's left of its budget rather than silently disarming it
+            elapsed = int(_time.monotonic() - armed_at)
+            signal.alarm(max(prev_remaining - elapsed, 1))
+
+
 def _time_candidate(fn: Callable[[], Any], iters: int) -> float:
     """Median-of-3 wall time (ms per iteration) of a jitted loop."""
     import time
@@ -218,10 +273,17 @@ def autotune(key: str, candidates: Sequence[Any],
     best, best_t = default, float("inf")
     for cand in candidates:
         try:
-            fn = make_fn(cand)
-            if fn is None:
-                continue
-            t = _time_candidate(fn, iters)
+            with _candidate_deadline():
+                fn = make_fn(cand)
+                if fn is None:
+                    continue
+                t = _time_candidate(fn, iters)
+        except _CandidateTimeout:
+            import sys
+            print(f"autotune: candidate {cand} for {key} exceeded "
+                  "PADDLE_AUTOTUNE_CANDIDATE_TIMEOUT — skipped",
+                  file=sys.stderr)
+            continue
         except Exception:
             continue  # candidate doesn't compile/fit — skip
         timings[str(cand)] = t
