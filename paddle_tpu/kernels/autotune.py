@@ -202,7 +202,12 @@ def _candidate_deadline():
     import time as _time
     old_handler = signal.signal(signal.SIGALRM, on_alarm)
     armed_at = _time.monotonic()
-    prev_remaining = signal.alarm(budget)
+    prev_remaining = signal.alarm(0)
+    if prev_remaining:
+        # never postpone a sooner outer deadline (bench.py whole-run
+        # watchdog): the candidate budget is capped by what's left of it
+        budget = min(budget, prev_remaining)
+    signal.alarm(budget)
     try:
         yield
     finally:

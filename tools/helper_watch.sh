@@ -1,0 +1,24 @@
+#!/bin/bash
+# Probe the axon compile helper every 2 minutes; the moment it answers,
+# fire the one-claim measurement session (tools/onchip_session.py) and
+# exit. Results append to benchmarks/ONCHIP_R4.jsonl. The helper dying
+# mid-session is survivable: each section has its own wall budget and
+# already-landed sections persist.
+cd "$(dirname "$0")/.." || exit 1
+PORT="${AXON_COMPILE_PORT:-8083}"
+DEADLINE="${HELPER_WATCH_DEADLINE:-21600}"  # give up after 6 h
+START=$(date +%s)
+while true; do
+  if timeout 3 bash -c "echo > /dev/tcp/127.0.0.1/${PORT}" 2>/dev/null; then
+    echo "$(date -u +%H:%M:%S) helper ALIVE — launching on-chip session" >&2
+    # settle 10 s (a freshly restarted helper may still be wiring up)
+    sleep 10
+    python tools/onchip_session.py
+    exit $?
+  fi
+  if (( $(date +%s) - START > DEADLINE )); then
+    echo "helper never returned within ${DEADLINE}s" >&2
+    exit 1
+  fi
+  sleep 120
+done

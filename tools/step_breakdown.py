@@ -6,14 +6,17 @@ MFU regression; ref: the reference's op-benchmark CI
 Times, on the real chip, each piece of the bench train step so a
 regression can be attributed instead of guessed at:
 
-  dispatch   — trivial jitted fn (tunnel/executor round-trip floor)
-  fwd        — model forward + loss only
-  fwdbwd     — forward + backward (no optimizer)
-  step       — full TrainStep (fwd + bwd + AdamW), the bench number
-  attn       — one attention layer fwd+bwd at bench shapes
-  mlp        — one SwiGLU MLP fwd+bwd
-  lmhead_ce  — logits matmul + fused CE fwd+bwd
-  adamw      — optimizer update alone on the full param tree
+  dispatch      — trivial jitted fn (tunnel/executor round-trip floor)
+  fwd           — model forward + loss only
+  fwdbwd        — forward + backward (no optimizer)
+  step          — full TrainStep (fwd + bwd + AdamW), the bench number
+  step_unfused  — same with r2-era unfused qkv/mlp layouts (BENCH_UNFUSED=1)
+  attn_kernel   — flash-attention kernel fwd+bwd at bench shapes
+  attn_flash_b1 / attn_dense_b1 — flash vs dense-XLA attention at B=1
+  mlp           — one SwiGLU MLP fwd+bwd
+  lmhead_ce     — logits matmul + fused (Pallas) CE fwd+bwd
+  lmhead_ce_xla — same head through plain-XLA log_softmax CE
+  adamw         — optimizer update alone on the full param tree
 
 Prints one JSON line per piece: {"piece": ..., "ms": ..., "iters": N}.
 Timing forces a host transfer per iteration batch (the tunnel does not
@@ -121,18 +124,32 @@ def main():
     jgrad = jax.jit(lambda p, o, i: jax.grad(loss_of)(p, o, i))
     emit("fwdbwd", _time(jgrad, iters, params, other, ids.data))
 
+    # full TrainStep timing, shared by the fused (bench-path) and
+    # unfused (r2-layout) variants so the two stay comparable
+    def _time_full_step(size, S, iters, use_model=None, **cfg_kw):
+        if use_model is None:
+            paddle.seed(0)
+            cfg_v = {"tiny": L.llama_tiny, "350m": L.llama_350m,
+                     "1b": L.llama_1b, "7b": L.llama_7b}[size](**cfg_kw)
+            cfg_v.max_position_embeddings = max(
+                cfg_v.max_position_embeddings, S)
+            use_model = L.LlamaForCausalLM(cfg_v)
+        opt_v = popt.AdamW(learning_rate=3e-4,
+                           parameters=use_model.parameters(),
+                           weight_decay=0.1)
+        step_v = paddle.jit.TrainStep(
+            use_model, opt_v, lambda i, l: use_model.loss(i, l))
+        for _ in range(6):
+            loss = step_v(ids, ids)
+        float(loss.numpy())
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step_v(ids, ids)
+        float(loss.numpy())
+        return (time.perf_counter() - t0) / iters * 1e3
+
     # full step (bench path)
-    opt = popt.AdamW(learning_rate=3e-4, parameters=model.parameters(),
-                     weight_decay=0.1)
-    step = paddle.jit.TrainStep(model, opt, lambda i, l: model.loss(i, l))
-    for _ in range(6):
-        loss = step(ids, ids)
-    float(loss.numpy())
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step(ids, ids)
-    float(loss.numpy())
-    emit("step", (time.perf_counter() - t0) / iters * 1e3)
+    emit("step", _time_full_step(size, S, iters, use_model=model))
 
     # one attention layer fwd+bwd at bench shapes
     from paddle_tpu.kernels import flash_attention as fa
@@ -159,7 +176,9 @@ def main():
 
     emit("mlp", _time(jax.jit(jax.grad(mlp)), iters, x))
 
-    # lm head + fused CE fwd+bwd
+    # lm head + fused CE fwd+bwd, vs the plain-XLA CE it replaced
+    # (815228d landed the Pallas CE between the r2 measurement and r4 —
+    # this pair attributes its real on-chip cost)
     V = cfg.vocab_size
     wlm = jax.random.normal(jax.random.PRNGKey(5), (h, V), jnp.bfloat16)
     lbl = jnp.asarray(rng.integers(0, V, (B * S,)).astype(np.int32))
@@ -172,10 +191,46 @@ def main():
 
     emit("lmhead_ce", _time(jax.jit(jax.grad(head)), iters, x))
 
-    # optimizer update alone: reuse TrainStep's compiled update by timing
-    # an AdamW-shaped tree update
-    # re-capture: the TrainStep above donated (deleted) the original
-    # param buffers; the model now holds the updated arrays
+    def head_xla(x):
+        lg = (x @ wlm).astype(jnp.float32)
+        logp = jax.nn.log_softmax(lg, axis=-1)
+        nll = -jnp.take_along_axis(logp, lbl[:, None], axis=-1)[:, 0]
+        return jnp.mean(nll)
+
+    emit("lmhead_ce_xla", _time(jax.jit(jax.grad(head_xla)), iters, x))
+
+    # flash vs dense-XLA attention at B=1 (dense at full B would chance
+    # an HBM blowup; the per-call ratio is what matters)
+    if fa.supported(q.shape, k.shape, True):
+        q1, k1, v1 = q[:1], k[:1], v[:1]
+        jf1 = jax.jit(jax.grad(lambda q_: fa.flash_attention_bshd(
+            q_, k1, v1, causal=True).astype(jnp.float32).sum()))
+        emit("attn_flash_b1", _time(jf1, iters, q1))
+
+        def dense(q_):
+            qt = jnp.swapaxes(q_, 1, 2).astype(jnp.float32)
+            kt = jnp.swapaxes(k1, 1, 2).astype(jnp.float32)
+            vt = jnp.swapaxes(v1, 1, 2).astype(jnp.float32)
+            s = qt @ jnp.swapaxes(kt, -1, -2) / (D ** 0.5)
+            mask = jnp.tril(jnp.ones((S, S), bool))
+            s = jnp.where(mask, s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1)
+            return (p @ vt).astype(jnp.float32).sum()
+
+        emit("attn_dense_b1", _time(jax.jit(jax.grad(dense)), iters, q1))
+
+    # full step with the r2-era UNFUSED llama layouts (fuse_attention_qkv
+    # / fuse_mlp landed in 815228d, after the last good measurement) —
+    # attributes the fused-matmul change. BENCH_UNFUSED=1 opts in (one
+    # extra full-step compile is ~3 min of chip time).
+    if os.environ.get("BENCH_UNFUSED", "0") not in ("0", "", "false"):
+        emit("step_unfused", _time_full_step(
+            size, S, iters, fuse_attention_qkv=False, fuse_mlp=False))
+
+    # optimizer update alone: an AdamW-shaped tree update at the model's
+    # full param count.
+    # re-capture first: the TrainStep above donated (deleted) the
+    # original param buffers; the model now holds the updated arrays
     params = {k: t.data for k, t in model.state_dict().items()
               if k in set(pkeys)}
     grads = {k: jnp.zeros_like(v) for k, v in params.items()}
