@@ -29,8 +29,13 @@ class Strategy:
         self.mp_degree = config.get("mp_degree", 1)
         self.pp_degree = config.get("pp_degree", 1)
         self.dp_degree = config.get("dp_degree", -1)
-        self.amp = config.get("amp", {}).get("enable", False)
+        amp = config.get("amp", {})
+        self.amp = amp.get("enable", False)
+        self.amp_level = amp.get("level", "O1")
+        self.amp_dtype = amp.get("dtype", "bfloat16")
         self.recompute = config.get("recompute", {}).get("enable", False)
+        # kept as the raw mutable dict; consumers read it at use-site so
+        # strategy.gradient_merge["k_steps"] = 4 keeps working
         self.gradient_merge = config.get("gradient_merge", {})
 
 
@@ -206,50 +211,190 @@ class Engine:
         from ..sharding import ShardingPlan
 
         model, loss_fn = self.model, self.loss
+        self._inputs_spec = inputs_spec
+        self._labels_spec = labels_spec
 
-        def step_fn(*batch):
-            *xs, y = batch
-            out = model(*xs)
-            return loss_fn(out, y)
+        if s.amp:
+            # bf16 autocast traced into the step (ref: the amp pass the
+            # static engine inserts when strategy.amp.enable)
+            from ... import amp as _amp
+
+            def step_fn(*batch):
+                *xs, y = batch
+                with _amp.auto_cast(level=s.amp_level, dtype=s.amp_dtype):
+                    out = model(*xs)
+                    return loss_fn(out, y)
+        else:
+            def step_fn(*batch):
+                *xs, y = batch
+                out = model(*xs)
+                return loss_fn(out, y)
+
+        if s.recompute and hasattr(model, "use_recompute"):
+            model.use_recompute = True
 
         plan = ShardingPlan(self._mesh, stage=s.sharding_stage)
         self._plan = plan
+        gm = s.gradient_merge
+        accum = int(gm.get("k_steps", 1)) if gm.get("enable") else 1
         self._step = pjit.TrainStep(model, self.optimizer, step_fn,
-                                    shard=plan)
+                                    shard=plan, accumulate_steps=accum)
         return self
 
+    def _loader_for(self, data, batch_size, shuffle=False,
+                    drop_last=False):
+        """DataLoader with a per-process dp shard when the job is
+        multi-process (ref engine.py _prepare_dataloader →
+        DistributedBatchSampler): under single-process GSPMD the whole
+        global batch is fed and the mesh shards it, so no sampler.
+        Training passes drop_last=True — a short final batch would break
+        both the mesh's batch-divisibility and the gradient-merge split
+        (and force a retrace per odd shape)."""
+        import jax
+
+        from ...io import DataLoader, DistributedBatchSampler
+        if isinstance(data, DataLoader):
+            if drop_last and not getattr(data, "drop_last", False) \
+                    and getattr(data, "batch_sampler", None) is not None \
+                    and not getattr(data.batch_sampler, "drop_last", False):
+                import warnings
+                warnings.warn(
+                    "Engine.fit received a DataLoader without drop_last; "
+                    "a short final batch will break gradient-merge / mesh "
+                    "batch divisibility and force a retrace", stacklevel=3)
+            return data
+        # PROCESS-level sharding only: each process feeds its slice and
+        # GSPMD shards within the process's devices (a single process
+        # over a virtual/real mesh feeds the whole global batch)
+        world = jax.process_count()
+        if world > 1:
+            sampler = DistributedBatchSampler(
+                data, batch_size, num_replicas=world,
+                rank=jax.process_index(), shuffle=shuffle,
+                drop_last=drop_last)
+            return DataLoader(data, batch_sampler=sampler)
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last)
+
     def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
-            log_freq=10, verbose=0, **kw):
+            valid_data=None, valid_freq=1, log_freq=10, verbose=2,
+            callbacks=None, save_dir=None, save_freq=1, **kw):
+        """ref static/engine.py Engine.fit:991 — full orchestration:
+        callbacks, periodic evaluate, LR scheduler stepping, checkpoint
+        saves; the train step itself is ONE compiled executable
+        (gradient-merge scan included when strategy asks for it)."""
         if self._step is None:
             self.prepare(global_batch=batch_size)
-        from ...io import DataLoader, Dataset
-        loader = (train_data if isinstance(train_data, DataLoader)
-                  else DataLoader(train_data, batch_size=batch_size,
-                                  shuffle=True))
+        from ...hapi.callbacks import config_callbacks
+        loader = self._loader_for(train_data, batch_size, shuffle=True,
+                                  drop_last=True)
+        steps = steps_per_epoch
+        if steps is None:
+            try:
+                steps = len(loader)
+            except TypeError:
+                steps = None
+        # the Engine plays the hapi-Model role for callbacks: .save
+        # (ModelCheckpoint), .stop_training (EarlyStopping), ._optimizer
+        # (LRScheduler steps the scheduler per batch)
+        self._optimizer = self.optimizer
+        self.stop_training = False
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, verbose=verbose, save_freq=save_freq,
+            save_dir=save_dir,
+            metrics=["loss"] + [m.name() for m in self.metrics])
         history = {"loss": []}
+        logs = {}
+        for c in cbks:
+            c.on_train_begin(logs)
         for ep in range(epochs):
+            sampler = getattr(loader, "batch_sampler", None)
+            if hasattr(sampler, "set_epoch"):
+                sampler.set_epoch(ep)   # reshuffle the dp shard per epoch
+            for c in cbks:
+                c.on_epoch_begin(ep, logs)
             for i, batch in enumerate(loader):
                 if steps_per_epoch is not None and i >= steps_per_epoch:
                     break
+                for c in cbks:
+                    c.on_train_batch_begin(i, logs)
                 xs, y = batch[:-1], batch[-1]
                 loss = self._step(*xs, y)
-                history["loss"].append(float(loss.numpy()))
-                if verbose and i % log_freq == 0:
-                    print(f"epoch {ep} step {i}: loss "
-                          f"{history['loss'][-1]:.4f}")
+                logs = {"loss": float(loss.numpy())}
+                history["loss"].append(logs["loss"])
+                for c in cbks:
+                    c.on_train_batch_end(i, logs)
+            if valid_data is not None and (ep + 1) % valid_freq == 0:
+                eval_res = self.evaluate(valid_data, batch_size=batch_size,
+                                         callbacks=cbks)
+                logs.update({f"val_{k}": v for k, v in eval_res.items()})
+                for k, v in eval_res.items():
+                    history.setdefault(f"val_{k}", []).append(v)
+            for c in cbks:
+                c.on_epoch_end(ep, logs)
+            if self.stop_training:
+                import jax
+                if jax.process_count() > 1:
+                    # per-process val shards see DIFFERENT losses: one
+                    # process breaking out of a collective train loop
+                    # while others continue is a distributed hang. Early
+                    # stop needs a job-level decision; until then it is
+                    # advisory in multi-process runs.
+                    import warnings
+                    warnings.warn(
+                        "EarlyStopping triggered on this process's val "
+                        "shard; ignored in multi-process runs (processes "
+                        "must agree or the collective step deadlocks)")
+                    self.stop_training = False
+                else:
+                    break
+        for c in cbks:
+            c.on_train_end(logs)
         return history
 
-    def evaluate(self, valid_data, batch_size=1, **kw):
+    def evaluate(self, valid_data, batch_size=1, callbacks=None, **kw):
+        """Loss + every configured paddle.metric over the eval set
+        (ref Engine.evaluate:1103). Runs under the strategy's autocast
+        so the val numbers EarlyStopping/checkpointing monitor are in
+        the same numerics as training. (The forward is eager and
+        unsharded — a model that only fits sharded needs an eval step
+        over the mesh, which fit's train path provides but evaluate
+        does not yet.)"""
+        import contextlib
+
         from ...framework import core
-        from ...io import DataLoader
-        loader = (valid_data if isinstance(valid_data, DataLoader)
-                  else DataLoader(valid_data, batch_size=batch_size))
+        s = self.strategy
+        amp_ctx = contextlib.nullcontext
+        if s.amp:
+            from ... import amp as _amp
+            amp_ctx = lambda: _amp.auto_cast(level=s.amp_level,
+                                             dtype=s.amp_dtype)
+        loader = self._loader_for(valid_data, batch_size)
+        for m in self.metrics:
+            m.reset()
+        cbks = callbacks or []
+        for c in cbks:
+            c.on_eval_begin()
         losses = []
         with core.no_grad_guard():
-            for batch in loader:
+            for i, batch in enumerate(loader):
+                for c in cbks:
+                    c.on_eval_batch_begin(i)
                 xs, y = batch[:-1], batch[-1]
-                losses.append(float(self.loss(self.model(*xs), y).numpy()))
-        return {"loss": float(np.mean(losses))}
+                with amp_ctx():
+                    out = self.model(*xs)
+                    losses.append(float(self.loss(out, y).numpy()))
+                for m in self.metrics:
+                    m.update(*_as_tuple(m.compute(out, y)))
+                for c in cbks:
+                    c.on_eval_batch_end(i, {"loss": losses[-1]})
+        res = {"loss": float(np.mean(losses))}
+        for m in self.metrics:
+            res[m.name()] = m.accumulate()
+        for c in cbks:
+            c.on_eval_end(res)
+        return res
 
     def predict(self, test_data, batch_size=1, **kw):
         from ...framework import core
@@ -265,9 +410,52 @@ class Engine:
         return outs
 
     def save(self, path, training=True):
+        """Model (+ optimizer when training=True) as a distributed
+        checkpoint with reshard-on-load (ref Engine.save:1436 writes
+        both; dist_saver.py). Array-valued optimizer slots go through
+        the resharding checkpoint; scalar/meta entries (@step,
+        LR_Scheduler) ride a plain paddle.save file alongside."""
+        import os
+
+        from ... import save as _save
         from .. import checkpoint as dck
         dck.save_state_dict(dict(self.model.state_dict()), path)
+        if training and self.optimizer is not None:
+            sd = self.optimizer.state_dict()
+            arrays = {k: v for k, v in sd.items()
+                      if hasattr(v, "shape") or hasattr(v, "data")}
+            meta = {k: v for k, v in sd.items() if k not in arrays}
+            if arrays:
+                dck.save_state_dict(arrays, path + ".opt")
+            if meta:
+                os.makedirs(path + ".opt", exist_ok=True)
+                _save(meta, os.path.join(path + ".opt", "meta.pdopt"))
 
     def load(self, path, strict=True, load_optimizer=True):
+        import os
+
+        from ... import load as _load
         from .. import checkpoint as dck
         dck.load_state_dict(dict(self.model.state_dict()), path)
+        if load_optimizer and self.optimizer is not None \
+                and os.path.isdir(path + ".opt"):
+            # a fresh optimizer has no state slots yet (they are created
+            # lazily) — prime() materializes them so the checkpoint has
+            # a template to reshard into
+            if hasattr(self.optimizer, "prime"):
+                self.optimizer.prime()
+            state = {}
+            opt_sd = {k: v for k, v in self.optimizer.state_dict().items()
+                      if hasattr(v, "shape") or hasattr(v, "data")}
+            if opt_sd:
+                dck.load_state_dict(opt_sd, path + ".opt")
+                state.update(opt_sd)
+            meta_path = os.path.join(path + ".opt", "meta.pdopt")
+            if os.path.exists(meta_path):
+                state.update(_load(meta_path))
+            if state:
+                self.optimizer.set_state_dict(state)
+
+
+def _as_tuple(x):
+    return x if isinstance(x, (tuple, list)) else (x,)

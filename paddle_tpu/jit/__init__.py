@@ -210,10 +210,22 @@ class TrainStep:
 
     Optional `shard`: a paddle_tpu.distributed.ShardingPlan that places
     params/optimizer state/batch on a mesh (GSPMD partitioning).
+
+    Optional `accumulate_steps=k` (ref: the GradientMerge meta-optimizer
+    pass, fleet/meta_optimizers/gradient_merge_optimizer.py): the batch
+    is split into k micro-batches on its leading axis and a lax.scan
+    inside the SAME executable accumulates gradients across them, with
+    ONE optimizer update at the end — activation memory drops ~k-fold
+    while the optimizer sees the full global batch. The reference
+    replays the program k times and conditions the update on a step
+    counter; under XLA the scan keeps it a single compiled step with no
+    host round-trips. Requires batch leading dims divisible by k;
+    incompatible with a GradScaler (bf16 training needs no loss
+    scaling — pass scaler=None).
     """
 
     def __init__(self, model, optimizer, step_fn, scaler=None, shard=None,
-                 donate=True):
+                 donate=True, accumulate_steps=1):
         self.model = model
         self.optimizer = optimizer
         self.step_fn = step_fn
@@ -223,6 +235,12 @@ class TrainStep:
             shard.attach_model(model)
         self._compiled = None
         self._donate = donate
+        self._accum = int(accumulate_steps)
+        if self._accum > 1 and scaler is not None:
+            raise ValueError(
+                "accumulate_steps > 1 is incompatible with a GradScaler: "
+                "micro-grads are merged unscaled inside one executable "
+                "(bf16 training does not need loss scaling)")
 
     def _capture_state(self):
         params = {}
@@ -240,6 +258,62 @@ class TrainStep:
         opt = self.optimizer
         step_fn = self.step_fn
         scaler = self.scaler
+        accum = self._accum
+
+        def run_accum(batch, key):
+            """Gradient-merge path: lax.scan over k micro-batches, grads
+            accumulated as the carry, one optimizer update at the end.
+            Runs under model.use_state, so sd tensors are the traced
+            params."""
+            from ..tensor import Tensor as _TT
+            sd = model.state_dict()
+            pkeys = [k for k, t in sd.items()
+                     if not getattr(t, "stop_gradient", True)]
+            ptensors = [sd[k] for k in pkeys]
+
+            def split_leading(x):
+                if x.shape[0] % accum:
+                    raise ValueError(
+                        f"accumulate_steps={accum} must divide the batch "
+                        f"leading dim {x.shape[0]}")
+                return x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
+
+            micro = jax.tree_util.tree_map(split_leading, batch)
+            mkeys = jax.random.key_data(jax.random.split(key, accum))
+            zero = [jnp.zeros_like(p.data) for p in ptensors]
+            # which params the loss actually reaches is STATIC (the scan
+            # body traces once); record it so untouched params keep
+            # grad=None and are skipped by opt.step() exactly like the
+            # non-accumulating path (no spurious weight-decay updates)
+            touched = set()
+
+            def body(carry, xs):
+                acc, loss_sum = carry
+                mb, mk = xs
+                with core.rng_key_context(jax.random.wrap_key_data(mk)):
+                    loss = step_fn(*_tree_box(mb))
+                    loss.backward()
+                new_acc = []
+                for i, (a, p) in enumerate(zip(acc, ptensors)):
+                    g = p.grad
+                    if g is None:
+                        new_acc.append(a)
+                    else:
+                        touched.add(i)
+                        gd = g.data if isinstance(g, _TT) else g
+                        new_acc.append(a + gd.astype(a.dtype))
+                opt.clear_grad()
+                return (new_acc,
+                        loss_sum + loss.data.astype(jnp.float32)), None
+
+            (grads, loss_sum), _ = jax.lax.scan(
+                body, (zero, jnp.float32(0)), (micro, mkeys))
+            inv_k = 1.0 / accum
+            for i, (p, g) in enumerate(zip(ptensors, grads)):
+                if i in touched:
+                    p.grad = _TT((g * inv_k).astype(g.dtype))
+            opt.step()
+            return _TT(loss_sum * inv_k)
 
         def pure(params, buffers, opt_state, master, scaler_state, step_i,
                  lr, key, batch):
@@ -261,8 +335,12 @@ class TrainStep:
                     opt._state = dict(opt_state)
                     opt._step_count = step_i
                     opt._master_weights = dict(master)
-                    if not hasattr(opt._lr, "step"):
-                        opt._lr = lr
+                    # ALWAYS run the compiled update off the per-call lr
+                    # argument: __call__ evaluates scheduler/value on the
+                    # host each step. Keeping a scheduler object here
+                    # would bake float(scheduler()) at TRACE time — the
+                    # schedule would silently never reach the weights.
+                    opt._lr = lr
                     if scaler is not None:
                         scaler._set_traced_state(scaler_state)
                     try:
@@ -271,6 +349,8 @@ class TrainStep:
                             scaler.scale(loss).backward()
                             scaler.step(opt)
                             scaler.update()
+                        elif accum > 1:
+                            loss = run_accum(batch, key)
                         else:
                             loss = step_fn(*_tree_box(batch))
                             loss.backward()

@@ -164,3 +164,212 @@ def test_auto_tuner_picks_known_best():
                       metric_mode="min")
     best = tuner.tune()
     assert best.config["mp_degree"] == 4
+
+
+def test_engine_fit_orchestration_callbacks_metrics_gm():
+    """r4 Engine depth (ref engine.py fit:991): callbacks drive
+    checkpointing + early stop, metrics run in evaluate, the LR
+    scheduler steps per batch, and strategy.gradient_merge compiles the
+    k-micro-batch scan into the train step."""
+    import os
+    import tempfile
+
+    from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+    from paddle_tpu.hapi.callbacks import EarlyStopping
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.metric import Accuracy
+    from paddle_tpu.optimizer.lr import StepDecay
+
+    paddle.seed(0)
+    np.random.seed(0)
+    x = np.random.randn(32, 8).astype(np.float32)
+    w = np.random.randn(8, 4).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int64)
+    ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+
+    net = nn.Sequential(nn.Linear(8, 32), nn.Tanh(), nn.Linear(32, 4))
+    sched = StepDecay(learning_rate=0.05, step_size=10, gamma=0.5)
+    o = opt.AdamW(learning_rate=sched, parameters=net.parameters())
+    strategy = Strategy({"sharding": {"degree": 4, "stage": 3},
+                         "dp_degree": 2,
+                         "gradient_merge": {"enable": True, "k_steps": 2}})
+    eng = Engine(model=net, loss=F.cross_entropy, optimizer=o,
+                 metrics=[Accuracy()], strategy=strategy)
+    with tempfile.TemporaryDirectory() as d:
+        hist = eng.fit(ds, valid_data=ds, epochs=8, batch_size=16,
+                       verbose=0, save_dir=d,
+                       callbacks=[EarlyStopping(monitor="loss",
+                                                patience=50)])
+        # ModelCheckpoint wrote per-epoch + final checkpoints via
+        # Engine.save (model + optimizer dirs)
+        assert os.path.isdir(os.path.join(d, "final"))
+        assert os.path.isdir(os.path.join(d, "final.opt"))
+    assert hist["loss"][-1] < hist["loss"][0]
+    # eval ran every epoch with the metric
+    assert len(hist["val_acc"]) == 8
+    assert hist["val_acc"][-1] >= hist["val_acc"][0]
+    # the per-batch LRScheduler callback advanced the scheduler
+    assert sched.last_epoch >= 16
+    assert o.get_lr() < 0.05
+
+
+def test_engine_gradient_merge_equals_full_batch():
+    """accumulate_steps=k inside TrainStep must reproduce the full-batch
+    update exactly (grads merged as mean; ref
+    gradient_merge_optimizer.py avg=True semantics)."""
+    paddle.seed(0)
+    np.random.seed(0)
+    X = paddle.to_tensor(np.random.randn(16, 8).astype(np.float32))
+    Y = paddle.to_tensor(np.random.randn(16, 1).astype(np.float32))
+
+    def make():
+        paddle.seed(0)
+        net = nn.Linear(8, 1)
+        o = opt.AdamW(learning_rate=0.01, parameters=net.parameters())
+        return net, o
+
+    n1, o1 = make()
+    s1 = paddle.jit.TrainStep(n1, o1, lambda a, b: F.mse_loss(n1(a), b))
+    n2, o2 = make()
+    s2 = paddle.jit.TrainStep(n2, o2, lambda a, b: F.mse_loss(n2(a), b),
+                              accumulate_steps=4)
+    for _ in range(3):
+        l1 = float(s1(X, Y).numpy())
+        l2 = float(s2(X, Y).numpy())
+    assert abs(l1 - l2) < 1e-5
+    np.testing.assert_allclose(n1.weight.numpy(), n2.weight.numpy(),
+                               atol=1e-5)
+    # indivisible batch must fail loudly at trace time
+    with pytest.raises(ValueError, match="divide"):
+        n3, o3 = make()
+        s3 = paddle.jit.TrainStep(n3, o3,
+                                  lambda a, b: F.mse_loss(n3(a), b),
+                                  accumulate_steps=3)
+        s3(X, Y)
+    # scaler + accumulation is rejected up front
+    with pytest.raises(ValueError, match="GradScaler"):
+        paddle.jit.TrainStep(n1, o1, lambda a, b: F.mse_loss(n1(a), b),
+                             scaler=paddle.amp.GradScaler(),
+                             accumulate_steps=2)
+
+
+def test_engine_amp_strategy_runs_bf16():
+    """strategy.amp traces autocast into the compiled step."""
+    from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+    from paddle_tpu.io import TensorDataset
+    paddle.seed(0)
+    np.random.seed(0)
+    x = np.random.randn(16, 8).astype(np.float32)
+    y = (x @ np.random.randn(8, 4)).astype(np.float32)
+    ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    o = opt.AdamW(learning_rate=0.01, parameters=net.parameters())
+    eng = Engine(model=net, loss=F.mse_loss, optimizer=o,
+                 strategy=Strategy({"amp": {"enable": True,
+                                            "level": "O1"}}))
+    hist = eng.fit(ds, epochs=5, batch_size=8, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0]
+
+
+def test_trainstep_lr_schedule_reaches_weights():
+    """The compiled step must consume the per-call LR, not a trace-time
+    snapshot of the scheduler (r4 review find): with SGD and a StepDecay
+    that halves, per-step weight deltas must halve too."""
+    from paddle_tpu.optimizer.lr import StepDecay
+    paddle.seed(0)
+    np.random.seed(0)
+    X = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+    Y = paddle.to_tensor(np.random.randn(8, 1).astype(np.float32))
+    sched = StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    net = nn.Linear(4, 1)
+    o = opt.SGD(learning_rate=sched, parameters=net.parameters())
+    s = paddle.jit.TrainStep(net, o, lambda a, b: F.mse_loss(net(a), b))
+    deltas = []
+    for i in range(4):
+        w0 = net.weight.numpy().copy()
+        s(X, Y)
+        deltas.append(np.abs(net.weight.numpy() - w0).max())
+        sched.step()
+    # steps 0-1 at lr=0.1, steps 2-3 at lr=0.05: the schedule must show
+    # up in the applied update (loss landscape drifts, so compare
+    # against a generous band rather than exactly 2x)
+    assert deltas[2] < deltas[0] * 0.75, deltas
+
+
+def test_accum_untouched_param_not_decayed():
+    """A trainable param the loss never touches must stay bit-identical
+    under accumulate_steps>1, exactly like the non-accumulating path
+    (no spurious zero-grad AdamW weight-decay update)."""
+    paddle.seed(0)
+    np.random.seed(0)
+    X = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+    Y = paddle.to_tensor(np.random.randn(8, 1).astype(np.float32))
+
+    class WithAux(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.used = nn.Linear(4, 1)
+            self.unused = nn.Linear(4, 3)
+
+        def forward(self, x):
+            return self.used(x)
+
+    paddle.seed(0)
+    m = WithAux()
+    before = m.unused.weight.numpy().copy()
+    o = opt.AdamW(learning_rate=0.01, weight_decay=0.1,
+                  parameters=m.parameters())
+    s = paddle.jit.TrainStep(m, o, lambda a, b: F.mse_loss(m(a), b),
+                             accumulate_steps=2)
+    for _ in range(3):
+        s(X, Y)
+    np.testing.assert_array_equal(before, m.unused.weight.numpy())
+
+
+def test_engine_resume_restores_optimizer():
+    """save -> FRESH engine (unprimed optimizer) -> load must restore
+    Adam moments and the step count (r4 review find: lazily-created
+    state made load a silent no-op)."""
+    import os
+    import tempfile
+
+    from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+    from paddle_tpu.io import TensorDataset
+    paddle.seed(0)
+    np.random.seed(0)
+    x = np.random.randn(33, 8).astype(np.float32)   # 33: partial batch
+    y = (x @ np.random.randn(8, 4)).astype(np.float32)
+    ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+
+    def build():
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+        o = opt.AdamW(learning_rate=0.01, parameters=net.parameters())
+        return Engine(model=net, loss=F.mse_loss, optimizer=o,
+                      strategy=Strategy(
+                          {"gradient_merge": {"enable": True,
+                                              "k_steps": 2}}))
+
+    e1 = build()
+    # drop_last keeps every step's batch divisible by k_steps — a 33-row
+    # dataset at batch 16 must train 2 steps/epoch without a retrace
+    e1.fit(ds, epochs=2, batch_size=16, verbose=0)
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "ck")
+        e1.save(p)
+        e2 = build()
+        e2.load(p)
+        sd1 = e1.optimizer.state_dict()
+        sd2 = e2.optimizer.state_dict()
+        assert sd2["@step"] == sd1["@step"] > 0
+        arr_keys = [k for k, v in sd1.items() if hasattr(v, "shape")]
+        assert arr_keys
+        for k in arr_keys:
+            a = np.asarray(sd1[k].data if hasattr(sd1[k], "data")
+                           else sd1[k])
+            b = np.asarray(sd2[k].data if hasattr(sd2[k], "data")
+                           else sd2[k])
+            np.testing.assert_allclose(a, b, atol=0)
+        # resumed training continues to improve from restored state
+        h = e2.fit(ds, epochs=1, batch_size=16, verbose=0)
+        assert np.isfinite(h["loss"]).all()

@@ -11,9 +11,15 @@ START=$(date +%s)
 while true; do
   if timeout 3 bash -c "echo > /dev/tcp/127.0.0.1/${PORT}" 2>/dev/null; then
     echo "$(date -u +%H:%M:%S) helper ALIVE — launching on-chip session" >&2
-    # settle 10 s (a freshly restarted helper may still be wiring up)
+    # settle 10 s (a freshly restarted helper may still be wiring up).
+    # Outer timeout backs up the per-section SIGALRM fences: a wedge
+    # inside native tunnel code never returns to the interpreter, so
+    # the alarm alone cannot fire (CPython delivers signals only at
+    # bytecode boundaries). Already-landed sections persist in the
+    # JSONL either way.
     sleep 10
-    python tools/onchip_session.py
+    timeout --signal=INT --kill-after=60 "${SESSION_BUDGET:-7200}" \
+      python tools/onchip_session.py
     exit $?
   fi
   if (( $(date +%s) - START > DEADLINE )); then

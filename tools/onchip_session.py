@@ -146,16 +146,17 @@ def main():
                 orig_emit(record, on_tpu_flag)
 
             bench._emit = cap_emit
+            orig_init = bench._init_devices
             try:
+                os.environ["BENCH_MODEL"] = size
                 if size in ("bert", "ernie", "resnet50", "unet"):
-                    os.environ["BENCH_MODEL"] = size
                     bench._bench_other(size, devs, True)
                 else:
-                    os.environ["BENCH_MODEL"] = size
-                    bench.main.__globals__["_init_devices"] = lambda: devs
+                    bench._init_devices = lambda: devs
                     bench.main()
             finally:
                 bench._emit = orig_emit
+                bench._init_devices = orig_init
                 os.environ.pop("BENCH_MODEL", None)
             return captured
         return fn
