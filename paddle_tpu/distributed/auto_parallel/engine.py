@@ -194,6 +194,17 @@ class Engine:
         attrs += [spec_to_attr(plan.batch_spec(x), x.ndim) for x in data]
         return propagate_jaxpr(fwd, (*vals, *data), attrs, mesh_shape)
 
+    def _amp_ctx(self):
+        """Autocast context factory per the strategy — shared by the
+        compiled train step and eager evaluate so both run the same
+        numerics."""
+        import contextlib
+        s = self.strategy
+        if not s.amp:
+            return contextlib.nullcontext
+        from ... import amp as _amp
+        return lambda: _amp.auto_cast(level=s.amp_level, dtype=s.amp_dtype)
+
     def prepare(self, inputs_spec=None, labels_spec=None, mode="train",
                 global_batch=None):
         from ..topology import HybridCommunicateGroup, set_mesh
@@ -211,27 +222,28 @@ class Engine:
         from ..sharding import ShardingPlan
 
         model, loss_fn = self.model, self.loss
-        self._inputs_spec = inputs_spec
-        self._labels_spec = labels_spec
+        amp_ctx = self._amp_ctx()
 
-        if s.amp:
-            # bf16 autocast traced into the step (ref: the amp pass the
-            # static engine inserts when strategy.amp.enable)
-            from ... import amp as _amp
-
-            def step_fn(*batch):
-                *xs, y = batch
-                with _amp.auto_cast(level=s.amp_level, dtype=s.amp_dtype):
-                    out = model(*xs)
-                    return loss_fn(out, y)
-        else:
-            def step_fn(*batch):
-                *xs, y = batch
+        def step_fn(*batch):
+            *xs, y = batch
+            # bf16 autocast traced into the step when strategy.amp
+            # (ref: the amp pass the static engine inserts)
+            with amp_ctx():
                 out = model(*xs)
                 return loss_fn(out, y)
 
-        if s.recompute and hasattr(model, "use_recompute"):
-            model.use_recompute = True
+        if s.recompute:
+            # models consult cfg.use_recompute in forward (llama.py) —
+            # an instance attr nothing reads would be a silent no-op
+            cfg = getattr(model, "cfg", None) or getattr(model, "config",
+                                                         None)
+            if cfg is not None and hasattr(cfg, "use_recompute"):
+                cfg.use_recompute = True
+            else:
+                import warnings
+                warnings.warn(
+                    "strategy.recompute requested but the model exposes "
+                    "no use_recompute config — ignored", stacklevel=2)
 
         plan = ShardingPlan(self._mesh, stage=s.sharding_stage)
         self._plan = plan
@@ -268,8 +280,16 @@ class Engine:
         # over a virtual/real mesh feeds the whole global batch)
         world = jax.process_count()
         if world > 1:
+            # batch_size is the GLOBAL batch (matches prepare's
+            # global_batch); each process feeds its 1/world slice so
+            # moving a script from 1 to N processes keeps the same
+            # optimization hyperparameters
+            if batch_size % world:
+                raise ValueError(
+                    f"global batch_size {batch_size} must be divisible "
+                    f"by the process count {world}")
             sampler = DistributedBatchSampler(
-                data, batch_size, num_replicas=world,
+                data, batch_size // world, num_replicas=world,
                 rank=jax.process_index(), shuffle=shuffle,
                 drop_last=drop_last)
             return DataLoader(data, batch_sampler=sampler)
@@ -294,6 +314,13 @@ class Engine:
                 steps = len(loader)
             except TypeError:
                 steps = None
+        if steps == 0:
+            # drop_last with a dataset smaller than the batch would
+            # silently train zero steps (and still write checkpoints)
+            raise ValueError(
+                f"no full batch to train on: dataset yields 0 batches at "
+                f"batch_size={batch_size} with drop_last — lower "
+                "batch_size or grow the dataset")
         # the Engine plays the hapi-Model role for callbacks: .save
         # (ModelCheckpoint), .stop_training (EarlyStopping), ._optimizer
         # (LRScheduler steps the scheduler per batch)
@@ -314,9 +341,11 @@ class Engine:
                 sampler.set_epoch(ep)   # reshuffle the dp shard per epoch
             for c in cbks:
                 c.on_epoch_begin(ep, logs)
+            n_batches = 0
             for i, batch in enumerate(loader):
                 if steps_per_epoch is not None and i >= steps_per_epoch:
                     break
+                n_batches += 1
                 for c in cbks:
                     c.on_train_batch_begin(i, logs)
                 xs, y = batch[:-1], batch[-1]
@@ -325,6 +354,13 @@ class Engine:
                 history["loss"].append(logs["loss"])
                 for c in cbks:
                     c.on_train_batch_end(i, logs)
+            if n_batches == 0:
+                # unsized (iterable) loaders bypass the len()==0 guard
+                # above; a zero-batch epoch must still fail loudly
+                raise ValueError(
+                    f"epoch {ep} produced 0 full batches at batch_size="
+                    f"{batch_size} with drop_last — lower batch_size or "
+                    "grow the dataset")
             if valid_data is not None and (ep + 1) % valid_freq == 0:
                 eval_res = self.evaluate(valid_data, batch_size=batch_size,
                                          callbacks=cbks)
@@ -361,15 +397,8 @@ class Engine:
         unsharded — a model that only fits sharded needs an eval step
         over the mesh, which fit's train path provides but evaluate
         does not yet.)"""
-        import contextlib
-
         from ...framework import core
-        s = self.strategy
-        amp_ctx = contextlib.nullcontext
-        if s.amp:
-            from ... import amp as _amp
-            amp_ctx = lambda: _amp.auto_cast(level=s.amp_level,
-                                             dtype=s.amp_dtype)
+        amp_ctx = self._amp_ctx()
         loader = self._loader_for(valid_data, batch_size)
         for m in self.metrics:
             m.reset()

@@ -270,6 +270,12 @@ class TrainStep:
             pkeys = [k for k, t in sd.items()
                      if not getattr(t, "stop_gradient", True)]
             ptensors = [sd[k] for k in pkeys]
+            pset = set(pkeys)
+            # non-trainable state (BatchNorm running stats, …) mutates
+            # during forward; thread it through the scan carry so body
+            # tracers never leak into the outer trace and the final
+            # values are the k-th micro-step's, same as k eager steps
+            btensors = [t for k, t in sd.items() if k not in pset]
 
             def split_leading(x):
                 if x.shape[0] % accum:
@@ -288,8 +294,10 @@ class TrainStep:
             touched = set()
 
             def body(carry, xs):
-                acc, loss_sum = carry
+                acc, loss_sum, bufs = carry
                 mb, mk = xs
+                for t, b in zip(btensors, bufs):
+                    t.data = b
                 with core.rng_key_context(jax.random.wrap_key_data(mk)):
                     loss = step_fn(*_tree_box(mb))
                     loss.backward()
@@ -304,10 +312,14 @@ class TrainStep:
                         new_acc.append(a + gd.astype(a.dtype))
                 opt.clear_grad()
                 return (new_acc,
-                        loss_sum + loss.data.astype(jnp.float32)), None
+                        loss_sum + loss.data.astype(jnp.float32),
+                        [t.data for t in btensors]), None
 
-            (grads, loss_sum), _ = jax.lax.scan(
-                body, (zero, jnp.float32(0)), (micro, mkeys))
+            (grads, loss_sum, final_bufs), _ = jax.lax.scan(
+                body, (zero, jnp.float32(0),
+                       [t.data for t in btensors]), (micro, mkeys))
+            for t, b in zip(btensors, final_bufs):
+                t.data = b
             inv_k = 1.0 / accum
             for i, (p, g) in enumerate(zip(ptensors, grads)):
                 if i in touched:
@@ -457,8 +469,6 @@ class TrainStep:
                 raise FloatingPointError(
                     f"NaN or Inf in updated parameters {bad[:5]} "
                     "(FLAGS_check_nan_inf)")
-        if hasattr(opt._lr, "step") and not isinstance(opt._lr, float):
-            pass  # LR scheduler stepping is the caller's choice (paddle semantics)
         return Tensor(loss)
 
 

@@ -373,3 +373,40 @@ def test_engine_resume_restores_optimizer():
         # resumed training continues to improve from restored state
         h = e2.fit(ds, epochs=1, batch_size=16, verbose=0)
         assert np.isfinite(h["loss"]).all()
+
+
+def test_accum_threads_buffers_through_scan():
+    """BatchNorm running stats mutate inside the accumulation scan; the
+    carry must thread them so no scan tracer leaks (r4 review find) and
+    the stats end at the k-th micro-step's values."""
+    paddle.seed(0)
+    np.random.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.BatchNorm1D(16), nn.ReLU(),
+                      nn.Linear(16, 4))
+    o = opt.AdamW(learning_rate=0.01, parameters=m.parameters())
+    s = paddle.jit.TrainStep(m, o,
+                             lambda x, y: F.mse_loss(m(x), y),
+                             accumulate_steps=2)
+    X = paddle.to_tensor(np.random.randn(16, 8).astype(np.float32))
+    Y = paddle.to_tensor(np.random.randn(16, 4).astype(np.float32))
+    rm_key = next(k for k in m.state_dict() if "_mean" in k)
+    rm0 = np.asarray(m.state_dict()[rm_key].numpy()).copy()
+    for _ in range(2):
+        loss = s(X, Y)
+    assert np.isfinite(float(loss.numpy()))
+    rm1 = np.asarray(m.state_dict()[rm_key].numpy())
+    assert not np.allclose(rm0, rm1), "running stats must update"
+
+
+def test_engine_fit_zero_batches_raises():
+    """drop_last on a too-small dataset must fail loudly, not train
+    zero steps and still checkpoint (r4 review find)."""
+    from paddle_tpu.distributed.auto_parallel import Engine
+    from paddle_tpu.io import TensorDataset
+    X = paddle.to_tensor(np.zeros((4, 8), np.float32))
+    Y = paddle.to_tensor(np.zeros((4, 4), np.float32))
+    net = nn.Linear(8, 4)
+    o = opt.SGD(learning_rate=0.1, parameters=net.parameters())
+    eng = Engine(model=net, loss=F.mse_loss, optimizer=o)
+    with pytest.raises(ValueError, match="0 batches"):
+        eng.fit(TensorDataset([X, Y]), epochs=1, batch_size=16, verbose=0)
