@@ -52,6 +52,7 @@ class Engine:
         self.strategy = strategy or Strategy()
         self._step = None
         self._mesh = None
+        self._eval_cache = {}
 
     def _model_stats(self):
         """Derive ModelStats from the wrapped model for the cost model /
@@ -247,6 +248,9 @@ class Engine:
 
         plan = ShardingPlan(self._mesh, stage=s.sharding_stage)
         self._plan = plan
+        # executables compiled against a previous mesh/plan/amp setting
+        # must not survive a re-prepare
+        self._eval_cache = {}
         gm = s.gradient_merge
         accum = int(gm.get("k_steps", 1)) if gm.get("enable") else 1
         self._step = pjit.TrainStep(model, self.optimizer, step_fn,
@@ -389,35 +393,97 @@ class Engine:
             c.on_train_end(logs)
         return history
 
+    def _batch_divisor(self):
+        """Product of the mesh axes the batch dim is sharded over."""
+        spec = self._plan.batch_spec(np.zeros((1, 1), np.float32))
+        entry = tuple(spec)[0] if tuple(spec) else None
+        axes = (entry if isinstance(entry, (tuple, list))
+                else [entry] if entry else [])
+        d = 1
+        for a in axes:
+            d *= self._mesh.shape[a]
+        return d
+
+    def _eval_step(self, params, buffers, batch_tensors):
+        """ONE compiled forward+loss per batch-shape, placed under the
+        plan's shardings (ref Engine.evaluate runs a compiled eval
+        program, not eager ops) — same numerics as training (autocast
+        traced in), same memory footprint (params stay sharded).
+        A short final batch that does not divide over the mesh's batch
+        axes runs the same `pure` un-sharded instead of crashing."""
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from ...framework import core
+        from ...jit import _tree_box, _tree_unbox
+        model, loss_fn, plan, mesh = (self.model, self.loss, self._plan,
+                                      self._mesh)
+        amp_ctx = self._amp_ctx()
+
+        def pure(params, buffers, batch):
+            state = {}
+            state.update(params)
+            state.update(buffers)
+            with model.use_state(state), core.no_grad_guard(), amp_ctx():
+                *xs, y = _tree_box(batch)
+                out = model(*xs)
+                loss = loss_fn(out, y)
+            return _tree_unbox(loss), _tree_unbox(out)
+
+        batch = _tree_unbox(tuple(batch_tensors))
+        leaves = jax.tree_util.tree_leaves(batch)
+        divisible = all(
+            x.ndim == 0 or x.shape[0] % self._batch_divisor() == 0
+            for x in leaves)
+        sig = (divisible,) + tuple((a.shape, str(a.dtype))
+                                   for a in leaves)
+        if sig not in self._eval_cache:
+            if divisible:
+                in_sh = (
+                    {k: NamedSharding(mesh, plan.param_spec(k, v))
+                     for k, v in params.items()},
+                    {k: NamedSharding(mesh, P()) for k in buffers},
+                    jax.tree_util.tree_map(
+                        lambda a: NamedSharding(mesh, plan.batch_spec(a)),
+                        batch),
+                )
+                self._eval_cache[sig] = jax.jit(pure, in_shardings=in_sh)
+            else:
+                # tail batch: replicated compile (old eager semantics,
+                # still one executable per shape)
+                self._eval_cache[sig] = jax.jit(pure)
+        loss, out = self._eval_cache[sig](params, buffers, batch)
+        return loss, _tree_box(out)
+
     def evaluate(self, valid_data, batch_size=1, callbacks=None, **kw):
         """Loss + every configured paddle.metric over the eval set
-        (ref Engine.evaluate:1103). Runs under the strategy's autocast
-        so the val numbers EarlyStopping/checkpointing monitor are in
-        the same numerics as training. (The forward is eager and
-        unsharded — a model that only fits sharded needs an eval step
-        over the mesh, which fit's train path provides but evaluate
-        does not yet.)"""
-        from ...framework import core
-        amp_ctx = self._amp_ctx()
+        (ref Engine.evaluate:1103), through the compiled sharded eval
+        step — validation runs the same numerics (autocast) and memory
+        plan (param shardings) as training."""
         loader = self._loader_for(valid_data, batch_size)
+        if self._step is None:
+            self.prepare(global_batch=batch_size)
         for m in self.metrics:
             m.reset()
         cbks = callbacks or []
         for c in cbks:
             c.on_eval_begin()
         losses = []
-        with core.no_grad_guard():
-            for i, batch in enumerate(loader):
-                for c in cbks:
-                    c.on_eval_batch_begin(i)
-                xs, y = batch[:-1], batch[-1]
-                with amp_ctx():
-                    out = self.model(*xs)
-                    losses.append(float(self.loss(out, y).numpy()))
-                for m in self.metrics:
-                    m.update(*_as_tuple(m.compute(out, y)))
-                for c in cbks:
-                    c.on_eval_batch_end(i, {"loss": losses[-1]})
+        # weights cannot change during evaluate: capture the
+        # params/buffers split once (shared logic with TrainStep)
+        from ...jit import TrainStep as _TS
+        params, buffers = _TS._capture_state(self)
+        for i, batch in enumerate(loader):
+            for c in cbks:
+                c.on_eval_batch_begin(i)
+            xs, y = batch[:-1], batch[-1]
+            loss, out = self._eval_step(params, buffers, list(xs) + [y])
+            losses.append(float(loss))
+            for m in self.metrics:
+                m.update(*_as_tuple(m.compute(out, y)))
+            for c in cbks:
+                c.on_eval_batch_end(i, {"loss": losses[-1]})
         res = {"loss": float(np.mean(losses))}
         for m in self.metrics:
             res[m.name()] = m.accumulate()

@@ -410,3 +410,54 @@ def test_engine_fit_zero_batches_raises():
     eng = Engine(model=net, loss=F.mse_loss, optimizer=o)
     with pytest.raises(ValueError, match="0 batches"):
         eng.fit(TensorDataset([X, Y]), epochs=1, batch_size=16, verbose=0)
+
+
+def test_engine_evaluate_compiled_and_cached():
+    """evaluate() runs a compiled SHARDED eval step (ref: the reference
+    evaluates through a program, not eager ops): one executable per
+    batch shape, reused across evaluate() calls, same loss each time."""
+    from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.metric import Accuracy
+    paddle.seed(0)
+    np.random.seed(0)
+    x = np.random.randn(32, 8).astype(np.float32)
+    y = np.argmax(x @ np.random.randn(8, 4), axis=1).astype(np.int64)
+    ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    o = opt.AdamW(learning_rate=0.01, parameters=net.parameters())
+    eng = Engine(model=net, loss=F.cross_entropy, optimizer=o,
+                 metrics=[Accuracy()],
+                 strategy=Strategy({"sharding": {"degree": 4, "stage": 3},
+                                    "dp_degree": 2}))
+    eng.prepare()
+    r1 = eng.evaluate(ds, batch_size=16)
+    assert len(eng._eval_cache) == 1
+    r2 = eng.evaluate(ds, batch_size=16)
+    assert len(eng._eval_cache) == 1, "same shape must reuse the executable"
+    assert abs(r1["loss"] - r2["loss"]) < 1e-6
+    assert "acc" in r1
+
+
+def test_engine_evaluate_tail_batch_and_cache_reset():
+    """A short final eval batch (not divisible by the mesh's batch axes)
+    takes a replicated executable instead of crashing; re-prepare()
+    drops executables compiled against the old mesh/plan."""
+    from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+    from paddle_tpu.io import TensorDataset
+    paddle.seed(0)
+    np.random.seed(0)
+    x = np.random.randn(36, 8).astype(np.float32)   # 36 % 16 = 4 tail
+    y = (x @ np.random.randn(8, 4)).astype(np.float32)
+    ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    o = opt.AdamW(learning_rate=0.01, parameters=net.parameters())
+    eng = Engine(model=net, loss=F.mse_loss, optimizer=o,
+                 strategy=Strategy({"sharding": {"degree": 4, "stage": 3},
+                                    "dp_degree": 2}))
+    eng.prepare()
+    r = eng.evaluate(ds, batch_size=16)
+    assert np.isfinite(r["loss"])
+    assert len(eng._eval_cache) == 2   # sharded full + replicated tail
+    eng.prepare()
+    assert len(eng._eval_cache) == 0
