@@ -251,6 +251,15 @@ class Engine:
         # executables compiled against a previous mesh/plan/amp setting
         # must not survive a re-prepare
         self._eval_cache = {}
+        self._bdiv = None
+        import jax
+        if jax.process_count() > 1:
+            # multi-process mesh: params/opt state must become GLOBAL
+            # arrays before the first compiled step (jit cannot reshard
+            # a single-local-device array onto devices other processes
+            # own) — the hybrid workers do this explicitly; the Engine
+            # does it for the user (ref engine.py _initialize)
+            plan.materialize(model, self.optimizer)
         gm = s.gradient_merge
         accum = int(gm.get("k_steps", 1)) if gm.get("enable") else 1
         self._step = pjit.TrainStep(model, self.optimizer, step_fn,
@@ -270,6 +279,15 @@ class Engine:
 
         from ...io import DataLoader, DistributedBatchSampler
         if isinstance(data, DataLoader):
+            if jax.process_count() > 1:
+                import warnings
+                warnings.warn(
+                    "Engine received a pre-built DataLoader on a multi-"
+                    "process job: it MUST yield this process's shard "
+                    "(e.g. via DistributedBatchSampler) — identical "
+                    "loaders on every process would duplicate each row "
+                    "process_count times in the global batch",
+                    stacklevel=3)
             if drop_last and not getattr(data, "drop_last", False) \
                     and getattr(data, "batch_sampler", None) is not None \
                     and not getattr(data.batch_sampler, "drop_last", False):
@@ -352,7 +370,7 @@ class Engine:
                 n_batches += 1
                 for c in cbks:
                     c.on_train_batch_begin(i, logs)
-                xs, y = batch[:-1], batch[-1]
+                *xs, y = self._globalize_batch(list(batch))
                 loss = self._step(*xs, y)
                 logs = {"loss": float(loss.numpy())}
                 history["loss"].append(logs["loss"])
@@ -404,6 +422,23 @@ class Engine:
             d *= self._mesh.shape[a]
         return d
 
+    def _globalize_batch(self, tensors):
+        """Multi-process data path: each process's sampler slice becomes
+        its shard of ONE global array under the plan's batch sharding
+        (jax.make_array_from_process_local_data — the documented
+        multi-host feeding idiom). Single-process: passthrough."""
+        import jax
+        if jax.process_count() == 1:
+            return tensors
+        from jax.sharding import NamedSharding
+        out = []
+        for t in tensors:
+            arr = np.asarray(t.numpy() if isinstance(t, Tensor) else t)
+            sh = NamedSharding(self._mesh, self._plan.batch_spec(arr))
+            out.append(Tensor(
+                jax.make_array_from_process_local_data(sh, arr)))
+        return out
+
     def _eval_step(self, params, buffers, batch_tensors):
         """ONE compiled forward+loss per batch-shape, placed under the
         plan's shardings (ref Engine.evaluate runs a compiled eval
@@ -433,9 +468,11 @@ class Engine:
 
         batch = _tree_unbox(tuple(batch_tensors))
         leaves = jax.tree_util.tree_leaves(batch)
+        bdiv = getattr(self, "_bdiv", None)
+        if bdiv is None:
+            bdiv = self._bdiv = self._batch_divisor()
         divisible = all(
-            x.ndim == 0 or x.shape[0] % self._batch_divisor() == 0
-            for x in leaves)
+            x.ndim == 0 or x.shape[0] % bdiv == 0 for x in leaves)
         sig = (divisible,) + tuple((a.shape, str(a.dtype))
                                    for a in leaves)
         if sig not in self._eval_cache:
@@ -472,16 +509,29 @@ class Engine:
         losses = []
         # weights cannot change during evaluate: capture the
         # params/buffers split once (shared logic with TrainStep)
-        from ...jit import TrainStep as _TS
-        params, buffers = _TS._capture_state(self)
+        from ...jit import capture_state
+        params, buffers = capture_state(self.model)
         for i, batch in enumerate(loader):
             for c in cbks:
                 c.on_eval_batch_begin(i)
             xs, y = batch[:-1], batch[-1]
-            loss, out = self._eval_step(params, buffers, list(xs) + [y])
+            loss, out = self._eval_step(
+                params, buffers, self._globalize_batch(list(batch)))
             losses.append(float(loss))
-            for m in self.metrics:
-                m.update(*_as_tuple(m.compute(out, y)))
+            # metrics read `out` on the host: in multi-process runs the
+            # globalized output spans other processes' devices and the
+            # local `y` no longer matches its leading dim — a per-shard
+            # metric + cross-process reduction is needed; until then
+            # metrics are single-process only
+            if self.metrics and _world() > 1:
+                import warnings
+                warnings.warn("Engine.evaluate metrics are skipped in "
+                              "multi-process runs (loss is global; "
+                              "metrics need a per-shard reduction)",
+                              stacklevel=2)
+            elif self.metrics:
+                for m in self.metrics:
+                    m.update(*_as_tuple(m.compute(out, y)))
             for c in cbks:
                 c.on_eval_batch_end(i, {"loss": losses[-1]})
         res = {"loss": float(np.mean(losses))}
@@ -554,3 +604,8 @@ class Engine:
 
 def _as_tuple(x):
     return x if isinstance(x, (tuple, list)) else (x,)
+
+
+def _world():
+    import jax
+    return jax.process_count()

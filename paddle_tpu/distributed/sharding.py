@@ -357,11 +357,23 @@ class ShardingPlan:
         Harmless on single-process meshes (it just places arrays).
         Ref: fleet sharding init broadcast (group_sharded stage init)."""
         from ..tensor import Parameter
+
+        def _already_global(a):
+            # a re-materialize (second prepare(), or after training) sees
+            # global arrays spanning other processes' devices; np.asarray
+            # on those raises — they are already placed, leave them be
+            return isinstance(a, jax.Array) and not a.is_fully_addressable
+
         self.attach_model(model)
         p_specs = {}
         for name, t in model.state_dict().items():
-            arr = np.asarray(t.data)
             is_param = isinstance(t, Parameter) and not t.stop_gradient
+            if _already_global(t.data):
+                if is_param:
+                    p_specs[name] = self.param_spec(
+                        name, np.empty(t.data.shape))
+                continue
+            arr = np.asarray(t.data)
             spec = self.param_spec(name, arr) if is_param else P()
             t.data = jax.device_put(arr, NamedSharding(self.mesh, spec))
             if is_param:
@@ -370,12 +382,16 @@ class ShardingPlan:
             if hasattr(optimizer, "prime"):
                 optimizer.prime()
             for k, v in list(optimizer._state.items()):
+                if _already_global(v):
+                    continue
                 arr = np.asarray(v)
                 optimizer._state[k] = jax.device_put(
                     arr, NamedSharding(self.mesh,
                                        self.opt_spec(k, arr, p_specs)))
             for k, v in list(getattr(optimizer, "_master_weights",
                                      {}).items()):
+                if _already_global(v):
+                    continue
                 arr = np.asarray(v)
                 pname = getattr(self, "_pid_to_name", {}).get(k, "")
                 spec = (p_specs.get(pname)

@@ -47,6 +47,19 @@ def _tree_box(x):
         lambda v: Tensor(v) if isinstance(v, jax.Array) else v, x)
 
 
+def capture_state(model):
+    """Split a model's state into (trainable params, everything else) as
+    raw arrays — shared by TrainStep and the auto-parallel Engine."""
+    from ..tensor import Parameter
+    params, buffers = {}, {}
+    for k, t in model.state_dict().items():
+        if isinstance(t, Parameter) and not t.stop_gradient:
+            params[k] = t.data
+        else:
+            buffers[k] = t.data
+    return params, buffers
+
+
 class StaticFunction:
     """Compiled wrapper over a Layer (or bound layer method)."""
 
@@ -243,15 +256,7 @@ class TrainStep:
                 "(bf16 training does not need loss scaling)")
 
     def _capture_state(self):
-        params = {}
-        buffers = {}
-        for k, t in self.model.state_dict().items():
-            from ..tensor import Parameter
-            if isinstance(t, Parameter) and not t.stop_gradient:
-                params[k] = t.data
-            else:
-                buffers[k] = t.data
-        return params, buffers
+        return capture_state(self.model)
 
     def _build(self):
         model = self.model

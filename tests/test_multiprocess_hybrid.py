@@ -200,3 +200,20 @@ def test_elastic_kill_worker_ttl_relaunch_resume():
         # (c) both ranks completed
         assert any(n.startswith("done_0") for n in os.listdir(d))
         assert any(n.startswith("done_1") for n in os.listdir(d))
+
+
+@pytest.mark.timeout(300)
+def test_two_process_engine_fit_dp_matches_eager_union():
+    """Engine.fit on a 2-process dp mesh: per-process sampler slices are
+    globalized onto the mesh and the compiled-step losses equal an
+    eager run over the union batch (r4 Engine multi-process path)."""
+    with tempfile.TemporaryDirectory() as d:
+        procs = _launch(2, os.path.join(COLL, "engine_dp_worker.py"), [d])
+        outs = _wait_all(procs, timeout=270)
+        vals = []
+        for rank in range(2):
+            marker = os.path.join(d, f"engine_dp_ok_{rank}")
+            assert os.path.exists(marker), outs[rank][-3000:]
+            with open(marker) as f:
+                vals.append(f.read())
+        assert len(set(vals)) == 1, vals
