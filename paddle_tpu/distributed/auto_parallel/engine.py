@@ -431,9 +431,20 @@ class Engine:
         if jax.process_count() == 1:
             return tensors
         from jax.sharding import NamedSharding
+        bdiv = getattr(self, "_bdiv", None)
+        if bdiv is None:
+            bdiv = self._bdiv = self._batch_divisor()
+        world = jax.process_count()
         out = []
         for t in tensors:
             arr = np.asarray(t.numpy() if isinstance(t, Tensor) else t)
+            if arr.ndim and (arr.shape[0] * world) % bdiv:
+                # short tail (eval without drop_last): the global dim
+                # would not divide over the mesh's batch axes — leave
+                # the batch local so the replicated tail executable
+                # handles it (per-process loss; eval is advisory in
+                # multi-process runs)
+                return tensors
             sh = NamedSharding(self._mesh, self._plan.batch_spec(arr))
             out.append(Tensor(
                 jax.make_array_from_process_local_data(sh, arr)))
@@ -507,6 +518,18 @@ class Engine:
         for c in cbks:
             c.on_eval_begin()
         losses = []
+        # metrics read `out` on the host: in multi-process runs the
+        # globalized output spans other processes' devices and the
+        # local `y` no longer matches its leading dim — a per-shard
+        # metric + cross-process reduction is needed; until then
+        # metrics are single-process only (and must not report bogus
+        # zero values when skipped)
+        metrics_on = bool(self.metrics) and _world() == 1
+        if self.metrics and not metrics_on:
+            import warnings
+            warnings.warn("Engine.evaluate metrics are skipped in "
+                          "multi-process runs (loss is global; metrics "
+                          "need a per-shard reduction)", stacklevel=2)
         # weights cannot change during evaluate: capture the
         # params/buffers split once (shared logic with TrainStep)
         from ...jit import capture_state
@@ -514,29 +537,19 @@ class Engine:
         for i, batch in enumerate(loader):
             for c in cbks:
                 c.on_eval_batch_begin(i)
-            xs, y = batch[:-1], batch[-1]
+            y = batch[-1]
             loss, out = self._eval_step(
                 params, buffers, self._globalize_batch(list(batch)))
             losses.append(float(loss))
-            # metrics read `out` on the host: in multi-process runs the
-            # globalized output spans other processes' devices and the
-            # local `y` no longer matches its leading dim — a per-shard
-            # metric + cross-process reduction is needed; until then
-            # metrics are single-process only
-            if self.metrics and _world() > 1:
-                import warnings
-                warnings.warn("Engine.evaluate metrics are skipped in "
-                              "multi-process runs (loss is global; "
-                              "metrics need a per-shard reduction)",
-                              stacklevel=2)
-            elif self.metrics:
+            if metrics_on:
                 for m in self.metrics:
                     m.update(*_as_tuple(m.compute(out, y)))
             for c in cbks:
                 c.on_eval_batch_end(i, {"loss": losses[-1]})
         res = {"loss": float(np.mean(losses))}
-        for m in self.metrics:
-            res[m.name()] = m.accumulate()
+        if metrics_on:
+            for m in self.metrics:
+                res[m.name()] = m.accumulate()
         for c in cbks:
             c.on_eval_end(res)
         return res

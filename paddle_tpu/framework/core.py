@@ -237,6 +237,10 @@ _flags: dict = {
     "FLAGS_embedding_deterministic": 0,
     # -- autotune (consumed by kernels/autotune.sweeps_enabled) --------
     "FLAGS_use_autotune": True,
+    # kernel-route kill switches (the on-chip ablation levers; analog of
+    # the reference's cudnn/flash deterministic+enable toggles)
+    "FLAGS_use_fused_ce": True,        # Pallas blockwise CE vs XLA CE
+    "FLAGS_use_flash_attention": True,  # Pallas flash vs dense XLA attn
     "FLAGS_cudnn_exhaustive_search": False,     # alias: force sweeps
     # -- numerics (consumed in _apply_flag -> jax matmul precision) ----
     "FLAGS_gemm_use_half_precision_compute_type": True,

@@ -38,7 +38,16 @@ def _on_tpu() -> bool:
 
 
 def supported(n_classes: int, min_vocab: int = 4096) -> bool:
-    """Worth routing through the kernel: big-vocab CE on TPU."""
+    """Worth routing through the kernel: big-vocab CE on TPU.
+    FLAGS_use_fused_ce=0 forces the plain-XLA log_softmax path (the
+    per-route ablation lever; ref: phi autotune/deterministic kill
+    switches)."""
+    try:
+        from ..framework import core
+        if not core.get_bool_flag("FLAGS_use_fused_ce", True):
+            return False
+    except Exception:
+        pass
     return _on_tpu() and n_classes >= min_vocab
 
 

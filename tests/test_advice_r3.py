@@ -194,3 +194,34 @@ class TestConfigWarnOnce:
             cfg.enable_tensorrt_engine(max_batch_size=4)
         msgs = [x for x in w if "enable_tensorrt_engine" in str(x.message)]
         assert len(msgs) == 1
+
+
+def test_kernel_route_kill_switches():
+    """FLAGS_use_fused_ce / FLAGS_use_flash_attention gate the Pallas
+    routes (the on-chip ablation levers; ref: phi kill-switch flags)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.kernels import cross_entropy as fck
+    from paddle_tpu.kernels import flash_attention as fa
+
+    # defaults: gates defer to the backend check only (False on CPU,
+    # but the flag consult must not throw and must honor an override)
+    paddle.set_flags({"FLAGS_use_fused_ce": False})
+    try:
+        assert fck.supported(32000) is False
+    finally:
+        paddle.set_flags({"FLAGS_use_fused_ce": True})
+
+    paddle.set_flags({"FLAGS_use_flash_attention": False})
+    try:
+        assert fa.supported((2, 256, 8, 64), (2, 256, 8, 64),
+                            True) is False
+    finally:
+        paddle.set_flags({"FLAGS_use_flash_attention": True})
+
+    # env-string form (the bench/session ablation path) normalizes
+    import os
+    os.environ["FLAGS_use_fused_ce"] = "0"
+    try:
+        assert fck.supported(32000) is False
+    finally:
+        del os.environ["FLAGS_use_fused_ce"]

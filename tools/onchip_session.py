@@ -132,8 +132,11 @@ def main():
              breakdown)
 
     # 2-3. configs + re-bench: subprocess bench.py would need a NEW
-    # claim per run — instead call bench's own functions inline
-    def bench_model(size):
+    # claim per run — instead call bench's own functions inline.
+    # `flags` pins route kill-switches for full-step ablations
+    # (FLAGS_use_fused_ce / FLAGS_use_flash_attention are consulted at
+    # trace time, so env changes take effect per-section).
+    def bench_model(size, flags=None):
         def fn():
             import bench
             # bench._emit prints the JSON line and persists last-good;
@@ -142,13 +145,22 @@ def main():
             orig_emit = bench._emit
 
             def cap_emit(record, on_tpu_flag):
+                if flags:
+                    record = dict(record)
+                    record.setdefault("extra", {})
+                    record["extra"]["ablation_flags"] = dict(flags)
                 captured.append(record)
-                orig_emit(record, on_tpu_flag)
+                # ablated runs must not become the BENCH_LAST_GOOD
+                # artifact a wedged session would later re-emit
+                orig_emit(record, on_tpu_flag and not flags)
 
             bench._emit = cap_emit
             orig_init = bench._init_devices
+            prior = {k: os.environ.get(k) for k in (flags or {})}
             try:
                 os.environ["BENCH_MODEL"] = size
+                for k, v in (flags or {}).items():
+                    os.environ[k] = v
                 if size in ("bert", "ernie", "resnet50", "unet"):
                     bench._bench_other(size, devs, True)
                 else:
@@ -158,15 +170,29 @@ def main():
                 bench._emit = orig_emit
                 bench._init_devices = orig_init
                 os.environ.pop("BENCH_MODEL", None)
+                for k, old in prior.items():
+                    # restore operator-set values, don't clobber them
+                    if old is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = old
             return captured
         return fn
 
-    for size, budget in (("bert", 1200), ("ernie", 1200),
-                         ("resnet50", 1200), ("unet", 1500),
-                         ("350m", 900)):
-        _section(f"bench_{size}",
-                 int(os.environ.get("CFG_BUDGET", str(budget))),
-                 bench_model(size))
+    for name, size, flags, budget in (
+            ("bench_bert", "bert", None, 1200),
+            ("bench_ernie", "ernie", None, 1200),
+            ("bench_resnet50", "resnet50", None, 1200),
+            ("bench_unet", "unet", None, 1500),
+            ("bench_350m", "350m", None, 900),
+            # full-step route ablations for the MFU regression
+            ("bench_350m_xla_ce", "350m",
+             {"FLAGS_use_fused_ce": "0"}, 900),
+            ("bench_350m_dense_attn", "350m",
+             {"FLAGS_use_flash_attention": "0"}, 900),
+    ):
+        _section(name, int(os.environ.get("CFG_BUDGET", str(budget))),
+                 bench_model(size, flags))
     print("session complete", flush=True)
     return 0
 
