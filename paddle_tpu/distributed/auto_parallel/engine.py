@@ -450,6 +450,37 @@ class Engine:
                 jax.make_array_from_process_local_data(sh, arr)))
         return out
 
+    def _localize(self, tree):
+        """This process's rows of a batch-sharded global output (the
+        inverse of _globalize_batch): concatenate the addressable
+        shards in row order. Fully-addressable leaves pass through."""
+        import jax
+        import jax.numpy as jnp
+
+        def leaf(x):
+            arr = x.data if isinstance(x, Tensor) else x
+            if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+                if arr.ndim == 0:
+                    # replicated scalar: any shard holds the value
+                    return Tensor(jnp.asarray(
+                        arr.addressable_shards[0].data))
+                # dedup replicas: an output replicated over some axis
+                # yields several addressable shards with the SAME index;
+                # concatenating them would duplicate rows
+                uniq = {}
+                for s in arr.addressable_shards:
+                    uniq.setdefault(str(s.index), s)
+                shards = sorted(
+                    uniq.values(),
+                    key=lambda s: (s.index[0].start or 0) if s.index
+                    else 0)
+                return Tensor(jnp.concatenate(
+                    [jnp.asarray(s.data) for s in shards], axis=0))
+            return x
+
+        return jax.tree_util.tree_map(
+            leaf, tree, is_leaf=lambda v: isinstance(v, Tensor))
+
     def _eval_step(self, params, buffers, batch_tensors):
         """ONE compiled forward+loss per batch-shape, placed under the
         plan's shardings (ref Engine.evaluate runs a compiled eval
@@ -518,18 +549,9 @@ class Engine:
         for c in cbks:
             c.on_eval_begin()
         losses = []
-        # metrics read `out` on the host: in multi-process runs the
-        # globalized output spans other processes' devices and the
-        # local `y` no longer matches its leading dim — a per-shard
-        # metric + cross-process reduction is needed; until then
-        # metrics are single-process only (and must not report bogus
-        # zero values when skipped)
-        metrics_on = bool(self.metrics) and _world() == 1
-        if self.metrics and not metrics_on:
-            import warnings
-            warnings.warn("Engine.evaluate metrics are skipped in "
-                          "multi-process runs (loss is global; metrics "
-                          "need a per-shard reduction)", stacklevel=2)
+        import jax
+        metrics_on = bool(self.metrics)
+        n_local = 0
         # weights cannot change during evaluate: capture the
         # params/buffers split once (shared logic with TrainStep)
         from ...jit import capture_state
@@ -542,14 +564,50 @@ class Engine:
                 params, buffers, self._globalize_batch(list(batch)))
             losses.append(float(loss))
             if metrics_on:
-                for m in self.metrics:
-                    m.update(*_as_tuple(m.compute(out, y)))
+                # multi-process: metrics run on THIS process's rows of
+                # the global output (the local shard matches local y),
+                # cross-process reduction happens below
+                out_local = self._localize(out) if _world() > 1 else out
+                yl = y.numpy() if isinstance(y, Tensor) else np.asarray(y)
+                ny = int(np.shape(yl)[0]) if np.ndim(yl) else 1
+                first = jax.tree_util.tree_leaves(out_local)
+                lead = (int(np.shape(
+                    first[0].data if isinstance(first[0], Tensor)
+                    else first[0])[0]) if first
+                    and np.ndim(first[0].data if isinstance(
+                        first[0], Tensor) else first[0]) else ny)
+                if _world() > 1 and lead != ny:
+                    # a compiler-chosen output layout we could not map
+                    # back to local rows — skip rather than mis-score
+                    import warnings
+                    warnings.warn(
+                        "Engine.evaluate: output rows do not match the "
+                        "local label shard; metrics skipped for this "
+                        "batch", stacklevel=2)
+                else:
+                    for m in self.metrics:
+                        m.update(*_as_tuple(m.compute(out_local, y)))
+                    n_local += ny
             for c in cbks:
                 c.on_eval_batch_end(i, {"loss": losses[-1]})
         res = {"loss": float(np.mean(losses))}
         if metrics_on:
-            for m in self.metrics:
-                res[m.name()] = m.accumulate()
+            local_vals = {m.name(): m.accumulate() for m in self.metrics}
+            if _world() > 1:
+                # sample-weighted aggregate of the per-shard metrics
+                # (exact for count-ratio metrics like Accuracy)
+                from ..collective import all_gather_object
+                gathered: list = []
+                all_gather_object(gathered, (local_vals, n_local))
+                tot = sum(n for _, n in gathered) or 1
+                for name in local_vals:
+                    vals = [np.asarray(v[name], np.float64) * n
+                            for v, n in gathered]
+                    agg = sum(vals) / tot
+                    res[name] = (float(agg) if np.ndim(agg) == 0
+                                 else agg.tolist())
+            else:
+                res.update(local_vals)
         for c in cbks:
             c.on_eval_end(res)
         return res

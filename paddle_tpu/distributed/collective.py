@@ -74,9 +74,37 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 
 
 def all_gather_object(object_list, obj, group=None):
+    """ref communication/all_gather.py::all_gather_object. Multi-process
+    jobs exchange pickled payloads over the jax distributed runtime
+    (multihost_utils.process_allgather — the same trust domain as the
+    job's own coordination service); single-controller keeps the
+    replicate semantics."""
+    n_proc = jax.process_count()
+    if n_proc > 1 and group is not None:
+        raise NotImplementedError(
+            "all_gather_object over a sub-group on a multi-process job "
+            "is not supported yet — pass group=None (world)")
+    if n_proc > 1:
+        import pickle
+
+        from jax.experimental import multihost_utils
+        data = np.frombuffer(pickle.dumps(obj), np.uint8)
+        lens = multihost_utils.process_allgather(
+            np.array([data.size], np.int64))
+        lens = np.asarray(lens).reshape(-1)
+        padded = np.zeros(int(lens.max()), np.uint8)
+        padded[: data.size] = data
+        gathered = np.asarray(
+            multihost_utils.process_allgather(padded))
+        object_list.clear()
+        for i in range(n_proc):
+            object_list.append(
+                pickle.loads(gathered[i, : int(lens[i])].tobytes()))
+        return object_list
     n = group.nranks if group is not None else 1
     object_list.clear()
     object_list.extend(obj for _ in range(n))
+    return object_list
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):

@@ -78,9 +78,35 @@ def main():
         exp.append(float(np.asarray(loss.data)))
 
     np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-6)
+
+    # multi-process evaluate with a metric: the per-shard Accuracy must
+    # aggregate (sample-weighted over all_gather_object) to exactly the
+    # single-model accuracy over the SAME rows
+    from paddle_tpu.metric import Accuracy
+    Yc = np.argmax(Xn @ np.asarray(rng.standard_normal((8, 4)),
+                                   np.float32), axis=1).astype(np.int64)
+    dsc = TensorDataset([paddle.to_tensor(Xn), paddle.to_tensor(Yc)])
+    eng.loss = F.cross_entropy
+    eng.metrics = [Accuracy()]
+    r = eng.evaluate(dsc, batch_size=8)
+    assert "acc" in r and 0.0 <= r["acc"] <= 1.0, r
+    # expected: the trained (dp) model's accuracy over the union of the
+    # eval sampler's rows — identical weights on both ranks, so rank 0's
+    # model scores the full sampler index set
+    sampler_rows = []
+    for rr in (0, 1):
+        s = DistributedBatchSampler(dsc, 4, num_replicas=2, rank=rr)
+        sampler_rows += [i for b in iter(s) for i in b]
+    idx = np.array(sampler_rows)
+    pred = np.asarray(net(paddle.to_tensor(Xn[idx])).numpy())
+    exp_acc = float((np.argmax(pred, -1) == Yc[idx]).mean())
+    assert abs(r["acc"] - exp_acc) < 1e-6, (r["acc"], exp_acc)
+
     with open(os.path.join(out_dir, f"engine_dp_ok_{rank}"), "w") as f:
-        f.write(",".join(f"{v:.6f}" for v in got))
-    print(f"rank {rank}: Engine dp=2 fit losses match eager union: {got}")
+        f.write(",".join(f"{v:.6f}" for v in got)
+                + f";acc={r['acc']:.6f}")
+    print(f"rank {rank}: Engine dp=2 fit losses match eager union: {got}; "
+          f"eval acc {r['acc']:.4f} == union {exp_acc:.4f}")
 
 
 if __name__ == "__main__":

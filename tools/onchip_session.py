@@ -147,7 +147,7 @@ def main():
             def cap_emit(record, on_tpu_flag):
                 if flags:
                     record = dict(record)
-                    record.setdefault("extra", {})
+                    record["extra"] = dict(record.get("extra") or {})
                     record["extra"]["ablation_flags"] = dict(flags)
                 captured.append(record)
                 # ablated runs must not become the BENCH_LAST_GOOD
