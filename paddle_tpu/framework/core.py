@@ -169,10 +169,12 @@ class RandomState(threading.local):
         self.key = None
         self.counter = 0
         self.stack = []  # traced keys pushed by functional contexts
+        self._base_data = None   # host cache for base_rng_key_data()
 
     def seed(self, s: int):
         self.key = jax.random.key(s)
         self.counter = 0
+        self._base_data = None   # host cache for base_rng_key_data()
 
     def next_key(self):
         if self.stack:
@@ -198,6 +200,21 @@ def next_rng_key():
     return _rng.next_key()
 
 
+def base_rng_key_data():
+    """The seed key's raw uint32 data as HOST numpy, cached per seed.
+
+    Compiled steps (TrainStep) take this once-per-seed constant and
+    fold the step counter in INSIDE the executable — the previous
+    per-call `fold_in` + `key_data` ran two tiny device programs per
+    step, a synchronous device round trip each (~8 ms/step over the
+    axon tunnel) for what is a host-side constant."""
+    if _rng.key is None:
+        _rng.seed(0)
+    if _rng._base_data is None:
+        _rng._base_data = np.asarray(jax.random.key_data(_rng.key))
+    return _rng._base_data
+
+
 @contextlib.contextmanager
 def rng_key_context(key):
     _rng.stack.append(key)
@@ -213,6 +230,7 @@ def get_rng_state():
 
 def set_rng_state(state):
     _rng.key, _rng.counter = state
+    _rng._base_data = None   # restored key invalidates the host cache
 
 
 # ---------------------------------------------------------------------------

@@ -248,6 +248,7 @@ class TrainStep:
             shard.attach_model(model)
         self._compiled = None
         self._donate = donate
+        self._key_base = None     # per-instance RNG base (see __call__)
         self._accum = int(accumulate_steps)
         if self._accum > 1 and scaler is not None:
             raise ValueError(
@@ -336,8 +337,13 @@ class TrainStep:
                  lr, key, batch):
             # key travels as raw uint32 key-data (host numpy — typed PRNG
             # keys are committed device arrays, which a multi-process
-            # mesh jit cannot accept); rewrap to a typed key here
+            # mesh jit cannot accept); rewrap to a typed key here. The
+            # per-step stream derives from the step counter IN-TRACE
+            # (domain-tagged so it cannot collide with the eager
+            # fold_in(counter) stream) — no per-call device RNG work.
             key = jax.random.wrap_key_data(key)
+            key = jax.random.fold_in(
+                jax.random.fold_in(key, 0x54524E), step_i)
             state = {}
             state.update(params)
             state.update(buffers)
@@ -418,7 +424,26 @@ class TrainStep:
         lr = _np.float32(opt.get_lr())
         # opt.step() inside the compiled fn performs the +1 itself
         step_i = _np.int32(opt._step_count)
-        key = _np.asarray(jax.random.key_data(core.next_rng_key()))
+        if core._rng.stack:
+            # an active rng_key_context must keep steering compiled-step
+            # randomness (the fleet TP rng-tracker pattern): split the
+            # context key per call, as before
+            key = _np.asarray(jax.random.key_data(core.next_rng_key()))
+        else:
+            if self._key_base is None:
+                # one fold of the globally-advancing eager counter per
+                # TrainStep INSTANCE: distinct streams for successive
+                # TrainSteps even when their step counters overlap,
+                # deterministic under paddle.seed, and base-cache
+                # invalidation (seed / set_rng_state) is respected
+                self._key_base = _np.asarray(
+                    jax.random.key_data(core.next_rng_key()))
+                self._key_base_src = core.base_rng_key_data()
+            elif self._key_base_src is not core.base_rng_key_data():
+                self._key_base = _np.asarray(
+                    jax.random.key_data(core.next_rng_key()))
+                self._key_base_src = core.base_rng_key_data()
+            key = self._key_base
         batch_arrays = _tree_unbox(batch)
         scaler_state = (self.scaler._get_traced_state()
                         if self.scaler is not None else {})

@@ -99,3 +99,53 @@ def test_dropout_inside_jit_varies():
     l1 = step(x).item()
     l2 = step(x).item()
     assert l1 != l2, "rng key must be threaded per step"
+
+
+def test_trainstep_rng_stream_semantics():
+    """The per-step RNG derives in-trace from (instance base, step_i) —
+    no per-call device round trips (the r4 tunnel-latency fix) — while
+    keeping: distinct streams per TrainStep instance, paddle.seed
+    determinism, set_rng_state invalidation, and rng_key_context
+    steering."""
+    import jax
+
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu.framework import core
+
+    X = paddle.to_tensor(np.ones((16, 8), np.float32))
+    Y = paddle.to_tensor(np.zeros((16, 4), np.float32))
+
+    def mk():
+        m = nn.Sequential(nn.Linear(8, 32), nn.Dropout(0.5),
+                          nn.Linear(32, 4))
+        o = popt.SGD(learning_rate=0.0, parameters=m.parameters())
+        return paddle.jit.TrainStep(
+            m, o, lambda x, y: F.mse_loss(m(x), y))
+
+    paddle.seed(3)
+    s1 = mk()
+    l1 = [float(s1(X, Y).numpy()) for _ in range(2)]
+    s2 = mk()
+    l2 = [float(s2(X, Y).numpy()) for _ in range(2)]
+    assert l1 != l2, "two TrainSteps must not replay one dropout stream"
+    assert len(set(l1)) == 2, "steps must decorrelate"
+
+    paddle.seed(3)
+    r1 = [float(mk()(X, Y).numpy()) for _ in range(1)]
+    assert r1[0] == l1[0], "seed must reproduce the whole program"
+
+    st = core.get_rng_state()
+    paddle.seed(99)
+    b = float(mk()(X, Y).numpy())
+    core.set_rng_state(st)
+    assert b != l1[0], "a different key must change the stream"
+
+    paddle.seed(3)
+    sa = mk()
+    with core.rng_key_context(jax.random.key(123)):
+        v1 = float(sa(X, Y).numpy())
+    paddle.seed(3)
+    sb = mk()
+    with core.rng_key_context(jax.random.key(456)):
+        v2 = float(sb(X, Y).numpy())
+    assert v1 != v2, "rng_key_context must steer compiled randomness"
