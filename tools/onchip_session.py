@@ -190,6 +190,11 @@ def main():
              {"FLAGS_use_fused_ce": "0"}, 900),
             ("bench_350m_dense_attn", "350m",
              {"FLAGS_use_flash_attention": "0"}, 900),
+            # batch scaling: the cheapest MFU lever if HBM allows
+            # (v5e 16 GB; B=4 is far from the memory roof at 350m)
+            ("bench_350m_b8", "350m", {"BENCH_BATCH": "8"}, 900),
+            ("bench_350m_b16_remat", "350m",
+             {"BENCH_BATCH": "16", "BENCH_REMAT": "1"}, 900),
     ):
         _section(name, int(os.environ.get("CFG_BUDGET", str(budget))),
                  bench_model(size, flags))
