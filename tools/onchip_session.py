@@ -148,11 +148,20 @@ def main():
                 if flags:
                     record = dict(record)
                     record["extra"] = dict(record.get("extra") or {})
-                    record["extra"]["ablation_flags"] = dict(flags)
+                    kills = {k: v for k, v in flags.items()
+                             if k.startswith("FLAGS_")}
+                    knobs = {k: v for k, v in flags.items()
+                             if not k.startswith("FLAGS_")}
+                    if kills:
+                        record["extra"]["ablation_flags"] = kills
+                    if knobs:
+                        record["extra"]["bench_knobs"] = knobs
                 captured.append(record)
-                # ablated runs must not become the BENCH_LAST_GOOD
-                # artifact a wedged session would later re-emit
-                orig_emit(record, on_tpu_flag and not flags)
+                # route-ablated runs must not become the BENCH_LAST_GOOD
+                # artifact a wedged session would later re-emit; config
+                # variations (batch/remat) are legitimate fresh numbers
+                ablated = any(k.startswith("FLAGS_") for k in flags or {})
+                orig_emit(record, on_tpu_flag and not ablated)
 
             bench._emit = cap_emit
             orig_init = bench._init_devices

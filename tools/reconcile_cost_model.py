@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Reconcile the auto-parallel cost model against measured on-chip step
+times (VERDICT r3 weak #5: the estimator had never been compared to a
+real TPU step; its pruning could discard the TPU-best candidate).
+
+Reads every measured llama record it can find — BENCH_R4_PRE_SWEEP.json,
+BENCH_LAST_GOOD.json, ONCHIP_R4.jsonl bench_350m* sections — and prints,
+per record, the estimator's step time for the same (model, batch, seq,
+1-chip) point next to the measurement, with the ratio. Writes the table
+to benchmarks/COST_MODEL_RECONCILE.json so the planner's error factor is
+a recorded, recomputable number. Runs entirely on CPU.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _records():
+    bdir = os.path.join(REPO, "benchmarks")
+    for path in (os.path.join(bdir, "BENCH_R4_PRE_SWEEP.json"),
+                 os.path.join(bdir, "BENCH_LAST_GOOD.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            yield os.path.basename(path), rec
+        except (OSError, ValueError):
+            continue
+    jl = os.path.join(bdir, "ONCHIP_R4.jsonl")
+    if os.path.exists(jl):
+        with open(jl) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("section", "").startswith("bench_350m") \
+                        and "value" in rec:
+                    yield rec["section"], rec
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.distributed.auto_parallel.cost_model import (
+        HardwareSpec, ModelStats, estimate_config_cost)
+    from paddle_tpu.models import llama as L
+
+    # v5e single chip (the bench hardware)
+    v5e = HardwareSpec(flops_per_sec=197e12)
+
+    rows = []
+    seen = set()
+    for name, rec in _records():
+        metric = rec.get("metric", "")
+        if "llama" not in metric or rec.get("extra", {}).get("stale"):
+            continue
+        ex = rec.get("extra", {})
+        if ex.get("n_chips", 1) != 1:
+            # the estimator below is pinned to the 1-chip config; a
+            # multi-chip record folds ICI comm into the ratio
+            continue
+        if not ex.get("n_params"):
+            continue   # can't price a model of unknown size
+        sig = (metric, ex.get("batch"), ex.get("seq"),
+               rec.get("value"))
+        if sig in seen:
+            continue
+        seen.add(sig)
+        size = "350m" if "350m" in metric else (
+            "1b" if "1b" in metric else None)
+        if size is None:
+            continue
+        cfg = {"350m": L.llama_350m, "1b": L.llama_1b}[size]()
+        B, S = ex.get("batch", 4), ex.get("seq", 2048)
+        stats = ModelStats(
+            param_count=ex["n_params"],
+            layers=cfg.num_hidden_layers, hidden=cfg.hidden_size,
+            heads=cfg.num_attention_heads, seq_len=S,
+            vocab=cfg.vocab_size)
+        est = estimate_config_cost(
+            stats, dict(dp_degree=1, mp_degree=1, pp_degree=1,
+                        sharding_degree=1), B, v5e)
+        est_t = est.step_time_s
+        tokens = B * S
+        meas_t = tokens / rec["value"]       # s per step per chip
+        rows.append({
+            "source": name, "model": size, "batch": B, "seq": S,
+            "measured_step_s": round(meas_t, 4),
+            "estimated_step_s": round(float(est_t), 4),
+            "ratio_meas_over_est": round(meas_t / float(est_t), 3),
+            "ablation_flags": ex.get("ablation_flags"),
+        })
+
+    out = {"hw": "v5e 197e12 bf16 peak", "rows": rows}
+    print(json.dumps(out, indent=1))
+    if rows:
+        with open(os.path.join(REPO, "benchmarks",
+                               "COST_MODEL_RECONCILE.json"), "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"\n{len(rows)} reconciliation points written to "
+              "benchmarks/COST_MODEL_RECONCILE.json", file=sys.stderr)
+    else:
+        print("no non-stale measured llama records found", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
