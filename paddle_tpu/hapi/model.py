@@ -38,11 +38,14 @@ class Model:
 
     # -- configuration (ref model.py prepare) -------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, accumulate_steps=1):
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = list(_as_tuple(metrics))
         self._train_step = None
+        # gradient merge inside the compiled step (ref GradientMerge
+        # meta-optimizer; TrainStep scans k micro-batches in-executable)
+        self._accumulate_steps = int(accumulate_steps)
         return self
 
     # -- step functions -----------------------------------------------------
@@ -59,7 +62,9 @@ class Model:
             def step_fn(*xs):
                 return loss_fn(net(*xs))
 
-        self._train_step = pjit.TrainStep(net, self._optimizer, step_fn)
+        self._train_step = pjit.TrainStep(
+            net, self._optimizer, step_fn,
+            accumulate_steps=getattr(self, "_accumulate_steps", 1))
         self._train_step_has_labels = has_labels
 
     def train_batch(self, inputs, labels=None):
@@ -95,6 +100,13 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None):
+        if accumulate_grad_batches != 1 and \
+                accumulate_grad_batches != getattr(
+                    self, "_accumulate_steps", 1):
+            # the reference-API knob: k micro-batches merged inside the
+            # compiled step (same machinery as prepare(accumulate_steps))
+            self._accumulate_steps = int(accumulate_grad_batches)
+            self._train_step = None     # rebuild with the new scan
         loader = self._as_loader(train_data, batch_size, shuffle, drop_last,
                                  num_workers)
         cbs = config_callbacks(callbacks, model=self, epochs=epochs,

@@ -12,7 +12,6 @@ a recorded, recomputable number. Runs entirely on CPU.
 """
 from __future__ import annotations
 
-import glob
 import json
 import os
 import sys
@@ -62,6 +61,9 @@ def main():
         if "llama" not in metric or rec.get("extra", {}).get("stale"):
             continue
         ex = rec.get("extra", {})
+        knobs = ex.get("bench_knobs") or {}
+        if "BENCH_REMAT" in knobs and knobs["BENCH_REMAT"] not in ("0", ""):
+            continue   # remat adds ~1/3 fwd FLOPs the estimator ignores
         if ex.get("n_chips", 1) != 1:
             # the estimator below is pinned to the 1-chip config; a
             # multi-chip record folds ICI comm into the ratio
@@ -96,6 +98,7 @@ def main():
             "estimated_step_s": round(float(est_t), 4),
             "ratio_meas_over_est": round(meas_t / float(est_t), 3),
             "ablation_flags": ex.get("ablation_flags"),
+            "bench_knobs": knobs or None,
         })
 
     out = {"hw": "v5e 197e12 bf16 peak", "rows": rows}
