@@ -54,13 +54,21 @@ irfftn = _mkn(jnp.fft.irfftn)
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework import core
     from .tensor import Tensor
-    return Tensor(jnp.fft.fftfreq(n, d))
+    out = jnp.fft.fftfreq(n, d)
+    if dtype is not None:
+        out = out.astype(core.convert_dtype(dtype))
+    return Tensor(out)
 
 
 def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework import core
     from .tensor import Tensor
-    return Tensor(jnp.fft.rfftfreq(n, d))
+    out = jnp.fft.rfftfreq(n, d)
+    if dtype is not None:
+        out = out.astype(core.convert_dtype(dtype))
+    return Tensor(out)
 
 
 def fftshift(x, axes=None, name=None):

@@ -33,6 +33,12 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
         total = jnp.sum(jnp.stack(
             [jnp.sum(jnp.abs(p.grad.data.astype(jnp.float32)) ** norm_type)
              for p in params])) ** (1.0 / norm_type)
+    if error_if_nonfinite:
+        import numpy as _np
+        if not _np.isfinite(float(total)):
+            raise RuntimeError(
+                "The total norm of gradients is non-finite, so it cannot "
+                "be clipped (set error_if_nonfinite=False to skip)")
     clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
     for p in params:
         p.grad.data = (p.grad.data.astype(jnp.float32) * clip_coef).astype(

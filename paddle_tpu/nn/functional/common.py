@@ -167,15 +167,25 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
 
     method = _MODES[mode]
 
+    # align_mode applies to the linear family with align_corners=False:
+    # 0 (default) = half-pixel source mapping (jax.image.resize),
+    # 1 = asymmetric src = dst * scale (the reference's legacy mode)
+    asym = (align_mode == 1 and not align_corners
+            and mode in ("linear", "bilinear", "trilinear"))
+
     def f(a):
-        if mode == "nearest" or not align_corners:
+        if mode == "nearest" or (not align_corners and not asym):
             return jax.image.resize(a, out_shape, method=method)
-        # align_corners: gather with exact corner-aligned coordinates
+        # gather with exact coordinates (corner-aligned or asymmetric)
         out = a
         for ax, s_out in zip(spatial_axes, out_spatial):
             s_in = a.shape[ax]
             if s_out == 1 or s_in == 1:
                 idx = jnp.zeros((s_out,), jnp.float32)
+            elif asym:
+                idx = jnp.minimum(
+                    jnp.arange(s_out, dtype=jnp.float32)
+                    * (s_in / float(s_out)), s_in - 1.0)
             else:
                 idx = jnp.linspace(0.0, s_in - 1.0, s_out)
             lo = jnp.floor(idx).astype(jnp.int32)

@@ -143,7 +143,7 @@ def huber_loss(input, label, delta=1.0, name=None):
 
 def kldiv_loss(x, target, reduction="mean", log_target=False, name=None):
     from ..nn.functional import kl_div
-    return kl_div(x, target, reduction=reduction)
+    return kl_div(x, target, reduction=reduction, log_target=log_target)
 
 
 def dirichlet(alpha, name=None):

@@ -475,18 +475,40 @@ def put_along_axis(arr, indices, values, axis, reduce="assign",
     def f(a, i, v):
         i = i.astype(jnp.int32)
         v = jnp.broadcast_to(jnp.asarray(v, a.dtype), i.shape)
-        at = jnp.apply_along_axis  # unused; explicit scatter below
         if reduce == "assign":
             return jnp.put_along_axis(a, i, v, axis=ax, inplace=False)
         mode = {"add": "add", "multiply": "multiply", "mul": "multiply",
                 "amin": "min", "amax": "max", "mean": "add"}[reduce]
-        # build scatter via .at on moved axis
+        # scatter via .at on the moved axis
         am = jnp.moveaxis(a, ax, 0)
         im = jnp.moveaxis(i, ax, 0)
         vm = jnp.moveaxis(v, ax, 0)
         grid = jnp.meshgrid(*[jnp.arange(s) for s in im.shape], indexing="ij")
         full_idx = (im,) + tuple(grid[1:])
+        if not include_self:
+            # targets are re-initialized to the reduce identity: arr's
+            # prior values at scattered positions are excluded
+            if reduce in ("amin", "amax"):
+                if jnp.issubdtype(am.dtype, jnp.integer):
+                    info = jnp.iinfo(am.dtype)
+                    init = info.max if reduce == "amin" else info.min
+                else:
+                    init = jnp.inf if reduce == "amin" else -jnp.inf
+            else:
+                init = {"add": 0, "multiply": 1, "mul": 1,
+                        "mean": 0}[reduce]
+            am = am.at[full_idx].set(jnp.asarray(init, am.dtype))
         upd = getattr(am.at[full_idx], mode)(vm)
+        if reduce == "mean":
+            cnt = jnp.zeros(am.shape, jnp.float32).at[full_idx].add(1.0)
+            base = jnp.zeros_like(cnt) if not include_self \
+                else jnp.ones_like(cnt)
+            denom = jnp.maximum(cnt + base, 1.0)
+            scattered = cnt > 0
+            upd = jnp.where(scattered,
+                            (upd.astype(jnp.float32) / denom).astype(
+                                upd.dtype),
+                            upd)
         return jnp.moveaxis(upd, 0, ax)
     return apply_op(f, to_tensor_like(arr), to_tensor_like(indices),
                     to_tensor_like(values), name="put_along_axis")
@@ -546,7 +568,10 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False,
                     return_counts=return_counts, axis=axis)
     if not isinstance(res, tuple):
         return Tensor(jnp.asarray(res))
-    outs = [Tensor(jnp.asarray(r)) for r in res]
+    from ..framework import core as _core
+    idt = _core.convert_dtype(dtype)   # index/inverse/counts dtype
+    outs = [Tensor(jnp.asarray(r if i == 0 else r.astype(idt)))
+            for i, r in enumerate(res)]
     return tuple(outs)
 
 
@@ -560,7 +585,13 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
         ax = axis
     n = arr.shape[ax]
     if n == 0:
+        from ..framework import core as _core
+        idt = _core.convert_dtype(dtype)
         outs = [Tensor(jnp.asarray(arr))]
+        if return_inverse:
+            outs.append(Tensor(jnp.zeros((0,), idt)))
+        if return_counts:
+            outs.append(Tensor(jnp.zeros((0,), idt)))
     else:
         sl = [np.s_[:]] * arr.ndim
         sl[ax] = np.s_[1:]
@@ -572,13 +603,15 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
         keep = np.concatenate([[True], neq])
         out = np.compress(keep, arr, axis=ax)
         outs = [Tensor(jnp.asarray(out))]
+        from ..framework import core as _core
+        idt = _core.convert_dtype(dtype)
         if return_inverse:
             inv = np.cumsum(keep) - 1
-            outs.append(Tensor(jnp.asarray(inv)))
+            outs.append(Tensor(jnp.asarray(inv.astype(idt))))
         if return_counts:
             idx = np.nonzero(keep)[0]
             counts = np.diff(np.append(idx, n))
-            outs.append(Tensor(jnp.asarray(counts)))
+            outs.append(Tensor(jnp.asarray(counts.astype(idt))))
     return outs[0] if len(outs) == 1 else tuple(outs)
 
 
@@ -640,6 +673,16 @@ def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
 
 
 def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    if wrap and x.ndim == 2 and x.shape[0] > x.shape[1]:
+        # tall matrix + wrap: the diagonal restarts every (ncols+1)
+        # flat positions (ref fill_diagonal_ wrap semantics)
+        nr, nc = x.shape
+        start = offset if offset >= 0 else -offset * nc
+        idx = np.arange(start, nr * nc, nc + 1)
+        new = apply_op(
+            lambda a: a.reshape(-1).at[idx].set(value).reshape(nr, nc),
+            x, name="fill_diagonal_")
+        return x._inplace_from(new)
     n = min(x.shape[-2], x.shape[-1])
     i = np.arange(n - abs(offset))
     r = i + max(-offset, 0)

@@ -186,6 +186,9 @@ def cummin(x, axis=None, dtype="int64", name=None):
 
 def logcumsumexp(x, axis=None, dtype=None, name=None):
     def f(a):
+        if dtype is not None:
+            from ..framework import core as _core
+            a = a.astype(_core.convert_dtype(dtype))
         if axis is None:
             a = a.ravel()
             ax = 0

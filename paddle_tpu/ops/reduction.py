@@ -119,6 +119,40 @@ def median(x, axis=None, keepdim=False, mode="avg", name=None):
 
 def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
     ax = _axes(axis)
+    if mode == "min":
+        # lower middle of the NON-NaN values + its index (median's
+        # mode="min" convention; NaNs sort last so a per-slice valid
+        # count picks the right order statistic)
+        x = to_tensor_like(x)
+
+        def val_fn(a):
+            if ax is None:
+                f = a.ravel()
+                valid = jnp.sum(~jnp.isnan(f)).astype(jnp.int32)
+                k = jnp.maximum((valid - 1) // 2, 0)
+                v = jnp.sort(f)[k]
+                return v.reshape([1] * a.ndim) if keepdim else v
+            valid = jnp.sum(~jnp.isnan(a), axis=ax,
+                            keepdims=True).astype(jnp.int32)
+            k = jnp.maximum((valid - 1) // 2, 0)
+            v = jnp.take_along_axis(jnp.sort(a, axis=ax), k, axis=ax)
+            return v if keepdim else jnp.squeeze(v, ax)
+
+        val = apply_op(val_fn, x, name="nanmedian")
+        a = x.data
+        if ax is None:
+            f = a.ravel()
+            valid = jnp.sum(~jnp.isnan(f)).astype(jnp.int32)
+            k = jnp.maximum((valid - 1) // 2, 0)
+            idx = jnp.argsort(f)[k]
+        else:
+            valid = jnp.sum(~jnp.isnan(a), axis=ax,
+                            keepdims=True).astype(jnp.int32)
+            k = jnp.maximum((valid - 1) // 2, 0)
+            idx = jnp.take_along_axis(jnp.argsort(a, axis=ax), k, axis=ax)
+            if not keepdim:
+                idx = jnp.squeeze(idx, ax)
+        return val, Tensor(idx.astype(jnp.int64))
     return apply_op(lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim),
                     to_tensor_like(x), name="nanmedian")
 

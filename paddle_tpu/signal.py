@@ -105,8 +105,14 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
         sp = jnp.swapaxes(spec, -1, -2)               # [..., n, F]
         if normalized:
             sp = sp * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
-        frames = (jnp.fft.irfft(sp, n=n_fft, axis=-1) if onesided
-                  else jnp.fft.ifft(sp, axis=-1).real)
+        if return_complex:
+            if onesided:
+                raise ValueError(
+                    "return_complex=True requires onesided=False")
+            frames = jnp.fft.ifft(sp, axis=-1)
+        else:
+            frames = (jnp.fft.irfft(sp, n=n_fft, axis=-1) if onesided
+                      else jnp.fft.ifft(sp, axis=-1).real)
         frames = frames * w
         n = frames.shape[-2]
         T = (n - 1) * hop_length + n_fft

@@ -1,0 +1,138 @@
+"""Previously accepted-but-ignored parameters now implemented (r4 sweep:
+every numerics-affecting parameter in the public surface must act or
+raise — silently ignoring changes results)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def test_fill_diagonal_wrap():
+    import torch
+    x = paddle.ones((7, 3)) * 2
+    x.fill_diagonal_(1.0, wrap=True)
+    t = torch.ones(7, 3) * 2
+    t.fill_diagonal_(1.0, wrap=True)
+    np.testing.assert_allclose(x.numpy(), t.numpy())
+    # and without wrap stays plain
+    y = paddle.ones((7, 3)) * 2
+    y.fill_diagonal_(1.0)
+    t2 = torch.ones(7, 3) * 2
+    t2.fill_diagonal_(1.0)
+    np.testing.assert_allclose(y.numpy(), t2.numpy())
+
+
+def test_put_along_axis_mean_and_include_self():
+    import torch
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    idx = np.array([[0, 1, 2, 0]])
+    vals = np.full((1, 4), 10.0, np.float32)
+    for include in (True, False):
+        got = paddle.put_along_axis(
+            paddle.to_tensor(a), paddle.to_tensor(idx),
+            paddle.to_tensor(vals), axis=0, reduce="mean",
+            include_self=include).numpy()
+        ref = torch.from_numpy(a.copy()).scatter_reduce(
+            0, torch.from_numpy(idx).long(), torch.from_numpy(vals),
+            reduce="mean", include_self=include).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_put_along_axis_amin_include_self_false():
+    import torch
+    a = np.zeros((2, 3), np.float32)
+    idx = np.array([[0, 0, 1]])
+    vals = np.array([[5.0, 7.0, 9.0]], np.float32)
+    got = paddle.put_along_axis(
+        paddle.to_tensor(a), paddle.to_tensor(idx),
+        paddle.to_tensor(vals), axis=0, reduce="amin",
+        include_self=False).numpy()
+    ref = torch.zeros(2, 3).scatter_reduce(
+        0, torch.from_numpy(idx).long(), torch.from_numpy(vals),
+        reduce="amin", include_self=False).numpy()
+    np.testing.assert_allclose(got, ref)
+
+
+def test_kldiv_log_target():
+    x = np.log(np.array([[0.2, 0.8]], np.float32))
+    tgt = np.array([[0.5, 0.5]], np.float32)
+    a = float(F.kl_div(paddle.to_tensor(x),
+                       paddle.to_tensor(tgt)).numpy())
+    b = float(paddle.to_tensor(  # log-space target must match
+        np.zeros((), np.float32)).numpy()) + float(
+        __import__("paddle_tpu").ops.extra.kldiv_loss(
+            paddle.to_tensor(x), paddle.to_tensor(np.log(tgt)),
+            log_target=True).numpy())
+    assert abs(a - b) < 1e-6
+
+
+def test_nanmedian_mode_min():
+    x = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    avg = float(paddle.nanmedian(paddle.to_tensor(x)).numpy())
+    lo, idx = paddle.nanmedian(paddle.to_tensor(x), mode="min")
+    assert avg == 2.5 and float(lo.numpy()) == 2.0
+    assert int(idx.numpy()) == 1
+    # NaNs are skipped and the index refers to the original array
+    v2, i2 = paddle.nanmedian(paddle.to_tensor(
+        np.array([[1.0, np.nan, 3.0, 2.0]], np.float32)), axis=1,
+        mode="min")
+    assert float(v2.numpy()[0]) == 2.0 and int(i2.numpy()[0]) == 3
+
+
+def test_dtype_outputs():
+    import paddle_tpu.fft as pfft
+    f32 = pfft.fftfreq(8, dtype="float64")
+    # x64 disabled narrows to f32; the point is the cast path runs
+    assert f32.numpy().dtype in (np.float32, np.float64)
+    u, inv = paddle.unique(paddle.to_tensor(
+        np.array([3, 1, 1, 2])), return_inverse=True, dtype="int32")
+    assert inv.numpy().dtype == np.int32
+    _, cnt = paddle.unique_consecutive(
+        paddle.to_tensor(np.array([1, 1, 2])), return_counts=True,
+        dtype="int32")
+    assert cnt.numpy().dtype == np.int32
+    out = paddle.logcumsumexp(paddle.to_tensor(
+        np.array([0.0, 1.0], np.float32)), dtype="float32")
+    assert out.numpy().dtype == np.float32
+
+
+def test_clip_grad_norm_error_if_nonfinite():
+    import paddle_tpu.nn as nn
+    m = nn.Linear(2, 2)
+    loss = (m(paddle.to_tensor(np.ones((1, 2), np.float32))) * np.inf).sum()
+    loss.backward()
+    with pytest.raises(RuntimeError, match="non-finite"):
+        nn.utils.clip_grad_norm_(list(m.parameters()), 1.0,
+                                 error_if_nonfinite=True)
+
+
+def test_interpolate_align_mode_1():
+    import torch
+    x = np.arange(8, dtype=np.float32).reshape(1, 1, 8)
+    got = F.interpolate(paddle.to_tensor(x), size=5, mode="linear",
+                        align_corners=False, align_mode=1).numpy()
+    # align_mode=1 == asymmetric src = dst*scale; differs from the
+    # half-pixel default
+    half = F.interpolate(paddle.to_tensor(x), size=5, mode="linear",
+                         align_corners=False, align_mode=0).numpy()
+    assert not np.allclose(got, half)
+    # expected by direct formula
+    scale = 8 / 5
+    src = np.minimum(np.arange(5) * scale, 7.0)
+    lo = np.floor(src).astype(int)
+    hi = np.minimum(lo + 1, 7)
+    w = src - lo
+    exp = x[0, 0, lo] * (1 - w) + x[0, 0, hi] * w
+    np.testing.assert_allclose(got[0, 0], exp, rtol=1e-6)
+
+
+def test_istft_return_complex():
+    import paddle_tpu.signal as S
+    rng = np.random.default_rng(0)
+    sig = rng.standard_normal(256).astype(np.float32)
+    spec = S.stft(paddle.to_tensor(sig), n_fft=64, onesided=False)
+    out = S.istft(spec, n_fft=64, onesided=False, return_complex=True)
+    assert np.iscomplexobj(out.numpy())
+    with pytest.raises(ValueError):
+        S.istft(spec, n_fft=64, onesided=True, return_complex=True)

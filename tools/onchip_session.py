@@ -193,7 +193,6 @@ def main():
             ("bench_ernie", "ernie", None, 1200),
             ("bench_resnet50", "resnet50", None, 1200),
             ("bench_unet", "unet", None, 1500),
-            ("bench_350m", "350m", None, 900),
             # full-step route ablations for the MFU regression
             ("bench_350m_xla_ce", "350m",
              {"FLAGS_use_fused_ce": "0"}, 900),
@@ -204,6 +203,9 @@ def main():
             ("bench_350m_b8", "350m", {"BENCH_BATCH": "8"}, 900),
             ("bench_350m_b16_remat", "350m",
              {"BENCH_BATCH": "16", "BENCH_REMAT": "1"}, 900),
+            # default config LAST so BENCH_LAST_GOOD ends on the
+            # canonical (comparable) configuration
+            ("bench_350m", "350m", None, 900),
     ):
         _section(name, int(os.environ.get("CFG_BUDGET", str(budget))),
                  bench_model(size, flags))
