@@ -15,6 +15,11 @@ __all__ = ["get_window", "hz_to_mel", "mel_to_hz", "mel_frequencies",
            "create_dct"]
 
 
+def _adt(dtype):
+    from ..framework import core
+    return core.convert_dtype(dtype or "float32")
+
+
 def get_window(window, win_length, fftbins=True, dtype="float32"):
     if isinstance(window, tuple):
         window, *args = window
@@ -34,7 +39,7 @@ def get_window(window, win_length, fftbins=True, dtype="float32"):
         w = 1 - np.abs(2 * t / m - 1)
     else:
         raise ValueError(f"unknown window {window!r}")
-    return Tensor(jnp.asarray(w, jnp.float32))
+    return Tensor(jnp.asarray(w, _adt(dtype)))
 
 
 def hz_to_mel(freq, htk=False):
@@ -66,11 +71,12 @@ def mel_to_hz(mel, htk=False):
 def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
                     dtype="float32"):
     mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
-    return Tensor(jnp.asarray(mel_to_hz(mels, htk), jnp.float32))
+    return Tensor(jnp.asarray(mel_to_hz(mels, htk), _adt(dtype)))
 
 
 def fft_frequencies(sr, n_fft, dtype="float32"):
-    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2, dtype=jnp.float32))
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2,
+                               dtype=_adt(dtype)))
 
 
 def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
@@ -88,7 +94,7 @@ def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
     if norm == "slaney":
         enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
         fb *= enorm[:, None]
-    return Tensor(jnp.asarray(fb, jnp.float32))
+    return Tensor(jnp.asarray(fb, _adt(dtype)))
 
 
 def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
@@ -107,4 +113,4 @@ def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
     if norm == "ortho":
         dct[0] *= 1.0 / math.sqrt(2)
         dct *= math.sqrt(2.0 / n_mels)
-    return Tensor(jnp.asarray(dct.T, jnp.float32))
+    return Tensor(jnp.asarray(dct.T, _adt(dtype)))

@@ -408,7 +408,7 @@ class HeteroPipelineParallel:
         for d, r, off, size, saved in frozen_save:
             self._bufs[d].data = jax.lax.dynamic_update_slice(
                 self._bufs[d].data, saved, (r, off))
-        optimizer.clear_grad()
+        optimizer.clear_grad(set_to_zero=False)
         self._layers_stale = True
         if lr_scheduler is not None:
             lr_scheduler.step()

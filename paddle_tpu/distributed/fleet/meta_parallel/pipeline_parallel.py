@@ -425,7 +425,7 @@ class PipelineParallel:
             if not p.stop_gradient:
                 p.grad = Tensor(g.astype(p.data.dtype))
         optimizer.step()
-        optimizer.clear_grad()
+        optimizer.clear_grad(set_to_zero=False)
         if lr_scheduler is not None:
             lr_scheduler.step()
         return Tensor(loss)

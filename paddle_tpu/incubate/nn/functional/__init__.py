@@ -17,8 +17,21 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
     from ....kernels.rms_norm import rms_norm
     xt = to_tensor_like(x)
     wt = to_tensor_like(norm_weight)
-    out = apply_op(lambda a, w: rms_norm(a, w, epsilon), xt, wt,
-                   name="fused_rms_norm")
+    nd = xt.ndim
+    bna = begin_norm_axis % nd if begin_norm_axis != -1 else nd - 1
+
+    def f(a, w):
+        if bna == a.ndim - 1:
+            return rms_norm(a, w, epsilon)
+        # normalize jointly over axes [begin_norm_axis, ...): flatten
+        # them, run the kernel, restore (ref fused_rms_norm's
+        # begin_norm_axis semantics)
+        shp = a.shape
+        flat = a.reshape(shp[:bna] + (-1,))
+        out = rms_norm(flat, w.reshape(-1), epsilon)
+        return out.reshape(shp)
+
+    out = apply_op(f, xt, wt, name="fused_rms_norm")
     if norm_bias is not None:
         out = out + to_tensor_like(norm_bias)
     return out
@@ -29,6 +42,12 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, **kw):
     xt = to_tensor_like(x)
     return F.layer_norm(xt, xt.shape[-1:], weight=norm_weight,
                         bias=norm_bias, epsilon=epsilon)
+
+
+def _rotate_interleaved(a32):
+    """GPT-J pair rotation: (x0, x1) -> (-x1, x0), interleaved back."""
+    x1, x2 = a32[..., 0::2], a32[..., 1::2]
+    return jnp.stack([-x2, x1], axis=-1).reshape(a32.shape)
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
@@ -60,13 +79,42 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                 s32 = s32[None, :, None, :]               # [1, S, 1, D]
                 c32 = c32[None, :, None, :]
             a32 = a.astype(jnp.float32)
-            h = a32.shape[-1] // 2
-            rot_half = jnp.concatenate([-a32[..., h:], a32[..., :h]], axis=-1)
-            return (a32 * c32 + rot_half * s32).astype(a.dtype)
+            if use_neox_rotary_style:
+                h = a32.shape[-1] // 2
+                rot = jnp.concatenate([-a32[..., h:], a32[..., :h]],
+                                      axis=-1)
+            else:
+                rot = _rotate_interleaved(a32)
+            return (a32 * c32 + rot * s32).astype(a.dtype)
 
         pargs = (pid,) if pid is not None else ()
         q_out = apply_op(rot, qt, st, ct, *pargs, name="fused_rope_q")
         k_out = (apply_op(rot, kt, st, ct, *pargs, name="fused_rope_k")
+                 if kt is not None else None)
+        return (q_out, k_out, to_tensor_like(v) if v is not None else None)
+
+    if not use_neox_rotary_style:
+        # no caches + interleaved style: build GPT-J sin/cos inline
+        # (each frequency repeated per adjacent pair)
+        def rot_j(a, *p):
+            a32 = a.astype(jnp.float32)
+            D = a32.shape[-1]
+            inv = 1.0 / (10000.0 ** (jnp.arange(0, D, 2,
+                                                dtype=jnp.float32) / D))
+            pos = (p[0].astype(jnp.float32) if p
+                   else jnp.arange(a32.shape[1], dtype=jnp.float32))
+            if pos.ndim == 1:
+                pos = pos[None]                            # -> [1, S]
+            ang = pos[..., None] * inv[None, None]         # [B|1, S, D/2]
+            s = jnp.repeat(ang, 2, axis=-1)                # [B|1, S, D]
+            sin = jnp.sin(s)[:, :, None, :]                # [B|1, S, 1, D]
+            cos = jnp.cos(s)[:, :, None, :]
+            rot = _rotate_interleaved(a32)
+            return (a32 * cos + rot * sin).astype(a.dtype)
+
+        pargs = (pid,) if pid is not None else ()
+        q_out = apply_op(rot_j, qt, *pargs, name="fused_rope_q")
+        k_out = (apply_op(rot_j, kt, *pargs, name="fused_rope_k")
                  if kt is not None else None)
         return (q_out, k_out, to_tensor_like(v) if v is not None else None)
 

@@ -316,7 +316,7 @@ class TrainStep:
                         touched.add(i)
                         gd = g.data if isinstance(g, _TT) else g
                         new_acc.append(a + gd.astype(a.dtype))
-                opt.clear_grad()
+                opt.clear_grad(set_to_zero=False)
                 return (new_acc,
                         loss_sum + loss.data.astype(jnp.float32),
                         [t.data for t in btensors]), None
@@ -378,7 +378,9 @@ class TrainStep:
                             loss = step_fn(*_tree_box(batch))
                             loss.backward()
                             opt.step()
-                        opt.clear_grad()
+                        # in-trace: drop grads entirely — zero-filled
+                        # grads here would be traced values leaking out
+                        opt.clear_grad(set_to_zero=False)
                         sd = model.state_dict()
                         new_params = {k: sd[k].data for k in params}
                         new_buffers = {k: sd[k].data for k in buffers}

@@ -481,6 +481,9 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         per_seq = optax.ctc_loss(logits_btc, logitpaddings,
                                  labs.astype(jnp.int32), labelpaddings,
                                  blank_id=blank)
+        if norm_by_times:
+            # normalize each sample's loss by its input length
+            per_seq = per_seq / jnp.maximum(il.astype(jnp.float32), 1.0)
         if reduction == "mean":
             return jnp.mean(per_seq / jnp.maximum(ll.astype(jnp.float32), 1.0))
         return _reduce(per_seq, reduction)

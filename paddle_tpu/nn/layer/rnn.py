@@ -182,35 +182,79 @@ class RNN(Layer):
 
         time_major = self.time_major
         reverse = self.is_reverse
+        has_seq = sequence_length is not None
+
+        def _rev_valid(xt, L):
+            """Per-sequence reverse of the VALID prefix (time-major
+            [T, B, ...]; padded tail stays in place) — the reference's
+            reverse_sequence semantics under sequence_length."""
+            T = xt.shape[0]
+            t = jnp.arange(T)[:, None]                     # [T, 1]
+            src = jnp.where(t < L[None, :], L[None, :] - 1 - t, t)  # [T,B]
+            b = jnp.arange(xt.shape[1])[None, :]
+            return xt[src, b]
+
+        def _scan_masked(body_fn, carry0, xt, L):
+            """Scan with per-step sequence masking: padded steps emit
+            zeros and leave the carry unchanged (states freeze at each
+            sequence's last valid step — ref rnn.py mask logic)."""
+            T = xt.shape[0]
+
+            def body(carry_t, xin_t):
+                carry, t = carry_t
+                new_carry, y = body_fn(carry, xin_t)
+                m = (t < L)[..., None].astype(y.dtype)     # [B, 1]
+                if isinstance(carry, tuple):
+                    new_carry = tuple(m * nc + (1 - m) * oc
+                                      for nc, oc in zip(new_carry, carry))
+                else:
+                    new_carry = m * new_carry + (1 - m) * carry
+                return (new_carry, t + 1), m * y
+
+            (cT, _), ys = jax.lax.scan(body, (carry0, jnp.int32(0)), xt)
+            return cT, ys
 
         if is_lstm:
             h0, c0 = initial_states
-            def f(x, h, c, wi, wh, bi, bh):
+
+            def f(x, h, c, wi, wh, bi, bh, *seq):
                 xt = x if time_major else jnp.swapaxes(x, 0, 1)
+                L = seq[0].astype(jnp.int32) if seq else None
                 if reverse:
-                    xt = jnp.flip(xt, 0)
+                    xt = _rev_valid(xt, L) if has_seq else jnp.flip(xt, 0)
+
                 def body(carry, xin):
                     hh, cc = carry
                     nh, nc = _lstm_step(xin, hh, cc, wi, wh, bi, bh)
                     return (nh, nc), nh
-                (hT, cT), ys = jax.lax.scan(body, (h, c), xt)
+
+                if has_seq:
+                    (hT, cT), ys = _scan_masked(body, (h, c), xt, L)
+                else:
+                    (hT, cT), ys = jax.lax.scan(body, (h, c), xt)
                 if reverse:
-                    ys = jnp.flip(ys, 0)
+                    ys = _rev_valid(ys, L) if has_seq else jnp.flip(ys, 0)
                 if not time_major:
                     ys = jnp.swapaxes(ys, 0, 1)
                 return ys, hT, cT
+
+            extra = ([to_tensor_like(sequence_length)] if has_seq else [])
             ys, hT, cT = apply_op(f, to_tensor_like(inputs),
                                   to_tensor_like(h0), to_tensor_like(c0),
-                                  *params, n_outputs=3, name="rnn_scan")
+                                  *params, *extra, n_outputs=3,
+                                  name="rnn_scan")
             return ys, (hT, cT)
 
         h0 = initial_states
-        def f(x, h, wi, wh, bi, bh):
+
+        def f(x, h, wi, wh, bi, bh, *seq):
             xt = x if time_major else jnp.swapaxes(x, 0, 1)
+            L = seq[0].astype(jnp.int32) if seq else None
             if reverse:
-                xt = jnp.flip(xt, 0)
+                xt = _rev_valid(xt, L) if has_seq else jnp.flip(xt, 0)
             if step is None:
                 a = jnp.tanh if act == "tanh" else jax.nn.relu
+
                 def body(hh, xin):
                     nh = a(xin @ wi.T + bi + hh @ wh.T + bh)
                     return nh, nh
@@ -218,14 +262,20 @@ class RNN(Layer):
                 def body(hh, xin):
                     nh = step(xin, hh, wi, wh, bi, bh)
                     return nh, nh
-            hT, ys = jax.lax.scan(body, h, xt)
+
+            if has_seq:
+                hT, ys = _scan_masked(body, h, xt, L)
+            else:
+                hT, ys = jax.lax.scan(body, h, xt)
             if reverse:
-                ys = jnp.flip(ys, 0)
+                ys = _rev_valid(ys, L) if has_seq else jnp.flip(ys, 0)
             if not time_major:
                 ys = jnp.swapaxes(ys, 0, 1)
             return ys, hT
+
+        extra = ([to_tensor_like(sequence_length)] if has_seq else [])
         ys, hT = apply_op(f, to_tensor_like(inputs), to_tensor_like(h0),
-                          *params, n_outputs=2, name="rnn_scan")
+                          *params, *extra, n_outputs=2, name="rnn_scan")
         return ys, hT
 
 
@@ -239,8 +289,8 @@ class BiRNN(Layer):
         states_fw = states_bw = None
         if initial_states is not None:
             states_fw, states_bw = initial_states
-        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
-        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw, sequence_length)
         from ...ops.manipulation import concat
         return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
 
@@ -282,7 +332,7 @@ class _RNNBase(Layer):
             st = None if initial_states is None else initial_states[i] \
                 if isinstance(initial_states, (list, tuple)) and \
                 len(initial_states) == len(self.rnns) else None
-            out, fs = rnn(out, st)
+            out, fs = rnn(out, st, sequence_length)
             final_states.append(fs)
             if self.dropout > 0 and i < len(self.rnns) - 1:
                 out = F.dropout(out, p=self.dropout, training=self.training)

@@ -569,7 +569,7 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False,
     if not isinstance(res, tuple):
         return Tensor(jnp.asarray(res))
     from ..framework import core as _core
-    idt = _core.convert_dtype(dtype)   # index/inverse/counts dtype
+    idt = _core.convert_dtype(dtype or "int64")   # index/inverse/counts dtype
     outs = [Tensor(jnp.asarray(r if i == 0 else r.astype(idt)))
             for i, r in enumerate(res)]
     return tuple(outs)
@@ -586,7 +586,7 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
     n = arr.shape[ax]
     if n == 0:
         from ..framework import core as _core
-        idt = _core.convert_dtype(dtype)
+        idt = _core.convert_dtype(dtype or "int64")
         outs = [Tensor(jnp.asarray(arr))]
         if return_inverse:
             outs.append(Tensor(jnp.zeros((0,), idt)))
@@ -604,7 +604,7 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
         out = np.compress(keep, arr, axis=ax)
         outs = [Tensor(jnp.asarray(out))]
         from ..framework import core as _core
-        idt = _core.convert_dtype(dtype)
+        idt = _core.convert_dtype(dtype or "int64")
         if return_inverse:
             inv = np.cumsum(keep) - 1
             outs.append(Tensor(jnp.asarray(inv.astype(idt))))

@@ -119,6 +119,22 @@ def median(x, axis=None, keepdim=False, mode="avg", name=None):
 
 def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
     ax = _axes(axis)
+    if mode == "min" and isinstance(ax, (tuple, list)):
+        # multi-axis: collapse the reduced axes to one and recurse (the
+        # index then refers to the collapsed slice)
+        x = to_tensor_like(x)
+        axes = sorted(a % x.ndim for a in ax)
+        perm = [i for i in range(x.ndim) if i not in axes] + axes
+        from .manipulation import reshape, transpose
+        xt = transpose(x, perm)
+        lead = [xt.shape[i] for i in range(x.ndim - len(axes))]
+        xt = reshape(xt, lead + [-1])
+        v, i = nanmedian(xt, axis=-1, keepdim=False, mode="min")
+        if keepdim:
+            shp = [1 if d in axes else x.shape[d] for d in range(x.ndim)]
+            v = reshape(v, shp)
+            i = reshape(i, shp)
+        return v, i
     if mode == "min":
         # lower middle of the NON-NaN values + its index (median's
         # mode="min" convention; NaNs sort last so a per-slice valid

@@ -251,6 +251,8 @@ class ReduceOnPlateau(LRScheduler):
         return self.last_lr
 
     def step(self, metrics=None, epoch=None):
+        if epoch is not None:
+            self.last_epoch = epoch
         if metrics is None:
             return
         current = float(metrics.item() if hasattr(metrics, "item") else metrics)

@@ -113,8 +113,17 @@ class Optimizer:
 
     # -- step ---------------------------------------------------------------
     def clear_grad(self, set_to_zero=True):
+        # set_to_zero keeps a zero grad Tensor in place (the reference's
+        # in-place zeroing, so accumulation hooks see a buffer); False
+        # drops the grad entirely
         for p in self._parameter_list:
-            p.grad = None
+            if set_to_zero and p.grad is not None:
+                from ..tensor import Tensor
+                g = p.grad
+                p.grad = Tensor(jnp.zeros_like(
+                    g.data if isinstance(g, Tensor) else g))
+            else:
+                p.grad = None
 
     clear_gradients = clear_grad
 
@@ -499,7 +508,7 @@ class LBFGS(Optimizer):
             new_w = w + lr * d
             with no_grad():
                 self._scatter(ps, new_w)
-            self.clear_grad()
+            self.clear_grad(set_to_zero=False)
             loss = closure()
             _, w2, g2 = self._gather()
             s_vec = w2 - w

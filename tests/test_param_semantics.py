@@ -136,3 +136,118 @@ def test_istft_return_complex():
     assert np.iscomplexobj(out.numpy())
     with pytest.raises(ValueError):
         S.istft(spec, n_fft=64, onesided=True, return_complex=True)
+
+
+def test_rnn_sequence_length_matches_torch_packed():
+    """sequence_length was accepted and ignored — padded steps now emit
+    zeros and states freeze at each sequence's end (torch
+    pack_padded_sequence semantics, LSTM fwd + bidirectional)."""
+    import torch
+
+    import paddle_tpu.nn as nn
+    np.random.seed(0)
+    B, T, I, H = 3, 5, 4, 6
+    x = np.random.randn(B, T, I).astype(np.float32)
+    lens = np.array([5, 3, 2], np.int64)
+
+    paddle.seed(0)
+    lstm = nn.LSTM(I, H)
+    sd = lstm.state_dict()
+    tl = torch.nn.LSTM(I, H, batch_first=True)
+    with torch.no_grad():
+        for ours, theirs in (("weight_ih", tl.weight_ih_l0),
+                             ("weight_hh", tl.weight_hh_l0),
+                             ("bias_ih", tl.bias_ih_l0),
+                             ("bias_hh", tl.bias_hh_l0)):
+            theirs.copy_(torch.from_numpy(
+                np.asarray(sd[f"rnns.0.cell.{ours}"].numpy()).copy()))
+    out, st = lstm(paddle.to_tensor(x),
+                   sequence_length=paddle.to_tensor(lens))
+    h, c = st[0]
+    packed = torch.nn.utils.rnn.pack_padded_sequence(
+        torch.from_numpy(x.copy()), lens, batch_first=True,
+        enforce_sorted=False)
+    to, (th, tc) = tl(packed)
+    to_pad, _ = torch.nn.utils.rnn.pad_packed_sequence(
+        to, batch_first=True, total_length=T)
+    np.testing.assert_allclose(out.numpy(), to_pad.detach().numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), th.detach().numpy()[0],
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(c.numpy(), tc.detach().numpy()[0],
+                               rtol=1e-5, atol=1e-5)
+
+    # reverse direction: outputs for the valid prefix must equal a
+    # manual run over the reversed valid slice, padded tail zero
+    paddle.seed(1)
+    rnn_bw = nn.SimpleRNN(I, H, direction="forward")
+    cell = rnn_bw.rnns[0].cell
+    from paddle_tpu.nn.layer.rnn import RNN
+    r = RNN(cell, is_reverse=True)
+    out_r, _ = r(paddle.to_tensor(x),
+                 sequence_length=paddle.to_tensor(lens))
+    o = out_r.numpy()
+    assert np.allclose(o[2, 2:], 0.0), "padded tail must be zero"
+    # the valid prefix must equal running the same reverse RNN on just
+    # the valid slice (no padding): identical sequence, same direction
+    out_manual, _ = r(paddle.to_tensor(x[2:3, :2].copy()))
+    np.testing.assert_allclose(o[2, :2], out_manual.numpy()[0],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_rotary_style_and_rms_begin_axis():
+    from paddle_tpu.incubate.nn import functional as IF
+    np.random.seed(0)
+    q = paddle.to_tensor(np.random.randn(2, 6, 2, 8).astype(np.float32))
+    qn, _, _ = IF.fused_rotary_position_embedding(
+        q, use_neox_rotary_style=True)
+    qj, _, _ = IF.fused_rotary_position_embedding(
+        q, use_neox_rotary_style=False)
+    assert not np.allclose(qn.numpy(), qj.numpy())
+    # GPT-J interleaved formula
+    a = q.numpy().astype(np.float32)
+    inv = 1.0 / (10000.0 ** (np.arange(0, 8, 2) / 8))
+    ang = np.arange(6)[:, None] * inv[None]
+    s = np.repeat(ang, 2, axis=-1)
+    sin = np.sin(s)[None, :, None, :]
+    cos = np.cos(s)[None, :, None, :]
+    x1, x2 = a[..., 0::2], a[..., 1::2]
+    rot = np.stack([-x2, x1], axis=-1).reshape(a.shape)
+    np.testing.assert_allclose(qj.numpy(), a * cos + rot * sin,
+                               rtol=1e-5, atol=1e-6)
+
+    # begin_norm_axis: joint normalization over trailing axes
+    x = paddle.to_tensor(np.random.randn(2, 3, 4).astype(np.float32))
+    w = paddle.to_tensor(np.ones((12,), np.float32))
+    out = IF.fused_rms_norm(x, w, begin_norm_axis=1).numpy()
+    xa = x.numpy()
+    flat = xa.reshape(2, 12)
+    exp = (flat / np.sqrt((flat ** 2).mean(-1, keepdims=True) + 1e-6)
+           ).reshape(2, 3, 4)
+    np.testing.assert_allclose(out, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_norm_by_times_and_clear_grad_modes():
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as popt
+    # ctc: norm_by_times divides per-sample loss by input length
+    T, B, C = 6, 2, 5
+    np.random.seed(0)
+    lp = paddle.to_tensor(np.random.randn(T, B, C).astype(np.float32))
+    lbl = paddle.to_tensor(np.array([[1, 2], [3, 0]], np.int64))
+    il = paddle.to_tensor(np.array([6, 4], np.int64))
+    ll = paddle.to_tensor(np.array([2, 1], np.int64))
+    a = F.ctc_loss(lp, lbl, il, ll, reduction="sum")
+    b = F.ctc_loss(lp, lbl, il, ll, reduction="sum", norm_by_times=True)
+    assert float(b.numpy()) < float(a.numpy())
+
+    # clear_grad: default keeps zeroed grads, False drops them
+    m = nn.Linear(2, 2)
+    o = popt.SGD(learning_rate=0.1, parameters=m.parameters())
+    loss = m(paddle.to_tensor(np.ones((1, 2), np.float32))).sum()
+    loss.backward()
+    o.clear_grad()   # set_to_zero=True default
+    assert m.weight.grad is not None
+    assert np.allclose(m.weight.grad.numpy(), 0.0)
+    o.clear_grad(set_to_zero=False)
+    assert m.weight.grad is None
