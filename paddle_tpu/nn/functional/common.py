@@ -82,8 +82,7 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 feature_alpha_dropout = alpha_dropout
 
 
-def embedding(x, weight, padding_idx=None, sparse=False, max_norm=None,
-              norm_type=2.0, scale_grad_by_freq=False, name=None):
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     """Gather rows (ref: phi/kernels/gpu/embedding_kernel.cu). On TPU this is
     a single dynamic-gather the MXU-adjacent layout handles natively."""
     def f(ids, w):

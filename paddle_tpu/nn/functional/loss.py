@@ -482,8 +482,12 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
                                  labs.astype(jnp.int32), labelpaddings,
                                  blank_id=blank)
         if norm_by_times:
-            # normalize each sample's loss by its input length
-            per_seq = per_seq / jnp.maximum(il.astype(jnp.float32), 1.0)
+            # the reference (warpctc) normalizes the GRADIENTS by each
+            # sample's time steps, leaving the loss value unchanged:
+            # value == per_seq, d/dx == (1/T) * d(per_seq)/dx
+            t = jnp.maximum(il.astype(jnp.float32), 1.0)
+            scaled = per_seq / t
+            per_seq = scaled + jax.lax.stop_gradient(per_seq - scaled)
         if reduction == "mean":
             return jnp.mean(per_seq / jnp.maximum(ll.astype(jnp.float32), 1.0))
         return _reduce(per_seq, reduction)

@@ -167,6 +167,20 @@ def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
     sort_p = jnp.take_along_axis(probs, sort_idx, axis=-1)
     cum = jnp.cumsum(sort_p, axis=-1)
     keep = cum - sort_p < p_cap[:, None]     # always keep the top token
+    if threshold is not None:
+        # absolute probability floor, effective together with ps
+        thr = jnp.reshape(unwrap(to_tensor_like(threshold))
+                          .astype(jnp.float32), (-1, 1))
+        keep = keep & (sort_p >= thr)
+    if k and int(k) > 0:
+        keep = keep & (jnp.arange(sort_p.shape[-1])[None, :] < int(k))
+    keep = keep.at[:, 0].set(True)           # never filter the argmax
+    if mode != "truncated" or return_top:
+        import warnings
+        warnings.warn("top_p_sampling: mode!='truncated' / return_top "
+                      "are accepted for kernel-signature parity but not "
+                      "implemented; sampling uses the truncated "
+                      "distribution", UserWarning)
     filt = jnp.where(keep, sort_p, 0.0)
     filt = filt / jnp.maximum(filt.sum(-1, keepdims=True), 1e-12)
     key = (jax.random.PRNGKey(seed) if seed >= 0 else core.next_rng_key())

@@ -215,6 +215,13 @@ def lstsq(x, y, rcond=None, driver=None, name=None):
 
 
 def lu(x, pivot=True, get_infos=False, name=None):
+    if not pivot:
+        # LAPACK getrf (and the reference GPU kernel) always pivots;
+        # silently returning pivoted factors for pivot=False would be a
+        # wrong decomposition
+        raise NotImplementedError(
+            "lu(pivot=False) is not supported (the underlying "
+            "factorization always partial-pivots)")
     lu_mat, piv = jax.scipy.linalg.lu_factor(unwrap(x))
     outs = [Tensor(lu_mat), Tensor(piv.astype(jnp.int32) + 1)]
     if get_infos:
@@ -226,15 +233,31 @@ def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True, name=N
     lu_mat = unwrap(lu_data)
     piv = unwrap(lu_pivots) - 1
     n = lu_mat.shape[-2]
-    L = jnp.tril(lu_mat, -1) + jnp.eye(n, lu_mat.shape[-1], dtype=lu_mat.dtype)
-    L = L[..., :, : min(lu_mat.shape[-2:])]
-    U = jnp.triu(lu_mat)[..., : min(lu_mat.shape[-2:]), :]
-    perm = np.arange(n)
-    pv = np.asarray(piv)
-    for i, p in enumerate(pv):
-        perm[i], perm[p] = perm[p], perm[i]
-    P = jnp.eye(n, dtype=lu_mat.dtype)[perm].T
-    return Tensor(P), Tensor(L), Tensor(U)
+    L = U = P = None
+    if unpack_ludata:
+        L = jnp.tril(lu_mat, -1) + jnp.eye(n, lu_mat.shape[-1],
+                                           dtype=lu_mat.dtype)
+        L = L[..., :, : min(lu_mat.shape[-2:])]
+        U = jnp.triu(lu_mat)[..., : min(lu_mat.shape[-2:]), :]
+    if unpack_pivots:
+        pv = np.asarray(piv)
+        batch = pv.shape[:-1]
+        pv2 = pv.reshape(-1, pv.shape[-1])
+        eyes = []
+        for row in pv2:
+            perm = np.arange(n)
+            for i, p in enumerate(row):
+                perm[i], perm[p] = perm[p], perm[i]
+            eyes.append(np.eye(n)[perm].T)
+        P = jnp.asarray(np.stack(eyes).reshape(batch + (n, n)).astype(
+            np.asarray(lu_mat.dtype).type if hasattr(
+                np.asarray(lu_mat.dtype), "type") else lu_mat.dtype))
+        if not batch:
+            P = P.reshape(n, n)
+    # paddle returns (P, L, U) with None placeholders for skipped parts
+    return (Tensor(P) if P is not None else None,
+            Tensor(L) if L is not None else None,
+            Tensor(U) if U is not None else None)
 
 
 def lu_solve(b, lu_data, lu_pivots, trans=0, name=None):

@@ -114,16 +114,10 @@ class Optimizer:
     # -- step ---------------------------------------------------------------
     def clear_grad(self, set_to_zero=True):
         # set_to_zero keeps a zero grad Tensor in place (the reference's
-        # in-place zeroing, so accumulation hooks see a buffer); False
-        # drops the grad entirely
+        # in-place zeroing); False drops the grad entirely. One shared
+        # implementation with Tensor.clear_gradient.
         for p in self._parameter_list:
-            if set_to_zero and p.grad is not None:
-                from ..tensor import Tensor
-                g = p.grad
-                p.grad = Tensor(jnp.zeros_like(
-                    g.data if isinstance(g, Tensor) else g))
-            else:
-                p.grad = None
+            p.clear_gradient(set_to_zero)
 
     clear_gradients = clear_grad
 

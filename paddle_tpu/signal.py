@@ -34,10 +34,20 @@ def frame(x, frame_length, hop_length, axis=-1, name=None):
 
 
 def overlap_add(x, hop_length, axis=-1, name=None):
-    """[..., frame_length, n_frames] -> [..., T] (inverse of frame)."""
+    """[..., frame_length, n_frames] -> [..., T] (inverse of frame).
+    axis=0 takes the transposed layout [n_frames, frame_length, ...]
+    and returns [T, ...] (ref signal.py::overlap_add axis semantics)."""
     xt = to_tensor_like(x)
 
     def f(a):
+        if axis == 0:
+            # [n, L, rest...] -> [rest..., L, n], compute, then put the
+            # time dim back in front
+            perm = list(range(2, a.ndim)) + [1, 0]
+            return jnp.moveaxis(_core_oa(jnp.transpose(a, perm)), -1, 0)
+        return _core_oa(a)
+
+    def _core_oa(a):
         L, n = a.shape[-2], a.shape[-1]
         T = (n - 1) * hop_length + L
         frames = jnp.swapaxes(a, -1, -2)                        # [..., n, L]

@@ -140,7 +140,7 @@ class Tensor:
     def clear_grad(self):
         self.grad = None
 
-    def clear_gradient(self, set_to_zero: bool = False):
+    def clear_gradient(self, set_to_zero: bool = True):
         if set_to_zero and self.grad is not None:
             self.grad = Tensor(jnp.zeros_like(self.grad.data), stop_gradient=True)
         else:

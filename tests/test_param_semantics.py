@@ -237,9 +237,20 @@ def test_ctc_norm_by_times_and_clear_grad_modes():
     lbl = paddle.to_tensor(np.array([[1, 2], [3, 0]], np.int64))
     il = paddle.to_tensor(np.array([6, 4], np.int64))
     ll = paddle.to_tensor(np.array([2, 1], np.int64))
+    lp.stop_gradient = False
     a = F.ctc_loss(lp, lbl, il, ll, reduction="sum")
+    a.backward()
+    ga = lp.grad.numpy().copy()
+    lp.clear_gradient(False)
     b = F.ctc_loss(lp, lbl, il, ll, reduction="sum", norm_by_times=True)
-    assert float(b.numpy()) < float(a.numpy())
+    # warpctc semantics: the VALUE is unchanged; gradients scale 1/T
+    assert abs(float(b.numpy()) - float(a.numpy())) < 1e-5
+    b.backward()
+    gb = lp.grad.numpy()
+    np.testing.assert_allclose(gb[:, 0], ga[:, 0] / 6.0, rtol=1e-4,
+                               atol=1e-6)
+    np.testing.assert_allclose(gb[:, 1], ga[:, 1] / 4.0, rtol=1e-4,
+                               atol=1e-6)
 
     # clear_grad: default keeps zeroed grads, False drops them
     m = nn.Linear(2, 2)
@@ -251,3 +262,48 @@ def test_ctc_norm_by_times_and_clear_grad_modes():
     assert np.allclose(m.weight.grad.numpy(), 0.0)
     o.clear_grad(set_to_zero=False)
     assert m.weight.grad is None
+
+
+def test_misc_param_batch3():
+    """overlap_add(axis=0), top_p_sampling(threshold), lu(pivot=False)
+    raises, lu_unpack unpack flags."""
+    import paddle_tpu.signal as S
+    x = np.random.randn(4, 6).astype(np.float32)
+    a = S.overlap_add(paddle.to_tensor(x), hop_length=2).numpy()
+    b = S.overlap_add(paddle.to_tensor(x.T.copy()), hop_length=2,
+                      axis=0).numpy()
+    np.testing.assert_allclose(a, b)
+
+    lg = paddle.to_tensor(np.log(np.array([[0.6, 0.25, 0.15]],
+                                          np.float32)))
+    ps = paddle.to_tensor(np.array([0.99], np.float32))
+    seen = set()
+    for s in range(20):
+        _, i = paddle.top_p_sampling(
+            lg, ps, threshold=paddle.to_tensor(
+                np.array([0.2], np.float32)), seed=s)
+        seen.add(int(i.numpy()[0, 0]))
+    assert 2 not in seen, seen   # below the absolute floor
+
+    with pytest.raises(NotImplementedError):
+        paddle.linalg.lu(paddle.to_tensor(
+            np.eye(3, dtype=np.float32)), pivot=False)
+
+    m = paddle.to_tensor(np.random.randn(3, 3).astype(np.float32))
+    lu_mat, piv = paddle.linalg.lu(m)
+    P, L, U = paddle.linalg.lu_unpack(lu_mat, piv)
+    np.testing.assert_allclose(
+        (P.numpy() @ L.numpy() @ U.numpy()), m.numpy(), atol=1e-5)
+    P2, L2, U2 = paddle.linalg.lu_unpack(lu_mat, piv,
+                                         unpack_ludata=False)
+    assert L2 is None and U2 is None and P2 is not None
+    P3, L3, U3 = paddle.linalg.lu_unpack(lu_mat, piv,
+                                         unpack_pivots=False)
+    assert P3 is None and L3 is not None
+    # batched reconstruction
+    mb = paddle.to_tensor(np.random.randn(3, 4, 4).astype(np.float32))
+    lub, pivb = paddle.linalg.lu(mb)
+    Pb, Lb, Ub = paddle.linalg.lu_unpack(lub, pivb)
+    rec = np.einsum("bij,bjk,bkl->bil", Pb.numpy(), Lb.numpy(),
+                    Ub.numpy())
+    np.testing.assert_allclose(rec, mb.numpy(), atol=1e-4)
