@@ -50,6 +50,15 @@ def _rotate_interleaved(a32):
     return jnp.stack([-x2, x1], axis=-1).reshape(a32.shape)
 
 
+def _gptj_sincos(pos, D, base=10000.0):
+    """Interleaved-style rotary tables: sin/cos of shape pos.shape+(D,)
+    with each frequency repeated per adjacent pair."""
+    inv = 1.0 / (base ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+    ang = pos.astype(jnp.float32)[..., None] * inv
+    s = jnp.repeat(ang, 2, axis=-1)
+    return jnp.sin(s), jnp.cos(s)
+
+
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                                     position_ids=None,
                                     use_neox_rotary_style=True):
@@ -98,17 +107,12 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         # (each frequency repeated per adjacent pair)
         def rot_j(a, *p):
             a32 = a.astype(jnp.float32)
-            D = a32.shape[-1]
-            inv = 1.0 / (10000.0 ** (jnp.arange(0, D, 2,
-                                                dtype=jnp.float32) / D))
             pos = (p[0].astype(jnp.float32) if p
                    else jnp.arange(a32.shape[1], dtype=jnp.float32))
             if pos.ndim == 1:
                 pos = pos[None]                            # -> [1, S]
-            ang = pos[..., None] * inv[None, None]         # [B|1, S, D/2]
-            s = jnp.repeat(ang, 2, axis=-1)                # [B|1, S, D]
-            sin = jnp.sin(s)[:, :, None, :]                # [B|1, S, 1, D]
-            cos = jnp.cos(s)[:, :, None, :]
+            sin, cos = _gptj_sincos(pos, a32.shape[-1])    # [B|1, S, D]
+            sin, cos = sin[:, :, None, :], cos[:, :, None, :]
             rot = _rotate_interleaved(a32)
             return (a32 * cos + rot * sin).astype(a.dtype)
 
@@ -196,20 +200,52 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None, *,
     sl = (sequence_lengths.data if isinstance(sequence_lengths, Tensor)
           else jnp.asarray(sequence_lengths)).astype(jnp.int32).reshape(B)
     if rotary_emb_dims and rotary_emb_dims > 0:
-        # apply RoPE to this step's q/k at their absolute positions
-        from ....kernels.rope import apply_rope
-        qr, kr = apply_rope(q[:, None], k[:, None],
-                            position_ids=sl[:, None], seq_len=S_max)
-        q, k = qr[:, 0], kr[:, 0]
+        if use_neox_rotary_style:
+            # rotate-half layout at this step's absolute positions
+            from ....kernels.rope import apply_rope
+            qr, kr = apply_rope(q[:, None], k[:, None],
+                                position_ids=sl[:, None], seq_len=S_max)
+            q, k = qr[:, 0], kr[:, 0]
+        else:
+            # the kernel's default: GPT-J interleaved pairs
+            sin, cos = _gptj_sincos(sl, q.shape[-1])       # [B, D]
+            sin, cos = sin[:, None, :], cos[:, None, :]    # [B, 1, D]
+            q32, k32 = q.astype(jnp.float32), k.astype(jnp.float32)
+            q = (q32 * cos + _rotate_interleaved(q32) * sin).astype(
+                q.dtype)
+            k = (k32 * cos + _rotate_interleaved(k32) * sin).astype(
+                k.dtype)
     # write this step's k/v at position sl
     oh = jax.nn.one_hot(sl, S_max, dtype=cache.dtype)        # [B, S_max]
     ck = cache[0] * (1 - oh[:, None, :, None]) + \
         oh[:, None, :, None] * k[:, :, None, :].astype(cache.dtype)
     cv = cache[1] * (1 - oh[:, None, :, None]) + \
         oh[:, None, :, None] * v[:, :, None, :].astype(cache.dtype)
-    # [B, nh, S, d] -> [B, S, nh, d] for the kernel
-    out = decode_attention(q[:, None], jnp.swapaxes(ck, 1, 2),
-                           jnp.swapaxes(cv, 1, 2), sl + 1)
+    if src_mask is not None:
+        # arbitrary additive mask over cached positions: dense masked
+        # path (the kernel route only supports the length mask)
+        from ....tensor import Tensor as _T
+        sm = (src_mask.data if isinstance(src_mask, _T)
+              else jnp.asarray(src_mask)).astype(jnp.float32)
+        sm = sm.reshape(B, 1, -1)
+        if sm.shape[-1] < S_max:
+            # masks come sized to the live prefix ([B,1,1,seq_len+1]
+            # in the reference docs); positions beyond are covered by
+            # the length mask, pad with zeros
+            sm = jnp.pad(sm, ((0, 0), (0, 0),
+                              (0, S_max - sm.shape[-1])))
+        sm = sm[..., :S_max]                               # [B, 1, S]
+        scores = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                            ck.astype(jnp.float32)) / (d ** 0.5)
+        pos_ok = jnp.arange(S_max)[None, None, :] <= sl[:, None, None]
+        scores = jnp.where(pos_ok, scores + sm, -1e30)
+        p = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhs,bhsd->bhd", p,
+                         cv.astype(jnp.float32))[:, None].astype(q.dtype)
+    else:
+        # [B, nh, S, d] -> [B, S, nh, d] for the kernel
+        out = decode_attention(q[:, None], jnp.swapaxes(ck, 1, 2),
+                               jnp.swapaxes(cv, 1, 2), sl + 1)
     new_cache = jnp.stack([ck, cv])
     return (Tensor(out[:, 0].reshape(B, nh * d), stop_gradient=True),
             Tensor(new_cache, stop_gradient=True))
@@ -377,6 +413,24 @@ def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
         return act(out)
 
     return apply_op(f, *args, name="fused_linear_activation")
+
+
+def _fmt_dropout(v, rate, training, mode):
+    """Residual-branch dropout for fused_multi_transformer (ref: the
+    CUDA kernel applies dropout on both residual adds in training)."""
+    if not rate:
+        return v
+    if not training:
+        # downscale_in_infer: train keeps the unscaled mask, inference
+        # scales by the keep probability
+        if mode == "downscale_in_infer":
+            return (v * (1.0 - rate)).astype(v.dtype)
+        return v
+    from ....framework import core as _core
+    keep = jax.random.bernoulli(_core.next_rng_key(), 1.0 - rate, v.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, v / (1.0 - rate), 0.0).astype(v.dtype)
+    return jnp.where(keep, v, 0.0).astype(v.dtype)
 
 
 def fused_multi_transformer(
@@ -548,6 +602,7 @@ def fused_multi_transformer(
         o = o @ (lw if lw.shape[0] == nh * d else lw.T)
         if linear_biases and linear_biases[i] is not None:
             o = o + arr(linear_biases[i])
+        o = _fmt_dropout(o, dropout_rate, training, mode)
         h = residual + o
         if not pre_layer_norm:   # post-LN: norm AFTER the residual add
             h = layer_norm(h, arr(ln_scales[i]),
@@ -570,6 +625,7 @@ def fused_multi_transformer(
         u = u @ (f2w if f2w.shape[0] == u.shape[-1] else f2w.T)
         if ffn2_biases and ffn2_biases[i] is not None:
             u = u + arr(ffn2_biases[i])
+        u = _fmt_dropout(u, dropout_rate, training, mode)
         h = residual + u
         if not pre_layer_norm:
             h = layer_norm(h, arr(ffn_ln_scales[i]),

@@ -249,11 +249,8 @@ def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True, name=N
             for i, p in enumerate(row):
                 perm[i], perm[p] = perm[p], perm[i]
             eyes.append(np.eye(n)[perm].T)
-        P = jnp.asarray(np.stack(eyes).reshape(batch + (n, n)).astype(
-            np.asarray(lu_mat.dtype).type if hasattr(
-                np.asarray(lu_mat.dtype), "type") else lu_mat.dtype))
-        if not batch:
-            P = P.reshape(n, n)
+        P = jnp.asarray(
+            np.stack(eyes).reshape(batch + (n, n))).astype(lu_mat.dtype)
     # paddle returns (P, L, U) with None placeholders for skipped parts
     return (Tensor(P) if P is not None else None,
             Tensor(L) if L is not None else None,

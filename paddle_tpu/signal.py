@@ -37,6 +37,9 @@ def overlap_add(x, hop_length, axis=-1, name=None):
     """[..., frame_length, n_frames] -> [..., T] (inverse of frame).
     axis=0 takes the transposed layout [n_frames, frame_length, ...]
     and returns [T, ...] (ref signal.py::overlap_add axis semantics)."""
+    if axis not in (0, -1):
+        raise ValueError(
+            f"overlap_add supports axis 0 or -1, got {axis}")
     xt = to_tensor_like(x)
 
     def f(a):

@@ -307,3 +307,50 @@ def test_misc_param_batch3():
     rec = np.einsum("bij,bjk,bkl->bil", Pb.numpy(), Lb.numpy(),
                     Ub.numpy())
     np.testing.assert_allclose(rec, mb.numpy(), atol=1e-4)
+
+
+def test_mmha_src_mask_and_fmt_dropout():
+    from paddle_tpu.incubate.nn import functional as IF
+    np.random.seed(0)
+    B, nh, S, d = 2, 2, 8, 4
+    cache = paddle.to_tensor(
+        np.random.randn(2, B, nh, S, d).astype(np.float32))
+    x = paddle.to_tensor(np.random.randn(B, 3 * nh * d).astype(np.float32))
+    sl = paddle.to_tensor(np.array([3, 5], np.int64))
+    o1, _ = IF.masked_multihead_attention(x, cache, sequence_lengths=sl)
+    zm = paddle.to_tensor(np.zeros((B, 1, 1, S), np.float32))
+    o2, c2 = IF.masked_multihead_attention(x, cache, src_mask=zm,
+                                           sequence_lengths=sl)
+    np.testing.assert_allclose(o1.numpy(), o2.numpy(), rtol=2e-3,
+                               atol=2e-3)
+    hard = np.full((B, 1, 1, S), -1e30, np.float32)
+    hard[..., 0] = 0
+    o3, _ = IF.masked_multihead_attention(
+        x, cache, src_mask=paddle.to_tensor(hard), sequence_lengths=sl)
+    v0 = c2.numpy()[1][:, :, 0]
+    np.testing.assert_allclose(o3.numpy(), v0.reshape(B, nh * d),
+                               rtol=1e-4, atol=1e-5)
+
+    # fmt dropout: training=True with rate>0 changes outputs run-to-run
+    # while training=False is deterministic
+    H, L = nh * d, 1
+    mk = lambda *s: paddle.to_tensor(
+        np.random.randn(*s).astype(np.float32) * 0.1)
+    args = dict(
+        x=mk(B, 2, H), ln_scales=[mk(H)], ln_biases=[mk(H)],
+        qkv_weights=[mk(H, 3, nh, d)], qkv_biases=[mk(3, nh, d)],
+        linear_weights=[mk(H, H)], linear_biases=[mk(H)],
+        ffn_ln_scales=[mk(H)], ffn_ln_biases=[mk(H)],
+        ffn1_weights=[mk(H, 2 * H)], ffn1_biases=[mk(2 * H)],
+        ffn2_weights=[mk(2 * H, H)], ffn2_biases=[mk(H)],
+        trans_qkvw=False)
+    paddle.seed(0)
+    a = IF.fused_multi_transformer(**args).numpy()
+    b = IF.fused_multi_transformer(**args).numpy()
+    np.testing.assert_allclose(a, b)      # eval: deterministic
+    paddle.seed(0)
+    c = IF.fused_multi_transformer(**args, dropout_rate=0.5,
+                                   training=True).numpy()
+    d2 = IF.fused_multi_transformer(**args, dropout_rate=0.5,
+                                    training=True).numpy()
+    assert not np.allclose(c, d2), "training dropout must be stochastic"
