@@ -227,6 +227,11 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None, *,
         from ....tensor import Tensor as _T
         sm = (src_mask.data if isinstance(src_mask, _T)
               else jnp.asarray(src_mask)).astype(jnp.float32)
+        if sm.ndim >= 3 and any(s != 1 for s in sm.shape[1:-1]):
+            raise ValueError(
+                "masked_multihead_attention src_mask must broadcast "
+                "over heads and the single query "
+                f"([B, 1, 1, S]); got {tuple(sm.shape)}")
         sm = sm.reshape(B, 1, -1)
         if sm.shape[-1] < S_max:
             # masks come sized to the live prefix ([B,1,1,seq_len+1]
@@ -307,6 +312,19 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
         qmax = 7.0
     else:
         qmax = 127.0
+    if group_size and group_size > 0:
+        # group-wise scales along K (ref: group_size rows share a scale)
+        K, N = wf.shape
+        if K % group_size:
+            raise ValueError(
+                f"group_size {group_size} must divide K={K}")
+        g = wf.reshape(K // group_size, group_size, N)
+        scale = jnp.maximum(
+            jnp.max(jnp.abs(g), axis=1) / qmax, 1e-8)      # [K/g, N]
+        q = jnp.clip(jnp.round(g / scale[:, None, :]),
+                     -qmax - 1, qmax).reshape(K, N)
+        return (Tensor(q.astype(jnp.int8), stop_gradient=True),
+                Tensor(scale, stop_gradient=True))
     scale = jnp.max(jnp.abs(wf), axis=0) / qmax            # [N]
     scale = jnp.maximum(scale, 1e-8)
     q = jnp.clip(jnp.round(wf / scale[None, :]), -qmax - 1, qmax)
@@ -321,7 +339,12 @@ def weight_dequantize(x, scale, algo="weight_only_int8",
     from ....tensor import Tensor
     q = x.data if isinstance(x, Tensor) else jnp.asarray(x)
     s = scale.data if isinstance(scale, Tensor) else jnp.asarray(scale)
-    out = q.astype(jnp.float32) * s[None, :]
+    if s.ndim == 2:
+        gs = q.shape[0] // s.shape[0]
+        out = (q.reshape(s.shape[0], gs, -1).astype(jnp.float32)
+               * s[:, None, :].astype(jnp.float32)).reshape(q.shape)
+    else:
+        out = q.astype(jnp.float32) * s[None, :]
     return Tensor(out.astype(core.convert_dtype(out_dtype)),
                   stop_gradient=True)
 
@@ -342,7 +365,13 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
         args.append(to_tensor_like(bias))
 
     def f(a, q, s, *b):
-        w = q.astype(a.dtype) * s.astype(a.dtype)[None, :]
+        if s.ndim == 2:
+            # group-wise scales [K/g, N]: expand each group over its rows
+            gs = q.shape[0] // s.shape[0]
+            w = (q.reshape(s.shape[0], gs, -1).astype(a.dtype)
+                 * s.astype(a.dtype)[:, None, :]).reshape(q.shape)
+        else:
+            w = q.astype(a.dtype) * s.astype(a.dtype)[None, :]
         out = a @ w
         if b:
             out = out + b[0]

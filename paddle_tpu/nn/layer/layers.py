@@ -190,6 +190,11 @@ class Layer:
 
     def named_buffers(self, prefix="", include_sublayers=True):
         seen = set()
+        if not include_sublayers:
+            for bname, b in self._buffers.items():
+                if b is not None:
+                    yield (f"{prefix}.{bname}" if prefix else bname), b
+            return
         for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
             for bname, b in layer._buffers.items():
                 if b is None or id(b) in seen:
@@ -248,6 +253,19 @@ class Layer:
     def state_dict(self, destination=None, include_sublayers=True,
                    structured_name_prefix="", use_hook=True):
         dest = destination if destination is not None else OrderedDict()
+        if not include_sublayers:
+            # own parameters/buffers only (ref state_dict semantics)
+            pre = structured_name_prefix
+            if pre and not pre.endswith("."):
+                pre += "."
+            for name, p in self._parameters.items():
+                if p is not None:
+                    dest[pre + name] = p
+            for name, b in self._buffers.items():
+                if b is not None and \
+                        name not in self._non_persistable_buffer_names:
+                    dest[pre + name] = b
+            return dest
         for name, p in self.named_parameters(prefix=structured_name_prefix.rstrip(".")):
             dest[name] = p
         for name, b in self.named_buffers(prefix=structured_name_prefix.rstrip(".")):

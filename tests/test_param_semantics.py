@@ -354,3 +354,28 @@ def test_mmha_src_mask_and_fmt_dropout():
     d2 = IF.fused_multi_transformer(**args, dropout_rate=0.5,
                                     training=True).numpy()
     assert not np.allclose(c, d2), "training dropout must be stochastic"
+
+
+def test_groupwise_weight_quant_and_state_dict_scope():
+    from paddle_tpu.incubate.nn import functional as IF
+    np.random.seed(0)
+    w = np.random.randn(16, 8).astype(np.float32)
+    q, s = IF.weight_quantize(paddle.to_tensor(w), group_size=4)
+    assert tuple(s.shape) == (4, 8)
+    deq = IF.weight_dequantize(q, s, out_dtype="float32").numpy()
+    # group-wise quantization error bounded by per-group resolution
+    assert np.abs(deq - w).max() < np.abs(w).max() / 64
+    x = paddle.to_tensor(np.random.randn(3, 16).astype(np.float32))
+    out = IF.weight_only_linear(x, q, weight_scale=s).numpy()
+    np.testing.assert_allclose(out, x.numpy() @ deq, rtol=1e-4,
+                               atol=1e-4)
+    with pytest.raises(ValueError, match="divide"):
+        IF.weight_quantize(paddle.to_tensor(w), group_size=5)
+
+    import paddle_tpu.nn as nn
+    lin = nn.Linear(2, 2)
+    own = lin.state_dict(include_sublayers=False)
+    assert set(own) == {"weight", "bias"}
+    seq = nn.Sequential(nn.Linear(2, 2))
+    assert len(seq.state_dict(include_sublayers=False)) == 0
+    assert len(list(seq.named_buffers(include_sublayers=False))) == 0
