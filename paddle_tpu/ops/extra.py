@@ -115,7 +115,9 @@ def check_numerics(x, op_type="", var_name="", message="", stack_height_limit=-1
     """ref: check_numerics kernel — raises on nan/inf (eager)."""
     t = to_tensor_like(x)
     from ..autograd.tape import _check_nan_inf
-    _check_nan_inf(var_name or op_type or "check_numerics", (t.data,))
+    label = " ".join(s for s in (op_type, var_name, message) if s) \
+        or "check_numerics"
+    _check_nan_inf(label, (t.data,))
     return t
 
 

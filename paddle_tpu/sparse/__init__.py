@@ -97,6 +97,10 @@ class SparseCsrTensor:
         return list(self._shape)
 
     def to_sparse_coo(self, sparse_dim=2):
+        if sparse_dim != 2:
+            raise ValueError(
+                "a 2-D CSR tensor converts only with sparse_dim=2, got "
+                f"{sparse_dim}")
         n_rows = self._shape[0]
         counts = self.crows_[1:] - self.crows_[:-1]
         rows = jnp.repeat(jnp.arange(n_rows), counts,
@@ -127,6 +131,10 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None,
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
                       place=None, stop_gradient=True):
+    if dtype is not None:
+        from ..framework import core
+        values = jnp.asarray(unwrap(values)).astype(
+            core.convert_dtype(dtype))
     return SparseCsrTensor(crows, cols, values, shape)
 
 
