@@ -97,10 +97,10 @@ class SparseCsrTensor:
         return list(self._shape)
 
     def to_sparse_coo(self, sparse_dim=2):
-        if sparse_dim != 2:
+        if sparse_dim != 2 or len(self._shape) != 2:
             raise ValueError(
-                "a 2-D CSR tensor converts only with sparse_dim=2, got "
-                f"{sparse_dim}")
+                "to_sparse_coo supports 2-D CSR with sparse_dim=2; got "
+                f"sparse_dim={sparse_dim}, shape={list(self._shape)}")
         n_rows = self._shape[0]
         counts = self.crows_[1:] - self.crows_[:-1]
         rows = jnp.repeat(jnp.arange(n_rows), counts,
@@ -113,14 +113,19 @@ class SparseCsrTensor:
         return self.to_sparse_coo().to_dense()
 
 
+def _cast_values(values, dtype):
+    v = jnp.asarray(unwrap(values))
+    if dtype is not None:
+        from ..framework import core
+        v = v.astype(core.convert_dtype(dtype))
+    return v
+
+
 def sparse_coo_tensor(indices, values, shape=None, dtype=None,
                       place=None, stop_gradient=True):
     """ref: python/paddle/sparse/creation.py sparse_coo_tensor."""
     idx = jnp.asarray(unwrap(indices), jnp.int32)
-    vals = jnp.asarray(unwrap(values))
-    if dtype is not None:
-        from ..framework import core
-        vals = vals.astype(core.convert_dtype(dtype))
+    vals = _cast_values(values, dtype)
     if shape is None:
         shape = tuple(int(m) + 1 for m in np.asarray(idx.max(axis=1)))
         shape = shape + vals.shape[1:]
@@ -131,11 +136,8 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None,
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
                       place=None, stop_gradient=True):
-    if dtype is not None:
-        from ..framework import core
-        values = jnp.asarray(unwrap(values)).astype(
-            core.convert_dtype(dtype))
-    return SparseCsrTensor(crows, cols, values, shape)
+    return SparseCsrTensor(crows, cols, _cast_values(values, dtype),
+                           shape)
 
 
 def is_sparse_coo(x):
