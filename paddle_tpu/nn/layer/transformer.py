@@ -5,6 +5,8 @@ attention on TPU (replacing the reference's fused_attention CUDA kernels).
 """
 from __future__ import annotations
 
+import collections
+
 import jax.numpy as jnp
 
 from ...ops import manipulation as M
@@ -29,9 +31,12 @@ def _convert_attn_mask(mask, dtype):
 
 
 class MultiHeadAttention(Layer):
-    """ref: nn/layer/transformer.py::MultiHeadAttention (q/k/v proj + sdpa)."""
+    """ref: nn/layer/transformer.py::MultiHeadAttention (q/k/v proj + sdpa).
+    Cache = growing self-attn KV; StaticCache = cross-attn KV computed
+    once from the encoder output (ref transformer.py:157,247)."""
 
-    Cache = tuple
+    Cache = collections.namedtuple("Cache", ["k", "v"])
+    StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
                  need_weights=False, weight_attr=None, bias_attr=None):
@@ -52,13 +57,18 @@ class MultiHeadAttention(Layer):
         key = query if key is None else key
         value = query if value is None else value
         q = self.q_proj(query)
-        k = self.k_proj(key)
-        v = self.v_proj(value)
         B = q.shape[0]
         q = M.reshape(q, [B, -1, self.num_heads, self.head_dim])
-        k = M.reshape(k, [B, -1, self.num_heads, self.head_dim])
-        v = M.reshape(v, [B, -1, self.num_heads, self.head_dim])
-        if cache is not None:
+        if isinstance(cache, self.StaticCache):
+            # cross-attention: the cached encoder K/V are the whole
+            # key/value — `key`/`value` args are ignored (ref :247)
+            k, v = cache.k, cache.v
+        else:
+            k = self.k_proj(key)
+            v = self.v_proj(value)
+            k = M.reshape(k, [B, -1, self.num_heads, self.head_dim])
+            v = M.reshape(v, [B, -1, self.num_heads, self.head_dim])
+        if cache is not None and not isinstance(cache, self.StaticCache):
             ck, cv = cache
             k = M.concat([ck, k], axis=1)
             v = M.concat([cv, v], axis=1)
@@ -67,16 +77,31 @@ class MultiHeadAttention(Layer):
             dropout_p=self.dropout if self.training else 0.0)
         out = M.reshape(out, [B, -1, self.embed_dim])
         out = self.out_proj(out)
+        if isinstance(cache, self.StaticCache):
+            return out, cache           # static KV never grows
         if cache is not None:
-            return out, (k, v)
+            return out, self.Cache(k, v)
         return out
 
     def gen_cache(self, key, value=None, type=None):
+        """ref transformer.py:342-353: StaticCache projects key/value
+        once (cross-attn); Cache with value=None is an empty growing
+        cache; Cache with value given wraps the ALREADY-projected pair
+        verbatim (resuming incremental decode)."""
         B = key.shape[0]
+        if type is MultiHeadAttention.StaticCache:
+            vsrc = value if value is not None else key
+            k = M.reshape(self.k_proj(key),
+                          [B, -1, self.num_heads, self.head_dim])
+            v = M.reshape(self.v_proj(vsrc),
+                          [B, -1, self.num_heads, self.head_dim])
+            return self.StaticCache(k, v)
+        if value is not None:
+            return self.Cache(key, value)
         from ...ops.creation import zeros
         empty_k = zeros([B, 0, self.num_heads, self.head_dim], dtype=key.dtype)
         empty_v = zeros([B, 0, self.num_heads, self.head_dim], dtype=key.dtype)
-        return (empty_k, empty_v)
+        return self.Cache(empty_k, empty_v)
 
 
 class TransformerEncoderLayer(Layer):

@@ -97,17 +97,44 @@ class SparseCsrTensor:
         return list(self._shape)
 
     def to_sparse_coo(self, sparse_dim=2):
-        if sparse_dim != 2 or len(self._shape) != 2:
-            raise ValueError(
-                "to_sparse_coo supports 2-D CSR with sparse_dim=2; got "
-                f"sparse_dim={sparse_dim}, shape={list(self._shape)}")
-        n_rows = self._shape[0]
-        counts = self.crows_[1:] - self.crows_[:-1]
-        rows = jnp.repeat(jnp.arange(n_rows), counts,
-                          total_repeat_length=self.cols_.shape[0])
-        idx = jnp.stack([rows, self.cols_], axis=1)
-        bcoo = jsparse.BCOO((self.values_, idx), shape=tuple(self._shape))
-        return SparseCooTensor(bcoo)
+        nd = len(self._shape)
+        if nd == 2:
+            if sparse_dim != 2:
+                raise ValueError(
+                    "a 2-D CSR converts with sparse_dim=2, got "
+                    f"{sparse_dim}")
+            n_rows = self._shape[0]
+            counts = self.crows_[1:] - self.crows_[:-1]
+            rows = jnp.repeat(jnp.arange(n_rows), counts,
+                              total_repeat_length=self.cols_.shape[0])
+            idx = jnp.stack([rows, self.cols_], axis=1)
+            bcoo = jsparse.BCOO((self.values_, idx),
+                                shape=tuple(self._shape))
+            return SparseCooTensor(bcoo)
+        if nd == 3:
+            # batched CSR (ref paddle layout): crows [B*(n+1)],
+            # cols/values concatenated per batch
+            B, n, m = self._shape
+            crows = np.asarray(self.crows_).reshape(B, n + 1)
+            cols = np.asarray(self.cols_)
+            vals = np.asarray(self.values_)
+            rows_all, bs_all = [], []
+            for b in range(B):
+                counts = np.diff(crows[b])
+                rows_all.append(np.repeat(np.arange(n), counts))
+                bs_all.append(np.full(int(counts.sum()), b))
+            rows = np.concatenate(rows_all) if rows_all else \
+                np.zeros((0,), np.int32)
+            bs = np.concatenate(bs_all) if bs_all else \
+                np.zeros((0,), np.int32)
+            idx = jnp.asarray(np.stack([bs, rows, cols], axis=1),
+                              jnp.int32)
+            bcoo = jsparse.BCOO((jnp.asarray(vals), idx),
+                                shape=(int(B), int(n), int(m)))
+            return SparseCooTensor(bcoo)
+        raise ValueError(
+            f"to_sparse_coo supports 2-D or batched 3-D CSR, shape="
+            f"{list(self._shape)}")
 
     def to_dense(self):
         return self.to_sparse_coo().to_dense()

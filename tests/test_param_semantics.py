@@ -379,3 +379,63 @@ def test_groupwise_weight_quant_and_state_dict_scope():
     seq = nn.Sequential(nn.Linear(2, 2))
     assert len(seq.state_dict(include_sublayers=False)) == 0
     assert len(list(seq.named_buffers(include_sublayers=False))) == 0
+
+
+def test_mha_cache_types():
+    """gen_cache(type=StaticCache) precomputes cross-attn K/V that the
+    forward uses verbatim (key/value args ignored, cache unchanged);
+    the default Cache grows per step (ref transformer.py:157,247)."""
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    mha = nn.MultiHeadAttention(16, 2)
+    enc = paddle.to_tensor(np.random.randn(2, 5, 16).astype(np.float32))
+    q1 = paddle.to_tensor(np.random.randn(2, 1, 16).astype(np.float32))
+    sc = mha.gen_cache(enc, enc, type=nn.MultiHeadAttention.StaticCache)
+    o_static, sc2 = mha(q1, None, None, cache=sc)
+    o_direct = mha(q1, enc, enc)
+    np.testing.assert_allclose(o_static.numpy(), o_direct.numpy(),
+                               rtol=1e-5, atol=1e-6)
+    assert sc2 is sc
+    c = mha.gen_cache(q1)
+    o1, c = mha(q1, cache=c)
+    q2 = paddle.to_tensor(np.random.randn(2, 1, 16).astype(np.float32))
+    o2, c = mha(q2, cache=c)
+    both = paddle.to_tensor(np.concatenate([q1.numpy(), q2.numpy()], 1))
+    o_joint = mha(q2, both, both)
+    np.testing.assert_allclose(o2.numpy(), o_joint.numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_batched_csr_to_coo_and_attention():
+    """3-D (batched) CSR converts to COO correctly, making the
+    documented sparse.attention CSR-mask path work end-to-end."""
+    import paddle_tpu.sparse as sp
+    B, H, S, D = 1, 2, 4, 8
+    rng = np.random.default_rng(0)
+    q = paddle.to_tensor(rng.standard_normal((B, H, S, D))
+                         .astype(np.float32))
+    pat = np.tril(np.ones((B * H, S, S), np.float32))
+    crows, cols, vals = [], [], []
+    for b in range(B * H):
+        crows.append(0)
+        cnt = 0
+        for r in range(S):
+            nz = np.nonzero(pat[b, r])[0]
+            cols.extend(nz.tolist())
+            vals.extend(pat[b, r, nz].tolist())
+            cnt += len(nz)
+            crows.append(cnt)
+    csr = sp.sparse_csr_tensor(np.array(crows), np.array(cols),
+                               np.array(vals, np.float32),
+                               [B * H, S, S])
+    dense = csr.to_sparse_coo().to_dense().numpy()
+    np.testing.assert_allclose(dense, pat)
+    out = np.asarray(sp.attention(q, q, q, csr).numpy())
+    qn = np.asarray(q.numpy())
+    s = np.einsum("bhsd,bhtd->bhst", qn, qn) / np.sqrt(D)
+    mask = np.tril(np.ones((S, S), bool))
+    s = np.where(mask, s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bhst,bhtd->bhsd", p, qn)
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
