@@ -439,3 +439,44 @@ def test_batched_csr_to_coo_and_attention():
     p /= p.sum(-1, keepdims=True)
     want = np.einsum("bhst,bhtd->bhsd", p, qn)
     np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+
+def test_bidirectional_lstm_sequence_length_torch_golden():
+    """Bidirectional LSTM with per-sequence lengths must match torch
+    pack_padded_sequence exactly: the backward direction runs over the
+    reversed VALID prefix only."""
+    import torch
+
+    import paddle_tpu.nn as nn
+    np.random.seed(0)
+    B, T, I, H = 3, 5, 4, 6
+    x = np.random.randn(B, T, I).astype(np.float32)
+    lens = np.array([5, 3, 2], np.int64)
+    paddle.seed(0)
+    lstm = nn.LSTM(I, H, direction="bidirect")
+    sd = lstm.state_dict()
+    tl = torch.nn.LSTM(I, H, batch_first=True, bidirectional=True)
+    keymap = {
+        "weight_ih_l0": "rnns.0.rnn_fw.cell.weight_ih",
+        "weight_hh_l0": "rnns.0.rnn_fw.cell.weight_hh",
+        "bias_ih_l0": "rnns.0.rnn_fw.cell.bias_ih",
+        "bias_hh_l0": "rnns.0.rnn_fw.cell.bias_hh",
+        "weight_ih_l0_reverse": "rnns.0.rnn_bw.cell.weight_ih",
+        "weight_hh_l0_reverse": "rnns.0.rnn_bw.cell.weight_hh",
+        "bias_ih_l0_reverse": "rnns.0.rnn_bw.cell.bias_ih",
+        "bias_hh_l0_reverse": "rnns.0.rnn_bw.cell.bias_hh",
+    }
+    with torch.no_grad():
+        for tk, ok in keymap.items():
+            getattr(tl, tk).copy_(torch.from_numpy(
+                np.asarray(sd[ok].numpy()).copy()))
+    out, _ = lstm(paddle.to_tensor(x),
+                  sequence_length=paddle.to_tensor(lens))
+    packed = torch.nn.utils.rnn.pack_padded_sequence(
+        torch.from_numpy(x.copy()), lens, batch_first=True,
+        enforce_sorted=False)
+    to, _ = tl(packed)
+    to_pad, _ = torch.nn.utils.rnn.pad_packed_sequence(
+        to, batch_first=True, total_length=T)
+    np.testing.assert_allclose(out.numpy(), to_pad.detach().numpy(),
+                               rtol=1e-4, atol=1e-4)
