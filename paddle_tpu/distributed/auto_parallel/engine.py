@@ -262,8 +262,14 @@ class Engine:
             plan.materialize(model, self.optimizer)
         gm = s.gradient_merge
         accum = int(gm.get("k_steps", 1)) if gm.get("enable") else 1
-        self._step = pjit.TrainStep(model, self.optimizer, step_fn,
-                                    shard=plan, accumulate_steps=accum)
+        if self.optimizer is not None and self.loss is not None:
+            self._step = pjit.TrainStep(model, self.optimizer, step_fn,
+                                        shard=plan,
+                                        accumulate_steps=accum)
+        else:
+            # inference-only engine: mesh/plan for compiled predict
+            self._step = None
+        self._prepared = True
         return self
 
     def _loader_for(self, data, batch_size, shuffle=False,
@@ -325,8 +331,11 @@ class Engine:
         callbacks, periodic evaluate, LR scheduler stepping, checkpoint
         saves; the train step itself is ONE compiled executable
         (gradient-merge scan included when strategy asks for it)."""
-        if self._step is None:
+        if not getattr(self, "_prepared", False):
             self.prepare(global_batch=batch_size)
+        if self._step is None:
+            raise ValueError(
+                "Engine.fit requires a loss and an optimizer")
         from ...hapi.callbacks import config_callbacks
         loader = self._loader_for(train_data, batch_size, shuffle=True,
                                   drop_last=True)
@@ -481,13 +490,13 @@ class Engine:
         return jax.tree_util.tree_map(
             leaf, tree, is_leaf=lambda v: isinstance(v, Tensor))
 
-    def _eval_step(self, params, buffers, batch_tensors):
-        """ONE compiled forward+loss per batch-shape, placed under the
-        plan's shardings (ref Engine.evaluate runs a compiled eval
-        program, not eager ops) — same numerics as training (autocast
-        traced in), same memory footprint (params stay sharded).
-        A short final batch that does not divide over the mesh's batch
-        axes runs the same `pure` un-sharded instead of crashing."""
+    def _compiled_forward(self, params, buffers, batch_tensors, tag,
+                          with_loss):
+        """Shared compile-and-cache machinery for evaluate/predict:
+        one executable per (tag, divisibility, batch-shape), params
+        placed under the plan's shardings, autocast traced in; a tail
+        batch that does not divide over the mesh's batch axes takes a
+        replicated executable."""
         import jax
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
@@ -503,10 +512,13 @@ class Engine:
             state.update(params)
             state.update(buffers)
             with model.use_state(state), core.no_grad_guard(), amp_ctx():
-                *xs, y = _tree_box(batch)
-                out = model(*xs)
-                loss = loss_fn(out, y)
-            return _tree_unbox(loss), _tree_unbox(out)
+                if with_loss:
+                    *xs, y = _tree_box(batch)
+                    out = model(*xs)
+                    loss = loss_fn(out, y)
+                    return _tree_unbox(loss), _tree_unbox(out)
+                out = model(*_tree_box(batch))
+                return _tree_unbox(out)
 
         batch = _tree_unbox(tuple(batch_tensors))
         leaves = jax.tree_util.tree_leaves(batch)
@@ -515,8 +527,8 @@ class Engine:
             bdiv = self._bdiv = self._batch_divisor()
         divisible = all(
             x.ndim == 0 or x.shape[0] % bdiv == 0 for x in leaves)
-        sig = (divisible,) + tuple((a.shape, str(a.dtype))
-                                   for a in leaves)
+        sig = (tag, divisible) + tuple((a.shape, str(a.dtype))
+                                       for a in leaves)
         if sig not in self._eval_cache:
             if divisible:
                 in_sh = (
@@ -532,8 +544,15 @@ class Engine:
                 # tail batch: replicated compile (old eager semantics,
                 # still one executable per shape)
                 self._eval_cache[sig] = jax.jit(pure)
-        loss, out = self._eval_cache[sig](params, buffers, batch)
-        return loss, _tree_box(out)
+        out = self._eval_cache[sig](params, buffers, batch)
+        from ...jit import _tree_box as _tb
+        return _tb(out)
+
+    def _eval_step(self, params, buffers, batch_tensors):
+        """Compiled forward+loss for evaluate (see _compiled_forward)."""
+        loss, out = self._compiled_forward(params, buffers,
+                                           batch_tensors, "eval", True)
+        return loss, out
 
     def evaluate(self, valid_data, batch_size=1, callbacks=None, **kw):
         """Loss + every configured paddle.metric over the eval set
@@ -541,7 +560,7 @@ class Engine:
         step — validation runs the same numerics (autocast) and memory
         plan (param shardings) as training."""
         loader = self._loader_for(valid_data, batch_size)
-        if self._step is None:
+        if not getattr(self, "_prepared", False):
             self.prepare(global_batch=batch_size)
         for m in self.metrics:
             m.reset()
@@ -613,16 +632,26 @@ class Engine:
         return res
 
     def predict(self, test_data, batch_size=1, **kw):
-        from ...framework import core
-        from ...io import DataLoader
-        loader = (test_data if isinstance(test_data, DataLoader)
-                  else DataLoader(test_data, batch_size=batch_size))
+        """Compiled sharded forward per batch shape (ref
+        Engine.predict:1210 runs a program, not eager ops). Every batch
+        element is an input (predict datasets carry no labels); on
+        multi-process runs each process feeds its shard and receives
+        ITS rows of the output back (localized)."""
+        if not getattr(self, "_prepared", False):
+            self.prepare(global_batch=batch_size)
+        from ...jit import capture_state
+        from ...tensor import Tensor as _T
+        loader = self._loader_for(test_data, batch_size)
+        params, buffers = capture_state(self.model)
+        world = _world()
         outs = []
-        with core.no_grad_guard():
-            for batch in loader:
-                xs = batch if not isinstance(batch, (list, tuple)) \
-                    else batch[:-1]
-                outs.append(self.model(*xs))
+        for batch in loader:
+            xs = list(batch) if isinstance(batch, (list, tuple)) \
+                else [batch]
+            out = self._compiled_forward(
+                params, buffers, self._globalize_batch(xs), "predict",
+                False)
+            outs.append(self._localize(out) if world > 1 else out)
         return outs
 
     def save(self, path, training=True):
