@@ -480,3 +480,36 @@ def test_bidirectional_lstm_sequence_length_torch_golden():
         to, batch_first=True, total_length=T)
     np.testing.assert_allclose(out.numpy(), to_pad.detach().numpy(),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_gru_sequence_length_torch_golden():
+    """GRU with per-sequence lengths matches torch packed semantics
+    (same gate order, masked scan)."""
+    import torch
+
+    import paddle_tpu.nn as nn
+    np.random.seed(0)
+    B, T, I, H = 3, 5, 4, 6
+    x = np.random.randn(B, T, I).astype(np.float32)
+    lens = np.array([5, 3, 2], np.int64)
+    paddle.seed(0)
+    gru = nn.GRU(I, H)
+    sd = gru.state_dict()
+    tg = torch.nn.GRU(I, H, batch_first=True)
+    with torch.no_grad():
+        for ours, theirs in (("weight_ih", tg.weight_ih_l0),
+                             ("weight_hh", tg.weight_hh_l0),
+                             ("bias_ih", tg.bias_ih_l0),
+                             ("bias_hh", tg.bias_hh_l0)):
+            theirs.copy_(torch.from_numpy(
+                np.asarray(sd[f"rnns.0.cell.{ours}"].numpy()).copy()))
+    out, _ = gru(paddle.to_tensor(x),
+                 sequence_length=paddle.to_tensor(lens))
+    packed = torch.nn.utils.rnn.pack_padded_sequence(
+        torch.from_numpy(x.copy()), lens, batch_first=True,
+        enforce_sorted=False)
+    to, _ = tg(packed)
+    to_pad, _ = torch.nn.utils.rnn.pad_packed_sequence(
+        to, batch_first=True, total_length=T)
+    np.testing.assert_allclose(out.numpy(), to_pad.detach().numpy(),
+                               rtol=1e-4, atol=1e-4)
