@@ -145,6 +145,11 @@ class TransformerEncoderLayer(Layer):
             src = self.norm2(src)
         return src if cache is None else (src, cache)
 
+    def gen_cache(self, src):
+        """ref transformer.py:623 — an empty growing Cache for
+        incremental encoding."""
+        return self.self_attn.gen_cache(src)
+
 
 class TransformerEncoder(Layer):
     def __init__(self, encoder_layer, num_layers, norm=None):
@@ -169,6 +174,10 @@ class TransformerEncoder(Layer):
         if self.norm is not None:
             output = self.norm(output)
         return output if cache is None else (output, new_caches)
+
+    def gen_cache(self, src):
+        """ref transformer.py:743 — per-layer incremental caches."""
+        return [layer.gen_cache(src) for layer in self.layers]
 
 
 class TransformerDecoderLayer(Layer):
@@ -203,16 +212,24 @@ class TransformerDecoderLayer(Layer):
             tgt = self.norm1(tgt)
         if cache is None:
             tgt = self.self_attn(tgt, tgt, tgt, tgt_mask)
-            incr = None
+            incr = static = None
         else:
-            tgt, incr = self.self_attn(tgt, tgt, tgt, tgt_mask, cache[0])
+            # per-layer cache is ALWAYS the (incremental, static) pair
+            # the reference requires (gen_cache produces it)
+            incr_in, static = cache
+            tgt, incr = self.self_attn(tgt, tgt, tgt, tgt_mask, incr_in)
         tgt = residual + self.dropout1(tgt)
         if not self.normalize_before:
             tgt = self.norm1(tgt)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm2(tgt)
-        tgt = self.cross_attn(tgt, memory, memory, memory_mask)
+        if static is not None:
+            # cross-attn K/V precomputed once from the encoder output
+            tgt, static = self.cross_attn(tgt, memory, memory,
+                                          memory_mask, static)
+        else:
+            tgt = self.cross_attn(tgt, memory, memory, memory_mask)
         tgt = residual + self.dropout2(tgt)
         if not self.normalize_before:
             tgt = self.norm2(tgt)
@@ -223,7 +240,17 @@ class TransformerDecoderLayer(Layer):
         tgt = residual + self.dropout3(tgt)
         if not self.normalize_before:
             tgt = self.norm3(tgt)
-        return tgt if cache is None else (tgt, (incr,))
+        if cache is None:
+            return tgt
+        return tgt, (incr, static)
+
+    def gen_cache(self, memory):
+        """ref transformer.py:989 — (incremental self-attn cache,
+        static cross-attn cache from the encoder output)."""
+        incremental = self.self_attn.gen_cache(memory)
+        static = self.cross_attn.gen_cache(
+            memory, memory, type=MultiHeadAttention.StaticCache)
+        return incremental, static
 
 
 class TransformerDecoder(Layer):
@@ -248,6 +275,15 @@ class TransformerDecoder(Layer):
         if self.norm is not None:
             output = self.norm(output)
         return output if cache is None else (output, new_caches)
+
+    def gen_cache(self, memory, do_zip=False):
+        """ref transformer.py:1148 — per-layer (incremental, static)
+        pairs; do_zip=True transposes to ([incrementals], [statics])
+        (the beam-search gather layout)."""
+        caches = [layer.gen_cache(memory) for layer in self.layers]
+        if do_zip:
+            return list(map(list, zip(*caches)))
+        return caches
 
 
 class Transformer(Layer):

@@ -513,3 +513,35 @@ def test_gru_sequence_length_torch_golden():
         to, batch_first=True, total_length=T)
     np.testing.assert_allclose(out.numpy(), to_pad.detach().numpy(),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_transformer_decoder_incremental_cache():
+    """TransformerDecoder gen_cache -> (incremental, static) per layer
+    (ref transformer.py:989,1148): step-by-step decode equals a joint
+    causal run, the static cross-attn K/V are computed once, and
+    do_zip transposes the layout."""
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    np.random.seed(0)
+    dec = nn.TransformerDecoder(nn.TransformerDecoderLayer(16, 2, 32), 2)
+    dec.eval()
+    memory = paddle.to_tensor(np.random.randn(2, 5, 16).astype(np.float32))
+    caches = dec.gen_cache(memory)
+    assert len(caches) == 2 and len(caches[0]) == 2
+    t1 = paddle.to_tensor(np.random.randn(2, 1, 16).astype(np.float32))
+    t2 = paddle.to_tensor(np.random.randn(2, 1, 16).astype(np.float32))
+    o1, caches = dec(t1, memory, cache=caches)
+    o2, caches = dec(t2, memory, cache=caches)
+    both = paddle.to_tensor(np.concatenate([t1.numpy(), t2.numpy()], 1))
+    mask = np.triu(np.full((2, 2), -1e9, np.float32), 1)[None, None]
+    o_joint = dec(both, memory, tgt_mask=paddle.to_tensor(mask))
+    np.testing.assert_allclose(o1.numpy()[:, 0], o_joint.numpy()[:, 0],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(o2.numpy()[:, 0], o_joint.numpy()[:, 1],
+                               rtol=1e-4, atol=1e-5)
+    z = dec.gen_cache(memory, do_zip=True)
+    assert len(z) == 2 and len(z[0]) == 2
+    # encoder-side caches exist too
+    enc = nn.TransformerEncoder(nn.TransformerEncoderLayer(16, 2, 32), 2)
+    ec = enc.gen_cache(memory)
+    assert len(ec) == 2
