@@ -461,8 +461,13 @@ class Engine:
 
     def _localize(self, tree):
         """This process's rows of a batch-sharded global output (the
-        inverse of _globalize_batch): concatenate the addressable
-        shards in row order. Fully-addressable leaves pass through."""
+        inverse of _globalize_batch), reassembled across EVERY sharded
+        dim — an output can be sharded on a non-batch axis under
+        mp_degree>1 meshes, where concatenating distinct column shards
+        along axis 0 would fabricate rows. Raises ValueError when the
+        locally addressable shards cannot reconstruct full rows (the
+        caller decides whether that is fatal). Fully-addressable
+        leaves pass through."""
         import jax
         import jax.numpy as jnp
 
@@ -474,17 +479,64 @@ class Engine:
                     return Tensor(jnp.asarray(
                         arr.addressable_shards[0].data))
                 # dedup replicas: an output replicated over some axis
-                # yields several addressable shards with the SAME index;
-                # concatenating them would duplicate rows
+                # yields several addressable shards with the SAME index
                 uniq = {}
                 for s in arr.addressable_shards:
                     uniq.setdefault(str(s.index), s)
-                shards = sorted(
-                    uniq.values(),
-                    key=lambda s: (s.index[0].start or 0) if s.index
-                    else 0)
-                return Tensor(jnp.concatenate(
-                    [jnp.asarray(s.data) for s in shards], axis=0))
+                shards = list(uniq.values())
+
+                def bounds(s, d):
+                    sl = s.index[d]
+                    lo = sl.start or 0
+                    hi = arr.shape[d] if sl.stop is None else sl.stop
+                    return lo, hi
+
+                lo = [min(bounds(s, d)[0] for s in shards)
+                      for d in range(arr.ndim)]
+                hi = [max(bounds(s, d)[1] for s in shards)
+                      for d in range(arr.ndim)]
+                # full rows required: every non-leading dim must span
+                # the global extent locally, else this process cannot
+                # hand back ITS rows of the output
+                for d in range(1, arr.ndim):
+                    if lo[d] != 0 or hi[d] != arr.shape[d]:
+                        raise ValueError(
+                            "cannot localize output: dim %d is sharded "
+                            "across processes (local cols [%d,%d) of "
+                            "%d)" % (d, lo[d], hi[d], arr.shape[d]))
+                # fast path (the common dp layout): every shard spans
+                # the full non-leading extent, so row blocks concat on
+                # device with no host round-trip. Sorted-by-start concat
+                # is the exact inverse of make_array_from_process_local_data
+                # even when this process's blocks are non-adjacent in the
+                # global array (local rows land in index order).
+                full_rows = all(
+                    all(bounds(s, d) == (0, arr.shape[d])
+                        for d in range(1, arr.ndim))
+                    for s in shards)
+                if full_rows:
+                    shards.sort(key=lambda s: bounds(s, 0)[0])
+                    return Tensor(jnp.concatenate(
+                        [jnp.asarray(s.data) for s in shards], axis=0))
+                # general case: paste each shard into the dense
+                # bounding box of the local indices (covers outputs
+                # sharded on several dims within one process)
+                shape = tuple(h - g for g, h in zip(lo, hi))
+                buf = np.zeros(shape, np.dtype(arr.dtype))
+                filled = np.zeros(shape, bool)
+                for s in shards:
+                    sl = tuple(
+                        slice(bounds(s, d)[0] - lo[d],
+                              bounds(s, d)[1] - lo[d])
+                        for d in range(arr.ndim))
+                    buf[sl] = np.asarray(s.data)
+                    filled[sl] = True
+                if not filled.all():
+                    raise ValueError(
+                        "cannot localize output: this process's shards "
+                        "do not tile a dense row block of the global "
+                        "array")
+                return Tensor(jnp.asarray(buf))
             return x
 
         return jax.tree_util.tree_map(
@@ -568,6 +620,7 @@ class Engine:
         for c in cbks:
             c.on_eval_begin()
         losses = []
+        loss_weights = []
         import jax
         metrics_on = bool(self.metrics)
         n_local = 0
@@ -579,37 +632,59 @@ class Engine:
             for c in cbks:
                 c.on_eval_batch_begin(i)
             y = batch[-1]
-            loss, out = self._eval_step(
-                params, buffers, self._globalize_batch(list(batch)))
+            lst = list(batch)
+            gb = self._globalize_batch(lst)
+            loss, out = self._eval_step(params, buffers, gb)
             losses.append(float(loss))
+            # per-batch sample count: the eval loader has no drop_last,
+            # so a short final batch must not be over-weighted in the
+            # dataset-level mean. A globalized batch's loss is a GLOBAL
+            # mean, so its weight is the global row count (keeps the
+            # weighted loss identical on every rank); the replicated
+            # tail path computes a per-process loss — weight locally.
+            yshape = tuple(y.shape) if hasattr(y, "shape") \
+                else np.shape(y)
+            ny = int(yshape[0]) if yshape else 1
+            # the globalized label's leading dim IS the global row
+            # count (ny * world would over-count on meshes whose batch
+            # dim is not sharded over every process axis)
+            loss_weights.append(
+                int(gb[-1].shape[0]) if gb is not lst else ny)
             if metrics_on:
                 # multi-process: metrics run on THIS process's rows of
                 # the global output (the local shard matches local y),
                 # cross-process reduction happens below
-                out_local = self._localize(out) if _world() > 1 else out
-                yl = y.numpy() if isinstance(y, Tensor) else np.asarray(y)
-                ny = int(np.shape(yl)[0]) if np.ndim(yl) else 1
-                first = jax.tree_util.tree_leaves(out_local)
-                lead = (int(np.shape(
-                    first[0].data if isinstance(first[0], Tensor)
-                    else first[0])[0]) if first
-                    and np.ndim(first[0].data if isinstance(
-                        first[0], Tensor) else first[0]) else ny)
-                if _world() > 1 and lead != ny:
-                    # a compiler-chosen output layout we could not map
-                    # back to local rows — skip rather than mis-score
-                    import warnings
+                import warnings
+                out_local = skip = None
+                try:
+                    out_local = (self._localize(out) if _world() > 1
+                                 else out)
+                except ValueError as e:
+                    skip = str(e)
+                if skip is None:
+                    first = jax.tree_util.tree_leaves(out_local)
+                    lead = (int(np.shape(
+                        first[0].data if isinstance(first[0], Tensor)
+                        else first[0])[0]) if first
+                        and np.ndim(first[0].data if isinstance(
+                            first[0], Tensor) else first[0]) else ny)
+                    if _world() > 1 and lead != ny:
+                        # a compiler-chosen output layout we could not
+                        # map back to local rows — skip, don't mis-score
+                        skip = ("output rows do not match the local "
+                                "label shard")
+                if skip is not None:
                     warnings.warn(
-                        "Engine.evaluate: output rows do not match the "
-                        "local label shard; metrics skipped for this "
-                        "batch", stacklevel=2)
+                        "Engine.evaluate: %s; metrics skipped for this "
+                        "batch" % skip, stacklevel=2)
                 else:
                     for m in self.metrics:
                         m.update(*_as_tuple(m.compute(out_local, y)))
                     n_local += ny
             for c in cbks:
                 c.on_eval_batch_end(i, {"loss": losses[-1]})
-        res = {"loss": float(np.mean(losses))}
+        res = {"loss": float(np.average(losses, weights=loss_weights))
+               if losses else float("nan")}
         if metrics_on:
             local_vals = {m.name(): m.accumulate() for m in self.metrics}
             if _world() > 1:
@@ -636,7 +711,10 @@ class Engine:
         Engine.predict:1210 runs a program, not eager ops). Every batch
         element is an input (predict datasets carry no labels); on
         multi-process runs each process feeds its shard and receives
-        ITS rows of the output back (localized)."""
+        ITS rows of the output back (localized). An output layout that
+        cannot be mapped back to local rows raises (fail-loud by
+        design — evaluate degrades to a warning instead because its
+        metrics are advisory)."""
         if not getattr(self, "_prepared", False):
             self.prepare(global_batch=batch_size)
         from ...jit import capture_state

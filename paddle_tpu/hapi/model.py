@@ -99,9 +99,12 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
-        if accumulate_grad_batches != 1 and \
-                accumulate_grad_batches != getattr(
+            accumulate_grad_batches=None, num_iters=None):
+        # None = keep whatever a prior prepare()/fit() configured; any
+        # explicit value (INCLUDING 1, which turns accumulation off)
+        # overrides and rebuilds the compiled step
+        if accumulate_grad_batches is not None and \
+                int(accumulate_grad_batches) != getattr(
                     self, "_accumulate_steps", 1):
             # the reference-API knob: k micro-batches merged inside the
             # compiled step (same machinery as prepare(accumulate_steps))

@@ -446,20 +446,12 @@ def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
 
 def _fmt_dropout(v, rate, training, mode):
     """Residual-branch dropout for fused_multi_transformer (ref: the
-    CUDA kernel applies dropout on both residual adds in training)."""
+    CUDA kernel applies dropout on both residual adds in training).
+    Delegates to _dropout_mode so the two dropout-mode semantics
+    cannot drift."""
     if not rate:
         return v
-    if not training:
-        # downscale_in_infer: train keeps the unscaled mask, inference
-        # scales by the keep probability
-        if mode == "downscale_in_infer":
-            return (v * (1.0 - rate)).astype(v.dtype)
-        return v
-    from ....framework import core as _core
-    keep = jax.random.bernoulli(_core.next_rng_key(), 1.0 - rate, v.shape)
-    if mode == "upscale_in_train":
-        return jnp.where(keep, v / (1.0 - rate), 0.0).astype(v.dtype)
-    return jnp.where(keep, v, 0.0).astype(v.dtype)
+    return _dropout_mode(v, rate, training, mode).astype(v.dtype)
 
 
 def fused_multi_transformer(

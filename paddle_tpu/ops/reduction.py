@@ -89,17 +89,15 @@ def median(x, axis=None, keepdim=False, mode="avg", name=None):
     if mode == "avg":
         return apply_op(lambda a: jnp.median(a, axis=ax, keepdims=keepdim),
                         to_tensor_like(x), name="median")
-    # mode="min": lower median + its index
+    # mode="min": lower median (+ its index for a single-int axis —
+    # upstream returns the (values, index) pair only in that case)
     x = to_tensor_like(x)
     a = x.data
     if ax is None:
-        flat = a.ravel()
-        k = (flat.shape[0] - 1) // 2
-        srt = jnp.sort(flat)
-        val = apply_op(lambda b: jnp.sort(b.ravel())[k] if not keepdim
-                       else jnp.sort(b.ravel())[k].reshape([1] * b.ndim), x)
-        idx = jnp.argsort(flat)[k]
-        return val, Tensor(idx.astype(jnp.int64))
+        k = (a.size - 1) // 2
+        return apply_op(lambda b: jnp.sort(b.ravel())[k] if not keepdim
+                        else jnp.sort(b.ravel())[k].reshape([1] * b.ndim),
+                        x, name="median")
     val = apply_op(
         lambda b: jnp.take_along_axis(
             jnp.sort(b, axis=ax),
@@ -117,11 +115,13 @@ def median(x, axis=None, keepdim=False, mode="avg", name=None):
     return val, Tensor(idx.astype(jnp.int64))
 
 
-def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None,
+              _values_only=False):
     ax = _axes(axis)
     if mode == "min" and isinstance(ax, (tuple, list)):
-        # multi-axis: collapse the reduced axes to one and recurse (the
-        # index then refers to the collapsed slice)
+        # multi-axis: collapse the reduced axes to one and recurse.
+        # Upstream returns (values, index) only for a single-int axis,
+        # so the recursion skips the index (argsort) work entirely.
         x = to_tensor_like(x)
         axes = sorted(a % x.ndim for a in ax)
         perm = [i for i in range(x.ndim) if i not in axes] + axes
@@ -129,12 +129,12 @@ def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
         xt = transpose(x, perm)
         lead = [xt.shape[i] for i in range(x.ndim - len(axes))]
         xt = reshape(xt, lead + [-1])
-        v, i = nanmedian(xt, axis=-1, keepdim=False, mode="min")
+        v = nanmedian(xt, axis=-1, keepdim=False, mode="min",
+                      _values_only=True)
         if keepdim:
             shp = [1 if d in axes else x.shape[d] for d in range(x.ndim)]
             v = reshape(v, shp)
-            i = reshape(i, shp)
-        return v, i
+        return v
     if mode == "min":
         # lower middle of the NON-NaN values + its index (median's
         # mode="min" convention; NaNs sort last so a per-slice valid
@@ -155,19 +155,17 @@ def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
             return v if keepdim else jnp.squeeze(v, ax)
 
         val = apply_op(val_fn, x, name="nanmedian")
+        # upstream contract: the (values, index) pair only for a
+        # single-int axis; axis=None returns the values alone
+        if ax is None or _values_only:
+            return val
         a = x.data
-        if ax is None:
-            f = a.ravel()
-            valid = jnp.sum(~jnp.isnan(f)).astype(jnp.int32)
-            k = jnp.maximum((valid - 1) // 2, 0)
-            idx = jnp.argsort(f)[k]
-        else:
-            valid = jnp.sum(~jnp.isnan(a), axis=ax,
-                            keepdims=True).astype(jnp.int32)
-            k = jnp.maximum((valid - 1) // 2, 0)
-            idx = jnp.take_along_axis(jnp.argsort(a, axis=ax), k, axis=ax)
-            if not keepdim:
-                idx = jnp.squeeze(idx, ax)
+        valid = jnp.sum(~jnp.isnan(a), axis=ax,
+                        keepdims=True).astype(jnp.int32)
+        k = jnp.maximum((valid - 1) // 2, 0)
+        idx = jnp.take_along_axis(jnp.argsort(a, axis=ax), k, axis=ax)
+        if not keepdim:
+            idx = jnp.squeeze(idx, ax)
         return val, Tensor(idx.astype(jnp.int64))
     return apply_op(lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim),
                     to_tensor_like(x), name="nanmedian")

@@ -70,9 +70,12 @@ def test_kldiv_log_target():
 def test_nanmedian_mode_min():
     x = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
     avg = float(paddle.nanmedian(paddle.to_tensor(x)).numpy())
-    lo, idx = paddle.nanmedian(paddle.to_tensor(x), mode="min")
+    # axis=None: mode='min' returns the values alone (upstream returns
+    # the (values, index) pair only for a single-int axis)
+    lo = paddle.nanmedian(paddle.to_tensor(x), mode="min")
     assert avg == 2.5 and float(lo.numpy()) == 2.0
-    assert int(idx.numpy()) == 1
+    lo1, idx = paddle.nanmedian(paddle.to_tensor(x), axis=0, mode="min")
+    assert float(lo1.numpy()) == 2.0 and int(idx.numpy()) == 1
     # NaNs are skipped and the index refers to the original array
     v2, i2 = paddle.nanmedian(paddle.to_tensor(
         np.array([[1.0, np.nan, 3.0, 2.0]], np.float32)), axis=1,

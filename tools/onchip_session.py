@@ -27,7 +27,7 @@ import traceback
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
-OUT = os.path.join(REPO, "benchmarks", "ONCHIP_R4.jsonl")
+OUT = os.path.join(REPO, "benchmarks", "ONCHIP_R5.jsonl")
 
 
 class SectionTimeout(Exception):
