@@ -18,9 +18,11 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
-from .spmd_rules import (DistAttr, concat_rule, elementwise_rule,
+from .spmd_rules import (DistAttr, argsort_rule, concat_rule,
+                         cumsum_rule, elementwise_rule, pad_rule,
                          reduction_rule, reshape_rule, reshard_cost_bytes,
-                         slice_rule, softmax_rule, transpose_rule)
+                         roll_rule, slice_rule, softmax_rule, topk_rule,
+                         transpose_rule)
 
 __all__ = ["Propagator", "PropagationReport", "propagate_jaxpr",
            "graph_reshard_bytes"]
@@ -221,12 +223,32 @@ class Propagator:
         elif name == "softmax":  # jax lowers via exp/reduce; kept for compat
             _, out = softmax_rule(ins[0])
         elif name == "pad":
-            cfg = eqn.params["padding_config"]
-            dm = [a if lo == 0 and hi == 0 and inner == 0 else None
-                  for a, (lo, hi, inner) in zip(ins[0].dims_mapping, cfg)]
-            rx = DistAttr(dm, set(ins[0].partial))
+            rx, out = pad_rule(ins[0], eqn.params["padding_config"])
             self._reshard(name, ins[0], rx, avals[0])
-            out = DistAttr(list(dm), set(ins[0].partial))
+        elif name in ("cumsum", "cumprod", "cummax", "cummin",
+                      "cumlogsumexp"):
+            rx, out = cumsum_rule(ins[0], eqn.params["axis"])
+            self._reshard(name, ins[0], rx, avals[0])
+        elif name == "rev":
+            # reversal relocates data across shard boundaries on every
+            # reversed dim — same constraint as roll
+            rx, out = roll_rule(ins[0], eqn.params["dimensions"])
+            self._reshard(name, ins[0], rx, avals[0])
+        elif name == "sort":
+            # one resolved attr serves every operand (values + any
+            # carried key/index arrays share the sort layout)
+            rx, (o, _) = argsort_rule(ins[0], eqn.params["dimension"])
+            for a, av in zip(ins, avals):
+                self._reshard(name, a, rx, av)
+            for v in eqn.outvars:
+                env[v] = DistAttr(list(o.dims_mapping), set(o.partial))
+            return
+        elif name == "top_k":
+            rx, (ov, oi) = topk_rule(ins[0], -1)
+            self._reshard(name, ins[0], rx, avals[0])
+            for v, a in zip(eqn.outvars, (ov, oi)):
+                env[v] = a
+            return
         elif name == "gather":
             out = self._gather(eqn, ins, avals, out_avals)
         elif name == "iota":
@@ -370,6 +392,18 @@ def propagate_jaxpr(fn, example_args, in_attrs: Sequence[DistAttr],
     prop = Propagator(mesh_shape, elem_bytes)
     flat_attrs = list(in_attrs)
     outs = prop.run(closed.jaxpr, flat_attrs)
+    if prop.unknown:
+        # one summary per propagated model (ref completion.py logs
+        # unannotated ops): each unknown prim fell back to replicated,
+        # so the plan's reshard bill may under-price those ops
+        import warnings
+        warnings.warn(
+            "propagate_jaxpr: %d primitive kind(s) had no SPMD rule "
+            "and fell back to replicated outputs: %s" % (
+                len(prop.unknown),
+                ", ".join(f"{k}x{v}"
+                          for k, v in sorted(prop.unknown.items()))),
+            stacklevel=2)
     return PropagationReport(out_attrs=outs,
                              env_size=len(closed.jaxpr.eqns),
                              reshards=prop.reshards,

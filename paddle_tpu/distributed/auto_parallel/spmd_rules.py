@@ -31,6 +31,11 @@ __all__ = ["DistAttr", "matmul_rule", "embedding_rule", "layer_norm_rule",
            "pow_rule", "full_like_rule", "numel_rule", "rms_norm_rule",
            "replicated_rule", "default_data_parallel_rule",
            "optimizer_rule", "fused_linear_param_grad_add_rule",
+           "topk_rule", "cumsum_rule", "argsort_rule", "expand_as_rule",
+           "set_value_rule", "gather_nd_rule", "index_select_rule",
+           "nonzero_rule", "pad_rule", "roll_rule", "einsum_rule",
+           "one_hot_rule", "unbind_rule", "take_along_axis_rule",
+           "fused_dropout_add_rule",
            "register_rule", "reshard_cost_bytes"]
 
 
@@ -628,6 +633,322 @@ def fused_linear_param_grad_add_rule(
     return resolved, out
 
 
+# ---------------- round-5 tail: index/scan/sort/einsum families ----------
+
+def topk_rule(x: DistAttr, axis: int = -1
+              ) -> Tuple[DistAttr, Tuple[DistAttr, DistAttr]]:
+    """ref: spmd_rules/topk.cc TopkInferSpmd — selection runs along
+    `axis`, so that dim must be replicated (a shard cannot know the
+    global top-k); every other dim carries into values AND indices."""
+    ax = axis % x.ndim
+    dm = list(x.dims_mapping)
+    dm[ax] = None
+    rx = DistAttr(dm, set(x.partial))
+    return rx, (DistAttr(list(dm), set(x.partial)),
+                DistAttr(list(dm), set(x.partial)))
+
+
+def cumsum_rule(x: DistAttr, axis: Optional[int] = None
+                ) -> Tuple[DistAttr, DistAttr]:
+    """ref: spmd_rules/cumsum.cc CumSumInferSpmd — the prefix scan
+    chains every element along `axis`: that dim must be replicated;
+    axis=None (flattened cumsum) replicates everything."""
+    if axis is None:
+        rx = DistAttr.replicated(x.ndim)
+        return rx, DistAttr.replicated(x.ndim)
+    ax = axis % x.ndim
+    dm = list(x.dims_mapping)
+    dm[ax] = None
+    rx = DistAttr(dm, set(x.partial))
+    return rx, DistAttr(list(dm), set(x.partial))
+
+
+def argsort_rule(x: DistAttr, axis: int = -1
+                 ) -> Tuple[DistAttr, Tuple[DistAttr, DistAttr]]:
+    """ref: spmd_rules/argsort.cc — comparisons span the whole sort
+    axis, so it must be replicated; other dims carry into both the
+    sorted values and the index tensor."""
+    ax = axis % x.ndim
+    dm = list(x.dims_mapping)
+    dm[ax] = None
+    rx = DistAttr(dm, set(x.partial))
+    return rx, (DistAttr(list(dm), set(x.partial)),
+                DistAttr(list(dm), set(x.partial)))
+
+
+def expand_as_rule(x: DistAttr, y: DistAttr,
+                   x_shape: Optional[Sequence[int]] = None,
+                   y_shape: Optional[Sequence[int]] = None
+                   ) -> Tuple[Tuple[DistAttr, DistAttr], DistAttr]:
+    """ref: spmd_rules/expand_as.cc ExpandAsInferSpmd — right-aligned
+    broadcast of x to y's shape. Dims present in both keep x's sharding
+    (merged with y's); broadcast dims (missing or size-1 in x) take
+    the TARGET's mapping — the copies are identical so target sharding
+    is free."""
+    pad = y.ndim - x.ndim
+    out: List[Optional[str]] = []
+    rx = list(x.dims_mapping)
+    used: Set[str] = set()
+
+    def claim(a):
+        # one mesh axis never shards two output dims (matmul invariant)
+        if a is None or a in used:
+            return None
+        used.add(a)
+        return a
+
+    for j in range(y.ndim):
+        i = j - pad
+        if i < 0:
+            out.append(claim(y.dims_mapping[j]))
+            continue
+        broadcast = (x_shape is not None and y_shape is not None
+                     and x_shape[i] == 1 and y_shape[j] != 1)
+        if broadcast:
+            out.append(claim(y.dims_mapping[j]))
+            rx[i] = None
+        else:
+            out.append(claim(_merge(x.dims_mapping[i],
+                                    y.dims_mapping[j])))
+            rx[i] = out[-1]
+    return (DistAttr(rx, set(x.partial)),
+            DistAttr(list(y.dims_mapping), set(y.partial))), \
+        DistAttr(out, set(x.partial))
+
+
+def set_value_rule(x: DistAttr, value: DistAttr,
+                   axes: Sequence[int]
+                   ) -> Tuple[Tuple[DistAttr, DistAttr], DistAttr]:
+    """ref: spmd_rules/set_value.cc SetValueInferSpmd — a slice
+    assignment writes through `axes`: those dims must be replicated on
+    the destination (writes would straddle shard boundaries); untouched
+    dims merge between x and the value (right-aligned)."""
+    cut = {a % x.ndim for a in axes}
+    dm = [None if i in cut else a for i, a in enumerate(x.dims_mapping)]
+    used = {a for a in dm if a is not None}
+    pad = x.ndim - value.ndim
+    rv: List[Optional[str]] = []
+    for i in range(value.ndim):
+        j = i + pad
+        a = (None if j in cut
+             else _merge(dm[j], value.dims_mapping[i]))
+        if a is not None and a != dm[j] and a in used:
+            a = dm[j]           # an axis cannot shard two dims
+        rv.append(a)
+        if j not in cut:
+            dm[j] = a
+            if a is not None:
+                used.add(a)
+    rx = DistAttr(dm, set(x.partial))
+    return (rx, DistAttr(rv, set(value.partial))), \
+        DistAttr(list(dm), set(x.partial))
+
+
+def gather_nd_rule(table: DistAttr, index: DistAttr,
+                   index_depth: Optional[int] = None
+                   ) -> Tuple[Tuple[DistAttr, DistAttr], DistAttr]:
+    """ref: spmd_rules/gather_nd.cc GatherNdInferSpmd — index's last
+    dim addresses the first `index_depth` table dims: those must be
+    replicated (a shard cannot serve arbitrary coordinates); the output
+    is index.shape[:-1] + table.shape[depth:], inheriting index's batch
+    dims and the table's surviving trailing dims."""
+    depth = index_depth if index_depth is not None else 1
+    used: Set[str] = set()
+
+    def claim(a):
+        # one mesh axis never shards two output dims; index batch dims
+        # claim first, table tail dims take what's left
+        if a is None or a in used:
+            return None
+        used.add(a)
+        return a
+
+    ib = [claim(a) for a in index.dims_mapping[:-1]]
+    tt = [claim(a) for a in table.dims_mapping[depth:]]
+    rt = DistAttr([None] * depth + tt, set(table.partial))
+    ri = DistAttr(ib + [None], set(index.partial))
+    out = DistAttr(ib + tt,
+                   set(table.partial) | set(index.partial))
+    return (rt, ri), out
+
+
+def index_select_rule(x: DistAttr, index: DistAttr, axis: int = 0
+                      ) -> Tuple[Tuple[DistAttr, DistAttr], DistAttr]:
+    """ref: spmd_rules/index_select (gather.cc GatherInferSpmd with a
+    1-D index) — the gathered axis must be replicated; the index's own
+    dim replaces it in the output; all other x dims carry."""
+    ax = axis % x.ndim
+    dm = list(x.dims_mapping)
+    dm[ax] = None
+    rx = DistAttr(dm, set(x.partial))
+    out = list(dm)
+    idx_axis = index.dims_mapping[0] if index.ndim else None
+    if idx_axis in {a for a in dm if a is not None}:
+        idx_axis = None        # x's surviving dims claimed it first
+    out[ax] = idx_axis
+    ri = DistAttr([idx_axis] if index.ndim else [],
+                  set(index.partial))
+    return (rx, ri), DistAttr(out, set(x.partial) | set(index.partial))
+
+
+def nonzero_rule(x: DistAttr) -> Tuple[DistAttr, DistAttr]:
+    """ref: spmd_rules/nonzero.cc — the output row count is data
+    dependent; both the scan and its [n, ndim] coordinate output are
+    replicated."""
+    return DistAttr.replicated(x.ndim), DistAttr.replicated(2)
+
+
+def pad_rule(x: DistAttr, paddings: Sequence[Sequence[int]]
+             ) -> Tuple[DistAttr, DistAttr]:
+    """ref: spmd_rules/pad.cc PadInferSpmd — a padded dim changes size
+    non-uniformly across shards, so it must be replicated; unpadded
+    dims carry. `paddings` is per-dim (lo, hi[, interior])."""
+    dm = [a if not any(p) else None
+          for a, p in zip(x.dims_mapping, paddings)]
+    rx = DistAttr(dm, set(x.partial))
+    return rx, DistAttr(list(dm), set(x.partial))
+
+
+def roll_rule(x: DistAttr, axes: Optional[Sequence[int]] = None
+              ) -> Tuple[DistAttr, DistAttr]:
+    """ref: spmd_rules/roll (rotation crosses shard boundaries on every
+    rolled axis → replicated there; axis=None rolls the flattened
+    tensor → fully replicated). Other dims carry."""
+    if axes is None:
+        rx = DistAttr.replicated(x.ndim)
+        return rx, DistAttr.replicated(x.ndim)
+    cut = {a % x.ndim for a in axes}
+    dm = [None if i in cut else a for i, a in enumerate(x.dims_mapping)]
+    rx = DistAttr(dm, set(x.partial))
+    return rx, DistAttr(list(dm), set(x.partial))
+
+
+def einsum_rule(equation: str, *xs: DistAttr
+                ) -> Tuple[Tuple[DistAttr, ...], DistAttr]:
+    """ref: spmd_rules/einsum — per-letter axis merge, exactly the
+    matmul rule generalized: each subscript letter gets ONE mesh axis
+    (merged across operands, first-operand tiebreak); letters absent
+    from the output are contractions whose mesh axis becomes PARTIAL;
+    one mesh axis never shards two different letters ('claim' rule,
+    same as _dot_general)."""
+    lhs, _, out_spec = equation.replace(" ", "").partition("->")
+    in_specs = lhs.split(",")
+    if len(in_specs) != len(xs):
+        raise ValueError(
+            f"einsum equation {equation!r} has {len(in_specs)} operands, "
+            f"got {len(xs)} attrs")
+    batch = ""
+    if any("..." in s for s in in_specs) or "..." in out_spec:
+        # ellipsis = right-aligned broadcast batch dims; expand to
+        # explicit letters so the claim logic below sees every dim
+        batch_rank = max((x.ndim - len(s.replace("...", "")))
+                         for s, x in zip(in_specs, xs) if "..." in s)
+        pool = [c for c in
+                "ABCDEFGHIJKLMNOPQRSTUVWXYZ" if c not in equation]
+        batch = "".join(pool[:batch_rank])
+        in_specs = [
+            s.replace("...", batch[batch_rank
+                                   - (x.ndim
+                                      - len(s.replace("...", ""))):])
+            if "..." in s else s for s, x in zip(in_specs, xs)]
+        if "..." in out_spec:
+            out_spec = out_spec.replace("...", batch)
+    if not out_spec and "->" not in equation:
+        # implicit output: ellipsis batch dims first (numpy rule),
+        # then letters appearing exactly once, alphabetical
+        from collections import Counter
+        cnt = Counter("".join(in_specs))
+        out_spec = batch + "".join(
+            sorted(c for c, n in cnt.items()
+                   if n == 1 and c not in batch))
+    letter_axis: dict = {}
+    for spec, x in zip(in_specs, xs):
+        if len(spec) != x.ndim:
+            raise ValueError(
+                f"einsum spec {spec!r} rank != attr rank {x.ndim}")
+        for c, a in zip(spec, x.dims_mapping):
+            letter_axis[c] = _merge(letter_axis.get(c), a)
+    used: Set[str] = set()
+
+    def claim(c):
+        a = letter_axis.get(c)
+        if a is None or a in used:
+            letter_axis[c] = None
+            return None
+        used.add(a)
+        return a
+
+    # output letters claim first (keeps results sharded over free dims),
+    # then contracted letters take what's left and mark partial
+    for c in out_spec:
+        claim(c)
+    partial: Set[str] = set().union(*(x.partial for x in xs)) \
+        if xs else set()
+    for c in set("".join(in_specs)) - set(out_spec):
+        a = claim(c)
+        if a is not None:
+            partial.add(a)
+    resolved = tuple(
+        DistAttr([letter_axis[c] for c in spec], set(x.partial))
+        for spec, x in zip(in_specs, xs))
+    out = DistAttr([letter_axis[c] for c in out_spec], partial)
+    return resolved, out
+
+
+def one_hot_rule(x: DistAttr) -> Tuple[DistAttr, DistAttr]:
+    """ref: spmd_rules/one_hot.cc — index dims carry; the new trailing
+    class dim is replicated (each shard expands its own indices)."""
+    rx = DistAttr(list(x.dims_mapping), set(x.partial))
+    return rx, DistAttr(list(x.dims_mapping) + [None], set(x.partial))
+
+
+def unbind_rule(x: DistAttr, axis: int = 0, num: int = 1
+                ) -> Tuple[DistAttr, List[DistAttr]]:
+    """ref: spmd_rules/unbind.cc — the unbound axis must be replicated
+    (each output is one full slice of it); every one of the `num`
+    outputs drops that dim and keeps the rest (same contract as
+    split_rule: one attr per outvar)."""
+    ax = axis % x.ndim
+    dm = list(x.dims_mapping)
+    dm[ax] = None
+    rx = DistAttr(dm, set(x.partial))
+    out_dm = [a for i, a in enumerate(dm) if i != ax]
+    return rx, [DistAttr(list(out_dm), set(x.partial))
+                for _ in range(num)]
+
+
+def take_along_axis_rule(x: DistAttr, index: DistAttr, axis: int = 0
+                         ) -> Tuple[Tuple[DistAttr, DistAttr], DistAttr]:
+    """ref: spmd_rules/take_along_axis (gather family) — positions
+    along `axis` are data dependent, so that dim is replicated on both
+    operands; the other dims merge (x and index share rank) and carry
+    into the output, whose shape follows the index."""
+    ax = axis % x.ndim
+    used: Set[str] = set()
+    merged: List[Optional[str]] = []
+    for i in range(x.ndim):
+        a = (None if i == ax
+             else _merge(x.dims_mapping[i], index.dims_mapping[i]))
+        if a in used:           # an axis cannot shard two dims
+            a = None
+        elif a is not None:
+            used.add(a)
+        merged.append(a)
+    rx = DistAttr(list(merged), set(x.partial))
+    ri = DistAttr(list(merged), set(index.partial))
+    return (rx, ri), DistAttr(list(merged),
+                              set(x.partial) | set(index.partial))
+
+
+def fused_dropout_add_rule(x: DistAttr, y: DistAttr
+                           ) -> Tuple[Tuple[DistAttr, DistAttr],
+                                      Tuple[DistAttr, DistAttr]]:
+    """ref: spmd_rules/fused_dropout_add.cc — elementwise over the pair;
+    the seed-offset/mask output shares the data layout."""
+    (rx, ry), out = elementwise_rule(x, y)
+    return (rx, ry), (out, DistAttr(list(out.dims_mapping)))
+
+
 def reshard_cost_bytes(src: DistAttr, dst: DistAttr, shape: Sequence[int],
                        mesh_shape: dict, elem_bytes: int = 2) -> float:
     """Bytes each chip moves to convert src->dst sharding of a tensor
@@ -699,6 +1020,25 @@ _FORWARD_RULES = {
     "default_data_parallel": default_data_parallel_rule,
     "optimizer": optimizer_rule,
     "fused_linear_param_grad_add": fused_linear_param_grad_add_rule,
+    # round-5 tail: index/scan/sort/einsum families
+    # (phi/infermeta/spmd_rules/: topk.cc, cumsum.cc, argsort.cc,
+    #  expand_as.cc, set_value.cc, gather_nd.cc, gather.cc,
+    #  nonzero.cc, pad.cc, einsum semantics)
+    "topk": topk_rule,
+    "cumsum": cumsum_rule,
+    "argsort": argsort_rule,
+    "expand_as": expand_as_rule,
+    "set_value": set_value_rule,
+    "gather_nd": gather_nd_rule,
+    "index_select": index_select_rule,
+    "nonzero": nonzero_rule,
+    "pad": pad_rule,
+    "roll": roll_rule,
+    "einsum": einsum_rule,
+    "one_hot": one_hot_rule,
+    "unbind": unbind_rule,
+    "take_along_axis": take_along_axis_rule,
+    "fused_dropout_add": fused_dropout_add_rule,
 }
 
 

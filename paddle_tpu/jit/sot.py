@@ -115,9 +115,25 @@ def _instrument():
 
 
 def _guard_equal(a, b) -> bool:
-    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
-        return bool(np.array_equal(np.asarray(a), np.asarray(b)))
-    return a == b
+    """Pulled-value guard comparison. Floating values compare with a
+    tight tolerance, NOT bitwise: the captured value came from eager
+    op-by-op execution while replay re-derives it from the fused
+    compiled fragment, and XLA fusion legitimately changes rounding
+    (observed 3e-7 relative drift on a 24-layer stack — bitwise
+    equality made every replay respecialize). The tolerance is kept
+    tight (~30x the observed drift): wider would replay a stale
+    specialization for genuinely different values near a branch
+    threshold. Integer/bool values compare exactly (they often feed
+    shapes and trip counts)."""
+    a = np.asarray(a)
+    b = np.asarray(b)
+    if a.shape != b.shape:
+        return False
+    if np.issubdtype(a.dtype, np.floating) \
+            or np.issubdtype(a.dtype, np.complexfloating):
+        return bool(np.allclose(a, b, rtol=1e-5, atol=1e-8,
+                                equal_nan=True))
+    return bool(np.array_equal(a, b))
 
 
 class _Fragment:
@@ -241,7 +257,7 @@ class _Spec:
         if b.kind == "__bool__":
             return bool(actual) == b.value
         if b.kind == "__float__":
-            return float(actual) == b.value
+            return _guard_equal(float(actual), b.value)
         return int(actual) == b.value
 
     def run(self, arg_leaves, params):
@@ -274,6 +290,7 @@ class SubgraphProgram:
         self.layer = layer
         self._specs: Dict[Tuple, List[_Spec]] = {}
         self.last_path = None          # 'fragments' | 'capture'
+        self._param_cache = None       # (struct_version, state items)
 
     # -- signatures ---------------------------------------------------------
     @staticmethod
@@ -295,12 +312,31 @@ class SubgraphProgram:
                 sig.append(("T", tuple(leaf.shape), str(leaf.data.dtype)))
             elif isinstance(leaf, (jax.Array, np.ndarray)):
                 # raw arrays are captured as CONSTS (frozen values), so
-                # the signature must fingerprint the value — cheap hash,
-                # never repr (which truncates AND prints element-wise)
+                # the signature must fingerprint the value — but a full
+                # sha1 made every call O(array bytes) (ref SOT guards
+                # are O(guards)). Hash a BOUNDED strided sample: exact
+                # for arrays <= 4096 elems, head/tail/stride beyond —
+                # real data that differs virtually always differs there
+                # (documented tradeoff: a value changed ONLY between
+                # sample points replays the stale const). Only the
+                # sample is materialized to host — never the full leaf
+                # (a jax.Array const would otherwise pay a full
+                # device->host copy per call).
                 import hashlib
-                arr = np.asarray(leaf)
-                sig.append(("A", arr.shape, str(arr.dtype),
-                            hashlib.sha1(arr.tobytes()).hexdigest()))
+                size = int(np.prod(leaf.shape)) if leaf.shape else 1
+                flat = leaf.reshape(-1)
+                if size > 4096:
+                    step = max(size // 2048, 1)
+                    parts = [np.asarray(flat[:1024]),
+                             np.asarray(flat[::step]),
+                             np.asarray(flat[-1024:])]
+                    payload = b"".join(
+                        np.ascontiguousarray(p).tobytes() for p in parts)
+                else:
+                    payload = np.ascontiguousarray(
+                        np.asarray(flat)).tobytes()
+                sig.append(("A", tuple(leaf.shape), str(leaf.dtype),
+                            hashlib.sha1(payload).hexdigest()))
             else:
                 sig.append(("P", repr(leaf)))
         return tuple(sig)
@@ -314,9 +350,21 @@ class SubgraphProgram:
         return out
 
     def _params(self):
+        """Per-call param map. state_dict() walks the whole module tree
+        (string prefix joins per tensor) — far too slow to redo every
+        replay on a large model — so the (name, Tensor) ITEMS are
+        cached and invalidated by the global layer structure version
+        (bumped on add/remove/replace; optimizer steps and
+        set_state_dict mutate Tensor.data in place and keep the cache
+        valid)."""
         if self.layer is None:
             return {}
-        return {k: t.data for k, t in self.layer.state_dict().items()}
+        from ..nn.layer.layers import struct_version
+        ver = struct_version()
+        if self._param_cache is None or self._param_cache[0] != ver:
+            self._param_cache = (
+                ver, tuple(self.layer.state_dict().items()))
+        return {k: t.data for k, t in self._param_cache[1]}
 
     # -- capture ------------------------------------------------------------
     def _capture(self, args, kwargs):

@@ -4,7 +4,7 @@ from __future__ import annotations
 from collections import OrderedDict
 
 from ...tensor import Parameter
-from .layers import Layer
+from .layers import Layer, bump_struct_version
 
 __all__ = ["Sequential", "LayerList", "ParameterList", "LayerDict"]
 
@@ -59,6 +59,7 @@ class LayerList(Layer):
     def __setitem__(self, idx, layer):
         keys = list(self._sub_layers.keys())
         self._sub_layers[keys[idx]] = layer
+        bump_struct_version()
 
     def __len__(self):
         return len(self._sub_layers)
@@ -76,6 +77,7 @@ class LayerList(Layer):
         self._sub_layers.clear()
         for i, l in enumerate(layers):
             self._sub_layers[str(i)] = l
+        bump_struct_version()
 
     def extend(self, layers):
         for l in layers:
@@ -119,6 +121,7 @@ class LayerDict(Layer):
 
     def __delitem__(self, key):
         del self._sub_layers[key]
+        bump_struct_version()
 
     def __len__(self):
         return len(self._sub_layers)
@@ -131,9 +134,11 @@ class LayerDict(Layer):
 
     def clear(self):
         self._sub_layers.clear()
+        bump_struct_version()
 
     def pop(self, key):
         layer = self._sub_layers.pop(key)
+        bump_struct_version()
         return layer
 
     def keys(self):

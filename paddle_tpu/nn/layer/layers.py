@@ -18,6 +18,22 @@ from ...framework import core
 from ...tensor import Parameter, Tensor
 from .. import initializer as I
 
+# Monotonic counter bumped on ANY structural mutation of ANY layer
+# (param/sublayer/buffer added, removed, or replaced). Callers that
+# cache a layer's state_dict STRUCTURE (e.g. the SOT guard layer's
+# per-call param map) key their cache on this; .data updates
+# (optimizer steps, set_state_dict) mutate Tensor objects in place and
+# deliberately do NOT bump it.
+_STRUCT_VERSION = [0]
+
+
+def struct_version() -> int:
+    return _STRUCT_VERSION[0]
+
+
+def bump_struct_version() -> None:
+    _STRUCT_VERSION[0] += 1
+
 
 class ParamAttr:
     """ref: python/paddle/base/param_attr.py."""
@@ -72,6 +88,7 @@ class Layer:
                     del d[name]
             params[name] = value
             self.__dict__.pop(name, None)
+            bump_struct_version()
         elif isinstance(value, Layer):
             if layers is None:
                 raise RuntimeError("call super().__init__() first")
@@ -80,15 +97,19 @@ class Layer:
                     del d[name]
             layers[name] = value
             self.__dict__.pop(name, None)
+            bump_struct_version()
         else:
             if params is not None and name in params:
+                bump_struct_version()
                 if value is None:
                     params[name] = None
                     return
                 del params[name]
             if layers is not None and name in layers:
                 del layers[name]
+                bump_struct_version()
             if buffers is not None and name in buffers:
+                bump_struct_version()
                 if value is None or isinstance(value, Tensor):
                     buffers[name] = value
                     return
@@ -108,6 +129,7 @@ class Layer:
             d = self.__dict__.get(store)
             if d is not None and name in d:
                 del d[name]
+                bump_struct_version()
                 return
         object.__delattr__(self, name)
 
@@ -135,16 +157,19 @@ class Layer:
             self._parameters[name] = None
         else:
             self._parameters[name] = parameter
+        bump_struct_version()
         return parameter
 
     def add_sublayer(self, name, sublayer):
         self._sub_layers[str(name)] = sublayer
+        bump_struct_version()
         return sublayer
 
     def register_buffer(self, name, tensor, persistable=True):
         self._buffers[name] = tensor
         if not persistable:
             self._non_persistable_buffer_names.add(name)
+        bump_struct_version()
         return tensor
 
     # -- traversal ----------------------------------------------------------

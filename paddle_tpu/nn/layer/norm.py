@@ -80,8 +80,10 @@ class SyncBatchNorm(_BatchNormBase):
 
     @classmethod
     def convert_sync_batchnorm(cls, layer):
+        from .layers import bump_struct_version
         for name, sub in list(layer._sub_layers.items()):
             layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        bump_struct_version()
         if isinstance(layer, _BatchNormBase) and not isinstance(layer, SyncBatchNorm):
             new = SyncBatchNorm(layer._num_features, layer._momentum,
                                 layer._epsilon,

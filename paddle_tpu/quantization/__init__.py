@@ -139,10 +139,12 @@ class QuantConfig:
 
 
 def _wrap_layers(model: Layer, cfg: QuantConfig):
+    from ..nn.layer.layers import bump_struct_version
     for name, child in list(model._sub_layers.items()):
         wrapper = cfg._type_map.get(type(child))
         if wrapper is not None:
             model._sub_layers[name] = wrapper(child)
+            bump_struct_version()
         else:
             _wrap_layers(child, cfg)
     return model

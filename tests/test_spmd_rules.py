@@ -530,3 +530,266 @@ class TestRegistryParityTail:
                 "unsqueeze", "where"}
         missing = want - set(_FORWARD_RULES)
         assert not missing, missing
+
+
+class TestRound5RuleTail:
+    """Index/scan/sort/einsum families (ref: spmd_rules/topk.cc,
+    cumsum.cc, argsort.cc, expand_as.cc, set_value.cc, gather_nd.cc,
+    gather.cc index path, nonzero.cc, pad.cc; test pattern:
+    test/auto_parallel/spmd_rules/test_*_rule.py)."""
+
+    def test_topk_axis_replicated_two_outputs(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            topk_rule)
+        x = DistAttr(["dp", None, "mp"])
+        rx, (vals, idx) = topk_rule(x, axis=-1)
+        assert rx.dims_mapping == ["dp", None, None]
+        assert vals.dims_mapping == ["dp", None, None]
+        assert idx.dims_mapping == ["dp", None, None]
+
+    def test_cumsum_scan_axis_replicated(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            cumsum_rule)
+        x = DistAttr(["dp", "mp"])
+        rx, out = cumsum_rule(x, axis=1)
+        assert rx.dims_mapping == ["dp", None]
+        assert out.dims_mapping == ["dp", None]
+        # axis=None (flattened) replicates everything
+        rx2, out2 = cumsum_rule(x, axis=None)
+        assert out2.dims_mapping == [None, None]
+
+    def test_argsort_sort_axis_replicated(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            argsort_rule)
+        x = DistAttr(["dp", "mp"])
+        rx, (vals, idx) = argsort_rule(x, axis=0)
+        assert rx.dims_mapping == [None, "mp"]
+        assert vals.dims_mapping == idx.dims_mapping == [None, "mp"]
+
+    def test_expand_as_broadcast_dims_take_target(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            expand_as_rule)
+        # x [1, h] broadcast to y [b, h]: out batch dim takes y's dp,
+        # h merges from x
+        x = DistAttr([None, "mp"])
+        y = DistAttr(["dp", None])
+        (rx, ry), out = expand_as_rule(x, y, x_shape=(1, 8),
+                                       y_shape=(4, 8))
+        assert out.dims_mapping == ["dp", "mp"]
+        assert rx.dims_mapping == [None, "mp"]
+        # rank-extending broadcast: missing leading dims take target's
+        x1 = DistAttr(["mp"])
+        (rx1, _), out1 = expand_as_rule(x1, y, x_shape=(8,),
+                                        y_shape=(4, 8))
+        assert out1.dims_mapping == ["dp", "mp"]
+
+    def test_set_value_written_axes_replicated(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            set_value_rule)
+        x = DistAttr(["dp", "mp"])
+        v = DistAttr([None, "mp"])
+        (rx, rv), out = set_value_rule(x, v, axes=[0])
+        assert rx.dims_mapping == [None, "mp"]
+        assert rv.dims_mapping == [None, "mp"]
+        assert out.dims_mapping == [None, "mp"]
+
+    def test_gather_nd_addressed_dims_replicated(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            gather_nd_rule)
+        # table [v, h] mp-sharded on h; index [b, s, 1] dp on batch
+        t = DistAttr([None, "mp"])
+        i = DistAttr(["dp", None, None])
+        (rt, ri), out = gather_nd_rule(t, i, index_depth=1)
+        assert rt.dims_mapping == [None, "mp"]
+        assert ri.dims_mapping == ["dp", None, None]
+        assert out.dims_mapping == ["dp", None, "mp"]
+        # depth-2 coordinates consume two table dims; the table tail's
+        # dp is dropped because the index batch dim claimed dp first
+        # (one mesh axis never shards two output dims)
+        t2 = DistAttr(["mp", None, "dp"])
+        (rt2, _), out2 = gather_nd_rule(t2, i, index_depth=2)
+        assert rt2.dims_mapping == [None, None, None]
+        assert out2.dims_mapping == ["dp", None, None]
+        # without the clash the tail keeps its sharding
+        (rt3, _), out3 = gather_nd_rule(t2, DistAttr([None, None, None]),
+                                        index_depth=2)
+        assert rt3.dims_mapping == [None, None, "dp"]
+        assert out3.dims_mapping == [None, None, "dp"]
+
+    def test_index_select_axis_replaced_by_index_dim(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            index_select_rule)
+        x = DistAttr(["dp", "mp"])
+        idx = DistAttr([None])
+        (rx, ri), out = index_select_rule(x, idx, axis=0)
+        assert rx.dims_mapping == [None, "mp"]
+        assert out.dims_mapping == [None, "mp"]
+
+    def test_nonzero_replicated(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            nonzero_rule)
+        rx, out = nonzero_rule(DistAttr(["dp", "mp"]))
+        assert rx.dims_mapping == [None, None]
+        assert out.dims_mapping == [None, None]
+
+    def test_pad_padded_dims_replicated(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            pad_rule)
+        x = DistAttr(["dp", "mp"])
+        rx, out = pad_rule(x, [(0, 0, 0), (1, 1, 0)])
+        assert rx.dims_mapping == ["dp", None]
+        assert out.dims_mapping == ["dp", None]
+
+    def test_roll_shifted_axes_replicated(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            roll_rule)
+        x = DistAttr(["dp", "mp"])
+        rx, out = roll_rule(x, axes=[1])
+        assert out.dims_mapping == ["dp", None]
+        _, out2 = roll_rule(x, axes=None)
+        assert out2.dims_mapping == [None, None]
+
+    def test_einsum_matmul_equivalence(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            einsum_rule, matmul_rule)
+        # bsh,hm->bsm must match the matmul rule's decisions
+        x = DistAttr(["dp", None, "mp"])
+        w = DistAttr(["mp", None])
+        (rx, rw), out = einsum_rule("bsh,hm->bsm", x, w)
+        (_, _), out_mm = matmul_rule(x, w)
+        assert out.dims_mapping == out_mm.dims_mapping
+        assert out.partial == out_mm.partial == {"mp"}
+
+    def test_einsum_contraction_partial_and_claim(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            einsum_rule)
+        # contracted letter sharded on both operands -> partial out;
+        # an axis never shards two letters
+        a = DistAttr(["dp", "mp"])
+        b = DistAttr(["mp", "dp"])
+        (ra, rb), out = einsum_rule("ik,kj->ij", a, b)
+        assert out.dims_mapping == ["dp", None]   # j cannot reuse dp
+        assert out.partial == {"mp"}
+
+    def test_einsum_implicit_output(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            einsum_rule)
+        # implicit mode: unique letters, alphabetical -> "ij"
+        a = DistAttr(["dp", None])
+        b = DistAttr([None, "mp"])
+        _, out = einsum_rule("ik,kj", a, b)
+        assert out.dims_mapping == ["dp", "mp"]
+
+    def test_registry_round5_tail_registered(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            _FORWARD_RULES)
+        want = {"topk", "cumsum", "argsort", "expand_as", "set_value",
+                "gather_nd", "index_select", "nonzero", "pad", "roll",
+                "einsum"}
+        missing = want - set(_FORWARD_RULES)
+        assert not missing, missing
+        # VERDICT r4 item 7: >=46 registered families
+        assert len(_FORWARD_RULES) >= 46, len(_FORWARD_RULES)
+
+
+class TestRound5Propagation:
+    """The new prims propagate through whole jaxprs (no unknowns) and
+    the unknown-prim summary warns once per model."""
+
+    def test_sort_topk_cumsum_rev_pad_no_unknowns(self):
+        import warnings
+
+        import jax.numpy as jnp
+        from jax import lax
+
+        from paddle_tpu.distributed.auto_parallel.propagation import (
+            propagate_jaxpr)
+
+        def f(x):
+            s = jnp.sort(x, axis=1)
+            v, i = lax.top_k(x, 2)
+            c = jnp.cumsum(x, axis=1)
+            r = jnp.flip(x, axis=1)
+            p = jnp.pad(x, ((0, 0), (1, 1)))
+            return (s.sum() + v.sum() + c.sum() + r.sum() + p.sum()
+                    + i.astype(jnp.float32).sum())
+
+        x = jnp.zeros((4, 8), jnp.float32)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            rep = propagate_jaxpr(f, (x,), [DistAttr(["dp", "mp"])],
+                                  {"dp": 2, "mp": 2})
+        assert rep.unknown_prims == {}, rep.unknown_prims
+
+    def test_argsort_dp_batch_survives(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.distributed.auto_parallel.propagation import (
+            propagate_jaxpr)
+
+        def f(x):
+            return jnp.argsort(x, axis=-1)
+
+        x = jnp.zeros((4, 8), jnp.float32)
+        rep = propagate_jaxpr(f, (x,), [DistAttr(["dp", None])],
+                              {"dp": 2, "mp": 2})
+        assert rep.unknown_prims == {}
+        (out,) = rep.out_attrs
+        assert out.dims_mapping == ["dp", None]
+
+    def test_unknown_prim_warns_summary(self):
+        import warnings
+
+        import jax.numpy as jnp
+
+        from paddle_tpu.distributed.auto_parallel.propagation import (
+            propagate_jaxpr)
+
+        def f(x):
+            # erf_inv-free odd prim: use a cholesky (no rule registered)
+            import jax
+            return jax.lax.linalg.cholesky(x)
+
+        x = jnp.eye(4, dtype=jnp.float32)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            rep = propagate_jaxpr(f, (x,), [DistAttr([None, None])],
+                                  {"dp": 2})
+        assert rep.unknown_prims, "expected an unknown prim"
+        assert any("no SPMD rule" in str(x.message) for x in w)
+
+    def test_one_hot_unbind_take_along_axis_fused_dropout(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            fused_dropout_add_rule, one_hot_rule, take_along_axis_rule,
+            unbind_rule)
+        _, out = one_hot_rule(DistAttr(["dp", None]))
+        assert out.dims_mapping == ["dp", None, None]
+        rx, outs = unbind_rule(DistAttr(["dp", "mp"]), axis=0)
+        assert rx.dims_mapping == [None, "mp"]
+        assert outs[0].dims_mapping == ["mp"]
+        (rx, ri), out = take_along_axis_rule(
+            DistAttr(["dp", "mp"]), DistAttr([None, None]), axis=1)
+        assert rx.dims_mapping == ["dp", None]
+        assert out.dims_mapping == ["dp", None]
+        (rx, ry), (out, mask) = fused_dropout_add_rule(
+            DistAttr(["dp", None]), DistAttr(["dp", None]))
+        assert out.dims_mapping == mask.dims_mapping == ["dp", None]
+
+    def test_einsum_ellipsis_batched_matmul(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            einsum_rule)
+        a = DistAttr(["dp", None, None, "mp"])   # [B, b2, i, k]
+        b = DistAttr([None, None, "mp", None])   # [B, b2, k, j]
+        (ra, rb), out = einsum_rule("...ij,...jk->...ik", a, b)
+        assert out.dims_mapping == ["dp", None, None, None]
+        assert out.partial == {"mp"}
+        # implicit-output ellipsis: batch dims lead
+        _, out2 = einsum_rule("...ik,...kj", a, b)
+        assert out2.dims_mapping[0] == "dp"
+
+    def test_unbind_one_attr_per_output(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            unbind_rule)
+        rx, outs = unbind_rule(DistAttr(["dp", "mp"]), axis=0, num=3)
+        assert len(outs) == 3
+        assert all(o.dims_mapping == ["mp"] for o in outs)
