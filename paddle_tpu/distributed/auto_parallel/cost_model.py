@@ -15,7 +15,34 @@ from typing import Dict, Optional
 
 __all__ = ["HardwareSpec", "TPU_V4_LIKE", "comm_bytes", "comm_time",
            "CostEstimate", "estimate_flops", "estimate_config_cost",
-           "ModelStats"]
+           "ModelStats", "load_calibration"]
+
+
+_CALIBRATION = None
+
+
+def load_calibration() -> Dict:
+    """Measured efficiency factors fitted from on-chip step times
+    (VERDICT r4 item 5: the raw estimator under-priced a real v5e step
+    2.0x because mfu_ceiling=0.55 assumed an ideal schedule; ref:
+    auto_parallel/static/cost/ calibrates from an op-benchmark table).
+    Lives in calibration.json next to this module; keys:
+      compute_efficiency — achieved fraction of peak FLOPs (measured
+                           MFU at the bench operating point)
+      comm_efficiency    — achieved fraction of peak ICI bandwidth
+    Missing file -> identity calibration (raw hardware ceilings)."""
+    global _CALIBRATION
+    if _CALIBRATION is None:
+        import json
+        import os
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "calibration.json")
+        try:
+            with open(path) as f:
+                _CALIBRATION = json.load(f)
+        except (OSError, ValueError):
+            _CALIBRATION = {}
+    return _CALIBRATION
 
 
 @dataclass(frozen=True)
@@ -113,13 +140,26 @@ class CostEstimate:
 
 def estimate_config_cost(stats: ModelStats, config: Dict, global_batch: int,
                          hw: HardwareSpec = TPU_V4_LIKE,
-                         inter_host_dp: bool = False) -> CostEstimate:
+                         inter_host_dp: bool = False,
+                         calibration: Optional[Dict] = None) -> CostEstimate:
     """Estimate one train step under a (dp, mp, pp, sharding) config.
 
     Mirrors the reference's estimator structure: per-device compute time +
     per-parallelism-dimension collective times + memory accounting with
     ZeRO-stage-dependent splits (ref cost/estimate_cost + sharding docs).
+    Efficiencies come from the measured calibration (load_calibration)
+    unless an explicit `calibration` dict (possibly {}) is passed. A
+    calibration fitted on one chip generation must not silently
+    reprice another: it only applies when its recorded
+    hw_flops_per_sec matches `hw` (a file without the key applies to
+    any hw, for hand-written calibrations).
     """
+    cal = load_calibration() if calibration is None else calibration
+    cal_hw = cal.get("hw_flops_per_sec")
+    if cal_hw is not None and float(cal_hw) != hw.flops_per_sec:
+        cal = {}
+    compute_eff = float(cal.get("compute_efficiency", hw.mfu_ceiling))
+    comm_eff = float(cal.get("comm_efficiency", 1.0))
     dp = config.get("dp_degree", 1)
     mp = config.get("mp_degree", 1)
     pp = config.get("pp_degree", 1)
@@ -134,7 +174,7 @@ def estimate_config_cost(stats: ModelStats, config: Dict, global_batch: int,
     # share of the batch
     batch_per_replica = max(global_batch // max(replicas, 1), 1)
     flops_chip = stats.step_flops(batch_per_replica) / max(n_model_split, 1)
-    compute_t = flops_chip / (hw.flops_per_sec * hw.mfu_ceiling)
+    compute_t = flops_chip / (hw.flops_per_sec * compute_eff)
 
     # ---- comm ----
     bd: Dict[str, float] = {}
@@ -174,7 +214,8 @@ def estimate_config_cost(stats: ModelStats, config: Dict, global_batch: int,
         compute_t *= (1.0 + bubble)
         bd["pp_bubble_factor"] = bubble
 
-    comm_t = sum(v for k, v in bd.items() if not k.endswith("_factor"))
+    comm_t = sum(v for k, v in bd.items()
+                 if not k.endswith("_factor")) / comm_eff
 
     # ---- memory (per chip) ----
     shard_all = max(n_model_split, 1)

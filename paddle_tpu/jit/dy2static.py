@@ -10,9 +10,16 @@ plus a call to a runtime helper that dispatches at execution time:
 a concrete (python) condition keeps plain Python semantics; a traced
 tensor condition lowers to the lax primitive — so a data-dependent loop
 compiles into ONE executable with no per-trip-count respecialization
-(VERDICT r3 #5). Constructs the rewrite cannot lower soundly
-(break/continue/return in the body, attribute/subscript stores, loop
-else-clauses) are left untouched and fall to the SOT fragment path.
+(VERDICT r3 #5). `break`/`continue` in a while body lower via carried
+done/skip flags (ref: dy2static/transformers/break_continue_transformer
+.py rewrites them into bool flag variables + guarded blocks): the loop
+condition becomes `not brk and test`, statements after a potential
+break/continue are wrapped in a flag-guarded `if`, and the flags join
+the lax.while_loop carry. Constructs the rewrite cannot lower soundly
+(return in the body, attribute/subscript stores, loop else-clauses,
+a carried name first bound inside the loop body — nothing to seed the
+lax carry with, the reference papers over this with UndefinedVar
+dummies) are left untouched and fall to the SOT fragment path.
 """
 from __future__ import annotations
 
@@ -91,6 +98,38 @@ def run_while(cond_fn, body_fn, vars_tuple):
     return _rebox_like(out, templates)
 
 
+def loop_not_done(brk, test_thunk):
+    """`not brk and test` — the while condition including the lowered
+    break flag. `test_thunk` is LAZY: a concrete taken break must not
+    evaluate the test again (the original `while` never evaluates its
+    test after a break — it may only be valid pre-break, e.g. an index
+    bound). On the traced path both operands evaluate, as lax control
+    flow inherently does."""
+    b = _unbox(brk)
+    if not _is_tensorish(b):
+        if bool(b):
+            return False          # short-circuit: break already taken
+        return test_thunk()
+    t = _unbox(test_thunk())
+    import jax.numpy as jnp
+    return jnp.logical_and(
+        jnp.logical_not(jnp.asarray(b).reshape(())),
+        jnp.asarray(t).reshape(()))
+
+
+def not_any(*flags):
+    """`not (f1 or f2 or ...)` — guard for statements following a
+    potential break/continue. Mixed python/tensor operands supported."""
+    vals = [_unbox(f) for f in flags]
+    if any(_is_tensorish(v) for v in vals):
+        import jax.numpy as jnp
+        acc = jnp.asarray(False)
+        for v in vals:
+            acc = jnp.logical_or(acc, jnp.asarray(v).reshape(()))
+        return jnp.logical_not(acc)
+    return not any(bool(v) for v in vals)
+
+
 def run_if(cond, true_fn, false_fn, vars_tuple):
     """`if cond: ... else: ...` assigning into `vars_tuple`. Traced
     tensor condition -> lax.cond; concrete -> Python branch."""
@@ -117,10 +156,11 @@ class _NameCollector(ast.NodeVisitor):
     """Assigned / loaded names of a statement list, NOT descending into
     nested function/lambda bodies (their locals are their own)."""
 
-    def __init__(self):
+    def __init__(self, allow_bc=False):
         self.stores: Set[str] = set()
         self.loads: Set[str] = set()
         self.unsupported = False
+        self._allow_bc = allow_bc     # break/continue handled separately
 
     def visit_Name(self, node):
         if isinstance(node.ctx, ast.Store):
@@ -139,10 +179,12 @@ class _NameCollector(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_Break(self, node):
-        self.unsupported = True
+        if not self._allow_bc:
+            self.unsupported = True
 
     def visit_Continue(self, node):
-        self.unsupported = True
+        if not self._allow_bc:
+            self.unsupported = True
 
     def visit_Return(self, node):
         self.unsupported = True
@@ -156,11 +198,91 @@ class _NameCollector(ast.NodeVisitor):
         pass
 
 
-def _analyze(stmts: List[ast.stmt]):
-    c = _NameCollector()
+def _analyze(stmts: List[ast.stmt], allow_bc=False):
+    c = _NameCollector(allow_bc=allow_bc)
     for s in stmts:
         c.visit(s)
     return c
+
+
+def _locally_initialized_flags(stmts: List[ast.stmt]) -> Set[str]:
+    """Flag names whose `= False` pre-init lives INSIDE these
+    statements — i.e. flags of a construct fully contained here. Such
+    flags must not join an enclosing construct's carry (they are
+    unbound before it). Only this module emits False-constant assigns
+    to __ds_brk_/__ds_cont_ names, so the pattern is unambiguous."""
+    out: Set[str] = set()
+    for s in ast.walk(ast.Module(body=list(stmts), type_ignores=[])):
+        if (isinstance(s, ast.Assign) and len(s.targets) == 1
+                and isinstance(s.targets[0], ast.Name)
+                and s.targets[0].id.startswith(("__ds_brk_",
+                                                "__ds_cont_"))
+                and isinstance(s.value, ast.Constant)
+                and s.value.value is False):
+            out.add(s.targets[0].id)
+    return out
+
+
+# ---------------- break/continue pre-lowering ------------------------------
+
+def _has_break_continue(stmts: List[ast.stmt]) -> bool:
+    """Break/Continue belonging to THIS loop level (descends into ifs
+    and try blocks, never into nested loops or function defs)."""
+    for s in stmts:
+        if isinstance(s, (ast.Break, ast.Continue)):
+            return True
+        if isinstance(s, (ast.While, ast.For, ast.AsyncFor,
+                          ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        for field in ("body", "orelse", "finalbody"):
+            inner = getattr(s, field, None)
+            if inner and _has_break_continue(inner):
+                return True
+    return False
+
+
+def _flag_assign(name: str, value: bool) -> ast.Assign:
+    return ast.Assign(
+        targets=[ast.Name(id=name, ctx=ast.Store())],
+        value=ast.Constant(value=value))
+
+
+def _guard_call(brk: str, cont: str) -> ast.expr:
+    return ast.Call(
+        func=ast.Attribute(value=ast.Name(id=_RT_NAME, ctx=ast.Load()),
+                           attr="not_any", ctx=ast.Load()),
+        args=[ast.Name(id=brk, ctx=ast.Load()),
+              ast.Name(id=cont, ctx=ast.Load())],
+        keywords=[])
+
+
+def _rewrite_break_continue(stmts: List[ast.stmt], brk: str, cont: str):
+    """Replace break/continue with flag stores and wrap every statement
+    that could execute after one in a flag guard (ref:
+    break_continue_transformer.py BreakContinueTransformer). Returns
+    (new_stmts, contains_bc)."""
+    out: List[ast.stmt] = []
+    for idx, s in enumerate(stmts):
+        if isinstance(s, ast.Break):
+            out.append(_flag_assign(brk, True))
+            return out, True              # rest of the list is dead
+        if isinstance(s, ast.Continue):
+            out.append(_flag_assign(cont, True))
+            return out, True
+        if isinstance(s, ast.If):
+            tb, t_bc = _rewrite_break_continue(s.body, brk, cont)
+            fb, f_bc = _rewrite_break_continue(s.orelse, brk, cont)
+            if t_bc or f_bc:
+                out.append(ast.If(test=s.test, body=tb, orelse=fb))
+                rest, _ = _rewrite_break_continue(stmts[idx + 1:],
+                                                  brk, cont)
+                if rest:
+                    out.append(ast.If(test=_guard_call(brk, cont),
+                                      body=rest, orelse=[]))
+                return out, True
+        out.append(s)
+    return out, False
 
 
 # ---------------- the transformer ------------------------------------------
@@ -170,13 +292,23 @@ class _CtrlFlow(ast.NodeTransformer):
         self.n = 0
         self.rewrote = False
 
-    def _carried(self, analyses) -> Optional[List[str]]:
+    def _carried(self, analyses, keep_flags=True) -> Optional[List[str]]:
         stores: Set[str] = set()
         for a in analyses:
             if a.unsupported:
                 return None
             stores |= a.stores
-        names = sorted(n for n in stores if not n.startswith("__ds_"))
+        # __ds_* closure names never carry. Break/continue flags are
+        # ordinary state for the construct that OWNS them (an if inside
+        # the loop must carry them; keep_flags=True), but an ENCLOSING
+        # loop must not — an inner loop's flags are stored-before-
+        # loaded within the enclosing body and dead after it, and
+        # carrying them would reference names unbound before the loop.
+        names = sorted(
+            n for n in stores
+            if not n.startswith("__ds_")
+            or (keep_flags and n.startswith(("__ds_brk_",
+                                             "__ds_cont_"))))
         return names or None
 
     def _closure(self, name: str, carried: List[str],
@@ -204,14 +336,59 @@ class _CtrlFlow(ast.NodeTransformer):
         return ast.Assign(targets=[target], value=call)
 
     def visit_While(self, node: ast.While):
+        # break/continue pre-lowering must run BEFORE generic_visit so
+        # the guard ifs it synthesizes get lax-lowered like any other
+        # if — but only when the body is otherwise lowerable: an
+        # attribute/subscript store or return must keep the ORIGINAL
+        # loop so it falls to SOT (lowering just the flags would trace
+        # the side effect once and bake a leaked tracer)
+        pre: List[ast.stmt] = []
+        flags: List[str] = []
+        test = node.test
+        if not node.orelse and _has_break_continue(node.body) \
+                and not _analyze(node.body, allow_bc=True).unsupported:
+            i = self.n
+            self.n += 1
+            brk, cont = f"__ds_brk_{i}", f"__ds_cont_{i}"
+            new_body, _ = _rewrite_break_continue(node.body, brk, cont)
+            if _has_break_continue(new_body):
+                # a break/continue inside a `with`/`try` survived the
+                # rewrite (it only descends into ifs) — lowering now
+                # would emit a bare `break` outside any loop; keep the
+                # original node so it falls to SOT
+                self.generic_visit(node)
+                return node
+            # cont resets every iteration; brk persists in the carry.
+            # The original test is wrapped in a LAZY thunk: a taken
+            # break must not evaluate it again (see loop_not_done).
+            thunk = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                                   kwonlyargs=[], kw_defaults=[],
+                                   kwarg=None, defaults=[]),
+                body=node.test)
+            node = ast.While(
+                test=ast.Call(
+                    func=ast.Attribute(
+                        value=ast.Name(id=_RT_NAME, ctx=ast.Load()),
+                        attr="loop_not_done", ctx=ast.Load()),
+                    args=[ast.Name(id=brk, ctx=ast.Load()), thunk],
+                    keywords=[]),
+                body=[_flag_assign(cont, False)] + new_body,
+                orelse=[])
+            test = node.test
+            pre = [_flag_assign(brk, False), _flag_assign(cont, False)]
+            flags = [brk, cont]
         self.generic_visit(node)
         if node.orelse:
             return node
         body_a = _analyze(node.body)
-        test_a = _analyze([ast.Expr(value=node.test)])
-        carried = self._carried([body_a])
+        test_a = _analyze([ast.Expr(value=test)])
+        carried = self._carried([body_a], keep_flags=False)
+        if carried is None and flags:
+            carried = []
         if carried is None or test_a.unsupported:
             return node
+        carried = sorted(set(carried) | set(flags))
         i = self.n
         self.n += 1
         cond_fn = self._closure(
@@ -226,7 +403,7 @@ class _CtrlFlow(ast.NodeTransformer):
             [ast.Name(id=f"__ds_cond_{i}", ctx=ast.Load()),
              ast.Name(id=f"__ds_body_{i}", ctx=ast.Load())], carried)
         self.rewrote = True
-        return [cond_fn, body_fn, assign]
+        return pre + [cond_fn, body_fn, assign]
 
     def visit_If(self, node: ast.If):
         self.generic_visit(node)
@@ -235,6 +412,17 @@ class _CtrlFlow(ast.NodeTransformer):
         carried = self._carried([body_a, else_a])
         if carried is None:
             return node
+        # flags of constructs fully inside this if (their False-init
+        # lives in a branch) are unbound before it — drop them from
+        # the carry; an ENCLOSING loop's flags (stored via `= True`
+        # only) stay
+        local = (_locally_initialized_flags(node.body)
+                 | _locally_initialized_flags(node.orelse))
+        if local:
+            carried = [n for n in carried if n not in local]
+            if not carried:
+                # nothing escapes this if; leave it to the fallback
+                return node
         i = self.n
         self.n += 1
         t_fn = self._closure(f"__ds_true_{i}", carried, node.body, carried)

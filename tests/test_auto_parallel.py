@@ -194,3 +194,70 @@ class TestEngineIntegration:
         assert (s.dp_degree * s.mp_degree * s.pp_degree
                 * s.sharding_degree) == 8
         assert choice.cost.step_time_s > 0
+
+
+class TestCostModelCalibration:
+    """VERDICT r4 item 5: the estimator scales by MEASURED efficiency
+    factors (auto_parallel/calibration.json, fitted from the on-chip
+    step) instead of the ideal mfu_ceiling that under-priced a real
+    v5e step 2.0x."""
+
+    def _stats(self):
+        return ap.ModelStats(param_count=10_000_000, layers=4,
+                             hidden=256, heads=8, seq_len=512,
+                             vocab=1000)
+
+    def test_calibration_file_loads_and_applies(self):
+        from paddle_tpu.distributed.auto_parallel.cost_model import (
+            HardwareSpec, load_calibration)
+        cal = load_calibration()
+        assert 0.0 < cal["compute_efficiency"] <= 1.0
+        stats = self._stats()
+        cfg = dict(dp_degree=1, mp_degree=1, pp_degree=1,
+                   sharding_degree=1, micro_batch_size=1)
+        # the calibration is fitted on v5e — it applies on the
+        # matching spec only
+        hw = HardwareSpec(flops_per_sec=float(cal["hw_flops_per_sec"]))
+        raw = ap.estimate_config_cost(stats, cfg, 8, hw,
+                                      calibration={})
+        cald = ap.estimate_config_cost(stats, cfg, 8, hw)
+        expect = raw.compute_time_s * (hw.mfu_ceiling
+                                       / cal["compute_efficiency"])
+        np.testing.assert_allclose(cald.compute_time_s, expect,
+                                   rtol=1e-9)
+
+    def test_calibration_skipped_on_other_hardware(self):
+        """A v5e-fitted calibration must not reprice a different chip
+        (the default TPU_V4_LIKE spec keeps its own ceiling)."""
+        stats = self._stats()
+        cfg = dict(dp_degree=1, mp_degree=1, pp_degree=1,
+                   sharding_degree=1, micro_batch_size=1)
+        a = ap.estimate_config_cost(stats, cfg, 8)            # v4 default
+        b = ap.estimate_config_cost(stats, cfg, 8, calibration={})
+        np.testing.assert_allclose(a.compute_time_s, b.compute_time_s,
+                                   rtol=1e-12)
+
+    def test_explicit_empty_calibration_is_raw(self):
+        stats = self._stats()
+        cfg = dict(dp_degree=1, mp_degree=1, pp_degree=1,
+                   sharding_degree=1, micro_batch_size=1)
+        a = ap.estimate_config_cost(stats, cfg, 8, calibration={})
+        from paddle_tpu.distributed.auto_parallel.cost_model import TPU_V4_LIKE as hw
+        expect = stats.step_flops(8) / (hw.flops_per_sec
+                                        * hw.mfu_ceiling)
+        np.testing.assert_allclose(a.compute_time_s, expect, rtol=1e-9)
+
+    def test_reconcile_ratio_within_bar(self):
+        """The recorded reconcile artifact must meet the <=1.3 bar with
+        calibration applied (r4: 2.0x raw)."""
+        import json
+        import os
+        path = os.path.join(os.path.dirname(__file__), "..",
+                            "benchmarks", "COST_MODEL_RECONCILE.json")
+        with open(path) as f:
+            data = json.load(f)
+        canon = [r for r in data["rows"]
+                 if not r["ablation_flags"] and not r["bench_knobs"]]
+        assert canon, "no canonical reconcile rows"
+        for r in canon:
+            assert r["ratio_meas_over_est_calibrated"] <= 1.3, r

@@ -209,6 +209,20 @@ def main():
     ):
         _section(name, int(os.environ.get("CFG_BUDGET", str(budget))),
                  bench_model(size, flags))
+
+    # final: refit the cost-model calibration from the fresh numbers and
+    # record the calibrated ratios + planner batch-ordering check
+    # (CPU-only math; subprocess so it cannot disturb the chip claim)
+    def reconcile():
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "reconcile_cost_model.py"),
+             "--fit"],
+            capture_output=True, text=True, timeout=240)
+        return [{"stdout_tail": r.stdout[-1500:],
+                 "returncode": r.returncode}]
+
+    _section("reconcile_cost_model", 300, reconcile)
     print("session complete", flush=True)
     return 0
 
