@@ -35,7 +35,7 @@ def helper_alive() -> bool:
         s.close()
 
 
-def main():
+def main(arm_watchdog=True):
     # the helper gate only applies when the axon tunnel backend is in
     # play (same detection as bench.py) — a plain CPU box must run the
     # CPU smoke path, not read a bogus "helper down" skip
@@ -48,10 +48,22 @@ def main():
                           "unit": "tokens/s",
                           "extra": {"reason": "axon compile helper down"}}))
         return 0
-    budget = int(os.environ.get("SMOKE_WALL_TIMEOUT", "1800"))
-    signal.signal(signal.SIGALRM, lambda *_: (_ for _ in ()).throw(
-        TimeoutError(f"serving smoke exceeded {budget}s")))
-    signal.alarm(budget)
+    if arm_watchdog:
+        # standalone runs fence themselves; an inline caller (the
+        # one-claim session) passes False so ITS section alarm survives
+        # (one SIGALRM per process)
+        budget = int(os.environ.get("SMOKE_WALL_TIMEOUT", "1800"))
+        signal.signal(signal.SIGALRM, lambda *_: (_ for _ in ()).throw(
+            TimeoutError(f"serving smoke exceeded {budget}s")))
+        signal.alarm(budget)
+
+    import jax
+    if platforms == "cpu":
+        # sitecustomize force-pins the axon TPU platform at interpreter
+        # start; honor an explicit CPU request (same as bench.py /
+        # step_breakdown) — without this, jax.devices() below would try
+        # the axon tunnel and HANG on a dead helper
+        jax.config.update("jax_platforms", "cpu")
 
     import numpy as np
 
@@ -60,7 +72,6 @@ def main():
                                       GenerationRequest)
     from paddle_tpu.models import llama as L
 
-    import jax
     on_tpu = jax.devices()[0].platform == "tpu"
     size = os.environ.get("SMOKE_MODEL", "350m" if on_tpu else "tiny")
     cfg = {"tiny": L.llama_tiny, "350m": L.llama_350m}[size](
@@ -71,12 +82,20 @@ def main():
 
     paddle.seed(0)
     model = L.LlamaForCausalLM(cfg)
-    # pool at half the dense equivalent: the round-4 memory claim runs
-    # on hardware, not just the CPU test
+    # pool at half the dense equivalent ON HARDWARE (the round-4 memory
+    # claim); the CPU sanity path keeps test-sized buckets and a
+    # comfortable pool — a starved pool preempts every step and each
+    # resume recompiles a prefill bucket, minutes per tick on CPU
     ppseq = S // 16
+    if on_tpu:
+        buckets = (32, 64, 128)
+        pages = (B * ppseq) // 2 + 1
+    else:
+        buckets = (8, 16)
+        pages = B * ppseq + 1
     eng = ContinuousBatchingEngine(model, max_batch=B, max_seq=S,
-                                   prefill_buckets=(32, 64, 128),
-                                   total_pages=(B * ppseq) // 2 + 1)
+                                   prefill_buckets=buckets,
+                                   total_pages=pages)
     rng = np.random.default_rng(0)
     for i in range(B):
         eng.add_request(GenerationRequest(
