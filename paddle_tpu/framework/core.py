@@ -257,7 +257,13 @@ _flags: dict = {
     "FLAGS_use_autotune": True,
     # kernel-route kill switches (the on-chip ablation levers; analog of
     # the reference's cudnn/flash deterministic+enable toggles)
-    "FLAGS_use_fused_ce": True,        # Pallas blockwise CE vs XLA CE
+    # Default FALSE: the only two on-chip measurements bracket the
+    # route — r2 (XLA CE) 23,126 tok/s/chip vs r4 (fused CE on,
+    # UNTUNED — its autotune sweep died mid-run) 19,011. Until the
+    # attribution session proves the Pallas CE faster, the measured
+    # configuration is the default; FLAGS_use_fused_ce=1 opts in
+    # (benchmarks/MEASUREMENT_RUNBOOK.md).
+    "FLAGS_use_fused_ce": False,       # Pallas blockwise CE vs XLA CE
     "FLAGS_use_flash_attention": True,  # Pallas flash vs dense XLA attn
     "FLAGS_cudnn_exhaustive_search": False,     # alias: force sweeps
     # -- numerics (consumed in _apply_flag -> jax matmul precision) ----

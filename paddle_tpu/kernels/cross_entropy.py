@@ -44,7 +44,7 @@ def supported(n_classes: int, min_vocab: int = 4096) -> bool:
     switches)."""
     try:
         from ..framework import core
-        if not core.get_bool_flag("FLAGS_use_fused_ce", True):
+        if not core.get_bool_flag("FLAGS_use_fused_ce", False):
             return False
     except Exception:
         pass

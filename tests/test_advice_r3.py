@@ -204,19 +204,25 @@ def test_kernel_route_kill_switches():
     from paddle_tpu.kernels import flash_attention as fa
 
     # defaults: gates defer to the backend check only (False on CPU,
-    # but the flag consult must not throw and must honor an override)
+    # but the flag consult must not throw and must honor an override).
+    # Restore the PRIOR value, not a hardcoded one — the shipped
+    # default changed once already (r5: fused CE off until proven).
+    prior_ce = paddle.get_flags(["FLAGS_use_fused_ce"])[
+        "FLAGS_use_fused_ce"]
     paddle.set_flags({"FLAGS_use_fused_ce": False})
     try:
         assert fck.supported(32000) is False
     finally:
-        paddle.set_flags({"FLAGS_use_fused_ce": True})
+        paddle.set_flags({"FLAGS_use_fused_ce": prior_ce})
 
+    prior_fa = paddle.get_flags(["FLAGS_use_flash_attention"])[
+        "FLAGS_use_flash_attention"]
     paddle.set_flags({"FLAGS_use_flash_attention": False})
     try:
         assert fa.supported((2, 256, 8, 64), (2, 256, 8, 64),
                             True) is False
     finally:
-        paddle.set_flags({"FLAGS_use_flash_attention": True})
+        paddle.set_flags({"FLAGS_use_flash_attention": prior_fa})
 
     # env-string form (the bench/session ablation path) normalizes
     import os
