@@ -393,8 +393,13 @@ def main():
     # that fit without it should skip it (BENCH_REMAT=1 forces it on)
     remat_default = size == "7b"
     remat = bool(int(os.environ.get("BENCH_REMAT", int(remat_default))))
+    # BENCH_FUSE_QKV_MLP=0 reverts to the r2-measured separate
+    # qkv/gate/up matmul layouts (the session's layout A/B lever —
+    # the fused layouts landed post-r2 without an on-chip number)
+    fuse = bool(int(os.environ.get("BENCH_FUSE_QKV_MLP", "1")))
     cfg = {"tiny": L.llama_tiny, "350m": L.llama_350m,
-           "1b": L.llama_1b, "7b": L.llama_7b}[size](use_recompute=remat)
+           "1b": L.llama_1b, "7b": L.llama_7b}[size](
+        use_recompute=remat, fuse_attention_qkv=fuse, fuse_mlp=fuse)
     # batch must divide evenly over the sharding axis (= all chips)
     batch = int(os.environ.get("BENCH_BATCH",
                                max(4, len(devs)) if on_tpu else 2))

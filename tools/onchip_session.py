@@ -138,8 +138,16 @@ def main():
         os.environ["PADDLE_AUTOTUNE"] = "1"
         try:
             from paddle_tpu.kernels import cross_entropy as ce
-            best = ce.sweep_block_sizes(N=4 * 2048, V=32000)
-            return [{"fused_ce_winner": best}]
+            recs = []
+            # the autotune key matches N exactly; the bench's loss
+            # shifts labels (N = B*(S-1)) while the breakdown's head
+            # piece uses N = B*S — sweep every N the session traces
+            # (B=4 default, B=8 and B=16 scaling sections)
+            for n in (4 * 2047, 4 * 2048, 8 * 2047, 8 * 2048,
+                      16 * 2047, 16 * 2048):
+                best = ce.sweep_block_sizes(N=n, V=32000)
+                recs.append({"fused_ce_N": n, "winner": best})
+            return recs
         finally:
             if prior_at is None:
                 os.environ.pop("PADDLE_AUTOTUNE", None)
@@ -185,10 +193,14 @@ def main():
                     if knobs:
                         record["extra"]["bench_knobs"] = knobs
                 captured.append(record)
-                # route-ablated runs must not become the BENCH_LAST_GOOD
-                # artifact a wedged session would later re-emit; config
-                # variations (batch/remat) are legitimate fresh numbers
-                ablated = any(k.startswith("FLAGS_") for k in flags or {})
+                # route-ablated and layout-variant runs must not become
+                # the BENCH_LAST_GOOD artifact a wedged session would
+                # later re-emit as the canonical default-config number;
+                # config variations (batch/remat) are legitimate fresh
+                # numbers
+                ablated = any(k.startswith("FLAGS_")
+                              or k == "BENCH_FUSE_QKV_MLP"
+                              for k in flags or {})
                 orig_emit(record, on_tpu_flag and not ablated)
 
             bench._emit = cap_emit
@@ -242,6 +254,9 @@ def main():
              {"FLAGS_use_fused_ce": "1"}, 900),
             ("bench_350m_dense_attn", "350m",
              {"FLAGS_use_flash_attention": "0"}, 900),
+            # layout A/B: r2-measured separate qkv/gate/up matmuls
+            ("bench_350m_unfused_matmul", "350m",
+             {"BENCH_FUSE_QKV_MLP": "0"}, 900),
             # batch scaling: the cheapest MFU lever if HBM allows
             # (v5e 16 GB; B=4 is far from the memory roof at 350m)
             ("bench_350m_b8", "350m", {"BENCH_BATCH": "8"}, 900),
@@ -260,7 +275,9 @@ def main():
         for sec, flags in (
                 ("bench_350m_fused_ce", {"FLAGS_use_fused_ce": "1"}),
                 ("bench_350m_dense_attn",
-                 {"FLAGS_use_flash_attention": "0"})):
+                 {"FLAGS_use_flash_attention": "0"}),
+                ("bench_350m_unfused_matmul",
+                 {"BENCH_FUSE_QKV_MLP": "0"})):
             v = section_values.get(sec)
             if v and v > base * 1.03 and (
                     winner is None or v > winner[1]):
@@ -272,8 +289,11 @@ def main():
                 "default_tok_s": base, "ablated_tok_s": v,
                 "gain_pct": round((v / base - 1) * 100, 1),
                 "from_section": sec,
-                "action": ("flip the corresponding FLAGS_ default in "
-                           "framework/core.py and re-bench")}])
+                "action": ("flip the corresponding default — FLAGS_ in "
+                           "framework/core.py, or for the layout "
+                           "variant LlamaConfig.fuse_attention_qkv/"
+                           "fuse_mlp + bench.py BENCH_FUSE_QKV_MLP — "
+                           "and re-bench")}])
             run_cfg("bench_350m_recommended", "350m", flags, 900)
 
     # autotune sweeps for the shapes that matter (VERDICT r4 item 4:

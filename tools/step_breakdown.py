@@ -182,12 +182,16 @@ def main():
     V = cfg.vocab_size
     wlm = jax.random.normal(jax.random.PRNGKey(5), (h, V), jnp.bfloat16)
     lbl = jnp.asarray(rng.integers(0, V, (B * S,)).astype(np.int32))
-    import paddle_tpu.nn.functional as F
-    from paddle_tpu.tensor import Tensor
+
+    # call the Pallas kernel DIRECTLY: F.cross_entropy routes by the
+    # FLAGS_use_fused_ce default (False since r5), which would make
+    # this A/B compare XLA against XLA
+    from paddle_tpu.kernels.cross_entropy import fused_cross_entropy
 
     def head(x):
         lg = (x @ wlm)
-        return F.cross_entropy(Tensor(lg), Tensor(lbl)).data
+        return fused_cross_entropy(lg.astype(jnp.float32), lbl,
+                                   -100).mean()
 
     emit("lmhead_ce", _time(jax.jit(jax.grad(head)), iters, x))
 
