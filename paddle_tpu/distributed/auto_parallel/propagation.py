@@ -249,6 +249,47 @@ class Propagator:
             for v, a in zip(eqn.outvars, (ov, oi)):
                 env[v] = a
             return
+        elif name == "conv_general_dilated":
+            from .spmd_rules import conv2d_rule
+            dn = eqn.params["dimension_numbers"]
+            # lhs_spec/rhs_spec/out_spec give the dim roles directly
+            lhs, rhs, out_spec = (dn.lhs_spec, dn.rhs_spec, dn.out_spec)
+            (rx, rw), o = conv2d_rule(
+                ins[0], ins[1],
+                batch_dim=lhs[0], feature_dim=lhs[1],
+                w_out_dim=rhs[0], w_in_dim=rhs[1],
+                feature_group_count=eqn.params.get(
+                    "feature_group_count", 1))
+            self._reshard(name, ins[0], rx, avals[0])
+            self._reshard(name, ins[1], rw, avals[1])
+            # conv2d_rule lays out by the LHS positions; remap batch +
+            # feature onto the out_spec positions
+            dm: List[Optional[str]] = [None] * len(out_avals[0].shape)
+            dm[out_spec[0]] = o.dims_mapping[lhs[0]]
+            dm[out_spec[1]] = o.dims_mapping[lhs[1]]
+            out = DistAttr(dm, set(o.partial))
+        elif name in ("reduce_window_max", "reduce_window_min",
+                      "reduce_window_sum"):
+            # NOT the variadic "reduce_window" (multiple_results) —
+            # that one stays on the unknown path, which attributes
+            # replicated to EVERY outvar
+            from .spmd_rules import pool2d_rule
+            rx, out = pool2d_rule(ins[0],
+                                  eqn.params["window_dimensions"])
+            self._reshard(name, ins[0], rx, avals[0])
+        elif name == "select_and_scatter_add":
+            # maxpool backward: same windowed-dim constraint as the
+            # forward pool for BOTH the cotangent source (pooled
+            # shape, same dim positions) and the operand; the output
+            # takes the operand's rank, partial unioned from both
+            from .spmd_rules import pool2d_rule
+            win = eqn.params["window_dimensions"]
+            rsrc, _ = pool2d_rule(ins[0], win)
+            rop, out = pool2d_rule(ins[1], win)
+            self._reshard(name, ins[0], rsrc, avals[0])
+            self._reshard(name, ins[1], rop, avals[1])
+            out = DistAttr(list(out.dims_mapping),
+                           set(out.partial) | set(rsrc.partial))
         elif name == "gather":
             out = self._gather(eqn, ins, avals, out_avals)
         elif name == "iota":

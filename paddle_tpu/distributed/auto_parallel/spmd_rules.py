@@ -35,7 +35,7 @@ __all__ = ["DistAttr", "matmul_rule", "embedding_rule", "layer_norm_rule",
            "set_value_rule", "gather_nd_rule", "index_select_rule",
            "nonzero_rule", "pad_rule", "roll_rule", "einsum_rule",
            "one_hot_rule", "unbind_rule", "take_along_axis_rule",
-           "fused_dropout_add_rule",
+           "fused_dropout_add_rule", "conv2d_rule", "pool2d_rule",
            "register_rule", "reshard_cost_bytes"]
 
 
@@ -895,6 +895,64 @@ def einsum_rule(equation: str, *xs: DistAttr
     return resolved, out
 
 
+def conv2d_rule(x: DistAttr, w: DistAttr,
+                batch_dim: int = 0, feature_dim: int = 1,
+                w_out_dim: int = 0, w_in_dim: int = 1,
+                feature_group_count: int = 1
+                ) -> Tuple[Tuple[DistAttr, DistAttr], DistAttr]:
+    """ref: spmd_rules/conv (the newer reference adds conv2d rules;
+    semantics follow matmul over the channel dims): the input batch
+    dim carries; the weight's OUT-channel sharding lands on the output
+    feature dim; in-channels sharded on BOTH sides contract to a
+    PARTIAL output; spatial dims replicate (halo exchange is not
+    modeled — GSPMD handles spatial sharding itself when chosen).
+    Grouped/depthwise convs (feature_group_count > 1) do NOT contract
+    across the full channel dim — the matmul model would declare a
+    phantom allreduce — so they conservatively carry only the batch
+    dim and replicate the channels."""
+    used: Set[str] = set()
+
+    def claim(a):
+        if a is None or a in used:
+            return None
+        used.add(a)
+        return a
+
+    rx = [None] * x.ndim
+    rw = [None] * w.ndim
+    out = [None] * x.ndim
+    b = claim(x.dims_mapping[batch_dim])
+    rx[batch_dim] = b
+    out[batch_dim] = b
+    if feature_group_count > 1:
+        return (DistAttr(rx, set(x.partial)),
+                DistAttr(rw, set(w.partial))), \
+            DistAttr(out, set(x.partial) | set(w.partial))
+    o = claim(w.dims_mapping[w_out_dim])
+    rw[w_out_dim] = o
+    out[feature_dim] = o
+    cin = _merge(x.dims_mapping[feature_dim], w.dims_mapping[w_in_dim])
+    cin = claim(cin)
+    rx[feature_dim] = cin
+    rw[w_in_dim] = cin
+    partial = set(x.partial) | set(w.partial)
+    if cin is not None:
+        partial.add(cin)
+    return (DistAttr(rx, set(x.partial)), DistAttr(rw, set(w.partial))), \
+        DistAttr(out, partial)
+
+
+def pool2d_rule(x: DistAttr, window: Sequence[int]
+                ) -> Tuple[DistAttr, DistAttr]:
+    """ref: spmd_rules/pool (reduce_window family) — dims with a
+    window span > 1 reduce across neighbors and must replicate; unit-
+    window dims (batch, channels) carry."""
+    dm = [a if w == 1 else None
+          for a, w in zip(x.dims_mapping, window)]
+    rx = DistAttr(dm, set(x.partial))
+    return rx, DistAttr(list(dm), set(x.partial))
+
+
 def one_hot_rule(x: DistAttr) -> Tuple[DistAttr, DistAttr]:
     """ref: spmd_rules/one_hot.cc — index dims carry; the new trailing
     class dim is replicated (each shard expands its own indices)."""
@@ -1039,6 +1097,8 @@ _FORWARD_RULES = {
     "unbind": unbind_rule,
     "take_along_axis": take_along_axis_rule,
     "fused_dropout_add": fused_dropout_add_rule,
+    "conv2d": conv2d_rule,
+    "pool2d": pool2d_rule,
 }
 
 

@@ -308,3 +308,37 @@ class TestEnginePropagate:
         assert out.dims_mapping[0] is not None      # batch stays sharded
         # FSDP param shards force allgather-style reshards: priced > 0
         assert rep.total_reshard_bytes > 0
+
+
+class TestVisionPropagation:
+    def test_resnet18_propagates_no_unknowns(self):
+        """Conv/pool primitives have rules: the whole resnet18 forward
+        propagates with zero unknown prims and keeps the dp batch
+        sharding to the logits."""
+        import warnings
+
+        import paddle_tpu as paddle
+        import paddle_tpu.vision.models as vm
+        from paddle_tpu.framework import core
+        from paddle_tpu.tensor import Tensor
+
+        paddle.seed(0)
+        model = vm.resnet18(num_classes=10)
+        model.eval()
+        keys = sorted(model.state_dict())
+        vals = [model.state_dict()[k].data for k in keys]
+
+        def fwd(inp, *vs):
+            st = dict(zip(keys, vs))
+            with model.use_state(st), core.no_grad_guard():
+                return model(Tensor(inp)).data
+
+        x = jnp.zeros((2, 3, 32, 32), jnp.float32)
+        attrs = [DistAttr(["dp", None, None, None])] + [
+            DistAttr.replicated(v.ndim) for v in vals]
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            rep = propagate_jaxpr(fwd, (x, *vals), attrs, MESH_SHAPE)
+        assert rep.unknown_prims == {}, rep.unknown_prims
+        (out,) = rep.out_attrs
+        assert out.dims_mapping[0] == "dp"

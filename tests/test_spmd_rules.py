@@ -793,3 +793,39 @@ class TestRound5Propagation:
         rx, outs = unbind_rule(DistAttr(["dp", "mp"]), axis=0, num=3)
         assert len(outs) == 3
         assert all(o.dims_mapping == ["mp"] for o in outs)
+
+    def test_conv2d_rule(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            conv2d_rule)
+        # NCHW x dp-batch, OIHW w mp-sharded out-channels
+        x = DistAttr(["dp", None, None, None])
+        w = DistAttr(["mp", None, None, None])
+        (rx, rw), out = conv2d_rule(x, w)
+        assert out.dims_mapping == ["dp", "mp", None, None]
+        assert out.partial == set()
+        # in-channels sharded both sides -> partial (matmul semantics)
+        x2 = DistAttr([None, "mp", None, None])
+        w2 = DistAttr([None, "mp", None, None])
+        (_, _), out2 = conv2d_rule(x2, w2)
+        assert out2.partial == {"mp"}
+        assert out2.dims_mapping == [None, None, None, None]
+
+    def test_pool2d_rule(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            pool2d_rule)
+        x = DistAttr(["dp", "mp", None, None])
+        rx, out = pool2d_rule(x, (1, 1, 2, 2))
+        assert out.dims_mapping == ["dp", "mp", None, None]
+        rx2, out2 = pool2d_rule(DistAttr([None, None, "dp", None]),
+                                (1, 1, 2, 2))
+        assert out2.dims_mapping == [None, None, None, None]
+
+    def test_conv2d_grouped_no_phantom_allreduce(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            conv2d_rule)
+        # depthwise: channels sharded on x must NOT contract to partial
+        x = DistAttr([None, "mp", None, None])
+        w = DistAttr([None, None, None, None])
+        (rx, rw), out = conv2d_rule(x, w, feature_group_count=8)
+        assert out.partial == set()
+        assert rx.dims_mapping == [None, None, None, None]
