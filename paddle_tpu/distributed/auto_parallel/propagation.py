@@ -376,6 +376,36 @@ class Propagator:
                                                       set(idx.partial)))
             self._reshard("gather", x, rt, table_aval)
             return out
+        # jnp.take along one axis with a 1-D index (nearest-neighbor
+        # upsampling, index_select): slices are full on every dim but
+        # the gathered one, and the index dim lands at its position
+        out_ndim = len(out_avals[0].shape)
+        if (dn is not None and slice_sizes is not None
+                and len(dn.collapsed_slice_dims) == 1
+                and tuple(dn.start_index_map)
+                == tuple(dn.collapsed_slice_dims)
+                and len(eqn.invars[1].aval.shape) == 2
+                and eqn.invars[1].aval.shape[-1] == 1):
+            d = dn.collapsed_slice_dims[0]
+            full_elsewhere = all(
+                slice_sizes[i] == table_aval.shape[i]
+                for i in range(len(slice_sizes)) if i != d)
+            lands_at_d = (set(range(out_ndim))
+                          - set(dn.offset_dims) == {d})
+            if slice_sizes[d] == 1 and full_elsewhere and lands_at_d:
+                from .spmd_rules import index_select_rule
+                idx_attr = DistAttr([idx.dims_mapping[0]],
+                                    set(idx.partial))
+                (rt, ri), out = index_select_rule(x, idx_attr, axis=d)
+                self._reshard("gather", x, rt, table_aval)
+                # the index reshard (allgather when its sharding must
+                # drop) is part of the bill too; the real index attr
+                # carries the trailing coord dim
+                self._reshard("gather", idx,
+                              DistAttr([ri.dims_mapping[0], None],
+                                       set(ri.partial)),
+                              eqn.invars[1].aval)
+                return out
         self.unknown[eqn.primitive.name] = \
             self.unknown.get(eqn.primitive.name, 0) + 1
         return DistAttr.replicated(len(out_avals[0].shape))

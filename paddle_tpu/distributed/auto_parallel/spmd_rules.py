@@ -783,8 +783,11 @@ def index_select_rule(x: DistAttr, index: DistAttr, axis: int = 0
     rx = DistAttr(dm, set(x.partial))
     out = list(dm)
     idx_axis = index.dims_mapping[0] if index.ndim else None
-    if idx_axis in {a for a in dm if a is not None}:
-        idx_axis = None        # x's surviving dims claimed it first
+    # one mesh axis can neither shard two output dims nor shard a dim
+    # AND carry a partial (same invariant as embedding_rule)
+    if idx_axis in {a for a in dm if a is not None} \
+            or idx_axis in x.partial:
+        idx_axis = None
     out[ax] = idx_axis
     ri = DistAttr([idx_axis] if index.ndim else [],
                   set(index.partial))

@@ -342,3 +342,42 @@ class TestVisionPropagation:
         assert rep.unknown_prims == {}, rep.unknown_prims
         (out,) = rep.out_attrs
         assert out.dims_mapping[0] == "dp"
+
+    def test_unet_propagates_no_unknowns(self):
+        """The diffusion UNet (convs, pooling, nearest-neighbor
+        upsample gathers, cross-attention) propagates with zero
+        unknown prims — with llama/bert/ernie/resnet this covers every
+        BASELINE model family."""
+        import warnings
+
+        import paddle_tpu as paddle
+        from paddle_tpu.framework import core
+        from paddle_tpu.models.unet import (UNet2DConditionModel,
+                                            unet_tiny)
+        from paddle_tpu.tensor import Tensor
+
+        paddle.seed(0)
+        cfg = unet_tiny()
+        model = UNet2DConditionModel(cfg)
+        model.eval()
+        keys = sorted(model.state_dict())
+        vals = [model.state_dict()[k].data for k in keys]
+
+        def fwd(inp, tt, cc, *vs):
+            st = dict(zip(keys, vs))
+            with model.use_state(st), core.no_grad_guard():
+                return model(Tensor(inp), Tensor(tt), Tensor(cc)).data
+
+        x = jnp.zeros((2, cfg.in_channels, 32, 32), jnp.float32)
+        t = jnp.zeros((2,), jnp.int32)
+        ctx = jnp.zeros((2, 8, cfg.cross_attention_dim), jnp.float32)
+        attrs = [DistAttr(["dp", None, None, None]), DistAttr(["dp"]),
+                 DistAttr(["dp", None, None])] + [
+            DistAttr.replicated(v.ndim) for v in vals]
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            rep = propagate_jaxpr(fwd, (x, t, ctx, *vals), attrs,
+                                  MESH_SHAPE)
+        assert rep.unknown_prims == {}, rep.unknown_prims
+        (out,) = rep.out_attrs
+        assert out.dims_mapping[0] == "dp"
