@@ -75,14 +75,17 @@ def _concrete_bool(c):
 
 def run_while(cond_fn, body_fn, vars_tuple):
     """`while cond: body` over carried `vars_tuple`. Traced tensor
-    condition -> lax.while_loop (one executable); concrete -> Python."""
-    c0 = cond_fn(*vars_tuple)
-    cb = _concrete_bool(c0)
-    if cb is not None:
+    condition -> lax.while_loop (one executable); concrete -> Python.
+    A condition that STARTS concrete but turns traced mid-loop (a
+    lowered break flag becomes a tensor after the first lax.cond)
+    continues under lax from the current carry — the already-run
+    iterations stay unrolled in the trace."""
+    cb = _concrete_bool(cond_fn(*vars_tuple))
+    while cb:
         # concrete condition: plain Python loop (eager or static-trip)
-        while cb:
-            vars_tuple = tuple(body_fn(*vars_tuple))
-            cb = bool(_unbox(cond_fn(*vars_tuple)))
+        vars_tuple = tuple(body_fn(*vars_tuple))
+        cb = _concrete_bool(cond_fn(*vars_tuple))
+    if cb is not None:
         return vars_tuple
     templates = vars_tuple
 
@@ -404,6 +407,93 @@ class _CtrlFlow(ast.NodeTransformer):
              ast.Name(id=f"__ds_body_{i}", ctx=ast.Load())], carried)
         self.rewrote = True
         return pre + [cond_fn, body_fn, assign]
+
+    def visit_For(self, node: ast.For):
+        """Lower `for <name> in range(...)` to the while form (ref:
+        dy2static/transformers/loop_transformer.py) so tensor trip
+        counts compile into lax.while_loop and break/continue reuse the
+        flag lowering. The increment runs at the TOP of the body
+        (iterator seeded at start-step) so a lowered `continue` — which
+        guards every statement after it — cannot skip the increment.
+        Non-range iterables, tuple targets, for/else, and dynamic
+        step signs keep Python semantics."""
+        a = node.iter.args if isinstance(node.iter, ast.Call) else None
+
+        def const_int(n):
+            # range steps must be INT literals (a float step is a
+            # TypeError in real range); negative literals parse as
+            # UnaryOp(USub, Constant)
+            if isinstance(n, ast.Constant) and isinstance(n.value, int) \
+                    and not isinstance(n.value, bool):
+                return n.value
+            if isinstance(n, ast.UnaryOp) and isinstance(n.op, ast.USub):
+                v = const_int(n.operand)
+                return -v if v is not None else None
+            return None
+
+        step_node = (a[2] if a is not None and len(a) == 3
+                     else ast.Constant(value=1))
+        step_val = const_int(step_node)
+        if (node.orelse or not isinstance(node.target, ast.Name)
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or node.iter.keywords
+                or a is None or not 1 <= len(a) <= 3
+                or any(isinstance(x, ast.Starred) for x in a)
+                or step_val in (None, 0)):
+            self.generic_visit(node)
+            return node
+        start = a[0] if len(a) >= 2 else ast.Constant(value=0)
+        stop = a[1] if len(a) >= 2 else a[0]
+        k = self.n
+        self.n += 1
+        # single-underscore prefix: these are ORDINARY loop state that
+        # must join the while carry (the __ds_ prefix is excluded from
+        # carries as closure-name namespace)
+        it, stop_n = f"_ds_it_{k}", f"_ds_stop_{k}"
+
+        def name(n, ctx):
+            return ast.Name(id=n, ctx=ctx)
+
+        def step_const():
+            return ast.Constant(value=step_val)
+
+        cmp_op = ast.Lt() if step_val > 0 else ast.Gt()
+        seed = ast.BinOp(left=start, op=ast.Sub(), right=step_const())
+        # the target must be bound before the loop (it joins the while
+        # carry) — but ONLY seed it when currently unbound: an empty
+        # range must leave a pre-existing binding untouched
+        target_seed = ast.Try(
+            body=[ast.Expr(value=name(node.target.id, ast.Load()))],
+            handlers=[ast.ExceptHandler(
+                type=ast.Name(id="NameError", ctx=ast.Load()),
+                name=None,
+                body=[ast.Assign(
+                    targets=[name(node.target.id, ast.Store())],
+                    value=name(it, ast.Load()))])],
+            orelse=[], finalbody=[])
+        init = [
+            ast.Assign(targets=[name(it, ast.Store())], value=seed),
+            ast.Assign(targets=[name(stop_n, ast.Store())], value=stop),
+            target_seed,
+        ]
+        body = [
+            ast.Assign(targets=[name(it, ast.Store())],
+                       value=ast.BinOp(left=name(it, ast.Load()),
+                                       op=ast.Add(),
+                                       right=step_const())),
+            ast.Assign(targets=[name(node.target.id, ast.Store())],
+                       value=name(it, ast.Load())),
+        ] + node.body
+        test = ast.Compare(
+            left=ast.BinOp(left=name(it, ast.Load()), op=ast.Add(),
+                           right=step_const()),
+            ops=[cmp_op], comparators=[name(stop_n, ast.Load())])
+        wh = ast.While(test=test, body=body, orelse=[])
+        lowered = self.visit_While(wh)
+        return init + (lowered if isinstance(lowered, list)
+                       else [lowered])
 
     def visit_If(self, node: ast.If):
         self.generic_visit(node)

@@ -381,3 +381,38 @@ class TestVisionPropagation:
         assert rep.unknown_prims == {}, rep.unknown_prims
         (out,) = rep.out_attrs
         assert out.dims_mapping[0] == "dp"
+
+    def test_conv_agreement_with_gspmd(self):
+        """GSPMD's compiled decision for a dp-sharded conv+pool stack
+        must agree with the conv/pool rules: batch stays on dp, no
+        collectives needed (weights replicated)."""
+        def cnn(x, w1, w2):
+            h = jax.lax.conv_general_dilated(
+                x, w1, (1, 1), "SAME",
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            h = jax.nn.relu(h)
+            h = jax.lax.reduce_window(
+                h, -jnp.inf, jax.lax.max, (1, 1, 2, 2), (1, 1, 2, 2),
+                "VALID")
+            h = jax.lax.conv_general_dilated(
+                h, w2, (1, 1), "SAME",
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            return h.mean(axis=(2, 3))
+
+        x = jnp.zeros((4, 8, 16, 16), jnp.float32)
+        w1 = jnp.zeros((16, 8, 3, 3), jnp.float32)
+        w2 = jnp.zeros((32, 16, 3, 3), jnp.float32)
+        attrs = [DistAttr(["dp", None, None, None]),
+                 DistAttr.replicated(4), DistAttr.replicated(4)]
+        rep = propagate_jaxpr(cnn, (x, w1, w2), attrs, MESH_SHAPE)
+        assert rep.unknown_prims == {}, rep.unknown_prims
+        rule_out = rep.out_attrs[0]
+        assert rule_out.dims_mapping == ["dp", None]
+        assert rule_out.partial == set()
+        assert rep.total_reshard_bytes == 0.0
+
+        creport = complete(cnn, (x, w1, w2), _mesh(),
+                           in_specs=[P("dp"), P(), P()])
+        gspmd_spec = creport.output_spec(0) or P()
+        dims = list(gspmd_spec) + [None] * (2 - len(gspmd_spec))
+        assert dims[0] == "dp" and dims[1] is None
