@@ -228,6 +228,24 @@ def _locally_initialized_flags(stmts: List[ast.stmt]) -> Set[str]:
 
 # ---------------- break/continue pre-lowering ------------------------------
 
+def _contains_raw_loop(stmts: List[ast.stmt]) -> bool:
+    """Any un-lowered for/while remaining in these statements (not
+    inside nested function bodies, which own their locals). Such a
+    loop stores names that are typically body-local — carrying them
+    would reference unbound names before the enclosing loop."""
+    for s in stmts:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        if isinstance(s, (ast.While, ast.For, ast.AsyncFor)):
+            return True
+        for field in ("body", "orelse", "finalbody"):
+            inner = getattr(s, field, None)
+            if inner and _contains_raw_loop(inner):
+                return True
+    return False
+
+
 def _has_break_continue(stmts: List[ast.stmt]) -> bool:
     """Break/Continue belonging to THIS loop level (descends into ifs
     and try blocks, never into nested loops or function defs)."""
@@ -291,9 +309,21 @@ def _rewrite_break_continue(stmts: List[ast.stmt], brk: str, cont: str):
 # ---------------- the transformer ------------------------------------------
 
 class _CtrlFlow(ast.NodeTransformer):
-    def __init__(self):
+    def __init__(self, allow_range_lowering=True):
         self.n = 0
         self.rewrote = False
+        # for-range lowering is sound only for TOP-LEVEL loops: the
+        # synthesized iterator/seed assignments live inside an
+        # enclosing construct's body and would join its carry unbound
+        self._depth = 0
+        self._allow_range = allow_range_lowering
+
+    def _visit_children(self, node):
+        self._depth += 1
+        try:
+            self.generic_visit(node)
+        finally:
+            self._depth -= 1
 
     def _carried(self, analyses, keep_flags=True) -> Optional[List[str]]:
         stores: Set[str] = set()
@@ -359,7 +389,7 @@ class _CtrlFlow(ast.NodeTransformer):
                 # rewrite (it only descends into ifs) — lowering now
                 # would emit a bare `break` outside any loop; keep the
                 # original node so it falls to SOT
-                self.generic_visit(node)
+                self._visit_children(node)
                 return node
             # cont resets every iteration; brk persists in the carry.
             # The original test is wrapped in a LAZY thunk: a taken
@@ -381,8 +411,13 @@ class _CtrlFlow(ast.NodeTransformer):
             test = node.test
             pre = [_flag_assign(brk, False), _flag_assign(cont, False)]
             flags = [brk, cont]
-        self.generic_visit(node)
+        self._visit_children(node)
         if node.orelse:
+            return node
+        if _contains_raw_loop(node.body):
+            # an un-lowered nested loop stores body-local names the
+            # carry would reference unbound before this loop — keep
+            # Python semantics (whole-trace unroll or SOT)
             return node
         body_a = _analyze(node.body)
         test_a = _analyze([ast.Expr(value=test)])
@@ -441,8 +476,9 @@ class _CtrlFlow(ast.NodeTransformer):
                 or node.iter.keywords
                 or a is None or not 1 <= len(a) <= 3
                 or any(isinstance(x, ast.Starred) for x in a)
-                or step_val in (None, 0)):
-            self.generic_visit(node)
+                or step_val in (None, 0)
+                or self._depth > 0 or not self._allow_range):
+            self._visit_children(node)
             return node
         start = a[0] if len(a) >= 2 else ast.Constant(value=0)
         stop = a[1] if len(a) >= 2 else a[0]
@@ -463,7 +499,13 @@ class _CtrlFlow(ast.NodeTransformer):
         seed = ast.BinOp(left=start, op=ast.Sub(), right=step_const())
         # the target must be bound before the loop (it joins the while
         # carry) — but ONLY seed it when currently unbound: an empty
-        # range must leave a pre-existing binding untouched
+        # range must leave a pre-existing binding untouched, and a
+        # prior of another dtype must stay visible (a lax carry
+        # mismatch fails LOUDLY and to_static falls back — better than
+        # silently replacing the value). Known deviation (the
+        # reference's UndefinedVar dummies behave the same way): a
+        # previously-UNBOUND target read after an EMPTY range sees
+        # start-step instead of raising UnboundLocalError.
         target_seed = ast.Try(
             body=[ast.Expr(value=name(node.target.id, ast.Load()))],
             handlers=[ast.ExceptHandler(
@@ -496,7 +538,7 @@ class _CtrlFlow(ast.NodeTransformer):
                        else [lowered])
 
     def visit_If(self, node: ast.If):
-        self.generic_visit(node)
+        self._visit_children(node)
         body_a = _analyze(node.body)
         else_a = _analyze(node.orelse)
         carried = self._carried([body_a, else_a])
@@ -542,7 +584,13 @@ def ast_rewrite(fn):
     if not isinstance(fndef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return None
     fndef.decorator_list = []
-    tr = _CtrlFlow()
+    # for-range lowering assumes `range` is the builtin — a local,
+    # closure, or module-global shadow would be silently mis-lowered
+    code = raw.__code__
+    range_is_builtin = ("range" not in code.co_varnames
+                        and "range" not in code.co_freevars
+                        and "range" not in raw.__globals__)
+    tr = _CtrlFlow(allow_range_lowering=range_is_builtin)
     tr.visit(fndef)
     if not tr.rewrote:
         return None

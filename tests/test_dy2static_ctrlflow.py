@@ -530,3 +530,58 @@ class TestForRangeLowering:
             return s
 
         assert ast_rewrite(f_float) is None  # python semantics kept
+
+    def test_nested_for_keeps_python_semantics(self):
+        """for-range lowering is top-level-only: the synthesized
+        iterator names cannot soundly join an enclosing carry. Nested
+        loops stay Python (correct results, fallback allowed)."""
+        def fn(x):
+            s = x
+            for i in range(2):
+                for j in range(3):
+                    s = s + 1.0
+            return s
+
+        from paddle_tpu.jit.dy2static import ast_rewrite
+        new = ast_rewrite(fn)
+        a = np.zeros((2,), np.float32)
+        if new is not None:      # must not crash if returned
+            np.testing.assert_allclose(
+                np.asarray(new(paddle.to_tensor(a)).numpy()),
+                [6.0, 6.0])
+        f = paddle.jit.to_static(fn)
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor(a)).numpy()), [6.0, 6.0])
+
+    def test_shadowed_range_not_lowered(self):
+        from paddle_tpu.jit.dy2static import ast_rewrite
+
+        def fn(x):
+            range = lambda n: [10, 20]           # noqa: A001
+            s = x
+            for i in range(2):
+                s = s + float(i)
+            return s
+
+        assert ast_rewrite(fn) is None
+        out = fn(paddle.to_tensor(np.zeros(2, np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [30.0, 30.0])
+
+    def test_mismatched_prior_binding_falls_back_loudly(self):
+        """A float prior binding cannot carry an int iterator through
+        a lax carry: the lowered variant fails LOUDLY (no silent value
+        replacement) and to_static falls back to correct semantics."""
+        def fn(x, n):
+            i = 0.5
+            s = x
+            for i in range(n):
+                s = s * 2.0
+            return s
+
+        f = paddle.jit.to_static(fn)
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = f(paddle.to_tensor(np.ones(2, np.float32)),
+                    paddle.to_tensor(np.int32(3)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [8.0, 8.0])

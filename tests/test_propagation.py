@@ -416,3 +416,32 @@ class TestVisionPropagation:
         gspmd_spec = creport.output_spec(0) or P()
         dims = list(gspmd_spec) + [None] * (2 - len(gspmd_spec))
         assert dims[0] == "dp" and dims[1] is None
+
+    def test_ernie_propagates_no_unknowns(self):
+        import warnings
+
+        import paddle_tpu as paddle
+        from paddle_tpu.framework import core
+        from paddle_tpu.models.ernie import (ErnieForPretraining,
+                                             ernie_tiny)
+        from paddle_tpu.tensor import Tensor
+
+        paddle.seed(0)
+        model = ErnieForPretraining(ernie_tiny())
+        model.eval()
+        keys = sorted(model.state_dict())
+        vals = [model.state_dict()[k].data for k in keys]
+
+        def fwd(ids, *vs):
+            st = dict(zip(keys, vs))
+            with model.use_state(st), core.no_grad_guard():
+                return model(Tensor(ids)).data
+
+        ids = jnp.zeros((4, 16), jnp.int32)
+        attrs = [DistAttr(["dp", None])] + [
+            DistAttr.replicated(v.ndim) for v in vals]
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            rep = propagate_jaxpr(fwd, (ids, *vals), attrs, MESH_SHAPE)
+        assert rep.unknown_prims == {}, rep.unknown_prims
+        assert rep.out_attrs[0].dims_mapping[0] == "dp"
