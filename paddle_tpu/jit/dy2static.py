@@ -15,7 +15,10 @@ done/skip flags (ref: dy2static/transformers/break_continue_transformer
 .py rewrites them into bool flag variables + guarded blocks): the loop
 condition becomes `not brk and test`, statements after a potential
 break/continue are wrapped in a flag-guarded `if`, and the flags join
-the lax.while_loop carry. Constructs the rewrite cannot lower soundly
+the lax.while_loop carry. Top-level `for i in range(...)` (int-literal
+step, builtin range only) rewrites into the same while form with an
+increment-first body, so tensor trip counts and break/continue work
+there too. Constructs the rewrite cannot lower soundly
 (return in the body, attribute/subscript stores, loop else-clauses,
 a carried name first bound inside the loop body — nothing to seed the
 lax carry with, the reference papers over this with UndefinedVar
