@@ -397,6 +397,19 @@ def main():
     # qkv/gate/up matmul layouts (the session's layout A/B lever —
     # the fused layouts landed post-r2 without an on-chip number)
     fuse = bool(int(os.environ.get("BENCH_FUSE_QKV_MLP", "1")))
+
+    def _kernel_routes(cfg, batch, seq):
+        """What actually RAN: the kernels' own eligibility gates at the
+        bench shapes (flag AND backend AND shape), not raw flags."""
+        from paddle_tpu.kernels import cross_entropy as _ce
+        from paddle_tpu.kernels import flash_attention as _fa
+        qkv = (batch, seq, cfg.num_attention_heads, cfg.head_dim)
+        kv = (batch, seq, cfg.kv_heads, cfg.head_dim)
+        return {
+            "fused_ce": bool(_ce.supported(cfg.vocab_size)),
+            "flash_attention": bool(_fa.supported(qkv, kv, True)),
+            "fused_qkv_mlp": bool(fuse),
+        }
     cfg = {"tiny": L.llama_tiny, "350m": L.llama_350m,
            "1b": L.llama_1b, "7b": L.llama_7b}[size](
         use_recompute=remat, fuse_attention_qkv=fuse, fuse_mlp=fuse)
@@ -456,6 +469,9 @@ def main():
             "n_params": n_params, "n_chips": n_chips,
             "compiles_in_timed_loop": n_compiles_timed,
             "device": getattr(devs[0], "device_kind", devs[0].platform),
+            # self-describing kernel routes: r2 measured with XLA CE,
+            # r3/r4 with fused CE — artifacts must say which ran
+            "kernel_routes": _kernel_routes(cfg, batch, seq),
         },
     }, on_tpu)
 
