@@ -38,6 +38,7 @@ _ELEMENTWISE = {
     "erf", "erfc", "erf_inv", "integer_pow", "not", "is_finite",
     "select_n", "clamp", "nextafter", "real", "imag", "conj",
     "convert_element_type", "stop_gradient", "copy", "square",
+    "add_any",   # transpose-rule gradient accumulation (same as add)
 }
 
 _REDUCE = {"reduce_sum": True, "reduce_max": False, "reduce_min": False,
@@ -290,6 +291,40 @@ class Propagator:
             self._reshard(name, ins[1], rop, avals[1])
             out = DistAttr(list(out.dims_mapping),
                            set(out.partial) | set(rsrc.partial))
+        elif name == "scatter-add":
+            dnum = eqn.params.get("dimension_numbers")
+            sdims = tuple(getattr(dnum, "scatter_dims_to_operand_dims",
+                                  ()) or ())
+            obatch = tuple(getattr(dnum, "operand_batching_dims",
+                                   ()) or ())
+            x_, idx_, upd_ = ins
+            if sdims == (0,) and not obatch \
+                    and upd_.ndim >= x_.ndim - 1:
+                # embedding backward: summed table PARTIAL over axes
+                # sharding the updates' batch dims
+                from .spmd_rules import scatter_add_rule
+                (rx, ri, ru), out = scatter_add_rule(x_, idx_, upd_)
+                self._reshard(name, x_, rx, avals[0])
+                self._reshard(name, idx_, ri, avals[1])
+                self._reshard(name, upd_, ru, avals[2])
+            elif x_.ndim == 2 and upd_.ndim == 2 \
+                    and sdims == (1,) and obatch == (0,):
+                # take_along_axis backward (per-row scatter along dim
+                # 1, rows batched): dim 0 carries the merged row
+                # sharding, the scattered dim replicates — NO partial
+                from .spmd_rules import take_along_axis_rule
+                (rx, ru), o = take_along_axis_rule(x_, upd_, axis=1)
+                self._reshard(name, x_, rx, avals[0])
+                self._reshard(name, upd_, ru, avals[2])
+                out = DistAttr([o.dims_mapping[0], None],
+                               set(o.partial))
+            else:
+                # unrecognized scatter layout: honest replicated
+                # fallback, counted as unknown
+                self.unknown[name] = self.unknown.get(name, 0) + 1
+                for v in eqn.outvars:
+                    env[v] = DistAttr.replicated(len(v.aval.shape))
+                return
         elif name == "gather":
             out = self._gather(eqn, ins, avals, out_avals)
         elif name == "iota":
@@ -365,6 +400,7 @@ class Propagator:
         if (dn is not None and slice_sizes is not None
                 and tuple(dn.collapsed_slice_dims) == (0,)
                 and tuple(dn.start_index_map) == (0,)
+                and not getattr(dn, "operand_batching_dims", ())
                 and slice_sizes[0] == 1
                 and tuple(slice_sizes[1:]) == tuple(table_aval.shape[1:])
                 and x.ndim == 2):
@@ -384,6 +420,7 @@ class Propagator:
                 and len(dn.collapsed_slice_dims) == 1
                 and tuple(dn.start_index_map)
                 == tuple(dn.collapsed_slice_dims)
+                and not getattr(dn, "operand_batching_dims", ())
                 and len(eqn.invars[1].aval.shape) == 2
                 and eqn.invars[1].aval.shape[-1] == 1):
             d = dn.collapsed_slice_dims[0]
@@ -406,6 +443,29 @@ class Propagator:
                                        set(ri.partial)),
                               eqn.invars[1].aval)
                 return out
+        # per-row pick: take_along_axis(x[N, V], idx[N, 1], axis=1) —
+        # the cross-entropy label gather. Index batch dim aligns with
+        # the operand's row dim; the picked dim must replicate.
+        idx_shape = tuple(eqn.invars[1].aval.shape)
+        if (dn is not None and slice_sizes is not None
+                and x.ndim == 2
+                and tuple(dn.collapsed_slice_dims) == (1,)
+                and tuple(dn.start_index_map) == (1,)
+                and tuple(getattr(dn, "operand_batching_dims",
+                                  ()) or ()) == (0,)
+                and tuple(getattr(dn, "start_indices_batching_dims",
+                                  ()) or ()) == (0,)
+                and tuple(slice_sizes) == (1, 1)
+                and len(idx_shape) >= 2 and idx_shape[-1] == 1
+                and idx_shape[0] == table_aval.shape[0]):
+            from .spmd_rules import take_along_axis_rule
+            idx2 = DistAttr([idx.dims_mapping[0], None],
+                            set(idx.partial))
+            (rx, ri), out = take_along_axis_rule(x, idx2, axis=1)
+            self._reshard("gather", x, rx, table_aval)
+            dm = list(out.dims_mapping)[:out_ndim] \
+                + [None] * max(0, out_ndim - out.ndim)
+            return DistAttr(dm, set(out.partial))
         self.unknown[eqn.primitive.name] = \
             self.unknown.get(eqn.primitive.name, 0) + 1
         return DistAttr.replicated(len(out_avals[0].shape))

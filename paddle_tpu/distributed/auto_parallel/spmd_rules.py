@@ -25,7 +25,8 @@ __all__ = ["DistAttr", "matmul_rule", "embedding_rule", "layer_norm_rule",
            "flash_attention_rule", "elementwise_rule", "reduction_rule",
            "softmax_rule", "transpose_rule", "reshape_rule", "concat_rule",
            "split_rule", "slice_rule", "cross_entropy_rule",
-           "fused_rope_rule", "scatter_rule", "squeeze_rule",
+           "fused_rope_rule", "scatter_rule", "scatter_add_rule",
+           "squeeze_rule",
            "unsqueeze_rule", "flatten_rule", "stack_rule", "tile_rule",
            "triu_rule", "where_rule", "cast_rule", "scale_rule",
            "pow_rule", "full_like_rule", "numel_rule", "rms_norm_rule",
@@ -440,6 +441,48 @@ def scatter_rule(x: DistAttr, index: DistAttr, updates: DistAttr
     out = DistAttr([None] + tail,
                    set(x.partial) | set(updates.partial))
     return (rx, ridx, rupd), out
+
+
+def scatter_add_rule(x: DistAttr, index: DistAttr, updates: DistAttr
+                     ) -> Tuple[Tuple[DistAttr, DistAttr, DistAttr],
+                                DistAttr]:
+    """ref: spmd_rules/scatter (additive combiner — the embedding
+    BACKWARD, rows scattered into x's dim 0): rows land data-
+    dependently, so x's dim 0 replicates; but unlike overwrite-scatter
+    a SHARDED updates batch dim is legal — each shard adds its own
+    rows and the summed table comes out PARTIAL over that axis.
+    Trailing dims merge right-aligned; the index reshards to the
+    updates' batch layout (its rows pair with update rows). Requires
+    updates.ndim >= x.ndim - 1 (callers route lower-rank forms to the
+    replicated fallback)."""
+    nd = x.ndim
+    n_tail = nd - 1
+    if updates.ndim < n_tail:
+        raise ValueError(
+            f"scatter_add_rule: updates rank {updates.ndim} cannot "
+            f"cover {n_tail} trailing dims of the {nd}-d operand")
+    upd_batch = list(updates.dims_mapping[:updates.ndim - n_tail])
+    upd_tail = updates.dims_mapping[updates.ndim - n_tail:]
+    tail = [_merge(x.dims_mapping[1 + i], upd_tail[i])
+            for i in range(n_tail)]
+    used = {a for a in tail if a is not None}
+    batch: List[Optional[str]] = []
+    for a in upd_batch:
+        # an axis cannot shard two dims of the same tensor
+        if a is not None and a in used:
+            a = None
+        elif a is not None:
+            used.add(a)
+        batch.append(a)
+    partial = set(x.partial) | set(updates.partial) | {
+        a for a in batch if a is not None}
+    rx = DistAttr([None] + tail, set(x.partial))
+    rupd = DistAttr(batch + tail, set(updates.partial))
+    # index rows pair with update rows: same batch layout, trailing
+    # coord dims replicated
+    ridx = DistAttr((batch + [None] * index.ndim)[:index.ndim],
+                    set(index.partial))
+    return (rx, ridx, rupd), DistAttr([None] + tail, partial)
 
 
 def squeeze_rule(x: DistAttr, axes: Sequence[int]
@@ -1062,6 +1105,7 @@ _FORWARD_RULES = {
     "cross_entropy": cross_entropy_rule,
     "fused_rope": fused_rope_rule,
     "scatter": scatter_rule,
+    "scatter_add": scatter_add_rule,
     # round-4 tail: full parity with the reference registry
     # (phi/infermeta/spmd_rules/: 31 rule families)
     "squeeze": squeeze_rule,

@@ -445,3 +445,109 @@ class TestVisionPropagation:
             rep = propagate_jaxpr(fwd, (ids, *vals), attrs, MESH_SHAPE)
         assert rep.unknown_prims == {}, rep.unknown_prims
         assert rep.out_attrs[0].dims_mapping[0] == "dp"
+
+    def test_llama_train_graph_propagates_no_unknowns(self):
+        """The BACKWARD graph too (the planner prices TRAIN steps):
+        jax.grad of the llama loss propagates with zero unknown prims —
+        covering add_any grad accumulation, the embedding-backward
+        scatter-add (PARTIAL over the sharded batch axis), and the CE
+        label pick (take_along_axis gather)."""
+        import warnings
+
+        import jax.tree_util as jtu
+
+        import paddle_tpu as paddle
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+        from paddle_tpu.tensor import Parameter, Tensor
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny(use_recompute=False))
+        keys = sorted(model.state_dict())
+        pkeys = [k for k in keys
+                 if isinstance(model.state_dict()[k], Parameter)
+                 and not model.state_dict()[k].stop_gradient]
+        state = {k: model.state_dict()[k].data for k in keys}
+        params = {k: state[k] for k in pkeys}
+        other = {k: v for k, v in state.items() if k not in pkeys}
+
+        def loss_of(p, ids):
+            st = dict(other)
+            st.update(p)
+            with model.use_state(st):
+                return model.loss(Tensor(ids), Tensor(ids)).data
+
+        flat, treedef = jtu.tree_flatten(params)
+
+        def grad_flat(*args):
+            p = jtu.tree_unflatten(treedef, args[:-1])
+            return jax.grad(loss_of)(p, args[-1])
+
+        ids = jnp.zeros((4, 16), jnp.int32)
+        attrs = [DistAttr.replicated(v.ndim) for v in flat] + [
+            DistAttr(["dp", None])]
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            rep = propagate_jaxpr(grad_flat, (*flat, ids), attrs,
+                                  MESH_SHAPE)
+        assert rep.unknown_prims == {}, rep.unknown_prims
+
+    def test_scatter_add_partial_over_update_batch(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            scatter_add_rule)
+        # embedding backward: table [V, H], updates [N, H] dp-sharded
+        table = DistAttr([None, "mp"])
+        idx = DistAttr(["dp", None])
+        upd = DistAttr(["dp", "mp"])
+        (rt, ri, ru), out = scatter_add_rule(table, idx, upd)
+        assert out.dims_mapping == [None, "mp"]
+        assert "dp" in out.partial          # summed across dp shards
+        assert ru.dims_mapping == ["dp", "mp"]   # NO update reshard
+
+    def test_take_along_axis_backward_sharded_not_partial(self):
+        """The CE label-pick backward (per-row scatter-add along dim 1
+        with batched rows) must carry the dp row sharding with NO
+        partial — it is not the embedding-style dim-0 scatter."""
+        import warnings
+
+        def f(x, idx, ct):
+            _, vjp = jax.vjp(
+                lambda a: jnp.take_along_axis(a, idx, axis=1), x)
+            return vjp(ct)[0]
+
+        x = jnp.zeros((8, 16), jnp.float32)
+        idx = jnp.zeros((8, 1), jnp.int32)
+        ct = jnp.zeros((8, 1), jnp.float32)    # dp-sharded cotangent
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            rep = propagate_jaxpr(
+                f, (x, idx, ct),
+                [DistAttr(["dp", None]), DistAttr(["dp", None]),
+                 DistAttr(["dp", None])],
+                MESH_SHAPE)
+        assert rep.unknown_prims == {}
+        (out,) = rep.out_attrs
+        assert out.dims_mapping == ["dp", None]
+        assert out.partial == set()
+
+    def test_embedding_backward_partial_over_dp(self):
+        """Embedding backward: the scattered table grad is PARTIAL
+        over the axis sharding the token batch."""
+        import warnings
+
+        def f(tbl, ids, upd):
+            return tbl.at[ids].add(upd)
+
+        tbl = jnp.zeros((64, 8), jnp.float32)
+        ids = jnp.zeros((16,), jnp.int32)
+        upd = jnp.zeros((16, 8), jnp.float32)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            rep = propagate_jaxpr(
+                f, (tbl, ids, upd),
+                [DistAttr.replicated(2), DistAttr(["dp"]),
+                 DistAttr(["dp", None])],
+                MESH_SHAPE)
+        assert rep.unknown_prims == {}
+        (out,) = rep.out_attrs
+        assert "dp" in out.partial
+        assert out.dims_mapping == [None, None]
