@@ -253,6 +253,11 @@ _flags: dict = {
     "FLAGS_cudnn_deterministic": False,
     "FLAGS_cpu_deterministic": False,
     "FLAGS_embedding_deterministic": 0,
+    # -- eager dispatch cache (consumed by autograd/tape.apply_op): the
+    # compile-once fast path for repeated eager ops; 0 restores the
+    # per-call jax.vjp re-trace (kill switch for debugging)
+    "FLAGS_eager_dispatch_cache": True,
+    "FLAGS_eager_dispatch_cache_size": 1024,   # LRU bound (entries)
     # -- autotune (consumed by kernels/autotune.sweeps_enabled) --------
     "FLAGS_use_autotune": True,
     # kernel-route kill switches (the on-chip ablation levers; analog of
@@ -330,6 +335,13 @@ def _apply_flag(key, value):
             "false" if value == "auto_growth" else "true")
     elif key == "FLAGS_check_nan_inf_level":
         _flags["FLAGS_check_nan_inf_warn_only"] = bool(int(value) >= 1)
+    elif key == "FLAGS_eager_dispatch_cache_size":
+        from ..autograd import tape  # late: tape imports this module
+        tape._dispatch_cache.resize(int(value))
+    elif key == "FLAGS_eager_dispatch_cache" and value in _FALSY:
+        # disabling also drops the cached executables (debugging hygiene)
+        from ..autograd import tape
+        tape.clear_dispatch_cache()
 
 
 def set_flags(flags: dict):

@@ -24,8 +24,56 @@ __all__ = [
 ]
 
 
-def _unary(fn, x, name=""):
-    return apply_op(fn, to_tensor_like(x), name=name)
+def _unary(fn, x, name="", **sk):
+    return apply_op(fn, to_tensor_like(x), name=name, **sk)
+
+
+# Parameterized activations route through module-level kernels with the
+# parameter as a keyword-only static kwarg — a per-call closure would defeat
+# the eager dispatch cache (tape.apply_op keys on callable code identity).
+
+def _elu_k(a, *, alpha):
+    return jax.nn.elu(a, alpha)
+
+
+def _selu_k(a, *, scale, alpha):
+    return scale * jnp.where(a > 0, a, alpha * jnp.expm1(a))
+
+
+def _celu_k(a, *, alpha):
+    return jax.nn.celu(a, alpha)
+
+
+def _gelu_k(a, *, approximate):
+    return jax.nn.gelu(a, approximate=approximate)
+
+
+def _hardsigmoid_k(a, *, slope, offset):
+    return jnp.clip(slope * a + offset, 0.0, 1.0)
+
+
+def _hardswish_k(a):
+    return a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0
+
+
+def _hardtanh_k(a, *, mn, mx):
+    return jnp.clip(a, mn, mx)
+
+
+def _hardshrink_k(a, *, threshold):
+    return jnp.where(jnp.abs(a) > threshold, a, 0.0)
+
+
+def _softshrink_k(a, *, threshold):
+    return jnp.sign(a) * jnp.maximum(jnp.abs(a) - threshold, 0.0)
+
+
+def _tanhshrink_k(a):
+    return a - jnp.tanh(a)
+
+
+def _leaky_relu_k(a, *, slope):
+    return jax.nn.leaky_relu(a, slope)
 
 
 def relu(x, name=None):
@@ -41,7 +89,7 @@ def relu6(x, name=None):
 
 
 def elu(x, alpha=1.0, name=None):
-    return _unary(lambda a: jax.nn.elu(a, alpha), x, "elu")
+    return _unary(_elu_k, x, "elu", alpha=alpha)
 
 
 def elu_(x, alpha=1.0, name=None):
@@ -49,16 +97,15 @@ def elu_(x, alpha=1.0, name=None):
 
 
 def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
-    return _unary(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
-                  x, "selu")
+    return _unary(_selu_k, x, "selu", scale=scale, alpha=alpha)
 
 
 def celu(x, alpha=1.0, name=None):
-    return _unary(lambda a: jax.nn.celu(a, alpha), x, "celu")
+    return _unary(_celu_k, x, "celu", alpha=alpha)
 
 
 def gelu(x, approximate=False, name=None):
-    return _unary(lambda a: jax.nn.gelu(a, approximate=approximate), x, "gelu")
+    return _unary(_gelu_k, x, "gelu", approximate=bool(approximate))
 
 
 def silu(x, name=None):
@@ -74,42 +121,53 @@ def sigmoid(x, name=None):
 
 
 def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
-    return _unary(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x)
+    return _unary(_hardsigmoid_k, x, slope=slope, offset=offset)
 
 
 def hardswish(x, name=None):
-    return _unary(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x)
+    return _unary(_hardswish_k, x)
 
 
 def hardtanh(x, min=-1.0, max=1.0, name=None):
-    return _unary(lambda a: jnp.clip(a, min, max), x)
+    return _unary(_hardtanh_k, x, mn=min, mx=max)
 
 
 def hardshrink(x, threshold=0.5, name=None):
-    return _unary(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+    return _unary(_hardshrink_k, x, threshold=threshold)
 
 
 def softshrink(x, threshold=0.5, name=None):
-    return _unary(lambda a: jnp.sign(a) * jnp.maximum(jnp.abs(a) - threshold, 0.0), x)
+    return _unary(_softshrink_k, x, threshold=threshold)
 
 
 def tanhshrink(x, name=None):
-    return _unary(lambda a: a - jnp.tanh(a), x)
+    return _unary(_tanhshrink_k, x)
 
 
 def leaky_relu(x, negative_slope=0.01, name=None):
-    return _unary(lambda a: jax.nn.leaky_relu(a, negative_slope), x, "leaky_relu")
+    return _unary(_leaky_relu_k, x, "leaky_relu", slope=negative_slope)
+
+
+def _prelu_k(a, w, *, data_format):
+    if w.size == 1:
+        return jnp.where(a >= 0, a, w.ravel()[0] * a)
+    c_axis = 1 if data_format[1] == "C" else a.ndim - 1
+    shape = [1] * a.ndim
+    shape[c_axis] = -1
+    return jnp.where(a >= 0, a, w.reshape(shape) * a)
 
 
 def prelu(x, weight, data_format="NCHW", name=None):
-    def f(a, w):
-        if w.size == 1:
-            return jnp.where(a >= 0, a, w.ravel()[0] * a)
-        c_axis = 1 if data_format[1] == "C" else a.ndim - 1
-        shape = [1] * a.ndim
-        shape[c_axis] = -1
-        return jnp.where(a >= 0, a, w.reshape(shape) * a)
-    return apply_op(f, to_tensor_like(x), to_tensor_like(weight), name="prelu")
+    return apply_op(_prelu_k, to_tensor_like(x), to_tensor_like(weight),
+                    name="prelu", data_format=data_format)
+
+
+def _rrelu_train_k(a, slope):
+    return jnp.where(a >= 0, a, slope * a)
+
+
+def _rrelu_eval_k(a, *, slope):
+    return jnp.where(a >= 0, a, slope * a)
 
 
 def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
@@ -117,59 +175,73 @@ def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
     if training:
         slope = jax.random.uniform(core.next_rng_key(), tuple(x.shape),
                                    minval=lower, maxval=upper)
-    else:
-        slope = (lower + upper) / 2.0
-    return apply_op(lambda a: jnp.where(a >= 0, a, slope * a), x, name="rrelu")
+        return apply_op(_rrelu_train_k, x, slope, name="rrelu")
+    return apply_op(_rrelu_eval_k, x, name="rrelu",
+                    slope=(lower + upper) / 2.0)
 
 
 def log_sigmoid(x, name=None):
     return _unary(jax.nn.log_sigmoid, x)
 
 
+def _maxout_k(a, *, groups, axis):
+    ax = axis % a.ndim
+    c = a.shape[ax]
+    shape = list(a.shape)
+    shape[ax:ax + 1] = [groups, c // groups]
+    return jnp.max(a.reshape(shape), axis=ax + 1)
+
+
 def maxout(x, groups, axis=1, name=None):
-    def f(a):
-        ax = axis % a.ndim
-        c = a.shape[ax]
-        shape = list(a.shape)
-        shape[ax:ax + 1] = [groups, c // groups]
-        return jnp.max(a.reshape(shape), axis=ax + 1)
-    return apply_op(f, to_tensor_like(x), name="maxout")
+    return apply_op(_maxout_k, to_tensor_like(x), name="maxout",
+                    groups=groups, axis=axis)
+
+
+def _softmax_k(a, *, axis, dt):
+    if dt is not None:
+        a = a.astype(dt)
+    return jax.nn.softmax(a, axis=axis)
 
 
 def softmax(x, axis=-1, dtype=None, name=None):
-    d = core.convert_dtype(dtype)
-    def f(a):
-        if d is not None:
-            a = a.astype(d)
-        return jax.nn.softmax(a, axis=axis)
-    return _unary(f, x, "softmax")
+    return _unary(_softmax_k, x, "softmax", axis=int(axis),
+                  dt=core.convert_dtype(dtype))
 
 
 def softmax_(x, axis=-1, dtype=None, name=None):
     return x._inplace_from(softmax(x, axis, dtype))
 
 
+def _log_softmax_k(a, *, axis, dt):
+    if dt is not None:
+        a = a.astype(dt)
+    return jax.nn.log_softmax(a, axis=axis)
+
+
 def log_softmax(x, axis=-1, dtype=None, name=None):
-    d = core.convert_dtype(dtype)
-    def f(a):
-        if d is not None:
-            a = a.astype(d)
-        return jax.nn.log_softmax(a, axis=axis)
-    return _unary(f, x, "log_softmax")
+    return _unary(_log_softmax_k, x, "log_softmax", axis=int(axis),
+                  dt=core.convert_dtype(dtype))
+
+
+def _softplus_k(a, *, beta, threshold):
+    return jnp.where(beta * a > threshold, a,
+                     jnp.logaddexp(beta * a, 0.0) / beta)
 
 
 def softplus(x, beta=1.0, threshold=20.0, name=None):
-    return _unary(
-        lambda a: jnp.where(beta * a > threshold, a,
-                            jnp.logaddexp(beta * a, 0.0) / beta), x)
+    return _unary(_softplus_k, x, beta=beta, threshold=threshold)
 
 
 def softsign(x, name=None):
     return _unary(jax.nn.soft_sign, x)
 
 
+def _mish_k(a):
+    return a * jnp.tanh(jax.nn.softplus(a))
+
+
 def mish(x, name=None):
-    return _unary(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x)
+    return _unary(_mish_k, x)
 
 
 def tanh(x, name=None):
@@ -180,15 +252,32 @@ def tanh_(x, name=None):
     return x._inplace_from(tanh(x))
 
 
+def _thresholded_relu_k(a, *, threshold, value):
+    return jnp.where(a > threshold, a, value)
+
+
 def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
-    return _unary(lambda a: jnp.where(a > threshold, a, value), x)
+    return _unary(_thresholded_relu_k, x, threshold=threshold, value=value)
+
+
+def _glu_k(a, *, axis):
+    a1, a2 = jnp.split(a, 2, axis=axis)
+    return a1 * jax.nn.sigmoid(a2)
 
 
 def glu(x, axis=-1, name=None):
-    def f(a):
-        a1, a2 = jnp.split(a, 2, axis=axis)
-        return a1 * jax.nn.sigmoid(a2)
-    return _unary(f, x, "glu")
+    return _unary(_glu_k, x, "glu", axis=int(axis))
+
+
+def _gumbel_softmax_k(a, g, *, temperature, hard, axis):
+    y = jax.nn.softmax((a + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        onehot = jnp.put_along_axis(jnp.zeros_like(y), idx,
+                                    jnp.ones_like(idx, y.dtype), axis=axis,
+                                    inplace=False)
+        return onehot + y - jax.lax.stop_gradient(y)
+    return y
 
 
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
@@ -196,17 +285,8 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     g = -jnp.log(-jnp.log(
         jax.random.uniform(core.next_rng_key(), tuple(x.shape),
                            minval=1e-10, maxval=1.0) + 1e-10))
-    def f(a):
-        y = jax.nn.softmax((a + g) / temperature, axis=axis)
-        if hard:
-            idx = jnp.argmax(y, axis=axis, keepdims=True)
-            onehot = jnp.zeros_like(y).at[...].set(0.0)
-            onehot = jnp.put_along_axis(jnp.zeros_like(y), idx,
-                                        jnp.ones_like(idx, y.dtype), axis=axis,
-                                        inplace=False)
-            return onehot + y - jax.lax.stop_gradient(y)
-        return y
-    return apply_op(f, x, name="gumbel_softmax")
+    return apply_op(_gumbel_softmax_k, x, g, name="gumbel_softmax",
+                    temperature=temperature, hard=bool(hard), axis=int(axis))
 
 
 def sigmoid_(x, name=None):

@@ -21,14 +21,34 @@ __all__ = [
 ]
 
 
+def _linear_k(a, w):
+    return a @ w
+
+
+def _linear_bias_k(a, w, b):
+    return a @ w + b
+
+
 def linear(x, weight, bias=None, name=None):
     """x @ W + b. Weight layout [in, out] (paddle convention) — feeds the MXU
     directly (ref kernel: phi/kernels/.../matmul + fused_gemm_epilogue)."""
     if bias is None:
-        return apply_op(lambda a, w: a @ w, to_tensor_like(x),
+        return apply_op(_linear_k, to_tensor_like(x),
                         to_tensor_like(weight), name="linear")
-    return apply_op(lambda a, w, b: a @ w + b, to_tensor_like(x),
+    return apply_op(_linear_bias_k, to_tensor_like(x),
                     to_tensor_like(weight), to_tensor_like(bias), name="linear")
+
+
+def _dropout_scale_k(a, *, s):
+    return a * s
+
+
+def _dropout_upscale_k(a, keep, *, p):
+    return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+
+
+def _dropout_mask_k(a, keep):
+    return jnp.where(keep, a, 0.0).astype(a.dtype)
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
@@ -36,22 +56,23 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
     x = to_tensor_like(x)
     if not training or p == 0.0:
         if mode == "downscale_in_infer" and not training:
-            return apply_op(lambda a: a * (1.0 - p), x, name="dropout_infer")
+            return apply_op(_dropout_scale_k, x, name="dropout_infer",
+                            s=1.0 - p)
         return x.clone() if core.is_grad_enabled() and not x.stop_gradient else x
     if p == 1.0:
-        return apply_op(lambda a: a * 0.0, x, name="dropout")
+        return apply_op(_dropout_scale_k, x, name="dropout", s=0.0)
     shape = tuple(x.shape)
     if axis is not None:
         axes = [axis] if isinstance(axis, int) else list(axis)
         mask_shape = tuple(s if i in axes else 1 for i, s in enumerate(shape))
     else:
         mask_shape = shape
+    # the fresh per-call mask rides along as a dynamic arg (same aval every
+    # step), so repeated dropout calls hit the dispatch cache
     keep = jax.random.bernoulli(core.next_rng_key(), 1.0 - p, mask_shape)
     if mode == "upscale_in_train":
-        return apply_op(lambda a: jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype),
-                        x, name="dropout")
-    return apply_op(lambda a: jnp.where(keep, a, 0.0).astype(a.dtype), x,
-                    name="dropout")
+        return apply_op(_dropout_upscale_k, x, keep, name="dropout", p=float(p))
+    return apply_op(_dropout_mask_k, x, keep, name="dropout")
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -64,6 +85,10 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
     return dropout(x, p=p, axis=axis, training=training)
 
 
+def _alpha_dropout_k(v, keep, *, a, b, alpha_p):
+    return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+
+
 def alpha_dropout(x, p=0.5, training=True, name=None):
     x = to_tensor_like(x)
     if not training or p == 0.0:
@@ -74,9 +99,8 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     keep = jax.random.bernoulli(core.next_rng_key(), 1.0 - p, tuple(x.shape))
     a = (1.0 / ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** 0.5)
     b = -a * alpha_p * p
-    return apply_op(
-        lambda v: (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype), x,
-        name="alpha_dropout")
+    return apply_op(_alpha_dropout_k, x, keep, name="alpha_dropout",
+                    a=a, b=b, alpha_p=alpha_p)
 
 
 feature_alpha_dropout = alpha_dropout

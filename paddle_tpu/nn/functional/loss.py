@@ -113,7 +113,7 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
                                return_softmax=False, axis=-1):
     loss = cross_entropy(logits, label, soft_label=soft_label,
                          ignore_index=ignore_index, reduction="none", axis=axis)
-    loss = apply_op(lambda a: jnp.expand_dims(a, axis), loss)
+    loss = apply_op(_expand_dims_k, loss, ax=int(axis))
     if return_softmax:
         from .activation import softmax as _softmax
         return loss, _softmax(logits, axis=axis)
@@ -168,19 +168,34 @@ def binary_cross_entropy_with_logits(logit, label, weight=None,
     return apply_op(f, *args, name="bce_logits")
 
 
+def _expand_dims_k(a, *, ax):
+    return jnp.expand_dims(a, ax)
+
+
+def _mse_k(a, b, *, reduction):
+    return _reduce((a - b) ** 2, reduction)
+
+
 def mse_loss(input, label, reduction="mean", name=None):
-    return apply_op(lambda a, b: _reduce((a - b) ** 2, reduction),
-                    to_tensor_like(input), to_tensor_like(label), name="mse")
+    return apply_op(_mse_k, to_tensor_like(input), to_tensor_like(label),
+                    name="mse", reduction=reduction)
+
+
+def _sq_err_k(a, b):
+    return (a - b) ** 2
 
 
 def square_error_cost(input, label):
-    return apply_op(lambda a, b: (a - b) ** 2,
-                    to_tensor_like(input), to_tensor_like(label))
+    return apply_op(_sq_err_k, to_tensor_like(input), to_tensor_like(label))
+
+
+def _l1_k(a, b, *, reduction):
+    return _reduce(jnp.abs(a - b), reduction)
 
 
 def l1_loss(input, label, reduction="mean", name=None):
-    return apply_op(lambda a, b: _reduce(jnp.abs(a - b), reduction),
-                    to_tensor_like(input), to_tensor_like(label), name="l1")
+    return apply_op(_l1_k, to_tensor_like(input), to_tensor_like(label),
+                    name="l1", reduction=reduction)
 
 
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",

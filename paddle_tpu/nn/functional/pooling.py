@@ -236,25 +236,32 @@ def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive(x, output_size, 3, False, return_mask)
 
 
+def _abs_pow_k(a, *, p):
+    return jnp.abs(a) ** p
+
+
+def _lp_rescale_k(a, *, k, p):
+    return (a * k) ** (1.0 / p)
+
+
 def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
               ceil_mode=False, data_format="NCL", name=None):
     p = float(norm_type)
-    from ...ops.math import pow as _pow
-    xx = apply_op(lambda a: jnp.abs(a) ** p, to_tensor_like(x))
+    xx = apply_op(_abs_pow_k, to_tensor_like(x), p=p)
     s = _pool(xx, kernel_size, stride, padding, 1, "NCW", None, None,
               "lp_pool1d", ceil_mode, exclusive=False, is_avg=True)
     k = _tup(kernel_size, 1)[0]
-    return apply_op(lambda a: (a * k) ** (1.0 / p), s)
+    return apply_op(_lp_rescale_k, s, k=k, p=p)
 
 
 def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
               ceil_mode=False, data_format="NCHW", name=None):
     p = float(norm_type)
-    xx = apply_op(lambda a: jnp.abs(a) ** p, to_tensor_like(x))
+    xx = apply_op(_abs_pow_k, to_tensor_like(x), p=p)
     s = _pool(xx, kernel_size, stride, padding, 2, data_format, None, None,
               "lp_pool2d", ceil_mode, exclusive=False, is_avg=True)
     ks = _tup(kernel_size, 2)
-    return apply_op(lambda a: (a * (ks[0] * ks[1])) ** (1.0 / p), s)
+    return apply_op(_lp_rescale_k, s, k=ks[0] * ks[1], p=p)
 
 
 def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,

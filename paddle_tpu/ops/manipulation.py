@@ -1,6 +1,7 @@
 """Shape/layout manipulation ops (ref: python/paddle/tensor/manipulation.py)."""
 from __future__ import annotations
 
+import functools
 from typing import Sequence
 
 import jax
@@ -40,31 +41,51 @@ def _axes(axis):
     return static_int(axis)
 
 
+# Op bodies live at module level with shape/axis parameters as keyword-only
+# static kwargs: a per-call closure (`lambda a: jnp.reshape(a, shape)`) gets
+# a fresh function object every call, which defeats the eager dispatch cache
+# (tape.apply_op keys on callable code identity + statics). Enforced by
+# tools/check_apply_op_closures.py.
+
+def _reshape_k(a, *, shape):
+    return jnp.reshape(a, shape)
+
+
 def reshape(x, shape, name=None):
     if isinstance(shape, Tensor):
         shape = [int(v) for v in np.asarray(shape.data)]
     else:
         shape = [static_int(s) for s in shape]
-    return apply_op(lambda a: jnp.reshape(a, shape), to_tensor_like(x), name="reshape")
+    return apply_op(_reshape_k, to_tensor_like(x), name="reshape",
+                    shape=tuple(shape))
 
 
 def reshape_(x, shape, name=None):
     return x._inplace_from(reshape(x, shape))
 
 
+def _view_dtype_k(a, *, dt):
+    return a.view(dt)
+
+
 def view(x, shape_or_dtype, name=None):
     if isinstance(shape_or_dtype, (list, tuple)):
         return reshape(x, shape_or_dtype)
-    return apply_op(lambda a: a.view(core.convert_dtype(shape_or_dtype)), to_tensor_like(x))
+    return apply_op(_view_dtype_k, to_tensor_like(x),
+                    dt=core.convert_dtype(shape_or_dtype))
 
 
 def view_as(x, other, name=None):
     return reshape(x, other.shape)
 
 
+def _transpose_k(a, *, perm):
+    return jnp.transpose(a, perm)
+
+
 def transpose(x, perm=None, name=None):
-    return apply_op(lambda a: jnp.transpose(a, _axes(perm)), to_tensor_like(x),
-                    name="transpose")
+    return apply_op(_transpose_k, to_tensor_like(x), name="transpose",
+                    perm=_axes(perm))
 
 
 def t(x, name=None):
@@ -74,73 +95,93 @@ def t(x, name=None):
     return apply_op(jnp.transpose, x, name="t")
 
 
+def _moveaxis_k(a, *, src, dst):
+    return jnp.moveaxis(a, src, dst)
+
+
 def moveaxis(x, source, destination, name=None):
-    return apply_op(lambda a: jnp.moveaxis(a, _axes(source), _axes(destination)),
-                    to_tensor_like(x))
+    return apply_op(_moveaxis_k, to_tensor_like(x),
+                    src=_axes(source), dst=_axes(destination))
+
+
+def _swapaxes_k(a, *, a0, a1):
+    return jnp.swapaxes(a, a0, a1)
 
 
 def swapaxes(x, axis0, axis1, name=None):
-    return apply_op(lambda a: jnp.swapaxes(a, static_int(axis0), static_int(axis1)),
-                    to_tensor_like(x))
+    return apply_op(_swapaxes_k, to_tensor_like(x),
+                    a0=static_int(axis0), a1=static_int(axis1))
+
+
+def _flatten_k(a, *, s, e):
+    shape = list(a.shape[:s]) + [-1] + list(a.shape[e + 1:])
+    return jnp.reshape(a, shape)
 
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
     x = to_tensor_like(x)
     nd = max(x.ndim, 1)
-    s = start_axis % nd
-    e = stop_axis % nd
-    def f(a):
-        shape = list(a.shape[:s]) + [-1] + list(a.shape[e + 1:])
-        return jnp.reshape(a, shape)
-    return apply_op(f, x, name="flatten")
+    return apply_op(_flatten_k, x, name="flatten",
+                    s=start_axis % nd, e=stop_axis % nd)
 
 
 def flatten_(x, start_axis=0, stop_axis=-1, name=None):
     return x._inplace_from(flatten(x, start_axis, stop_axis))
 
 
+def _squeeze_k(a, *, ax):
+    if ax is None:
+        return jnp.squeeze(a)
+    keep = tuple(i for i in ax if a.shape[i % a.ndim] == 1)
+    return jnp.squeeze(a, axis=keep) if keep else a
+
+
 def squeeze(x, axis=None, name=None):
-    x = to_tensor_like(x)
     ax = _axes(axis)
     if isinstance(ax, int):
         ax = (ax,)
-    def f(a):
-        if ax is None:
-            return jnp.squeeze(a)
-        keep = tuple(i for i in ax if a.shape[i % a.ndim] == 1)
-        return jnp.squeeze(a, axis=keep) if keep else a
-    return apply_op(f, x, name="squeeze")
+    return apply_op(_squeeze_k, to_tensor_like(x), name="squeeze", ax=ax)
 
 
 def squeeze_(x, axis=None, name=None):
     return x._inplace_from(squeeze(x, axis))
 
 
+def _unsqueeze_k(a, *, ax):
+    out = a
+    for i in sorted(ax):
+        out = jnp.expand_dims(out, i)
+    return out
+
+
 def unsqueeze(x, axis, name=None):
     ax = _axes(axis)
     if isinstance(ax, int):
         ax = (ax,)
-    def f(a):
-        out = a
-        for i in sorted(ax):
-            out = jnp.expand_dims(out, i)
-        return out
-    return apply_op(f, to_tensor_like(x), name="unsqueeze")
+    return apply_op(_unsqueeze_k, to_tensor_like(x), name="unsqueeze",
+                    ax=tuple(ax))
 
 
 def unsqueeze_(x, axis, name=None):
     return x._inplace_from(unsqueeze(x, axis))
 
 
+def _concat_k(*xs, ax):
+    return jnp.concatenate(xs, axis=ax)
+
+
 def concat(x, axis=0, name=None):
     ts = [to_tensor_like(t) for t in x]
-    ax = static_int(axis)
-    return apply_op(lambda *xs: jnp.concatenate(xs, axis=ax), *ts, name="concat")
+    return apply_op(_concat_k, *ts, name="concat", ax=static_int(axis))
+
+
+def _stack_k(*xs, ax):
+    return jnp.stack(xs, axis=ax)
 
 
 def stack(x, axis=0, name=None):
     ts = [to_tensor_like(t) for t in x]
-    return apply_op(lambda *xs: jnp.stack(xs, axis=static_int(axis)), *ts, name="stack")
+    return apply_op(_stack_k, *ts, name="stack", ax=static_int(axis))
 
 
 def hstack(x, name=None):
@@ -179,13 +220,17 @@ def split(x, num_or_sections, axis=0, name=None):
         if minus:
             rest = dim - sum(s for s in sizes if s not in (-1, None))
             sizes[minus[0]] = rest
-    offsets = np.cumsum([0] + sizes[:-1])
+    offsets = [int(o) for o in np.cumsum([0] + sizes[:-1])]
     n_out = len(sizes)
-    def f(a):
-        return tuple(jax.lax.slice_in_dim(a, int(o), int(o + s), axis=ax)
-                     for o, s in zip(offsets, sizes))
-    out = apply_op(f, x, n_outputs=n_out, name="split")
+    out = apply_op(_split_k, x, n_outputs=n_out, name="split",
+                   offsets=tuple(offsets), sizes=tuple(int(s) for s in sizes),
+                   ax=ax)
     return list(out) if isinstance(out, tuple) else [out]
+
+
+def _split_k(a, *, offsets, sizes, ax):
+    return tuple(jax.lax.slice_in_dim(a, o, o + s, axis=ax)
+                 for o, s in zip(offsets, sizes))
 
 
 def tensor_split(x, num_or_indices, axis=0, name=None):
@@ -219,39 +264,47 @@ def vsplit(x, num_or_indices, name=None):
     return tensor_split(x, num_or_indices, axis=0)
 
 
+def _unbind_k(a, *, ax, n):
+    return tuple(jax.lax.index_in_dim(a, i, axis=ax, keepdims=False)
+                 for i in range(n))
+
+
 def unbind(x, axis=0, name=None):
     x = to_tensor_like(x)
     ax = static_int(axis)
     n = x.data.shape[ax]
-    out = apply_op(
-        lambda a: tuple(jax.lax.index_in_dim(a, i, axis=ax, keepdims=False)
-                        for i in range(n)),
-        x, n_outputs=n, name="unbind")
+    out = apply_op(_unbind_k, x, n_outputs=n, name="unbind", ax=ax, n=n)
     return list(out) if isinstance(out, tuple) else [out]
 
 
 unstack = unbind
 
 
+def _tile_k(a, *, reps):
+    return jnp.tile(a, reps)
+
+
 def tile(x, repeat_times, name=None):
     if isinstance(repeat_times, Tensor):
         repeat_times = [int(v) for v in np.asarray(repeat_times.data)]
     reps = tuple(static_int(r) for r in repeat_times)
-    return apply_op(lambda a: jnp.tile(a, reps), to_tensor_like(x), name="tile")
+    return apply_op(_tile_k, to_tensor_like(x), name="tile", reps=reps)
+
+
+def _expand_k(a, *, shape):
+    tgt = list(shape)
+    off = len(tgt) - a.ndim
+    for i in range(a.ndim):
+        if tgt[off + i] in (-1, None):
+            tgt[off + i] = a.shape[i]
+    return jnp.broadcast_to(a, tgt)
 
 
 def expand(x, shape, name=None):
     if isinstance(shape, Tensor):
         shape = [int(v) for v in np.asarray(shape.data)]
-    shape = [static_int(s) for s in shape]
-    def f(a):
-        tgt = list(shape)
-        off = len(tgt) - a.ndim
-        for i in range(a.ndim):
-            if tgt[off + i] in (-1, None):
-                tgt[off + i] = a.shape[i]
-        return jnp.broadcast_to(a, tgt)
-    return apply_op(f, to_tensor_like(x), name="expand")
+    shape = tuple(static_int(s) for s in shape)
+    return apply_op(_expand_k, to_tensor_like(x), name="expand", shape=shape)
 
 
 def expand_as(x, y, name=None):
@@ -268,15 +321,22 @@ def broadcast_tensors(inputs, name=None):
                          *ts, n_outputs=len(ts), name="broadcast_tensors"))
 
 
+def _cast_k(a, *, dt):
+    return a.astype(dt)
+
+
 def cast(x, dtype, name=None):
-    d = core.convert_dtype(dtype)
-    return apply_op(lambda a: a.astype(d), to_tensor_like(x), name="cast")
+    return apply_op(_cast_k, to_tensor_like(x), name="cast",
+                    dt=core.convert_dtype(dtype))
+
+
+def _gather_k(a, i, *, ax):
+    return jnp.take(a, i.astype(jnp.int32).ravel(), axis=ax)
 
 
 def gather(x, index, axis=0, name=None):
-    ax = static_int(axis)
-    return apply_op(lambda a, i: jnp.take(a, i.astype(jnp.int32).ravel(), axis=ax),
-                    to_tensor_like(x), to_tensor_like(index), name="gather")
+    return apply_op(_gather_k, to_tensor_like(x), to_tensor_like(index),
+                    name="gather", ax=static_int(axis))
 
 
 def gather_nd(x, index, name=None):
@@ -287,15 +347,18 @@ def gather_nd(x, index, name=None):
     return apply_op(f, to_tensor_like(x), to_tensor_like(index), name="gather_nd")
 
 
+def _scatter_k(a, i, u, *, overwrite):
+    i = i.astype(jnp.int32).ravel()
+    if overwrite:
+        return a.at[i].set(u)
+    z = a.at[i].set(jnp.zeros_like(u))
+    return z.at[i].add(u)
+
+
 def scatter(x, index, updates, overwrite=True, name=None):
-    def f(a, i, u):
-        i = i.astype(jnp.int32).ravel()
-        if overwrite:
-            return a.at[i].set(u)
-        z = a.at[i].set(jnp.zeros_like(u))
-        return z.at[i].add(u)
-    return apply_op(f, to_tensor_like(x), to_tensor_like(index),
-                    to_tensor_like(updates), name="scatter")
+    return apply_op(_scatter_k, to_tensor_like(x), to_tensor_like(index),
+                    to_tensor_like(updates), name="scatter",
+                    overwrite=bool(overwrite))
 
 
 def scatter_(x, index, updates, overwrite=True, name=None):
@@ -317,9 +380,8 @@ def scatter_nd(index, updates, shape, name=None):
 
 
 def index_select(x, index, axis=0, name=None):
-    ax = static_int(axis)
-    return apply_op(lambda a, i: jnp.take(a, i.astype(jnp.int32).ravel(), axis=ax),
-                    to_tensor_like(x), to_tensor_like(index), name="index_select")
+    return apply_op(_gather_k, to_tensor_like(x), to_tensor_like(index),
+                    name="index_select", ax=static_int(axis))
 
 
 def index_sample(x, index):
@@ -328,25 +390,33 @@ def index_sample(x, index):
     return apply_op(f, to_tensor_like(x), to_tensor_like(index), name="index_sample")
 
 
+def _index_add_k(a, i, v, *, ax):
+    i = i.astype(jnp.int32).ravel()
+    am = jnp.moveaxis(a, ax, 0)
+    vm = jnp.moveaxis(v, ax, 0)
+    return jnp.moveaxis(am.at[i].add(vm), 0, ax)
+
+
 def index_add(x, index, axis, value, name=None):
-    ax = static_int(axis)
-    def f(a, i, v):
-        i = i.astype(jnp.int32).ravel()
-        am = jnp.moveaxis(a, ax, 0)
-        vm = jnp.moveaxis(v, ax, 0)
-        return jnp.moveaxis(am.at[i].add(vm), 0, ax)
-    return apply_op(f, to_tensor_like(x), to_tensor_like(index),
-                    to_tensor_like(value), name="index_add")
+    return apply_op(_index_add_k, to_tensor_like(x), to_tensor_like(index),
+                    to_tensor_like(value), name="index_add",
+                    ax=static_int(axis))
+
+
+def _index_put_k(a, v, *idx, accumulate):
+    idx = tuple(i.astype(jnp.int32) if jnp.issubdtype(i.dtype, jnp.integer) else i
+                for i in idx)
+    return a.at[idx].add(v) if accumulate else a.at[idx].set(v)
 
 
 def index_put(x, indices, value, accumulate=False, name=None):
     idx_ts = [to_tensor_like(i) for i in indices]
-    def f(a, v, *idx):
-        idx = tuple(i.astype(jnp.int32) if jnp.issubdtype(i.dtype, jnp.integer) else i
-                    for i in idx)
-        return a.at[idx].add(v) if accumulate else a.at[idx].set(v)
-    return apply_op(f, to_tensor_like(x), to_tensor_like(value), *idx_ts,
-                    name="index_put")
+    return apply_op(_index_put_k, to_tensor_like(x), to_tensor_like(value),
+                    *idx_ts, name="index_put", accumulate=bool(accumulate))
+
+
+def _masked_select_k(a, idx, *, shape):
+    return jnp.take(jnp.broadcast_to(a, shape).ravel(), idx)
 
 
 def masked_select(x, mask, name=None):
@@ -356,24 +426,29 @@ def masked_select(x, mask, name=None):
     shape = jnp.broadcast_shapes(x.data.shape, mask.data.shape)
     mb = np.broadcast_to(np.asarray(mask.data), shape)
     idx = np.nonzero(mb.ravel())[0]
-    return apply_op(lambda a: jnp.take(jnp.broadcast_to(a, shape).ravel(), idx),
-                    x, name="masked_select")
+    return apply_op(_masked_select_k, x, idx, name="masked_select",
+                    shape=tuple(shape))
+
+
+def _masked_fill_k(a, m, *, v):
+    return jnp.where(m, jnp.asarray(v, a.dtype), a)
 
 
 def masked_fill(x, mask, value, name=None):
-    v = unwrap(value)
-    return apply_op(lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a),
-                    to_tensor_like(x), to_tensor_like(mask), name="masked_fill")
+    return apply_op(_masked_fill_k, to_tensor_like(x), to_tensor_like(mask),
+                    name="masked_fill", v=unwrap(value))
+
+
+def _masked_scatter_k(a, v, pos):
+    flat = a.ravel()
+    return flat.at[pos].set(v.ravel()[: pos.shape[0]]).reshape(a.shape)
 
 
 def masked_scatter(x, mask, value, name=None):
     x, mask, value = to_tensor_like(x), to_tensor_like(mask), to_tensor_like(value)
     mb = np.asarray(jnp.broadcast_to(mask.data, x.data.shape)).ravel()
     pos = np.nonzero(mb)[0]
-    def f(a, v):
-        flat = a.ravel()
-        return flat.at[pos].set(v.ravel()[: len(pos)]).reshape(a.shape)
-    return apply_op(f, x, value, name="masked_scatter")
+    return apply_op(_masked_scatter_k, x, value, pos, name="masked_scatter")
 
 
 def where(condition, x=None, y=None, name=None):
@@ -397,45 +472,68 @@ def nonzero(x, as_tuple=False):
     return Tensor(jnp.asarray(np.stack(nz, axis=1)))
 
 
+def _roll_k(a, *, sh, ax):
+    return jnp.roll(a, sh, axis=ax)
+
+
 def roll(x, shifts, axis=None, name=None):
     sh = tuple(shifts) if isinstance(shifts, (list, tuple)) else static_int(shifts)
-    ax = _axes(axis)
-    return apply_op(lambda a: jnp.roll(a, sh, axis=ax), to_tensor_like(x), name="roll")
+    return apply_op(_roll_k, to_tensor_like(x), name="roll",
+                    sh=sh, ax=_axes(axis))
+
+
+def _flip_k(a, *, ax):
+    return jnp.flip(a, axis=ax)
 
 
 def flip(x, axis, name=None):
-    ax = _axes(axis)
-    return apply_op(lambda a: jnp.flip(a, axis=ax), to_tensor_like(x), name="flip")
+    return apply_op(_flip_k, to_tensor_like(x), name="flip", ax=_axes(axis))
+
+
+def _rot90_k(a, *, k, axes):
+    return jnp.rot90(a, k=k, axes=axes)
 
 
 def rot90(x, k=1, axes=(0, 1), name=None):
-    return apply_op(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), to_tensor_like(x))
+    return apply_op(_rot90_k, to_tensor_like(x), k=static_int(k),
+                    axes=tuple(axes))
+
+
+def _slice_k(a, *, axes, starts, ends):
+    out = a
+    for ax, st, en in zip(axes, starts, ends):
+        n = out.shape[ax]
+        st2 = max(st + n, 0) if st < 0 else min(st, n)
+        en2 = max(en + n, 0) if en < 0 else min(en, n)
+        out = jax.lax.slice_in_dim(out, st2, en2, axis=ax)
+    return out
 
 
 def slice(input, axes, starts, ends):
-    axes = [static_int(a) for a in axes]
-    starts = [static_int(s) for s in starts]
-    ends = [static_int(e) for e in ends]
-    def f(a):
-        out = a
-        for ax, st, en in zip(axes, starts, ends):
-            n = out.shape[ax]
-            st2 = max(st + n, 0) if st < 0 else min(st, n)
-            en2 = max(en + n, 0) if en < 0 else min(en, n)
-            out = jax.lax.slice_in_dim(out, st2, en2, axis=ax)
-        return out
-    return apply_op(f, to_tensor_like(input), name="slice")
+    return apply_op(_slice_k, to_tensor_like(input), name="slice",
+                    axes=tuple(static_int(a) for a in axes),
+                    starts=tuple(static_int(s) for s in starts),
+                    ends=tuple(static_int(e) for e in ends))
+
+
+def _strided_slice_k(a, *, axes, starts, ends, strides):
+    import builtins
+    idx = [builtins.slice(None)] * a.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(st, en, sd)
+    return a[tuple(idx)]
 
 
 def strided_slice(x, axes, starts, ends, strides, name=None):
-    import builtins
-    axes = [static_int(a) for a in axes]
-    def f(a):
-        idx = [builtins.slice(None)] * a.ndim
-        for ax, st, en, sd in zip(axes, starts, ends, strides):
-            idx[ax] = builtins.slice(static_int(st), static_int(en), static_int(sd))
-        return a[tuple(idx)]
-    return apply_op(f, to_tensor_like(x), name="strided_slice")
+    return apply_op(_strided_slice_k, to_tensor_like(x), name="strided_slice",
+                    axes=tuple(static_int(a) for a in axes),
+                    starts=tuple(static_int(s) for s in starts),
+                    ends=tuple(static_int(e) for e in ends),
+                    strides=tuple(static_int(s) for s in strides))
+
+
+def _crop_k(a, *, offs, shp):
+    return jax.lax.dynamic_slice(a, offs, shp)
 
 
 def crop(x, shape=None, offsets=None, name=None):
@@ -445,73 +543,85 @@ def crop(x, shape=None, offsets=None, name=None):
     for i, s in enumerate(shp):
         if s in (-1, None):
             shp[i] = x.shape[i] - offs[i]
-    def f(a):
-        return jax.lax.dynamic_slice(a, offs, shp)
-    return apply_op(f, x, name="crop")
+    return apply_op(_crop_k, x, name="crop", offs=tuple(offs), shp=tuple(shp))
+
+
+def _repeat_var_k(a, reps, *, ax, total):
+    return jnp.repeat(a, reps, axis=ax, total_repeat_length=total)
+
+
+def _repeat_k(a, *, reps, ax):
+    return jnp.repeat(a, reps, axis=ax)
 
 
 def repeat_interleave(x, repeats, axis=None, name=None):
     x = to_tensor_like(x)
+    ax = _axes(axis)
     if isinstance(repeats, Tensor):
         reps = np.asarray(repeats.data)
         total = int(reps.sum())
-        return apply_op(
-            lambda a: jnp.repeat(a, jnp.asarray(reps), axis=axis, total_repeat_length=total),
-            x, name="repeat_interleave")
-    return apply_op(lambda a: jnp.repeat(a, repeats, axis=axis), x,
-                    name="repeat_interleave")
+        return apply_op(_repeat_var_k, x, jnp.asarray(reps),
+                        name="repeat_interleave", ax=ax, total=total)
+    return apply_op(_repeat_k, x, name="repeat_interleave",
+                    reps=static_int(repeats), ax=ax)
+
+
+def _take_along_axis_k(a, i, *, ax):
+    return jnp.take_along_axis(a, i.astype(jnp.int32), axis=ax)
 
 
 def take_along_axis(arr, indices, axis, broadcast=True, name=None):
-    ax = static_int(axis)
-    return apply_op(lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=ax),
-                    to_tensor_like(arr), to_tensor_like(indices),
-                    name="take_along_axis")
+    return apply_op(_take_along_axis_k, to_tensor_like(arr),
+                    to_tensor_like(indices), name="take_along_axis",
+                    ax=static_int(axis))
 
 
 def put_along_axis(arr, indices, values, axis, reduce="assign",
                    include_self=True, broadcast=True, name=None):
-    ax = static_int(axis)
-    def f(a, i, v):
-        i = i.astype(jnp.int32)
-        v = jnp.broadcast_to(jnp.asarray(v, a.dtype), i.shape)
-        if reduce == "assign":
-            return jnp.put_along_axis(a, i, v, axis=ax, inplace=False)
-        mode = {"add": "add", "multiply": "multiply", "mul": "multiply",
-                "amin": "min", "amax": "max", "mean": "add"}[reduce]
-        # scatter via .at on the moved axis
-        am = jnp.moveaxis(a, ax, 0)
-        im = jnp.moveaxis(i, ax, 0)
-        vm = jnp.moveaxis(v, ax, 0)
-        grid = jnp.meshgrid(*[jnp.arange(s) for s in im.shape], indexing="ij")
-        full_idx = (im,) + tuple(grid[1:])
-        if not include_self:
-            # targets are re-initialized to the reduce identity: arr's
-            # prior values at scattered positions are excluded
-            if reduce in ("amin", "amax"):
-                if jnp.issubdtype(am.dtype, jnp.integer):
-                    info = jnp.iinfo(am.dtype)
-                    init = info.max if reduce == "amin" else info.min
-                else:
-                    init = jnp.inf if reduce == "amin" else -jnp.inf
+    return apply_op(_put_along_axis_k, to_tensor_like(arr),
+                    to_tensor_like(indices), to_tensor_like(values),
+                    name="put_along_axis", ax=static_int(axis),
+                    reduce=reduce, include_self=bool(include_self))
+
+
+def _put_along_axis_k(a, i, v, *, ax, reduce, include_self):
+    i = i.astype(jnp.int32)
+    v = jnp.broadcast_to(jnp.asarray(v, a.dtype), i.shape)
+    if reduce == "assign":
+        return jnp.put_along_axis(a, i, v, axis=ax, inplace=False)
+    mode = {"add": "add", "multiply": "multiply", "mul": "multiply",
+            "amin": "min", "amax": "max", "mean": "add"}[reduce]
+    # scatter via .at on the moved axis
+    am = jnp.moveaxis(a, ax, 0)
+    im = jnp.moveaxis(i, ax, 0)
+    vm = jnp.moveaxis(v, ax, 0)
+    grid = jnp.meshgrid(*[jnp.arange(s) for s in im.shape], indexing="ij")
+    full_idx = (im,) + tuple(grid[1:])
+    if not include_self:
+        # targets are re-initialized to the reduce identity: arr's
+        # prior values at scattered positions are excluded
+        if reduce in ("amin", "amax"):
+            if jnp.issubdtype(am.dtype, jnp.integer):
+                info = jnp.iinfo(am.dtype)
+                init = info.max if reduce == "amin" else info.min
             else:
-                init = {"add": 0, "multiply": 1, "mul": 1,
-                        "mean": 0}[reduce]
-            am = am.at[full_idx].set(jnp.asarray(init, am.dtype))
-        upd = getattr(am.at[full_idx], mode)(vm)
-        if reduce == "mean":
-            cnt = jnp.zeros(am.shape, jnp.float32).at[full_idx].add(1.0)
-            base = jnp.zeros_like(cnt) if not include_self \
-                else jnp.ones_like(cnt)
-            denom = jnp.maximum(cnt + base, 1.0)
-            scattered = cnt > 0
-            upd = jnp.where(scattered,
-                            (upd.astype(jnp.float32) / denom).astype(
-                                upd.dtype),
-                            upd)
-        return jnp.moveaxis(upd, 0, ax)
-    return apply_op(f, to_tensor_like(arr), to_tensor_like(indices),
-                    to_tensor_like(values), name="put_along_axis")
+                init = jnp.inf if reduce == "amin" else -jnp.inf
+        else:
+            init = {"add": 0, "multiply": 1, "mul": 1,
+                    "mean": 0}[reduce]
+        am = am.at[full_idx].set(jnp.asarray(init, am.dtype))
+    upd = getattr(am.at[full_idx], mode)(vm)
+    if reduce == "mean":
+        cnt = jnp.zeros(am.shape, jnp.float32).at[full_idx].add(1.0)
+        base = jnp.zeros_like(cnt) if not include_self \
+            else jnp.ones_like(cnt)
+        denom = jnp.maximum(cnt + base, 1.0)
+        scattered = cnt > 0
+        upd = jnp.where(scattered,
+                        (upd.astype(jnp.float32) / denom).astype(
+                            upd.dtype),
+                        upd)
+    return jnp.moveaxis(upd, 0, ax)
 
 
 def take(x, index, mode="raise", name=None):
@@ -529,8 +639,11 @@ def take(x, index, mode="raise", name=None):
         except (TypeError, jax.errors.TracerArrayConversionError):
             pass
     m = "clip" if mode == "raise" else mode
-    return apply_op(lambda a, i: jnp.take(a.ravel(), i.astype(jnp.int32), mode=m),
-                    x, index, name="take")
+    return apply_op(_take_k, x, index, name="take", m=m)
+
+
+def _take_k(a, i, *, m):
+    return jnp.take(a.ravel(), i.astype(jnp.int32), mode=m)
 
 
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
@@ -554,11 +667,14 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
             cfg[d] = pr
     jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
              "edge": "edge", "circular": "wrap", "wrap": "wrap"}[mode]
-    def f(a):
-        if jmode == "constant":
-            return jnp.pad(a, cfg, mode="constant", constant_values=value)
-        return jnp.pad(a, cfg, mode=jmode)
-    return apply_op(f, x, name="pad")
+    return apply_op(_pad_k, x, name="pad", cfg=tuple(tuple(p) for p in cfg),
+                    jmode=jmode, value=value)
+
+
+def _pad_k(a, *, cfg, jmode, value):
+    if jmode == "constant":
+        return jnp.pad(a, cfg, mode="constant", constant_values=value)
+    return jnp.pad(a, cfg, mode=jmode)
 
 
 def unique(x, return_index=False, return_inverse=False, return_counts=False,
@@ -630,46 +746,70 @@ def atleast_3d(*inputs, name=None):
     return outs[0] if len(outs) == 1 else outs
 
 
+def _diagonal_k(a, *, offset, axis1, axis2):
+    return jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2)
+
+
 def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
-    return apply_op(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
-                    to_tensor_like(x), name="diagonal")
+    return apply_op(_diagonal_k, to_tensor_like(x), name="diagonal",
+                    offset=static_int(offset), axis1=static_int(axis1),
+                    axis2=static_int(axis2))
+
+
+def _diag_embed_k(a, *, offset, dim1, dim2):
+    n = a.shape[-1] + abs(offset)
+    base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    i = jnp.arange(a.shape[-1])
+    r = i + max(-offset, 0)
+    c = i + max(offset, 0)
+    out = base.at[..., r, c].set(a)
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    perm = [d for d in range(nd) if d not in (nd - 2, nd - 1)]
+    # place last two dims at (dim1, dim2)
+    order = []
+    src = iter(perm)
+    for d in range(nd):
+        if d == d1:
+            order.append(nd - 2)
+        elif d == d2:
+            order.append(nd - 1)
+        else:
+            order.append(next(src))
+    return jnp.transpose(out, order)
 
 
 def diag_embed(input, offset=0, dim1=-2, dim2=-1):
-    def f(a):
-        n = a.shape[-1] + abs(offset)
-        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
-        i = jnp.arange(a.shape[-1])
-        r = i + max(-offset, 0)
-        c = i + max(offset, 0)
-        out = base.at[..., r, c].set(a)
-        nd = out.ndim
-        d1, d2 = dim1 % nd, dim2 % nd
-        perm = [d for d in range(nd) if d not in (nd - 2, nd - 1)]
-        # place last two dims at (dim1, dim2)
-        order = []
-        src = iter(perm)
-        for d in range(nd):
-            if d == d1:
-                order.append(nd - 2)
-            elif d == d2:
-                order.append(nd - 1)
-            else:
-                order.append(next(src))
-        return jnp.transpose(out, order)
-    return apply_op(f, to_tensor_like(input), name="diag_embed")
+    return apply_op(_diag_embed_k, to_tensor_like(input), name="diag_embed",
+                    offset=static_int(offset), dim1=static_int(dim1),
+                    dim2=static_int(dim2))
+
+
+def _diagonal_scatter_k(a, b, *, offset, axis1, axis2):
+    i = jnp.arange(b.shape[-1])
+    r = i + max(-offset, 0)
+    c = i + max(offset, 0)
+    am = jnp.moveaxis(a, (axis1, axis2), (0, 1))
+    bm = jnp.moveaxis(b, -1, 0)
+    return jnp.moveaxis(am.at[r, c].set(bm), (0, 1), (axis1, axis2))
 
 
 def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
-    def f(a, b):
-        n = min(a.shape[axis1], a.shape[axis2])
-        i = jnp.arange(b.shape[-1])
-        r = i + max(-offset, 0)
-        c = i + max(offset, 0)
-        am = jnp.moveaxis(a, (axis1, axis2), (0, 1))
-        bm = jnp.moveaxis(b, -1, 0)
-        return jnp.moveaxis(am.at[r, c].set(bm), (0, 1), (axis1, axis2))
-    return apply_op(f, to_tensor_like(x), to_tensor_like(y), name="diagonal_scatter")
+    return apply_op(_diagonal_scatter_k, to_tensor_like(x), to_tensor_like(y),
+                    name="diagonal_scatter", offset=static_int(offset),
+                    axis1=static_int(axis1), axis2=static_int(axis2))
+
+
+def _fill_diag_wrap_k(a, *, start, step, value, nr, nc):
+    idx = jnp.arange(start, nr * nc, step)
+    return a.reshape(-1).at[idx].set(value).reshape(nr, nc)
+
+
+def _fill_diag_k(a, *, n, offset, value):
+    i = jnp.arange(n - abs(offset))
+    r = i + max(-offset, 0)
+    c = i + max(offset, 0)
+    return a.at[..., r, c].set(value)
 
 
 def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
@@ -678,66 +818,83 @@ def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
         # flat positions (ref fill_diagonal_ wrap semantics)
         nr, nc = x.shape
         start = offset if offset >= 0 else -offset * nc
-        idx = np.arange(start, nr * nc, nc + 1)
-        new = apply_op(
-            lambda a: a.reshape(-1).at[idx].set(value).reshape(nr, nc),
-            x, name="fill_diagonal_")
+        new = apply_op(_fill_diag_wrap_k, x, name="fill_diagonal_",
+                       start=int(start), step=nc + 1, value=value,
+                       nr=nr, nc=nc)
         return x._inplace_from(new)
     n = min(x.shape[-2], x.shape[-1])
-    i = np.arange(n - abs(offset))
-    r = i + max(-offset, 0)
-    c = i + max(offset, 0)
-    new = apply_op(lambda a: a.at[..., r, c].set(value), x, name="fill_diagonal_")
+    new = apply_op(_fill_diag_k, x, name="fill_diagonal_",
+                   n=n, offset=static_int(offset), value=value)
     return x._inplace_from(new)
 
 
+def _shard_index_k(i, *, size, shard_id, ignore_value):
+    shard = i // size
+    return jnp.where(shard == shard_id, i % size, ignore_value)
+
+
 def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
-    size = index_num // nshards
-    def f(i):
-        shard = i // size
-        return jnp.where(shard == shard_id, i % size, ignore_value)
-    return apply_op(f, to_tensor_like(input), name="shard_index")
+    return apply_op(_shard_index_k, to_tensor_like(input), name="shard_index",
+                    size=index_num // nshards, shard_id=static_int(shard_id),
+                    ignore_value=static_int(ignore_value))
+
+
+def _unfold_k(a, *, ax, size, step):
+    n = a.shape[ax]
+    starts = list(range(0, n - size + 1, step))
+    parts = [jax.lax.slice_in_dim(a, s, s + size, axis=ax) for s in starts]
+    return jnp.stack(parts, axis=ax if ax >= 0 else a.ndim + ax)
+
+
+def _unfold_move_k(a, *, ax):
+    return jnp.moveaxis(a, ax + 1, -1)
 
 
 def unfold(x, axis, size, step, name=None):
     ax = static_int(axis)
-    def f(a):
-        n = a.shape[ax]
-        starts = list(range(0, n - size + 1, step))
-        parts = [jax.lax.slice_in_dim(a, s, s + size, axis=ax) for s in starts]
-        return jnp.stack(parts, axis=ax if ax >= 0 else a.ndim + ax)
-    out = apply_op(f, to_tensor_like(x), name="unfold")
+    out = apply_op(_unfold_k, to_tensor_like(x), name="unfold",
+                   ax=ax, size=static_int(size), step=static_int(step))
     # paddle returns windows appended as last dim
-    return apply_op(lambda a: jnp.moveaxis(a, ax + 1, -1), out)
+    return apply_op(_unfold_move_k, out, ax=ax)
+
+
+def _as_strided_k(a, idx):
+    return a.ravel()[idx]
 
 
 def as_strided(x, shape, stride, offset=0, name=None):
-    def f(a):
-        flat = a.ravel()
-        idx = np.full(tuple(shape), offset, dtype=np.int64)
-        for d, (s, st) in enumerate(zip(shape, stride)):
-            r = np.arange(s) * st
-            idx = idx + r.reshape([-1 if i == d else 1 for i in range(len(shape))])
-        return flat[jnp.asarray(idx)]
-    return apply_op(f, to_tensor_like(x), name="as_strided")
+    idx = np.full(tuple(shape), offset, dtype=np.int64)
+    for d, (s, st) in enumerate(zip(shape, stride)):
+        r = np.arange(s) * st
+        idx = idx + r.reshape([-1 if i == d else 1 for i in range(len(shape))])
+    return apply_op(_as_strided_k, to_tensor_like(x), jnp.asarray(idx),
+                    name="as_strided")
+
+
+def _select_scatter_k(a, v, *, ax, index):
+    return jnp.moveaxis(jnp.moveaxis(a, ax, 0).at[index].set(v), 0, ax)
 
 
 def select_scatter(x, values, axis, index, name=None):
-    ax = static_int(axis)
-    def f(a, v):
-        return jnp.moveaxis(jnp.moveaxis(a, ax, 0).at[index].set(v), 0, ax)
-    return apply_op(f, to_tensor_like(x), to_tensor_like(values))
+    return apply_op(_select_scatter_k, to_tensor_like(x),
+                    to_tensor_like(values), ax=static_int(axis),
+                    index=static_int(index))
+
+
+def _slice_scatter_k(a, v, *, axes, starts, ends, strides):
+    import builtins
+    idx = [builtins.slice(None)] * a.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(st, en, sd)
+    return a.at[tuple(idx)].set(v)
 
 
 def slice_scatter(x, value, axes, starts, ends, strides, name=None):
-    import builtins
-    def f(a, v):
-        idx = [builtins.slice(None)] * a.ndim
-        for ax, st, en, sd in zip(axes, starts, ends, strides):
-            idx[static_int(ax)] = builtins.slice(static_int(st), static_int(en),
-                                                 static_int(sd))
-        return a.at[tuple(idx)].set(v)
-    return apply_op(f, to_tensor_like(x), to_tensor_like(value))
+    return apply_op(_slice_scatter_k, to_tensor_like(x), to_tensor_like(value),
+                    axes=tuple(static_int(a) for a in axes),
+                    starts=tuple(static_int(s) for s in starts),
+                    ends=tuple(static_int(e) for e in ends),
+                    strides=tuple(static_int(s) for s in strides))
 
 
 def as_complex(x, name=None):
@@ -749,40 +906,51 @@ def as_real(x, name=None):
                     to_tensor_like(x))
 
 
+def _tensordot_k(a, b, *, axes):
+    return jnp.tensordot(a, b, axes=axes)
+
+
 def tensordot(x, y, axes=2, name=None):
     if isinstance(axes, Tensor):
         axes = np.asarray(axes.data).tolist()
     if isinstance(axes, (list, tuple)):
         axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
-    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=axes),
-                    to_tensor_like(x), to_tensor_like(y), name="tensordot")
+    return apply_op(_tensordot_k, to_tensor_like(x), to_tensor_like(y),
+                    name="tensordot", axes=axes)
+
+
+def _bucketize_k(ss, xx, *, side, dt):
+    return jnp.searchsorted(ss, xx, side=side).astype(dt)
 
 
 def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
     side = "right" if right else "left"
     d = jnp.int32 if out_int32 else core.convert_dtype("int64")
-    return apply_op(
-        lambda ss, xx: jnp.searchsorted(ss, xx, side=side).astype(d),
-        to_tensor_like(sorted_sequence), to_tensor_like(x),
-        name="bucketize")
+    return apply_op(_bucketize_k, to_tensor_like(sorted_sequence),
+                    to_tensor_like(x), name="bucketize", side=side, dt=d)
+
+
+def _searchsorted_1d(s, x, side):
+    return jnp.searchsorted(s, x, side=side)
+
+
+def _searchsorted_k(ss, v, *, side, dt):
+    if ss.ndim == 1:
+        out = jnp.searchsorted(ss, v, side=side)
+    else:
+        out = jax.vmap(functools.partial(_searchsorted_1d, side=side))(
+            ss.reshape(-1, ss.shape[-1]), v.reshape(-1, v.shape[-1])
+        ).reshape(v.shape)
+    return out.astype(dt)
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
     side = "right" if right else "left"
     # paddle returns int64 unless out_int32 (matching bucketize above)
     d = jnp.int32 if out_int32 else core.convert_dtype("int64")
-
-    def f(ss, v):
-        if ss.ndim == 1:
-            out = jnp.searchsorted(ss, v, side=side)
-        else:
-            out = jax.vmap(lambda s, x: jnp.searchsorted(s, x, side=side))(
-                ss.reshape(-1, ss.shape[-1]), v.reshape(-1, v.shape[-1])
-            ).reshape(v.shape)
-        return out.astype(d)
-
-    return apply_op(f, to_tensor_like(sorted_sequence),
-                    to_tensor_like(values), name="searchsorted")
+    return apply_op(_searchsorted_k, to_tensor_like(sorted_sequence),
+                    to_tensor_like(values), name="searchsorted",
+                    side=side, dt=d)
 
 
 def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
@@ -816,12 +984,15 @@ def block_diag(inputs, name=None):
     return apply_op(lambda *xs: jax.scipy.linalg.block_diag(*xs), *ts)
 
 
+def _cdist_k(a, b, *, p):
+    diff = a[..., :, None, :] - b[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, -1), 1e-30))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(diff), -1)
+    return jnp.sum(jnp.abs(diff) ** p, -1) ** (1.0 / p)
+
+
 def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
-    def f(a, b):
-        diff = a[..., :, None, :] - b[..., None, :, :]
-        if p == 2.0:
-            return jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, -1), 1e-30))
-        if p == float("inf"):
-            return jnp.max(jnp.abs(diff), -1)
-        return jnp.sum(jnp.abs(diff) ** p, -1) ** (1.0 / p)
-    return apply_op(f, to_tensor_like(x), to_tensor_like(y), name="cdist")
+    return apply_op(_cdist_k, to_tensor_like(x), to_tensor_like(y),
+                    name="cdist", p=float(p))

@@ -66,26 +66,40 @@ def bitwise_not(x, out=None, name=None):
     return apply_op(jnp.bitwise_not, to_tensor_like(x))
 
 
+def _clip_k(a, *, mn, mx):
+    return jnp.clip(a, mn, mx)
+
+
 def clip(x, min=None, max=None, name=None):
     mn = unwrap(min) if min is not None else None
     mx = unwrap(max) if max is not None else None
-    return apply_op(lambda a: jnp.clip(a, mn, mx), to_tensor_like(x), name="clip")
+    return apply_op(_clip_k, to_tensor_like(x), name="clip", mn=mn, mx=mx)
+
+
+def _scale_bias_after_k(a, *, s, b):
+    return a * s + b
+
+
+def _scale_bias_before_k(a, *, s, b):
+    return (a + b) * s
 
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
     s, b = unwrap(scale), unwrap(bias)
-    if bias_after_scale:
-        out = apply_op(lambda a: a * s + b, to_tensor_like(x), name="scale")
-    else:
-        out = apply_op(lambda a: (a + b) * s, to_tensor_like(x), name="scale")
+    k = _scale_bias_after_k if bias_after_scale else _scale_bias_before_k
+    out = apply_op(k, to_tensor_like(x), name="scale", s=s, b=b)
     if act:
         from ..nn import functional as F
         out = getattr(F, act)(out)
     return out
 
 
+def _stanh_k(a, *, sa, sb):
+    return sb * jnp.tanh(sa * a)
+
+
 def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
-    return apply_op(lambda a: scale_b * jnp.tanh(scale_a * a), to_tensor_like(x))
+    return apply_op(_stanh_k, to_tensor_like(x), sa=scale_a, sb=scale_b)
 
 
 def multiplex(inputs, index, name=None):
@@ -98,33 +112,63 @@ def multiplex(inputs, index, name=None):
         idx, *ts, name="multiplex")
 
 
+def _addmm_k(i, a, b, *, beta, alpha):
+    return beta * i + alpha * (a @ b)
+
+
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
-    return apply_op(lambda i, a, b: beta * i + alpha * (a @ b),
-                    to_tensor_like(input), to_tensor_like(x), to_tensor_like(y),
-                    name="addmm")
+    return apply_op(_addmm_k, to_tensor_like(input), to_tensor_like(x),
+                    to_tensor_like(y), name="addmm", beta=beta, alpha=alpha)
+
+
+def _lerp_scalar_k(a, b, *, w):
+    return a + w * (b - a)
+
+
+def _lerp_k(a, b, w):
+    return a + w * (b - a)
 
 
 def lerp(x, y, weight, name=None):
     if isinstance(weight, (int, float)):
-        return apply_op(lambda a, b: a + weight * (b - a),
-                        to_tensor_like(x), to_tensor_like(y), name="lerp")
-    return apply_op(lambda a, b, w: a + w * (b - a),
-                    to_tensor_like(x), to_tensor_like(y), to_tensor_like(weight),
-                    name="lerp")
+        return apply_op(_lerp_scalar_k, to_tensor_like(x), to_tensor_like(y),
+                        name="lerp", w=weight)
+    return apply_op(_lerp_k, to_tensor_like(x), to_tensor_like(y),
+                    to_tensor_like(weight), name="lerp")
+
+
+def _nan_to_num_k(a, *, nan, posinf, neginf):
+    return jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf)
 
 
 def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
-    return apply_op(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
-                    to_tensor_like(x))
+    return apply_op(_nan_to_num_k, to_tensor_like(x), nan=nan, posinf=posinf,
+                    neginf=neginf)
+
+
+def _trapezoid_x_k(yy, xx, *, ax):
+    return jax.scipy.integrate.trapezoid(yy, xx, axis=ax)
+
+
+def _trapezoid_dx_k(yy, *, dx, ax):
+    return jax.scipy.integrate.trapezoid(yy, dx=dx, axis=ax)
 
 
 def trapezoid(y, x=None, dx=None, axis=-1, name=None):
     y = to_tensor_like(y)
     if x is not None:
-        return apply_op(lambda yy, xx: jax.scipy.integrate.trapezoid(yy, xx, axis=axis),
-                        y, to_tensor_like(x))
-    d = 1.0 if dx is None else dx
-    return apply_op(lambda yy: jax.scipy.integrate.trapezoid(yy, dx=d, axis=axis), y)
+        return apply_op(_trapezoid_x_k, y, to_tensor_like(x), ax=int(axis))
+    return apply_op(_trapezoid_dx_k, y, dx=1.0 if dx is None else dx,
+                    ax=int(axis))
+
+
+def _diff_k(*xs, pre, ap, n, ax):
+    kw = {}
+    if pre is not None:
+        kw["prepend"] = xs[pre]
+    if ap is not None:
+        kw["append"] = xs[ap]
+    return jnp.diff(xs[0], n=n, axis=ax, **kw)
 
 
 def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
@@ -134,69 +178,71 @@ def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
         pre = len(args); args.append(to_tensor_like(prepend))
     if append is not None:
         ap = len(args); args.append(to_tensor_like(append))
+    return apply_op(_diff_k, *args, name="diff", pre=pre, ap=ap, n=int(n),
+                    ax=int(axis))
 
-    def f(*xs):
-        kw = {}
-        if pre is not None:
-            kw["prepend"] = xs[pre]
-        if ap is not None:
-            kw["append"] = xs[ap]
-        return jnp.diff(xs[0], n=n, axis=axis, **kw)
-    return apply_op(f, *args, name="diff")
+
+def _cumsum_k(a, *, ax, dt):
+    return jnp.cumsum(a, axis=ax, dtype=dt)
 
 
 def cumsum(x, axis=None, dtype=None, name=None):
-    d = core.convert_dtype(dtype)
-    return apply_op(lambda a: jnp.cumsum(a, axis=axis, dtype=d), to_tensor_like(x))
+    return apply_op(_cumsum_k, to_tensor_like(x), ax=axis,
+                    dt=core.convert_dtype(dtype))
+
+
+def _cumprod_k(a, *, ax, dt):
+    return jnp.cumprod(a, axis=ax, dtype=dt)
 
 
 def cumprod(x, dim=None, dtype=None, name=None):
-    d = core.convert_dtype(dtype)
-    return apply_op(lambda a: jnp.cumprod(a, axis=dim, dtype=d), to_tensor_like(x))
+    return apply_op(_cumprod_k, to_tensor_like(x), ax=dim,
+                    dt=core.convert_dtype(dtype))
 
 
-def _cummaxmin(x, axis, dtype, fn):
+def _cummaxmin_k(a, *, which, flat, ax):
+    fn = jax.lax.cummax if which == "max" else jax.lax.cummin
+    a = a.ravel() if flat else a
+    axx = ax % a.ndim
+    cm = fn(a, axis=axx)
+    eq = a == cm  # positions achieving the running extremum
+    ar = jnp.arange(a.shape[axx]).reshape(
+        [-1 if i == axx else 1 for i in range(a.ndim)])
+    idx = jax.lax.cummax(jnp.where(eq, jnp.broadcast_to(ar, a.shape), -1),
+                         axis=axx)
+    return cm, idx
+
+
+def _cummaxmin(x, axis, dtype, which):
     x = to_tensor_like(x)
     d = core.convert_dtype(dtype) or jnp.int32
-    flat = axis is None
-    ax = 0 if axis is None else axis
-
-    def f(a):
-        a = a.ravel() if flat else a
-        axx = ax % a.ndim
-        cm = fn(a, axis=axx)
-        eq = a == cm  # positions achieving the running extremum
-        ar = jnp.arange(a.shape[axx]).reshape(
-            [-1 if i == axx else 1 for i in range(a.ndim)])
-        idx = jax.lax.cummax(jnp.where(eq, jnp.broadcast_to(ar, a.shape), -1),
-                             axis=axx)
-        return cm, idx
-
-    vals, idx = apply_op(f, x, n_outputs=2, name="cummaxmin")
+    vals, idx = apply_op(_cummaxmin_k, x, n_outputs=2, name="cummaxmin",
+                         which=which, flat=axis is None,
+                         ax=0 if axis is None else int(axis))
     return vals, Tensor(idx.data.astype(d))
 
 
 def cummax(x, axis=None, dtype="int64", name=None):
-    return _cummaxmin(x, axis, dtype, jax.lax.cummax)
+    return _cummaxmin(x, axis, dtype, "max")
 
 
 def cummin(x, axis=None, dtype="int64", name=None):
-    return _cummaxmin(x, axis, dtype, jax.lax.cummin)
+    return _cummaxmin(x, axis, dtype, "min")
+
+
+def _logcumsumexp_k(a, *, ax, dt):
+    if dt is not None:
+        a = a.astype(dt)
+    if ax is None:
+        a = a.ravel()
+        ax = 0
+    m = jax.lax.cummax(a, axis=ax)
+    return jnp.log(jnp.cumsum(jnp.exp(a - m), axis=ax)) + m
 
 
 def logcumsumexp(x, axis=None, dtype=None, name=None):
-    def f(a):
-        if dtype is not None:
-            from ..framework import core as _core
-            a = a.astype(_core.convert_dtype(dtype))
-        if axis is None:
-            a = a.ravel()
-            ax = 0
-        else:
-            ax = axis
-        m = jax.lax.cummax(a, axis=ax)
-        return jnp.log(jnp.cumsum(jnp.exp(a - m), axis=ax)) + m
-    return apply_op(f, to_tensor_like(x))
+    return apply_op(_logcumsumexp_k, to_tensor_like(x), ax=axis,
+                    dt=core.convert_dtype(dtype))
 
 
 isfinite = make_unary(jnp.isfinite, "isfinite")
@@ -204,8 +250,12 @@ isinf = make_unary(jnp.isinf, "isinf")
 isnan = make_unary(jnp.isnan, "isnan")
 
 
+def _add_scalar_k(a, *, v):
+    return a + v
+
+
 def increment(x, value=1.0, name=None):
-    x._inplace_from(apply_op(lambda a: a + value, x, name="increment"))
+    x._inplace_from(apply_op(_add_scalar_k, x, name="increment", v=value))
     return x
 
 
@@ -214,8 +264,12 @@ def divide_no_nan(x, y, name=None):
                     to_tensor_like(x), to_tensor_like(y))
 
 
+def _rsub_k(a, b, *, alpha):
+    return b - alpha * a
+
+
 def rsub(x, y, alpha=1.0):
-    return apply_op(lambda a, b: b - alpha * a, to_tensor_like(x), to_tensor_like(y))
+    return apply_op(_rsub_k, to_tensor_like(x), to_tensor_like(y), alpha=alpha)
 
 
 def inner(x, y, name=None):
@@ -230,12 +284,14 @@ def kron(x, y, name=None):
     return apply_op(jnp.kron, to_tensor_like(x), to_tensor_like(y))
 
 
+def _logit_k(a, *, eps):
+    if eps is not None:
+        a = jnp.clip(a, eps, 1.0 - eps)
+    return jnp.log(a / (1.0 - a))
+
+
 def logit(x, eps=None, name=None):
-    def f(a):
-        if eps is not None:
-            a = jnp.clip(a, eps, 1.0 - eps)
-        return jnp.log(a / (1.0 - a))
-    return apply_op(f, to_tensor_like(x))
+    return apply_op(_logit_k, to_tensor_like(x), eps=eps)
 
 
 def exp2(x, name=None):
@@ -249,8 +305,12 @@ def sinc(x, name=None):
     return apply_op(jnp.sinc, to_tensor_like(x))
 
 
+def _polygamma_k(a, *, n):
+    return jax.scipy.special.polygamma(n, a)
+
+
 def polygamma(x, n, name=None):
-    return apply_op(lambda a: jax.scipy.special.polygamma(n, a), to_tensor_like(x))
+    return apply_op(_polygamma_k, to_tensor_like(x), n=n)
 
 
 def gammaln(x, name=None):

@@ -29,33 +29,44 @@ def _axes(axis):
     return int(axis)
 
 
-def _reduce(jfn, x, axis, keepdim, dtype=None, name=""):
-    ax = _axes(axis)
-    d = core.convert_dtype(dtype)
-    def f(a):
-        out = jfn(a, axis=ax, keepdims=keepdim)
-        return out.astype(d) if d is not None else out
-    return apply_op(f, to_tensor_like(x), name=name)
+# keyword-only statics + a name-keyed registry keep the op body a single
+# module-level function, so repeated reductions hit the eager dispatch cache
+# (a per-call closure over `jfn`/`ax` would miss every time).
+_REDUCE_FNS = {
+    "sum": jnp.sum, "mean": jnp.mean, "prod": jnp.prod, "max": jnp.max,
+    "min": jnp.min, "nansum": jnp.nansum, "nanmean": jnp.nanmean,
+}
+
+
+def _reduce_k(a, *, op, ax, keepdim, dt):
+    out = _REDUCE_FNS[op](a, axis=ax, keepdims=keepdim)
+    return out.astype(dt) if dt is not None else out
+
+
+def _reduce(jfn_name, x, axis, keepdim, dtype=None, name=""):
+    return apply_op(_reduce_k, to_tensor_like(x), name=name, op=jfn_name,
+                    ax=_axes(axis), keepdim=bool(keepdim),
+                    dt=core.convert_dtype(dtype))
 
 
 def sum(x, axis=None, dtype=None, keepdim=False, name=None):
-    return _reduce(jnp.sum, x, axis, keepdim, dtype, "sum")
+    return _reduce("sum", x, axis, keepdim, dtype, "sum")
 
 
 def mean(x, axis=None, keepdim=False, name=None):
-    return _reduce(jnp.mean, x, axis, keepdim, None, "mean")
+    return _reduce("mean", x, axis, keepdim, None, "mean")
 
 
 def prod(x, axis=None, keepdim=False, dtype=None, name=None):
-    return _reduce(jnp.prod, x, axis, keepdim, dtype, "prod")
+    return _reduce("prod", x, axis, keepdim, dtype, "prod")
 
 
 def max(x, axis=None, keepdim=False, name=None):
-    return _reduce(jnp.max, x, axis, keepdim, None, "max")
+    return _reduce("max", x, axis, keepdim, None, "max")
 
 
 def min(x, axis=None, keepdim=False, name=None):
-    return _reduce(jnp.min, x, axis, keepdim, None, "min")
+    return _reduce("min", x, axis, keepdim, None, "min")
 
 
 amax = max
@@ -63,51 +74,62 @@ amin = min
 
 
 def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
-    return _reduce(jnp.nansum, x, axis, keepdim, dtype, "nansum")
+    return _reduce("nansum", x, axis, keepdim, dtype, "nansum")
 
 
 def nanmean(x, axis=None, keepdim=False, name=None):
-    return _reduce(jnp.nanmean, x, axis, keepdim, None, "nanmean")
+    return _reduce("nanmean", x, axis, keepdim, None, "nanmean")
+
+
+def _std_k(a, *, ax, dd, keepdim):
+    return jnp.std(a, axis=ax, ddof=dd, keepdims=keepdim)
 
 
 def std(x, axis=None, unbiased=True, keepdim=False, name=None):
-    ax = _axes(axis)
-    dd = 1 if unbiased else 0
-    return apply_op(lambda a: jnp.std(a, axis=ax, ddof=dd, keepdims=keepdim),
-                    to_tensor_like(x), name="std")
+    return apply_op(_std_k, to_tensor_like(x), name="std", ax=_axes(axis),
+                    dd=1 if unbiased else 0, keepdim=bool(keepdim))
+
+
+def _var_k(a, *, ax, dd, keepdim):
+    return jnp.var(a, axis=ax, ddof=dd, keepdims=keepdim)
 
 
 def var(x, axis=None, unbiased=True, keepdim=False, name=None):
-    ax = _axes(axis)
-    dd = 1 if unbiased else 0
-    return apply_op(lambda a: jnp.var(a, axis=ax, ddof=dd, keepdims=keepdim),
-                    to_tensor_like(x), name="var")
+    return apply_op(_var_k, to_tensor_like(x), name="var", ax=_axes(axis),
+                    dd=1 if unbiased else 0, keepdim=bool(keepdim))
+
+
+def _median_avg_k(a, *, ax, keepdim):
+    return jnp.median(a, axis=ax, keepdims=keepdim)
+
+
+def _median_flat_k(b, *, k, keepdim):
+    v = jnp.sort(b.ravel())[k]
+    return v.reshape([1] * b.ndim) if keepdim else v
+
+
+def _median_axis_k(b, *, ax, keepdim):
+    kk = jnp.full([1 if i == ax % b.ndim else s for i, s in enumerate(b.shape)],
+                  (b.shape[ax] - 1) // 2, jnp.int32)
+    v = jnp.take_along_axis(jnp.sort(b, axis=ax), kk, axis=ax)
+    return v if keepdim else jnp.squeeze(v, ax)
 
 
 def median(x, axis=None, keepdim=False, mode="avg", name=None):
     ax = _axes(axis)
     if mode == "avg":
-        return apply_op(lambda a: jnp.median(a, axis=ax, keepdims=keepdim),
-                        to_tensor_like(x), name="median")
+        return apply_op(_median_avg_k, to_tensor_like(x), name="median",
+                        ax=ax, keepdim=bool(keepdim))
     # mode="min": lower median (+ its index for a single-int axis —
     # upstream returns the (values, index) pair only in that case)
     x = to_tensor_like(x)
     a = x.data
     if ax is None:
         k = (a.size - 1) // 2
-        return apply_op(lambda b: jnp.sort(b.ravel())[k] if not keepdim
-                        else jnp.sort(b.ravel())[k].reshape([1] * b.ndim),
-                        x, name="median")
-    val = apply_op(
-        lambda b: jnp.take_along_axis(
-            jnp.sort(b, axis=ax),
-            jnp.full([1 if i == ax % b.ndim else s for i, s in enumerate(b.shape)],
-                     (b.shape[ax] - 1) // 2, jnp.int32), axis=ax)
-        if keepdim else jnp.squeeze(jnp.take_along_axis(
-            jnp.sort(b, axis=ax),
-            jnp.full([1 if i == ax % b.ndim else s for i, s in enumerate(b.shape)],
-                     (b.shape[ax] - 1) // 2, jnp.int32), axis=ax), ax),
-        x, name="median")
+        return apply_op(_median_flat_k, x, name="median", k=int(k),
+                        keepdim=bool(keepdim))
+    val = apply_op(_median_axis_k, x, name="median", ax=ax,
+                   keepdim=bool(keepdim))
     k = (a.shape[ax] - 1) // 2
     idx = jnp.take(jnp.argsort(a, axis=ax), jnp.asarray([k]), axis=ax)
     if not keepdim:
@@ -140,21 +162,8 @@ def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None,
         # mode="min" convention; NaNs sort last so a per-slice valid
         # count picks the right order statistic)
         x = to_tensor_like(x)
-
-        def val_fn(a):
-            if ax is None:
-                f = a.ravel()
-                valid = jnp.sum(~jnp.isnan(f)).astype(jnp.int32)
-                k = jnp.maximum((valid - 1) // 2, 0)
-                v = jnp.sort(f)[k]
-                return v.reshape([1] * a.ndim) if keepdim else v
-            valid = jnp.sum(~jnp.isnan(a), axis=ax,
-                            keepdims=True).astype(jnp.int32)
-            k = jnp.maximum((valid - 1) // 2, 0)
-            v = jnp.take_along_axis(jnp.sort(a, axis=ax), k, axis=ax)
-            return v if keepdim else jnp.squeeze(v, ax)
-
-        val = apply_op(val_fn, x, name="nanmedian")
+        val = apply_op(_nanmedian_min_k, x, name="nanmedian", ax=ax,
+                       keepdim=bool(keepdim))
         # upstream contract: the (values, index) pair only for a
         # single-int axis; axis=None returns the values alone
         if ax is None or _values_only:
@@ -167,52 +176,82 @@ def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None,
         if not keepdim:
             idx = jnp.squeeze(idx, ax)
         return val, Tensor(idx.astype(jnp.int64))
-    return apply_op(lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim),
-                    to_tensor_like(x), name="nanmedian")
+    return apply_op(_nanmedian_avg_k, to_tensor_like(x), name="nanmedian",
+                    ax=ax, keepdim=bool(keepdim))
+
+
+def _nanmedian_min_k(a, *, ax, keepdim):
+    if ax is None:
+        f = a.ravel()
+        valid = jnp.sum(~jnp.isnan(f)).astype(jnp.int32)
+        k = jnp.maximum((valid - 1) // 2, 0)
+        v = jnp.sort(f)[k]
+        return v.reshape([1] * a.ndim) if keepdim else v
+    valid = jnp.sum(~jnp.isnan(a), axis=ax,
+                    keepdims=True).astype(jnp.int32)
+    k = jnp.maximum((valid - 1) // 2, 0)
+    v = jnp.take_along_axis(jnp.sort(a, axis=ax), k, axis=ax)
+    return v if keepdim else jnp.squeeze(v, ax)
+
+
+def _nanmedian_avg_k(a, *, ax, keepdim):
+    return jnp.nanmedian(a, axis=ax, keepdims=keepdim)
+
+
+def _quantile_k(a, q, *, ax, keepdim, method):
+    return jnp.quantile(a, q, axis=ax, keepdims=keepdim, method=method)
 
 
 def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
-    ax = _axes(axis)
-    qq = unwrap(q)
-    return apply_op(
-        lambda a: jnp.quantile(a, jnp.asarray(qq), axis=ax, keepdims=keepdim,
-                               method=interpolation),
-        to_tensor_like(x), name="quantile")
+    return apply_op(_quantile_k, to_tensor_like(x), jnp.asarray(unwrap(q)),
+                    name="quantile", ax=_axes(axis), keepdim=bool(keepdim),
+                    method=interpolation)
+
+
+def _nanquantile_k(a, q, *, ax, keepdim, method):
+    return jnp.nanquantile(a, q, axis=ax, keepdims=keepdim, method=method)
 
 
 def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
-    ax = _axes(axis)
-    qq = unwrap(q)
-    return apply_op(
-        lambda a: jnp.nanquantile(a, jnp.asarray(qq), axis=ax, keepdims=keepdim,
-                                  method=interpolation),
-        to_tensor_like(x), name="nanquantile")
+    return apply_op(_nanquantile_k, to_tensor_like(x), jnp.asarray(unwrap(q)),
+                    name="nanquantile", ax=_axes(axis), keepdim=bool(keepdim),
+                    method=interpolation)
+
+
+def _logsumexp_k(a, *, ax, keepdim):
+    return jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim)
 
 
 def logsumexp(x, axis=None, keepdim=False, name=None):
-    ax = _axes(axis)
-    return apply_op(lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
-                    to_tensor_like(x), name="logsumexp")
+    return apply_op(_logsumexp_k, to_tensor_like(x), name="logsumexp",
+                    ax=_axes(axis), keepdim=bool(keepdim))
+
+
+def _all_k(a, *, ax, keepdim):
+    return jnp.all(a, axis=ax, keepdims=keepdim)
 
 
 def all(x, axis=None, keepdim=False, name=None):
-    ax = _axes(axis)
-    return apply_op(lambda a: jnp.all(a, axis=ax, keepdims=keepdim),
-                    to_tensor_like(x), name="all")
+    return apply_op(_all_k, to_tensor_like(x), name="all", ax=_axes(axis),
+                    keepdim=bool(keepdim))
+
+
+def _any_k(a, *, ax, keepdim):
+    return jnp.any(a, axis=ax, keepdims=keepdim)
 
 
 def any(x, axis=None, keepdim=False, name=None):
-    ax = _axes(axis)
-    return apply_op(lambda a: jnp.any(a, axis=ax, keepdims=keepdim),
-                    to_tensor_like(x), name="any")
+    return apply_op(_any_k, to_tensor_like(x), name="any", ax=_axes(axis),
+                    keepdim=bool(keepdim))
+
+
+def _count_nonzero_k(a, *, ax, keepdim):
+    return jnp.count_nonzero(a, axis=ax, keepdims=keepdim).astype(jnp.int64)
 
 
 def count_nonzero(x, axis=None, keepdim=False, name=None):
-    ax = _axes(axis)
-    return apply_op(
-        lambda a: jnp.count_nonzero(a, axis=ax,
-                                    keepdims=keepdim).astype(jnp.int64),
-        to_tensor_like(x), name="count_nonzero")
+    return apply_op(_count_nonzero_k, to_tensor_like(x), name="count_nonzero",
+                    ax=_axes(axis), keepdim=bool(keepdim))
 
 
 def mode(x, axis=-1, keepdim=False, name=None):
@@ -233,11 +272,8 @@ def mode(x, axis=-1, keepdim=False, name=None):
     hits = a == vals_b
     ar = jnp.broadcast_to(jnp.arange(n), a.shape)
     idx = jnp.max(jnp.where(hits, ar, -1), axis=-1)
-    out_val = apply_op(
-        lambda b: _squeeze_or_keep(
-            jnp.take_along_axis(jnp.moveaxis(b, ax, -1), idx[..., None], axis=-1),
-            ax, keepdim),
-        x, name="mode")
+    out_val = apply_op(_mode_gather_k, x, idx, name="mode", ax=ax,
+                       keepdim=bool(keepdim))
     idx_out = idx[..., None] if keepdim else idx
     if keepdim:
         idx_out = jnp.moveaxis(idx_out, -1, ax)
@@ -251,21 +287,28 @@ def _squeeze_or_keep(v, ax, keepdim):
     return v[..., 0]
 
 
+def _mode_gather_k(b, idx, *, ax, keepdim):
+    return _squeeze_or_keep(
+        jnp.take_along_axis(jnp.moveaxis(b, ax, -1), idx[..., None], axis=-1),
+        ax, keepdim)
+
+
+def _norm_k(a, *, p, ax, keepdim):
+    if p is None or p == "fro":
+        if ax is None:
+            return jnp.sqrt(jnp.sum(jnp.real(a * jnp.conj(a))))
+        return jnp.linalg.norm(a, ord=None, axis=ax, keepdims=keepdim)
+    if p == "nuc":
+        return jnp.linalg.norm(a, ord="nuc", axis=ax, keepdims=keepdim)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+    return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+
 def norm(x, p=None, axis=None, keepdim=False, name=None):
-    ax = _axes(axis)
-    def f(a):
-        if p is None or p == "fro":
-            if ax is None:
-                return jnp.sqrt(jnp.sum(jnp.real(a * jnp.conj(a))))
-            return jnp.linalg.norm(a, ord=None, axis=ax, keepdims=keepdim)
-        if p == "nuc":
-            return jnp.linalg.norm(a, ord="nuc", axis=ax, keepdims=keepdim)
-        if p == float("inf"):
-            r = jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
-            return r
-        if p == float("-inf"):
-            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
-        if p == 0:
-            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
-        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
-    return apply_op(f, to_tensor_like(x), name="norm")
+    return apply_op(_norm_k, to_tensor_like(x), name="norm", p=p,
+                    ax=_axes(axis), keepdim=bool(keepdim))

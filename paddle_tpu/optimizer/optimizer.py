@@ -89,11 +89,16 @@ class Optimizer:
             self._step_count = saved_count
 
     def state_dict(self):
+        # group state by param id ONCE — the former params × state nested
+        # scan was quadratic in model size (large models: thousands of
+        # params × several accumulators each)
+        by_pid: dict = {}
+        for (pid, name), v in self._state.items():
+            by_pid.setdefault(pid, []).append((name, v))
         out = {}
         for i, p in enumerate(self._parameter_list):
-            for (pid, name), v in self._state.items():
-                if pid == id(p):
-                    out[f"{p.name or i}.{name}"] = v
+            for name, v in by_pid.get(id(p), ()):
+                out[f"{p.name or i}.{name}"] = v
         out["@step"] = self._step_count
         if isinstance(self._lr, LRScheduler):
             out["LR_Scheduler"] = self._lr.state_dict()
@@ -101,13 +106,21 @@ class Optimizer:
 
     def set_state_dict(self, state):
         self._step_count = int(state.get("@step", 0))
+        # one pass over the state dict against a prefix index (param names
+        # may themselves contain dots, so try every '.'-split of each key)
+        prefix_map: dict = {}
         for i, p in enumerate(self._parameter_list):
-            prefix = f"{p.name or i}."
-            for k, v in state.items():
-                if isinstance(k, str) and k.startswith(prefix):
-                    name = k[len(prefix):]
+            prefix_map.setdefault(f"{p.name or i}.", []).append(p)
+        for k, v in state.items():
+            if not isinstance(k, str):
+                continue
+            pos = k.find(".")
+            while pos != -1:
+                for p in prefix_map.get(k[:pos + 1], ()):
+                    name = k[pos + 1:]
                     arr = v.data if isinstance(v, Tensor) else jnp.asarray(v)
                     self._state[(id(p), name)] = arr
+                pos = k.find(".", pos + 1)
         if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state:
             self._lr.set_state_dict(state["LR_Scheduler"])
 

@@ -16,7 +16,28 @@ from typing import Callable, Iterable, Optional
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "SummaryView"]
+           "SummaryView", "eager_dispatch_cache_stats",
+           "reset_eager_dispatch_cache_stats", "clear_eager_dispatch_cache"]
+
+
+def eager_dispatch_cache_stats() -> dict:
+    """Hit/miss/evict/bypass counters of the eager dispatch cache
+    (autograd/tape.apply_op; FLAGS_eager_dispatch_cache). Keys: hits,
+    misses, evictions, size, capacity, bypass_{flag,tracer,hooks,closure,
+    unhashable}."""
+    from ..autograd import tape
+    return tape.dispatch_cache_stats()
+
+
+def reset_eager_dispatch_cache_stats():
+    from ..autograd import tape
+    tape.reset_dispatch_cache_stats()
+
+
+def clear_eager_dispatch_cache():
+    """Drop cached executables AND zero the counters."""
+    from ..autograd import tape
+    tape.clear_dispatch_cache()
 
 
 class ProfilerState(enum.IntEnum):
@@ -197,6 +218,12 @@ class Profiler:
         print(f"-------------------  Profiler Summary  -------------------")
         print(f"steps: {n}   total: {tot*1000:.2f} ms   "
               f"avg: {tot/n*1000:.2f} ms")
+        s = eager_dispatch_cache_stats()
+        bp = "  ".join(f"{k}={v}" for k, v in sorted(s.items())
+                       if k.startswith("bypass_"))
+        print(f"eager dispatch cache: {s['hits']} hits  {s['misses']} misses  "
+              f"{s['evictions']} evictions  ({s['size']}/{s['capacity']} "
+              f"entries)  {bp}")
         if self._exported_dir or self._tracing:
             print(f"XLA trace: {self._dir} (open with TensorBoard XProf)")
 

@@ -195,26 +195,42 @@ class Tensor:
 
     # -- indexing -----------------------------------------------------------
     def __getitem__(self, idx):
-        idx = _map_index(idx)
-        return _tape.apply_op(lambda x: x[idx], self, name="getitem")
+        # module-level kernel + idx as a static kwarg: scalar/slice indexing
+        # is served from the eager dispatch cache (array indices bypass it)
+        return _tape.apply_op(_getitem_k, self, name="getitem",
+                              idx=_map_index(idx))
 
     def __setitem__(self, idx, value):
         idx = _map_index(idx)
         if isinstance(value, (int, float, bool)):
-            new = _tape.apply_op(lambda x: x.at[idx].set(value), self, name="setitem")
+            new = _tape.apply_op(_setitem_scalar_k, self, name="setitem",
+                                 idx=idx, value=value)
         else:
             # keep the value's tape node: grads must flow into the assigned
             # tensor (ref: eager inplace-version semantics)
             vt = value if isinstance(value, Tensor) else Tensor(value)
-            new = _tape.apply_op(
-                lambda x, v: x.at[idx].set(v.astype(x.dtype)),
-                self, vt, name="setitem")
+            new = _tape.apply_op(_setitem_k, self, vt, name="setitem", idx=idx)
         self._inplace_from(new)
 
     # -- iteration ----------------------------------------------------------
     def __iter__(self):
-        for i in range(len(self)):
-            yield self[i]
+        # ONE unbind dispatch for the whole loop instead of one getitem op
+        # per row (N tape dispatches -> 1; the rows share a single GradNode).
+        # Rows are materialized up front, so mutations during iteration are
+        # not reflected in later rows. Huge leading dims fall back to lazy
+        # getitem: a single op with 10^5 outputs costs more to build/compile
+        # than it saves.
+        if self.ndim == 0:
+            raise TypeError("iteration over a 0-d tensor")
+        n = self.data.shape[0]
+        if n == 0:
+            return
+        if n > 1024:
+            for i in range(n):
+                yield self[i]
+            return
+        from .ops.manipulation import unbind  # local import: avoid cycle
+        yield from unbind(self, axis=0)
 
     # -- pytree -------------------------------------------------------------
     def tree_flatten(self):
@@ -224,6 +240,18 @@ class Tensor:
     def tree_unflatten(cls, aux, children):
         t = cls(children[0], stop_gradient=aux[0], name=aux[1])
         return t
+
+
+def _getitem_k(x, *, idx):
+    return x[idx]
+
+
+def _setitem_scalar_k(x, *, idx, value):
+    return x.at[idx].set(value)
+
+
+def _setitem_k(x, v, *, idx):
+    return x.at[idx].set(v.astype(x.dtype))
 
 
 def _map_index(idx):
