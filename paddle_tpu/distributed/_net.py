@@ -1,15 +1,24 @@
-"""Small socket tuning shared by the host-side channels (collective
-p2p, parameter server, rpc, elastic).
+"""Small socket tuning + connect hardening shared by the host-side
+channels (collective p2p, parameter server, rpc, elastic).
 
 multiprocessing.connection sockets leave Nagle's algorithm on; the
 request/response patterns here (pull -> small reply -> push) then pay
 the classic Nagle + delayed-ACK ~40 ms stall per round trip (measured
 by tools/ps_benchmark.py: 44 ms socket_pull_us before this fix).
 TCP_NODELAY is the standard fix for latency-bound RPC.
+
+`connect_with_retry` is the one bounded retry/backoff implementation for
+every authenticated client connect (rpc registry, worker calls, elastic
+membership polls) — a peer mid-restart or a dropped SYN must not fail
+the first caller, while a persistent authkey mismatch must fail FAST
+with its real type instead of hanging the full window disguised as
+unreachability.
 """
 from __future__ import annotations
 
-__all__ = ["enable_nodelay"]
+import time
+
+__all__ = ["enable_nodelay", "connect_with_retry"]
 
 
 def enable_nodelay(conn) -> None:
@@ -32,3 +41,46 @@ def enable_nodelay(conn) -> None:
         pass        # unix socket / already closed
     finally:
         s.close()
+
+
+def connect_with_retry(addr, authkey_fn, timeout_s: float,
+                       describe: str = "endpoint",
+                       auth_hint=None,
+                       fault_name: str = "rpc.connect"):
+    """Authenticated Client(addr) with exponential backoff.
+
+    Transient failures (ConnectionError/OSError) retry up to `timeout_s`;
+    AuthenticationError is retried only briefly (2s — the
+    mid-keyfile-creation race window) then re-raised with its real type
+    plus `auth_hint()` (a lazy suffix naming the key source).
+    `authkey_fn` is called per attempt so rotated keyfiles are picked up.
+    The `fault_name` fault point sits INSIDE the retry loop: an armed
+    `raise:ConnectionError@1` exercises exactly the retry path a refused
+    connect takes, while a plain `raise` (FaultInjected) escapes it.
+    """
+    from multiprocessing import AuthenticationError
+    from multiprocessing.connection import Client
+
+    from paddle_tpu.utils.fault_injection import fault_point
+
+    start = time.time()
+    deadline = start + timeout_s
+    wait = 0.05
+    while True:
+        try:
+            fault_point(fault_name)
+            c = Client(addr, authkey=authkey_fn())
+            enable_nodelay(c)
+            return c
+        except AuthenticationError as e:
+            if time.time() > start + 2.0:
+                hint = auth_hint() if auth_hint is not None else ""
+                raise AuthenticationError(
+                    f"{e or 'digest mismatch'}{hint}") from e
+        except (ConnectionError, OSError) as e:
+            if time.time() > deadline:
+                raise ConnectionError(
+                    f"{describe} {addr} unreachable after "
+                    f"{timeout_s:.0f}s: {e}") from e
+        time.sleep(wait)
+        wait = min(wait * 2, 1.0)

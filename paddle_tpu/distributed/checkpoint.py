@@ -4,34 +4,62 @@ save_state_dict, load_state_dict.py — per-rank shard files + a global
 `metadata` mapping tensor -> (file, offset) with resharding across
 different mesh/degree on load).
 
-TPU-native layout: one `.metadata.json` (tensor name -> dtype, global
-shape, shard files with index slices) plus per-process `.shard_{i}.npz`
-holding the locally-addressable shards. Under single-controller JAX one
-process usually addresses every device, so saves are one shard file; the
-format still records per-shard slices so a future multi-host run (or a
-differently-sharded reload) reads only what it needs — the same metadata
-idea as the reference. Loading `device_put`s each assembled tensor to the
-requested sharding: GSPMD-level "reshard on load".
+TPU-native layout: one `metadata.json` (tensor name -> dtype, global
+shape, per-blob CRC32 checksums and the coordinator's slice-coverage
+map) plus per-process `shard_{i}.npz` holding the locally-addressable
+shards and `shards_rank{i}.json` naming which slices that rank wrote.
+Under single-controller JAX one process usually addresses every device,
+so saves are one shard file; the format still records per-shard slices
+so a future multi-host run (or a differently-sharded reload) reads only
+what it needs — the same metadata idea as the reference. Loading
+`device_put`s each assembled tensor to the requested sharding:
+GSPMD-level "reshard on load".
+
+Durability (format v2): every file is committed via tmp + fsync +
+`os.replace` (framework.io.atomic_write), so a crash at any instant
+leaves no torn visible file; `metadata.json` is written LAST and is the
+commit point. On load the shard slices must exactly tile each tensor's
+global shape and every blob's CRC32 must match — missing / overlapping /
+corrupt shards raise `CheckpointError` instead of silently zero-filling,
+which is what makes ElasticManager's fall-back-to-previous-checkpoint
+recovery sound.
 
 Async: `save_state_dict(..., async_save=True)` snapshots to host then
-writes in a daemon thread (the reference gets this from its dedicated
-checkpoint threads; Orbax-style)."""
+writes in a background thread drawn from a bounded in-flight window
+(the reference gets this from its dedicated checkpoint threads;
+Orbax-style). A second async save to the SAME path waits for the
+in-flight one instead of racing it, and write errors are captured and
+re-raised by `wait_save()` or the next `save_state_dict` call — they do
+not die silently in a daemon thread."""
 from __future__ import annotations
 
 import json
 import os
 import threading
-from typing import Dict, Optional
+import zlib
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..framework.io import atomic_write
 from ..tensor import Tensor
 
-__all__ = ["save_state_dict", "load_state_dict", "wait_save"]
+__all__ = ["save_state_dict", "load_state_dict", "wait_save",
+           "verify_checkpoint", "CheckpointError"]
 
-_pending: list = []
+_FORMAT_V1 = "paddle_tpu.dist_ckpt.v1"
+_FORMAT_V2 = "paddle_tpu.dist_ckpt.v2"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint is incomplete, torn, or corrupt — the caller must NOT
+    trust its tensors (ElasticManager falls back to an older one)."""
+
+
+def _crc(data: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(data).tobytes()) & 0xFFFFFFFF
 
 
 def _to_host_shards(arr):
@@ -58,14 +86,67 @@ def _index_to_json(index, shape):
     return spec
 
 
+# -- bounded async-save machinery -------------------------------------------
+
+class _PendingSave:
+    def __init__(self, path: str):
+        self.path = path            # realpath of the checkpoint dir
+        self.thread: Optional[threading.Thread] = None
+        self.error: Optional[BaseException] = None
+
+
+_MAX_PENDING = max(1, int(os.environ.get("PADDLE_CKPT_MAX_PENDING", "2")))
+_pending: List[_PendingSave] = []   # in-flight saves, start order
+_async_errors: List[BaseException] = []
+
+
+def _join(rec: _PendingSave):
+    rec.thread.join()
+    if rec in _pending:
+        _pending.remove(rec)
+    if rec.error is not None:
+        _async_errors.append(rec.error)
+
+
+def _raise_async_errors():
+    for rec in [r for r in _pending if not r.thread.is_alive()]:
+        _join(rec)                  # reap finished threads
+    if _async_errors:
+        first = _async_errors[0]
+        extra = len(_async_errors) - 1
+        _async_errors.clear()
+        raise CheckpointError(
+            "async checkpoint save failed: %r%s" % (
+                first, " (+%d more)" % extra if extra else "")) from first
+
+
+def wait_save():
+    """Block until every in-flight async save lands; re-raise the first
+    captured write error (further errors are noted in the message)."""
+    while _pending:
+        _join(_pending[0])
+    _raise_async_errors()
+
+
+# -- save --------------------------------------------------------------------
+
 def save_state_dict(state_dict: Dict, path: str, process_group=None,
                     coordinator_rank: int = 0, async_save: bool = False):
-    """state_dict: name -> Tensor/array (possibly sharded over a mesh)."""
+    """state_dict: name -> Tensor/array (possibly sharded over a mesh).
+
+    Raises CheckpointError here if a PREVIOUS async save failed — the
+    error surfaces at the next checkpoint attempt instead of vanishing
+    in a daemon thread."""
+    _raise_async_errors()
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
+    world = jax.process_count()
 
-    meta = {"tensors": {}, "world_size": jax.process_count(),
-            "format": "paddle_tpu.dist_ckpt.v1"}
+    meta = {"tensors": {}, "world_size": world, "format": _FORMAT_V2,
+            # true when the coordinator's shard entries in this metadata
+            # are the WHOLE coverage map (single-controller common case);
+            # multi-host saves merge the per-rank index fragments instead
+            "coverage_complete": world == 1}
     rank_shards: Dict[str, list] = {}   # this rank's shard entries
     blobs = {}
     for name, t in state_dict.items():
@@ -79,37 +160,226 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
         for i, (index, data) in enumerate(shards):
             key = f"{name}::shard{i}"
             # npz has no portable bf16: store as f32 bytes, dtype in meta
-            blobs[key] = (data.astype(np.float32)
-                          if dtype_name == "bfloat16" else data)
+            stored = (data.astype(np.float32)
+                      if dtype_name == "bfloat16" else data)
+            blobs[key] = stored
             entries.append({
                 "key": key, "file": f"shard_{rank}.npz",
-                "slices": _index_to_json(index, shape)})
+                "slices": _index_to_json(index, shape),
+                "crc32": _crc(stored)})
         rank_shards[name] = entries
         meta["tensors"][name] = {
-            "dtype": dtype_name, "shape": list(shape)}
+            "dtype": dtype_name, "shape": list(shape),
+            # per-blob checksums + slice-coverage map (coordinator view)
+            "shards": entries}
 
     def _write():
-        np.savez(os.path.join(path, f"shard_{rank}.npz"), **blobs)
+        atomic_write(os.path.join(path, f"shard_{rank}.npz"),
+                     lambda f: np.savez(f, **blobs),
+                     fault_name="ckpt.write_shard")
         # every rank records which shards IT holds (a multi-host save
         # on a shared filesystem merges all fragments at load time —
         # the coordinator cannot see other ranks' addressable shards)
-        with open(os.path.join(path, f"shards_rank{rank}.json"), "w") as f:
-            json.dump(rank_shards, f)
+        frag = json.dumps(rank_shards).encode()
+        atomic_write(os.path.join(path, f"shards_rank{rank}.json"),
+                     lambda f: f.write(frag),
+                     fault_name="ckpt.write_index")
         if rank == coordinator_rank:
-            with open(os.path.join(path, "metadata.json"), "w") as f:
-                json.dump(meta, f)
+            # metadata last: its presence is the commit point
+            mb = json.dumps(meta).encode()
+            atomic_write(os.path.join(path, "metadata.json"),
+                         lambda f: f.write(mb),
+                         fault_name="ckpt.write_meta")
 
-    if async_save:
-        th = threading.Thread(target=_write, daemon=True)
-        th.start()
-        _pending.append(th)
-    else:
+    apath = os.path.realpath(path)
+    # any save to a path with an in-flight async save WAITS for it —
+    # concurrent writers to one directory share the pid-suffixed tmp
+    # names and would interleave torn state (sync saves included)
+    for rec in [r for r in _pending if r.path == apath]:
+        _join(rec)
+    if not async_save:
+        _raise_async_errors()
         _write()
+        return
+
+    while len(_pending) >= _MAX_PENDING:    # bounded in-flight window
+        _join(_pending[0])
+    _raise_async_errors()
+
+    rec = _PendingSave(apath)
+
+    def _run():
+        try:
+            _write()
+        except BaseException as e:      # captured; re-raised on the
+            rec.error = e               # caller's thread, never lost
+
+    rec.thread = threading.Thread(target=_run, daemon=True)
+    _pending.append(rec)
+    rec.thread.start()
 
 
-def wait_save():
-    while _pending:
-        _pending.pop().join()
+# -- load / verify -----------------------------------------------------------
+
+def _read_json(fp: str, desc: str):
+    try:
+        with open(fp) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        raise CheckpointError(f"checkpoint {desc} missing: {fp}") from None
+    except (json.JSONDecodeError, UnicodeDecodeError, OSError) as e:
+        raise CheckpointError(
+            f"checkpoint {desc} torn/unreadable: {fp}: {e}") from e
+
+
+def _read_index(path: str):
+    """metadata + merged per-rank shard map; raises CheckpointError on
+    missing/torn metadata or index fragments."""
+    meta = _read_json(os.path.join(path, "metadata.json"), "metadata")
+    if not isinstance(meta, dict) or "tensors" not in meta:
+        raise CheckpointError(
+            f"checkpoint metadata malformed: {path}/metadata.json")
+    fmt = meta.get("format", _FORMAT_V1)
+    if fmt not in (_FORMAT_V1, _FORMAT_V2):
+        raise CheckpointError(
+            f"unknown checkpoint format {fmt!r} in {path}")
+    world = int(meta.get("world_size", 1))
+    shard_map: Dict[str, list] = {}
+    for r in range(world):      # every rank's fragment must be present
+        frag = _read_json(os.path.join(path, f"shards_rank{r}.json"),
+                          f"shard index (rank {r})")
+        for name, entries in frag.items():
+            shard_map.setdefault(name, []).extend(entries)
+    return meta, shard_map
+
+
+def _dedup_replicas(entries):
+    """Replicated tensors are saved once per rank with identical slices;
+    keep one entry per distinct slice spec."""
+    seen = set()
+    out = []
+    for e in entries:
+        key = tuple(tuple(s) for s in e["slices"])
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(e)
+    return out
+
+
+def _verify_tiling(name: str, shape: tuple, entries: list, path: str):
+    """Shard slices must EXACTLY tile the global shape — a gap means a
+    lost shard (the old code zero-filled it), an overlap means two ranks
+    claim the same elements. Interval arithmetic only (in-bounds +
+    pairwise-disjoint + volumes summing to the tensor's): no dense
+    coverage array, so verifying a multi-GB tensor costs O(shards^2)
+    ints, not O(elements) host memory mid-crash-recovery."""
+    for e in entries:
+        sl = e["slices"]
+        if len(sl) != len(shape) or any(
+                not (0 <= a <= b <= dim)
+                for (a, b), dim in zip(sl, shape)):
+            raise CheckpointError(
+                f"shard slices {sl} for '{name}' out of bounds for "
+                f"shape {list(shape)} in {path}")
+
+    def _vol(slices):
+        v = 1
+        for a, b in slices:
+            v *= b - a
+        return v
+
+    boxes = [e["slices"] for e in entries if _vol(e["slices"])]
+    for i in range(len(boxes)):
+        for j in range(i + 1, len(boxes)):
+            # boxes intersect iff their intervals overlap in EVERY dim
+            # (vacuously true for 0-d scalars: duplicate claims)
+            if all(max(a1, a2) < min(b1, b2)
+                   for (a1, b1), (a2, b2) in zip(boxes[i], boxes[j])):
+                raise CheckpointError(
+                    f"shards for '{name}' do not tile shape "
+                    f"{list(shape)} in {path}: slices {boxes[i]} and "
+                    f"{boxes[j]} are multiply covered — refusing to "
+                    f"load")
+    total = 1
+    for dim in shape:
+        total *= dim
+    covered = sum(_vol(b) for b in boxes)
+    if covered != total:       # disjoint + in-bounds => covered <= total
+        raise CheckpointError(
+            f"shards for '{name}' do not tile shape {list(shape)} in "
+            f"{path}: {total - covered} element(s) uncovered — refusing "
+            f"to load (zero-filling gaps silently corrupts weights)")
+
+
+class _BlobReader:
+    """npz access with per-blob CRC32 verification; torn zip containers
+    and checksum mismatches surface as CheckpointError."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._files: Dict[str, object] = {}
+
+    def get(self, fname: str, key: str, crc: Optional[int]):
+        if fname not in self._files:
+            fp = os.path.join(self.path, fname)
+            try:
+                self._files[fname] = np.load(fp)
+            except FileNotFoundError:
+                raise CheckpointError(
+                    f"checkpoint shard file missing: {fp}") from None
+            except Exception as e:
+                raise CheckpointError(
+                    f"checkpoint shard file torn/unreadable: {fp}: "
+                    f"{e}") from e
+        try:
+            arr = self._files[fname][key]
+        except KeyError:
+            raise CheckpointError(
+                f"blob {key!r} missing from {fname} in "
+                f"{self.path}") from None
+        except CheckpointError:
+            raise
+        except Exception as e:      # zip member CRC failure on lazy read
+            raise CheckpointError(
+                f"blob {key!r} in {fname} torn/unreadable: {e}") from e
+        if crc is not None and _crc(arr) != crc:
+            raise CheckpointError(
+                f"checksum mismatch for blob {key!r} in {fname} "
+                f"(stored crc32 {crc}, recomputed {_crc(arr)}) — "
+                f"corrupt shard in {self.path}")
+        return arr
+
+    def close(self):
+        for z in self._files.values():
+            try:
+                z.close()
+            except Exception:
+                pass
+        self._files.clear()
+
+
+def verify_checkpoint(path: str, names=None) -> dict:
+    """Full integrity check WITHOUT assembling tensors: metadata + every
+    index fragment readable, shard slices exactly tile every tensor, and
+    every blob's CRC32 matches. Returns the metadata dict; raises
+    CheckpointError otherwise. ElasticManager.restore() runs this before
+    trusting a checkpoint."""
+    meta, shard_map = _read_index(path)
+    reader = _BlobReader(path)
+    try:
+        for name in (names if names is not None else meta["tensors"]):
+            info = meta["tensors"].get(name)
+            if info is None:
+                raise CheckpointError(
+                    f"tensor '{name}' not in checkpoint {path}")
+            entries = _dedup_replicas(shard_map.get(name, []))
+            _verify_tiling(name, tuple(info["shape"]), entries, path)
+            for sh in entries:
+                reader.get(sh["file"], sh["key"], sh.get("crc32"))
+    finally:
+        reader.close()
+    return meta
 
 
 def load_state_dict(state_dict: Dict, path: str, process_group=None,
@@ -118,36 +388,44 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
     """Fills `state_dict` (name -> Tensor with target shapes/shardings)
     in place, resharding saved shards as needed; also returns it.
     If `state_dict` is empty, reconstructs every tensor replicated (or per
-    `shardings`: name -> NamedSharding)."""
-    import glob as _glob
-    with open(os.path.join(path, "metadata.json")) as f:
-        meta = json.load(f)
-    shard_map: Dict[str, list] = {}
-    for frag in sorted(_glob.glob(os.path.join(path, "shards_rank*.json"))):
-        with open(frag) as f:
-            for name, entries in json.load(f).items():
-                shard_map.setdefault(name, []).extend(entries)
-    files = {}
-
-    def blob(fname, key):
-        if fname not in files:
-            files[fname] = np.load(os.path.join(path, fname))
-        return files[fname][key]
-
+    `shardings`: name -> NamedSharding). Integrity failures (missing or
+    overlapping shards, checksum mismatch, torn files) raise
+    CheckpointError before any target tensor is mutated."""
+    meta, shard_map = _read_index(path)
     names = list(state_dict.keys()) or list(meta["tensors"].keys())
     out = state_dict if state_dict else {}
-    for name in names:
-        info = meta["tensors"].get(name)
-        if info is None:
-            raise KeyError(f"{name} not in checkpoint {path}")
-        full = np.zeros(tuple(info["shape"]),
-                        dtype=np.dtype(info["dtype"]
-                                       if info["dtype"] != "bfloat16"
-                                       else np.float32))
-        for sh in shard_map.get(name, []):
-            idx = tuple(slice(a, b) for a, b in sh["slices"])
-            piece = blob(sh["file"], sh["key"])
-            full[idx] = piece.astype(full.dtype)
+    reader = _BlobReader(path)
+    assembled = {}
+    try:
+        # phase 1: assemble + verify on host — a corrupt blob found here
+        # leaves the caller's tensors untouched (no partial restore)
+        for name in names:
+            info = meta["tensors"].get(name)
+            if info is None:
+                raise CheckpointError(
+                    f"tensor '{name}' not in checkpoint {path}")
+            shape = tuple(info["shape"])
+            entries = _dedup_replicas(shard_map.get(name, []))
+            _verify_tiling(name, shape, entries, path)
+            full = np.empty(shape,
+                            dtype=np.dtype(info["dtype"]
+                                           if info["dtype"] != "bfloat16"
+                                           else np.float32))
+            for sh in entries:
+                piece = reader.get(sh["file"], sh["key"], sh.get("crc32"))
+                want = tuple(b - a for a, b in sh["slices"])
+                if tuple(piece.shape) != want:
+                    raise CheckpointError(
+                        f"blob {sh['key']!r} shape {tuple(piece.shape)} "
+                        f"!= declared slice shape {want} in {path}")
+                full[tuple(slice(a, b) for a, b in sh["slices"])] = \
+                    piece.astype(full.dtype)
+            assembled[name] = (info, full)
+    finally:
+        reader.close()
+
+    # phase 2: device placement / reshard
+    for name, (info, full) in assembled.items():
         if info["dtype"] == "bfloat16":
             arr = jnp.asarray(full, dtype=jnp.bfloat16)
         else:

@@ -7,18 +7,27 @@ ELASTIC :45).
 TPU-native: preemption/fault recovery is checkpoint-resume, not process
 membership — the coordinator (jax.distributed) already detects dead hosts.
 ElasticManager here drives the train loop: periodic async distributed
-checkpoints, automatic resume from the newest complete checkpoint, and a
-restart-on-exception policy matching the reference's FAULT_TOLERANCE
-level. The reference's etcd store maps to the filesystem/GCS path the
-checkpoints live in (SURVEY §5 'etcd -> coordination service')."""
+checkpoints, automatic resume from the newest COMPLETE checkpoint (each
+candidate is checksum/coverage-verified first; corrupt ones are
+quarantined as `step_N.corrupt` and the next-newest is tried), and a
+restart-on-exception policy with capped exponential backoff + jitter
+matching the reference's FAULT_TOLERANCE level. The reference's etcd
+store maps to the filesystem/GCS path the checkpoints live in (SURVEY §5
+'etcd -> coordination service'). Hangs (desynced peer, stuck collective)
+can be converted to restarts by passing `watchdog=` — the step runs
+under distributed/watchdog.CommWatchdog, whose abort path exits with the
+faulted-worker code for the launch layer to relaunch."""
 from __future__ import annotations
 
 import glob
 import os
+import random
 import shutil
 import time
+import warnings
 from typing import Callable, Optional
 
+from ..utils.fault_injection import fault_point
 from . import checkpoint as dck
 
 __all__ = ["ElasticManager", "ELASTIC_EXIT_CODE",
@@ -32,16 +41,33 @@ class ElasticManager:
 
     train_fn(state_dict, start_step) -> iterator of (step, state_dict)
     yielding after each step; the manager checkpoints every
-    `save_interval` steps and resumes from the newest checkpoint after a
-    crash (max_restarts attempts in-process; beyond that exits with
-    ELASTIC_EXIT_CODE for the launcher to relaunch)."""
+    `save_interval` steps and resumes from the newest complete checkpoint
+    after a crash (max_restarts attempts in-process; beyond that exits
+    with ELASTIC_EXIT_CODE for the launcher to relaunch).
+
+    backoff_base/backoff_max: restart N sleeps
+    min(backoff_max, backoff_base * 2**(N-1)) scaled by jitter in
+    [0.5, 1.5) — a fleet of preempted workers must not thundering-herd
+    the checkpoint store in lockstep.
+
+    watchdog: None, True, or a CommWatchdog instance — when set, every
+    train_step runs inside a watchdog section (timeout `step_timeout`,
+    default FLAGS_comm_timeout); with on_timeout='abort' a hung step
+    exits ELASTIC_EXIT_CODE so the launch layer relaunches and resume
+    picks up from the last complete checkpoint."""
 
     def __init__(self, ckpt_dir: str, save_interval: int = 100,
-                 keep: int = 2, max_restarts: int = 3):
+                 keep: int = 2, max_restarts: int = 3,
+                 backoff_base: float = 0.1, backoff_max: float = 5.0,
+                 watchdog=None, step_timeout: Optional[float] = None):
         self.ckpt_dir = ckpt_dir
         self.save_interval = save_interval
         self.keep = keep
         self.max_restarts = max_restarts
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.step_timeout = step_timeout
+        self.watchdog = watchdog
         os.makedirs(ckpt_dir, exist_ok=True)
 
     # -- checkpoint bookkeeping --------------------------------------------
@@ -52,7 +78,7 @@ class ElasticManager:
                 try:
                     out.append((int(os.path.basename(d)[5:]), d))
                 except ValueError:
-                    pass
+                    pass        # step_N.corrupt / foreign names
         return sorted(out)
 
     def latest(self):
@@ -75,27 +101,79 @@ class ElasticManager:
         for _, old in self._step_dirs()[:-self.keep]:
             shutil.rmtree(old, ignore_errors=True)
 
+    def _quarantine(self, path: str, err: Exception):
+        """Move a failed-validation checkpoint aside (never delete — a
+        human may want the forensics) so retries don't re-validate it."""
+        dst = path + ".corrupt"
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = f"{path}.corrupt.{n}"
+        try:
+            os.replace(path, dst)
+        except OSError:
+            dst = path + " (quarantine rename failed)"
+        warnings.warn(
+            f"[elastic] checkpoint {path} failed validation ({err}); "
+            f"quarantined as {dst}, falling back to an older checkpoint",
+            RuntimeWarning)
+
     def restore(self, state_dict):
-        step, path = self.latest()
-        if path is not None:
-            dck.load_state_dict(self._tensors_of(state_dict), path)
-        return step
+        """Load the newest checkpoint that passes validation (checksums
+        + slice coverage, enforced by load_state_dict before it mutates
+        any target tensor); corrupt/torn candidates are quarantined and
+        the next-newest is tried. Returns the restored step, or 0
+        (fresh start) when no complete checkpoint survives."""
+        for step, path in reversed(self._step_dirs()):
+            fault_point("elastic.restore")
+            try:
+                # load_state_dict verifies everything it reads (tiling +
+                # CRCs) BEFORE mutating any target tensor — a separate
+                # verify_checkpoint pass would read every blob twice
+                dck.load_state_dict(self._tensors_of(state_dict), path)
+                return step
+            except dck.CheckpointError as e:
+                self._quarantine(path, e)
+        return 0
 
     # -- managed loop -------------------------------------------------------
+    def _restart_delay(self, restarts: int) -> float:
+        d = min(self.backoff_max,
+                self.backoff_base * (2.0 ** max(restarts - 1, 0)))
+        return d * (0.5 + random.random())      # jitter in [0.5, 1.5)
+
+    def _wrap_step(self, train_step: Callable) -> Callable:
+        if not self.watchdog:
+            return train_step
+        from .watchdog import CommWatchdog
+        if isinstance(self.watchdog, CommWatchdog):
+            wd = self.watchdog
+            if self.step_timeout is not None:
+                wd.timeout = self.step_timeout
+        else:
+            # a PRIVATE watchdog — mutating the watch() singleton would
+            # silently flip every other user to on_timeout='abort'
+            kw = {} if self.step_timeout is None else \
+                {"timeout": self.step_timeout}
+            wd = self.watchdog = CommWatchdog(on_timeout="abort", **kw)
+        return wd.wrap(train_step, name="elastic.train_step")
+
     def run(self, make_state: Callable[[], dict],
             train_step: Callable[[dict, int], float],
             total_steps: int, on_restart: Optional[Callable] = None):
         """Runs train_step(state, step) for steps [resume..total); returns
         list of losses. Exceptions trigger restore+retry (FAULT_TOLERANCE
-        semantics)."""
+        semantics) with capped exponential backoff + jitter."""
         restarts = 0
         losses: dict = {}    # step -> loss; replayed steps overwrite
+        step_fn = self._wrap_step(train_step)
         while True:
             try:
                 state = make_state()
                 start = self.restore(state)
                 for step in range(start, total_steps):
-                    losses[step] = train_step(state, step)
+                    fault_point("elastic.train_step")
+                    losses[step] = step_fn(state, step)
                     nxt = step + 1
                     if nxt % self.save_interval == 0 or nxt == total_steps:
                         self.save(state, nxt)
@@ -106,7 +184,7 @@ class ElasticManager:
                     raise SystemExit(ELASTIC_EXIT_CODE)
                 if on_restart is not None:
                     on_restart(restarts)
-                time.sleep(0.1)
+                time.sleep(self._restart_delay(restarts))
 
 
 class MembershipManager:
@@ -160,6 +238,23 @@ class MembershipManager:
         from paddle_tpu.distributed._auth import derive_authkey
         return derive_authkey("PADDLE_ELASTIC_AUTHKEY", "elastic",
                               bind_host=self._addr(self.master_endpoint)[0])
+
+    def _connect(self, timeout_s: Optional[float] = None):
+        """Bounded retry/backoff client connect (shares
+        _net.connect_with_retry with the rpc module) — a master that is
+        mid-restart or briefly overloaded must not fail the first poll."""
+        from ._auth import authkey_source
+        from ._net import connect_with_retry
+        if timeout_s is None:
+            timeout_s = float(os.environ.get(
+                "PADDLE_ELASTIC_CONNECT_TIMEOUT", "5"))
+        return connect_with_retry(
+            self._addr(self.master_endpoint),
+            lambda: self._AUTH, timeout_s,
+            describe="elastic: master",
+            auth_hint=lambda: (" (elastic authkey: "
+                               f"{authkey_source('PADDLE_ELASTIC_AUTHKEY')})"),
+            fault_name="elastic.connect")
 
     # -- master side --------------------------------------------------------
     def start_master(self):
@@ -222,13 +317,13 @@ class MembershipManager:
     # -- node side ----------------------------------------------------------
     def start_heartbeat(self):
         import threading
-        from multiprocessing.connection import Client
 
         def beat():
             while not self._stop.is_set():
                 try:
-                    c = Client(self._addr(self.master_endpoint),
-                               authkey=self._AUTH)
+                    # short per-beat window: the NEXT interval retries
+                    # anyway, a long stall here would skew the TTL clock
+                    c = self._connect(timeout_s=min(self.interval, 2.0))
                     c.send(("beat", self.name, self.rank))
                     c.recv()
                     c.close()
@@ -242,12 +337,13 @@ class MembershipManager:
         return self
 
     def alive(self):
-        """Poll the membership view {name: rank} (master or any node)."""
-        from multiprocessing.connection import Client
-
+        """Poll the membership view {name: rank} (master or any node).
+        The client connect retries with bounded exponential backoff
+        (PADDLE_ELASTIC_CONNECT_TIMEOUT, default 5s) instead of failing
+        on the first refused connection."""
         if self._listener is not None:
             return self._alive_now()
-        c = Client(self._addr(self.master_endpoint), authkey=self._AUTH)
+        c = self._connect()
         try:
             c.send(("alive",))
             status, view = c.recv()

@@ -188,36 +188,20 @@ def get_all_worker_infos():
     return list(_state.workers.values())
 
 
+def _auth_hint() -> str:
+    from paddle_tpu.distributed._auth import authkey_source
+    return f" (rpc authkey: {authkey_source('PADDLE_RPC_AUTHKEY')})"
+
+
 def _connect_with_retry(addr, timeout_s: float):
     """Cross-host transport hardening shared by the registry connect and
-    worker calls: transient failures (peer restarting, SYN drop) retry
-    with exponential backoff up to `timeout_s`. AuthenticationError is
-    retried only briefly (2s — the mid-keyfile-creation race window); a
-    persistent key mismatch must fail FAST with its real type, not hang
-    the full window disguised as unreachability."""
-    from multiprocessing import AuthenticationError
-    start = time.time()
-    deadline = start + timeout_s
-    wait = 0.05
-    while True:
-        try:
-            c = Client(addr, authkey=_AUTH())
-            from paddle_tpu.distributed._net import enable_nodelay
-            enable_nodelay(c)
-            return c
-        except AuthenticationError as e:
-            if time.time() > start + 2.0:
-                from paddle_tpu.distributed._auth import authkey_source
-                raise AuthenticationError(
-                    f"{e or 'digest mismatch'} (rpc authkey: "
-                    f"{authkey_source('PADDLE_RPC_AUTHKEY')})") from e
-        except (ConnectionError, OSError) as e:
-            if time.time() > deadline:
-                raise ConnectionError(
-                    f"rpc: endpoint {addr} unreachable after "
-                    f"{timeout_s:.0f}s: {e}") from e
-        time.sleep(wait)
-        wait = min(wait * 2, 1.0)
+    worker calls — delegates to the channel-generic
+    _net.connect_with_retry (elastic membership polls share it)."""
+    from paddle_tpu.distributed._net import connect_with_retry
+    return connect_with_retry(addr, _AUTH, timeout_s,
+                              describe="rpc: endpoint",
+                              auth_hint=_auth_hint,
+                              fault_name="rpc.connect")
 
 
 def _call(to: str, fn, args, kwargs):

@@ -37,9 +37,13 @@ class CommWatchdog:
 
     def __init__(self, timeout: float = _DEFAULT_TIMEOUT,
                  on_timeout: str = "warn",
-                 logger: Optional[Callable[[str], None]] = None):
+                 logger: Optional[Callable[[str], None]] = None,
+                 on_fire: Optional[Callable[[str, float], None]] = None):
         self.timeout = timeout
         self.on_timeout = on_timeout
+        # observability hook (name, elapsed_s) — ElasticManager/chaos
+        # tests count conversions of hangs into restarts through this
+        self.on_fire = on_fire
         self._log = logger or (lambda msg: warnings.warn(
             msg, RuntimeWarning))
         self._lock = threading.Lock()
@@ -75,6 +79,11 @@ class CommWatchdog:
                        f"on rank {rank} — likely peer desync, preemption, "
                        "or a hung collective")
                 self._log(msg)
+                if self.on_fire is not None:
+                    try:
+                        self.on_fire(name, elapsed)
+                    except Exception:
+                        pass    # a broken hook must not kill the monitor
                 if self.on_timeout == "abort":
                     os._exit(self.FAULT_EXIT_CODE)
 

@@ -258,6 +258,10 @@ _flags: dict = {
     # per-call jax.vjp re-trace (kill switch for debugging)
     "FLAGS_eager_dispatch_cache": True,
     "FLAGS_eager_dispatch_cache_size": 1024,   # LRU bound (entries)
+    # -- chaos / robustness testing (consumed by utils/fault_injection):
+    # deterministic fault schedule, e.g. "ckpt.write_shard:crash@2" —
+    # empty = disarmed (fault_point() sites are a single bool check)
+    "FLAGS_fault_inject": "",
     # -- autotune (consumed by kernels/autotune.sweeps_enabled) --------
     "FLAGS_use_autotune": True,
     # kernel-route kill switches (the on-chip ablation levers; analog of
@@ -335,6 +339,9 @@ def _apply_flag(key, value):
             "false" if value == "auto_growth" else "true")
     elif key == "FLAGS_check_nan_inf_level":
         _flags["FLAGS_check_nan_inf_warn_only"] = bool(int(value) >= 1)
+    elif key == "FLAGS_fault_inject":
+        from ..utils import fault_injection
+        fault_injection.configure(value if isinstance(value, str) else None)
     elif key == "FLAGS_eager_dispatch_cache_size":
         from ..autograd import tape  # late: tape imports this module
         tape._dispatch_cache.resize(int(value))

@@ -3,17 +3,67 @@
 Format: pickle with Tensors swapped to numpy arrays (same spirit as the
 reference's pickle+binary-tensor format; orbax handles the distributed
 checkpoint path in paddle_tpu.distributed.checkpoint).
+
+Durability: every user-visible persistence write in this repo goes
+through `atomic_write` — tmp file + fsync + `os.replace` + directory
+fsync — so a crash at ANY instant leaves either the old complete file or
+the new complete file, never a torn one (a bare `open(path, "wb")`
+destroys the previous bytes at `path` the moment it opens).
+`tools/check_atomic_writes.py` lints the durability-critical modules for
+bare writes.
 """
 from __future__ import annotations
 
 import os
 import pickle
-from typing import Any
+from typing import Any, Callable
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..tensor import Parameter, Tensor
+from ..utils.fault_injection import fault_point
+
+
+def _fsync_dir(dirname: str) -> None:
+    """Persist a rename: fsync the directory entry (POSIX crash safety;
+    silently skipped where directories can't be opened, e.g. some
+    network/overlay filesystems)."""
+    try:
+        fd = os.open(dirname or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, write_fn: Callable, fault_name: str = "io.save"):
+    """Crash-safe file commit: `write_fn(f)` fills a same-directory tmp
+    file (pid-suffixed — concurrent processes never collide), which is
+    fsynced and `os.replace`d over `path`, then the directory entry is
+    fsynced. The fault point fires between write and rename with the tmp
+    path, so an armed `crash` leaves only the tmp (old file intact) and
+    an armed `torn_write` publishes a truncated blob — exactly the two
+    real-world failure shapes the checkpoint loader must detect."""
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    try:
+        with open(tmp, "wb") as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        fault_point(fault_name, file=tmp)
+        os.replace(tmp, path)
+        _fsync_dir(os.path.dirname(path))
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 class _TensorPayload:
@@ -54,8 +104,9 @@ def save(obj: Any, path: str, protocol: int = 4, **configs):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_pack(obj), f, protocol=protocol)
+    packed = _pack(obj)
+    atomic_write(path, lambda f: pickle.dump(packed, f, protocol=protocol),
+                 fault_name="io.save")
 
 
 def load(path: str, **configs):

@@ -127,8 +127,9 @@ def _pallas_fwd(q, k, v, mask, scale, bias=None, interpret=None):
 
     if interpret is None:
         interpret = not _on_tpu()
-    params = pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    # jax >= 0.7 renamed TPUCompilerParams -> CompilerParams
+    _CP = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    params = _CP(dimension_semantics=("parallel", "parallel", "arbitrary"))
     mask_spec = (pl.BlockSpec((bq, bk), lambda n, i, j: (i, j)) if use_mask
                  else pl.BlockSpec((bq, bk), lambda n, i, j: (0, 0)))
     bias_spec = (pl.BlockSpec((1, bq, bk), lambda n, i, j: (n, i, j))

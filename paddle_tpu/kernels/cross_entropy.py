@@ -127,8 +127,9 @@ def _pallas_common(n, v, bn, bv):
     grid = (pl.cdiv(n, bn), pl.cdiv(v, bv))
     x_spec = pl.BlockSpec((bn, bv), lambda i, j: (i, j))
     row_spec = pl.BlockSpec((bn, 1), lambda i, j: (i, 0))
-    params = pltpu.CompilerParams(
-        dimension_semantics=("parallel", "arbitrary"))
+    # jax >= 0.7 renamed TPUCompilerParams -> CompilerParams
+    _CP = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    params = _CP(dimension_semantics=("parallel", "arbitrary"))
     return pl, pltpu, grid, x_spec, row_spec, params
 
 

@@ -17,7 +17,17 @@ from typing import Callable, Iterable, Optional
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
            "SummaryView", "eager_dispatch_cache_stats",
-           "reset_eager_dispatch_cache_stats", "clear_eager_dispatch_cache"]
+           "reset_eager_dispatch_cache_stats", "clear_eager_dispatch_cache",
+           "fault_injection_stats"]
+
+
+def fault_injection_stats() -> dict:
+    """Hit/trigger counters of the deterministic fault-injection harness
+    (utils/fault_injection; FLAGS_fault_inject). Returns
+    {'enabled': bool, 'points': {name: {'hits': n, 'triggered': m}}} —
+    chaos tests assert the armed fault actually fired through this."""
+    from ..utils import fault_injection
+    return fault_injection.stats()
 
 
 def eager_dispatch_cache_stats() -> dict:
@@ -224,6 +234,13 @@ class Profiler:
         print(f"eager dispatch cache: {s['hits']} hits  {s['misses']} misses  "
               f"{s['evictions']} evictions  ({s['size']}/{s['capacity']} "
               f"entries)  {bp}")
+        fi = fault_injection_stats()
+        if fi["enabled"] or fi["points"]:
+            pts = "  ".join(
+                f"{n}={v['hits']}/{v['triggered']}"
+                for n, v in fi["points"].items())
+            print(f"fault injection ({'armed' if fi['enabled'] else 'off'}; "
+                  f"point=hits/triggered): {pts}")
         if self._exported_dir or self._tracing:
             print(f"XLA trace: {self._dir} (open with TensorBoard XProf)")
 

@@ -1,9 +1,10 @@
 """paddle.utils (ref: python/paddle/utils/)."""
 from . import cpp_extension  # noqa: F401
 from . import dlpack  # noqa: F401
+from . import fault_injection  # noqa: F401
 
-__all__ = ["cpp_extension", "dlpack", "run_check", "try_import",
-           "deprecated", "require_version"]
+__all__ = ["cpp_extension", "dlpack", "fault_injection", "run_check",
+           "try_import", "deprecated", "require_version"]
 
 
 def deprecated(update_to="", since="", reason="", level=0):

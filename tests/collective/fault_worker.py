@@ -1,0 +1,59 @@
+"""Chaos worker for the durable-checkpoint acceptance test.
+
+Runs a deterministic counter-training loop (w += 1 per step, checkpoint
+every step) under ElasticManager. The PARENT test arms
+`FLAGS_fault_inject=ckpt.write_shard:crash@N` in the environment of the
+first incarnation, so this process dies mid-shard-write (torn tmp file,
+no visible checkpoint commit) and the parent relaunches it — the second
+incarnation must resume from the last COMPLETE checkpoint with bitwise
+the saved tensors and finish training.
+
+argv: out_json ckpt_dir total_steps
+Writes {restored_step, restored_w, final_w, losses_len} to out_json.
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.elastic import ElasticManager
+
+
+def main():
+    out_json, ckpt_dir, total = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    em = ElasticManager(ckpt_dir, save_interval=1, keep=2, max_restarts=0,
+                        backoff_base=0.01)
+
+    def make_state():
+        return {"w": paddle.to_tensor(np.zeros(4, np.float32))}
+
+    # probe what restore() hands this incarnation (run() re-restores
+    # internally — the checkpoint files are read-only here, so the
+    # double restore is byte-identical)
+    probe = make_state()
+    restored_step = em.restore(probe)
+    restored_w = np.asarray(probe["w"].numpy()).tolist()
+
+    def train_step(state, step):
+        state["w"].data = state["w"].data + 1.0
+        return float(step)
+
+    losses = em.run(make_state, train_step, total_steps=total)
+
+    final = make_state()
+    final_step = em.restore(final)
+    with open(out_json + ".tmp", "w") as f:
+        json.dump({"restored_step": restored_step,
+                   "restored_w": restored_w,
+                   "final_step": final_step,
+                   "final_w": np.asarray(final["w"].numpy()).tolist(),
+                   "losses_len": len(losses)}, f)
+    os.replace(out_json + ".tmp", out_json)
+
+
+if __name__ == "__main__":
+    main()

@@ -64,6 +64,88 @@ def test_bf16_roundtrip():
             np.asarray(x, dtype=np.float32))
 
 
+def test_metadata_records_checksums_and_coverage():
+    """v2 format: per-blob CRC32 + the coordinator's slice-coverage map
+    live in metadata.json; verify_checkpoint passes on a healthy dir."""
+    import json
+
+    from paddle_tpu.distributed.checkpoint import verify_checkpoint
+    sd = {"w": paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))}
+    with tempfile.TemporaryDirectory() as d:
+        save_state_dict(sd, d)
+        with open(os.path.join(d, "metadata.json")) as f:
+            meta = json.load(f)
+        assert meta["format"] == "paddle_tpu.dist_ckpt.v2"
+        assert meta["coverage_complete"] is True
+        sh = meta["tensors"]["w"]["shards"]
+        assert sh[0]["crc32"] > 0 and sh[0]["slices"] == [[0, 3], [0, 4]]
+        assert verify_checkpoint(d)["tensors"]["w"]["shape"] == [3, 4]
+
+
+def test_missing_shard_raises_not_zero_fill():
+    """A tensor whose shards are absent must raise CheckpointError —
+    the old code silently zero-filled the gap."""
+    import json
+
+    from paddle_tpu.distributed.checkpoint import CheckpointError
+    sd = {"w": paddle.to_tensor(np.ones((2, 2), np.float32)),
+          "b": paddle.to_tensor(np.ones(3, np.float32))}
+    with tempfile.TemporaryDirectory() as d:
+        save_state_dict(sd, d)
+        frag_p = os.path.join(d, "shards_rank0.json")
+        with open(frag_p) as f:
+            frag = json.load(f)
+        del frag["b"]           # lose b's shard entries
+        with open(frag_p, "w") as f:
+            json.dump(frag, f)
+        with pytest.raises(CheckpointError, match="uncovered"):
+            load_state_dict({}, d)
+        # w alone still loads (per-tensor validation)
+        out = load_state_dict({"w": paddle.to_tensor(
+            np.zeros((2, 2), np.float32))}, d)
+        np.testing.assert_array_equal(out["w"].numpy(), np.ones((2, 2)))
+
+
+def test_missing_name_raises_checkpoint_error():
+    from paddle_tpu.distributed.checkpoint import CheckpointError
+    sd = {"w": paddle.to_tensor(np.ones(2, np.float32))}
+    with tempfile.TemporaryDirectory() as d:
+        save_state_dict(sd, d)
+        with pytest.raises(CheckpointError, match="not in checkpoint"):
+            load_state_dict({"nope": paddle.to_tensor(
+                np.zeros(2, np.float32))}, d)
+
+
+def test_async_save_error_propagates_to_next_save():
+    """A failed async save must surface at wait_save() AND at the next
+    save_state_dict — not die silently in a daemon thread."""
+    from paddle_tpu.distributed.checkpoint import CheckpointError
+    from paddle_tpu.utils import fault_injection as fi
+    sd = {"x": paddle.to_tensor(np.ones(4, np.float32))}
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            fi.configure("ckpt.write_shard:raise@1")
+            save_state_dict(sd, os.path.join(d, "a"), async_save=True)
+            with pytest.raises(CheckpointError, match="async"):
+                wait_save()
+            # error consumed; a fresh save works
+            save_state_dict(sd, os.path.join(d, "b"))
+
+            fi.configure("ckpt.write_shard:raise@1")
+            save_state_dict(sd, os.path.join(d, "c"), async_save=True)
+            import paddle_tpu.distributed.checkpoint as dck
+            while dck._pending and dck._pending[0].thread.is_alive():
+                dck._pending[0].thread.join()
+            with pytest.raises(CheckpointError, match="async"):
+                save_state_dict(sd, os.path.join(d, "e"))
+    finally:
+        fi.configure(None)
+        try:
+            wait_save()
+        except CheckpointError:
+            pass
+
+
 def test_model_checkpoint_resume_training():
     """Save mid-training, reload into a fresh model+optimizer, losses align
     (the elastic-restart correctness property)."""

@@ -1,0 +1,414 @@
+"""Chaos suite: deterministic fault injection (utils/fault_injection),
+the durable-checkpoint commit protocol (tmp+fsync+replace, CRC32,
+slice-coverage), and ElasticManager's validate/quarantine/fall-back
+recovery. The subprocess scenarios are the acceptance criteria of
+ISSUE 2: a process killed mid-shard-write must resume from the last
+COMPLETE checkpoint with bitwise-identical tensors and finish."""
+import importlib.util
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import checkpoint as dck
+from paddle_tpu.distributed.checkpoint import (
+    CheckpointError, load_state_dict, save_state_dict, verify_checkpoint,
+    wait_save)
+from paddle_tpu.distributed.elastic import ElasticManager
+from paddle_tpu.utils import fault_injection as fi
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test leaves the harness disarmed and the async queue clean."""
+    yield
+    fi.configure(None)
+    try:
+        wait_save()
+    except CheckpointError:
+        pass
+
+
+def _flip_byte(path):
+    """Bit-flip one byte of one STORED TENSOR inside the npz, rewriting
+    a valid zip container (consistent member CRCs) — detection must come
+    from the checkpoint's own recorded CRC32, not from zipfile. (A naive
+    flip at the file midpoint can land in zip padding and corrupt
+    nothing.)"""
+    with np.load(path) as z:
+        data = {k: np.array(z[k]) for k in z.files}
+    k = sorted(data)[0]
+    data[k].reshape(-1).view(np.uint8)[0] ^= 0xFF
+    with open(str(path) + ".tmp", "wb") as f:
+        np.savez(f, **data)
+    os.replace(str(path) + ".tmp", path)
+
+
+# -- the fault-injection subsystem itself ------------------------------------
+
+class TestFaultPoint:
+    def test_disarmed_is_noop(self):
+        fi.configure(None)
+        for _ in range(3):
+            fi.fault_point("ckpt.write_shard")
+        s = fi.stats()
+        assert s["enabled"] is False and s["points"] == {}
+
+    def test_grammar_errors(self):
+        for bad in ("justapoint", "p:unknown_action@1", "p:raise@zero",
+                    "p:raise:NoSuchError@1", "p:delay:abc", "p:raise@0",
+                    "p:torn_write:arg@1", "p:crash:notanint"):
+            with pytest.raises(fi.FaultConfigError):
+                fi.configure(bad)
+
+    def test_raise_at_nth_hit_fires_once(self):
+        fi.configure("p.x:raise@3")
+        fi.fault_point("p.x")
+        fi.fault_point("p.x")
+        with pytest.raises(fi.FaultInjected):
+            fi.fault_point("p.x")
+        fi.fault_point("p.x")       # armed plan fired — later hits pass
+        s = fi.stats()["points"]["p.x"]
+        assert s["hits"] == 4 and s["triggered"] == 1
+
+    def test_raise_named_exception(self):
+        fi.configure("p.y:raise:ConnectionError@1")
+        with pytest.raises(ConnectionError):
+            fi.fault_point("p.y")
+
+    def test_delay(self):
+        fi.configure("p.d:delay:0.2@1")
+        t0 = time.monotonic()
+        fi.fault_point("p.d")
+        assert time.monotonic() - t0 >= 0.15
+
+    def test_multiple_plans_and_semicolons(self):
+        fi.configure("a:raise@2; b:raise@1")
+        with pytest.raises(fi.FaultInjected):
+            fi.fault_point("b")
+        fi.fault_point("a")
+        with pytest.raises(fi.FaultInjected):
+            fi.fault_point("a")
+
+    def test_torn_write_truncates_and_continues(self, tmp_path):
+        p = tmp_path / "blob.bin"
+        p.write_bytes(b"x" * 100)
+        fi.configure("p.t:torn_write@1")
+        fi.fault_point("p.t", file=str(p))      # no raise
+        assert p.stat().st_size == 50
+
+    def test_set_flags_routes_to_configure(self):
+        paddle.set_flags({"FLAGS_fault_inject": "p.f:raise@1"})
+        try:
+            assert fi.enabled()
+            with pytest.raises(fi.FaultInjected):
+                fi.fault_point("p.f")
+        finally:
+            paddle.set_flags({"FLAGS_fault_inject": ""})
+        assert not fi.enabled()
+
+    def test_profiler_exposes_counters(self):
+        from paddle_tpu.profiler import fault_injection_stats
+        fi.configure("p.z:delay:0@1")
+        fi.fault_point("p.z")
+        s = fault_injection_stats()
+        assert s["enabled"] and s["points"]["p.z"]["triggered"] == 1
+
+    def test_crash_exits_process(self):
+        """crash = os._exit: no cleanup, no atexit — run in a child.
+        fault_injection is stdlib-only, so load it by path (fast)."""
+        code = (
+            "import importlib.util\n"
+            f"spec = importlib.util.spec_from_file_location('fi', "
+            f"{str(REPO / 'paddle_tpu/utils/fault_injection.py')!r})\n"
+            "fi = importlib.util.module_from_spec(spec)\n"
+            "spec.loader.exec_module(fi)\n"
+            "fi.configure('x:crash@1')\n"
+            "fi.fault_point('x')\n"
+            "print('UNREACHED')\n")
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=60)
+        assert r.returncode == 137
+        assert "UNREACHED" not in r.stdout
+
+
+# -- durable checkpoint commit protocol --------------------------------------
+
+class TestDurableCheckpoint:
+    def test_kill_mid_shard_write_leaves_no_visible_file(self, tmp_path):
+        """raise between tmp write and rename == crash before commit:
+        only the tmp exists, and it is cleaned up on the error path."""
+        sd = {"w": paddle.to_tensor(np.ones(4, np.float32))}
+        fi.configure("ckpt.write_shard:raise@1")
+        with pytest.raises(fi.FaultInjected):
+            save_state_dict(sd, str(tmp_path))
+        assert not (tmp_path / "shard_0.npz").exists()
+        assert not (tmp_path / "metadata.json").exists()
+
+    def test_torn_shard_blob_detected_by_checksum(self, tmp_path):
+        sd = {"w": paddle.to_tensor(np.arange(8, dtype=np.float32))}
+        fi.configure("ckpt.write_shard:torn_write@1")
+        save_state_dict(sd, str(tmp_path))      # torn npz published
+        fi.configure(None)
+        with pytest.raises(CheckpointError):
+            verify_checkpoint(str(tmp_path))
+        with pytest.raises(CheckpointError):
+            load_state_dict({}, str(tmp_path))
+
+    def test_overlapping_shards_raise(self, tmp_path):
+        sd = {"w": paddle.to_tensor(
+            np.arange(12, dtype=np.float32).reshape(3, 4))}
+        save_state_dict(sd, str(tmp_path))
+        frag = json.loads((tmp_path / "shards_rank0.json").read_text())
+        e = dict(frag["w"][0])
+        e["slices"] = [[1, 3], [0, 4]]          # overlaps rows 1-2
+        frag["w"] = [{**frag["w"][0], "slices": [[0, 2], [0, 4]]}, e]
+        (tmp_path / "shards_rank0.json").write_text(json.dumps(frag))
+        with pytest.raises(CheckpointError, match="tile|multiply"):
+            verify_checkpoint(str(tmp_path))
+
+    def test_out_of_bounds_slices_raise(self, tmp_path):
+        sd = {"w": paddle.to_tensor(np.ones((2, 2), np.float32))}
+        save_state_dict(sd, str(tmp_path))
+        frag = json.loads((tmp_path / "shards_rank0.json").read_text())
+        frag["w"][0]["slices"] = [[0, 3], [0, 2]]
+        (tmp_path / "shards_rank0.json").write_text(json.dumps(frag))
+        with pytest.raises(CheckpointError, match="out of bounds"):
+            load_state_dict({}, str(tmp_path))
+
+    def test_failed_load_leaves_targets_untouched(self, tmp_path):
+        """Integrity failure must not partially overwrite live weights."""
+        sd = {"a": paddle.to_tensor(np.ones(4, np.float32)),
+              "b": paddle.to_tensor(np.full(4, 2.0, np.float32))}
+        save_state_dict(sd, str(tmp_path))
+        _flip_byte(tmp_path / "shard_0.npz")
+        tgt = {"a": paddle.to_tensor(np.full(4, 7.0, np.float32)),
+               "b": paddle.to_tensor(np.full(4, 9.0, np.float32))}
+        with pytest.raises(CheckpointError):
+            load_state_dict(tgt, str(tmp_path))
+        np.testing.assert_array_equal(tgt["a"].numpy(), np.full(4, 7.0))
+        np.testing.assert_array_equal(tgt["b"].numpy(), np.full(4, 9.0))
+
+    def test_async_same_path_waits_instead_of_racing(self, tmp_path):
+        d = str(tmp_path / "ck")
+        fi.configure("ckpt.write_shard:delay:0.4@1")
+        save_state_dict({"w": paddle.to_tensor(np.ones(4, np.float32))},
+                        d, async_save=True)
+        first = dck._pending[-1]
+        save_state_dict({"w": paddle.to_tensor(np.full(4, 5.0, np.float32))},
+                        d, async_save=True)
+        # the second call joined the in-flight save before starting
+        assert not first.thread.is_alive()
+        wait_save()
+        out = load_state_dict({}, d)
+        np.testing.assert_array_equal(out["w"].numpy(), np.full(4, 5.0))
+
+    def test_sync_save_waits_for_inflight_async_same_path(self, tmp_path):
+        """A SYNC save must also join an in-flight async save to the
+        same path — both run in one process, share the pid-suffixed tmp
+        names, and would interleave a torn shard."""
+        d = str(tmp_path / "ck")
+        fi.configure("ckpt.write_shard:delay:0.4@1")
+        save_state_dict({"w": paddle.to_tensor(np.ones(4, np.float32))},
+                        d, async_save=True)
+        first = dck._pending[-1]
+        save_state_dict({"w": paddle.to_tensor(np.full(4, 5.0, np.float32))},
+                        d)
+        assert not first.thread.is_alive()
+        out = load_state_dict({}, d)
+        np.testing.assert_array_equal(out["w"].numpy(), np.full(4, 5.0))
+
+    def test_async_window_is_bounded(self, tmp_path):
+        fi.configure("ckpt.write_shard:delay:0.3@1,"
+                     "ckpt.write_shard:delay:0.3@2,"
+                     "ckpt.write_shard:delay:0.3@3")
+        sd = {"w": paddle.to_tensor(np.ones(2, np.float32))}
+        for i in range(4):
+            save_state_dict(sd, str(tmp_path / f"c{i}"), async_save=True)
+            assert len(dck._pending) <= dck._MAX_PENDING
+        wait_save()
+        assert not dck._pending
+
+
+# -- elastic validate/quarantine/fallback ------------------------------------
+
+class TestElasticRecovery:
+    def _two_checkpoints(self, tmp_path):
+        em = ElasticManager(str(tmp_path), save_interval=1, keep=4,
+                            backoff_base=0.01)
+        em.save({"w": paddle.to_tensor(np.ones(4, np.float32))}, 1)
+        em.save({"w": paddle.to_tensor(np.full(4, 2.0, np.float32))}, 2)
+        return em
+
+    def test_corrupt_blob_falls_back_bitwise(self, tmp_path):
+        em = self._two_checkpoints(tmp_path)
+        _flip_byte(tmp_path / "step_2" / "shard_0.npz")
+        probe = {"w": paddle.to_tensor(np.zeros(4, np.float32))}
+        with pytest.warns(RuntimeWarning, match="quarantined"):
+            step = em.restore(probe)
+        assert step == 1
+        np.testing.assert_array_equal(probe["w"].numpy(),
+                                      np.ones(4, np.float32))
+        assert (tmp_path / "step_2.corrupt").is_dir()
+        assert em.latest()[0] == 1      # quarantined dir no longer a candidate
+
+    def test_torn_metadata_falls_back(self, tmp_path):
+        em = self._two_checkpoints(tmp_path)
+        meta = tmp_path / "step_2" / "metadata.json"
+        meta.write_bytes(meta.read_bytes()[: meta.stat().st_size // 2])
+        probe = {"w": paddle.to_tensor(np.zeros(4, np.float32))}
+        with pytest.warns(RuntimeWarning):
+            assert em.restore(probe) == 1
+        np.testing.assert_array_equal(probe["w"].numpy(),
+                                      np.ones(4, np.float32))
+
+    def test_missing_shard_file_falls_back(self, tmp_path):
+        em = self._two_checkpoints(tmp_path)
+        (tmp_path / "step_2" / "shard_0.npz").unlink()
+        probe = {"w": paddle.to_tensor(np.zeros(4, np.float32))}
+        with pytest.warns(RuntimeWarning):
+            assert em.restore(probe) == 1
+
+    def test_all_corrupt_returns_fresh_start(self, tmp_path):
+        em = self._two_checkpoints(tmp_path)
+        _flip_byte(tmp_path / "step_1" / "shard_0.npz")
+        _flip_byte(tmp_path / "step_2" / "shard_0.npz")
+        probe = {"w": paddle.to_tensor(np.zeros(4, np.float32))}
+        with pytest.warns(RuntimeWarning):
+            assert em.restore(probe) == 0
+        np.testing.assert_array_equal(probe["w"].numpy(), np.zeros(4))
+
+    def test_restart_backoff_capped_with_jitter(self, tmp_path):
+        em = ElasticManager(str(tmp_path), backoff_base=0.1,
+                            backoff_max=0.4)
+        for n, lo, hi in ((1, 0.05, 0.15), (2, 0.1, 0.3),
+                          (5, 0.2, 0.6), (50, 0.2, 0.6)):
+            d = em._restart_delay(n)
+            assert lo <= d < hi, (n, d)
+
+    def test_watchdog_wraps_step(self, tmp_path):
+        from paddle_tpu.distributed.watchdog import CommWatchdog
+        msgs = []
+        wd = CommWatchdog(timeout=30, logger=msgs.append)
+        em = ElasticManager(str(tmp_path), save_interval=10,
+                            watchdog=wd, backoff_base=0.01)
+        seen = []
+
+        def train_step(state, step):
+            seen.append(step)
+            return 0.0
+
+        losses = em.run(lambda: {"w": paddle.to_tensor(
+            np.zeros(2, np.float32))}, train_step, total_steps=3)
+        assert len(losses) == 3 and seen == [0, 1, 2]
+        assert wd.timeouts == 0 and not wd._active
+        wd.shutdown()
+
+    def test_watchdog_true_uses_private_instance(self, tmp_path):
+        """watchdog=True must not mutate the watch() singleton — that
+        would flip every other user to on_timeout='abort'."""
+        from paddle_tpu.distributed import watchdog as W
+        W._reset_global()
+        g = W.watch(timeout=50, on_timeout="warn")
+        em = ElasticManager(str(tmp_path), watchdog=True, step_timeout=30)
+        em._wrap_step(lambda s, i: 0.0)
+        assert W.watch() is g and g.on_timeout == "warn"
+        assert isinstance(em.watchdog, W.CommWatchdog)
+        assert em.watchdog is not g and em.watchdog.on_timeout == "abort"
+        assert em.watchdog.timeout == 30
+        em.watchdog.shutdown()
+        W._reset_global()
+
+    def test_watchdog_on_fire_hook(self):
+        import threading
+        from paddle_tpu.distributed.watchdog import CommWatchdog
+        fired = []
+        wd = CommWatchdog(timeout=0.2, logger=lambda m: None,
+                          on_fire=lambda name, el: fired.append(name))
+        release = threading.Event()
+
+        def hung():
+            with wd.section("elastic.train_step"):
+                release.wait(timeout=10)
+
+        t = threading.Thread(target=hung, daemon=True)
+        t.start()
+        deadline = time.time() + 5
+        while not fired and time.time() < deadline:
+            time.sleep(0.05)
+        release.set()
+        t.join(timeout=5)
+        wd.shutdown()
+        assert fired == ["elastic.train_step"]
+
+
+# -- acceptance: subprocess chaos --------------------------------------------
+
+@pytest.mark.timeout(180)
+def test_crash_mid_save_resume_bitwise_subprocess(tmp_path):
+    """FLAGS_fault_inject=ckpt.write_shard:crash@2: the worker dies
+    mid-save of the step-2 checkpoint (torn tmp, no commit); relaunched,
+    it must restore step 1 with bitwise the saved tensor and finish."""
+    worker = str(REPO / "tests" / "collective" / "fault_worker.py")
+    out = str(tmp_path / "result.json")
+    ckpt = str(tmp_path / "ckpt")
+    total = 5
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               FLAGS_fault_inject="ckpt.write_shard:crash@2")
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    r1 = subprocess.run([sys.executable, worker, out, ckpt, str(total)],
+                        capture_output=True, text=True, timeout=120,
+                        env=env)
+    assert r1.returncode == 137, (r1.stdout, r1.stderr)
+    assert "fault_inject: crash at 'ckpt.write_shard'" in r1.stderr
+    assert not os.path.exists(out)              # died before finishing
+    # the torn save left no visible step_2 checkpoint
+    assert not os.path.isdir(os.path.join(ckpt, "step_2"))
+    assert os.path.isdir(os.path.join(ckpt, "step_1"))
+
+    env.pop("FLAGS_fault_inject")               # relaunch, fault cleared
+    r2 = subprocess.run([sys.executable, worker, out, ckpt, str(total)],
+                        capture_output=True, text=True, timeout=120,
+                        env=env)
+    assert r2.returncode == 0, (r2.stdout, r2.stderr)
+    res = json.load(open(out))
+    # resumed from the last COMPLETE checkpoint (step 1, w == 1.0)
+    assert res["restored_step"] == 1
+    assert res["restored_w"] == [1.0, 1.0, 1.0, 1.0]    # bitwise
+    # and training finished: w advanced one per step to `total`
+    assert res["final_step"] == total
+    assert res["final_w"] == [float(total)] * 4
+
+
+# -- CI lint -----------------------------------------------------------------
+
+def test_no_bare_persistence_writes():
+    """CI guard: bare open(...,'wb')/np.savez on durability-critical
+    paths must not regrow (tools/check_atomic_writes.py)."""
+    spec = importlib.util.spec_from_file_location(
+        "check_atomic_writes", REPO / "tools" / "check_atomic_writes.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([]) == 0, "non-atomic persistence writes found"
+
+    # and the checker itself still catches violations
+    probe = REPO / "tests" / "_atomic_probe_tmp.py"
+    probe.write_text(
+        "import numpy as np\n"
+        "def save(path, arr):\n"
+        "    with open(path, 'wb') as f:\n"
+        "        f.write(b'x')\n"
+        "    np.savez(path, a=arr)\n")
+    try:
+        assert mod.main([str(probe)]) == 1
+    finally:
+        probe.unlink()

@@ -14,6 +14,8 @@ import tempfile
 import numpy as np
 import pytest
 
+from _capabilities import requires_cross_process_backend
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "collective", "dp_two_proc_worker.py")
 
@@ -27,6 +29,7 @@ def _free_port():
 
 
 @pytest.mark.timeout(300)
+@requires_cross_process_backend
 def test_two_process_dp_matches_single():
     port = _free_port()
     with tempfile.TemporaryDirectory() as d:
