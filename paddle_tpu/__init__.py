@@ -98,6 +98,7 @@ from . import signal      # noqa: F401,E402
 from . import geometric   # noqa: F401,E402
 from . import audio       # noqa: F401,E402
 from . import profiler    # noqa: F401,E402
+from . import observability  # noqa: F401,E402
 from . import incubate    # noqa: F401,E402
 from . import inference   # noqa: F401,E402
 from . import text        # noqa: F401,E402

@@ -133,6 +133,31 @@ _dispatch_cache = _DispatchCache(
     int(core.get_flag("FLAGS_eager_dispatch_cache_size", 1024)))
 
 
+def _dispatch_cache_collector():
+    """Registry bridge (observability.metrics.register_collector): the
+    hot-path counters stay cheap attribute increments on _DispatchStats;
+    snapshot/export polls them through this — zero new work per op."""
+    s = _dispatch_cache.stats
+    rows = [
+        ("counter", "dispatch.cache_hits_total", None, s.hits),
+        ("counter", "dispatch.cache_misses_total", None, s.misses),
+        ("counter", "dispatch.cache_evictions_total", None, s.evictions),
+        ("gauge", "dispatch.cache_size", None, len(_dispatch_cache.entries)),
+        ("gauge", "dispatch.cache_capacity", None, _dispatch_cache.maxsize),
+    ]
+    rows.extend(("counter", "dispatch.cache_bypass_total", {"reason": k}, v)
+                for k, v in s.bypasses.items())
+    return rows
+
+
+def _register_collector():
+    from ..observability import metrics as _om
+    _om.register_collector("dispatch_cache", _dispatch_cache_collector)
+
+
+_register_collector()
+
+
 def dispatch_cache_stats() -> dict:
     d = _dispatch_cache.stats.snapshot()
     d["size"] = len(_dispatch_cache.entries)

@@ -18,7 +18,17 @@ from __future__ import annotations
 
 import time
 
+from ..observability import metrics as _m
+
 __all__ = ["enable_nodelay", "connect_with_retry"]
+
+# connect telemetry (ISSUE 3): every authenticated client connect in the
+# repo funnels through connect_with_retry, so these two counters cover
+# rpc, elastic membership and ps channels in one place
+_NET_RETRIES = _m.counter("net.connect_retries_total",
+                          "failed connect attempts that were retried")
+_NET_FAILURES = _m.counter("net.connect_failures_total",
+                           "connects abandoned after the retry window")
 
 
 def enable_nodelay(conn) -> None:
@@ -75,12 +85,15 @@ def connect_with_retry(addr, authkey_fn, timeout_s: float,
         except AuthenticationError as e:
             if time.time() > start + 2.0:
                 hint = auth_hint() if auth_hint is not None else ""
+                _NET_FAILURES.inc(1, target=describe)
                 raise AuthenticationError(
                     f"{e or 'digest mismatch'}{hint}") from e
         except (ConnectionError, OSError) as e:
             if time.time() > deadline:
+                _NET_FAILURES.inc(1, target=describe)
                 raise ConnectionError(
                     f"{describe} {addr} unreachable after "
                     f"{timeout_s:.0f}s: {e}") from e
+        _NET_RETRIES.inc(1, target=describe)
         time.sleep(wait)
         wait = min(wait * 2, 1.0)

@@ -36,6 +36,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 import zlib
 from typing import Dict, List, Optional
 
@@ -44,7 +45,25 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.io import atomic_write
+from ..observability import metrics as _m
+from ..observability.spans import span as _span
 from ..tensor import Tensor
+
+# checkpoint telemetry (ISSUE 3): durations, bytes and verify failures.
+# The ckpt.save / ckpt.load spans also put checkpoint phases into the
+# span ring + XProf, and — through the flight recorder's write-through
+# sink — let the chaos suite see which phase a killed worker died in.
+_CKPT_SAVES = _m.counter("ckpt.saves_total", "completed checkpoint saves")
+_CKPT_LOADS = _m.counter("ckpt.loads_total", "completed checkpoint loads")
+_CKPT_BYTES_WRITTEN = _m.counter("ckpt.bytes_written_total",
+                                 "tensor bytes written by checkpoint saves")
+_CKPT_VERIFY_FAILURES = _m.counter(
+    "ckpt.verify_failures_total",
+    "CheckpointError raised by load/verify (torn, missing, corrupt)")
+_CKPT_SAVE_SECONDS = _m.histogram("ckpt.save_seconds",
+                                  "checkpoint save wall time")
+_CKPT_LOAD_SECONDS = _m.histogram("ckpt.load_seconds",
+                                  "checkpoint load wall time")
 
 __all__ = ["save_state_dict", "load_state_dict", "wait_save",
            "verify_checkpoint", "CheckpointError"]
@@ -174,22 +193,29 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
             "shards": entries}
 
     def _write():
-        atomic_write(os.path.join(path, f"shard_{rank}.npz"),
-                     lambda f: np.savez(f, **blobs),
-                     fault_name="ckpt.write_shard")
-        # every rank records which shards IT holds (a multi-host save
-        # on a shared filesystem merges all fragments at load time —
-        # the coordinator cannot see other ranks' addressable shards)
-        frag = json.dumps(rank_shards).encode()
-        atomic_write(os.path.join(path, f"shards_rank{rank}.json"),
-                     lambda f: f.write(frag),
-                     fault_name="ckpt.write_index")
-        if rank == coordinator_rank:
-            # metadata last: its presence is the commit point
-            mb = json.dumps(meta).encode()
-            atomic_write(os.path.join(path, "metadata.json"),
-                         lambda f: f.write(mb),
-                         fault_name="ckpt.write_meta")
+        t0 = time.perf_counter()
+        with _span("ckpt.save", path=path, rank=rank):
+            atomic_write(os.path.join(path, f"shard_{rank}.npz"),
+                         lambda f: np.savez(f, **blobs),
+                         fault_name="ckpt.write_shard")
+            # every rank records which shards IT holds (a multi-host save
+            # on a shared filesystem merges all fragments at load time —
+            # the coordinator cannot see other ranks' addressable shards)
+            frag = json.dumps(rank_shards).encode()
+            atomic_write(os.path.join(path, f"shards_rank{rank}.json"),
+                         lambda f: f.write(frag),
+                         fault_name="ckpt.write_index")
+            if rank == coordinator_rank:
+                # metadata last: its presence is the commit point
+                mb = json.dumps(meta).encode()
+                atomic_write(os.path.join(path, "metadata.json"),
+                             lambda f: f.write(mb),
+                             fault_name="ckpt.write_meta")
+        if _m.enabled():
+            _CKPT_SAVES.inc()
+            _CKPT_BYTES_WRITTEN.inc(
+                sum(int(b.nbytes) for b in blobs.values()))
+            _CKPT_SAVE_SECONDS.observe(time.perf_counter() - t0)
 
     apath = os.path.realpath(path)
     # any save to a path with an in-flight async save WAITS for it —
@@ -365,6 +391,14 @@ def verify_checkpoint(path: str, names=None) -> dict:
     every blob's CRC32 matches. Returns the metadata dict; raises
     CheckpointError otherwise. ElasticManager.restore() runs this before
     trusting a checkpoint."""
+    try:
+        return _verify_checkpoint_impl(path, names)
+    except CheckpointError:
+        _CKPT_VERIFY_FAILURES.inc()
+        raise
+
+
+def _verify_checkpoint_impl(path: str, names=None) -> dict:
     meta, shard_map = _read_index(path)
     reader = _BlobReader(path)
     try:
@@ -391,6 +425,22 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
     `shardings`: name -> NamedSharding). Integrity failures (missing or
     overlapping shards, checksum mismatch, torn files) raise
     CheckpointError before any target tensor is mutated."""
+    t0 = time.perf_counter()
+    try:
+        with _span("ckpt.load", path=path):
+            out = _load_state_dict_impl(state_dict, path,
+                                        shardings=shardings)
+    except CheckpointError:
+        _CKPT_VERIFY_FAILURES.inc()
+        raise
+    if _m.enabled():
+        _CKPT_LOADS.inc()
+        _CKPT_LOAD_SECONDS.observe(time.perf_counter() - t0)
+    return out
+
+
+def _load_state_dict_impl(state_dict: Dict, path: str,
+                          shardings: Optional[Dict] = None) -> Dict:
     meta, shard_map = _read_index(path)
     names = list(state_dict.keys()) or list(meta["tensors"].keys())
     out = state_dict if state_dict else {}

@@ -11,6 +11,7 @@ Two regimes, mirroring SURVEY §5's TPU mapping:
 """
 from __future__ import annotations
 
+import functools
 import os
 import time
 from typing import Optional
@@ -19,8 +20,79 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import metrics as _m
+from ..observability.spans import span as _span
 from ..tensor import Tensor
 from ..ops._helpers import to_tensor_like, unwrap
+
+# per-collective telemetry (ISSUE 3; EQuARX-style bytes/latency
+# accounting is the prerequisite for measuring any future comms
+# optimization). Disarmed: one wrapper frame + bool check per call.
+# CAVEAT: these are HOST-side counters. For the shard_map regime the
+# wrapper runs at TRACE time — one count per compile, not per executed
+# step, and wall_seconds measures tracing, not ICI communication; true
+# per-execution device numbers need an XLA-metrics bridge (ROADMAP
+# observability follow-on). Eager host-channel paths (send/recv,
+# object exchange, single-controller calls) count per call as expected.
+_COLL_CALLS = _m.counter("collective.calls_total",
+                         "collective op invocations by op")
+_COLL_BYTES = _m.counter("collective.bytes_total",
+                         "payload bytes entering collectives by op")
+_COLL_SECONDS = _m.histogram("collective.wall_seconds",
+                             "collective wall time by op")
+
+
+def _payload_nbytes(payload) -> int:
+    """Host-visible byte size of a collective's input payload (a Tensor/
+    array or a list of them); 0 when it has no measurable buffer."""
+    if payload is None:
+        return 0
+    if isinstance(payload, (list, tuple)):
+        return sum(_payload_nbytes(p) for p in payload)
+    data = getattr(payload, "data", payload)
+    nb = getattr(data, "nbytes", None)
+    if nb is None:
+        try:
+            nb = np.asarray(data).nbytes
+        except Exception:
+            return 0
+    return int(nb)
+
+
+def _collective_telemetry(op_name: str, payload_arg: Optional[int] = 0):
+    """Wrap a collective with op-labeled call/byte counters, a wall-time
+    histogram, and a span (ring + XProf TraceAnnotation). `payload_arg`
+    names the input whose bytes are accounted — by POSITION, with the
+    matching parameter name resolved at decoration time so keyword call
+    styles (scatter(t, tensor_list=parts)) are accounted too; None
+    skips byte accounting (barrier)."""
+    def deco(fn):
+        payload_name = None
+        if payload_arg is not None:
+            import inspect
+            params = list(inspect.signature(fn).parameters)
+            if payload_arg < len(params):
+                payload_name = params[payload_arg]
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not _m.enabled():
+                return fn(*args, **kwargs)
+            _COLL_CALLS.inc(1, op=op_name)
+            if payload_arg is not None:
+                payload = (args[payload_arg]
+                           if len(args) > payload_arg
+                           else kwargs.get(payload_name))
+                nb = _payload_nbytes(payload)
+                if nb:
+                    _COLL_BYTES.inc(nb, op=op_name)
+            t0 = time.perf_counter()
+            with _span("collective." + op_name):
+                out = fn(*args, **kwargs)
+            _COLL_SECONDS.observe(time.perf_counter() - t0, op=op_name)
+            return out
+        return wrapper
+    return deco
 
 
 class ReduceOp:
@@ -45,6 +117,7 @@ def _axis_of(group):
     return getattr(group, "axis", None)
 
 
+@_collective_telemetry("all_reduce")
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     axis = _axis_of(group)
     if axis is not None and _in_shard_map(axis):
@@ -57,6 +130,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return tensor
 
 
+@_collective_telemetry("all_gather", payload_arg=1)
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     axis = _axis_of(group)
     if axis is not None and _in_shard_map(axis):
@@ -73,6 +147,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     return tensor_list
 
 
+@_collective_telemetry("all_gather_object", payload_arg=None)
 def all_gather_object(object_list, obj, group=None):
     """ref communication/all_gather.py::all_gather_object. Multi-process
     jobs exchange pickled payloads over the jax distributed runtime
@@ -107,6 +182,7 @@ def all_gather_object(object_list, obj, group=None):
     return object_list
 
 
+@_collective_telemetry("broadcast")
 def broadcast(tensor, src=0, group=None, sync_op=True):
     return tensor
 
@@ -115,10 +191,14 @@ def broadcast_object_list(object_list, src=0, group=None):
     return object_list
 
 
+@_collective_telemetry("reduce")
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    return all_reduce(tensor, op, group, sync_op)
+    # the UNdecorated all_reduce body: one reduce call must count once
+    # (under op=reduce), not also as an all_reduce
+    return all_reduce.__wrapped__(tensor, op, group, sync_op)
 
 
+@_collective_telemetry("reduce_scatter", payload_arg=1)
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     axis = _axis_of(group)
@@ -132,12 +212,14 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
     return tensor
 
 
+@_collective_telemetry("scatter", payload_arg=1)
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     if tensor_list:
         tensor.data = unwrap(tensor_list[0])
     return tensor
 
 
+@_collective_telemetry("alltoall", payload_arg=1)
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     axis = _axis_of(group)
     if axis is not None and _in_shard_map(axis):
@@ -154,6 +236,7 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 alltoall_single = alltoall
 
 
+@_collective_telemetry("barrier", payload_arg=None)
 def barrier(group=None):
     try:
         from jax.experimental import multihost_utils
@@ -313,6 +396,7 @@ def _ensure_p2p_server():
     threading.Thread(target=loop, daemon=True).start()
 
 
+@_collective_telemetry("send")
 def send(tensor, dst=0, group=None, sync_op=True):
     """ref: paddle.distributed.send — eager host-channel p2p (see note
     above; in-program p2p is lax.ppermute via the pipeline schedules)."""
@@ -350,6 +434,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     raise ConnectionError(f"send to rank {dst} failed: {last}")
 
 
+@_collective_telemetry("recv", payload_arg=None)
 def recv(tensor, src=0, group=None, sync_op=True):
     """ref: paddle.distributed.recv — blocks for a message from `src`
     and copies it into `tensor` (returned)."""

@@ -10,10 +10,17 @@ wait() is immediate — matching semantics, since the result array is
 already a future under JAX's async dispatch."""
 from __future__ import annotations
 
+from ...observability import metrics as _m
 from .. import collective as C
 
 __all__ = ["all_reduce", "all_gather", "broadcast", "reduce",
            "reduce_scatter", "alltoall", "scatter"]
+
+# the underlying collectives carry the per-op count/bytes/wall-time
+# telemetry (collective.py); this counter just tracks how often the
+# stream API's async form is exercised
+_STREAM_ASYNC = _m.counter("collective.stream_async_total",
+                           "stream-API collective calls with sync_op=False")
 
 
 class _DoneTask:
@@ -31,6 +38,8 @@ class _DoneTask:
 
 def _wrap(fn):
     def op(*args, sync_op=True, use_calc_stream=False, **kw):
+        if not sync_op:
+            _STREAM_ASYNC.inc(1, op=fn.__name__)
         out = fn(*args, **kw)
         return out if sync_op else _DoneTask(out)
     op.__name__ = fn.__name__

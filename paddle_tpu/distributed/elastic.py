@@ -27,6 +27,8 @@ import time
 import warnings
 from typing import Callable, Optional
 
+from ..observability import metrics as _m
+from ..observability.spans import span as _span
 from ..utils.fault_injection import fault_point
 from . import checkpoint as dck
 
@@ -34,6 +36,18 @@ __all__ = ["ElasticManager", "ELASTIC_EXIT_CODE",
            "MembershipManager"]
 
 ELASTIC_EXIT_CODE = 101  # ref manager.py:32 — relaunch-me marker
+
+# elastic telemetry (ISSUE 3): how often the manager restarts, falls
+# back past corrupt checkpoints, and how long it backs off — the chaos
+# suite and a fleet dashboard both read recovery behavior from these
+_EL_RESTARTS = _m.counter("elastic.restarts_total",
+                          "in-process restart attempts after an exception")
+_EL_QUARANTINES = _m.counter("elastic.quarantines_total",
+                             "checkpoints quarantined as .corrupt")
+_EL_RESTORES = _m.counter("elastic.restores_total",
+                          "successful checkpoint restores")
+_EL_BACKOFF = _m.gauge("elastic.last_backoff_seconds",
+                       "most recent restart backoff delay")
 
 
 class ElasticManager:
@@ -113,6 +127,7 @@ class ElasticManager:
             os.replace(path, dst)
         except OSError:
             dst = path + " (quarantine rename failed)"
+        _EL_QUARANTINES.inc()
         warnings.warn(
             f"[elastic] checkpoint {path} failed validation ({err}); "
             f"quarantined as {dst}, falling back to an older checkpoint",
@@ -130,7 +145,9 @@ class ElasticManager:
                 # load_state_dict verifies everything it reads (tiling +
                 # CRCs) BEFORE mutating any target tensor — a separate
                 # verify_checkpoint pass would read every blob twice
-                dck.load_state_dict(self._tensors_of(state_dict), path)
+                with _span("elastic.restore", path=path):
+                    dck.load_state_dict(self._tensors_of(state_dict), path)
+                _EL_RESTORES.inc()
                 return step
             except dck.CheckpointError as e:
                 self._quarantine(path, e)
@@ -172,19 +189,23 @@ class ElasticManager:
                 state = make_state()
                 start = self.restore(state)
                 for step in range(start, total_steps):
-                    fault_point("elastic.train_step")
-                    losses[step] = step_fn(state, step)
+                    with _span("elastic.train_step", step=step):
+                        fault_point("elastic.train_step")
+                        losses[step] = step_fn(state, step)
                     nxt = step + 1
                     if nxt % self.save_interval == 0 or nxt == total_steps:
                         self.save(state, nxt)
                 return [losses[s] for s in sorted(losses)]
             except Exception:
                 restarts += 1
+                _EL_RESTARTS.inc()
                 if restarts > self.max_restarts:
                     raise SystemExit(ELASTIC_EXIT_CODE)
                 if on_restart is not None:
                     on_restart(restarts)
-                time.sleep(self._restart_delay(restarts))
+                delay = self._restart_delay(restarts)
+                _EL_BACKOFF.set(delay)
+                time.sleep(delay)
 
 
 class MembershipManager:

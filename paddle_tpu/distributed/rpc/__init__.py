@@ -21,6 +21,15 @@ from typing import Any, Dict, Optional
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
 
+from paddle_tpu.observability import metrics as _m
+
+# rpc call telemetry (connect retries are counted by _net.py, which
+# every rpc connect funnels through)
+_RPC_CALLS = _m.counter("rpc.calls_total",
+                        "outbound rpc calls by target worker")
+_RPC_ERRORS = _m.counter("rpc.errors_total",
+                         "outbound rpc calls that raised")
+
 def _AUTH(bind_host=None) -> bytes:
     """Per-job secret (distributed/_auth.py) — never a source constant
     (authenticated-pickle channel = RCE to anyone holding the key).
@@ -208,6 +217,7 @@ def _call(to: str, fn, args, kwargs):
     info = _state.workers[to] if to in _state.workers else None
     if info is None:
         raise KeyError(f"rpc: unknown worker '{to}'")
+    _RPC_CALLS.inc(1, to=to)
     # short default: these retries run on the SHARED thread pool that
     # also serves inbound calls — a dead peer must not starve it for
     # long (raise PADDLE_RPC_CONNECT_TIMEOUT for flaky networks)
@@ -222,6 +232,7 @@ def _call(to: str, fn, args, kwargs):
     finally:
         c.close()
     if status == "err":
+        _RPC_ERRORS.inc(1, to=to)
         raise payload
     return payload
 

@@ -21,9 +21,14 @@ import time
 import warnings
 from typing import Callable, Optional
 
+from ..observability import metrics as _m
+
 __all__ = ["CommWatchdog", "watch", "watched_step"]
 
 _DEFAULT_TIMEOUT = float(os.environ.get("FLAGS_comm_timeout", "1800"))
+
+_WD_TIMEOUTS = _m.counter("watchdog.timeouts_total",
+                          "watchdog sections that overran their timeout")
 
 
 class CommWatchdog:
@@ -73,12 +78,23 @@ class CommWatchdog:
                     self._fired.add(key)
             for (name, _tok), elapsed in overdue:
                 self.timeouts += 1
+                _WD_TIMEOUTS.inc(1, section=name)
                 rank = os.environ.get("PADDLE_TRAINER_ID", "0")
                 msg = (f"[CommWatchdog] step '{name}' has not completed "
                        f"after {elapsed:.0f}s (timeout {self.timeout:.0f}s) "
                        f"on rank {rank} — likely peer desync, preemption, "
                        "or a hung collective")
                 self._log(msg)
+                # post-mortem artifact BEFORE any abort: a hung trainer
+                # leaves a flight-recorder dump naming the stuck section,
+                # the open spans and the metric state at death
+                try:
+                    from ..observability.export import flight_dump
+                    flight_dump(f"watchdog:{name} after {elapsed:.0f}s "
+                                f"(timeout {self.timeout:.0f}s, "
+                                f"rank {rank})")
+                except Exception:
+                    pass    # telemetry must not kill the monitor
                 if self.on_fire is not None:
                     try:
                         self.on_fire(name, elapsed)
@@ -95,8 +111,12 @@ class CommWatchdog:
             self._token += 1
             key = (name, self._token)   # unique: concurrent/nested same-
             self._active[key] = time.monotonic()  # name sections tracked
+        # armed telemetry: the watched section is a span, so a firing
+        # watchdog's flight dump names it among the open spans
+        from ..observability.spans import span as _span
         try:                                      # independently
-            yield
+            with _span("watchdog." + name):
+                yield
         finally:
             with self._lock:
                 self._active.pop(key, None)

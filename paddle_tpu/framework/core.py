@@ -262,6 +262,15 @@ _flags: dict = {
     # deterministic fault schedule, e.g. "ckpt.write_shard:crash@2" —
     # empty = disarmed (fault_point() sites are a single bool check)
     "FLAGS_fault_inject": "",
+    # -- runtime telemetry (consumed by observability/*): arming bool for
+    # the metrics registry + span ring (disarmed sites are a single bool
+    # check, same discipline as FLAGS_fault_inject), the background
+    # Prometheus /metrics HTTP port (0 = off), the crash flight-recorder
+    # JSONL path (empty = off), and the span ring bound
+    "FLAGS_metrics": False,
+    "FLAGS_metrics_port": 0,
+    "FLAGS_flight_recorder": "",
+    "FLAGS_span_ring_size": 512,
     # -- autotune (consumed by kernels/autotune.sweeps_enabled) --------
     "FLAGS_use_autotune": True,
     # kernel-route kill switches (the on-chip ablation levers; analog of
@@ -342,6 +351,21 @@ def _apply_flag(key, value):
     elif key == "FLAGS_fault_inject":
         from ..utils import fault_injection
         fault_injection.configure(value if isinstance(value, str) else None)
+    elif key == "FLAGS_metrics":
+        from .. import observability
+        observability.enable(value not in _FALSY)
+    elif key == "FLAGS_metrics_port":
+        from ..observability import export as _oexp
+        _oexp.serve_metrics(int(value or 0))
+    elif key == "FLAGS_flight_recorder":
+        from ..observability import export as _oexp
+        if value:
+            _oexp.install_flight_recorder(str(value))
+        else:
+            _oexp.uninstall_flight_recorder()
+    elif key == "FLAGS_span_ring_size":
+        from ..observability import spans as _ospans
+        _ospans.set_ring_size(int(value))
     elif key == "FLAGS_eager_dispatch_cache_size":
         from ..autograd import tape  # late: tape imports this module
         tape._dispatch_cache.resize(int(value))

@@ -6,7 +6,8 @@ import time
 from typing import Optional
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "VisualDL", "config_callbacks"]
+           "EarlyStopping", "VisualDL", "MetricsCallback",
+           "config_callbacks"]
 
 
 class Callback:
@@ -140,6 +141,48 @@ class VisualDL(Callback):
             f.write(json.dumps({"step": step, **{
                 k: float(v) for k, v in (logs or {}).items()
                 if isinstance(v, (int, float))}}) + "\n")
+
+
+class MetricsCallback(Callback):
+    """Per-epoch telemetry for `Model.fit` users without touching the
+    profiler (ISSUE 3): arms the observability registry for the run and
+    appends one JSONL record per epoch — the epoch logs plus a full
+    registry snapshot — through the exporter. Readable by the same
+    dashboards as VisualDL's scalars file."""
+
+    # Model.fit calls on_train_end for run_on_error callbacks even when
+    # training raises — without it, an aborted fit would leave the
+    # process-wide registry armed forever
+    run_on_error = True
+
+    def __init__(self, log_dir: str = "./log",
+                 filename: str = "metrics.jsonl", arm: bool = True):
+        self.log_dir = log_dir
+        self.filename = filename
+        self.arm = arm
+        self._restore_arming = None
+
+    def _path(self):
+        import os
+        return os.path.join(self.log_dir, self.filename)
+
+    def on_train_begin(self, logs=None):
+        if self.arm:
+            from .. import observability
+            self._restore_arming = observability.arm()
+
+    def on_epoch_end(self, epoch, logs=None):
+        from ..observability import export, metrics
+        export.append_jsonl(self._path(), {
+            "ts": time.time(), "epoch": epoch,
+            "logs": {k: float(v) for k, v in (logs or {}).items()
+                     if isinstance(v, (int, float))},
+            "metrics": metrics.snapshot()})
+
+    def on_train_end(self, logs=None):
+        if self._restore_arming is not None:
+            self._restore_arming()
+            self._restore_arming = None
 
 
 def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,

@@ -119,35 +119,57 @@ class Model:
                                save_freq=save_freq, save_dir=save_dir,
                                metrics=self._metrics)
         self.stop_training = False
-        for cb in cbs:
-            cb.on_train_begin()
         it = 0
-        for epoch in range(epochs):
+        try:
+            # inside the try: a LATER callback's on_train_begin raising
+            # must still tear down an earlier one that already armed
+            # process-global state (MetricsCallback)
             for cb in cbs:
-                cb.on_epoch_begin(epoch)
-            logs = {}
-            for step, batch in enumerate(loader):
+                cb.on_train_begin()
+            for epoch in range(epochs):
                 for cb in cbs:
-                    cb.on_train_batch_begin(step)
-                xs, ys = self._split_batch(batch)
-                losses = self.train_batch(xs, ys)
-                logs = {"loss": losses[0] if losses else 0.0}
+                    cb.on_epoch_begin(epoch)
+                logs = {}
+                for step, batch in enumerate(loader):
+                    for cb in cbs:
+                        cb.on_train_batch_begin(step)
+                    xs, ys = self._split_batch(batch)
+                    losses = self.train_batch(xs, ys)
+                    logs = {"loss": losses[0] if losses else 0.0}
+                    for cb in cbs:
+                        cb.on_train_batch_end(step, logs)
+                    it += 1
+                    if num_iters is not None and it >= num_iters:
+                        self.stop_training = True
+                        break
+                if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_data,
+                                              batch_size=batch_size,
+                                              verbose=0,
+                                              num_workers=num_workers)
+                    logs.update({f"eval_{k}": v
+                                 for k, v in eval_logs.items()})
+                    for cb in cbs:
+                        cb.on_eval_end(eval_logs)
                 for cb in cbs:
-                    cb.on_train_batch_end(step, logs)
-                it += 1
-                if num_iters is not None and it >= num_iters:
-                    self.stop_training = True
+                    cb.on_epoch_end(epoch, logs)
+                if self.stop_training:
                     break
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
-                                          verbose=0, num_workers=num_workers)
-                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
-                for cb in cbs:
-                    cb.on_eval_end(eval_logs)
+        except BaseException:
+            # teardown-critical callbacks (opt-in via run_on_error, e.g.
+            # MetricsCallback's registry arming) must still be torn down
+            # when training raises — without this an aborted fit leaks
+            # their process-global state. Other callbacks keep the
+            # reference semantics: no on_train_end on the error path
+            # (ModelCheckpoint must not publish a 'final' model from a
+            # crashed run).
             for cb in cbs:
-                cb.on_epoch_end(epoch, logs)
-            if self.stop_training:
-                break
+                if getattr(cb, "run_on_error", False):
+                    try:
+                        cb.on_train_end()
+                    except Exception:
+                        pass
+            raise
         for cb in cbs:
             cb.on_train_end()
         return self

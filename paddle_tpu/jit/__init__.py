@@ -475,15 +475,18 @@ class TrainStep:
                   f"{(_time.perf_counter() - _t0) * 1e3:.2f} ms",
                   file=_sys.stderr)
         if core.get_bool_flag("FLAGS_log_memory_stats"):
+            # real device.memory_stats() readings, mirrored into the
+            # metrics registry gauges (device.bytes_in_use /
+            # device.peak_bytes_in_use); backends without memory_stats
+            # (CPU jaxlib returns None) no-op cleanly — no zeros printed
             import sys as _sys
-            from ..device import cuda as _dev
-            try:
+            from .. import observability as _obs
+            mem = _obs.update_device_memory_gauges()
+            if mem is not None:
                 print(f"TrainStep[{opt._step_count}] memory: "
-                      f"in_use={_dev.memory_allocated()} "
-                      f"peak={_dev.max_memory_allocated()}",
+                      f"in_use={mem['bytes_in_use']} "
+                      f"peak={mem['peak_bytes_in_use']}",
                       file=_sys.stderr)
-            except Exception:
-                pass
         if core.get_bool_flag("FLAGS_check_nan_inf"):
             # compiled-path sweep: values can't be branched on at trace
             # time, so the check runs on the step RESULT; rerun in eager
@@ -564,8 +567,11 @@ def save(layer, path, input_spec=None, **configs):
             f"current backend only — the artifact will not load on other "
             "platforms", stacklevel=2)
         exp = jexport.export(jax.jit(fwd))(state_abs, *abstract)
-    with open(path + ".pdmodel", "wb") as f:
-        f.write(exp.serialize())
+    from ..framework.io import atomic_write
+    blob = exp.serialize()
+    # atomic commit: a crash mid-serialize must not tear the inference
+    # artifact or destroy the previous one (ROADMAP lint-coverage item)
+    atomic_write(path + ".pdmodel", lambda f: f.write(blob))
 
 
 class TranslatedLayer:

@@ -1,0 +1,136 @@
+"""paddle_tpu.observability — unified runtime telemetry.
+
+One subsystem, three parts (see the per-module docstrings):
+
+- `metrics`  — process-wide registry of counters/gauges/fixed-bucket
+  histograms with labels; disarmed by default (single bool check per
+  record site, the fault_injection.py discipline).
+- `spans`    — `span(name, **attrs)` context manager: bounded in-memory
+  ring + jax.profiler.TraceAnnotation forwarding (XProf correlation).
+- `export`   — Prometheus text dump (+ optional HTTP endpoint via
+  FLAGS_metrics_port), atomic JSON / append-only JSONL writers, and the
+  crash flight recorder (FLAGS_flight_recorder) that leaves a
+  post-mortem artifact when a trainer hangs, crashes or is killed.
+
+Arm everything with `FLAGS_metrics=1` (env var — read at import so
+subprocess chaos tests inherit it — or paddle.set_flags) or
+`observability.enable()`. Instrumented call sites live in
+autograd/tape (dispatch cache, via collector), distributed/{collective,
+checkpoint, elastic, _net, rpc, watchdog}, utils/fault_injection (via
+collector), jit.TrainStep and profiler.Profiler.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from . import export, metrics, spans  # noqa: F401
+from .export import (append_jsonl, flight_dump,  # noqa: F401
+                     install_flight_recorder, prometheus_text,
+                     serve_metrics, uninstall_flight_recorder,
+                     write_snapshot)
+from .metrics import counter, gauge, histogram, snapshot  # noqa: F401
+from .spans import span  # noqa: F401
+
+__all__ = ["metrics", "spans", "export", "enable", "enabled", "arm", "span",
+           "counter", "gauge", "histogram", "snapshot", "prometheus_text",
+           "write_snapshot", "append_jsonl", "serve_metrics",
+           "install_flight_recorder", "uninstall_flight_recorder",
+           "flight_dump", "update_device_memory_gauges"]
+
+
+def enable(on: bool = True) -> None:
+    """Arm (or disarm) the metrics registry and span tracing together."""
+    metrics.enable(on)
+    spans.enable(on)
+
+
+def enabled() -> bool:
+    return metrics.enabled()
+
+
+_arm_lock = threading.Lock()
+_arm_count = 0
+_arm_prev = False
+
+
+def arm():
+    """Arm the registry+spans and return an idempotent restore()
+    callable. REFCOUNTED: with two overlapping armers (a Profiler
+    running across a Model.fit that carries a MetricsCallback), the
+    first restore() must not disarm telemetry out from under the one
+    still active — only the last restore standing reverts to the state
+    captured before the first arm. The one implementation of the
+    protocol, so Profiler and MetricsCallback cannot diverge."""
+    global _arm_count, _arm_prev
+    with _arm_lock:
+        if _arm_count == 0:
+            _arm_prev = metrics.enabled()
+        if not metrics.enabled():
+            enable(True)    # also re-arms after a direct enable(False)
+        _arm_count += 1
+    done = [False]
+
+    def restore():
+        global _arm_count
+        with _arm_lock:
+            if done[0]:
+                return
+            done[0] = True
+            _arm_count -= 1
+            if _arm_count == 0 and not _arm_prev:
+                enable(False)
+
+    return restore
+
+
+# device-memory gauges (FLAGS_log_memory_stats + Profiler.step); created
+# here once — consumers import the helper, not their own instruments
+_G_MEM_IN_USE = metrics.gauge("device.bytes_in_use",
+                              "device memory currently allocated (bytes)")
+_G_MEM_PEAK = metrics.gauge("device.peak_bytes_in_use",
+                            "peak device memory allocated (bytes)")
+
+
+def update_device_memory_gauges():
+    """Refresh device.bytes_in_use / device.peak_bytes_in_use from
+    jax.local_devices()[0].memory_stats() and return
+    {'bytes_in_use', 'peak_bytes_in_use'} — or None on backends without
+    memory_stats (a clean no-op; CPU jaxlib returns None)."""
+    try:
+        import jax
+        st = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not st:
+        return None
+    mem = {"bytes_in_use": int(st.get("bytes_in_use", 0)),
+           "peak_bytes_in_use": int(st.get("peak_bytes_in_use",
+                                           st.get("bytes_in_use", 0)))}
+    _G_MEM_IN_USE.set(mem["bytes_in_use"])
+    _G_MEM_PEAK.set(mem["peak_bytes_in_use"])
+    return mem
+
+
+# env arming at import (the fault_injection.py pattern): subprocess chaos
+# tests set these before the interpreter starts; paddle.set_flags routes
+# here in-process (framework/core._apply_flag)
+_FALSY_ENV = (None, "", "0", "false", "False", "off", "OFF")
+if os.environ.get("FLAGS_metrics") not in _FALSY_ENV:
+    enable(True)
+if os.environ.get("FLAGS_span_ring_size"):
+    try:
+        spans.set_ring_size(int(os.environ["FLAGS_span_ring_size"]))
+    except ValueError:
+        pass
+if os.environ.get("FLAGS_metrics_port"):
+    try:
+        export.serve_metrics(int(os.environ["FLAGS_metrics_port"]))
+    except (ValueError, OSError):
+        pass        # bad/busy port must not break `import paddle_tpu`
+_flight_path = os.environ.get("FLAGS_flight_recorder")
+if _flight_path:
+    try:
+        install_flight_recorder(_flight_path)
+    except OSError:
+        pass    # unwritable path must not break `import paddle_tpu`

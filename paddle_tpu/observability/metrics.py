@@ -1,0 +1,301 @@
+"""Process-wide metrics registry: named counters, gauges and fixed-bucket
+histograms with label support (ref: paddle/fluid/platform/profiler/* stats
++ the VisualDL scalar surface; Prometheus client semantics).
+
+Discipline (same as utils/fault_injection.py): the registry is DISARMED by
+default and every record call — `Counter.inc`, `Gauge.set`,
+`Histogram.observe` — bails on a single module-global bool check, so
+production code carries the instrumentation at no measurable cost (the
+eager-dispatch bench's >= 3x bound is the regression guard). Arm with
+`FLAGS_metrics=1` (env or paddle.set_flags), `observability.enable()`, or
+by running a `paddle_tpu.profiler.Profiler`.
+
+Instruments are created ONCE at module level with a literal
+`subsystem.name` snake-case id (enforced by tools/check_metric_names.py)
+and then incremented through the returned handle:
+
+    from ..observability import metrics as _m
+    _SAVES = _m.counter("ckpt.saves_total", "completed checkpoint saves")
+    ...
+    _SAVES.inc()                       # disarmed: one global load + bool
+    _SAVES.inc(3, rank="0")            # labeled series
+
+`counter()/gauge()/histogram()` are get-or-create: re-requesting an id
+returns the existing instrument; requesting it as a DIFFERENT type raises.
+
+Always-on subsystem counters that predate the registry (eager dispatch
+cache, fault injection, watchdog) stay on their own cheap attribute
+increments and bridge in through `register_collector` — a callable polled
+at snapshot/export time — so their hot paths gained zero new work while
+`snapshot()`/`prometheus_text()` still see them. The old
+`profiler.*_stats()` functions remain as thin per-subsystem views.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from bisect import bisect_left
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
+           "enable", "enabled", "snapshot", "reset", "register_collector",
+           "unregister_collector", "instruments", "split_label_key",
+           "DEFAULT_BUCKETS"]
+
+# fast-path guard: every record call reads this module global and returns
+# when False — the disarmed cost of an instrumented site
+_enabled = False
+
+# RLock, not Lock: the flight recorder's SIGTERM/watchdog dump calls
+# snapshot() and may run on the MAIN thread between bytecodes of a
+# record call that already holds a lock — a non-reentrant lock would
+# deadlock the dying process instead of letting it dump and exit
+_lock = threading.RLock()                # registry structure, not values
+_instruments: Dict[str, "_Instrument"] = {}
+_collectors: Dict[str, Callable] = {}
+
+# subsystem.name snake_case (e.g. "ckpt.save_seconds"); the AST lint in
+# tools/check_metric_names.py enforces the same shape on call-site literals
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*$")
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                   60.0)
+
+
+def enable(on: bool = True) -> None:
+    global _enabled
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def _esc_label_value(v) -> str:
+    """Escape the separators so free-form values (worker names, section
+    labels) cannot fork or merge series when the key is split back."""
+    return (str(v).replace("\\", "\\\\").replace(",", "\\,")
+            .replace("=", "\\="))
+
+
+def _label_key(labels: Optional[dict]) -> str:
+    """Flat 'k=v,k2=v2' series key (sorted; values escaped). Label KEYS
+    are python identifiers (they arrive as **kwargs), so only values
+    need escaping; split_label_key is the inverse."""
+    if not labels:
+        return ""
+    return ",".join(f"{k}={_esc_label_value(labels[k])}"
+                    for k in sorted(labels))
+
+
+def split_label_key(key: str) -> List[Tuple[str, str]]:
+    """Inverse of _label_key: [(k, v), ...] with escapes resolved. A
+    char scanner, not a regex split — escapes consume in pairs, so a
+    value ENDING in a backslash ('x\\' -> 'x\\\\') still parses."""
+    if not key:
+        return []
+    out = []
+    k: list = []
+    v: list = []
+    cur = k
+    i, n = 0, len(key)
+    while i < n:
+        c = key[i]
+        if c == "\\" and i + 1 < n:
+            cur.append(key[i + 1])
+            i += 2
+            continue
+        if c == "=" and cur is k:
+            cur = v
+        elif c == ",":
+            out.append(("".join(k), "".join(v)))
+            k, v = [], []
+            cur = k
+        else:
+            cur.append(c)
+        i += 1
+    out.append(("".join(k), "".join(v)))
+    return out
+
+
+class _Instrument:
+    kind = "abstract"
+
+    __slots__ = ("name", "help", "_values", "_vlock")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._values: dict = {}
+        # per-instrument: increments from two threads must not lose
+        # counts; reentrant so a signal-handler dump interrupting a
+        # held record call cannot self-deadlock (see _lock above)
+        self._vlock = threading.RLock()
+
+    def snapshot(self) -> dict:
+        with self._vlock:
+            return dict(self._values)
+
+    def reset(self) -> None:
+        with self._vlock:
+            self._values.clear()
+
+
+class Counter(_Instrument):
+    """Monotonic count, optionally per label set."""
+
+    kind = "counter"
+    __slots__ = ()
+
+    def inc(self, n: float = 1, **labels) -> None:
+        if not _enabled:
+            return
+        key = _label_key(labels)
+        with self._vlock:
+            self._values[key] = self._values.get(key, 0) + n
+
+
+class Gauge(_Instrument):
+    """Last-written value, optionally per label set."""
+
+    kind = "gauge"
+    __slots__ = ()
+
+    def set(self, v: float, **labels) -> None:
+        if not _enabled:
+            return
+        key = _label_key(labels)
+        with self._vlock:
+            self._values[key] = v
+
+    def inc(self, n: float = 1, **labels) -> None:
+        if not _enabled:
+            return
+        key = _label_key(labels)
+        with self._vlock:
+            self._values[key] = self._values.get(key, 0) + n
+
+    def dec(self, n: float = 1, **labels) -> None:
+        self.inc(-n, **labels)
+
+
+class Histogram(_Instrument):
+    """Fixed-bucket histogram: per-bucket counts + sum + count per label
+    set. Bucket bounds are upper-inclusive edges; an implicit +Inf bucket
+    catches the tail (Prometheus histogram semantics)."""
+
+    kind = "histogram"
+    __slots__ = ("buckets",)
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        b = tuple(sorted(float(x) for x in buckets))
+        if not b:
+            raise ValueError(f"histogram {name!r}: needs >= 1 bucket")
+        self.buckets = b
+
+    def observe(self, v: float, **labels) -> None:
+        if not _enabled:
+            return
+        key = _label_key(labels)
+        i = bisect_left(self.buckets, v)    # index of first bound >= v
+        with self._vlock:
+            cell = self._values.get(key)
+            if cell is None:
+                # [counts per bucket + overflow, sum, count]
+                cell = self._values[key] = \
+                    [[0] * (len(self.buckets) + 1), 0.0, 0]
+            cell[0][i] += 1
+            cell[1] += v
+            cell[2] += 1
+
+    def snapshot(self) -> dict:
+        with self._vlock:
+            out = {}
+            for key, (counts, total, n) in self._values.items():
+                out[key] = {
+                    "buckets": [[b, c] for b, c in
+                                zip(self.buckets, counts)] +
+                               [["+Inf", counts[-1]]],
+                    "sum": total, "count": n}
+            return out
+
+
+def _get_or_create(cls, name: str, help: str, **kw):
+    if not _NAME_RE.match(name or ""):
+        raise ValueError(
+            f"metric id {name!r} must be snake_case 'subsystem.name' "
+            f"(e.g. 'ckpt.save_seconds')")
+    with _lock:
+        inst = _instruments.get(name)
+        if inst is not None:
+            if type(inst) is not cls:
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{inst.kind}, requested {cls.kind}")
+            return inst
+        inst = cls(name, help, **kw)
+        _instruments[name] = inst
+        return inst
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return _get_or_create(Counter, name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return _get_or_create(Gauge, name, help)
+
+
+def histogram(name: str, help: str = "",
+              buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> Histogram:
+    return _get_or_create(Histogram, name, help, buckets=buckets)
+
+
+def instruments() -> Dict[str, _Instrument]:
+    with _lock:
+        return dict(_instruments)
+
+
+def register_collector(name: str, fn: Callable[[], List[tuple]]) -> None:
+    """Bridge for always-on subsystem counters (dispatch cache, fault
+    injection, watchdog): `fn()` is polled at snapshot/export time and
+    returns rows `(kind, metric_id, labels_dict_or_None, value)` with
+    kind in {"counter", "gauge"} — zero added work on the subsystem's
+    hot path."""
+    with _lock:
+        _collectors[name] = fn
+
+
+def unregister_collector(name: str) -> None:
+    with _lock:
+        _collectors.pop(name, None)
+
+
+def snapshot() -> dict:
+    """{'counters': {id: {label_key: val}}, 'gauges': {...},
+    'histograms': {id: {label_key: {'buckets': [[le, n]...], 'sum': s,
+    'count': c}}}} — instruments merged with collector rows."""
+    out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+    for name, inst in sorted(instruments().items()):
+        out[inst.kind + "s"][name] = inst.snapshot()
+    with _lock:
+        colls = list(_collectors.items())
+    for cname, fn in colls:
+        try:
+            rows = fn()
+        except Exception:
+            continue        # a broken collector must not kill the export
+        for kind, name, labels, value in rows:
+            if kind not in ("counter", "gauge"):
+                continue
+            out[kind + "s"].setdefault(name, {})[_label_key(labels)] = value
+    return out
+
+
+def reset() -> None:
+    """Zero every instrument's values (instruments and collectors stay
+    registered)."""
+    for inst in instruments().values():
+        inst.reset()

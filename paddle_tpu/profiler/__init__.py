@@ -10,15 +10,58 @@ READY/RECORD/RECORD_AND_RETURN) and the user API are kept."""
 from __future__ import annotations
 
 import enum
+import json
 import os
 import time
 from typing import Callable, Iterable, Optional
+
+from ..observability import metrics as _m
+from ..observability import spans as _spans
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
            "SummaryView", "eager_dispatch_cache_stats",
            "reset_eager_dispatch_cache_stats", "clear_eager_dispatch_cache",
-           "fault_injection_stats"]
+           "fault_injection_stats", "metrics_snapshot"]
+
+# per-step training stats (ISSUE 3): step wall time, step count, jit
+# compilations (via jax.monitoring when available) — fed by
+# Profiler.step(); device memory gauges live in observability.__init__
+_H_STEP_SECONDS = _m.histogram("profiler.step_seconds",
+                               "training step wall time (Profiler.step)")
+_C_STEPS = _m.counter("profiler.steps_total",
+                      "training steps observed by Profiler.step")
+_C_JIT_COMPILES = _m.counter(
+    "profiler.jit_compilations_total",
+    "XLA compilations observed via jax.monitoring (cache misses)")
+
+_jit_monitor_state = {"registered": False}
+
+
+def _register_jit_monitor():
+    """Count jit compiles / compilation-cache misses through
+    jax.monitoring's event stream when this jax version exposes it; a
+    silent no-op otherwise (the counter just stays 0)."""
+    if _jit_monitor_state["registered"]:
+        return
+    _jit_monitor_state["registered"] = True
+    try:
+        from jax import monitoring
+
+        def _on_event(event, *a, **k):
+            if "compile" in event or "cache_miss" in event:
+                _C_JIT_COMPILES.inc(1)
+
+        monitoring.register_event_listener(_on_event)
+    except Exception:
+        pass
+
+
+def metrics_snapshot() -> dict:
+    """Thin view over the unified registry (observability.metrics) —
+    counters/gauges/histograms from every instrumented subsystem."""
+    from ..observability import metrics
+    return metrics.snapshot()
 
 
 def fault_injection_stats() -> dict:
@@ -110,14 +153,40 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
 
 
 class _ProfilerResult:
-    def __init__(self, trace_dir):
+    """Machine-readable profiling result: the trace dir plus whatever
+    the Profiler measured (step times, registry snapshot)."""
+
+    def __init__(self, trace_dir, data: Optional[dict] = None):
         self.trace_dir = trace_dir
+        self.data = dict(data or {})
 
     def save(self, path, format="json"):
-        pass
+        """Commit the result as JSON at `path` (was a silent no-op)."""
+        if format != "json":
+            raise ValueError(
+                f"unsupported profiler result format {format!r} "
+                f"(only 'json')")
+        from ..framework.io import atomic_write
+        payload = {"trace_dir": self.trace_dir, **self.data}
+        blob = json.dumps(payload, indent=2).encode()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        atomic_write(path, lambda f: f.write(blob))
+        return path
 
 
 def load_profiler_result(path):
+    """A saved JSON result file loads back with its data; a trace
+    directory (the old calling convention) yields an empty result
+    pointing at it."""
+    if os.path.isfile(path):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            return _ProfilerResult(data.pop("trace_dir", path), data)
+        except (json.JSONDecodeError, UnicodeDecodeError, OSError):
+            pass
     return _ProfilerResult(path)
 
 
@@ -132,7 +201,8 @@ class Profiler:
                  scheduler=None, on_trace_ready: Optional[Callable] = None,
                  record_shapes: bool = False, profile_memory: bool = False,
                  timer_only: bool = False, emit_nvtx: bool = False,
-                 custom_device_types=None, with_flops: bool = False):
+                 custom_device_types=None, with_flops: bool = False,
+                 collect_metrics: bool = True):
         if scheduler is None:
             self._sched = lambda step: ProfilerState.RECORD
         elif callable(scheduler):
@@ -151,9 +221,20 @@ class Profiler:
         self._step_times = []
         self._t0 = None
         self._exported_dir = None
+        # a running Profiler arms the telemetry registry (ISSUE 3): the
+        # per-step stats below and every instrumented subsystem record
+        # for its lifetime; prior arming is restored on stop()
+        self._collect_metrics = collect_metrics
+        self._restore_arming = None
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
+        if self._collect_metrics and self._restore_arming is None:
+            # None-guard: a double start() must not clobber the arming
+            # token (the orphaned restore would leak arming forever)
+            from .. import observability
+            self._restore_arming = observability.arm()
+            _register_jit_monitor()
         self._state = self._sched(self._step)
         self._maybe_toggle()
         self._t0 = time.perf_counter()
@@ -165,10 +246,19 @@ class Profiler:
         if self._on_ready is not None:
             self._on_ready(self)
         self._state = ProfilerState.CLOSED
+        if self._restore_arming is not None:
+            self._restore_arming()
+            self._restore_arming = None
 
     def step(self, num_samples: Optional[int] = None):
         if self._t0 is not None:
-            self._step_times.append(time.perf_counter() - self._t0)
+            dt = time.perf_counter() - self._t0
+            self._step_times.append(dt)
+            if _m.enabled():
+                _H_STEP_SECONDS.observe(dt)
+                _C_STEPS.inc()
+                from .. import observability
+                observability.update_device_memory_gauges()
         self._step += 1
         new_state = self._sched(self._step)
         if new_state != self._state:
@@ -211,12 +301,32 @@ class Profiler:
         self.stop()
 
     # -- reporting ----------------------------------------------------------
+    _UNIT_SCALE = {"s": (1.0, "s"), "ms": (1e3, "ms"), "us": (1e6, "us"),
+                   "ns": (1e9, "ns")}
+
     def step_info(self, unit=None):
+        """Average step time + throughput; `unit` in {'s','ms','us','ns'}
+        scales the time figure (was silently ignored; default ms)."""
         if not self._step_times:
             return ""
+        scale, suffix = self._UNIT_SCALE.get(unit or "ms",
+                                             self._UNIT_SCALE["ms"])
         avg = sum(self._step_times) / len(self._step_times)
-        return (f"avg step {avg*1000:.2f} ms, ips "
+        return (f"avg step {avg*scale:.2f} {suffix}, ips "
                 f"{1.0/avg if avg else 0:.2f} steps/s")
+
+    def _summary_payload(self, snap: Optional[dict] = None) -> dict:
+        n = len(self._step_times)
+        tot = sum(self._step_times)
+        return {
+            "steps": n,
+            "total_seconds": tot,
+            "avg_step_seconds": tot / n if n else 0.0,
+            "step_times_seconds": list(self._step_times),
+            "eager_dispatch_cache": eager_dispatch_cache_stats(),
+            "fault_injection": fault_injection_stats(),
+            "metrics": snap if snap is not None else metrics_snapshot(),
+        }
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms", views=None):
@@ -241,18 +351,43 @@ class Profiler:
                 for n, v in fi["points"].items())
             print(f"fault injection ({'armed' if fi['enabled'] else 'off'}; "
                   f"point=hits/triggered): {pts}")
+        snap = metrics_snapshot()   # once: reused for the JSON artifact
+        n_series = sum(len(v) for kind in snap.values()
+                       for v in kind.values())
+        if n_series:
+            print(f"metrics registry: {n_series} series across "
+                  f"{sum(len(kind) for kind in snap.values())} metrics "
+                  f"(observability.prometheus_text() for the full dump)")
+        # machine-readable twin next to the XLA trace dir (was: the
+        # printed text was the ONLY artifact)
+        out = os.path.join(self._dir, "profiler_summary.json")
+        try:
+            _ProfilerResult(self._dir, self._summary_payload(snap)).save(out)
+            print(f"summary JSON: {out}")
+        except OSError:
+            pass
         if self._exported_dir or self._tracing:
             print(f"XLA trace: {self._dir} (open with TensorBoard XProf)")
 
 
 class RecordEvent:
-    """ref profiler user span — maps to jax.profiler.TraceAnnotation."""
+    """ref profiler user span — maps to jax.profiler.TraceAnnotation.
+    When the telemetry registry is armed the event ALSO lands in the
+    observability span ring (and flight recorder), so user spans show up
+    in post-mortems alongside checkpoint/collective spans."""
 
     def __init__(self, name: str, event_type=None):
         self.name = name
         self._ann = None
+        self._span = None
 
     def begin(self):
+        if _spans.enabled():
+            # spans.span carries its own TraceAnnotation — one XProf
+            # annotation, plus the ring/flight-recorder record
+            self._span = _spans.span(self.name)
+            self._span.__enter__()
+            return
         import jax
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
@@ -261,6 +396,9 @@ class RecordEvent:
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
             self._ann = None
+        if self._span is not None:
+            self._span.__exit__(None, None, None)
+            self._span = None
 
     def __enter__(self):
         self.begin()

@@ -205,6 +205,33 @@ def fault_point(name: str, file: Optional[str] = None) -> None:
             raise exc(f"fault injected at {name!r} (hit {hit})")
 
 
+def _fault_collector():
+    """Registry bridge (observability.metrics.register_collector): the
+    armed-path counters keep their own lock; snapshot/export polls them
+    here so `prometheus_text()` carries chaos telemetry too."""
+    st = stats()
+    rows = [("gauge", "fault.armed", None, 1 if st["enabled"] else 0)]
+    for n, v in st["points"].items():
+        rows.append(("counter", "fault.hits_total",
+                     {"point": n}, v["hits"]))
+        rows.append(("counter", "fault.triggered_total",
+                     {"point": n}, v["triggered"]))
+    return rows
+
+
+def _register_collector():
+    try:
+        from ..observability import metrics as _om
+    except ImportError:
+        # loaded standalone by file path (chaos tests import this module
+        # without the package) — the harness stays stdlib-only there
+        return
+    _om.register_collector("fault_injection", _fault_collector)
+
+
+_register_collector()
+
+
 # arm from the environment at import — subprocess chaos tests set
 # FLAGS_fault_inject before the interpreter starts; paddle.set_flags
 # routes here for in-process control (framework/core._apply_flag)

@@ -1,0 +1,728 @@
+"""Unified runtime telemetry (ISSUE 3): metrics registry semantics,
+span-ring bounds, Prometheus/JSONL round-trips, per-collective byte
+accounting, the crash flight recorder (watchdog fire + subprocess
+kill), the profiler satellites, and the two CI lints (metric naming,
+atomic-write coverage)."""
+import importlib.util
+import json
+import os
+import pathlib
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import export, metrics, spans
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Every test leaves the registry disarmed, zeroed and without a
+    flight recorder or HTTP endpoint; the span ring is restored to its
+    default bound."""
+    yield
+    obs.enable(False)
+    metrics.reset()
+    spans.clear()
+    spans.set_ring_size(512)
+    export.uninstall_flight_recorder()
+    export.stop_metrics_server()
+
+
+# -- registry semantics ------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_labels_and_disarmed(self):
+        c = metrics.counter("testobs.hits_total", "test counter")
+        c.inc()                                  # disarmed: no record
+        assert metrics.snapshot()["counters"]["testobs.hits_total"] == {}
+        obs.enable(True)
+        c.inc()
+        c.inc(2)
+        c.inc(5, op="x")
+        series = metrics.snapshot()["counters"]["testobs.hits_total"]
+        assert series[""] == 3
+        assert series["op=x"] == 5
+
+    def test_gauge_set_inc_dec(self):
+        obs.enable(True)
+        g = metrics.gauge("testobs.level", "test gauge")
+        g.set(7)
+        g.inc(2)
+        g.dec()
+        assert metrics.snapshot()["gauges"]["testobs.level"][""] == 8
+
+    def test_histogram_buckets_sum_count(self):
+        obs.enable(True)
+        h = metrics.histogram("testobs.lat_seconds", "test histogram",
+                              buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0, 0.01):
+            h.observe(v)
+        cell = metrics.snapshot()["histograms"]["testobs.lat_seconds"][""]
+        assert cell["count"] == 4
+        assert abs(cell["sum"] - 5.56) < 1e-9
+        # per-bucket (non-cumulative) counts: <=0.1 -> 2, <=1.0 -> 1, inf -> 1
+        assert cell["buckets"] == [[0.1, 2], [1.0, 1], ["+Inf", 1]]
+
+    def test_get_or_create_idempotent_type_collision_raises(self):
+        c1 = metrics.counter("testobs.same_total", "a")
+        c2 = metrics.counter("testobs.same_total", "a")
+        assert c1 is c2
+        with pytest.raises(ValueError, match="already registered"):
+            metrics.gauge("testobs.same_total")
+
+    def test_name_shape_enforced(self):
+        for bad in ("nodot", "Upper.case", "a.b-c", "a..b", ".x", "x."):
+            with pytest.raises(ValueError, match="subsystem.name"):
+                metrics.counter(bad)
+
+    def test_reset_zeroes_values_keeps_instruments(self):
+        obs.enable(True)
+        c = metrics.counter("testobs.reset_total", "r")
+        c.inc(3)
+        metrics.reset()
+        assert metrics.snapshot()["counters"]["testobs.reset_total"] == {}
+        c.inc()
+        assert metrics.snapshot()["counters"]["testobs.reset_total"][""] == 1
+
+    def test_threaded_increments_lose_nothing(self):
+        obs.enable(True)
+        c = metrics.counter("testobs.race_total", "t")
+
+        def work():
+            for _ in range(1000):
+                c.inc()
+
+        ts = [threading.Thread(target=work) for _ in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert metrics.snapshot()["counters"]["testobs.race_total"][""] == 4000
+
+    def test_label_values_with_separators_roundtrip(self):
+        """A ','/'='/backslash inside a label VALUE must not fork or
+        merge series — the key escapes them and split_label_key is the
+        exact inverse (free-form values feed labels: rpc worker names,
+        watchdog section names)."""
+        obs.enable(True)
+        c = metrics.counter("testobs.sep_total", "s")
+        c.inc(1, to="worker,ps=1")
+        c.inc(2, to="tail\\")
+        series = metrics.snapshot()["counters"]["testobs.sep_total"]
+        assert len(series) == 2
+        decoded = {dict(metrics.split_label_key(k))["to"]: v
+                   for k, v in series.items()}
+        assert decoded == {"worker,ps=1": 1, "tail\\": 2}
+        parsed = _parse_prometheus(export.prometheus_text())
+        assert parsed[("testobs_sep_total",
+                       frozenset({("to", "worker,ps=1")}))] == 1
+
+    def test_collector_rows_merge_into_snapshot(self):
+        obs.enable(True)
+        metrics.register_collector(
+            "testobs", lambda: [("counter", "testobs.bridged_total",
+                                 {"k": "v"}, 42)])
+        try:
+            snap = metrics.snapshot()
+            assert snap["counters"]["testobs.bridged_total"]["k=v"] == 42
+        finally:
+            metrics.unregister_collector("testobs")
+
+    def test_existing_subsystem_collectors_present(self):
+        """Dispatch-cache, fault-injection and watchdog counters are
+        visible through the ONE registry (migrated per ISSUE 3)."""
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        for _ in range(3):
+            _ = x * 2.0
+        snap = metrics.snapshot()
+        assert "dispatch.cache_hits_total" in snap["counters"]
+        assert "dispatch.cache_misses_total" in snap["counters"]
+        assert "dispatch.cache_bypass_total" in snap["counters"]
+        assert "fault.armed" in snap["gauges"]
+        assert "watchdog.timeouts_total" in snap["counters"]
+        # thin views kept
+        import paddle_tpu.profiler as profiler
+        assert profiler.eager_dispatch_cache_stats()["hits"] >= 0
+        assert profiler.metrics_snapshot().keys() == snap.keys()
+
+    def test_disarmed_overhead_smoke(self):
+        """The disarmed record path is a module-global bool check; guard
+        against someone adding work before the bail-out. Generous bound:
+        200k disarmed incs in < 1s (~5us each — two orders of magnitude
+        above the real cost, immune to CI noise). The real regression
+        guard is benchmarks/eager_dispatch_bench.py's >= 3x bound."""
+        c = metrics.counter("testobs.overhead_total", "o")
+        assert not metrics.enabled()
+        t0 = time.perf_counter()
+        for _ in range(200_000):
+            c.inc()
+        assert time.perf_counter() - t0 < 1.0
+        assert metrics.snapshot()["counters"]["testobs.overhead_total"] == {}
+
+
+# -- spans -------------------------------------------------------------------
+
+class TestSpans:
+    def test_disarmed_span_records_nothing(self):
+        with obs.span("testspan.noop"):
+            pass
+        assert spans.ring() == []
+
+    def test_ring_is_bounded(self):
+        obs.enable(True)
+        spans.set_ring_size(10)
+        for i in range(50):
+            with obs.span("testspan.many"):
+                pass
+        r = spans.ring()
+        assert len(r) == 10
+        # newest events kept: the last span_end is the 50th
+        assert r[-1]["ev"] == "span_end"
+
+    def test_span_begin_end_pair_and_attrs(self):
+        obs.enable(True)
+        with obs.span("testspan.block", step=3):
+            time.sleep(0.01)
+        begin, end = spans.ring()[-2:]
+        assert begin["ev"] == "span_begin" and end["ev"] == "span_end"
+        assert begin["sid"] == end["sid"]
+        assert begin["attrs"] == {"step": "3"}
+        assert end["dur_s"] >= 0.009
+
+    def test_open_spans_tracked_across_threads(self):
+        obs.enable(True)
+        release = threading.Event()
+        started = threading.Event()
+
+        def hold():
+            with obs.span("testspan.held"):
+                started.set()
+                release.wait(timeout=10)
+
+        t = threading.Thread(target=hold, daemon=True)
+        t.start()
+        assert started.wait(timeout=5)
+        names = [ev["name"] for ev in spans.open_spans()]
+        assert "testspan.held" in names
+        release.set()
+        t.join(timeout=5)
+        assert spans.open_spans() == []
+
+
+# -- exporters ---------------------------------------------------------------
+
+def _parse_prometheus(text):
+    """Minimal text-format parser: {(name, frozen_labels): value}."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{(.*)\})?\s+(\S+)$",
+                     line)
+        assert m, f"unparseable prometheus line: {line!r}"
+        name, _, labels, value = m.groups()
+        lab = {}
+        if labels:
+            for part in re.findall(r'(\w+)="((?:[^"\\]|\\.)*)"', labels):
+                lab[part[0]] = part[1]
+        out[(name, frozenset(lab.items()))] = float(value)
+    return out
+
+
+class TestExport:
+    def test_prometheus_roundtrip(self):
+        obs.enable(True)
+        metrics.counter("testexp.hits_total", "h").inc(3, op="x")
+        metrics.gauge("testexp.level", "g").set(1.5)
+        h = metrics.histogram("testexp.lat_seconds", "l", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        parsed = _parse_prometheus(export.prometheus_text())
+        assert parsed[("testexp_hits_total",
+                       frozenset({("op", "x")}))] == 3
+        assert parsed[("testexp_level", frozenset())] == 1.5
+        assert parsed[("testexp_lat_seconds_bucket",
+                       frozenset({("le", "0.1")}))] == 1
+        assert parsed[("testexp_lat_seconds_bucket",
+                       frozenset({("le", "1")}))] == 2      # cumulative
+        assert parsed[("testexp_lat_seconds_bucket",
+                       frozenset({("le", "+Inf")}))] == 2
+        assert parsed[("testexp_lat_seconds_count", frozenset())] == 2
+        assert abs(parsed[("testexp_lat_seconds_sum",
+                           frozenset())] - 0.55) < 1e-9
+
+    def test_prometheus_large_counters_exact(self):
+        """Counter samples render full-precision: %g would round a
+        128MB byte counter to 6 significant digits."""
+        obs.enable(True)
+        metrics.counter("testexp.big_total", "b").inc(134217728)
+        line = [ln for ln in export.prometheus_text().splitlines()
+                if ln.startswith("testexp_big_total ")]
+        assert line == ["testexp_big_total 134217728"]
+
+    def test_json_snapshot_and_jsonl_roundtrip(self, tmp_path):
+        obs.enable(True)
+        metrics.counter("testexp.snap_total", "s").inc(7)
+        with obs.span("testexp.snapspan"):
+            pass
+        p = str(tmp_path / "snap.json")
+        export.write_snapshot(p, extra={"note": "n1"})
+        data = json.load(open(p))
+        assert data["metrics"]["counters"]["testexp.snap_total"][""] == 7
+        assert data["note"] == "n1"
+        assert any(ev["name"] == "testexp.snapspan"
+                   for ev in data["spans"])
+        jl = str(tmp_path / "events.jsonl")
+        export.append_jsonl(jl, {"a": 1})
+        export.append_jsonl(jl, {"a": 2})
+        recs = [json.loads(ln) for ln in open(jl)]
+        assert [r["a"] for r in recs] == [1, 2]
+
+    def test_http_metrics_endpoint(self, tmp_path):
+        import socket
+        import urllib.request
+        obs.enable(True)
+        metrics.counter("testexp.http_total", "h").inc(9)
+        with socket.socket() as s:      # pick a free port
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        bound = export.serve_metrics(port)
+        assert bound == port
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10).read()
+            assert b"testexp_http_total 9" in body
+        finally:
+            export.stop_metrics_server()
+
+
+# -- collective byte accounting ----------------------------------------------
+
+class TestCollectiveTelemetry:
+    def test_all_reduce_all_gather_bytes(self):
+        import paddle_tpu.distributed as dist
+        obs.enable(True)
+        t = paddle.to_tensor(np.ones((8, 4), np.float32))      # 128 bytes
+        dist.all_reduce(t)
+        out = []
+        dist.all_gather(out, t)
+        snap = metrics.snapshot()
+        calls = snap["counters"]["collective.calls_total"]
+        nbytes = snap["counters"]["collective.bytes_total"]
+        assert calls["op=all_reduce"] == 1
+        assert calls["op=all_gather"] == 1
+        assert nbytes["op=all_reduce"] == 8 * 4 * 4
+        assert nbytes["op=all_gather"] == 8 * 4 * 4
+        lat = snap["histograms"]["collective.wall_seconds"]
+        assert lat["op=all_reduce"]["count"] == 1
+        # the collective call left a span in the ring (XProf correlation)
+        assert any(ev["name"] == "collective.all_reduce"
+                   for ev in spans.ring())
+
+    def test_disarmed_collectives_record_nothing(self):
+        import paddle_tpu.distributed as dist
+        t = paddle.to_tensor(np.ones(4, np.float32))
+        dist.all_reduce(t)
+        assert metrics.snapshot()["counters"].get(
+            "collective.calls_total", {}) == {}
+
+    def test_keyword_payload_bytes_accounted(self):
+        """scatter(t, tensor_list=parts) passes the payload by keyword
+        — byte accounting must resolve it by parameter name, not only
+        by position."""
+        import paddle_tpu.distributed as dist
+        obs.enable(True)
+        t = paddle.to_tensor(np.zeros(4, np.float32))
+        parts = [paddle.to_tensor(np.ones(4, np.float32))]   # 16 bytes
+        dist.scatter(t, tensor_list=parts)
+        snap = metrics.snapshot()
+        assert snap["counters"]["collective.bytes_total"][
+            "op=scatter"] == 16
+
+    def test_reduce_counts_once_not_as_all_reduce(self):
+        """reduce() delegates to the UNdecorated all_reduce body — one
+        call must record one series entry, not double-count bytes/time
+        under both op labels."""
+        import paddle_tpu.distributed as dist
+        obs.enable(True)
+        t = paddle.to_tensor(np.ones(4, np.float32))       # 16 bytes
+        dist.reduce(t)
+        snap = metrics.snapshot()
+        assert snap["counters"]["collective.calls_total"] == \
+            {"op=reduce": 1}
+        assert snap["counters"]["collective.bytes_total"] == \
+            {"op=reduce": 16}
+
+
+# -- checkpoint / elastic telemetry ------------------------------------------
+
+class TestCheckpointTelemetry:
+    def test_save_load_counters_and_verify_failure(self, tmp_path):
+        from paddle_tpu.distributed import checkpoint as dck
+        obs.enable(True)
+        d = str(tmp_path / "ck")
+        sd = {"w": paddle.to_tensor(np.ones((4, 4), np.float32))}
+        dck.save_state_dict(sd, d)
+        dck.load_state_dict({}, d)
+        snap = metrics.snapshot()
+        assert snap["counters"]["ckpt.saves_total"][""] == 1
+        assert snap["counters"]["ckpt.loads_total"][""] == 1
+        assert snap["counters"]["ckpt.bytes_written_total"][""] == 64.0
+        assert snap["histograms"]["ckpt.save_seconds"][""]["count"] == 1
+        # corrupt it -> load raises -> verify-failure counter
+        meta = tmp_path / "ck" / "metadata.json"
+        meta.write_text("{ torn")
+        with pytest.raises(dck.CheckpointError):
+            dck.load_state_dict({}, d)
+        snap = metrics.snapshot()
+        assert snap["counters"]["ckpt.verify_failures_total"][""] == 1
+        spans_seen = {ev["name"] for ev in spans.ring()}
+        assert "ckpt.save" in spans_seen and "ckpt.load" in spans_seen
+
+
+# -- flight recorder ---------------------------------------------------------
+
+def _read_flight(path):
+    """JSONL lines (skipping any faulthandler traceback text)."""
+    recs = []
+    for ln in open(path):
+        try:
+            recs.append(json.loads(ln))
+        except json.JSONDecodeError:
+            continue
+    return recs
+
+
+def _open_span_names(recs):
+    begins, ends = {}, set()
+    for r in recs:
+        if r.get("ev") == "span_begin":
+            begins[r["sid"]] = r["name"]
+        elif r.get("ev") == "span_end":
+            ends.add(r["sid"])
+    return {name for sid, name in begins.items() if sid not in ends}
+
+
+class TestFlightRecorder:
+    def test_install_arms_and_writes_through(self, tmp_path):
+        p = str(tmp_path / "flight.jsonl")
+        export.install_flight_recorder(p)
+        assert metrics.enabled() and spans.enabled()
+        with obs.span("testfr.work"):
+            pass
+        export.flight_dump("test")
+        recs = _read_flight(p)
+        evs = [r["ev"] for r in recs]
+        assert "flight_recorder_start" in evs
+        assert "span_begin" in evs and "span_end" in evs
+        dump = [r for r in recs if r["ev"] == "dump"][-1]
+        assert dump["reason"] == "test"
+        assert dump["open_spans"] == []
+        assert "metrics" in dump and "ring_tail" in dump
+
+    def test_watchdog_fire_dumps_open_span(self, tmp_path):
+        from paddle_tpu.distributed.watchdog import CommWatchdog
+        p = str(tmp_path / "flight.jsonl")
+        export.install_flight_recorder(p)
+        wd = CommWatchdog(timeout=0.2, logger=lambda m: None)
+        release = threading.Event()
+
+        def hung():
+            with wd.section("train_step"):
+                release.wait(timeout=10)
+
+        t = threading.Thread(target=hung, daemon=True)
+        t.start()
+        deadline = time.time() + 5
+        dumps = []
+        while not dumps and time.time() < deadline:
+            time.sleep(0.05)
+            dumps = [r for r in _read_flight(p)
+                     if r.get("ev") == "dump"]
+        release.set()
+        t.join(timeout=5)
+        wd.shutdown()
+        assert dumps, "watchdog fire left no flight-recorder dump"
+        d = dumps[0]
+        assert d["reason"].startswith("watchdog:train_step")
+        assert "watchdog.train_step" in \
+            {s["name"] for s in d["open_spans"]}
+        # the timeout also landed in the registry
+        snap = d["metrics"]
+        assert snap["counters"]["watchdog.timeouts_total"][
+            "section=train_step"] >= 1
+
+
+# -- acceptance: subprocess kill leaves a post-mortem ------------------------
+
+@pytest.mark.timeout(180)
+def test_flight_recorder_survives_subprocess_kill(tmp_path):
+    """Chaos acceptance (ISSUE 3): a worker killed mid-checkpoint-write
+    (os._exit — the SIGKILL/preemption shape: no atexit, no cleanup)
+    must leave a flight-recorder artifact naming the span that was open
+    at death. Reuses the ISSUE-2 fault_worker harness."""
+    worker = str(REPO / "tests" / "collective" / "fault_worker.py")
+    out = str(tmp_path / "result.json")
+    ckpt = str(tmp_path / "ckpt")
+    flight = str(tmp_path / "flight.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               FLAGS_fault_inject="ckpt.write_shard:crash@2",
+               FLAGS_metrics="1",
+               FLAGS_flight_recorder=flight)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, worker, out, ckpt, "5"],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 137, (r.stdout, r.stderr)
+    assert os.path.exists(flight)
+    recs = _read_flight(flight)
+    # write-through events survived the kill; the open-span set names
+    # what the worker was doing when it died: the step-2 checkpoint save
+    open_names = _open_span_names(recs)
+    assert "ckpt.save" in open_names, open_names
+    # no dump record: os._exit skips atexit — exactly the SIGKILL shape
+    # (the write-through lines are the artifact); the completed step-1
+    # save shows as a begin/end pair
+    ended = [r["name"] for r in recs if r.get("ev") == "span_end"]
+    assert "ckpt.save" in ended
+
+
+# -- profiler satellites -----------------------------------------------------
+
+class TestProfilerSatellites:
+    def test_step_info_unit_and_result_save(self, tmp_path):
+        from paddle_tpu.profiler import Profiler, load_profiler_result
+        os.environ["PADDLE_TPU_PROFDIR"] = str(tmp_path / "prof")
+        try:
+            p = Profiler(timer_only=True)
+            p.start()
+            for _ in range(2):
+                time.sleep(0.01)
+                p.step()
+            ms = p.step_info("ms")
+            s = p.step_info("s")
+            us = p.step_info("us")
+            p.stop()
+        finally:
+            os.environ.pop("PADDLE_TPU_PROFDIR")
+        v_ms = float(re.search(r"avg step ([\d.]+) ms", ms).group(1))
+        v_s = float(re.search(r"avg step ([\d.]+) s", s).group(1))
+        v_us = float(re.search(r"avg step ([\d.]+) us", us).group(1))
+        # each figure prints %.2f, so allow half a ULP of the coarser
+        # unit: 0.005 s = 5 ms when comparing s->ms, 0.005 ms -> 5 us
+        assert abs(v_ms - v_s * 1e3) <= 5.0 + 1e-6
+        assert abs(v_us - v_ms * 1e3) <= 5.0 + 1e-6
+        # _ProfilerResult.save was a silent no-op; now a JSON round-trip
+        from paddle_tpu.profiler import _ProfilerResult
+        rp = str(tmp_path / "result.json")
+        _ProfilerResult("tracedir", {"steps": 2}).save(rp)
+        r = load_profiler_result(rp)
+        assert r.trace_dir == "tracedir" and r.data["steps"] == 2
+
+    def test_profiler_arms_registry_and_writes_summary_json(
+            self, tmp_path, capsys):
+        from paddle_tpu.profiler import Profiler
+        os.environ["PADDLE_TPU_PROFDIR"] = str(tmp_path / "prof")
+        try:
+            p = Profiler(timer_only=True)
+            p.start()
+            assert metrics.enabled()
+            p.step()
+            p.summary()
+            p.stop()
+        finally:
+            os.environ.pop("PADDLE_TPU_PROFDIR")
+        assert not metrics.enabled()     # prior (disarmed) state restored
+        sj = tmp_path / "prof" / "profiler_summary.json"
+        assert sj.exists()
+        data = json.load(open(sj))
+        assert data["steps"] == 1
+        assert "metrics" in data
+        snap = metrics.snapshot()   # histogram retained after stop
+        assert "profiler.step_seconds" in snap["histograms"]
+
+    def test_update_device_memory_gauges_clean_noop(self):
+        """CPU jaxlib has no memory_stats → None, no crash, no gauges;
+        backends with stats return the dict and set the gauges."""
+        obs.enable(True)
+        mem = obs.update_device_memory_gauges()
+        snap = metrics.snapshot()["gauges"]
+        if mem is None:
+            assert snap["device.bytes_in_use"] == {}
+        else:
+            assert snap["device.bytes_in_use"][""] == mem["bytes_in_use"]
+            assert snap["device.peak_bytes_in_use"][""] == \
+                mem["peak_bytes_in_use"]
+
+
+# -- hapi --------------------------------------------------------------------
+
+def test_metrics_callback_emits_jsonl(tmp_path):
+    from paddle_tpu.hapi.callbacks import MetricsCallback
+    cb = MetricsCallback(log_dir=str(tmp_path))
+    cb.on_train_begin()
+    assert metrics.enabled()
+    metrics.counter("testobs.cb_total", "cb").inc(4)
+    cb.on_epoch_end(0, {"loss": 0.25, "acc": np.float64(0.5)})
+    cb.on_epoch_end(1, {"loss": 0.125})
+    cb.on_train_end()
+    assert not metrics.enabled()
+    recs = [json.loads(ln) for ln in open(tmp_path / "metrics.jsonl")]
+    assert [r["epoch"] for r in recs] == [0, 1]
+    assert recs[0]["logs"] == {"loss": 0.25, "acc": 0.5}
+    assert recs[0]["metrics"]["counters"]["testobs.cb_total"][""] == 4
+
+
+def test_metrics_callback_restores_arming_when_fit_raises(tmp_path):
+    """An aborted Model.fit must not leak a process-wide armed registry:
+    MetricsCallback opts into run_on_error teardown and fit tears it
+    down on the exception path (other callbacks keep the reference
+    semantics — no on_train_end from a crashed run)."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.hapi.callbacks import Callback, MetricsCallback
+    from paddle_tpu.io import TensorDataset
+
+    class Boom(Callback):
+        def on_train_batch_begin(self, step, logs=None):
+            raise RuntimeError("boom")
+
+    ends = []
+
+    class TracksEnd(Callback):
+        def on_train_end(self, logs=None):
+            ends.append(1)
+
+    net = nn.Linear(4, 2)
+    model = paddle.Model(net)
+    model.prepare(optimizer=opt.SGD(learning_rate=0.1,
+                                    parameters=net.parameters()),
+                  loss=F.mse_loss)
+    x = np.ones((8, 4), np.float32)
+    y = np.ones((8, 2), np.float32)
+    ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+    mcb = MetricsCallback(log_dir=str(tmp_path))
+    with pytest.raises(RuntimeError, match="boom"):
+        model.fit(ds, batch_size=4, epochs=1, verbose=0,
+                  callbacks=[mcb, Boom(), TracksEnd()])
+    assert not metrics.enabled()    # arming restored despite the raise
+    assert ends == []               # non-opt-in callbacks untouched
+
+    class BoomAtBegin(Callback):
+        def on_train_begin(self, logs=None):
+            raise RuntimeError("begin-boom")
+
+    # a LATER callback raising in on_train_begin must still tear down
+    # the already-armed MetricsCallback (begin runs inside fit's try)
+    with pytest.raises(RuntimeError, match="begin-boom"):
+        model.fit(ds, batch_size=4, epochs=1, verbose=0,
+                  callbacks=[MetricsCallback(log_dir=str(tmp_path)),
+                             BoomAtBegin()])
+    assert not metrics.enabled()
+
+
+def test_sigterm_ignored_stays_ignored_with_recorder(tmp_path):
+    """A process that configured SIGTERM ignored (preemption drain)
+    must survive SIGTERM with the flight recorder installed: the
+    handler dumps, restores SIG_IGN, and does NOT re-deliver."""
+    import signal
+    if threading.current_thread() is not threading.main_thread():
+        pytest.skip("signal handling requires the main thread")
+    prev = signal.getsignal(signal.SIGTERM)
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    # the sigterm/atexit hooks install once per process — reset so THIS
+    # install captures the SIG_IGN disposition just configured
+    export._hooks_installed = False
+    try:
+        p = str(tmp_path / "flight.jsonl")
+        export.install_flight_recorder(p)
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.05)            # deliver
+        # still alive; the dump landed and SIG_IGN is back in place
+        dumps = [r for r in _read_flight(p) if r.get("ev") == "dump"]
+        assert any(d["reason"] == "signal:SIGTERM" for d in dumps)
+        assert signal.getsignal(signal.SIGTERM) == signal.SIG_IGN
+    finally:
+        export.uninstall_flight_recorder()
+        signal.signal(signal.SIGTERM, prev)
+        # the recorder's signal hook installs once per process; reset so
+        # a later install in this process re-hooks cleanly
+        export._hooks_installed = False
+
+
+# -- flags -------------------------------------------------------------------
+
+def test_arm_is_refcounted_across_overlapping_armers():
+    """Profiler running across a fit with MetricsCallback: the inner
+    restore must NOT disarm telemetry the outer armer still owns; only
+    the last restore reverts, and each restore is idempotent."""
+    r1 = obs.arm()
+    assert metrics.enabled()
+    r2 = obs.arm()
+    r1()
+    assert metrics.enabled()        # r2 still active
+    r1()                            # idempotent double-restore
+    assert metrics.enabled()
+    r2()
+    assert not metrics.enabled()    # last one out reverts
+
+
+def test_flags_arm_and_disarm():
+    paddle.set_flags({"FLAGS_metrics": True})
+    assert metrics.enabled() and spans.enabled()
+    paddle.set_flags({"FLAGS_metrics": False})
+    assert not metrics.enabled() and not spans.enabled()
+    paddle.set_flags({"FLAGS_span_ring_size": 7})
+    try:
+        obs.enable(True)
+        for _ in range(20):
+            with obs.span("testflags.ring"):
+                pass
+        assert len(spans.ring()) == 7
+    finally:
+        paddle.set_flags({"FLAGS_span_ring_size": 512})
+        obs.enable(False)
+
+
+# -- CI lints ----------------------------------------------------------------
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_metric_names_lint_clean_and_catches(tmp_path):
+    """CI guard: every registry call site uses a literal snake_case
+    'subsystem.name' id, unique per type (tools/check_metric_names.py)."""
+    lint = _load_tool("check_metric_names")
+    assert lint.main([]) == 0
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from paddle_tpu.observability import metrics\n"
+        "c = metrics.counter('no_subsystem')\n"           # bad shape
+        "d = metrics.counter('x.' + 'computed')\n"        # not a literal
+        "e = metrics.gauge('ok.dup')\n"
+        "f = metrics.gauge('ok.dup')\n")                  # duplicate site
+    assert lint.main([str(bad)]) == 1
+
+
+def test_atomic_writes_lint_covers_observability():
+    """CI guard: the observability/profiler/jit writers stay on the
+    atomic-write protocol (coverage grown per ISSUE 3 satellite)."""
+    lint = _load_tool("check_atomic_writes")
+    covered = "\n".join(lint.CHECKED_MODULES)
+    assert "observability/export.py" in covered
+    assert "profiler/__init__.py" in covered
+    assert "jit/__init__.py" in covered
+    assert lint.main([]) == 0

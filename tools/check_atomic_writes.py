@@ -35,12 +35,19 @@ REPO = Path(__file__).resolve().parent.parent
 
 # modules holding user-visible persistence paths already converted to the
 # atomic-write protocol; grow this list as more writers are converted
-# (jit.save / static.save / onnx.export are ROADMAP open items)
+# (static.save / onnx.export are ROADMAP open items)
 CHECKED_MODULES = [
     "paddle_tpu/framework/io.py",
     "paddle_tpu/distributed/checkpoint.py",
     "paddle_tpu/distributed/elastic.py",
     "paddle_tpu/distributed/ps/__init__.py",
+    # ISSUE 3: observability writers (JSONL snapshot + flight recorder —
+    # the recorder's append-only event log is exempt by mode) and the
+    # profiler's summary/result JSON
+    "paddle_tpu/observability/export.py",
+    "paddle_tpu/profiler/__init__.py",
+    # jit.save's .pdmodel inference artifact (converted in ISSUE 3)
+    "paddle_tpu/jit/__init__.py",
 ]
 
 # truncating/creating modes only: "a" (append) never destroys prior
