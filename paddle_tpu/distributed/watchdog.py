@@ -21,11 +21,17 @@ import time
 import warnings
 from typing import Callable, Optional
 
+from ..framework import core
 from ..observability import metrics as _m
 
 __all__ = ["CommWatchdog", "watch", "watched_step"]
 
-_DEFAULT_TIMEOUT = float(os.environ.get("FLAGS_comm_timeout", "1800"))
+def _default_timeout() -> float:
+    """Resolved at watchdog CONSTRUCTION, not import: registered default
+    in framework/core.py, overridable by paddle.set_flags at any point
+    before the watchdog is built, and by the FLAGS_comm_timeout env var
+    (get_flag prefers env)."""
+    return float(core.get_flag("FLAGS_comm_timeout", 1800.0))
 
 _WD_TIMEOUTS = _m.counter("watchdog.timeouts_total",
                           "watchdog sections that overran their timeout")
@@ -40,11 +46,12 @@ class CommWatchdog:
 
     FAULT_EXIT_CODE = 101          # ref: fleet/elastic/manager.py:32
 
-    def __init__(self, timeout: float = _DEFAULT_TIMEOUT,
+    def __init__(self, timeout: Optional[float] = None,
                  on_timeout: str = "warn",
                  logger: Optional[Callable[[str], None]] = None,
                  on_fire: Optional[Callable[[str, float], None]] = None):
-        self.timeout = timeout
+        self.timeout = timeout if timeout is not None else \
+            _default_timeout()
         self.on_timeout = on_timeout
         # observability hook (name, elapsed_s) — ElasticManager/chaos
         # tests count conversions of hangs into restarts through this
@@ -161,8 +168,7 @@ def watch(timeout: Optional[float] = None, on_timeout: Optional[str] = None):
     global _global
     if _global is None:
         _global = CommWatchdog(
-            timeout=timeout if timeout is not None else _DEFAULT_TIMEOUT,
-            on_timeout=on_timeout or "warn")
+            timeout=timeout, on_timeout=on_timeout or "warn")
     else:
         if timeout is not None:
             _global.timeout = timeout

@@ -262,6 +262,9 @@ _flags: dict = {
     # deterministic fault schedule, e.g. "ckpt.write_shard:crash@2" —
     # empty = disarmed (fault_point() sites are a single bool check)
     "FLAGS_fault_inject": "",
+    # -- distributed watchdog (consumed by distributed/watchdog.py):
+    # seconds a collective may stall before the watchdog fires
+    "FLAGS_comm_timeout": 1800.0,
     # -- runtime telemetry (consumed by observability/*): arming bool for
     # the metrics registry + span ring (disarmed sites are a single bool
     # check, same discipline as FLAGS_fault_inject), the background

@@ -94,7 +94,10 @@ def _segment_api(pool):
     def op(data, segment_ids, name=None):
         dt = to_tensor_like(data)
         ids = jnp.asarray(unwrap(segment_ids), jnp.int32)
-        num = int(jnp.max(ids)) + 1 if ids.size else 0
+        # required sync: the segment count sizes the op's static output
+        # shape, so it must be a concrete python int before dispatch
+        num = (int(jnp.max(ids)) + 1  # graft-lint: disable=host-sync
+               if ids.size else 0)
 
         def f(a):
             return _finite(_segment(a, ids, num, pool), pool)

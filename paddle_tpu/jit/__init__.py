@@ -399,9 +399,14 @@ class TrainStep:
                     new_master, new_scaler)
 
         # FLAGS_eager_delete_tensor_gb < 0 disables buffer donation (the
-        # reference's eager-deletion kill switch maps to donation here)
+        # reference's eager-deletion kill switch maps to donation here);
+        # FLAGS_max_inplace_grad_add > 0 is the explicit opt-IN for
+        # in-place grad-buffer reuse and overrides that veto
         flag_gb = core.get_flag("FLAGS_eager_delete_tensor_gb", 0.0)
-        donate_ok = self._donate and float(flag_gb or 0.0) >= 0.0
+        force_inplace = int(float(
+            core.get_flag("FLAGS_max_inplace_grad_add", 0) or 0)) > 0
+        donate_ok = self._donate and (
+            force_inplace or float(flag_gb or 0.0) >= 0.0)
         donate = (0, 1, 2, 3) if donate_ok else ()
         if self.shard is not None:
             self._compiled = self.shard.compile_train_step(pure, donate)

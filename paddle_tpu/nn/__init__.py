@@ -35,7 +35,10 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
              for p in params])) ** (1.0 / norm_type)
     if error_if_nonfinite:
         import numpy as _np
-        if not _np.isfinite(float(total)):
+        # required sync: raising a python exception on a non-finite norm
+        # is the documented contract of error_if_nonfinite=True, and the
+        # verdict must be on host to raise (opt-in, off the default path)
+        if not _np.isfinite(float(total)):  # graft-lint: disable=host-sync
             raise RuntimeError(
                 "The total norm of gradients is non-finite, so it cannot "
                 "be clipped (set error_if_nonfinite=False to skip)")

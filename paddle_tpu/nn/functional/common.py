@@ -179,10 +179,14 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
     if size is not None:
         if isinstance(size, Tensor):
             size = [int(v) for v in np.asarray(size.data)]
-        out_spatial = [int(unwrap(s)) for s in (size if isinstance(size, (list, tuple)) else [size])]
+        # required sync: paddle's API accepts tensor sizes/scales, but
+        # the output SHAPE must be concrete before dispatch — one scalar
+        # pull per spatial dim, only when a tensor was passed
+        out_spatial = [int(unwrap(s)) for s in (size if isinstance(size, (list, tuple)) else [size])]  # graft-lint: disable=host-sync
     else:
         sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
             else [scale_factor] * len(in_spatial)
+        # graft-lint: disable=host-sync  (same shape-concretization contract)
         out_spatial = [int(np.floor(d * float(unwrap(f)))) for d, f in zip(in_spatial, sf)]
     out_shape = list(x.shape)
     for a, s in zip(spatial_axes, out_spatial):

@@ -328,8 +328,11 @@ def fractional_max_pool2d(x, output_size, kernel_size=None,
         end = np.concatenate([idx[1:], [inp]])
         return idx, np.maximum(end, idx + 1)
 
+    # required sync: the fractional-pool offset drives HOST-side window
+    # boundary computation (np.floor over output indices), so the one
+    # random scalar must be concrete — a single pull per call
     u = (float(random_u) if random_u is not None
-         else float(jax.random.uniform(core.next_rng_key(), ())))
+         else float(jax.random.uniform(core.next_rng_key(), ())))  # graft-lint: disable=host-sync
     hs, he = edges(H, oh, u)
     ws, we = edges(W, ow, u)
 
@@ -377,8 +380,11 @@ def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
         end = np.concatenate([idx[1:], [inp]])
         return idx, np.maximum(end, idx + 1)
 
+    # required sync: the fractional-pool offset drives HOST-side window
+    # boundary computation (np.floor over output indices), so the one
+    # random scalar must be concrete — a single pull per call
     u = (float(random_u) if random_u is not None
-         else float(jax.random.uniform(core.next_rng_key(), ())))
+         else float(jax.random.uniform(core.next_rng_key(), ())))  # graft-lint: disable=host-sync
     ds, de = edges(D, od, u)
     hs, he = edges(H, oh, u)
     ws, we = edges(W, ow, u)

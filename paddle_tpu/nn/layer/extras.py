@@ -233,6 +233,9 @@ class _FractionalMaxPool(Layer):
                 us = [self.random_u] * nd
             else:
                 key = core.next_rng_key()
+                # required sync: the offsets drive host-side window
+                # boundary computation — one bulk pull per forward
+                # graft-lint: disable=host-sync
                 us = jax.random.uniform(key, (nd,)).tolist() \
                     if not isinstance(key, type(None)) else [0.5] * nd
             # boundaries per spatial dim (host-computed sizes, traced data)

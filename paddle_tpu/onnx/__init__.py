@@ -85,8 +85,11 @@ def export(layer, path: str, input_spec: Optional[Sequence] = None,
                                          graph_name=type(layer).__name__)
         model = proto.model_proto(graph, opset=opset_version)
         out_path = path if path.endswith(".onnx") else path + ".onnx"
-        with open(out_path, "wb") as f:
-            f.write(model)
+        # atomic commit (tmp + fsync + os.replace): a crash mid-export
+        # must not leave a torn .onnx or destroy the previous export
+        from ..framework.io import atomic_write
+        atomic_write(out_path, lambda f: f.write(model),
+                     fault_name="onnx.export")
         return out_path
     finally:
         if was_training and hasattr(layer, "train"):

@@ -955,14 +955,20 @@ def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=Non
 
 def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
     arr = unwrap(input)
-    if min == 0 and max == 0:
-        mn, mx = float(jnp.min(arr)), float(jnp.max(arr))
-    else:
-        mn, mx = float(min), float(max)
-    h, _ = jnp.histogram(arr.ravel(), bins=bins, range=(mn, mx),
-                         weights=unwrap(weight) if weight is not None else None,
+    # paddle's min==max==0 sentinel means "use the data range", which is
+    # jnp.histogram's range=None default — computed on device, no host
+    # sync (float(jnp.min(arr)) here cost two blocking round-trips and
+    # broke tracing)
+    rng = None if (min == 0 and max == 0) else (float(min), float(max))
+    h, _ = jnp.histogram(arr.ravel(), bins=bins, range=rng,
+                         weights=(unwrap(weight).ravel()
+                                  if weight is not None else None),
                          density=density)
-    return Tensor(h if density else h.astype(jnp.int64))
+    # int64 counts only for the plain unweighted histogram: weighted bin
+    # sums are fractional (paddle returns float there) and an int cast
+    # would floor sub-1.0 bins to zero
+    return Tensor(h if (density or weight is not None)
+                  else h.astype(jnp.int64))
 
 
 def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):

@@ -46,7 +46,9 @@ def create_array(dtype="float32", initialized_list=None) -> TensorArray:
 
 def _idx(i) -> int:
     if isinstance(i, Tensor):
-        return int(i.numpy().reshape(()))
+        # required sync: a TensorArray index addresses a python list, so
+        # a tensor index must concretize — one scalar pull per access
+        return int(i.numpy().reshape(()))  # graft-lint: disable=host-sync
     return int(i)
 
 

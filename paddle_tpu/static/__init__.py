@@ -381,20 +381,43 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
         else:
             abstract.append(jax.ShapeDtypeStruct(tuple(shape), dt))
     exp = jexport.export(jax.jit(fwd))(*abstract)
-    with open(path_prefix + ".pdmodel", "wb") as f:
-        f.write(exp.serialize())
+    # atomic commit (tmp + fsync + os.replace) per file, .pdmodel LAST:
+    # each file is individually crash-safe. The pair spans two files, so
+    # a crash BETWEEN the replaces can still mix generations — the
+    # .pdiparams carries the .pdmodel's sha256 and the loader verifies
+    # it, turning a mixed pair into a loud error instead of silently
+    # misbound feeds
+    import hashlib
     import pickle
-    with open(path_prefix + ".pdiparams", "wb") as f:
-        pickle.dump({"feed_names": names}, f)
+
+    from ..framework.io import atomic_write
+    blob = exp.serialize()
+    meta = {"feed_names": names,
+            "model_sha256": hashlib.sha256(blob).hexdigest()}
+    atomic_write(path_prefix + ".pdiparams",
+                 lambda f: pickle.dump(meta, f),
+                 fault_name="static.save_params")
+    atomic_write(path_prefix + ".pdmodel", lambda f: f.write(blob),
+                 fault_name="static.save_model")
 
 
 def load_inference_model(path_prefix, executor=None, **kw):
+    import hashlib
+    import pickle
+
     from jax import export as jexport
     with open(path_prefix + ".pdmodel", "rb") as f:
-        exp = jexport.deserialize(f.read())
-    import pickle
+        raw = f.read()
     with open(path_prefix + ".pdiparams", "rb") as f:
         meta = pickle.load(f)
+    want = meta.get("model_sha256") if isinstance(meta, dict) else None
+    if want is not None and hashlib.sha256(raw).hexdigest() != want:
+        raise ValueError(
+            f"torn inference-model pair at {path_prefix!r}: "
+            f".pdiparams was written for a different .pdmodel (a crash "
+            f"landed between the two commits) — re-export with "
+            f"save_inference_model")
+    exp = jexport.deserialize(raw)
 
     class _Prog:
         def __init__(self):

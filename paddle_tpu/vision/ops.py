@@ -65,7 +65,10 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
 
     keep, _ = jax.lax.scan(body, jnp.zeros((N,), bool), jnp.arange(N))
     kept_sorted = order[jnp.nonzero(keep, size=N, fill_value=-1)[0]]
-    n_keep = int(jnp.sum(keep))
+    # count on host from the mask pull: still two transfers total (mask
+    # + kept indices), but no device-side reduction dispatched just to
+    # produce one scalar
+    n_keep = int(np.asarray(keep).sum())
     out = np.asarray(kept_sorted)[:n_keep]
     if top_k is not None:
         out = out[:top_k]
@@ -98,9 +101,15 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
                 decay = 1.0 - max_iou
             dec = sc_s * decay
             m = dec > max(score_threshold, post_threshold)
-            for j in range(bx.shape[0]):
-                if bool(m[j]):
-                    per.append((float(dec[j]), c, bx[j], int(order[j])))
+            # one bulk device->host pull per class; the previous
+            # bool(m[j])/float(dec[j])/int(order[j]) per-element form
+            # paid 3 blocking syncs per candidate box
+            dec_h, m_h = np.asarray(dec), np.asarray(m)
+            bx_h, order_h = np.asarray(bx), np.asarray(order)
+            for j in range(bx_h.shape[0]):
+                if m_h[j]:
+                    per.append((float(dec_h[j]), c, bx_h[j],
+                                int(order_h[j])))
         per.sort(key=lambda t: -t[0])
         per = per[:keep_top_k]
         outs.append(np.array([[c, scv, *np.asarray(box)]
@@ -566,7 +575,10 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         ok = ((boxes[:, 2] - boxes[:, 0] >= min_size)
               & (boxes[:, 3] - boxes[:, 1] >= min_size))
         boxes, sc = boxes[ok], sc[ok]
-        keep = np.asarray(nms(jnp.asarray(boxes), nms_thresh,
+        # host-side proposal assembly: one bulk sync per image to bring
+        # the device NMS verdict back for numpy post-filtering — required
+        # here, the surrounding algorithm is numpy end-to-end
+        keep = np.asarray(nms(jnp.asarray(boxes), nms_thresh,  # graft-lint: disable=host-sync
                               jnp.asarray(sc)).numpy())[:post_nms_top_n]
         rois_out.append(boxes[keep])
         num_out.append(len(keep))

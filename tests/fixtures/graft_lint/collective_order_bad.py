@@ -1,0 +1,24 @@
+"""Known-bad fixture for the collective-order pass — each function has
+the static signature of a cross-rank deadlock."""
+import jax
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.collective import all_reduce
+
+
+def rank_gated_reduce(t, rank):
+    if rank == 0:
+        all_reduce(t)          # ranks != 0 never enter: deadlock
+    return t
+
+
+def early_return_then_reduce(t, group):
+    if dist.get_rank() != 0:
+        return t
+    return dist.all_reduce(t, group=group)   # rank 0 waits forever
+
+
+def lax_psum_in_rank_branch(x, rank):
+    if rank > 0:
+        x = jax.lax.psum(x, "dp")            # rank 0 skips the psum
+    return x

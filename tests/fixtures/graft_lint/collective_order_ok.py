@@ -1,0 +1,30 @@
+"""Known-good fixture for the collective-order pass — collectives every
+rank reaches, plus shapes that merely look similar."""
+from paddle_tpu.distributed.collective import all_reduce
+
+
+def reduce_then_log(t, rank):
+    out = all_reduce(t)        # before any rank branching: every rank
+    if rank == 0:
+        _log(out)              # non-collective work may be rank-gated
+    return out
+
+
+def data_gated(t, enabled):
+    if enabled:                # data condition, not a rank condition
+        t = all_reduce(t)
+    return t
+
+
+def scatter(x):                # local helper shadowing a collective name
+    return x
+
+
+def uses_local_scatter(x, rank):
+    if rank == 0:
+        x = scatter(x)         # not imported from a collective module
+    return x
+
+
+def _log(x):
+    return x

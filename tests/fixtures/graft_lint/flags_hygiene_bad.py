@@ -1,0 +1,9 @@
+"""Known-bad fixture for the flags-hygiene pass — one registered read,
+one typo'd read that would silently return its fallback forever."""
+from paddle_tpu.framework import core
+
+
+def read_flags():
+    good = core.get_bool_flag("FLAGS_benchmark")
+    bad = core.get_flag("FLAGS_bennchmark_typo", False)
+    return good, bad

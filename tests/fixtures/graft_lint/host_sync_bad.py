@@ -1,0 +1,24 @@
+"""Known-bad fixture for the host-sync pass — per-element device pulls
+in what looks like library hot-path code."""
+import numpy as np
+
+from paddle_tpu.ops._helpers import unwrap
+
+
+def slow_threshold_count(x, thr):
+    arr = unwrap(x)
+    total = 0
+    for i in range(int(arr.shape[0])):   # shape is host metadata: fine
+        v = float(arr[i])                # blocking sync PER ELEMENT
+        if v > thr:
+            total += 1
+    return total
+
+
+def scalarize(t):
+    return t.mean().item()               # sync on an unproven receiver
+
+
+def fine_host(x):
+    arr = np.asarray(x)                  # one bulk pull
+    return float(arr.sum())              # host arithmetic: fine

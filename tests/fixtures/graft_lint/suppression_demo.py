@@ -1,0 +1,14 @@
+"""Fixture proving per-line suppressions: three identical violations,
+two suppressed (inline and standalone-comment forms), one live."""
+import time
+
+from paddle_tpu.jit import to_static
+
+
+@to_static
+def partially_suppressed(x):
+    t0 = time.time()  # graft-lint: disable=trace-safety
+    t1 = time.time()  # the one live finding in this file
+    # graft-lint: disable=trace-safety
+    t2 = time.time()
+    return x, t0, t1, t2

@@ -1,0 +1,34 @@
+"""Known-bad fixture for the trace-safety pass — every construct here
+silently misbehaves under tracing. Never imported; parsed only."""
+import random
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.jit import to_static
+
+STEP = 0
+
+
+@to_static
+def bad_step(x):
+    global STEP                    # global mutation escapes the trace
+    STEP += 1
+    print("step", STEP)            # fires at trace time only
+    t0 = time.time()               # constant-folds to one timestamp
+    noise = np.random.rand()       # host RNG constant-folds
+    r = random.random()            # host RNG constant-folds
+    y = jnp.sin(x) * noise + r
+    lr = float(jnp.mean(y))        # host sync / tracer error
+    host = y.numpy()               # host sync / tracer error
+    s = y.item()                   # host sync / tracer error
+    return y, lr, t0, host, s
+
+
+@to_static
+def outer(x):
+    def inner(a):
+        print("inner traces too")  # nested def traces when called
+        return a
+    return inner(x)

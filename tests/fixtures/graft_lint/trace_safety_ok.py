@@ -1,0 +1,21 @@
+"""Known-good fixture for the trace-safety pass — the traced-safe
+equivalents of everything trace_safety_bad.py does wrong."""
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.jit import to_static
+
+
+@to_static
+def good_step(x, key):
+    noise = jax.random.uniform(key, x.shape)   # traced RNG: fresh per step
+    y = jnp.sin(x) + noise
+    jax.debug.print("mean {m}", m=jnp.mean(y))  # runtime-side print
+    return y
+
+
+def host_helper(values):
+    # not traced: host constructs are fine here (trace-safety scope is
+    # decorated bodies only)
+    print("host-side logging is fine")
+    return [v * 2 for v in values]

@@ -1,0 +1,2 @@
+# `tools` is a package so `python -m tools.graft_lint` works from the
+# repo root; the standalone scripts in here still run by path.

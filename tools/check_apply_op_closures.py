@@ -1,136 +1,47 @@
 #!/usr/bin/env python
-"""Guard against cache-defeating `apply_op(lambda ...)` call sites.
+"""Shim over tools/graft_lint — the `apply-op-closures` pass.
 
-The eager dispatch cache (paddle_tpu/autograd/tape.py) keys op callables on
-code identity, which only works when the callable carries no per-call state:
-a lambda (or nested def) that closes over enclosing locals gets a fresh
-closure every call and silently misses the cache forever. The refactored
-modules below pass indices/axes through keyword-only static kwargs instead;
-this checker keeps that invariant from regressing.
-
-A lambda passed to apply_op is only flagged when it CAPTURES enclosing
-function locals — capture-free lambdas (`lambda a, b: a @ b`) share one code
-object per source site and are cacheable as-is.
-
-Usage: python tools/check_apply_op_closures.py [files...]
-Exit 1 (with a report) if any violation is found. Wired into tier-1 via
-tests/test_dispatch_cache.py.
+Guards against cache-defeating `apply_op(lambda ...)` call sites: the
+eager dispatch cache (paddle_tpu/autograd/tape.py) keys op callables on
+code identity, so a lambda capturing enclosing locals misses the cache
+forever. See tools/graft_lint/passes/apply_op_closures.py for the pass;
+this file only preserves the historical CLI
+(`python tools/check_apply_op_closures.py [files...]`) and module API
+(`CHECKED_MODULES`, `check_file`, `main`) that tests and muscle memory
+depend on. Wired into tier-1 via tests/test_dispatch_cache.py.
 """
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:      # standalone execution by file path
+    sys.path.insert(0, str(REPO))
 
-# modules refactored for the dispatch cache: keep them closure-free at
-# apply_op call sites
-CHECKED_MODULES = [
-    "paddle_tpu/tensor.py",
-    "paddle_tpu/ops/_helpers.py",
-    "paddle_tpu/ops/manipulation.py",
-    "paddle_tpu/ops/math.py",
-    "paddle_tpu/ops/reduction.py",
-    "paddle_tpu/nn/functional/common.py",
-    "paddle_tpu/nn/functional/activation.py",
-    "paddle_tpu/nn/functional/pooling.py",
-]
+from tools.graft_lint.core import run_collect  # noqa: E402
+from tools.graft_lint.passes.apply_op_closures import (  # noqa: E402
+    CHECKED_MODULES, ApplyOpClosuresPass,
+)
 
-
-def _is_apply_op(func: ast.AST) -> bool:
-    if isinstance(func, ast.Name):
-        return func.id in ("apply_op", "_unary")
-    if isinstance(func, ast.Attribute):
-        return func.attr == "apply_op"
-    return False
-
-
-class _ScopeVisitor(ast.NodeVisitor):
-    """Track enclosing function scopes' bound names; flag apply_op lambdas
-    whose free variables resolve to one of them."""
-
-    def __init__(self, path: str):
-        self.path = path
-        self.scope_stack: list[set] = []
-        self.violations: list[tuple[int, str]] = []
-
-    # -- scope bookkeeping --------------------------------------------------
-    def _bound_names(self, node) -> set:
-        bound = set()
-        for a in list(node.args.args) + list(node.args.posonlyargs) \
-                + list(node.args.kwonlyargs):
-            bound.add(a.arg)
-        if node.args.vararg:
-            bound.add(node.args.vararg.arg)
-        if node.args.kwarg:
-            bound.add(node.args.kwarg.arg)
-        for sub in ast.walk(node):
-            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
-                bound.add(sub.id)
-            elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                bound.add(sub.name)
-            elif isinstance(sub, ast.comprehension):
-                for t in ast.walk(sub.target):
-                    if isinstance(t, ast.Name):
-                        bound.add(t.id)
-        return bound
-
-    def visit_FunctionDef(self, node):
-        self.scope_stack.append(self._bound_names(node))
-        self.generic_visit(node)
-        self.scope_stack.pop()
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-    # -- the check ----------------------------------------------------------
-    def visit_Call(self, node):
-        if _is_apply_op(node.func) and self.scope_stack:
-            for arg in node.args:
-                if isinstance(arg, ast.Lambda):
-                    captured = self._captured_locals(arg)
-                    if captured:
-                        self.violations.append((
-                            node.lineno,
-                            f"apply_op(lambda ...) captures enclosing "
-                            f"locals {sorted(captured)} — move the body to "
-                            f"a module-level function and pass these via "
-                            f"static kwargs"))
-        self.generic_visit(node)
-
-    def _captured_locals(self, lam: ast.Lambda) -> set:
-        params = {a.arg for a in list(lam.args.args)
-                  + list(lam.args.posonlyargs) + list(lam.args.kwonlyargs)}
-        if lam.args.vararg:
-            params.add(lam.args.vararg.arg)
-        if lam.args.kwarg:
-            params.add(lam.args.kwarg.arg)
-        enclosing = set().union(*self.scope_stack) if self.scope_stack else set()
-        captured = set()
-        for sub in ast.walk(lam.body):
-            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
-                if sub.id not in params and sub.id in enclosing:
-                    captured.add(sub.id)
-        return captured
+__all__ = ["CHECKED_MODULES", "check_file", "main"]
 
 
 def check_file(path: Path) -> list:
-    tree = ast.parse(path.read_text(), filename=str(path))
-    v = _ScopeVisitor(str(path))
-    v.visit(tree)
-    return [(str(path), ln, msg) for ln, msg in v.violations]
+    res = run_collect([ApplyOpClosuresPass()], paths=[Path(path)],
+                      repo=REPO)
+    return [(f.path, f.line, f.message) for f in res.active]
 
 
 def main(argv=None) -> int:
     args = (argv if argv is not None else sys.argv[1:])
-    files = [Path(a) for a in args] or [REPO / m for m in CHECKED_MODULES]
-    violations = []
-    for f in files:
-        violations.extend(check_file(f))
-    for path, ln, msg in violations:
-        print(f"{path}:{ln}: {msg}")
-    if violations:
-        print(f"\n{len(violations)} cache-defeating apply_op closure(s) found")
+    paths = [Path(a) for a in args] or None
+    res = run_collect([ApplyOpClosuresPass()], paths=paths, repo=REPO)
+    for f in res.active:
+        print(f"{f.path}:{f.line}: {f.message}")
+    if res.active:
+        print(f"\n{len(res.active)} cache-defeating apply_op "
+              f"closure(s) found")
         return 1
     return 0
 

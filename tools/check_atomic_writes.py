@@ -1,160 +1,47 @@
 #!/usr/bin/env python
-"""Guard against bare (non-atomic) writes on durability-critical paths.
+"""Shim over tools/graft_lint — the `atomic-writes` pass.
 
-A crash between `open(path, "wb")` and close leaves a TORN file at a
-user-visible persistence path — and destroys the previous bytes the
-moment the open succeeds. Every such write must go through
-`paddle_tpu.framework.io.atomic_write` (tmp + fsync + os.replace +
-dir fsync) so a crash at any instant leaves either the old complete
-file or the new complete file; ISSUE 2's checkpoint commit protocol
-depends on this invariant.
-
-Flagged in the checked modules:
-- `open(path, mode)` with a creating/truncating mode (w/a/x)
-- `np.save` / `np.savez` / `np.savez_compressed` straight to a path
-
-Allowed:
-- anything inside `atomic_write` itself (or a function whose name
-  contains "atomic") — that's the helper's own tmp write
-- anything inside a lambda/def passed TO `atomic_write(...)` — the
-  write_fn fills the helper's tmp file handle
-- a path expression mentioning a tmp/buf name (`tmp`, `buf`, …): a
-  same-directory tmp later `os.replace`d, or an in-memory buffer
-
-Usage: python tools/check_atomic_writes.py [files...]
-Exit 1 (with a report) if any violation is found. Wired into tier-1 via
-tests/test_fault_injection.py.
+Guards against bare (non-atomic) writes on durability-critical paths:
+every user-visible persistence write must go through
+`paddle_tpu.framework.io.atomic_write` (tmp + fsync + os.replace + dir
+fsync) so a crash at any instant leaves either the old complete file or
+the new complete file. See tools/graft_lint/passes/atomic_writes.py for
+the pass; this file only preserves the historical CLI
+(`python tools/check_atomic_writes.py [files...]`) and module API
+(`CHECKED_MODULES`, `check_file`, `main`). Wired into tier-1 via
+tests/test_fault_injection.py and tests/test_observability.py.
 """
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:      # standalone execution by file path
+    sys.path.insert(0, str(REPO))
 
-# modules holding user-visible persistence paths already converted to the
-# atomic-write protocol; grow this list as more writers are converted
-# (static.save / onnx.export are ROADMAP open items)
-CHECKED_MODULES = [
-    "paddle_tpu/framework/io.py",
-    "paddle_tpu/distributed/checkpoint.py",
-    "paddle_tpu/distributed/elastic.py",
-    "paddle_tpu/distributed/ps/__init__.py",
-    # ISSUE 3: observability writers (JSONL snapshot + flight recorder —
-    # the recorder's append-only event log is exempt by mode) and the
-    # profiler's summary/result JSON
-    "paddle_tpu/observability/export.py",
-    "paddle_tpu/profiler/__init__.py",
-    # jit.save's .pdmodel inference artifact (converted in ISSUE 3)
-    "paddle_tpu/jit/__init__.py",
-]
+from tools.graft_lint.core import run_collect  # noqa: E402
+from tools.graft_lint.passes.atomic_writes import (  # noqa: E402
+    CHECKED_MODULES, AtomicWritesPass,
+)
 
-# truncating/creating modes only: "a" (append) never destroys prior
-# bytes — append-only logs (ps LSM shards) recover torn tails themselves
-_WRITE_MODES = set("wx")
-_SAFE_NAME_HINTS = ("tmp", "temp", "buf", "bio")
-
-
-def _expr_mentions_safe_name(node) -> bool:
-    for sub in ast.walk(node):
-        name = None
-        if isinstance(sub, ast.Name):
-            name = sub.id
-        elif isinstance(sub, ast.Attribute):
-            name = sub.attr
-        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
-            name = sub.value
-        if name and any(h in name.lower() for h in _SAFE_NAME_HINTS):
-            return True
-    return False
-
-
-def _is_bare_open_write(call: ast.Call) -> bool:
-    fn = call.func
-    is_open = (isinstance(fn, ast.Name) and fn.id == "open") or \
-        (isinstance(fn, ast.Attribute) and fn.attr == "fdopen")
-    if not is_open or len(call.args) < 2:
-        return False
-    mode = call.args[1]
-    if not (isinstance(mode, ast.Constant) and isinstance(mode.value, str)):
-        return False
-    return bool(set(mode.value) & _WRITE_MODES)
-
-
-def _is_np_save(call: ast.Call) -> bool:
-    fn = call.func
-    return (isinstance(fn, ast.Attribute)
-            and fn.attr in ("save", "savez", "savez_compressed")
-            and isinstance(fn.value, ast.Name)
-            and fn.value.id in ("np", "numpy"))
-
-
-def _safe_region_ids(tree) -> set:
-    """Node ids inside the atomic helper or inside callables passed to
-    atomic_write(...) — writes there fill the helper's tmp file."""
-    safe = set()
-    inner_defs = set()      # names of defs passed to atomic_write by name
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
-                "atomic" in node.name.lower():
-            safe.update(id(s) for s in ast.walk(node))
-        if isinstance(node, ast.Call):
-            fn = node.func
-            fname = fn.id if isinstance(fn, ast.Name) else (
-                fn.attr if isinstance(fn, ast.Attribute) else "")
-            if fname == "atomic_write":
-                for arg in list(node.args) + [kw.value for kw in
-                                              node.keywords]:
-                    if isinstance(arg, ast.Lambda):
-                        safe.update(id(s) for s in ast.walk(arg))
-                    elif isinstance(arg, ast.Name):
-                        inner_defs.add(arg.id)
-    if inner_defs:
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                    and node.name in inner_defs:
-                safe.update(id(s) for s in ast.walk(node))
-    return safe
+__all__ = ["CHECKED_MODULES", "check_file", "main"]
 
 
 def check_file(path: Path) -> list:
-    tree = ast.parse(path.read_text(), filename=str(path))
-    safe = _safe_region_ids(tree)
-    violations = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call) or id(node) in safe:
-            continue
-        if _is_bare_open_write(node):
-            target = node.args[0]
-            kind = "open(..., %r)" % node.args[1].value
-        elif _is_np_save(node):
-            if not node.args:
-                continue
-            target = node.args[0]
-            kind = f"np.{node.func.attr}(...)"
-        else:
-            continue
-        if _expr_mentions_safe_name(target):
-            continue        # tmp-file/buffer write: renamed or in-memory
-        violations.append((
-            node.lineno,
-            f"bare {kind} to a persistence path — route it through "
-            f"framework.io.atomic_write (tmp + fsync + os.replace) so a "
-            f"crash cannot tear the file or destroy the previous one"))
-    return [(str(path), ln, msg) for ln, msg in violations]
+    res = run_collect([AtomicWritesPass()], paths=[Path(path)], repo=REPO)
+    return [(f.path, f.line, f.message) for f in res.active]
 
 
 def main(argv=None) -> int:
     args = (argv if argv is not None else sys.argv[1:])
-    files = [Path(a) for a in args] or [REPO / m for m in CHECKED_MODULES]
-    violations = []
-    for f in files:
-        violations.extend(check_file(f))
-    for path, ln, msg in violations:
-        print(f"{path}:{ln}: {msg}")
-    if violations:
-        print(f"\n{len(violations)} non-atomic persistence write(s) found")
+    paths = [Path(a) for a in args] or None
+    res = run_collect([AtomicWritesPass()], paths=paths, repo=REPO)
+    for f in res.active:
+        print(f"{f.path}:{f.line}: {f.message}")
+    if res.active:
+        print(f"\n{len(res.active)} non-atomic persistence write(s) "
+              f"found")
         return 1
     return 0
 

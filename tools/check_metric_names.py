@@ -1,106 +1,54 @@
 #!/usr/bin/env python
-"""Guard the metrics registry's namespace against silting up.
+"""Shim over tools/graft_lint — the `metric-names` pass.
 
-Every instrument-creating call site in `paddle_tpu/` —
-`metrics.counter(...)`, `metrics.gauge(...)`, `metrics.histogram(...)`
-(or through the conventional aliases `_m` / `_om` / `_metrics` /
-`observability`) — must:
-
-1. pass a LITERAL first argument (no f-strings, concatenation or
-   variables: a computed id defeats grep, this lint, and dashboard
-   queries alike),
-2. use the `subsystem.name` snake_case shape the registry enforces at
-   runtime (e.g. `ckpt.save_seconds`), and
-3. be the ONLY creation site for that (kind, id) pair — one instrument,
-   one home module; shared instruments are imported, not re-requested,
-   so a typo'd near-duplicate (`ckpt.save_total` vs `ckpt.saves_total`)
-   cannot silently fork a metric into two series.
-
-Collector-bridged ids (register_collector rows) are data, not creation
-sites, and are out of scope here; the registry's own name validation
-still covers them at runtime.
-
-Usage: python tools/check_metric_names.py [files...]
-Exit 1 (with a report) on any violation. Wired into tier-1 via
+Guards the metrics registry's namespace: every instrument-creating call
+site must use a literal snake_case 'subsystem.name' id, unique per
+(kind, id) pair. See tools/graft_lint/passes/metric_names.py for the
+pass; this file only preserves the historical CLI
+(`python tools/check_metric_names.py [files...]`) and module API
+(`check_file`, `main`). Wired into tier-1 via
 tests/test_observability.py.
 """
 from __future__ import annotations
 
-import ast
-import re
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-PACKAGE = REPO / "paddle_tpu"
+if str(REPO) not in sys.path:      # standalone execution by file path
+    sys.path.insert(0, str(REPO))
 
-KINDS = ("counter", "gauge", "histogram")
-# module aliases the registry is conventionally imported under
-ALIASES = {"metrics", "_m", "_om", "_metrics", "observability"}
-NAME_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*$")
+from tools.graft_lint.core import run_collect  # noqa: E402
+from tools.graft_lint.passes.metric_names import (  # noqa: E402
+    MetricNamesPass,
+)
 
-
-def _creation_calls(tree):
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        if isinstance(fn, ast.Attribute) and fn.attr in KINDS and \
-                isinstance(fn.value, ast.Name) and fn.value.id in ALIASES:
-            yield node, fn.attr
+__all__ = ["check_file", "main"]
 
 
-def check_file(path: Path, seen: dict) -> list:
-    violations = []
-    tree = ast.parse(path.read_text(), filename=str(path))
-    for node, kind in _creation_calls(tree):
-        if not node.args:
-            violations.append((path, node.lineno,
-                               f"metrics.{kind}(...) with no id argument"))
-            continue
-        arg = node.args[0]
-        if not (isinstance(arg, ast.Constant) and
-                isinstance(arg.value, str)):
-            violations.append((
-                path, node.lineno,
-                f"metrics.{kind}(...) id must be a string LITERAL "
-                f"(computed ids defeat grep, this lint and dashboards)"))
-            continue
-        name = arg.value
-        if not NAME_RE.match(name):
-            violations.append((
-                path, node.lineno,
-                f"metric id {name!r} must be snake_case "
-                f"'subsystem.name' (e.g. 'ckpt.save_seconds')"))
-            continue
-        key = (kind, name)
-        if key in seen:
-            prev_path, prev_line = seen[key]
-            violations.append((
-                path, node.lineno,
-                f"duplicate creation site for {kind} {name!r} "
-                f"(first at {prev_path}:{prev_line}) — import the "
-                f"existing instrument instead of re-requesting it"))
-        else:
-            seen[key] = (path, node.lineno)
-    return violations
+def check_file(path: Path, seen: dict = None) -> list:
+    """Old-API entry: callers thread one `seen` dict across files to get
+    cross-file duplicate detection, exactly as the standalone checker
+    did."""
+    from tools.graft_lint.core import FileContext
+    p = MetricNamesPass()
+    p.begin(REPO)
+    if seen is not None:
+        p._seen = seen
+    ctx = FileContext.load(Path(path), REPO)
+    findings = [f for f in p.check_file(ctx)
+                if not ctx.suppressed(f.line, p.name)]
+    return [(f.path, f.line, f.message) for f in findings]
 
 
 def main(argv=None) -> int:
-    args = argv if argv is not None else sys.argv[1:]
-    if args:
-        files = [Path(a) for a in args]
-    else:
-        files = sorted(p for p in PACKAGE.rglob("*.py")
-                       if "__pycache__" not in p.parts)
-    seen: dict = {}
-    violations = []
-    for f in files:
-        violations.extend(check_file(f, seen))
-    for path, ln, msg in violations:
-        print(f"{path}:{ln}: {msg}")
-    if violations:
-        print(f"\n{len(violations)} metric-naming violation(s) found")
+    args = (argv if argv is not None else sys.argv[1:])
+    paths = [Path(a) for a in args] or None
+    res = run_collect([MetricNamesPass()], paths=paths, repo=REPO)
+    for f in res.active:
+        print(f"{f.path}:{f.line}: {f.message}")
+    if res.active:
+        print(f"\n{len(res.active)} metric-naming violation(s) found")
         return 1
     return 0
 

@@ -1,0 +1,42 @@
+"""graft-lint — the repo's unified static-analysis suite.
+
+One AST walk, many passes. Before this package the repo had three
+one-off checkers (`tools/check_apply_op_closures.py`,
+`check_atomic_writes.py`, `check_metric_names.py`) that each
+reimplemented file walking, safe-region tracking and CLI plumbing;
+those now ride on this framework as passes (the old scripts remain as
+thin shims), and four new semantic passes cover the bug classes that
+actually burn TPU users:
+
+- ``trace-safety``     host side effects / host syncs inside
+                       `@to_static`- or `jax.jit`-traced bodies (they
+                       silently constant-fold at trace time or force a
+                       device round-trip per step)
+- ``host-sync``        `.numpy()` / `.item()` / `float()`-family syncs
+                       in library hot paths (warning tier, baselined)
+- ``collective-order`` collectives inside rank-conditional branches or
+                       after rank-conditional early returns — the
+                       static signature of a cross-rank deadlock
+- ``flags-hygiene``    every `FLAGS_*` literal resolves to a registered
+                       default in `framework/core.py`; registered flags
+                       nobody reads are reported dead
+
+Usage::
+
+    python -m tools.graft_lint [paths...]          # full default run
+    python -m tools.graft_lint --pass trace-safety paddle_tpu/
+    python -m tools.graft_lint --changed           # git-diff scoped
+    python -m tools.graft_lint --write-baseline    # regenerate baseline
+
+Findings are suppressed per line with ``# graft-lint: disable=<pass>``
+(same line, or a standalone comment line directly above) — always pair a
+suppression with a comment saying WHY the flagged construct is required.
+Grandfathered findings live in ``tools/graft_lint/baseline.json`` as
+``"pass:path" -> count`` entries that may only shrink; regenerate with
+``--write-baseline`` after fixing some.
+"""
+from .core import (  # noqa: F401
+    REPO, Finding, FileContext, LintPass, load_baseline, run,
+    run_collect, write_baseline,
+)
+from .passes import ALL_PASSES, get_passes  # noqa: F401

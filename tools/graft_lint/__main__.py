@@ -1,0 +1,54 @@
+"""`python -m tools.graft_lint` — unified static-analysis entry point."""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    from .core import run
+    from .passes import ALL_PASSES
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graft_lint",
+        description="Run the repo's static-analysis passes.")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories to lint (default: each "
+                         "pass's own scope)")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    metavar="NAME",
+                    help="run only this pass (repeatable; accepts "
+                         "comma-separated lists)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only .py files that differ from git HEAD "
+                         "(staged, unstaged or untracked)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate tools/graft_lint/baseline.json from "
+                         "the current findings")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also print findings covered by the baseline")
+    ap.add_argument("--list-passes", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for p in ALL_PASSES:
+            print(f"{p.name:18} [{p.severity:7}] {p.description}")
+        return 0
+
+    selected = None
+    if args.passes:
+        selected = [n.strip() for grp in args.passes
+                    for n in grp.split(",") if n.strip()]
+        unknown = set(selected) - {p.name for p in ALL_PASSES}
+        if unknown:
+            ap.error(f"unknown pass(es): {', '.join(sorted(unknown))} "
+                     f"(see --list-passes)")
+    return run(pass_names=selected, paths=args.paths or None,
+               fmt=args.format, changed=args.changed,
+               regen_baseline=args.write_baseline,
+               show_baselined=args.show_baselined)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

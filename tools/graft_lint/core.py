@@ -1,0 +1,437 @@
+"""Framework core: file walking, suppressions, baseline, run loop.
+
+Every pass sees each file through one shared parse (`FileContext`) —
+the walker reads and `ast.parse`s a file exactly once no matter how
+many passes inspect it. Suppression and baseline handling live here so
+individual passes only ever *emit* findings; they never need to know
+how a finding gets silenced.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+REPO = Path(__file__).resolve().parents[2]
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+
+_SUPPRESS_RE = re.compile(r"#\s*graft-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint hit. `path` is repo-relative (posix) for files under the
+    repo so baseline keys survive checkouts at different roots."""
+
+    path: str
+    line: int
+    pass_name: str
+    message: str
+    severity: str = "error"          # "error" | "warning"
+    baselined: bool = False
+
+    @property
+    def key(self) -> str:
+        return f"{self.pass_name}:{self.path}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        tag = self.pass_name + (
+            "" if self.severity == "error" else f" {self.severity}")
+        return f"{self.path}:{self.line}: [{tag}] {self.message}"
+
+
+class FileContext:
+    """One parsed file shared by every pass that inspects it."""
+
+    def __init__(self, path: Path, relpath: str, text: str,
+                 tree: ast.Module):
+        self.path = path
+        self.relpath = relpath
+        self.text = text
+        self.tree = tree
+        self.lines = text.splitlines()
+        self._suppressions: Optional[Dict[int, Set[str]]] = None
+
+    @classmethod
+    def load(cls, path: Path, repo: Path) -> "FileContext":
+        text = path.read_text()
+        tree = ast.parse(text, filename=str(path))
+        return cls(path, relpath(path, repo), text, tree)
+
+    def suppressions(self) -> Dict[int, Set[str]]:
+        """1-based line -> set of disabled pass names ('all' wildcards).
+        A standalone `# graft-lint: disable=...` comment line also covers
+        the next line (for findings on lines too long to annotate)."""
+        if self._suppressions is None:
+            sup: Dict[int, Set[str]] = {}
+            for i, raw in enumerate(self.lines, start=1):
+                m = _SUPPRESS_RE.search(raw)
+                if not m:
+                    continue
+                names = {p.strip() for p in m.group(1).split(",") if p.strip()}
+                sup.setdefault(i, set()).update(names)
+                if raw.lstrip().startswith("#"):     # comment-only line
+                    sup.setdefault(i + 1, set()).update(names)
+            self._suppressions = sup
+        return self._suppressions
+
+    def suppressed(self, line: int, pass_name: str) -> bool:
+        names = self.suppressions().get(line, ())
+        return pass_name in names or "all" in names
+
+
+class LintPass:
+    """Base class. Subclasses set `name`, `description`, `severity` and
+    `scope` (repo-relative file paths or directory prefixes ending in
+    '/'), and implement `check_file`. Cross-file passes accumulate in
+    `check_file` and emit from `finish` — the runner sets
+    `scanned_full_scope` before calling it so whole-repo analyses
+    (e.g. dead-flag detection) can bail on partial runs."""
+
+    name: str = ""
+    description: str = ""
+    severity: str = "error"
+    scope: Tuple[str, ...] = ("paddle_tpu/",)
+    scanned_full_scope: bool = False
+
+    def begin(self, repo: Path) -> None:
+        pass
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finish(self) -> Iterable[Finding]:
+        return ()
+
+    def in_scope(self, rel: str) -> bool:
+        return any(rel == s or (s.endswith("/") and rel.startswith(s))
+                   for s in self.scope)
+
+    def finding(self, ctx: FileContext, line: int, message: str,
+                severity: Optional[str] = None) -> Finding:
+        return Finding(ctx.relpath, line, self.name, message,
+                       severity or self.severity)
+
+
+def relpath(path: Path, repo: Path) -> str:
+    try:
+        return path.resolve().relative_to(repo.resolve()).as_posix()
+    except ValueError:
+        return path.resolve().as_posix()
+
+
+def expand_scope(repo: Path, scope: Sequence[str]) -> List[Path]:
+    out: List[Path] = []
+    for s in scope:
+        p = repo / s
+        if s.endswith("/"):
+            if p.is_dir():
+                out.extend(sorted(
+                    f for f in p.rglob("*.py")
+                    if "__pycache__" not in f.parts))
+        elif p.is_file():
+            out.append(p)
+    return out
+
+
+def changed_files(repo: Path) -> List[Path]:
+    """Working-tree .py files that differ from HEAD (staged, unstaged,
+    or untracked) — the fast pre-commit scope for `--changed`."""
+    names: Set[str] = set()
+    for cmd in (["git", "-C", str(repo), "diff", "--name-only", "HEAD",
+                 "--"],
+                ["git", "-C", str(repo), "ls-files", "--others",
+                 "--exclude-standard"]):
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 check=True)
+        except (OSError, subprocess.CalledProcessError):
+            continue
+        names.update(ln.strip() for ln in res.stdout.splitlines()
+                     if ln.strip())
+    return sorted(repo / n for n in names
+                  if n.endswith(".py") and (repo / n).is_file())
+
+
+# -- baseline ----------------------------------------------------------------
+
+def load_baseline(path: Optional[Path] = None) -> Dict[str, int]:
+    p = path or BASELINE_PATH
+    if not p.is_file():
+        return {}
+    return {str(k): int(v) for k, v in json.loads(p.read_text()).items()}
+
+
+def write_baseline(findings: Sequence[Finding],
+                   path: Optional[Path] = None,
+                   keep: Optional[Dict[str, int]] = None) -> Dict[str, int]:
+    """Write `findings` as the new baseline, preserving `keep` entries —
+    the existing baseline rows OUTSIDE the regenerating run's scope. A
+    subset run (`--pass`, `--changed`, explicit paths) must not wipe
+    other passes'/files' grandfathered findings."""
+    counts: Dict[str, int] = dict(keep or {})
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    p = path or BASELINE_PATH
+    p.write_text(json.dumps(dict(sorted(counts.items())), indent=1)
+                 + "\n")
+    return counts
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: Dict[str, int]) -> List[str]:
+    """Mark whole (pass, file) groups baselined when their count stays
+    within the grandfathered count; a group that GROWS reports every
+    site (line numbers shift too much to tell old from new). Returns the
+    stale keys — baseline entries now overcounting (a fix landed without
+    `--write-baseline`) or naming findings that no longer exist."""
+    by_key: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_key.setdefault(f.key, []).append(f)
+    stale = [k for k, allowed in baseline.items()
+             if len(by_key.get(k, ())) < allowed]
+    for key, group in by_key.items():
+        allowed = baseline.get(key, 0)
+        if allowed and len(group) <= allowed:
+            for f in group:
+                f.baselined = True
+    return sorted(stale)
+
+
+# -- run loop ----------------------------------------------------------------
+
+@dataclasses.dataclass
+class RunResult:
+    findings: List[Finding]              # everything kept after suppression
+    stale_baseline: List[str]
+    suppressed: int
+    files_scanned: int
+    # run scope, for baseline regeneration: entries outside (selected
+    # pass, scanned file) must survive a subset --write-baseline
+    selected_passes: List[str] = dataclasses.field(default_factory=list)
+    scanned_files: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def active(self) -> List[Finding]:
+        return [f for f in self.findings if not f.baselined]
+
+    @property
+    def baselined(self) -> List[Finding]:
+        return [f for f in self.findings if f.baselined]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.active else 0
+
+
+def _plan(passes: Sequence[LintPass], paths: Optional[Sequence[Path]],
+          changed: bool, repo: Path
+          ) -> Tuple[List[Tuple[Path, List[LintPass]]], Dict[str, bool]]:
+    """(file, passes-to-run) pairs plus per-pass full-scope coverage.
+    Directory walks and `--changed` honor each pass's scope; a file
+    named explicitly on the command line is checked unconditionally by
+    every selected pass (how the shim CLIs lint probe files living
+    outside the repo)."""
+    per_file: Dict[Path, List[LintPass]] = {}
+    scope_cache: Dict[Tuple[str, ...], List[Path]] = {}
+
+    def scoped(p: LintPass) -> List[Path]:
+        if p.scope not in scope_cache:
+            scope_cache[p.scope] = expand_scope(repo, p.scope)
+        return scope_cache[p.scope]
+
+    def add(f: Path, p: LintPass):
+        lst = per_file.setdefault(f.resolve(), [])
+        if p not in lst:
+            lst.append(p)
+
+    if changed:
+        for f in changed_files(repo):
+            rel = relpath(f, repo)
+            for p in passes:
+                if p.in_scope(rel):
+                    add(f, p)
+    elif paths:
+        for raw in paths:
+            pth = Path(raw)
+            if pth.is_dir():
+                for f in sorted(pth.rglob("*.py")):
+                    if "__pycache__" in f.parts:
+                        continue
+                    rel = relpath(f, repo)
+                    for p in passes:
+                        if p.in_scope(rel):
+                            add(f, p)
+            else:
+                for p in passes:
+                    add(pth, p)
+    else:
+        for p in passes:
+            for f in scoped(p):
+                add(f, p)
+
+    scanned = {f for f in per_file}
+    coverage = {
+        p.name: all(f.resolve() in scanned for f in scoped(p))
+        for p in passes}
+    ordered = sorted(per_file.items(), key=lambda kv: str(kv[0]))
+    return ordered, coverage
+
+
+def run_collect(passes: Sequence[LintPass],
+                paths: Optional[Sequence[Path]] = None,
+                changed: bool = False,
+                baseline: Optional[Dict[str, int]] = None,
+                repo: Optional[Path] = None) -> RunResult:
+    repo = repo or REPO
+    plan, coverage = _plan(passes, paths, changed, repo)
+    for p in passes:
+        p.scanned_full_scope = coverage[p.name]
+        p.begin(repo)
+
+    findings: List[Finding] = []
+    ctxs: Dict[str, FileContext] = {}
+    scanned_rel: Set[str] = set()
+    for path, file_passes in plan:
+        scanned_rel.add(relpath(path, repo))
+        try:
+            ctx = FileContext.load(path, repo)
+        except SyntaxError as e:
+            findings.append(Finding(relpath(path, repo), e.lineno or 0,
+                                    "syntax", f"does not parse: {e.msg}"))
+            continue
+        except (OSError, UnicodeDecodeError, ValueError) as e:
+            # non-UTF-8 bytes raise UnicodeDecodeError, null bytes raise
+            # ValueError from ast.parse — a broken file is a finding,
+            # not a crashed run
+            findings.append(Finding(relpath(path, repo), 0, "syntax",
+                                    f"unreadable: {e}"))
+            continue
+        ctxs[ctx.relpath] = ctx
+        for p in file_passes:
+            findings.extend(p.check_file(ctx))
+    for p in passes:
+        findings.extend(p.finish())
+
+    kept, suppressed = [], 0
+    for f in findings:
+        ctx = ctxs.get(f.path)
+        if ctx is not None and ctx.suppressed(f.line, f.pass_name):
+            suppressed += 1
+        else:
+            kept.append(f)
+
+    # judge only against baseline entries whose pass ran AND whose file
+    # was scanned — a subset run (--pass, explicit paths, --changed)
+    # must not report the rest of the baseline as stale. An entry whose
+    # file no longer EXISTS is stale outright (deleted/renamed files
+    # must not carry immortal debt rows).
+    selected = {p.name for p in passes}
+    applicable = {}
+    missing = []
+    for k, v in (baseline or {}).items():
+        pass_name, _, file_part = k.partition(":")
+        if pass_name not in selected:
+            continue
+        if file_part in scanned_rel:
+            applicable[k] = v
+        elif not (repo / file_part).is_file():
+            missing.append(k)
+    stale = sorted(set(apply_baseline(kept, applicable)) | set(missing))
+    kept.sort(key=lambda f: (f.path, f.line, f.pass_name))
+    return RunResult(kept, stale, suppressed, len(plan),
+                     sorted(selected), sorted(scanned_rel))
+
+
+def render_text(res: RunResult, show_baselined: bool = False) -> str:
+    out = []
+    shown = res.findings if show_baselined else res.active
+    for f in shown:
+        suffix = "  (baselined)" if f.baselined else ""
+        out.append(f.render() + suffix)
+    errors = sum(1 for f in res.active if f.severity == "error")
+    warnings = sum(1 for f in res.active if f.severity == "warning")
+    out.append(
+        f"{len(res.active)} finding(s) ({errors} error(s), "
+        f"{warnings} warning(s)); {len(res.baselined)} baselined, "
+        f"{res.suppressed} suppressed, {res.files_scanned} file(s) "
+        f"scanned")
+    if res.stale_baseline:
+        out.append(
+            "stale baseline entries (fixes landed — run "
+            "`python -m tools.graft_lint --write-baseline` to shrink): "
+            + ", ".join(res.stale_baseline))
+    return "\n".join(out)
+
+
+def render_json(res: RunResult) -> str:
+    return json.dumps({
+        "findings": [f.as_dict() for f in res.active],
+        "baselined": [f.as_dict() for f in res.baselined],
+        "stale_baseline": res.stale_baseline,
+        "suppressed": res.suppressed,
+        "files_scanned": res.files_scanned,
+        "exit_code": res.exit_code,
+    }, indent=1)
+
+
+def run(pass_names: Optional[Sequence[str]] = None,
+        paths: Optional[Sequence[str]] = None,
+        fmt: str = "text",
+        changed: bool = False,
+        baseline_path: Optional[Path] = None,
+        regen_baseline: bool = False,
+        show_baselined: bool = False,
+        repo: Optional[Path] = None,
+        out=None) -> int:
+    """CLI-shaped entry: select passes by name, run, print, return the
+    exit code. `regen_baseline` rewrites the baseline from the current
+    findings (after suppressions) instead of judging against it."""
+    from .passes import get_passes
+    out = out or sys.stdout
+    passes = get_passes(pass_names)
+    baseline = {} if regen_baseline else load_baseline(baseline_path)
+    res = run_collect(passes, [Path(p) for p in paths] if paths else None,
+                      changed=changed, baseline=baseline, repo=repo)
+    if regen_baseline:
+        # only WARNING-tier debt is baseline-eligible: silently
+        # grandfathering an error (a deadlock signature, a typo'd flag)
+        # would green-light it through the tier-1 gates with no
+        # rationale anywhere in the code — errors get fixed or get an
+        # explicit `# graft-lint: disable=` with a comment
+        errors = [f for f in res.findings if f.severity == "error"]
+        if errors:
+            for f in errors:
+                print(f.render(), file=out)
+            print(f"refusing to baseline {len(errors)} error-tier "
+                  f"finding(s) — fix them or suppress with a rationale "
+                  f"comment; only warnings are baseline-managed",
+                  file=out)
+            return 1
+        existing = load_baseline(baseline_path)
+        scanned = set(res.scanned_files)
+        sel = set(res.selected_passes)
+        outside = {}
+        for k, v in existing.items():
+            pass_name, _, file_part = k.partition(":")
+            if not ((repo or REPO) / file_part).is_file():
+                continue             # deleted/renamed file: drop the row
+            if pass_name not in sel or file_part not in scanned:
+                outside[k] = v       # not re-judged by this run: keep
+        counts = write_baseline(res.findings, baseline_path, keep=outside)
+        print(f"baseline written: {sum(counts.values())} finding(s) "
+              f"across {len(counts)} (pass, file) group(s)"
+              + (f" ({len(outside)} outside this run's scope kept)"
+                 if outside else ""), file=out)
+        return 0
+    print(render_text(res, show_baselined) if fmt == "text"
+          else render_json(res), file=out)
+    return res.exit_code

@@ -1,0 +1,40 @@
+"""Pass registry. Adding a pass = subclass LintPass in a module here,
+instantiate it in ALL_PASSES, done — the walker, suppressions,
+baseline, CLI and --changed mode come for free."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..core import LintPass
+from .apply_op_closures import ApplyOpClosuresPass
+from .atomic_writes import AtomicWritesPass
+from .collective_order import CollectiveOrderPass
+from .flags_hygiene import FlagsHygienePass
+from .host_sync import HostSyncPass
+from .metric_names import MetricNamesPass
+from .trace_safety import TraceSafetyPass
+
+ALL_PASSES: List[LintPass] = [
+    ApplyOpClosuresPass(),
+    AtomicWritesPass(),
+    MetricNamesPass(),
+    TraceSafetyPass(),
+    HostSyncPass(),
+    CollectiveOrderPass(),
+    FlagsHygienePass(),
+]
+
+
+def get_passes(names: Optional[Sequence[str]] = None) -> List[LintPass]:
+    """Fresh pass instances (cross-file state must not leak between
+    runs in one process — the tests run many)."""
+    instances = [type(p)() for p in ALL_PASSES]
+    if names is None:
+        return instances
+    by_name = {p.name: p for p in instances}
+    unknown = [n for n in names if n not in by_name]
+    if unknown:
+        raise KeyError(
+            f"unknown pass(es): {', '.join(unknown)}; known: "
+            f"{', '.join(sorted(by_name))}")
+    return [by_name[n] for n in names]

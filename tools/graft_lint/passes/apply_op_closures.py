@@ -1,0 +1,118 @@
+"""Pass: cache-defeating `apply_op(lambda ...)` call sites.
+
+The eager dispatch cache (paddle_tpu/autograd/tape.py) keys op
+callables on code identity, which only works when the callable carries
+no per-call state: a lambda (or nested def) that closes over enclosing
+locals gets a fresh closure every call and silently misses the cache
+forever. The refactored modules in `scope` pass indices/axes through
+keyword-only static kwargs instead; this pass keeps that invariant
+from regressing. A lambda passed to apply_op is only flagged when it
+CAPTURES enclosing function locals — capture-free lambdas
+(`lambda a, b: a @ b`) share one code object per source site and are
+cacheable as-is.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import FileContext, LintPass
+
+# modules refactored for the dispatch cache: keep them closure-free at
+# apply_op call sites
+CHECKED_MODULES = (
+    "paddle_tpu/tensor.py",
+    "paddle_tpu/ops/_helpers.py",
+    "paddle_tpu/ops/manipulation.py",
+    "paddle_tpu/ops/math.py",
+    "paddle_tpu/ops/reduction.py",
+    "paddle_tpu/nn/functional/common.py",
+    "paddle_tpu/nn/functional/activation.py",
+    "paddle_tpu/nn/functional/pooling.py",
+)
+
+
+def _is_apply_op(func: ast.AST) -> bool:
+    if isinstance(func, ast.Name):
+        return func.id in ("apply_op", "_unary")
+    if isinstance(func, ast.Attribute):
+        return func.attr == "apply_op"
+    return False
+
+
+class _ScopeVisitor(ast.NodeVisitor):
+    """Track enclosing function scopes' bound names; flag apply_op
+    lambdas whose free variables resolve to one of them."""
+
+    def __init__(self):
+        self.scope_stack: list = []
+        self.violations: list = []
+
+    def _bound_names(self, node) -> set:
+        bound = set()
+        for a in list(node.args.args) + list(node.args.posonlyargs) \
+                + list(node.args.kwonlyargs):
+            bound.add(a.arg)
+        if node.args.vararg:
+            bound.add(node.args.vararg.arg)
+        if node.args.kwarg:
+            bound.add(node.args.kwarg.arg)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+                bound.add(sub.id)
+            elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(sub.name)
+            elif isinstance(sub, ast.comprehension):
+                for t in ast.walk(sub.target):
+                    if isinstance(t, ast.Name):
+                        bound.add(t.id)
+        return bound
+
+    def visit_FunctionDef(self, node):
+        self.scope_stack.append(self._bound_names(node))
+        self.generic_visit(node)
+        self.scope_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        if _is_apply_op(node.func) and self.scope_stack:
+            for arg in node.args:
+                if isinstance(arg, ast.Lambda):
+                    captured = self._captured_locals(arg)
+                    if captured:
+                        self.violations.append((
+                            node.lineno,
+                            f"apply_op(lambda ...) captures enclosing "
+                            f"locals {sorted(captured)} — move the body "
+                            f"to a module-level function and pass these "
+                            f"via static kwargs"))
+        self.generic_visit(node)
+
+    def _captured_locals(self, lam: ast.Lambda) -> set:
+        params = {a.arg for a in list(lam.args.args)
+                  + list(lam.args.posonlyargs) + list(lam.args.kwonlyargs)}
+        if lam.args.vararg:
+            params.add(lam.args.vararg.arg)
+        if lam.args.kwarg:
+            params.add(lam.args.kwarg.arg)
+        enclosing = set().union(*self.scope_stack) if self.scope_stack \
+            else set()
+        captured = set()
+        for sub in ast.walk(lam.body):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                if sub.id not in params and sub.id in enclosing:
+                    captured.add(sub.id)
+        return captured
+
+
+class ApplyOpClosuresPass(LintPass):
+    name = "apply-op-closures"
+    description = ("apply_op(lambda) capturing enclosing locals defeats "
+                   "the eager dispatch cache")
+    severity = "error"
+    scope = CHECKED_MODULES
+
+    def check_file(self, ctx: FileContext):
+        v = _ScopeVisitor()
+        v.visit(ctx.tree)
+        return [self.finding(ctx, ln, msg) for ln, msg in v.violations]

@@ -1,0 +1,148 @@
+"""Pass: bare (non-atomic) writes on durability-critical paths.
+
+A crash between `open(path, "wb")` and close leaves a TORN file at a
+user-visible persistence path — and destroys the previous bytes the
+moment the open succeeds. Every such write must go through
+`paddle_tpu.framework.io.atomic_write` (tmp + fsync + os.replace + dir
+fsync) so a crash at any instant leaves either the old complete file or
+the new complete file; ISSUE 2's checkpoint commit protocol depends on
+this invariant.
+
+Flagged in the checked modules:
+- `open(path, mode)` with a creating/truncating mode (w/x)
+- `np.save` / `np.savez` / `np.savez_compressed` straight to a path
+
+Allowed:
+- anything inside `atomic_write` itself (or a function whose name
+  contains "atomic") — that's the helper's own tmp write
+- anything inside a lambda/def passed TO `atomic_write(...)` — the
+  write_fn fills the helper's tmp file handle
+- a path expression mentioning a tmp/buf name (`tmp`, `buf`, …): a
+  same-directory tmp later `os.replace`d, or an in-memory buffer
+- append mode ("a"): never destroys prior bytes — append-only logs
+  (ps LSM shards, flight recorder) recover torn tails themselves
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import FileContext, LintPass
+
+# modules holding user-visible persistence paths already converted to
+# the atomic-write protocol; grow this list as more writers convert
+CHECKED_MODULES = (
+    "paddle_tpu/framework/io.py",
+    "paddle_tpu/distributed/checkpoint.py",
+    "paddle_tpu/distributed/elastic.py",
+    "paddle_tpu/distributed/ps/__init__.py",
+    # ISSUE 3: observability writers (JSONL snapshot + flight recorder —
+    # the recorder's append-only event log is exempt by mode) and the
+    # profiler's summary/result JSON
+    "paddle_tpu/observability/export.py",
+    "paddle_tpu/profiler/__init__.py",
+    # jit.save's .pdmodel inference artifact (converted in ISSUE 3)
+    "paddle_tpu/jit/__init__.py",
+    # ISSUE 4: static.save_inference_model + onnx.export artifacts
+    # (converted this PR — closes the ROADMAP open item from ISSUE 2/3)
+    "paddle_tpu/static/__init__.py",
+    "paddle_tpu/onnx/__init__.py",
+)
+
+_WRITE_MODES = set("wx")
+_SAFE_NAME_HINTS = ("tmp", "temp", "buf", "bio")
+
+
+def _expr_mentions_safe_name(node) -> bool:
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            name = sub.value
+        if name and any(h in name.lower() for h in _SAFE_NAME_HINTS):
+            return True
+    return False
+
+
+def _is_bare_open_write(call: ast.Call) -> bool:
+    fn = call.func
+    is_open = (isinstance(fn, ast.Name) and fn.id == "open") or \
+        (isinstance(fn, ast.Attribute) and fn.attr == "fdopen")
+    if not is_open or len(call.args) < 2:
+        return False
+    mode = call.args[1]
+    if not (isinstance(mode, ast.Constant) and isinstance(mode.value, str)):
+        return False
+    return bool(set(mode.value) & _WRITE_MODES)
+
+
+def _is_np_save(call: ast.Call) -> bool:
+    fn = call.func
+    return (isinstance(fn, ast.Attribute)
+            and fn.attr in ("save", "savez", "savez_compressed")
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in ("np", "numpy"))
+
+
+def _safe_region_ids(tree) -> set:
+    """Node ids inside the atomic helper or inside callables passed to
+    atomic_write(...) — writes there fill the helper's tmp file."""
+    safe = set()
+    inner_defs = set()      # names of defs passed to atomic_write by name
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                "atomic" in node.name.lower():
+            safe.update(id(s) for s in ast.walk(node))
+        if isinstance(node, ast.Call):
+            fn = node.func
+            fname = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else "")
+            if fname == "atomic_write":
+                for arg in list(node.args) + [kw.value for kw in
+                                              node.keywords]:
+                    if isinstance(arg, ast.Lambda):
+                        safe.update(id(s) for s in ast.walk(arg))
+                    elif isinstance(arg, ast.Name):
+                        inner_defs.add(arg.id)
+    if inner_defs:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in inner_defs:
+                safe.update(id(s) for s in ast.walk(node))
+    return safe
+
+
+class AtomicWritesPass(LintPass):
+    name = "atomic-writes"
+    description = ("bare open(.., 'w')/np.save on persistence paths "
+                   "must route through framework.io.atomic_write")
+    severity = "error"
+    scope = CHECKED_MODULES
+
+    def check_file(self, ctx: FileContext):
+        safe = _safe_region_ids(ctx.tree)
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or id(node) in safe:
+                continue
+            if _is_bare_open_write(node):
+                target = node.args[0]
+                kind = "open(..., %r)" % node.args[1].value
+            elif _is_np_save(node):
+                if not node.args:
+                    continue
+                target = node.args[0]
+                kind = f"np.{node.func.attr}(...)"
+            else:
+                continue
+            if _expr_mentions_safe_name(target):
+                continue    # tmp-file/buffer write: renamed or in-memory
+            out.append(self.finding(
+                ctx, node.lineno,
+                f"bare {kind} to a persistence path — route it through "
+                f"framework.io.atomic_write (tmp + fsync + os.replace) "
+                f"so a crash cannot tear the file or destroy the "
+                f"previous one"))
+        return out

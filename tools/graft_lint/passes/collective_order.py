@@ -1,0 +1,230 @@
+"""Pass: collectives inside rank-conditional control flow.
+
+A collective (all_reduce / all_gather / broadcast / scatter / reduce /
+lax.psum…) only completes when EVERY rank in the group calls it, in the
+same order. A call site reachable by some ranks but not others — inside
+an `if rank == 0:` branch, or after a rank-conditional early return —
+is the static signature of a cross-rank deadlock: the ranks that enter
+wait forever on the ranks that don't (cf. "Scaling Deep Learning
+Training with MPMD Pipeline Parallelism", PAPERS.md). Even
+`broadcast`, whose src rank feels special, must be CALLED by every
+rank.
+
+Detection is per function body:
+- a collective call lexically inside an `if`/`while`/ternary whose test
+  mentions rank (`rank`, `local_rank`, `get_rank()`, `process_index()`,
+  `axis_index(...)`) is flagged;
+- a collective call AFTER a rank-conditional branch containing a
+  `return` is flagged (the returning ranks never reach it).
+
+Call provenance keeps noise down: bare names count only when imported
+from a distributed/collective/communication module, attribute calls
+only on conventional aliases (`dist.all_reduce`, `collective.scatter`)
+or `jax.lax` primitives. The collective implementation layer itself
+(`distributed/collective.py`, `distributed/communication/`) is exempt —
+its internal rank branches are protocol, not call sites.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..core import FileContext, LintPass
+from ..tensorish import root_name
+
+COLLECTIVES = {
+    "all_reduce", "all_gather", "all_gather_object", "broadcast",
+    "broadcast_object_list", "reduce", "reduce_scatter", "scatter",
+    "alltoall", "alltoall_single", "barrier", "send", "recv", "isend",
+    "irecv",
+}
+LAX_COLLECTIVES = {
+    "psum", "pmax", "pmin", "pmean", "psum_scatter", "all_gather",
+    "all_to_all", "ppermute", "pshuffle",
+}
+_DIST_ALIASES = {"dist", "distributed", "collective", "comm"}
+_DIST_MODULE_HINTS = ("collective", "communication", "distributed")
+_RANK_CALLS = {"get_rank", "get_local_rank", "process_index",
+               "axis_index", "get_world_rank"}
+
+
+def _is_rank_expr(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and "rank" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "rank" in sub.attr.lower():
+            return True
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            fname = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else "")
+            if fname in _RANK_CALLS or "rank" in fname.lower():
+                return True
+    return False
+
+
+def _imported_collectives(tree) -> Set[str]:
+    """Bare names bound by `from <dist-module> import all_reduce, ...`."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            module = node.module or ""
+            if any(h in module for h in _DIST_MODULE_HINTS):
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    if alias.name in COLLECTIVES:
+                        names.add(bound)
+    return names
+
+
+def _collective_call_name(call: ast.Call, imported: Set[str]):
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id if fn.id in imported else None
+    if isinstance(fn, ast.Attribute):
+        root = root_name(fn)
+        if fn.attr in LAX_COLLECTIVES and root in ("jax", "lax"):
+            return f"lax.{fn.attr}"
+        if fn.attr in COLLECTIVES and (
+                root in _DIST_ALIASES or
+                _attr_chain_mentions_dist(fn.value)):
+            return fn.attr
+    return None
+
+
+def _attr_chain_mentions_dist(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and \
+                any(h in sub.attr for h in _DIST_MODULE_HINTS):
+            return True
+    return False
+
+
+def _contains_return(node: ast.stmt) -> bool:
+    """True if `node` contains a `return` exiting the CURRENT function
+    (returns inside nested defs/lambdas don't count)."""
+    if isinstance(node, ast.Return):
+        return True
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        sub = stack.pop()
+        if isinstance(sub, ast.Return):
+            return True
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(sub))
+    return False
+
+
+class _FnChecker:
+    def __init__(self, lint: "CollectiveOrderPass", ctx: FileContext,
+                 imported: Set[str], fn_name: str):
+        self.lint = lint
+        self.ctx = ctx
+        self.imported = imported
+        self.fn_name = fn_name
+        self.rank_return_line = None
+        self.findings: List = []
+
+    def check(self, fn):
+        self._block(fn.body, 0)
+
+    def _block(self, stmts, rank_depth):
+        for s in stmts:
+            self._stmt(s, rank_depth)
+
+    def _stmt(self, s, rank_depth):
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            return      # nested scopes get their own checker
+        if isinstance(s, (ast.If, ast.While)):
+            ranky = _is_rank_expr(s.test)
+            self._exprs(s.test, rank_depth)
+            depth = rank_depth + (1 if ranky else 0)
+            self._block(s.body, depth)
+            self._block(s.orelse, depth)
+            if ranky and self.rank_return_line is None and \
+                    _contains_return(s):
+                self.rank_return_line = s.lineno
+            return
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            self._exprs(s.iter, rank_depth)
+            self._block(s.body, rank_depth)
+            self._block(s.orelse, rank_depth)
+            return
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                self._exprs(item.context_expr, rank_depth)
+            self._block(s.body, rank_depth)
+            return
+        if isinstance(s, ast.Try):
+            self._block(s.body, rank_depth)
+            for h in s.handlers:
+                self._block(h.body, rank_depth)
+            self._block(s.orelse, rank_depth)
+            self._block(s.finalbody, rank_depth)
+            return
+        self._exprs(s, rank_depth)
+
+    def _exprs(self, node, rank_depth):
+        """Scan an expression tree for collective calls; a ternary with
+        a rank test makes its arms rank-conditional too."""
+        if isinstance(node, ast.IfExp) and _is_rank_expr(node.test):
+            self._exprs(node.test, rank_depth)
+            self._exprs(node.body, rank_depth + 1)
+            self._exprs(node.orelse, rank_depth + 1)
+            return
+        if isinstance(node, ast.Call):
+            name = _collective_call_name(node, self.imported)
+            if name is not None:
+                self._judge(node, name, rank_depth)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        for child in ast.iter_child_nodes(node):
+            self._exprs(child, rank_depth)
+
+    def _judge(self, call, name, rank_depth):
+        if rank_depth > 0:
+            self.findings.append(self.lint.finding(
+                self.ctx, call.lineno,
+                f"collective `{name}` inside a rank-conditional branch "
+                f"in `{self.fn_name}` — ranks that skip the branch "
+                f"never enter the collective and the others deadlock "
+                f"waiting; call it on EVERY rank and branch on the "
+                f"result instead"))
+        elif self.rank_return_line is not None:
+            self.findings.append(self.lint.finding(
+                self.ctx, call.lineno,
+                f"collective `{name}` after the rank-conditional early "
+                f"return at line {self.rank_return_line} in "
+                f"`{self.fn_name}` — the returning ranks never reach "
+                f"it; restructure so every rank calls the collective"))
+
+
+class CollectiveOrderPass(LintPass):
+    name = "collective-order"
+    description = ("collectives inside rank-conditional branches or "
+                   "after rank-conditional early returns (cross-rank "
+                   "deadlock signature)")
+    severity = "error"
+    scope = ("paddle_tpu/",)
+    # the collective implementations' internal rank branches are
+    # protocol, not divergent call sites
+    exempt = ("paddle_tpu/distributed/collective.py",
+              "paddle_tpu/distributed/communication/")
+
+    def check_file(self, ctx: FileContext):
+        if any(ctx.relpath == e or
+               (e.endswith("/") and ctx.relpath.startswith(e))
+               for e in self.exempt):
+            return []
+        imported = _imported_collectives(ctx.tree)
+        out: List = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                checker = _FnChecker(self, ctx, imported, node.name)
+                checker.check(node)
+                out.extend(checker.findings)
+        return out

@@ -1,0 +1,96 @@
+"""Pass: blocking host syncs in library hot paths (warning tier).
+
+`.numpy()`, `.item()`, `.tolist()` and `float()/int()/bool()` on a
+device array block the caller until the device catches up, then ship
+the bytes over PCIe/ICI — one stray sync in an op that runs per step
+serializes the whole pipeline. The hot-path modules in `scope` should
+compute on device and sync at most once, in bulk, at a documented
+boundary.
+
+Warning tier: some syncs are genuinely required (host-side assembly
+algorithms, python-number returns mandated by the paddle API). Those
+get a `# graft-lint: disable=host-sync` with a rationale comment, or
+live in the baseline until someone converts them — the baseline may
+only shrink.
+
+Tensor-ness comes from `tensorish.TensorEnv`; `float()`-family calls
+fire only on a confident device-value verdict, `.numpy()`-family on
+any receiver not proven host-resident.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import FileContext, LintPass
+from ..tensorish import (CAST_FUNCS as _CAST_FUNCS,
+                         SYNC_ATTRS as _SYNC_ATTRS, HOST, TENSOR,
+                         TensorEnv)
+
+# the sync primitives themselves (Tensor.numpy/.item/__float__...)
+# necessarily sync; linting their own bodies would flag the definition
+_PRIMITIVE_DEFS = {"numpy", "item", "tolist", "__float__", "__int__",
+                   "__bool__", "__index__", "__len__", "astype"}
+
+
+class HostSyncPass(LintPass):
+    name = "host-sync"
+    description = (".numpy()/.item()/float()-family device syncs in "
+                   "library hot paths")
+    severity = "warning"
+    scope = (
+        "paddle_tpu/tensor.py",
+        "paddle_tpu/linalg.py",
+        "paddle_tpu/ops/",
+        "paddle_tpu/nn/",
+        "paddle_tpu/kernels/",
+        "paddle_tpu/amp/",
+        "paddle_tpu/vision/ops.py",
+        "paddle_tpu/geometric/__init__.py",
+    )
+
+    def check_file(self, ctx: FileContext):
+        out: List = []
+
+        def check_fn(fn):
+            if fn.name in _PRIMITIVE_DEFS:
+                return
+            env = TensorEnv(fn)
+            for node in _own_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr in _SYNC_ATTRS and not node.args and \
+                        env.classify(f.value) != HOST:
+                    out.append(self.finding(
+                        ctx, node.lineno,
+                        f".{f.attr}() blocks on the device and copies "
+                        f"to host — hoist out of the hot path or sync "
+                        f"once in bulk (np.asarray on the full array)"))
+                elif isinstance(f, ast.Name) and f.id in _CAST_FUNCS \
+                        and len(node.args) == 1 and \
+                        env.classify(node.args[0]) == TENSOR:
+                    out.append(self.finding(
+                        ctx, node.lineno,
+                        f"{f.id}() on a device value is a blocking "
+                        f"per-element host sync — pull the whole array "
+                        f"once with np.asarray(...) and index that, or "
+                        f"stay on device"))
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                check_fn(node)
+        return out
+
+
+def _own_nodes(fn):
+    """Nodes of `fn` excluding nested function bodies (each function is
+    checked against its own TensorEnv)."""
+    stack = [c for c in ast.iter_child_nodes(fn)]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))

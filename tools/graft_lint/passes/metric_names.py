@@ -1,0 +1,89 @@
+"""Pass: metrics-registry namespace hygiene.
+
+Every instrument-creating call site in `paddle_tpu/` —
+`metrics.counter(...)`, `metrics.gauge(...)`, `metrics.histogram(...)`
+(or through the conventional aliases `_m` / `_om` / `_metrics` /
+`observability`) — must:
+
+1. pass a LITERAL first argument (no f-strings, concatenation or
+   variables: a computed id defeats grep, this lint, and dashboard
+   queries alike),
+2. use the `subsystem.name` snake_case shape the registry enforces at
+   runtime (e.g. `ckpt.save_seconds`), and
+3. be the ONLY creation site for that (kind, id) pair — one instrument,
+   one home module; shared instruments are imported, not re-requested,
+   so a typo'd near-duplicate cannot silently fork a metric into two
+   series.
+
+Collector-bridged ids (register_collector rows) are data, not creation
+sites, and are out of scope here; the registry's own name validation
+still covers them at runtime.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import FileContext, LintPass
+
+KINDS = ("counter", "gauge", "histogram")
+# module aliases the registry is conventionally imported under
+ALIASES = {"metrics", "_m", "_om", "_metrics", "observability"}
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*$")
+
+
+def _creation_calls(tree):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in KINDS and \
+                isinstance(fn.value, ast.Name) and fn.value.id in ALIASES:
+            yield node, fn.attr
+
+
+class MetricNamesPass(LintPass):
+    name = "metric-names"
+    description = ("metric ids must be literal, unique, snake_case "
+                   "'subsystem.name'")
+    severity = "error"
+    scope = ("paddle_tpu/",)
+
+    def begin(self, repo):
+        self._seen = {}     # (kind, id) -> (relpath, line)
+
+    def check_file(self, ctx: FileContext):
+        out = []
+        for node, kind in _creation_calls(ctx.tree):
+            if not node.args:
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    f"metrics.{kind}(...) with no id argument"))
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant) and
+                    isinstance(arg.value, str)):
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    f"metrics.{kind}(...) id must be a string LITERAL "
+                    f"(computed ids defeat grep, this lint and "
+                    f"dashboards)"))
+                continue
+            name = arg.value
+            if not NAME_RE.match(name):
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    f"metric id {name!r} must be snake_case "
+                    f"'subsystem.name' (e.g. 'ckpt.save_seconds')"))
+                continue
+            key = (kind, name)
+            if key in self._seen:
+                prev_path, prev_line = self._seen[key]
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    f"duplicate creation site for {kind} {name!r} "
+                    f"(first at {prev_path}:{prev_line}) — import the "
+                    f"existing instrument instead of re-requesting it"))
+            else:
+                self._seen[key] = (ctx.relpath, node.lineno)
+        return out

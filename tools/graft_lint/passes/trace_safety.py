@@ -1,0 +1,169 @@
+"""Pass: host side effects inside traced (`@to_static` / `jax.jit`)
+function bodies.
+
+A traced function's Python body runs ONCE, at trace time. Host-side
+constructs inside it don't do what they appear to do:
+
+- `print(...)` fires once per compile, never per step (use
+  `jax.debug.print` for a per-execution print);
+- `time.*()` / `random.*` / `np.random.*` CONSTANT-FOLD: the trace
+  bakes in the one value observed at trace time, so every execution
+  reuses the same timestamp/sample (use `paddle.rand`-family ops or
+  `jax.random` with a traced key);
+- `global` / `nonlocal` mutation escapes the trace — it happens once at
+  compile time and silently goes stale (or re-fires on every recompile);
+- `.numpy()` / `.item()` / `.tolist()` / `float()` / `int()` / `bool()`
+  on a tensor either fails on the tracer or, via callback fallback,
+  forces a device round-trip per step and splits the program.
+
+The pass walks every function whose decorators mark it as traced
+(`to_static`, `jit.to_static`, `jax.jit`, `functools.partial(jax.jit,
+...)` — including nested defs inside such bodies, which trace when
+called) and flags the constructs above. Tensor-ness for the
+float/int/bool check comes from `tensorish.TensorEnv`; only a confident
+device-value verdict fires.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import FileContext, LintPass
+from ..tensorish import (CAST_FUNCS as _CAST_FUNCS,
+                         SYNC_ATTRS as _SYNC_ATTRS, HOST, TENSOR,
+                         TensorEnv, root_name)
+
+
+def _decorator_marks_traced(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        # @to_static(...), @jax.jit(...), @partial(jax.jit, ...)
+        if any(_decorator_marks_traced(a) for a in dec.args):
+            return True
+        return _decorator_marks_traced(dec.func)
+    if isinstance(dec, ast.Attribute):
+        if dec.attr == "to_static":
+            return True
+        if dec.attr == "jit" and root_name(dec) == "jax":
+            return True
+        return False
+    if isinstance(dec, ast.Name):
+        return dec.id == "to_static"
+    return False
+
+
+def is_traced_def(fn: ast.AST) -> bool:
+    return isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+        any(_decorator_marks_traced(d) for d in fn.decorator_list)
+
+
+class _TracedBodyChecker(ast.NodeVisitor):
+    """Walks one traced function (and its nested defs, which inherit the
+    trace when called) with a TensorEnv per enclosing function scope."""
+
+    def __init__(self, lint: "TraceSafetyPass", ctx: FileContext,
+                 traced_name: str):
+        self.lint = lint
+        self.ctx = ctx
+        self.traced_name = traced_name
+        self.env_stack: List[TensorEnv] = []
+        self.findings: List = []
+
+    def _flag(self, node, msg):
+        self.findings.append(self.lint.finding(
+            self.ctx, node.lineno,
+            f"in traced `{self.traced_name}`: {msg}"))
+
+    def check(self, fn):
+        self.env_stack.append(TensorEnv(fn))
+        for stmt in fn.body:
+            self.visit(stmt)
+        self.env_stack.pop()
+
+    def visit_FunctionDef(self, node):
+        # a def nested in a traced body traces when called
+        self.check(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Global(self, node):
+        self._flag(node,
+                   f"`global {', '.join(node.names)}` — mutation escapes "
+                   f"the trace: it runs once at compile time, then goes "
+                   f"stale (or refires per recompile); thread state "
+                   f"through function arguments/returns instead")
+        self.generic_visit(node)
+
+    def visit_Nonlocal(self, node):
+        self._flag(node,
+                   f"`nonlocal {', '.join(node.names)}` — mutation "
+                   f"escapes the trace (runs at compile time only); "
+                   f"carry the value through the traced signature")
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            if fn.id == "print":
+                self._flag(node,
+                           "print() executes at TRACE time only (once "
+                           "per compile, never per step) — use "
+                           "jax.debug.print for a runtime print")
+            elif fn.id in _CAST_FUNCS and len(node.args) == 1 and \
+                    self.env_stack and \
+                    self.env_stack[-1].classify(node.args[0]) == TENSOR:
+                self._flag(node,
+                           f"{fn.id}() on a tensor forces a host sync — "
+                           f"it fails on the tracer or splits the "
+                           f"program with a device round-trip per step; "
+                           f"keep the value as a traced array")
+        elif isinstance(fn, ast.Attribute):
+            root = root_name(fn)
+            if fn.attr in _SYNC_ATTRS and not node.args and \
+                    (not self.env_stack or
+                     self.env_stack[-1].classify(fn.value) != HOST):
+                self._flag(node,
+                           f".{fn.attr}() is a blocking host sync — "
+                           f"inside a trace it fails on the tracer or "
+                           f"forces a device round-trip per step")
+            elif root == "time":
+                self._flag(node,
+                           "time.* constant-folds at trace time: every "
+                           "execution reuses the one timestamp observed "
+                           "during compilation — measure outside the "
+                           "traced function")
+            elif root == "random" or (
+                    root in ("np", "numpy") and
+                    isinstance(fn.value, ast.Attribute) and
+                    fn.value.attr == "random"):
+                self._flag(node,
+                           "host RNG constant-folds at trace time: "
+                           "every execution replays the one sample "
+                           "drawn during compilation — use paddle.rand/"
+                           "randn ops or jax.random with a traced key")
+        self.generic_visit(node)
+
+
+class TraceSafetyPass(LintPass):
+    name = "trace-safety"
+    description = ("print/time/random/global mutation/host syncs inside "
+                   "@to_static- or jax.jit-traced bodies")
+    severity = "error"
+    scope = ("paddle_tpu/",)
+
+    def check_file(self, ctx: FileContext):
+        out = []
+
+        def find_roots(node):
+            # outermost traced defs only — the checker itself descends
+            # into nested defs, so recursing past a traced root would
+            # double-report its inner functions
+            for child in ast.iter_child_nodes(node):
+                if is_traced_def(child):
+                    checker = _TracedBodyChecker(self, ctx, child.name)
+                    checker.check(child)
+                    out.extend(checker.findings)
+                else:
+                    find_roots(child)
+
+        find_roots(ctx.tree)
+        return out

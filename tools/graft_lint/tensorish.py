@@ -1,0 +1,187 @@
+"""Conservative tensor-vs-host value inference over one function body.
+
+`float(x)` on a device array is a blocking host sync; `float(x)` on a
+numpy scalar is free. Telling them apart statically needs to know which
+names hold device values. This tracker classifies expressions as
+"tensor" (device-backed), "host" (numpy/python), or "unknown", seeded
+from how each name was assigned, in source order. Only a confident
+"tensor" verdict produces a finding — `unknown` never does, so the
+passes built on this stay quiet on code they can't read (a lint that
+cries wolf gets disabled, not fixed).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+TENSOR = "tensor"
+HOST = "host"
+UNKNOWN = "unknown"
+
+# dotted roots whose call results live on device
+TENSOR_ROOTS = {"jnp", "jax", "lax", "paddle", "paddle_tpu"}
+# dotted roots whose call results are host values
+HOST_ROOTS = {"np", "numpy", "math", "os", "sys", "random", "time",
+              "itertools", "pickle", "json", "re"}
+# bare callables producing device values in this codebase
+TENSOR_FUNCS = {"unwrap", "to_tensor_like", "Tensor", "Parameter",
+                "to_tensor", "apply_op"}
+# bare callables producing host values
+HOST_FUNCS = {"float", "int", "bool", "str", "len", "range", "min",
+              "max", "sum", "abs", "round", "list", "tuple", "dict",
+              "set", "enumerate", "zip", "sorted", "isinstance",
+              "getattr", "hasattr", "id", "repr"}
+# attribute accesses/methods that move a device value to host — the
+# single source of truth for trace_safety + host_sync too: a sync
+# primitive added here is seen by the classifier and both passes at once
+SYNC_ATTRS = ("numpy", "item", "tolist")
+# builtins whose call on a device value forces a scalar host sync
+CAST_FUNCS = ("float", "int", "bool")
+# attributes of a tensor that are host metadata, not device data
+META_ATTRS = {"shape", "ndim", "dtype", "size", "name", "stop_gradient",
+              "nbytes", "itemsize"}
+
+
+def root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of a dotted/called/subscripted chain."""
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        node = node.func if isinstance(node, ast.Call) else node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class TensorEnv:
+    """Name -> classification for one function body, built by replaying
+    assignments in line order (a single-pass approximation: good enough
+    for the straight-line library code this lints)."""
+
+    def __init__(self, fn: ast.AST):
+        self.names: Dict[str, str] = {}
+        for node in _body_statements(fn):
+            self._learn(node)
+
+    # -- assignment replay --------------------------------------------------
+    def _learn(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                self._bind(tgt, node.value)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            self._bind(node.target, node.value)
+        elif isinstance(node, ast.AugAssign):
+            self._bind(node.target, node.value, merge_with=node.target)
+        elif isinstance(node, ast.For):
+            # iterating a device array yields device rows; iterating a
+            # host sequence yields host items
+            kind = self.classify(node.iter)
+            self._bind_kind(node.target, kind)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, item.context_expr)
+
+    def _bind(self, target: ast.AST, value: ast.AST,
+              merge_with: Optional[ast.AST] = None) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)) and \
+                isinstance(value, (ast.Tuple, ast.List)) and \
+                len(target.elts) == len(value.elts):
+            for t, v in zip(target.elts, value.elts):
+                self._bind(t, v)
+            return
+        kind = self.classify(value)
+        if merge_with is not None and kind == UNKNOWN:
+            kind = self.classify(merge_with)
+        self._bind_kind(target, kind)
+
+    def _bind_kind(self, target: ast.AST, kind: str) -> None:
+        if isinstance(target, ast.Name):
+            self.names[target.id] = kind
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for t in target.elts:
+                self._bind_kind(t, kind)
+        # attribute/subscript stores don't rebind a name
+
+    # -- classification -----------------------------------------------------
+    def classify(self, node: ast.AST) -> str:
+        if isinstance(node, ast.Constant):
+            return HOST
+        if isinstance(node, ast.Name):
+            return self.names.get(node.id, UNKNOWN)
+        if isinstance(node, ast.Starred):
+            return self.classify(node.value)
+        if isinstance(node, (ast.Subscript, ast.UnaryOp)):
+            inner = node.value if isinstance(node, ast.Subscript) \
+                else node.operand
+            return self.classify(inner)
+        if isinstance(node, ast.BinOp):
+            kinds = {self.classify(node.left), self.classify(node.right)}
+            if TENSOR in kinds:
+                return TENSOR
+            return HOST if kinds == {HOST} else UNKNOWN
+        if isinstance(node, ast.BoolOp):
+            kinds = {self.classify(v) for v in node.values}
+            if TENSOR in kinds:
+                return TENSOR
+            return HOST if kinds == {HOST} else UNKNOWN
+        if isinstance(node, ast.Compare):
+            # `mask = dec > thr`: a device operand makes a device mask
+            kinds = {self.classify(node.left)} | {
+                self.classify(c) for c in node.comparators}
+            return TENSOR if TENSOR in kinds else UNKNOWN
+        if isinstance(node, ast.IfExp):
+            kinds = {self.classify(node.body), self.classify(node.orelse)}
+            if kinds == {TENSOR}:
+                return TENSOR
+            return HOST if kinds == {HOST} else UNKNOWN
+        if isinstance(node, ast.Attribute):
+            if node.attr in META_ATTRS:
+                return HOST
+            return self.classify(node.value)
+        if isinstance(node, ast.Call):
+            return self._classify_call(node)
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp, ast.List,
+                             ast.Tuple, ast.Dict, ast.Set)):
+            return HOST        # a python container is a host value
+        return UNKNOWN
+
+    def _classify_call(self, call: ast.Call) -> str:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            if fn.id in TENSOR_FUNCS:
+                return TENSOR
+            if fn.id in HOST_FUNCS:
+                return HOST
+            return UNKNOWN
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in SYNC_ATTRS:
+                return HOST            # .numpy()/.item() lands on host
+            root = root_name(fn)
+            if root in TENSOR_ROOTS:
+                return TENSOR
+            if root in HOST_ROOTS:
+                return HOST
+            # a method on a known value keeps its residence (x.astype,
+            # arr.max, ...)
+            return self.classify(fn.value)
+        return UNKNOWN
+
+
+def _body_statements(fn: ast.AST):
+    """Statements of `fn` in source order, NOT descending into nested
+    function/class definitions (their names live in another scope)."""
+    out = []
+
+    def block(stmts):
+        for s in stmts:
+            out.append(s)
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(s, field, None)
+                if sub:
+                    block(sub)
+            for h in getattr(s, "handlers", ()) or ():
+                block(h.body)
+
+    block(getattr(fn, "body", []))
+    return out
+
