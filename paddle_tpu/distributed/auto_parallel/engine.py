@@ -248,6 +248,14 @@ class Engine:
 
         plan = ShardingPlan(self._mesh, stage=s.sharding_stage)
         self._plan = plan
+        # make the plan visible to DataLoader prefetchers (same handoff
+        # as a sharded jit.TrainStep): engine-built loaders then stage
+        # batches straight into the mesh layout, and the compiled
+        # evaluate/predict executables (explicit in_shardings) accept
+        # the committed arrays instead of pjit rejecting a
+        # single-device commit. Latest prepare wins, like TrainStep
+        from ...io import prefetch as _io_prefetch
+        _io_prefetch.set_active_plan(plan)
         # executables compiled against a previous mesh/plan/amp setting
         # must not survive a re-prepare
         self._eval_cache = {}
@@ -596,6 +604,10 @@ class Engine:
                 # tail batch: replicated compile (old eager semantics,
                 # still one executable per shape)
                 self._eval_cache[sig] = jax.jit(pure)
+        if divisible:
+            # committed prefetched batches must match the compiled batch
+            # in_shardings — see ShardingPlan.reshard_batch
+            batch = plan.reshard_batch(batch)
         out = self._eval_cache[sig](params, buffers, batch)
         from ...jit import _tree_box as _tb
         return _tb(out)

@@ -345,6 +345,32 @@ class ShardingPlan:
         return P(self.data_axes if len(self.data_axes) > 1
                  else self.data_axes[0])
 
+    def reshard_batch(self, tree):
+        """Reshard COMMITTED jax.Array leaves of a collated batch onto
+        this plan's batch shardings — the belt both sharded step paths
+        (jit.TrainStep.__call__, Engine._compiled_forward) wear before
+        calling an executable compiled with explicit batch in_shardings.
+
+        A DataLoader prefetcher may hand over batches committed to a
+        sharding that is not this plan's (the active-plan registration
+        is latest-wins: a later unsharded TrainStep clears it, or
+        staging started before this plan existed); pjit refuses
+        committed args whose sharding differs from in_shardings. A
+        matching commit is a no-op; numpy/uncommitted leaves are left
+        for jit to place (on a multi-process mesh device_put of local
+        data would fail where jit's replicated placement succeeds),
+        and a failed reshard falls through to jit for the real error."""
+        def leaf(a):
+            if isinstance(a, jax.Array):
+                sh = NamedSharding(self.mesh, self.batch_spec(a))
+                if a.sharding != sh:
+                    try:
+                        return jax.device_put(a, sh)
+                    except Exception:
+                        return a
+            return a
+        return jax.tree_util.tree_map(leaf, tree)
+
     # -- multi-host entry ----------------------------------------------------
     def materialize(self, model, optimizer=None):
         """Place every model array (and primed optimizer state) as a

@@ -11,6 +11,7 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
+import zlib
 from typing import Any, Optional
 
 import jax
@@ -190,10 +191,51 @@ class RandomState(threading.local):
 
 _rng = RandomState()
 
+# last paddle.seed value, PROCESS-global (the key-stack RandomState above
+# is thread-local): DataLoader worker/prefetch threads derive their host
+# numpy seeds from this, and a fresh thread must see the seed set by the
+# main thread, not a blank thread-local
+_seed_value: Optional[int] = None
+
 
 def seed(s: int):
+    global _seed_value, _data_instance_seq
+    _seed_value = int(s)
+    _data_instance_seq = 0
     _rng.seed(s)
     return _rng
+
+
+_data_instance_seq = 0
+
+
+def next_data_instance() -> int:
+    """Monotonic id decorrelating sibling samplers' derived seeds (two
+    shuffled loaders must not emit the same permutation). Reset by
+    `seed()` so a re-seeded run reconstructs the same ids in the same
+    construction order — reproducibility is preserved. Consequence: two
+    samplers constructed under identical (seed value, construction
+    index) pairs — e.g. one before and one after re-seeding with the
+    SAME value — shuffle in lockstep; re-seed with a different value or
+    pass explicit `generator`s to decorrelate them."""
+    global _data_instance_seq
+    v = _data_instance_seq
+    _data_instance_seq += 1
+    return v
+
+
+def data_seed(*salt) -> Optional[int]:
+    """Host-side numpy seed derived from `paddle.seed` for the data
+    pipeline (io samplers, random_split, shuffle order): deterministic
+    per (seed, *salt), touches no device state, readable from any
+    thread. None when the process was never seeded — callers fall back
+    to nondeterministic numpy seeding (the pre-seed behavior)."""
+    if _seed_value is None:
+        return None
+    h = _seed_value & 0xFFFFFFFF
+    for s in salt:
+        h = (h * 1000003 + zlib.crc32(str(s).encode())) & 0xFFFFFFFF
+    return h
 
 
 def next_rng_key():
@@ -274,6 +316,11 @@ _flags: dict = {
     "FLAGS_metrics_port": 0,
     "FLAGS_flight_recorder": "",
     "FLAGS_span_ring_size": 512,
+    # -- input pipeline (consumed by io/prefetch.py + io DataLoader):
+    # device-side double-buffered batch staging via jax.device_put; false
+    # restores the synchronous un-staged loader path (the debugging kill
+    # switch — e.g. to localize a worker-thread fault to one batch)
+    "FLAGS_dataloader_prefetch": True,
     # -- autotune (consumed by kernels/autotune.sweeps_enabled) --------
     "FLAGS_use_autotune": True,
     # kernel-route kill switches (the on-chip ablation levers; analog of

@@ -10,6 +10,22 @@ __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
            "config_callbacks"]
 
 
+def _scalar(v):
+    """Coerce a logs value to float, or None if it isn't one. Accepts
+    plain numbers AND lazy handles (Model.fit passes _DeferredLoss
+    between sync boundaries — float() forces its tracker's bulk pull,
+    so a value-consuming callback still records every step while
+    non-consuming ones keep the deferral)."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    if hasattr(v, "__float__"):
+        try:
+            return float(v)
+        except Exception:
+            return None
+    return None
+
+
 class Callback:
     def set_model(self, model):
         self.model = model
@@ -42,20 +58,22 @@ class ProgBarLogger(Callback):
             print(f"Epoch {epoch + 1}/{self.params.get('epochs', '?')}"
                   + (f" ({steps} steps)" if steps else ""))
 
+    @staticmethod
+    def _fmt(logs):
+        out = []
+        for k, v in (logs or {}).items():
+            f = _scalar(v)
+            out.append(f"{k}: {f:.4f}" if f is not None else f"{k}: {v}")
+        return ", ".join(out)
+
     def on_train_batch_end(self, step, logs=None):
         if self.verbose and step % self.log_freq == 0:
-            items = ", ".join(f"{k}: {v:.4f}" if isinstance(v, float)
-                              else f"{k}: {v}"
-                              for k, v in (logs or {}).items())
-            print(f"  step {step}: {items}")
+            print(f"  step {step}: {self._fmt(logs)}")
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
-            items = ", ".join(f"{k}: {v:.4f}" if isinstance(v, float)
-                              else f"{k}: {v}"
-                              for k, v in (logs or {}).items())
             print(f"  epoch {epoch + 1} done in {time.time()-self.t0:.1f}s "
-                  f"- {items}")
+                  f"- {self._fmt(logs)}")
 
 
 class ModelCheckpoint(Callback):
@@ -137,10 +155,12 @@ class VisualDL(Callback):
         import json
         import os
         os.makedirs(self.log_dir, exist_ok=True)
+        # _scalar floats deferred handles too: a per-step scalar sink
+        # consumes every value, so it pays the (bulk) pull each step
+        scalars = {k: f for k, v in (logs or {}).items()
+                   if (f := _scalar(v)) is not None}
         with open(f"{self.log_dir}/scalars.jsonl", "a") as f:
-            f.write(json.dumps({"step": step, **{
-                k: float(v) for k, v in (logs or {}).items()
-                if isinstance(v, (int, float))}}) + "\n")
+            f.write(json.dumps({"step": step, **scalars}) + "\n")
 
 
 class MetricsCallback(Callback):
@@ -175,8 +195,8 @@ class MetricsCallback(Callback):
         from ..observability import export, metrics
         export.append_jsonl(self._path(), {
             "ts": time.time(), "epoch": epoch,
-            "logs": {k: float(v) for k, v in (logs or {}).items()
-                     if isinstance(v, (int, float))},
+            "logs": {k: f for k, v in (logs or {}).items()
+                     if (f := _scalar(v)) is not None},
             "metrics": metrics.snapshot()})
 
     def on_train_end(self, logs=None):

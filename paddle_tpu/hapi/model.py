@@ -8,6 +8,8 @@ evaluate/predict run the compiled forward. Callbacks/metrics keep the
 reference's interface."""
 from __future__ import annotations
 
+import operator
+import weakref
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -25,6 +27,146 @@ def _as_tuple(x):
     if isinstance(x, (list, tuple)):
         return tuple(x)
     return (x,)
+
+
+# device-memory bound for predict(): at most this many batches of
+# forward outputs are held on device between bulk pulls
+_PREDICT_FLUSH_BATCHES = 32
+
+
+def _host_pull(tree):
+    """THE host-sync boundary of the hapi loops: one `jax.device_get`
+    for a whole pytree of device arrays (pending losses, metric
+    outputs, predictions) per log interval — never one blocking
+    `.numpy()` per batch, which would stall the async dispatch queue
+    and idle the device behind the host (tests monkeypatch this to
+    count syncs)."""
+    import jax
+    return jax.device_get(tree)
+
+
+def _unbox_tree(obj):
+    """Tensor leaves -> raw device arrays (structure preserved) so a
+    deferred batch result can ride in one bulk _host_pull."""
+    from ..jit import _tree_unbox
+    return _tree_unbox(obj)
+
+
+class _LossTracker:
+    """Device losses accumulate un-synced; materializing (at a log_freq
+    step, epoch end, or a callback calling float() on a deferred
+    handle) performs ONE bulk host pull for everything pending —
+    keeping the XLA dispatch queue deep between boundaries.
+
+    Memory stays O(steps-per-boundary): materialized values are written
+    into the still-live handles (held weakly here) and the pending list
+    is dropped — the tracker itself retains only the latest scalar, so
+    a million-step fit does not accumulate a float per step."""
+
+    def __init__(self):
+        # (device array, weakref to the handle that will hold its value)
+        self._pending: List = []
+        self._last: Optional[float] = None
+
+    def push(self, loss):
+        handle = _DeferredLoss(self)
+        self._pending.append(
+            (loss.data if isinstance(loss, Tensor) else loss,
+             weakref.ref(handle)))
+        return handle
+
+    def _materialize(self):
+        if not self._pending:
+            return
+        vals = _host_pull([arr for arr, _ in self._pending])
+        for (_, href), v in zip(self._pending, vals):
+            handle = href()
+            if handle is not None:
+                handle._value = float(v)
+        self._last = float(vals[-1])
+        self._pending.clear()
+
+    def last(self) -> float:
+        self._materialize()
+        return 0.0 if self._last is None else self._last
+
+
+class _DeferredLoss:
+    """Loss handle passed to callbacks between sync boundaries: float()
+    forces the tracker's bulk pull (one host sync for ALL pending
+    losses, not one per step). Stock callbacks only format floats at
+    log boundaries, where fit has already materialized."""
+
+    __slots__ = ("_tracker", "_value", "__weakref__")
+
+    def __init__(self, tracker):
+        self._tracker = tracker
+        self._value: Optional[float] = None
+
+    def __float__(self):
+        if self._value is None:
+            # the caller holds a strong ref, so materialize writes _value
+            self._tracker._materialize()
+        return self._value
+
+    def __repr__(self):
+        return ("<deferred loss>" if self._value is None
+                else f"<deferred loss {self._value:.6g}>")
+
+    # Greedy callbacks format/compare/aggregate losses mid-epoch
+    # (f"{loss:.4f}", loss < best, sum(losses)); each dunder is a sync
+    # boundary identical to float() — ONE bulk pull for all pending.
+    def __format__(self, spec):
+        return format(float(self), spec)
+
+    def _as_float(self, other):
+        if isinstance(other, _DeferredLoss):
+            return float(other)
+        if isinstance(other, (int, float)):
+            return float(other)
+        return None
+
+    def _cmp(self, other, op):
+        o = self._as_float(other)
+        if o is None:
+            return NotImplemented
+        return op(float(self), o)
+
+    def __lt__(self, other): return self._cmp(other, operator.lt)
+    def __le__(self, other): return self._cmp(other, operator.le)
+    def __gt__(self, other): return self._cmp(other, operator.gt)
+    def __ge__(self, other): return self._cmp(other, operator.ge)
+    def __eq__(self, other): return self._cmp(other, operator.eq)
+    def __ne__(self, other): return self._cmp(other, operator.ne)
+    # identity hash: __eq__ forces a host pull, hashing must not
+    __hash__ = object.__hash__
+
+    def __add__(self, other): return self._cmp(other, operator.add)
+    __radd__ = __add__
+    def __mul__(self, other): return self._cmp(other, operator.mul)
+    __rmul__ = __mul__
+
+    def __sub__(self, other): return self._cmp(other, operator.sub)
+
+    def __rsub__(self, other):
+        o = self._as_float(other)
+        if o is None:
+            return NotImplemented
+        return o - float(self)
+
+    def __truediv__(self, other): return self._cmp(other, operator.truediv)
+
+    def __rtruediv__(self, other):
+        o = self._as_float(other)
+        if o is None:
+            return NotImplemented
+        return o / float(self)
+
+    def __neg__(self):
+        return -float(self)
+
+    def __abs__(self):
+        return abs(float(self))
 
 
 class Model:
@@ -68,13 +210,16 @@ class Model:
         self._train_step_has_labels = has_labels
 
     def train_batch(self, inputs, labels=None):
+        """One compiled training step; returns the DEVICE loss without a
+        host sync (float() it to pull — fit defers that to log_freq /
+        epoch boundaries so the dispatch queue stays deep)."""
         has_labels = labels is not None
         if self._train_step is None or \
                 getattr(self, "_train_step_has_labels", None) != has_labels:
             self._build_train_step(has_labels)
         args = tuple(_as_tuple(inputs)) + tuple(_as_tuple(labels))
         loss = self._train_step(*args)
-        return [float(loss.numpy())]
+        return [loss]
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -82,9 +227,13 @@ class Model:
             out = self.network(*_as_tuple(inputs))
             loss = self._loss(out, *_as_tuple(labels)) if self._loss else None
             for m in self._metrics:
+                # standalone per-batch API: the documented sync boundary
+                # (evaluate() batches these pulls per log interval)
+                # graft-lint: disable=host-sync
                 m.update(*[t.numpy() if isinstance(t, Tensor) else t
                            for t in m.compute(out, *_as_tuple(labels))])
         self.network.train()
+        # graft-lint: disable=host-sync — per-call API returns python floats
         return [float(loss.numpy())] if loss is not None else []
 
     def predict_batch(self, inputs):
@@ -92,6 +241,9 @@ class Model:
         with core.no_grad_guard():
             out = self.network(*_as_tuple(inputs))
         self.network.train()
+        # standalone per-batch API returns numpy; predict() instead
+        # collects device outputs and bulk-pulls in bounded chunks
+        # graft-lint: disable=host-sync
         return [o.numpy() if isinstance(o, Tensor) else o
                 for o in _as_tuple(out)]
 
@@ -112,14 +264,24 @@ class Model:
             self._train_step = None     # rebuild with the new scan
         loader = self._as_loader(train_data, batch_size, shuffle, drop_last,
                                  num_workers)
+        # clamp BEFORE config_callbacks: ProgBarLogger computes
+        # `step % log_freq` too, so a raw 0 would ZeroDivisionError in
+        # the callback even with fit's own boundary predicate guarded
+        log_freq = max(1, int(log_freq))
+        try:
+            # hasattr is not enough: DataLoader.__len__ exists but
+            # RAISES for IterableDataset (no len by contract)
+            steps = len(loader)
+        except TypeError:
+            steps = None
         cbs = config_callbacks(callbacks, model=self, epochs=epochs,
-                               steps=len(loader) if hasattr(
-                                   loader, "__len__") else None,
+                               steps=steps,
                                log_freq=log_freq, verbose=verbose,
                                save_freq=save_freq, save_dir=save_dir,
                                metrics=self._metrics)
         self.stop_training = False
         it = 0
+        tracker = _LossTracker()
         try:
             # inside the try: a LATER callback's on_train_begin raising
             # must still tear down an earlier one that already armed
@@ -135,13 +297,28 @@ class Model:
                         cb.on_train_batch_begin(step)
                     xs, ys = self._split_batch(batch)
                     losses = self.train_batch(xs, ys)
-                    logs = {"loss": losses[0] if losses else 0.0}
-                    for cb in cbs:
-                        cb.on_train_batch_end(step, logs)
                     it += 1
                     if num_iters is not None and it >= num_iters:
                         self.stop_training = True
+                    if losses:
+                        deferred = tracker.push(losses[0])
+                        # deferred host sync: the scalar is pulled (one
+                        # bulk device_get for every step since the last
+                        # boundary) only at log_freq steps / epoch end /
+                        # early stop — between boundaries callbacks get
+                        # a lazy handle (float() forces the bulk pull)
+                        if step % log_freq == 0 or self.stop_training:
+                            logs = {"loss": tracker.last()}
+                        else:
+                            logs = {"loss": deferred}
+                    else:
+                        logs = {"loss": 0.0}
+                    for cb in cbs:
+                        cb.on_train_batch_end(step, logs)
+                    if self.stop_training:
                         break
+                if isinstance(logs.get("loss"), _DeferredLoss):
+                    logs["loss"] = tracker.last()   # epoch boundary pull
                 if eval_data is not None and (epoch + 1) % eval_freq == 0:
                     eval_logs = self.evaluate(eval_data,
                                               batch_size=batch_size,
@@ -176,14 +353,63 @@ class Model:
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None):
+        """Deferred-sync evaluation: per-batch losses and metric
+        `compute` outputs stay on device and are pulled in ONE bulk
+        host sync per `log_freq` batches (mirrors fit's log-boundary
+        discipline; metric `update` order is preserved)."""
         loader = self._as_loader(eval_data, batch_size, False, False,
                                  num_workers)
         for m in self._metrics:
             m.reset()
-        losses = []
-        for batch in loader:
-            xs, ys = self._split_batch(batch)
-            losses.extend(self.eval_batch(xs, ys))
+        log_freq = max(1, int(log_freq))
+        losses: List[float] = []
+        pend_losses: List = []           # device loss arrays
+        pend_moutputs: List = []         # per-batch list of per-metric outs
+
+        def flush():
+            if not pend_losses and not pend_moutputs:
+                return
+            host_losses, host_moutputs = _host_pull(
+                (pend_losses, pend_moutputs))
+            losses.extend(float(v) for v in host_losses)
+            for per_metric in host_moutputs:
+                for m, outs in zip(self._metrics, per_metric):
+                    m.update(*outs)
+            pend_losses.clear()
+            pend_moutputs.clear()
+
+        # an overridden eval_batch (the documented per-batch extension
+        # point — subclass OR instance attribute) must keep being
+        # dispatched through normal self.eval_batch resolution; the
+        # deferred inline loop below only replaces the BASE behavior
+        custom_eval = ("eval_batch" in self.__dict__
+                       or type(self).eval_batch is not Model.eval_batch)
+        n_batches = 0
+        self.network.eval()
+        try:
+            with core.no_grad_guard():
+                for batch in loader:
+                    xs, ys = self._split_batch(batch)
+                    if custom_eval:
+                        # override handles loss/metrics itself (sync
+                        # per batch, like the pre-deferral loop)
+                        losses.extend(self.eval_batch(xs, ys))
+                        n_batches += 1
+                        continue
+                    out = self.network(*_as_tuple(xs))
+                    if self._loss is not None:
+                        pend_losses.append(
+                            _unbox_tree(self._loss(out, *_as_tuple(ys))))
+                    pend_moutputs.append(
+                        [tuple(_unbox_tree(t)
+                               for t in m.compute(out, *_as_tuple(ys)))
+                         for m in self._metrics])
+                    n_batches += 1
+                    if n_batches % log_freq == 0:
+                        flush()
+        finally:
+            self.network.train()
+        flush()
         logs = {"loss": float(np.mean(losses)) if losses else 0.0}
         for m in self._metrics:
             logs[m.name() if callable(getattr(m, "name", None))
@@ -192,12 +418,43 @@ class Model:
 
     def predict(self, test_data, batch_size=1, num_workers=0,
                 stack_outputs=False, verbose=1, callbacks=None):
+        """Deferred-sync prediction: forward outputs stay on device and
+        are transferred in bulk host pulls of `_PREDICT_FLUSH_BATCHES`
+        batches (per-batch `.numpy()` round trips serialized the
+        reference loop; one flushless pull would pin every prediction
+        in device memory at once)."""
         loader = self._as_loader(test_data, batch_size, False, False,
                                  num_workers)
         outs = []
-        for batch in loader:
-            xs = batch[0] if isinstance(batch, (list, tuple)) else batch
-            outs.append(self.predict_batch(_as_tuple(xs)))
+        pend: List = []
+
+        def flush():
+            if pend:
+                outs.extend(_host_pull(pend))
+                pend.clear()
+
+        # overridden predict_batch (subclass or instance attribute)
+        # keeps being dispatched (the deferred inline loop only
+        # replaces the BASE behavior)
+        custom_pred = ("predict_batch" in self.__dict__
+                       or type(self).predict_batch
+                       is not Model.predict_batch)
+        self.network.eval()
+        try:
+            with core.no_grad_guard():
+                for batch in loader:
+                    xs = batch[0] if isinstance(batch, (list, tuple)) \
+                        else batch
+                    if custom_pred:
+                        outs.append(self.predict_batch(_as_tuple(xs)))
+                        continue
+                    out = self.network(*_as_tuple(xs))
+                    pend.append([_unbox_tree(o) for o in _as_tuple(out)])
+                    if len(pend) >= _PREDICT_FLUSH_BATCHES:
+                        flush()
+        finally:
+            self.network.train()
+        flush()
         if stack_outputs and outs:
             n = len(outs[0])
             return [np.concatenate([o[i] for o in outs]) for i in range(n)]

@@ -1,20 +1,32 @@
 """Data loading (ref: python/paddle/io/: Dataset, DataLoader,
 io/reader.py:216; C++ side fluid/framework/data_feed.cc).
 
-TPU-native: the loader is host-side Python feeding jnp arrays; multi-worker
-prefetch uses a thread pool (the reference's multiprocess pinned-memory
-pipeline targets CUDA H2D; on TPU, jax device_put is the transfer)."""
+TPU-native: the loader is host-side Python feeding jnp arrays. The
+multi-worker path is a real worker pool — `num_workers` threads driven by
+a shared index queue with ordered reassembly (ref: the reference's
+dataloader_iter.py `_DataLoaderIterMultiProcess`), with worker errors
+re-raised at the consumer, `worker_init_fn`/`get_worker_info()` honored,
+`timeout` enforced at the blocking get, and `persistent_workers` keeping
+the pool alive across epochs. Threads, not processes: every heavy collate
+step ends in numpy/jnp bulk ops that release the GIL, and committed
+device arrays cannot cross process boundaries (the reference's
+multiprocess pinned-memory pipeline targets CUDA H2D; on TPU
+`jax.device_put` — see io/prefetch.py — is the transfer).
+"""
 from __future__ import annotations
 
 import bisect
 import itertools
 import queue
 import threading
+import time
+import traceback
 from typing import Any, Iterable, List, Optional, Sequence
 
 import numpy as np
 
 from ..framework import core
+from ..observability import metrics as _m
 from ..tensor import Tensor
 
 __all__ = [
@@ -22,8 +34,27 @@ __all__ = [
     "ChainDataset", "ConcatDataset", "Subset", "random_split", "DataLoader",
     "BatchSampler", "Sampler", "SequenceSampler", "RandomSampler",
     "WeightedRandomSampler", "DistributedBatchSampler", "SubsetRandomSampler",
-    "get_worker_info", "default_collate_fn",
+    "get_worker_info", "WorkerInfo", "default_collate_fn",
 ]
+
+# pipeline telemetry (PR 3 registry; disarmed = one bool check per site).
+# queue_depth/consumer_wait tell you whether workers keep ahead of the
+# consumer; producer_wait whether the consumer keeps up with workers; the
+# starvation counter itself lives at the device boundary (io/prefetch.py)
+_QUEUE_DEPTH = _m.gauge(
+    "dataloader.queue_depth", "collated batches waiting in the worker "
+    "out-queue when the consumer takes one")
+_CONSUMER_WAIT = _m.histogram(
+    "dataloader.consumer_wait_seconds", "time the consumer blocked on the "
+    "worker out-queue per batch")
+_PRODUCER_WAIT = _m.histogram(
+    "dataloader.producer_wait_seconds", "time a worker blocked handing a "
+    "finished batch to the full out-queue")
+_WORKER_ERRORS = _m.counter(
+    "dataloader.worker_errors_total", "exceptions raised inside dataloader "
+    "workers (re-raised at the consumer)")
+_BATCHES_OUT = _m.counter(
+    "dataloader.batches_total", "batches yielded by multi-worker loaders")
 
 
 class Dataset:
@@ -108,6 +139,39 @@ class Subset(Dataset):
         return len(self.indices)
 
 
+# ---------------------------------------------------------------------------
+# sampler RNG: every source of shuffle randomness resolves through here so
+# `paddle.seed` makes batch order reproducible (and rank-consistent for
+# DistributedBatchSampler — all ranks seed identically)
+# ---------------------------------------------------------------------------
+
+def _seeded_rng(generator, *salt):
+    """Resolve a sampler/`random_split` `generator` arg to a numpy RNG.
+    None derives a seed from `paddle.seed` (core.data_seed) so shuffle
+    order is reproducible run-to-run — or, when the process was never
+    paddle.seed()ed, falls back to the global np.random state (the
+    legacy path, steerable by np.random.seed()); an int seeds a fresh
+    Generator; numpy Generator/RandomState objects pass through and
+    advance their own state."""
+    if generator is None:
+        s = core.data_seed(*salt)
+        if s is None:
+            # never paddle.seed()ed: keep the legacy global-RNG path so
+            # np.random.seed() alone still reproduces shuffle order (the
+            # module exposes permutation/randint/choice like RandomState)
+            return np.random
+        return np.random.default_rng(s)
+    if isinstance(generator, (int, np.integer)):
+        return np.random.default_rng(int(generator))
+    return generator
+
+
+def _randint(rng, n, size):
+    if hasattr(rng, "integers"):          # np.random.Generator
+        return rng.integers(0, n, size)
+    return rng.randint(0, n, size)        # RandomState
+
+
 def random_split(dataset, lengths, generator=None):
     total = len(dataset)
     if abs(sum(lengths) - 1.0) < 1e-6 and all(0 < l < 1 for l in lengths):
@@ -115,9 +179,15 @@ def random_split(dataset, lengths, generator=None):
         lengths[-1] = total - sum(lengths[:-1])
     if sum(lengths) != total:
         raise ValueError("lengths must sum to dataset size")
-    perm = np.random.permutation(total)
+    # salted with next_data_instance() like the samplers: repeated calls
+    # under one paddle.seed (cross-validation folds) get distinct
+    # permutations, while a re-seeded run reconstructs the same sequence
+    perm = _seeded_rng(generator, "random_split",
+                       core.next_data_instance(), total).permutation(total)
     out, off = [], 0
     for l in lengths:
+        # host numpy permutation, no device value involved
+        # graft-lint: disable=host-sync
         out.append(Subset(dataset, perm[off:off + l].tolist()))
         off += l
     return out
@@ -145,6 +215,9 @@ class RandomSampler(Sampler):
         super().__init__(data_source)
         self.replacement = replacement
         self._num_samples = num_samples
+        self.generator = generator
+        self._epoch = 0       # salts the derived seed so epochs differ
+        self._instance = core.next_data_instance()  # decorrelates siblings
 
     @property
     def num_samples(self):
@@ -152,35 +225,59 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
+        rng = _seeded_rng(self.generator, "random_sampler", self._instance,
+                          self._epoch)
+        self._epoch += 1
         if self.replacement:
-            return iter(np.random.randint(0, n, self.num_samples).tolist())
-        return iter(np.random.permutation(n)[: self.num_samples].tolist())
+            idx = _randint(rng, n, self.num_samples)
+        else:
+            idx = rng.permutation(n)[: self.num_samples]
+        # host numpy index array, no device value involved
+        # graft-lint: disable=host-sync
+        return iter(np.asarray(idx).tolist())
 
     def __len__(self):
         return self.num_samples
 
 
 class SubsetRandomSampler(Sampler):
-    def __init__(self, indices):
+    def __init__(self, indices, generator=None):
         self.indices = list(indices)
+        self.generator = generator
+        self._epoch = 0
+        self._instance = core.next_data_instance()
 
     def __iter__(self):
-        return iter(np.random.permutation(self.indices).tolist())
+        rng = _seeded_rng(self.generator, "subset_random_sampler",
+                          self._instance, self._epoch)
+        self._epoch += 1
+        # host numpy permutation, no device value involved
+        # graft-lint: disable=host-sync
+        return iter(rng.permutation(self.indices).tolist())
 
     def __len__(self):
         return len(self.indices)
 
 
 class WeightedRandomSampler(Sampler):
-    def __init__(self, weights, num_samples, replacement=True):
+    def __init__(self, weights, num_samples, replacement=True,
+                 generator=None):
         self.weights = np.asarray(weights, np.float64)
         self.num_samples = num_samples
         self.replacement = replacement
+        self.generator = generator
+        self._epoch = 0
+        self._instance = core.next_data_instance()
 
     def __iter__(self):
         p = self.weights / self.weights.sum()
-        return iter(np.random.choice(len(self.weights), self.num_samples,
-                                     replace=self.replacement, p=p).tolist())
+        rng = _seeded_rng(self.generator, "weighted_random_sampler",
+                          self._instance, self._epoch)
+        self._epoch += 1
+        # host numpy choice, no device value involved
+        # graft-lint: disable=host-sync
+        return iter(rng.choice(len(self.weights), self.num_samples,
+                               replace=self.replacement, p=p).tolist())
 
     def __len__(self):
         return self.num_samples
@@ -220,10 +317,18 @@ class DistributedBatchSampler(BatchSampler):
     python/paddle/io/dataloader/batch_sampler.py::DistributedBatchSampler)."""
 
     def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
-                 shuffle=False, drop_last=False):
+                 shuffle=False, drop_last=False, seed=None):
         from .. import distributed as dist
         self.dataset = dataset
         self.batch_size = batch_size
+        # the shuffle base seed MUST be identical on every rank or the
+        # per-rank permutations diverge and shards overlap/miss rows
+        # silently. Default derives from paddle.seed (assumes the usual
+        # all-ranks-seed-identically idiom); jobs that decorrelate
+        # paddle.seed per rank (paddle.seed(base + rank)) must pass an
+        # explicit rank-constant `seed=` — torch's DistributedSampler
+        # contract
+        self.seed = seed
         self.nranks = num_replicas if num_replicas is not None \
             else dist.get_world_size()
         self.local_rank = rank if rank is not None else dist.get_rank()
@@ -237,7 +342,14 @@ class DistributedBatchSampler(BatchSampler):
         n = len(self.dataset)
         indices = np.arange(n)
         if self.shuffle:
-            rng = np.random.RandomState(self.epoch)
+            # seed + epoch, identical on every rank (explicit seed=, or
+            # all ranks calling paddle.seed with the same value — see
+            # __init__): set_epoch keeps the global shuffle consistent
+            # while epochs differ
+            base = self.seed if self.seed is not None \
+                else core.data_seed("distributed_batch_sampler")
+            rng = np.random.RandomState(
+                ((0 if base is None else base) + self.epoch) & 0xFFFFFFFF)
             rng.shuffle(indices)
         indices = np.concatenate(
             [indices, indices[: self.total_size - n]])
@@ -260,11 +372,40 @@ class DistributedBatchSampler(BatchSampler):
         self.epoch = epoch
 
 
+# ---------------------------------------------------------------------------
+# worker identity (ref: dataloader/worker.py get_worker_info)
+# ---------------------------------------------------------------------------
+
 _worker_info = threading.local()
+_iterable_dup_warned = False   # once-per-process (see iterable workers)
+
+
+class WorkerInfo:
+    """Visible inside worker threads via `get_worker_info()`: lets an
+    IterableDataset shard its stream and a `worker_init_fn`/`__getitem__`
+    branch per worker."""
+
+    __slots__ = ("id", "num_workers", "dataset", "seed", "_consulted")
+
+    def __init__(self, id, num_workers, dataset=None, seed=None):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+        self._consulted = False   # did this worker's code ever look?
+
+    def __repr__(self):
+        return (f"WorkerInfo(id={self.id}, num_workers={self.num_workers}, "
+                f"seed={self.seed})")
 
 
 def get_worker_info():
-    return getattr(_worker_info, "info", None)
+    info = getattr(_worker_info, "info", None)
+    if info is not None:
+        # consultation marker: _iter_with_iterable_workers uses it to
+        # warn when a multi-worker IterableDataset never sharded itself
+        info._consulted = True
+    return info
 
 
 def _stack_np(arrays):
@@ -305,10 +446,275 @@ def default_collate_fn(batch):
     return batch
 
 
+# ---------------------------------------------------------------------------
+# worker pool
+# ---------------------------------------------------------------------------
+
+_SHUTDOWN = object()          # index-queue sentinel: worker exits
+_STREAM_END = object()        # iterable-mode: one worker's stream finished
+_INIT_EPOCH = -1              # out-queue epoch tag: worker_init_fn error
+
+
+class _EpochCanceled(RuntimeError):
+    """Raised inside a stale epoch's consumer when a newer epoch is live
+    on the same pool (the prefetcher's staging thread can outlive the
+    epoch it was iterating — see DevicePrefetcher's deferred close).
+    Subclasses RuntimeError because it can reach USER code: a second
+    iterator over one persistent_workers DataLoader takes over the
+    shared pool, and the first iterator's next() raises this instead of
+    blocking forever on results that will never arrive."""
+
+    def __init__(self, epoch):
+        super().__init__(
+            f"DataLoader epoch {epoch} canceled: a newer iterator started "
+            f"on the same persistent_workers worker pool. Concurrent or "
+            f"nested iteration of one DataLoader is not supported with "
+            f"persistent_workers=True — create a second DataLoader (or "
+            f"set persistent_workers=False, giving each iterator its own "
+            f"worker pool) instead.")
+
+
+def _interruptible_put(q, item, stop, wait_hist=None):
+    """Blocking put that stays interruptible by the `stop` event (a plain
+    put could deadlock a producer against a consumer that is gone).
+    Returns False when abandoned because `stop` was set first."""
+    t0 = time.perf_counter()
+    ok = False
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.05)
+            ok = True
+            break
+        except queue.Full:
+            continue
+    if wait_hist is not None:
+        wait_hist.observe(time.perf_counter() - t0)
+    return ok
+
+
+class _WorkerError:
+    """An exception caught inside a worker, carried to the consumer and
+    re-raised there with the worker traceback attached (previously
+    `_produce` errors were swallowed by the producer's `finally:
+    q.put(stop)` and the epoch silently truncated)."""
+
+    __slots__ = ("exc", "tb", "worker_id")
+
+    def __init__(self, exc, tb, worker_id):
+        self.exc = exc
+        self.tb = tb
+        self.worker_id = worker_id
+
+    def reraise(self):
+        msg = (f"DataLoader worker {self.worker_id} raised "
+               f"{type(self.exc).__name__}: {self.exc}\n"
+               f"--- worker traceback ---\n{self.tb}")
+        try:
+            exc = type(self.exc)(msg)
+        except Exception:
+            exc = RuntimeError(msg)
+        raise exc from self.exc
+
+
+class _WorkerPool:
+    """`num_workers` threads, one shared index queue of `(epoch, seq,
+    idxs)` tasks, one bounded out-queue of `(epoch, seq, batch)` results.
+    The consumer reassembles results in `seq` order (workers finish out
+    of order), feeds new tasks as results drain (bounded in-flight
+    window), and drops results tagged with a stale epoch (early `break`
+    cancels an epoch by bumping the epoch id — workers skip stale
+    tasks). With `persistent_workers` the same pool (and each worker's
+    `worker_init_fn` state) is reused across epochs."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.num_workers = loader.num_workers
+        self.timeout = loader.timeout
+        self._epoch = 0
+        # worker seeds are salted with the loader's epoch ordinal AT POOL
+        # CREATION: non-persistent pools (one per epoch) give augmentation
+        # a fresh stream each epoch, persistent workers keep theirs
+        self._seed_epoch = loader._epoch_ordinal
+        # epoch transitions can race: the consumer starting epoch N+1 vs
+        # the prefetch reaper belatedly closing epoch N's generator (its
+        # finally must NOT cancel an epoch it doesn't own)
+        self._epoch_lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._index_q: "queue.Queue" = queue.Queue()
+        self._out_q: "queue.Queue" = queue.Queue(
+            maxsize=loader.prefetch_factor * self.num_workers)
+        self._threads = [
+            threading.Thread(target=self._worker, args=(wid,), daemon=True,
+                             name=f"paddle-io-worker-{wid}")
+            for wid in range(self.num_workers)]
+        for t in self._threads:
+            t.start()
+
+    # -- worker side --------------------------------------------------------
+    def _put(self, item):
+        _interruptible_put(self._out_q, item, self._shutdown,
+                           wait_hist=_PRODUCER_WAIT)
+
+    def _worker(self, wid):
+        loader = self.loader
+        _worker_info.info = WorkerInfo(
+            wid, self.num_workers, dataset=loader.dataset,
+            seed=core.data_seed("dataloader_worker", wid,
+                                self._seed_epoch))
+        try:
+            if loader.worker_init_fn is not None:
+                loader.worker_init_fn(wid)
+        except BaseException as e:   # init failure poisons every epoch
+            _WORKER_ERRORS.inc()
+            self._put((_INIT_EPOCH, 0,
+                       _WorkerError(e, traceback.format_exc(), wid)))
+            return
+        while not self._shutdown.is_set():
+            task = self._index_q.get()
+            if task is _SHUTDOWN:
+                break
+            epoch, seq, idxs = task
+            if epoch != self._epoch:
+                continue              # canceled epoch: drop stale work
+            try:
+                ds = loader.dataset
+                payload = loader.collate_fn([ds[i] for i in idxs])
+            except BaseException as e:
+                _WORKER_ERRORS.inc()
+                payload = _WorkerError(e, traceback.format_exc(), wid)
+            self._put((epoch, seq, payload))
+
+    # -- consumer side ------------------------------------------------------
+    def _get(self, epoch):
+        """One result for `epoch`, dropping canceled-epoch leftovers;
+        enforces the loader timeout and surfaces init errors. A result
+        tagged with a NEWER epoch means this consumer is stale (an
+        abandoned epoch's staging thread still parked on the shared
+        out-queue after the next epoch started): hand the result back to
+        the live consumer and bail out instead of discarding it."""
+        t0 = time.perf_counter()
+        deadline = t0 + self.timeout if self.timeout > 0 else None
+        while True:
+            try:
+                # short poll, not one indefinite get: a stale consumer
+                # (its epoch canceled by a nested iterator taking over
+                # the pool) may never receive another result — it must
+                # notice the epoch bump itself instead of hanging
+                e, seq, payload = self._out_q.get(True, 0.05)
+            except queue.Empty:
+                if epoch != self._epoch:
+                    raise _EpochCanceled(epoch) from None
+                if deadline is not None and time.perf_counter() > deadline:
+                    raise RuntimeError(
+                        f"DataLoader timed out after {self.timeout} seconds "
+                        f"waiting for a worker batch (num_workers="
+                        f"{self.num_workers}); raise `timeout` or speed up "
+                        f"dataset.__getitem__/collate_fn") from None
+                continue
+            if e == _INIT_EPOCH:
+                payload.reraise()
+            if e < epoch:
+                continue              # canceled epoch: drop stale result
+            if e > epoch:
+                # hand the newer epoch's result back for its live
+                # consumer. Bounded + shutdown-aware: if that consumer is
+                # gone too (the epoch moved on again) or the pool is
+                # shutting down, the result is stale — drop it instead of
+                # blocking forever on a full queue nobody drains
+                while not self._shutdown.is_set() and e >= self._epoch:
+                    try:
+                        self._out_q.put((e, seq, payload), timeout=0.05)
+                        break
+                    except queue.Full:
+                        continue
+                raise _EpochCanceled(epoch)
+            _CONSUMER_WAIT.observe(time.perf_counter() - t0)
+            _QUEUE_DEPTH.set(self._out_q.qsize())
+            return seq, payload
+
+    def run_epoch(self):
+        with self._epoch_lock:
+            self._epoch += 1
+            epoch = self._epoch
+        tasks = iter(self.loader.batch_sampler)
+        sent = 0
+        done_sending = False
+
+        def send_one():
+            nonlocal sent, done_sending
+            try:
+                idxs = next(tasks)
+            except StopIteration:
+                done_sending = True
+                return
+            self._index_q.put((epoch, sent, list(idxs)))
+            sent += 1
+
+        window = max(2, self.loader.prefetch_factor) * self.num_workers
+        while not done_sending and sent < window:
+            send_one()
+        buffers = {}
+        next_seq = 0
+        try:
+            while next_seq < sent or not done_sending:
+                while next_seq not in buffers:
+                    seq, payload = self._get(epoch)
+                    buffers[seq] = payload
+                payload = buffers.pop(next_seq)
+                next_seq += 1
+                if not done_sending:
+                    send_one()
+                if isinstance(payload, _WorkerError):
+                    payload.reraise()
+                _BATCHES_OUT.inc()
+                yield payload
+        finally:
+            # early exit (break/raise): cancel outstanding work — bump
+            # the epoch so workers skip queued tasks and the next epoch's
+            # consumer drops any in-flight results of this one. Only the
+            # CURRENT epoch may cancel itself: this close can arrive late
+            # (deferred through the prefetcher's reaper) when a newer
+            # epoch is already running, and bumping then would cancel
+            # that epoch mid-flight and hang its consumer
+            if not done_sending or next_seq < sent:
+                with self._epoch_lock:
+                    if self._epoch == epoch:
+                        self._epoch += 1
+
+    def shutdown(self):
+        self._shutdown.set()
+        with self._epoch_lock:
+            self._epoch += 1
+        for _ in self._threads:
+            self._index_q.put(_SHUTDOWN)
+        deadline = time.monotonic() + 2.0
+        for t in self._threads:
+            while t.is_alive() and time.monotonic() < deadline:
+                try:                  # unblock workers stuck in _put
+                    self._out_q.get_nowait()
+                except queue.Empty:
+                    pass
+                t.join(0.05)
+
+    def alive(self):
+        return not self._shutdown.is_set() and \
+            any(t.is_alive() for t in self._threads)
+
+
 class DataLoader:
-    """ref: python/paddle/io/dataloader/dataloader_iter.py. Thread-prefetched;
-    `prefetch_factor` batches are staged ahead so host→TPU transfer overlaps
-    compute."""
+    """ref: python/paddle/io/dataloader/dataloader_iter.py. Multi-worker
+    index-queue pool with ordered reassembly; `use_buffer_reader` stages
+    `prefetch_factor` collated batches onto device via io/prefetch.py so
+    host→TPU transfer of batch N+1 overlaps compute of batch N (kill
+    switch: FLAGS_dataloader_prefetch).
+
+    Caveat: with prefetch enabled, dataset.__getitem__/collate run on
+    the background staging thread even when `num_workers=0` (that is the
+    latency-hiding point — collate overlaps compute). A dataset holding
+    a thread-affine resource (e.g. a sqlite3 connection created on the
+    main thread) should pass `use_buffer_reader=False` or set
+    `FLAGS_dataloader_prefetch=false` to keep the synchronous
+    consumer-thread path."""
 
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -318,8 +724,21 @@ class DataLoader:
                  persistent_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
-        self.num_workers = num_workers
+        self.num_workers = max(0, int(num_workers))
         self.prefetch_factor = max(prefetch_factor, 1)
+        self.use_buffer_reader = use_buffer_reader
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self._pool: Optional[_WorkerPool] = None
+        # per-epoch salt for worker seeds (torch draws a fresh base_seed
+        # per epoch): without it every non-persistent pool re-runs
+        # worker_init_fn with the SAME data_seed and np.random.seed(
+        # get_worker_info().seed)-style augmentation replays identically
+        # every epoch. Deterministic across identically-seeded runs (the
+        # ordinal sequence is). Persistent pools keep their creation-time
+        # seeds for the workers' whole lifetime, like torch
+        self._epoch_ordinal = 0
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -338,6 +757,7 @@ class DataLoader:
         return len(self.batch_sampler)
 
     def _produce(self):
+        """Synchronous num_workers=0 path (errors propagate naturally)."""
         if self._iterable_mode:
             batch = []
             for item in self.dataset:
@@ -351,25 +771,147 @@ class DataLoader:
             for idxs in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in idxs])
 
-    def __iter__(self):
+    # -- iterable-mode worker pool ------------------------------------------
+    def _iter_with_iterable_workers(self):
+        """Each worker drives its own `iter(dataset)` (sharding is the
+        dataset's job via `get_worker_info()`, reference semantics) and
+        collates its stream locally; the consumer interleaves worker
+        streams round-robin for a deterministic order. Threads are
+        per-epoch: an iterable stream cannot be 'rewound', so there is
+        no worker state worth persisting."""
+        nw = self.num_workers
+        # one bounded queue PER worker: the round-robin consumer pulls
+        # from exactly the worker whose turn it is, so a slow worker
+        # backpressures the fast ones at `prefetch_factor` batches each
+        # instead of letting their whole streams pile up in host memory
+        qs = [queue.Queue(maxsize=max(2, self.prefetch_factor))
+              for _ in range(nw)]
+        stop = threading.Event()
+
+        def put(wid, item):
+            _interruptible_put(qs[wid], item, stop,
+                               wait_hist=_PRODUCER_WAIT)
+
+        infos = [WorkerInfo(w, nw, dataset=self.dataset,
+                            seed=core.data_seed("dataloader_worker", w,
+                                                self._epoch_ordinal))
+                 for w in range(nw)]
+
+        def work(wid):
+            _worker_info.info = infos[wid]
+            try:
+                if self.worker_init_fn is not None:
+                    self.worker_init_fn(wid)
+                batch = []
+                for item in self.dataset:
+                    if stop.is_set():
+                        return
+                    batch.append(item)
+                    if len(batch) == self.batch_size:
+                        put(wid, self.collate_fn(batch))
+                        batch = []
+                if batch and not self.drop_last:
+                    put(wid, self.collate_fn(batch))
+            except BaseException as e:
+                _WORKER_ERRORS.inc()
+                put(wid, _WorkerError(e, traceback.format_exc(), wid))
+                return
+            put(wid, _STREAM_END)
+
+        threads = [threading.Thread(target=work, args=(w,), daemon=True,
+                                    name=f"paddle-io-iterworker-{w}")
+                   for w in range(nw)]
+        for t in threads:
+            t.start()
+        rotation = list(range(nw))
+        rr = 0
+        try:
+            while rotation:
+                wid = rotation[rr % len(rotation)]
+                t0 = time.perf_counter()
+                try:
+                    payload = qs[wid].get(
+                        True, self.timeout if self.timeout > 0 else None)
+                except queue.Empty:
+                    raise RuntimeError(
+                        f"DataLoader timed out after {self.timeout} "
+                        f"seconds waiting for a worker batch") from None
+                _CONSUMER_WAIT.observe(time.perf_counter() - t0)
+                _QUEUE_DEPTH.set(sum(q.qsize() for q in qs))
+                if isinstance(payload, _WorkerError):
+                    payload.reraise()
+                if payload is _STREAM_END:
+                    rotation.remove(wid)
+                    continue
+                rr += 1
+                _BATCHES_OUT.inc()
+                yield payload
+            # every stream ran to completion: if no worker ever looked
+            # at get_worker_info() (and no worker_init_fn that could
+            # shard per worker was given), each worker replayed the FULL
+            # stream — every sample was produced num_workers times.
+            # That matches reference/torch semantics but silently
+            # changes epochs for datasets written against the old
+            # single-thread loader, so say it once
+            global _iterable_dup_warned
+            if (nw > 1 and self.worker_init_fn is None
+                    and not _iterable_dup_warned
+                    and not any(i._consulted for i in infos)):
+                _iterable_dup_warned = True
+                import warnings
+                warnings.warn(
+                    f"IterableDataset with num_workers={nw}: the dataset "
+                    "never consulted get_worker_info(), so every worker "
+                    f"replayed the full stream and each sample was "
+                    f"produced {nw} times this epoch. Shard the stream "
+                    "per worker via get_worker_info(), or use "
+                    "num_workers<=1", stacklevel=2)
+        finally:
+            stop.set()
+            for q in qs:
+                while True:           # unblock producers stuck in put()
+                    try:
+                        q.get_nowait()
+                    except queue.Empty:
+                        break
+
+    def _batches(self):
+        self._epoch_ordinal += 1
         if self.num_workers == 0:
             yield from self._produce()
             return
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor
-                                       * max(self.num_workers, 1))
-        stop = object()
+        if self._iterable_mode:
+            yield from self._iter_with_iterable_workers()
+            return
+        pool = self._pool
+        if pool is None or not pool.alive():
+            pool = _WorkerPool(self)
+            if self.persistent_workers:
+                self._pool = pool
+        gen = pool.run_epoch()
+        try:
+            yield from gen
+        finally:
+            gen.close()
+            if not self.persistent_workers:
+                pool.shutdown()
 
-        def worker():
+    def _prefetch_enabled(self):
+        return self.use_buffer_reader and \
+            core.get_bool_flag("FLAGS_dataloader_prefetch", True)
+
+    def __iter__(self):
+        batches = self._batches()
+        if not self._prefetch_enabled():
+            yield from batches
+            return
+        from .prefetch import DevicePrefetcher
+        yield from DevicePrefetcher(batches, self.prefetch_factor)
+
+    def __del__(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
             try:
-                for b in self._produce():
-                    q.put(b)
-            finally:
-                q.put(stop)
-
-        t = threading.Thread(target=worker, daemon=True)
-        t.start()
-        while True:
-            b = q.get()
-            if b is stop:
-                break
-            yield b
+                pool.shutdown()
+            except Exception:
+                pass

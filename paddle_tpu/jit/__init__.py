@@ -246,6 +246,13 @@ class TrainStep:
         self.shard = shard
         if shard is not None and hasattr(shard, "attach_model"):
             shard.attach_model(model)
+        # make the plan visible to DataLoader prefetchers so batches
+        # stage straight into the mesh layout (io/prefetch.py picks up
+        # the active plan's batch_spec at iteration time). Latest step
+        # wins: an unsharded TrainStep clears a predecessor's plan so
+        # loaders don't keep staging into a dead job's mesh layout
+        from ..io import prefetch as _prefetch
+        _prefetch.set_active_plan(shard)
         self._compiled = None
         self._donate = donate
         self._key_base = None     # per-instance RNG base (see __call__)
@@ -452,6 +459,10 @@ class TrainStep:
                 self._key_base_src = core.base_rng_key_data()
             key = self._key_base
         batch_arrays = _tree_unbox(batch)
+        if self.shard is not None and hasattr(self.shard, "reshard_batch"):
+            # committed prefetched batches must match the compiled batch
+            # in_shardings — see ShardingPlan.reshard_batch
+            batch_arrays = self.shard.reshard_batch(batch_arrays)
         scaler_state = (self.scaler._get_traced_state()
                         if self.scaler is not None else {})
         bench = core.get_bool_flag("FLAGS_benchmark")

@@ -121,6 +121,20 @@ class Tensor:
     def __float__(self):
         return float(np.asarray(self.data))
 
+    def __format__(self, spec):
+        # f"{loss:.4f}" on a scalar tensor is a host-sync boundary,
+        # same contract as float() — train_batch/log-time formatting
+        # of a still-on-device loss must not TypeError. The EMPTY spec
+        # keeps the pre-existing object.__format__ behavior (str(self):
+        # repr is trace-safe and syncs nothing) so a debug f"{x}" inside
+        # a traced body doesn't start failing or force a host pull
+        if not spec:
+            return str(self)
+        a = np.asarray(self.data)
+        if a.size == 1:
+            return format(a.item(), spec)
+        return format(a, spec)
+
     def __int__(self):
         return int(np.asarray(self.data))
 

@@ -47,6 +47,13 @@ class HostSyncPass(LintPass):
         "paddle_tpu/amp/",
         "paddle_tpu/vision/ops.py",
         "paddle_tpu/geometric/__init__.py",
+        # the training-loop layers: a per-step float(loss.numpy()) here
+        # defeats async dispatch for the WHOLE job (ISSUE 5 — fit/
+        # evaluate/predict sync once per log interval through
+        # hapi.model._host_pull; intentional per-call API boundaries
+        # carry rationale suppressions)
+        "paddle_tpu/hapi/",
+        "paddle_tpu/io/",
     )
 
     def check_file(self, ctx: FileContext):
