@@ -13,8 +13,8 @@ from .env import (  # noqa: F401
 from .collective import (  # noqa: F401
     ReduceOp, all_gather, all_gather_object, all_reduce, alltoall,
     alltoall_single, barrier, broadcast, broadcast_object_list,
-    destroy_process_group, get_group, irecv, isend, new_group, recv,
-    reduce, reduce_scatter, scatter, send, wait,
+    destroy_process_group, get_group, health_barrier, irecv, isend,
+    new_group, recv, reduce, reduce_scatter, scatter, send, wait,
 )
 from .topology import (  # noqa: F401
     AXES, AxisGroup, CommunicateTopology, HybridCommunicateGroup,

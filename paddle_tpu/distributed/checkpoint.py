@@ -77,6 +77,14 @@ class CheckpointError(RuntimeError):
     trust its tensors (ElasticManager falls back to an older one)."""
 
 
+def _incarnation() -> int:
+    """elastic.incarnation, imported lazily (elastic imports this module
+    at top level) — ONE parser for PADDLE_INCARNATION, malformed-env
+    tolerant, so a typo'd value can't fail every checkpoint save."""
+    from .elastic import incarnation
+    return incarnation()
+
+
 def _crc(data: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(data).tobytes()) & 0xFFFFFFFF
 
@@ -165,7 +173,12 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
             # true when the coordinator's shard entries in this metadata
             # are the WHOLE coverage map (single-controller common case);
             # multi-host saves merge the per-rank index fragments instead
-            "coverage_complete": world == 1}
+            "coverage_complete": world == 1,
+            # forensics for coordinated elastic recovery (ISSUE 6):
+            # which relaunch of which rank committed this checkpoint —
+            # post-mortems of a chaos run can line checkpoints up
+            # against the supervisor's death/relaunch records
+            "writer": {"rank": rank, "incarnation": _incarnation()}}
     rank_shards: Dict[str, list] = {}   # this rank's shard entries
     blobs = {}
     for name, t in state_dict.items():

@@ -103,6 +103,48 @@ class ReduceOp:
     AVG = "avg"
 
 
+# ---- coordinated elastic recovery (ISSUE 6): preflight health barrier.
+# DISARMED by default: unless the supervising launch layer set
+# PADDLE_ELASTIC_SUPERVISED, health_barrier() is one env lookup and an
+# immediate None — collective behavior is bitwise the unsupervised one.
+
+_health_client = None
+
+
+def _membership_client():
+    """Cached MembershipManager client built from the supervisor's env
+    (endpoint/world/rank); heartbeating so the master's alive view —
+    which the health barrier releases on — includes this rank. Rides the
+    same authenticated `_net.connect_with_retry` channel as every other
+    elastic poll."""
+    global _health_client
+    if _health_client is None:
+        from .elastic import MembershipManager
+        _health_client = MembershipManager(
+            rank=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+        _health_client.start_heartbeat()
+    return _health_client
+
+
+def health_barrier(tag: str = "init", timeout: Optional[float] = None):
+    """Generation-stamped preflight health barrier (ISSUE 6).
+
+    Under a supervising launcher (PADDLE_ELASTIC_SUPERVISED) this parks
+    until every expected rank of the job has a fresh heartbeat at the
+    elastic master — consulted at process-group init
+    (`init_parallel_env`) and by the CommWatchdog when a step overruns,
+    so a hung/dead peer converts into a DETECTED failure (TimeoutError
+    naming the missing ranks) instead of an indefinite deadlock inside
+    a half-dead collective. Bounded by FLAGS_comm_timeout unless
+    `timeout` overrides. Returns the release info {gen, alive, missing}
+    or None when no supervisor is configured (the disarmed fast path —
+    one env lookup)."""
+    if not os.environ.get("PADDLE_ELASTIC_SUPERVISED"):
+        return None
+    with _span("collective.health_barrier", tag=tag):
+        return _membership_client().health_barrier(timeout=timeout)
+
+
 def _in_shard_map(axis):
     try:
         jax.lax.axis_index(axis)

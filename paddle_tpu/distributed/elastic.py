@@ -16,7 +16,36 @@ store maps to the filesystem/GCS path the checkpoints live in (SURVEY §5
 'etcd -> coordination service'). Hangs (desynced peer, stuck collective)
 can be converted to restarts by passing `watchdog=` — the step runs
 under distributed/watchdog.CommWatchdog, whose abort path exits with the
-faulted-worker code for the launch layer to relaunch."""
+faulted-worker code for the launch layer to relaunch.
+
+COORDINATED recovery (ISSUE 6): under `paddle_tpu.distributed.launch
+--elastic_level 1` every rank runs as a supervised child and the rank-0
+supervisor hosts the master-side MembershipManager, which now also keeps
+a restart GENERATION and two barrier kinds:
+
+- *health barrier* (`health_barrier` / collective.health_barrier):
+  releases when every expected (non-abandoned) rank has a fresh
+  heartbeat — the preflight consulted at process-group init and on
+  watchdog fire, so a job never walks into a collective with a
+  known-dead peer.
+- *recovery barrier* (`recovery_barrier`): generation-stamped arrival
+  barrier. Each rank reports the list of checkpoint steps it holds
+  VERIFIED complete; the master releases the barrier when every
+  expected rank of that generation has arrived and answers with the
+  agreed resume step (the newest step present and valid on EVERY
+  rank), the current world size and a contiguous rank remap — the
+  degraded-world path when a rank was abandoned.
+
+The supervisor bumps the generation whenever it relaunches a rank, so
+survivors notice (heartbeat replies carry the generation), park at the
+recovery barrier instead of deadlocking in a half-dead collective, and
+resume together from the newest complete checkpoint. When a rank stays
+dead past the supervisor's budget it is ABANDONED: the master shrinks
+the expected world, the next barrier releases at the smaller world size,
+and `DistributedBatchSampler.update_world` / `ShardingPlan.remesh`
+reshard to it. Everything here is DISARMED unless the supervisor set
+PADDLE_ELASTIC_SUPERVISED / a `membership=` was passed explicitly —
+the unsupervised code paths are bitwise the pre-ISSUE-6 behavior."""
 from __future__ import annotations
 
 import glob
@@ -25,7 +54,7 @@ import random
 import shutil
 import time
 import warnings
-from typing import Callable, Optional
+from typing import Callable, List, Optional
 
 from ..observability import metrics as _m
 from ..observability.spans import span as _span
@@ -33,13 +62,31 @@ from ..utils.fault_injection import fault_point
 from . import checkpoint as dck
 
 __all__ = ["ElasticManager", "ELASTIC_EXIT_CODE",
-           "MembershipManager"]
+           "MembershipManager", "CheckpointScrubber", "incarnation"]
 
 ELASTIC_EXIT_CODE = 101  # ref manager.py:32 — relaunch-me marker
 
-# elastic telemetry (ISSUE 3): how often the manager restarts, falls
-# back past corrupt checkpoints, and how long it backs off — the chaos
-# suite and a fleet dashboard both read recovery behavior from these
+
+def incarnation() -> int:
+    """This process's per-rank relaunch ordinal (0 for the first spawn).
+    Set by the supervising launch layer (PADDLE_INCARNATION) so metrics,
+    flight-recorder files and checkpoint metadata can tell relaunch N
+    from relaunch N-1."""
+    try:
+        return int(os.environ.get("PADDLE_INCARNATION", "0"))
+    except ValueError:
+        return 0
+
+
+def _inc_label() -> str:
+    return str(incarnation())
+
+
+# elastic telemetry (ISSUE 3 + ISSUE 6): how often the manager restarts,
+# falls back past corrupt checkpoints, how long it backs off, and the
+# coordinated-recovery behavior (barrier waits, peer-failure recoveries,
+# degraded-world events) — all labeled with this process's incarnation so
+# the chaos suite and a fleet dashboard can tell relaunch N from N-1
 _EL_RESTARTS = _m.counter("elastic.restarts_total",
                           "in-process restart attempts after an exception")
 _EL_QUARANTINES = _m.counter("elastic.quarantines_total",
@@ -48,6 +95,150 @@ _EL_RESTORES = _m.counter("elastic.restores_total",
                           "successful checkpoint restores")
 _EL_BACKOFF = _m.gauge("elastic.last_backoff_seconds",
                        "most recent restart backoff delay")
+_EL_RECOVERIES = _m.counter(
+    "elastic.recoveries_total",
+    "coordinated recoveries after a PEER failure (generation bump)")
+_EL_BARRIER_WAITS = _m.counter(
+    "elastic.barrier_waits_total", "recovery/health barrier entries")
+_EL_BARRIER_SECONDS = _m.histogram(
+    "elastic.barrier_seconds", "time parked at recovery/health barriers")
+_EL_GENERATION = _m.gauge(
+    "elastic.generation", "last restart generation seen from the master")
+_EL_DEGRADED = _m.counter(
+    "elastic.degraded_total",
+    "degraded-world transitions (job re-formed at a smaller world size)")
+_EL_SCRUBS = _m.counter(
+    "elastic.scrub_passes_total",
+    "background checksum-scrubber passes over retained checkpoints")
+
+
+def _quarantine_dir(path: str, err) -> str:
+    """Move a failed-validation checkpoint aside (never delete — a human
+    may want the forensics) so retries don't re-validate it. Shared by
+    ElasticManager.restore and the background CheckpointScrubber."""
+    dst = path + ".corrupt"
+    n = 0
+    while os.path.exists(dst):
+        n += 1
+        dst = f"{path}.corrupt.{n}"
+    try:
+        os.replace(path, dst)
+    except OSError:
+        dst = path + " (quarantine rename failed)"
+    _EL_QUARANTINES.inc(1, incarnation=_inc_label())
+    warnings.warn(
+        f"[elastic] checkpoint {path} failed validation ({err}); "
+        f"quarantined as {dst}, falling back to an older checkpoint",
+        RuntimeWarning)
+    return dst
+
+
+def _step_dirs(ckpt_dir: str):
+    """Sorted [(step, path)] of COMMITTED checkpoint dirs (metadata.json
+    present = the v2 commit point)."""
+    out = []
+    for d in glob.glob(os.path.join(ckpt_dir, "step_*")):
+        if os.path.exists(os.path.join(d, "metadata.json")):
+            try:
+                out.append((int(os.path.basename(d)[5:]), d))
+            except ValueError:
+                pass        # step_N.corrupt / foreign names
+    return sorted(out)
+
+
+class _PeerFailure(RuntimeError):
+    """Internal: the master's generation moved — a PEER died and was
+    relaunched (or the world degraded); this rank must park at the
+    recovery barrier. Never counted against max_restarts."""
+
+    def __init__(self, generation):
+        super().__init__(f"peer failure: restart generation moved to "
+                         f"{generation}")
+        self.generation = generation
+
+
+class CheckpointScrubber:
+    """Background checksum scrubber (ISSUE 2 follow-on): a low-priority
+    daemon thread walks the retained `step_*` dirs between saves,
+    re-verifies every blob CRC32 via `checkpoint.verify_checkpoint`, and
+    quarantines bit-rot to `.corrupt` BEFORE restore needs it (counted by
+    `elastic.quarantines_total`). Dirs are re-verified only when their
+    metadata.json mtime changes, so steady-state passes are one stat per
+    retained dir."""
+
+    def __init__(self, ckpt_dir: str, interval: float = 30.0,
+                 full_rescrub_every: int = 10):
+        import threading
+        self.ckpt_dir = ckpt_dir
+        self.interval = interval
+        self.full_rescrub_every = full_rescrub_every
+        self._stop = threading.Event()
+        self._thread = None
+        self._seen = {}      # path -> metadata mtime already verified
+        self.passes = 0
+        self.quarantined: List[str] = []
+
+    def scrub_once(self) -> List[str]:
+        """One pass over retained checkpoints; returns paths quarantined
+        by THIS pass. Skips the newest committed dir only when a save to
+        it may still be in flight is impossible — commits are atomic
+        (metadata.json last), so every visible dir is fair game."""
+        self.passes += 1
+        if self.full_rescrub_every and \
+                self.passes % self.full_rescrub_every == 0:
+            # the mtime memo only detects NEW/rewritten dirs; bit-rot
+            # lands in files whose metadata never changes, so every Nth
+            # pass drops the memo and re-reads every CRC — the scrubber
+            # exists precisely for rot AFTER the first clean verify
+            self._seen.clear()
+        bad = []
+        for _step, path in _step_dirs(self.ckpt_dir):
+            meta = os.path.join(path, "metadata.json")
+            try:
+                mtime = os.path.getmtime(meta)
+            except OSError:
+                continue            # racing a quarantine/cleanup
+            if self._seen.get(path) == mtime:
+                continue
+            try:
+                dck.verify_checkpoint(path)
+                self._seen[path] = mtime
+            except dck.CheckpointError as e:
+                if not os.path.exists(os.path.join(path,
+                                                   "metadata.json")):
+                    # not rot: the dir was retention-pruned (or
+                    # quarantined by restore) UNDER the verify —
+                    # resurrecting a half-deleted dir as .corrupt would
+                    # fake a bit-rot alarm on a healthy job
+                    self._seen.pop(path, None)
+                    continue
+                bad.append(_quarantine_dir(path, e))
+                self._seen.pop(path, None)
+            if self._stop.is_set():
+                break
+        _EL_SCRUBS.inc(1, incarnation=_inc_label())
+        self.quarantined.extend(bad)
+        return bad
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.scrub_once()
+            except Exception:
+                # the scrubber is advisory: a transient filesystem error
+                # must not kill the thread (the next pass retries)
+                pass
+
+    def start(self):
+        import threading
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
 
 
 class ElasticManager:
@@ -68,12 +259,27 @@ class ElasticManager:
     train_step runs inside a watchdog section (timeout `step_timeout`,
     default FLAGS_comm_timeout); with on_timeout='abort' a hung step
     exits ELASTIC_EXIT_CODE so the launch layer relaunches and resume
-    picks up from the last complete checkpoint."""
+    picks up from the last complete checkpoint.
+
+    membership: None (default — bitwise the uncoordinated behavior),
+    True (build a MembershipManager client from PADDLE_ELASTIC_* env,
+    only when PADDLE_ELASTIC_SUPERVISED is set), or a MembershipManager.
+    When set, run() is COORDINATED: it parks at the master's recovery
+    barrier before (re)starting, resumes from the agreed newest step
+    every rank holds complete, watches the restart generation between
+    steps (a bump = a peer died; park instead of deadlocking in its
+    half-dead collective), and applies degraded-world releases through
+    `on_world_change(world, rank)`.
+
+    scrub_interval: seconds between background checksum-scrubber passes
+    over the retained checkpoints (None = no scrubber)."""
 
     def __init__(self, ckpt_dir: str, save_interval: int = 100,
                  keep: int = 2, max_restarts: int = 3,
                  backoff_base: float = 0.1, backoff_max: float = 5.0,
-                 watchdog=None, step_timeout: Optional[float] = None):
+                 watchdog=None, step_timeout: Optional[float] = None,
+                 membership=None, on_world_change: Optional[Callable] = None,
+                 scrub_interval: Optional[float] = None):
         self.ckpt_dir = ckpt_dir
         self.save_interval = save_interval
         self.keep = keep
@@ -82,18 +288,15 @@ class ElasticManager:
         self.backoff_max = backoff_max
         self.step_timeout = step_timeout
         self.watchdog = watchdog
+        self.membership = membership
+        self.on_world_change = on_world_change
+        self.scrubber = (CheckpointScrubber(ckpt_dir, scrub_interval)
+                         if scrub_interval is not None else None)
         os.makedirs(ckpt_dir, exist_ok=True)
 
     # -- checkpoint bookkeeping --------------------------------------------
     def _step_dirs(self):
-        out = []
-        for d in glob.glob(os.path.join(self.ckpt_dir, "step_*")):
-            if os.path.exists(os.path.join(d, "metadata.json")):
-                try:
-                    out.append((int(os.path.basename(d)[5:]), d))
-                except ValueError:
-                    pass        # step_N.corrupt / foreign names
-        return sorted(out)
+        return _step_dirs(self.ckpt_dir)
 
     def latest(self):
         dirs = self._step_dirs()
@@ -111,27 +314,26 @@ class ElasticManager:
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
         dck.save_state_dict(self._tensors_of(state_dict), tmp)
-        os.replace(tmp, path)      # metadata.json present => complete
+        if os.path.exists(path):
+            # replayed step after a coordinated rewind (resume_step
+            # older than our newest): os.replace cannot overwrite a
+            # non-empty dir (ENOTEMPTY), so swap the old copy aside
+            # atomically, commit the new one, then drop the old — the
+            # bytes are identical anyway (deterministic replay), but
+            # the commit must not crash the run
+            old = path + ".old"
+            if os.path.exists(old):
+                shutil.rmtree(old)
+            os.replace(path, old)
+            os.replace(tmp, path)  # metadata.json present => complete
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.replace(tmp, path)  # metadata.json present => complete
         for _, old in self._step_dirs()[:-self.keep]:
             shutil.rmtree(old, ignore_errors=True)
 
     def _quarantine(self, path: str, err: Exception):
-        """Move a failed-validation checkpoint aside (never delete — a
-        human may want the forensics) so retries don't re-validate it."""
-        dst = path + ".corrupt"
-        n = 0
-        while os.path.exists(dst):
-            n += 1
-            dst = f"{path}.corrupt.{n}"
-        try:
-            os.replace(path, dst)
-        except OSError:
-            dst = path + " (quarantine rename failed)"
-        _EL_QUARANTINES.inc()
-        warnings.warn(
-            f"[elastic] checkpoint {path} failed validation ({err}); "
-            f"quarantined as {dst}, falling back to an older checkpoint",
-            RuntimeWarning)
+        _quarantine_dir(path, err)
 
     def restore(self, state_dict):
         """Load the newest checkpoint that passes validation (checksums
@@ -147,11 +349,46 @@ class ElasticManager:
                 # verify_checkpoint pass would read every blob twice
                 with _span("elastic.restore", path=path):
                     dck.load_state_dict(self._tensors_of(state_dict), path)
-                _EL_RESTORES.inc()
+                _EL_RESTORES.inc(1, incarnation=_inc_label())
                 return step
             except dck.CheckpointError as e:
                 self._quarantine(path, e)
         return 0
+
+    def restore_exact(self, state_dict, step: int) -> int:
+        """Load EXACTLY checkpoint `step` (the coordinated-resume
+        agreement) — step<=0 means fresh start. A corrupt agreed
+        checkpoint is quarantined and CheckpointError propagates: the
+        supervised loop then bumps the GENERATION (the cached release
+        would just repeat the unusable agreement) so the whole world
+        re-parks and converges on an older step our report no longer
+        contains."""
+        if step <= 0:
+            return 0
+        path = os.path.join(self.ckpt_dir, f"step_{step}")
+        fault_point("elastic.restore")
+        try:
+            with _span("elastic.restore", path=path, agreed=step):
+                dck.load_state_dict(self._tensors_of(state_dict), path)
+        except dck.CheckpointError as e:
+            self._quarantine(path, e)
+            raise
+        _EL_RESTORES.inc(1, incarnation=_inc_label())
+        return step
+
+    def verified_steps(self) -> List[int]:
+        """Step numbers of retained checkpoints that pass full integrity
+        verification RIGHT NOW (corrupt ones are quarantined on sight) —
+        what this rank reports at the recovery barrier so the master can
+        agree on the newest step EVERY rank holds complete."""
+        ok = []
+        for step, path in self._step_dirs():
+            try:
+                dck.verify_checkpoint(path)
+                ok.append(step)
+            except dck.CheckpointError as e:
+                self._quarantine(path, e)
+        return ok
 
     # -- managed loop -------------------------------------------------------
     def _restart_delay(self, restarts: int) -> float:
@@ -175,12 +412,41 @@ class ElasticManager:
             wd = self.watchdog = CommWatchdog(on_timeout="abort", **kw)
         return wd.wrap(train_step, name="elastic.train_step")
 
+    def _resolve_membership(self) -> Optional["MembershipManager"]:
+        if self.membership is None:
+            return None
+        if self.membership is True:
+            # only a supervising launch layer arms the coordinated path
+            # (acceptance: unsupervised behavior is bitwise unchanged)
+            if not os.environ.get("PADDLE_ELASTIC_SUPERVISED"):
+                self.membership = None
+                return None
+            self.membership = MembershipManager(
+                rank=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+        return self.membership
+
     def run(self, make_state: Callable[[], dict],
             train_step: Callable[[dict, int], float],
             total_steps: int, on_restart: Optional[Callable] = None):
         """Runs train_step(state, step) for steps [resume..total); returns
         list of losses. Exceptions trigger restore+retry (FAULT_TOLERANCE
-        semantics) with capped exponential backoff + jitter."""
+        semantics) with capped exponential backoff + jitter. With
+        `membership` configured the restarts are COORDINATED (see class
+        docstring)."""
+        if self.scrubber is not None:
+            self.scrubber.start()
+        try:
+            mm = self._resolve_membership()
+            if mm is None:
+                return self._run_local(make_state, train_step, total_steps,
+                                       on_restart)
+            return self._run_supervised(mm, make_state, train_step,
+                                        total_steps, on_restart)
+        finally:
+            if self.scrubber is not None:
+                self.scrubber.stop()
+
+    def _run_local(self, make_state, train_step, total_steps, on_restart):
         restarts = 0
         losses: dict = {}    # step -> loss; replayed steps overwrite
         step_fn = self._wrap_step(train_step)
@@ -198,11 +464,145 @@ class ElasticManager:
                 return [losses[s] for s in sorted(losses)]
             except Exception:
                 restarts += 1
-                _EL_RESTARTS.inc()
+                _EL_RESTARTS.inc(1, incarnation=_inc_label())
                 if restarts > self.max_restarts:
                     raise SystemExit(ELASTIC_EXIT_CODE)
                 if on_restart is not None:
                     on_restart(restarts)
+                delay = self._restart_delay(restarts)
+                _EL_BACKOFF.set(delay)
+                time.sleep(delay)
+
+    # -- coordinated (supervised) loop --------------------------------------
+    def _coordinate(self, mm: "MembershipManager") -> dict:
+        """Park at the recovery barrier reporting this rank's verified
+        checkpoint steps; returns the release info (gen, resume_step,
+        world, rank_map)."""
+        release = mm.recovery_barrier(steps=self.verified_steps())
+        self._apply_world(mm, release)
+        return release
+
+    def _apply_world(self, mm: "MembershipManager", release: dict):
+        world = release.get("world")
+        rank_map = release.get("rank_map") or {}
+        if world is None:
+            return
+        new_rank = rank_map.get(mm.rank, mm.rank)
+        prev_w = getattr(self, "_world", None)
+        prev_r = getattr(self, "_rank", None)
+        full = mm.world
+        degraded = ((prev_w is not None and world < prev_w) or
+                    (prev_w is None and full is not None and world < full))
+        if degraded:
+            _EL_DEGRADED.inc(1, incarnation=_inc_label())
+            warnings.warn(
+                f"[elastic] world degraded: now {world} rank(s), this "
+                f"rank remapped {mm.rank} -> {new_rank} "
+                f"(generation {release.get('gen')})", RuntimeWarning)
+        self._world, self._rank = world, new_rank
+        if (world, new_rank) == (prev_w, prev_r):
+            return
+        # skip the callback for the initial full-world release (nothing
+        # to reshard); fire it for every later change AND for a relaunch
+        # landing straight in an already-degraded world
+        initial_full = (prev_w is None and
+                        (full is None or (world == full and
+                                          new_rank == mm.rank)))
+        if not initial_full and self.on_world_change is not None:
+            self.on_world_change(world, new_rank)
+
+    def _run_supervised(self, mm, make_state, train_step, total_steps,
+                        on_restart):
+        restarts = 0
+        losses: dict = {}
+        step_fn = self._wrap_step(train_step)
+        mm.start_heartbeat()
+        try:
+            return self._supervised_loop(mm, make_state, step_fn,
+                                         total_steps, on_restart,
+                                         restarts, losses)
+        finally:
+            # the beat thread must not outlive the run (stale beats
+            # would keep a finished rank "alive" at the master forever)
+            mm.stop()
+
+    def _supervised_loop(self, mm, make_state, step_fn, total_steps,
+                         on_restart, restarts, losses):
+        self._world = self._rank = None
+        gen = None
+        coordinate = True       # first entry + every peer failure
+        while True:
+            try:
+                state = make_state()
+                if coordinate:
+                    # recovery barrier: park with the peers, agree on
+                    # the newest step EVERY rank holds complete
+                    release = self._coordinate(mm)
+                    gen = release["gen"]
+                    _EL_GENERATION.set(gen, incarnation=_inc_label())
+                    try:
+                        start = self.restore_exact(
+                            state, release["resume_step"])
+                    except dck.CheckpointError:
+                        # OUR copy of the agreed step is corrupt (now
+                        # quarantined). The release for this generation
+                        # is cached, so re-arriving would hand back the
+                        # same unusable agreement — and restoring our
+                        # own newest instead would silently diverge
+                        # from peers that restored the agreed step.
+                        # Force a NEW generation: everyone re-parks and
+                        # re-agrees, and our report no longer contains
+                        # the quarantined step.
+                        gen = mm.notify_failure(
+                            None, reason="corrupt agreed checkpoint at "
+                            f"rank {mm.rank}")
+                        _EL_GENERATION.set(gen, incarnation=_inc_label())
+                        continue        # coordinate stays True
+                else:
+                    # local fault (our own exception, generation
+                    # unchanged): classic restore from OUR newest —
+                    # re-reading the barrier release would hand back the
+                    # stale agreement and rewind past checkpoints the
+                    # peers have moved beyond
+                    start = self.restore(state)
+                coordinate = True
+                for step in range(start, total_steps):
+                    seen = mm.last_generation()
+                    if seen is not None and gen is not None and \
+                            seen != gen:
+                        # a peer died and was relaunched (or the world
+                        # degraded) — park at the barrier instead of
+                        # deadlocking in its half-dead collective
+                        raise _PeerFailure(seen)
+                    with _span("elastic.train_step", step=step):
+                        fault_point("elastic.train_step")
+                        losses[step] = step_fn(state, step)
+                    nxt = step + 1
+                    if nxt % self.save_interval == 0 or nxt == total_steps:
+                        self.save(state, nxt)
+                # tell the master this rank is DONE: it leaves the
+                # barrier expectation so a peer relaunched after our
+                # exit doesn't park forever waiting for us
+                try:
+                    mm.notify_done()
+                except Exception:
+                    pass
+                return [losses[s] for s in sorted(losses)]
+            except _PeerFailure as e:
+                # peer failures are not THIS rank's fault: recover
+                # (coordinated) without burning a restart budget slot
+                _EL_RECOVERIES.inc(1, incarnation=_inc_label())
+                _EL_GENERATION.set(e.generation, incarnation=_inc_label())
+                coordinate = True
+                continue
+            except Exception:
+                restarts += 1
+                _EL_RESTARTS.inc(1, incarnation=_inc_label())
+                if restarts > self.max_restarts:
+                    raise SystemExit(ELASTIC_EXIT_CODE)
+                if on_restart is not None:
+                    on_restart(restarts)
+                coordinate = False      # local fault: restore our newest
                 delay = self._restart_delay(restarts)
                 _EL_BACKOFF.set(delay)
                 time.sleep(delay)
@@ -215,30 +615,70 @@ class MembershipManager:
 
     TPU-native: etcd is replaced by an authenticated TCP registry on the
     master (host-side control plane); each node heartbeats
-    `(name, rank, timestamp)`, the master expires entries past the TTL and
-    every node can poll `alive()` / `changed()` to trigger
+    `(name, rank, incarnation)`, the master expires entries past the TTL
+    and every node can poll `alive()` / `changed()` to trigger
     checkpoint-restore resizing. Faulted nodes exit with
     ELASTIC_EXIT_CODE for the launch CLI's restart loop to relaunch.
     Endpoint env: PADDLE_ELASTIC_ENDPOINT (distinct from the rpc module's
     PADDLE_MASTER_ENDPOINT — the two protocols must not share a port).
-    """
+
+    ISSUE 6 adds the COORDINATION plane on the same channel:
+
+    - a restart *generation* (bumped by the supervising launcher on
+      every relaunch; heartbeat replies carry it so every worker sees a
+      bump within one beat interval, no extra round trips),
+    - `recovery_barrier(steps=...)` — generation-stamped arrival barrier
+      with newest-common-checkpoint agreement,
+    - `health_barrier()` — releases when every expected rank has a
+      fresh heartbeat (preflight; survivors need not re-enter),
+    - `notify_failure(rank)` / `abandon(rank)` — the supervisor's death
+      and degrade notifications; abandoned ranks leave the expected
+      world and later barriers release at the smaller world size with a
+      contiguous rank remap.
+
+    `world=` (or PADDLE_ELASTIC_WORLD) tells the master the expected
+    rank count; barriers require it."""
 
     def __init__(self, master_endpoint=None, name=None, rank=0,
-                 ttl: float = 60.0, interval: float = 2.0):
+                 ttl: Optional[float] = None,
+                 interval: Optional[float] = None,
+                 world: Optional[int] = None):
         import threading
 
         self.master_endpoint = master_endpoint or os.environ.get(
             "PADDLE_ELASTIC_ENDPOINT", "127.0.0.1:18814")
         self.name = name or f"node{rank}"
         self.rank = rank
+        # env-tunable defaults so clients built from the supervisor's
+        # env (membership=True, collective.health_barrier) agree on
+        # cadence with the job config without plumbing numbers through
+        if ttl is None:
+            ttl = float(os.environ.get("PADDLE_ELASTIC_TTL", "60"))
+        if interval is None:
+            interval = float(os.environ.get(
+                "PADDLE_ELASTIC_HEARTBEAT", "2"))
         self.ttl = ttl
         self.interval = interval
+        if world is None:
+            w = os.environ.get("PADDLE_ELASTIC_WORLD")
+            world = int(w) if w else None
+        self.world = world
         self._stop = threading.Event()
         self._lock = threading.Lock()
-        self._beats = {}               # master-side: name -> (rank, t)
+        self._beats = {}               # master-side: name -> (rank, t, inc)
         self._listener = None
         self._threads = []
         self._last_view = frozenset()
+        self._heartbeating = False
+        # -- coordination state (master-side; guarded by _lock) ----------
+        self._generation = 0
+        self._abandoned = set()        # ranks degraded out of the world
+        self._completed = set()        # ranks that finished cleanly
+        self._dead = {}                # rank -> (gen, reason, t) forensics
+        self._arrived = {}             # gen -> {rank: steps-or-None}
+        self._released = {}            # gen -> release info dict
+        # -- client-side generation cache (updated by heartbeat replies)
+        self._seen_gen = None
 
     @staticmethod
     def _addr(endpoint):
@@ -309,45 +749,218 @@ class MembershipManager:
                         pass
                     time.sleep(0.02)
                     continue
-                try:
-                    msg = conn.recv()
-                    if msg[0] == "beat":
-                        _, name, rank = msg
-                        with self._lock:
-                            self._beats[name] = (rank, time.time())
-                        conn.send(("ok", None))
-                    elif msg[0] == "alive":
-                        conn.send(("ok", self._alive_now()))
-                except (OSError, EOFError):
-                    pass
-                finally:
-                    conn.close()
+                # PER-CONNECTION handler thread with a bounded read:
+                # serving inline would let ONE stalled/abandoned client
+                # (a worker preempted between connect and send, or
+                # killed mid-protocol) pin the accept loop in
+                # conn.recv() while every other rank's heartbeat and
+                # barrier poll queues behind it in the TCP backlog —
+                # observed as a whole-world recovery wedge
+                threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True).start()
 
         t = threading.Thread(target=serve, daemon=True)
         t.start()
         self._threads.append(t)
         return self
 
+    def _serve_conn(self, conn):
+        try:
+            if not conn.poll(30.0):
+                return      # abandoned connection: drop, don't pin
+            msg = conn.recv()
+            conn.send(self._handle(msg))
+        except (OSError, EOFError):
+            pass
+        finally:
+            conn.close()
+
+    def _handle(self, msg):
+        """One request -> one reply (master side). Unknown messages get
+        ("err", ...) instead of a dropped connection so a version-skewed
+        client fails loudly."""
+        kind = msg[0]
+        if kind == "beat":
+            name, rank = msg[1], msg[2]
+            inc = msg[3] if len(msg) > 3 else 0
+            with self._lock:
+                self._beats[name] = (rank, time.time(), inc)
+                return ("ok", self._generation)
+        if kind == "alive":
+            return ("ok", self._alive_now())
+        if kind == "gen":
+            with self._lock:
+                return ("ok", self._generation)
+        if kind == "bump":
+            dead_rank, reason = msg[1], msg[2]
+            return ("ok", self._bump(dead_rank, reason))
+        if kind == "abandon":
+            return ("ok", self._abandon(msg[1]))
+        if kind == "done":
+            with self._lock:
+                self._completed.add(msg[1])
+                return ("ok", None)
+        if kind == "world":
+            with self._lock:
+                return ("ok", self._world_info())
+        if kind == "barrier":
+            name, rank, gen, steps = msg[1], msg[2], msg[3], msg[4]
+            return ("ok", self._barrier_arrive(name, rank, gen, steps))
+        if kind == "hbar":
+            return ("ok", self._health_check())
+        return ("err", f"unknown elastic message {kind!r}")
+
+    # master-side coordination primitives (callable locally by the
+    # supervisor that hosts the master, or remotely via _call)
+    def _bump(self, dead_rank, reason) -> int:
+        """A rank died: advance the restart generation so survivors park
+        at the recovery barrier, and expire the dead rank's heartbeat
+        immediately (the supervisor's waitpid beats any TTL)."""
+        with self._lock:
+            self._generation += 1
+            if dead_rank is not None:
+                self._dead[dead_rank] = (self._generation, reason,
+                                         time.time())
+                for n, (r, _t, _i) in list(self._beats.items()):
+                    if r == dead_rank:
+                        del self._beats[n]
+            return self._generation
+
+    def _abandon(self, rank) -> dict:
+        """Degrade: remove `rank` from the expected world for good. Bumps
+        the generation so parked survivors re-enter and release at the
+        smaller world size."""
+        with self._lock:
+            self._abandoned.add(rank)
+            self._generation += 1
+            for n, (r, _t, _i) in list(self._beats.items()):
+                if r == rank:
+                    del self._beats[n]
+            return self._world_info()
+
+    def _expected_ranks(self):
+        # callers hold _lock. World membership: every rank not degraded
+        # away (completed ranks KEEP their slot — done is not dead, no
+        # remap needed).
+        if self.world is None:
+            return None
+        return [r for r in range(self.world) if r not in self._abandoned]
+
+    def _awaited_ranks(self):
+        # callers hold _lock. Barrier expectation: ranks that still have
+        # work — a rank that finished cleanly must not wedge a later
+        # recovery of its peers.
+        expected = self._expected_ranks()
+        if expected is None:
+            return None
+        return [r for r in expected if r not in self._completed]
+
+    def _world_info(self):
+        # callers hold _lock
+        expected = self._expected_ranks()
+        rank_map = ({r: i for i, r in enumerate(expected)}
+                    if expected is not None else {})
+        return {"gen": self._generation,
+                "world": len(expected) if expected is not None else None,
+                "abandoned": sorted(self._abandoned),
+                "rank_map": rank_map}
+
+    def _barrier_arrive(self, name, rank, gen, steps):
+        """Arrival-barrier bookkeeping: record (rank -> verified steps)
+        for `gen`; release once every expected rank arrived. The release
+        answer is cached per generation so late/duplicate arrivals (and
+        the releases' own polls) are idempotent."""
+        now = time.time()
+        with self._lock:
+            self._beats[name] = (rank, now, self._beats.get(name, (0, 0, 0))[2])
+            if gen != self._generation:
+                # stale stamp: tell the client the real generation; it
+                # re-enters there (handles a failure DURING recovery)
+                return {"released": False, "gen": self._generation}
+            if self.world is None:
+                return {"error": "recovery barrier needs world= "
+                                 "(PADDLE_ELASTIC_WORLD)"}
+            done = self._released.get(gen)
+            if done is not None:
+                return done
+            arrived = self._arrived.setdefault(gen, {})
+            arrived[rank] = list(steps) if steps is not None else None
+            awaited = self._awaited_ranks()
+            if not set(awaited) <= set(arrived):
+                return {"released": False, "gen": self._generation}
+            # every awaited rank is here: agree on the newest step that
+            # is verified-complete on EVERY rank with an opinion
+            opinions = [set(s) for r, s in arrived.items()
+                        if s is not None and r in awaited]
+            common = set.intersection(*opinions) if opinions else set()
+            info = self._world_info()
+            info.update({"released": True,
+                         "resume_step": max(common) if common else 0})
+            self._released[gen] = info
+            return info
+
+    def _health_check(self):
+        """Health-barrier poll: released once every expected rank has a
+        FRESH heartbeat (arrivals not required — survivors don't re-run
+        process-group init when a relaunched peer does)."""
+        with self._lock:
+            awaited = self._awaited_ranks()
+            gen = self._generation
+        alive = self._alive_now()
+        alive_ranks = set(alive.values())
+        if awaited is None:
+            # no world configured: degenerate to "master reachable"
+            return {"released": True, "gen": gen, "alive": alive}
+        missing = [r for r in awaited if r not in alive_ranks]
+        return {"released": not missing, "gen": gen, "alive": alive,
+                "missing": missing}
+
     def _alive_now(self):
         now = time.time()
         with self._lock:
             snapshot = dict(self._beats)
-        return {n: r for n, (r, t) in snapshot.items()
+        return {n: r for n, (r, t, _i) in snapshot.items()
                 if now - t <= self.ttl}
 
     # -- node side ----------------------------------------------------------
+    def _call(self, msg, timeout_s: Optional[float] = None):
+        """One request/reply round trip — local when this instance hosts
+        the master, over the authenticated channel otherwise."""
+        if self._listener is not None:
+            return self._handle(msg)
+        c = self._connect(timeout_s=timeout_s)
+        try:
+            c.send(msg)
+            return c.recv()
+        finally:
+            c.close()
+
     def start_heartbeat(self):
         import threading
+        if self._heartbeating:
+            return self
+        if self._listener is None:
+            # a stopped CLIENT may restart its beats (the master's stop
+            # flag also parks its serve loop, so only clients clear it)
+            self._stop.clear()
+        self._heartbeating = True
 
         def beat():
             while not self._stop.is_set():
+                # chaos hook (ISSUE 6): `elastic.heartbeat:crash@N` kills
+                # the whole process mid-training (SIGKILL-like) at a
+                # deterministic beat; `raise` kills only this thread — a
+                # zombie worker whose beats stop (TTL-expiry drill)
+                fault_point("elastic.heartbeat")
                 try:
                     # short per-beat window: the NEXT interval retries
                     # anyway, a long stall here would skew the TTL clock
                     c = self._connect(timeout_s=min(self.interval, 2.0))
-                    c.send(("beat", self.name, self.rank))
-                    c.recv()
+                    c.send(("beat", self.name, self.rank, incarnation()))
+                    status, gen = c.recv()
                     c.close()
+                    if status == "ok" and isinstance(gen, int):
+                        self._note_gen(gen)
                 except (OSError, EOFError, ConnectionError):
                     pass
                 self._stop.wait(self.interval)
@@ -357,6 +970,122 @@ class MembershipManager:
         self._threads.append(t)
         return self
 
+    def _note_gen(self, gen: int):
+        with self._lock:
+            self._seen_gen = gen
+
+    def last_generation(self) -> Optional[int]:
+        """Most recent restart generation carried back by a heartbeat
+        reply (None until the first successful beat) — the free peer-
+        failure signal ElasticManager polls between steps."""
+        with self._lock:
+            return self._seen_gen
+
+    def generation(self) -> int:
+        """Explicit generation poll (one round trip)."""
+        status, gen = self._call(("gen",))
+        if status != "ok":
+            raise RuntimeError(f"elastic master error: {gen}")
+        self._note_gen(gen)
+        return gen
+
+    def notify_failure(self, dead_rank: Optional[int], reason: str = "") \
+            -> int:
+        """Supervisor-side: rank died — bump the generation (survivors
+        park at the recovery barrier) and expire its heartbeat. Returns
+        the new generation."""
+        status, gen = self._call(("bump", dead_rank, reason))
+        if status != "ok":
+            raise RuntimeError(f"elastic master error: {gen}")
+        return gen
+
+    def abandon(self, rank: int) -> dict:
+        """Supervisor-side: rank stayed dead past the budget — degrade
+        the world. Returns the new world info."""
+        status, info = self._call(("abandon", rank))
+        if status != "ok":
+            raise RuntimeError(f"elastic master error: {info}")
+        return info
+
+    def notify_done(self) -> None:
+        """This rank finished its training cleanly: leave the barrier
+        expectation (a peer relaunched after our exit must not park
+        forever waiting for us)."""
+        self._call(("done", self.rank))
+
+    def world_view(self) -> dict:
+        status, info = self._call(("world",))
+        if status != "ok":
+            raise RuntimeError(f"elastic master error: {info}")
+        return info
+
+    def _barrier_timeout(self, timeout):
+        if timeout is not None:
+            return float(timeout)
+        from ..framework import core
+        return float(core.get_flag("FLAGS_comm_timeout", 1800.0))
+
+    def recovery_barrier(self, steps=None, timeout: Optional[float] = None) \
+            -> dict:
+        """Park at the generation-stamped recovery barrier; returns the
+        release info {gen, resume_step, world, rank_map, ...}. `steps`
+        is this rank's verified-complete checkpoint list (None = no
+        opinion). Bounded by FLAGS_comm_timeout unless overridden."""
+        deadline = time.monotonic() + self._barrier_timeout(timeout)
+        _EL_BARRIER_WAITS.inc(1, kind="recovery", incarnation=_inc_label())
+        t0 = time.perf_counter()
+        gen = None
+        with _span("elastic.barrier", kind="recovery", rank=self.rank):
+            while True:
+                fault_point("elastic.barrier")
+                status, info = self._call(
+                    ("barrier", self.name, self.rank,
+                     gen if gen is not None else self.generation(), steps))
+                if status != "ok" or "error" in info:
+                    raise RuntimeError(f"elastic master error: {info}")
+                gen = info["gen"]
+                self._note_gen(gen)
+                if info.get("released"):
+                    _EL_BARRIER_SECONDS.observe(
+                        time.perf_counter() - t0, kind="recovery")
+                    _EL_GENERATION.set(gen, incarnation=_inc_label())
+                    return info
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"recovery barrier (generation {gen}) not "
+                        f"released within the timeout — peer rank dead "
+                        f"and not relaunched?")
+                # each poll is a full authenticated connect + a master
+                # handler thread; release latency is dominated by the
+                # relaunch/boot time anyway, so don't hammer the master
+                time.sleep(0.25)
+
+    def health_barrier(self, timeout: Optional[float] = None) -> dict:
+        """Park until every expected rank has a fresh heartbeat (the
+        preflight consulted at process-group init / on watchdog fire).
+        Returns {gen, alive, missing}; raises TimeoutError naming the
+        ranks that never came up."""
+        deadline = time.monotonic() + self._barrier_timeout(timeout)
+        _EL_BARRIER_WAITS.inc(1, kind="health", incarnation=_inc_label())
+        t0 = time.perf_counter()
+        info = {}
+        with _span("elastic.barrier", kind="health", rank=self.rank):
+            while True:
+                fault_point("elastic.barrier")
+                status, info = self._call(("hbar",))
+                if status != "ok":
+                    raise RuntimeError(f"elastic master error: {info}")
+                if info.get("released"):
+                    _EL_BARRIER_SECONDS.observe(
+                        time.perf_counter() - t0, kind="health")
+                    self._note_gen(info["gen"])
+                    return info
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"health barrier: ranks {info.get('missing')} "
+                        f"have no fresh heartbeat")
+                time.sleep(0.25)      # see recovery_barrier's cadence note
+
     def alive(self):
         """Poll the membership view {name: rank} (master or any node).
         The client connect retries with bounded exponential backoff
@@ -364,13 +1093,8 @@ class MembershipManager:
         on the first refused connection."""
         if self._listener is not None:
             return self._alive_now()
-        c = self._connect()
-        try:
-            c.send(("alive",))
-            status, view = c.recv()
-            return view
-        finally:
-            c.close()
+        status, view = self._call(("alive",))
+        return view
 
     def changed(self):
         """True when membership (names AND ranks) differs from the last
@@ -383,7 +1107,20 @@ class MembershipManager:
 
     def stop(self):
         self._stop.set()
+        self._heartbeating = False
         if self._listener is not None:
+            # a blocked accept() is NOT interrupted by close() on
+            # Linux — the serve thread would sit on the dead (and
+            # eventually reused) fd forever. Wake it with one dummy
+            # connect (the failed handshake lands in the accept-loop's
+            # except, which sees _stop and exits), THEN close.
+            import socket as _socket
+            try:
+                s = _socket.create_connection(
+                    self._addr(self.master_endpoint), timeout=0.5)
+                s.close()
+            except OSError:
+                pass
             try:
                 self._listener.close()
             except OSError:

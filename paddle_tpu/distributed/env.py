@@ -26,6 +26,13 @@ def init_parallel_env(strategy=None):
                                os.environ.get("JAX_NUM_PROCESSES", "1")))
     pid = int(os.environ.get("PADDLE_TRAINER_ID",
                              os.environ.get("JAX_PROCESS_ID", "0")))
+    # preflight health barrier (ISSUE 6): under a supervising launcher,
+    # refuse to walk into the rendezvous (which would hang indefinitely)
+    # until every expected rank has a fresh heartbeat — a dead peer
+    # surfaces as a TimeoutError naming its rank instead. No-op (one env
+    # lookup) when unsupervised.
+    from . import collective
+    collective.health_barrier("init")
     if coord and nproc > 1:
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=nproc, process_id=pid)

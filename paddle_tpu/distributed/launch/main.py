@@ -8,7 +8,24 @@ controller's job is to export the jax.distributed bootstrap env
 (coordinator address, process id/count — replacing PADDLE_TRAINER_ID/
 ENDPOINTS + TCPStore rendezvous) and exec the training script, restarting
 it on failure up to --max_restart times (the reference's watcher/elastic
-relaunch, SURVEY §5)."""
+relaunch, SURVEY §5).
+
+`--elastic_level 1` (ISSUE 6) turns the restart loop into a real
+SUPERVISOR: each rank runs as a supervised child carrying a
+per-incarnation id (PADDLE_INCARNATION) and — when flight recording is
+configured — a per-incarnation FLAGS_flight_recorder file, so the
+post-mortem of relaunch N never overwrites relaunch N-1. The rank-0
+supervisor hosts the master-side MembershipManager (heartbeat registry
++ restart generation + recovery/health barriers, distributed/elastic).
+On a worker death (any rc: ELASTIC_EXIT_CODE, SIGKILL, preemption) the
+supervisor bumps the restart GENERATION — survivors park at the
+recovery barrier instead of deadlocking in a half-dead collective —
+and relaunches ONLY that rank. A rank that exhausts --max_restart and
+stays dead past --degrade_after seconds is ABANDONED: the master
+shrinks the expected world and survivors re-form at the smaller world
+size (degraded-world resharding) rather than the whole job aborting.
+Every transition is appended to <log_dir>/supervisor_flight.jsonl,
+naming the dead rank, rc, incarnation and generation."""
 from __future__ import annotations
 
 import argparse
@@ -39,7 +56,21 @@ def _parse(argv):
     p.add_argument("--log_dir", default=None)
     p.add_argument("--devices", default=None,
                    help="visible TPU chips, e.g. '0,1,2,3'")
-    p.add_argument("--elastic_level", type=int, default=0)
+    p.add_argument("--elastic_level", type=int, default=0,
+                   help=">=1 enables the coordinated supervisor: "
+                        "per-rank supervised children, rank-only "
+                        "relaunch, restart generations + recovery "
+                        "barriers (0 = legacy whole-process restart)")
+    p.add_argument("--elastic_endpoint", default=None,
+                   help="master endpoint of the elastic control plane "
+                        "(default: PADDLE_ELASTIC_ENDPOINT env, else "
+                        "--master host at port+1, else 127.0.0.1:18814)")
+    p.add_argument("--degrade_after", type=float, default=None,
+                   help="seconds a rank may stay dead after exhausting "
+                        "--max_restart before the job DEGRADES to the "
+                        "surviving world instead of failing (default: "
+                        "never degrade — restarts exhausted fails the "
+                        "job, the legacy policy)")
     p.add_argument("--auto_tuner_json", default=None,
                    help="ref distributed/launch + auto_tuner: JSON config "
                         "driving a launch-level grid search — each pruned "
@@ -148,11 +179,212 @@ def _auto_tune(args, env):
     return best
 
 
+# -- coordinated supervisor (--elastic_level >= 1, ISSUE 6) ------------------
+
+def _elastic_endpoint(args, env):
+    # explicit CLI flag wins over inherited env (the help text's
+    # "default" chain applies only when the flag is absent)
+    ep = args.elastic_endpoint or env.get("PADDLE_ELASTIC_ENDPOINT")
+    if ep:
+        return ep
+    if args.master:
+        host, port = args.master.rsplit(":", 1)
+        return f"{host}:{int(port) + 1}"
+    return "127.0.0.1:18814"
+
+
+def _child_env(env, args, rank, world, inc, ep):
+    """Env for one supervised child: paddle/jax rank bookkeeping, the
+    elastic control-plane coordinates, a per-incarnation id, and — when
+    flight recording is configured (FLAGS_flight_recorder base or
+    --log_dir) — a per-incarnation flight-recorder file so relaunch N's
+    post-mortem never overwrites relaunch N-1's."""
+    ce = dict(env)
+    ce["PADDLE_TRAINER_ID"] = str(rank)
+    ce["PADDLE_TRAINERS_NUM"] = str(world)
+    ce["PADDLE_ELASTIC_ENDPOINT"] = ep
+    ce["PADDLE_ELASTIC_SUPERVISED"] = "1"
+    ce["PADDLE_ELASTIC_WORLD"] = str(world)
+    ce["PADDLE_INCARNATION"] = str(inc)
+    if args.master:
+        ce["JAX_COORDINATOR_ADDRESS"] = args.master
+        ce["JAX_NUM_PROCESSES"] = str(world)
+        ce["JAX_PROCESS_ID"] = str(rank)
+    base = ce.get("FLAGS_flight_recorder") or (
+        os.path.join(args.log_dir, "flight") if args.log_dir else "")
+    if base:
+        ce["FLAGS_flight_recorder"] = f"{base}.rank{rank}.inc{inc}.jsonl"
+    return ce
+
+
+def _sup_record(args, record):
+    """Supervisor-side flight record (append-only JSONL, stdlib only —
+    the launcher must not drag the telemetry stack / jax into its own
+    process). Names the failed rank, rc, incarnation and generation for
+    every death/relaunch/degrade transition."""
+    if not args.log_dir:
+        return
+    import json
+    try:
+        os.makedirs(args.log_dir, exist_ok=True)
+        path = os.path.join(args.log_dir, "supervisor_flight.jsonl")
+        with open(path, "a") as f:
+            f.write(json.dumps(dict(record, ts=time.time())) + "\n")
+            f.flush()
+    except OSError:
+        pass        # forensics must not kill the supervisor
+
+
+def _supervise(args, env):
+    """Run this node's ranks as supervised children; relaunch ONLY the
+    rank that died (broadcasting a restart generation so survivors park
+    at the recovery barrier), degrade the world when a rank stays dead
+    past the budget. Returns the job's exit code."""
+    from paddle_tpu.distributed.elastic import MembershipManager
+    from paddle_tpu.utils.fault_injection import fault_point
+
+    nproc = max(1, args.nproc_per_node)
+    world = args.nnodes * nproc
+    ep = _elastic_endpoint(args, env)
+    env = dict(env)
+    env["PADDLE_ELASTIC_ENDPOINT"] = ep
+    # the in-process master/client must share the children's channel
+    # secret: _bootstrap_env minted PADDLE_JOB_AUTHKEY into the CHILD
+    # env only, while derive_authkey reads this process's os.environ
+    if env.get("PADDLE_JOB_AUTHKEY"):
+        os.environ["PADDLE_JOB_AUTHKEY"] = env["PADDLE_JOB_AUTHKEY"]
+    mm = MembershipManager(master_endpoint=ep,
+                           name=f"_supervisor{args.rank}", rank=-1,
+                           world=world)
+    if args.rank == 0:
+        mm.start_master()
+    local_ranks = [args.rank * nproc + j for j in range(nproc)]
+    procs = {}
+    inc = {r: 0 for r in local_ranks}         # incarnation ids
+    restarts = {r: 0 for r in local_ranks}
+    status = {r: "running" for r in local_ranks}
+    dead_since = {}
+    rc_last = 1
+
+    def spawn(r):
+        try:
+            fault_point("launch.spawn")
+            ce = _child_env(env, args, r, world, inc[r], ep)
+            logf = None
+            if args.log_dir:
+                os.makedirs(args.log_dir, exist_ok=True)
+                logf = open(os.path.join(
+                    args.log_dir, f"worker.rank{r}.inc{inc[r]}.log"), "ab")
+            try:
+                return subprocess.Popen(
+                    [sys.executable, args.script] + args.script_args,
+                    env=ce, stdout=logf, stderr=logf)
+            finally:
+                if logf is not None:
+                    logf.close()     # the child keeps its own fd
+        except Exception as e:       # spawn failure == instant death
+            print(f"launch: spawn of rank {r} failed: {e}",
+                  file=sys.stderr)
+            _sup_record(args, {"ev": "spawn_failed", "rank": r,
+                               "incarnation": inc[r], "error": repr(e)})
+            return None
+
+    def notify_bump(r, rc):
+        try:
+            return mm.notify_failure(r, reason=f"rc={rc}")
+        except Exception as e:
+            print(f"launch: generation bump for dead rank {r} failed: "
+                  f"{e}", file=sys.stderr)
+            return None
+
+    for r in local_ranks:
+        _sup_record(args, {"ev": "spawn", "rank": r, "incarnation": 0})
+        procs[r] = spawn(r)
+
+    while any(st == "running" for st in status.values()):
+        time.sleep(0.15)
+        for r in local_ranks:
+            if status[r] != "running":
+                continue
+            p = procs[r]
+            rc = 1 if p is None else p.poll()
+            if rc is None:
+                continue                     # still alive
+            if rc == 0:
+                status[r] = "done"
+                _sup_record(args, {"ev": "worker_done", "rank": r,
+                                   "incarnation": inc[r]})
+                continue
+            rc_last = rc
+            now = time.time()
+            if r not in dead_since:          # first notice of THIS death
+                dead_since[r] = now
+                gen = notify_bump(r, rc)
+                print(f"launch: rank {r} died rc={rc} "
+                      f"(incarnation {inc[r]}, generation {gen})",
+                      file=sys.stderr)
+                _sup_record(args, {"ev": "worker_death", "rank": r,
+                                   "rc": rc, "incarnation": inc[r],
+                                   "generation": gen})
+            if restarts[r] < args.max_restart:
+                restarts[r] += 1
+                inc[r] += 1
+                print(f"launch: relaunching ONLY rank {r} "
+                      f"(incarnation {inc[r]}, restart "
+                      f"{restarts[r]}/{args.max_restart})",
+                      file=sys.stderr)
+                _sup_record(args, {"ev": "relaunch", "rank": r,
+                                   "incarnation": inc[r],
+                                   "restart": restarts[r]})
+                procs[r] = spawn(r)
+                if procs[r] is not None:
+                    dead_since.pop(r, None)
+            elif args.degrade_after is not None:
+                if now - dead_since[r] >= args.degrade_after:
+                    try:
+                        info = mm.abandon(r)
+                    except Exception as e:
+                        # the master must LEARN about the abandonment or
+                        # survivors wait for this rank until their
+                        # barrier timeout — keep the rank 'running' so
+                        # the next 0.15s poll retries the notification
+                        print(f"launch: degrade notification for rank "
+                              f"{r} failed ({e!r}); retrying",
+                              file=sys.stderr)
+                        continue
+                    status[r] = "abandoned"
+                    print(f"launch: rank {r} dead past budget — "
+                          f"DEGRADING world: {info}", file=sys.stderr)
+                    _sup_record(args, {"ev": "degrade", "rank": r,
+                                       "incarnation": inc[r],
+                                       "world": info.get("world"),
+                                       "generation": info.get("gen")})
+            else:
+                # legacy policy: restarts exhausted fails the whole job
+                print(f"launch: rank {r} failed rc={rc}, restarts "
+                      f"exhausted", file=sys.stderr)
+                for r2 in local_ranks:
+                    p2 = procs.get(r2)
+                    if status[r2] == "running" and p2 is not None \
+                            and p2.poll() is None:
+                        p2.kill()
+                        p2.wait()
+                mm.stop()
+                return rc
+
+    mm.stop()
+    if any(st == "done" for st in status.values()):
+        return 0            # abandoned ranks don't fail a degraded job
+    return rc_last
+
+
 def launch(argv=None):
     args = _parse(argv if argv is not None else sys.argv[1:])
     env = _bootstrap_env(args)
     if args.auto_tuner_json:
         _auto_tune(args, env)
+    if args.elastic_level and args.elastic_level >= 1:
+        return _supervise(args, env)
     cmd = [sys.executable, args.script] + args.script_args
     restarts = 0
     while True:

@@ -250,10 +250,31 @@ class ShardingPlan:
         self.stage = stage
         self.param_rules = param_rules or {}
         self.pspecs: Dict[str, P] = {}  # model-annotated TP layouts (p.pspec)
+        self._requested_data_axes = tuple(data_axes)  # pre-filter (remesh)
         self.data_axes = tuple(a for a in data_axes if a in mesh.axis_names
                                and mesh.shape[a] > 1) or tuple(
                                    a for a in data_axes if a in mesh.axis_names)
         self.shard_min_size = shard_min_size
+
+    def remesh(self, mesh: Mesh) -> "ShardingPlan":
+        """Re-derive this plan over a DIFFERENT (usually smaller) mesh —
+        the degraded-world path of coordinated elastic recovery
+        (ISSUE 6): when a rank is abandoned and survivors re-form at the
+        smaller world size, the same stage/rules/annotations are
+        re-applied over the shrunk mesh. Axis names absent from (or
+        trivial on) the new mesh fall out of every spec through the
+        existing `_valid_axes`/`data_axes` filtering; a re-`materialize`
+        (or the next TrainStep compile, which keys its cache on shapes
+        and tree structure) then places arrays in the new layout.
+        Returns a NEW plan; the original keeps serving the old mesh."""
+        plan = ShardingPlan(mesh, stage=self.stage,
+                            param_rules=dict(self.param_rules),
+                            data_axes=self._requested_data_axes,
+                            shard_min_size=self.shard_min_size)
+        plan.pspecs = dict(self.pspecs)
+        if hasattr(self, "_pid_to_name"):
+            plan._pid_to_name = dict(self._pid_to_name)
+        return plan
 
     def attach_model(self, model):
         """Collect per-parameter PartitionSpec annotations (TP layouts set by

@@ -37,6 +37,30 @@ _WD_TIMEOUTS = _m.counter("watchdog.timeouts_total",
                           "watchdog sections that overran their timeout")
 
 
+def _suspect_peers() -> str:
+    """Under a supervising launcher (PADDLE_ELASTIC_SUPERVISED), ask the
+    elastic master which expected ranks have NO fresh heartbeat — the
+    likely culprits behind a hung step. Bounded (2s) and best-effort:
+    the monitor thread must fire its warning/abort regardless. Returns
+    '' when unsupervised or nothing is known."""
+    if not os.environ.get("PADDLE_ELASTIC_SUPERVISED"):
+        return ""
+    try:
+        from .collective import _membership_client
+        status, info = _membership_client()._call(("hbar",), timeout_s=2.0)
+        if status == "ok" and info.get("missing"):
+            return (f"; elastic master reports rank(s) "
+                    f"{info['missing']} with no fresh heartbeat "
+                    f"(generation {info.get('gen')})")
+        if status == "ok":
+            return (f"; elastic master reports all expected ranks alive "
+                    f"(generation {info.get('gen')}) — suspect a "
+                    f"data/compile stall, not a dead peer")
+    except Exception:
+        pass
+    return ""
+
+
 class CommWatchdog:
     """Times named critical sections; fires on overrun.
 
@@ -91,6 +115,14 @@ class CommWatchdog:
                        f"after {elapsed:.0f}s (timeout {self.timeout:.0f}s) "
                        f"on rank {rank} — likely peer desync, preemption, "
                        "or a hung collective")
+                # ISSUE 6: under a supervising launcher, consult the
+                # elastic master's health view so the hang converts to a
+                # DETECTED failure naming the dead peer(s) in the log
+                # and flight dump (disarmed: one env lookup). One poll,
+                # reused — a slow master must not double its bounded
+                # stall in the monitor thread.
+                suspects = _suspect_peers()
+                msg += suspects
                 self._log(msg)
                 # post-mortem artifact BEFORE any abort: a hung trainer
                 # leaves a flight-recorder dump naming the stuck section,
@@ -99,7 +131,7 @@ class CommWatchdog:
                     from ..observability.export import flight_dump
                     flight_dump(f"watchdog:{name} after {elapsed:.0f}s "
                                 f"(timeout {self.timeout:.0f}s, "
-                                f"rank {rank})")
+                                f"rank {rank}){suspects}")
                 except Exception:
                     pass    # telemetry must not kill the monitor
                 if self.on_fire is not None:

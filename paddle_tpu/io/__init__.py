@@ -312,6 +312,22 @@ class BatchSampler(Sampler):
         return (n + self.batch_size - 1) // self.batch_size
 
 
+def _all_gather_seeds(base: int):
+    """Every process's shuffle base seed (list, process-indexed), or
+    None when there is nothing to compare against (single process).
+    Module-level seam so tests can monkeypatch the exchange; the real
+    path rides collective.all_gather_object over the job's coordination
+    service. Called unconditionally by every rank of the group (a
+    collective gated per-rank would itself deadlock)."""
+    import jax
+    if jax.process_count() <= 1:
+        return None
+    from ..distributed import collective
+    seeds: list = []
+    collective.all_gather_object(seeds, int(base))
+    return seeds
+
+
 class DistributedBatchSampler(BatchSampler):
     """Per-rank shard of the index space (ref:
     python/paddle/io/dataloader/batch_sampler.py::DistributedBatchSampler)."""
@@ -335,8 +351,46 @@ class DistributedBatchSampler(BatchSampler):
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.epoch = 0
+        self._seed_checked = False
         self.num_samples = int(np.ceil(len(dataset) / self.nranks))
         self.total_size = self.num_samples * self.nranks
+
+    def update_world(self, num_replicas: int, rank: int):
+        """Reshard this sampler onto a DIFFERENT world (coordinated
+        elastic recovery, ISSUE 6): after a degraded-world barrier
+        release, survivors re-slice the index space over the surviving
+        `num_replicas` with their remapped `rank`. The shuffle base seed
+        is unchanged (it was rank-constant by contract), so the global
+        permutation stays identical — only the per-rank slice moves.
+        The seed-consensus check is DISABLED from here on: it is a
+        whole-world collective (all_gather over jax.process_count()),
+        and in a degraded world the abandoned rank would never arrive —
+        the very deadlock this path exists to avoid. Degrade does not
+        change the seed, so whatever consensus held (or would have
+        held) still does."""
+        self.nranks = int(num_replicas)
+        self.local_rank = int(rank)
+        self._seed_checked = True
+        self.num_samples = int(np.ceil(len(self.dataset) / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def _check_seed_consensus(self, base):
+        """Rank-divergent shuffle-seed detection (ISSUE 5 follow-on):
+        under an active multi-process group, all_gather the base seed
+        ONCE and raise on mismatch — divergent per-rank permutations
+        silently overlap/miss rows otherwise. Single-process jobs (and
+        re-checks after the first) cost one bool."""
+        if self._seed_checked:
+            return
+        self._seed_checked = True
+        seeds = _all_gather_seeds(base)
+        if seeds is not None and len(set(seeds)) > 1:
+            raise RuntimeError(
+                "DistributedBatchSampler: shuffle base seed differs "
+                f"across ranks ({seeds}) — per-rank permutations would "
+                "diverge and shards silently overlap/miss rows. Call "
+                "paddle.seed with the SAME value on every rank, or pass "
+                "a rank-constant seed= to the sampler.")
 
     def __iter__(self):
         n = len(self.dataset)
@@ -348,6 +402,7 @@ class DistributedBatchSampler(BatchSampler):
             # while epochs differ
             base = self.seed if self.seed is not None \
                 else core.data_seed("distributed_batch_sampler")
+            self._check_seed_consensus(0 if base is None else int(base))
             rng = np.random.RandomState(
                 ((0 if base is None else base) + self.epoch) & 0xFFFFFFFF)
             rng.shuffle(indices)

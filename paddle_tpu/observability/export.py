@@ -203,6 +203,20 @@ def stop_metrics_server() -> None:
 
 # -- crash flight recorder ---------------------------------------------------
 
+def _identity() -> dict:
+    """Rank + incarnation stamped on every flight-recorder start/dump
+    record (ISSUE 6): a chaos post-mortem must name WHICH rank's WHICH
+    relaunch died without correlating pids against the supervisor log."""
+    out = {}
+    rank = os.environ.get("PADDLE_TRAINER_ID")
+    if rank is not None:
+        out["rank"] = rank
+    inc = os.environ.get("PADDLE_INCARNATION")
+    if inc is not None:
+        out["incarnation"] = inc
+    return out
+
+
 class _FlightRecorder:
     """Append-only JSONL event log with write-through span events and
     on-demand `dump` records. The file handle stays open for the process
@@ -219,7 +233,7 @@ class _FlightRecorder:
         # same thread must not deadlock the dying process
         self._wlock = threading.RLock()
         self._write({"ev": "flight_recorder_start", "ts": time.time(),
-                     "pid": os.getpid()})
+                     "pid": os.getpid(), **_identity()})
         spans.add_sink(self._on_span)
 
     def _on_span(self, ev: dict) -> None:
@@ -244,7 +258,7 @@ class _FlightRecorder:
 
     def dump(self, reason: str) -> None:
         self._write({"ev": "dump", "reason": reason, "ts": time.time(),
-                     "pid": os.getpid(),
+                     "pid": os.getpid(), **_identity(),
                      "open_spans": spans.open_spans(),
                      "ring_tail": spans.ring()[-64:],
                      "metrics": metrics.snapshot()})

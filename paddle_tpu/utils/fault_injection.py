@@ -35,7 +35,14 @@ Hit/trigger counters are exposed through
 telemetry. Known points (grep ``fault_point(`` for the live list):
 ``ckpt.write_shard``, ``ckpt.write_meta``, ``ckpt.write_index``,
 ``elastic.train_step``, ``elastic.restore``, ``rpc.connect``,
-``io.save``.
+``io.save``, ``static.save_model``, ``static.save_params``,
+``onnx.export``, and the coordinated-recovery plane (ISSUE 6):
+``elastic.heartbeat`` (in the per-beat loop — ``crash`` kills the whole
+worker mid-training like a preemption, ``raise`` kills only the beat
+thread, simulating a zombie whose TTL expires), ``elastic.barrier``
+(each recovery/health-barrier poll), ``elastic.connect`` (the
+authenticated client connect), and ``launch.spawn`` (the supervisor's
+per-incarnation worker spawn).
 """
 from __future__ import annotations
 

@@ -1,0 +1,107 @@
+"""Coordinated-recovery chaos worker (ISSUE 6).
+
+Launched under the SUPERVISOR (`paddle_tpu.distributed.launch
+--elastic_level 1 --nproc_per_node N`): each rank runs a deterministic
+training loop through a supervised ElasticManager (membership=True —
+resolved from the supervisor's env). The designated fault rank arms the
+PR 2 fault grammar on its FIRST incarnation only (e.g.
+`elastic.heartbeat:crash@K`), so it dies mid-run exactly once; the
+supervisor must relaunch ONLY that rank, survivors must park at the
+recovery barrier and every rank must finish with weights bitwise equal
+to an uninterrupted run (the per-step update is exact dyadic float32
+arithmetic: w += (step+1) * 0.25, so any skipped or double-applied step
+shows).
+
+argv: out_dir total_steps [fault_rank fault_spec]
+Writes done_{rank}_{pid}.json with the final restored weights, the
+world-change events, the last seen generation and a metrics snapshot.
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import distributed as dist
+from paddle_tpu.distributed.elastic import ElasticManager, incarnation
+from paddle_tpu.io import DistributedBatchSampler
+
+
+def main():
+    out_dir = sys.argv[1]
+    total = int(sys.argv[2])
+    fault_rank = int(sys.argv[3]) if len(sys.argv) > 3 else -1
+    fault_spec = sys.argv[4] if len(sys.argv) > 4 else ""
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    inc = incarnation()
+
+    # pid marker per incarnation: the test asserts rank-only relaunch
+    with open(os.path.join(out_dir, f"pid_{rank}_inc{inc}"), "w") as f:
+        f.write(str(os.getpid()))
+
+    if rank == fault_rank and inc == 0 and fault_spec:
+        paddle.set_flags({"FLAGS_fault_inject": fault_spec})
+
+    # preflight health barrier rides process-group init (no jax
+    # coordinator here — the host-level control plane is what's tested)
+    dist.init_parallel_env()
+
+    # degraded-world resharding target: this rank's slice of the index
+    # space; update_world re-slices it when the barrier shrinks the world
+    dataset = list(range(16))
+    sampler = DistributedBatchSampler(dataset, batch_size=1,
+                                      num_replicas=world, rank=rank,
+                                      shuffle=False)
+    events = []
+
+    def on_world_change(new_world, new_rank):
+        events.append({"world": new_world, "rank": new_rank})
+        sampler.update_world(new_world, new_rank)
+
+    em = ElasticManager(os.path.join(out_dir, f"ckpt_{rank}"),
+                        save_interval=1, keep=50, max_restarts=1,
+                        backoff_base=0.05, membership=True,
+                        on_world_change=on_world_change)
+
+    def make_state():
+        return {"w": paddle.to_tensor(np.zeros(4, np.float32))}
+
+    def train_step(state, step):
+        # exact dyadic update: bitwise-reproducible across replays
+        state["w"].data = state["w"].data + (step + 1) * 0.25
+        time.sleep(0.05)
+        return float(step)
+
+    with open(os.path.join(out_dir,
+                           f"start_{rank}_inc{inc}"), "w") as f:
+        f.write("ok")
+    losses = em.run(make_state, train_step, total_steps=total)
+
+    final = make_state()
+    final_step = em.restore(final)
+    mm = em.membership
+    snap = paddle.observability.snapshot() \
+        if os.environ.get("FLAGS_metrics") else {}
+    out = {"rank": rank, "incarnation": inc,
+           "final_step": final_step,
+           "w": np.asarray(final["w"].numpy()).tolist(),
+           "losses_len": len(losses),
+           "events": events,
+           "generation": mm.last_generation() if mm else None,
+           "my_indices": [i for b in sampler for i in b],
+           "counters": snap.get("counters", {})}
+    path = os.path.join(out_dir, f"done_{rank}_{os.getpid()}.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump(out, f)
+    os.replace(path + ".tmp", path)
+    print(f"rank {rank} inc {inc} done at gen "
+          f"{out['generation']}")
+
+
+if __name__ == "__main__":
+    main()

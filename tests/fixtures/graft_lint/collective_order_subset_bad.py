@@ -1,0 +1,35 @@
+"""Known-bad fixture for collective-order GROUP-SUBSET awareness: a
+membership guard only legalizes collectives on THAT group."""
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.collective import all_reduce
+
+
+def wrong_group(t, rank, group, other):
+    if rank in group.ranks:
+        dist.all_reduce(t, group=other)        # gated on a DIFFERENT group
+    return t
+
+
+def no_group(t, rank, group):
+    if rank in group.ranks:
+        all_reduce(t)                          # world collective, subset gate
+    return t
+
+
+def mixed_plain_rank(t, rank, group):
+    if rank in group.ranks:
+        if rank == 0:
+            dist.all_reduce(t, group=group)    # plain rank gate inside
+    return t
+
+
+def member_early_return(t, rank, group):
+    if rank in group.ranks:
+        return t                               # MEMBERS leave early
+    return all_reduce(t, group=group)          # group is split: deadlock
+
+
+def other_guard_then_collective(t, rank, g1, g2):
+    if rank not in g1.ranks:
+        return t
+    return dist.all_reduce(t, group=g2)        # g2 split by g1's return

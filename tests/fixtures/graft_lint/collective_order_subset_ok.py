@@ -1,0 +1,30 @@
+"""Known-good fixture for collective-order GROUP-SUBSET awareness
+(ISSUE 6): collectives gated on membership of the group they name are
+legal — every rank of that group reaches them."""
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.collective import all_reduce
+
+
+def subgroup_reduce(t, rank, group):
+    if rank in group.ranks:
+        dist.all_reduce(t, group=group)        # legal: gated on itself
+    return t
+
+
+def non_member_early_return(t, rank, group):
+    if rank not in group.ranks:
+        return t                               # only non-members leave
+    return all_reduce(t, group=group)          # members all still here
+
+
+def nested_same_group(t, rank, group):
+    if rank in group.ranks:
+        if rank in group.ranks:                # redundant but consistent
+            dist.all_gather([], t, group=group)
+    return t
+
+
+def process_ids_alias(t, rank, mp_group):
+    if rank in mp_group.process_ids:
+        dist.broadcast(t, src=0, group=mp_group)
+    return t

@@ -1,0 +1,793 @@
+"""Coordinated elastic recovery (ISSUE 6).
+
+Covers: the master-side coordination plane (restart generations,
+recovery/health barriers, newest-common-checkpoint agreement, degrade),
+the supervised ElasticManager loop (peer-failure parking, local-fault
+restore, degraded-world callbacks), the launch supervisor (rank-only
+relaunch, per-incarnation ids + flight-recorder files, launch.spawn
+fault point, degrade budget), the background checksum scrubber, sampler
+resharding + rank-divergent seed detection, ShardingPlan.remesh, and —
+the acceptance scenario — a subprocess chaos run where one rank is
+killed mid-step and the job recovers without whole-job relaunch,
+bitwise-equal to an uninterrupted run.
+"""
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import collective
+from paddle_tpu.distributed.elastic import (
+    CheckpointScrubber, ElasticManager, MembershipManager)
+from paddle_tpu.io import DistributedBatchSampler
+from paddle_tpu.utils import fault_injection as fi
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+COLL = REPO / "tests" / "collective"
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(autouse=True)
+def _bounded_and_disarmed(monkeypatch):
+    """Every barrier in this module is bounded (a wedged barrier must
+    fail the test, not hang the suite) and faults are disarmed after."""
+    monkeypatch.setenv("FLAGS_comm_timeout", "30")
+    monkeypatch.setenv("PADDLE_ELASTIC_CONNECT_TIMEOUT", "5")
+    yield
+    fi.configure(None)
+
+
+def _master(world, port=None):
+    ep = f"127.0.0.1:{port or _free_port()}"
+    return MembershipManager(master_endpoint=ep, name="_master", rank=-1,
+                             world=world).start_master(), ep
+
+
+def _state_factory():
+    def make_state():
+        return {"w": paddle.to_tensor(np.zeros(4, np.float32))}
+    return make_state
+
+
+def _exact_step(state, step):
+    # exact dyadic float32 update: bitwise-reproducible across replays,
+    # and any skipped/double-applied step changes the sum
+    state["w"].data = state["w"].data + (step + 1) * 0.25
+    return float(step)
+
+
+def _expected_w(total):
+    return np.full(4, total * (total + 1) / 2 * 0.25, np.float32)
+
+
+# -- master-side coordination plane ------------------------------------------
+
+class TestCoordinationPlane:
+    def test_barrier_agreement_is_newest_common_step(self):
+        master, ep = _master(world=2)
+        try:
+            m0 = MembershipManager(ep, rank=0, interval=0.05)
+            m1 = MembershipManager(ep, rank=1, interval=0.05)
+            out = {}
+
+            def enter(mm, steps, key):
+                out[key] = mm.recovery_barrier(steps=steps, timeout=10)
+
+            t0 = threading.Thread(
+                target=enter, args=(m0, [1, 2, 3], 0), daemon=True)
+            t1 = threading.Thread(
+                target=enter, args=(m1, [2, 3, 4], 1), daemon=True)
+            t0.start(), t1.start()
+            t0.join(15), t1.join(15)
+            assert out[0]["released"] and out[1]["released"]
+            # newest step BOTH ranks hold verified-complete
+            assert out[0]["resume_step"] == 3 == out[1]["resume_step"]
+            assert out[0]["world"] == 2
+            assert out[0]["rank_map"] == {0: 0, 1: 1}
+            assert out[0]["gen"] == 0
+        finally:
+            master.stop()
+
+    def test_bump_moves_generation_and_beats_carry_it(self):
+        master, ep = _master(world=2)
+        try:
+            mm = MembershipManager(ep, rank=0, interval=0.05)
+            mm.start_heartbeat()
+            deadline = time.time() + 5
+            while mm.last_generation() != 0 and time.time() < deadline:
+                time.sleep(0.02)
+            assert mm.last_generation() == 0
+            gen = master._bump(1, "rc=137")
+            assert gen == 1
+            # the dead rank's heartbeat is expired IMMEDIATELY (the
+            # supervisor's waitpid beats any TTL)
+            assert 1 not in set(master._alive_now().values())
+            deadline = time.time() + 5
+            while mm.last_generation() != 1 and time.time() < deadline:
+                time.sleep(0.02)
+            assert mm.last_generation() == 1    # carried by a beat reply
+            mm.stop()
+        finally:
+            master.stop()
+
+    def test_stale_generation_barrier_reenters_at_current(self):
+        master, ep = _master(world=1)
+        try:
+            master._bump(None, "relaunch")      # gen -> 1
+            mm = MembershipManager(ep, rank=0, interval=0.05)
+            rel = mm.recovery_barrier(steps=[5], timeout=10)
+            assert rel["released"] and rel["gen"] == 1
+            assert rel["resume_step"] == 5
+        finally:
+            master.stop()
+
+    def test_abandon_shrinks_world_and_remaps_ranks(self):
+        master, ep = _master(world=3)
+        try:
+            info = master._abandon(1)
+            assert info["world"] == 2
+            assert info["abandoned"] == [1]
+            # survivors get CONTIGUOUS new ranks
+            assert info["rank_map"] == {0: 0, 2: 1}
+        finally:
+            master.stop()
+
+    def test_done_rank_not_awaited_by_later_barriers(self):
+        master, ep = _master(world=2)
+        try:
+            mm0 = MembershipManager(ep, rank=0, interval=0.05)
+            mm0.notify_done()
+            master._bump(1, "rc=137")
+            mm1 = MembershipManager(ep, rank=1, interval=0.05)
+            # releases with only rank 1 arriving: rank 0 finished already
+            rel = mm1.recovery_barrier(steps=[7], timeout=10)
+            assert rel["released"] and rel["resume_step"] == 7
+        finally:
+            master.stop()
+
+    def test_health_barrier_waits_for_fresh_heartbeats(self):
+        master, ep = _master(world=2)
+        try:
+            mm0 = MembershipManager(ep, rank=0, interval=0.05)
+            mm0.start_heartbeat()
+            with pytest.raises(TimeoutError, match=r"\[1\]"):
+                mm0.health_barrier(timeout=0.6)
+            mm1 = MembershipManager(ep, rank=1, interval=0.05)
+            mm1.start_heartbeat()
+            info = mm0.health_barrier(timeout=10)
+            assert info["released"] and info["missing"] == []
+            mm0.stop(), mm1.stop()
+        finally:
+            master.stop()
+
+    def test_barrier_fault_point_fires(self):
+        master, ep = _master(world=1)
+        try:
+            mm = MembershipManager(ep, rank=0, interval=0.05)
+            fi.configure("elastic.barrier:raise@1")
+            with pytest.raises(fi.FaultInjected):
+                mm.recovery_barrier(steps=[], timeout=5)
+            fi.configure(None)
+            assert mm.recovery_barrier(steps=[], timeout=10)["released"]
+        finally:
+            master.stop()
+
+    def test_heartbeat_raise_kills_only_beat_thread(self):
+        """`elastic.heartbeat:raise` simulates a ZOMBIE: the process
+        lives but its beats stop, so the master's alive view loses it
+        after the TTL."""
+        ep = f"127.0.0.1:{_free_port()}"
+        master = MembershipManager(master_endpoint=ep, name="_master",
+                                   rank=-1, world=1,
+                                   ttl=0.4).start_master()
+        try:
+            mm = MembershipManager(ep, rank=0, interval=0.05, ttl=0.4)
+            fi.configure("elastic.heartbeat:raise@3")
+            mm.start_heartbeat()
+            deadline = time.time() + 5
+            while 0 not in set(master._alive_now().values()) \
+                    and time.time() < deadline:
+                time.sleep(0.02)
+            assert 0 in set(master._alive_now().values())
+            deadline = time.time() + 5
+            while 0 in set(master._alive_now().values()) \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            assert 0 not in set(master._alive_now().values()), \
+                "zombie's stale beat never TTL-expired"
+            mm.stop()
+        finally:
+            master.stop()
+            fi.configure(None)
+
+
+# -- supervised ElasticManager loop ------------------------------------------
+
+class TestSupervisedManager:
+    def test_peer_failure_parks_and_resumes_coordinated(self, tmp_path):
+        """A generation bump mid-run makes BOTH ranks park at the
+        recovery barrier, restore the agreed step, and finish with
+        exact weights — no restart budget burned."""
+        master, ep = _master(world=2)
+        total = 16
+        results, probes = {}, {}
+        try:
+            def run_rank(rank):
+                mm = MembershipManager(ep, rank=rank, interval=0.05,
+                                       world=2)
+                em = ElasticManager(str(tmp_path / f"ck{rank}"),
+                                    save_interval=1, keep=50,
+                                    max_restarts=0, membership=mm)
+
+                def step(state, s):
+                    time.sleep(0.05)
+                    return _exact_step(state, s)
+
+                results[rank] = em.run(_state_factory(), step, total)
+                probe = _state_factory()()
+                em.restore(probe)
+                probes[rank] = np.asarray(probe["w"].numpy())
+
+            threads = [threading.Thread(target=run_rank, args=(r,),
+                                        daemon=True) for r in (0, 1)]
+            for t in threads:
+                t.start()
+            # bump only once BOTH ranks demonstrably checkpointed a few
+            # steps (a blind sleep races the initial barrier and jit
+            # warmup and lands the bump before training starts)
+            deadline = time.time() + 20
+            while not all(
+                    (tmp_path / f"ck{r}" / "step_3" /
+                     "metadata.json").exists() for r in (0, 1)) \
+                    and time.time() < deadline:
+                time.sleep(0.02)
+            assert all((tmp_path / f"ck{r}" / "step_3" /
+                        "metadata.json").exists() for r in (0, 1))
+            master._bump(None, "simulated relaunch")
+            for t in threads:
+                t.join(30)
+                assert not t.is_alive(), "supervised run wedged"
+            for r in (0, 1):
+                assert len(results[r]) == total
+                np.testing.assert_array_equal(probes[r],
+                                              _expected_w(total))
+            # the recovery barrier at generation 1 was agreed + released
+            assert master._released[1]["released"]
+            assert master._released[1]["resume_step"] >= 1
+        finally:
+            master.stop()
+
+    def test_local_exception_restores_locally_not_stale_release(
+            self, tmp_path):
+        """A rank's OWN fault (generation unchanged) restores from its
+        newest checkpoint — it must NOT re-read the generation-0 release
+        and rewind to the stale agreement."""
+        master, ep = _master(world=1)
+        try:
+            mm = MembershipManager(ep, rank=0, interval=0.05, world=1)
+            em = ElasticManager(str(tmp_path / "ck"), save_interval=1,
+                                keep=50, max_restarts=2, membership=mm,
+                                backoff_base=0.01)
+            boom = {"armed": True}
+
+            def step(state, s):
+                if s == 5 and boom.pop("armed", False):
+                    raise ValueError("local fault")
+                return _exact_step(state, s)
+
+            losses = em.run(_state_factory(), step, 9)
+            assert len(losses) == 9
+            probe = _state_factory()()
+            assert em.restore(probe) == 9
+            np.testing.assert_array_equal(
+                np.asarray(probe["w"].numpy()), _expected_w(9))
+            # only the initial generation-0 coordination happened
+            assert list(master._released) == [0]
+        finally:
+            master.stop()
+
+    def test_degraded_world_release_reshards_survivor(self, tmp_path):
+        """rank 1 never shows up; the master abandons it; rank 0's
+        barrier releases at world=1 and the on_world_change callback
+        reshards its sampler to cover the whole index space."""
+        master, ep = _master(world=2)
+        try:
+            sampler = DistributedBatchSampler(
+                list(range(8)), batch_size=1, num_replicas=2, rank=0,
+                shuffle=False)
+            events = []
+
+            def on_world_change(world, rank):
+                events.append((world, rank))
+                sampler.update_world(world, rank)
+
+            mm = MembershipManager(ep, rank=0, interval=0.05, world=2)
+            em = ElasticManager(str(tmp_path / "ck"), save_interval=2,
+                                keep=10, max_restarts=0, membership=mm,
+                                on_world_change=on_world_change)
+            out = {}
+
+            def run():
+                out["losses"] = em.run(_state_factory(), _exact_step, 6)
+
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            time.sleep(0.5)                 # rank 0 parked at gen-0
+            master._abandon(1)              # budget spent: degrade
+            t.join(30)
+            assert not t.is_alive(), "survivor wedged at the barrier"
+            assert len(out["losses"]) == 6
+            assert events == [(1, 0)]
+            assert sorted(i for b in sampler for i in b) == list(range(8))
+        finally:
+            master.stop()
+
+    def test_unsupervised_membership_true_is_plain_local_loop(
+            self, tmp_path, monkeypatch):
+        """membership=True without a supervisor (no
+        PADDLE_ELASTIC_SUPERVISED) must be bitwise the pre-ISSUE-6
+        behavior: no client, no barrier, no master needed."""
+        monkeypatch.delenv("PADDLE_ELASTIC_SUPERVISED", raising=False)
+        em = ElasticManager(str(tmp_path / "ck"), save_interval=2,
+                            membership=True)
+        losses = em.run(_state_factory(), _exact_step, 5)
+        assert len(losses) == 5
+        assert em.membership is None        # resolved to the local loop
+
+    def test_corrupt_agreed_checkpoint_forces_world_reagreement(
+            self, tmp_path):
+        """If OUR copy of the AGREED step turns out corrupt at restore
+        (rotted between the barrier report and the load), the rank must
+        bump the generation so the whole world re-agrees on an older
+        step — NOT restore its own newest locally (silent divergence)
+        and NOT burn a restart slot (max_restarts=0 here)."""
+        master, ep = _master(world=1)
+        try:
+            mm = MembershipManager(ep, rank=0, interval=0.05, world=1)
+            em = ElasticManager(str(tmp_path / "ck"), save_interval=1,
+                                keep=10, max_restarts=0, membership=mm)
+            state = _state_factory()()
+            for step in range(3):
+                _exact_step(state, step)
+                em.save(state, step + 1)
+            _flip_ckpt_blob(tmp_path / "ck" / "step_3")
+            # lie ONCE so the barrier report skips the pre-verify
+            # quarantine and the corrupt step 3 gets agreed
+            real = em.verified_steps
+            lied = []
+
+            def fake():
+                if not lied:
+                    lied.append(1)
+                    return [1, 2, 3]
+                return real()
+
+            em.verified_steps = fake
+            losses = em.run(_state_factory(), _exact_step, 5)
+            assert losses == [2.0, 3.0, 4.0]    # resumed from step 2
+            assert (tmp_path / "ck" / "step_3.corrupt").exists()
+            assert master._generation == 1      # forced re-agreement
+            assert master._released[1]["resume_step"] == 2
+            probe = _state_factory()()
+            assert em.restore(probe) == 5
+            np.testing.assert_array_equal(
+                np.asarray(probe["w"].numpy()), _expected_w(5))
+        finally:
+            master.stop()
+
+    def test_save_overwrites_existing_step_after_rewind(self, tmp_path):
+        """A coordinated rewind makes the survivor REPLAY steps it
+        already checkpointed; the re-save must atomically replace the
+        existing step_N dir (os.replace alone fails ENOTEMPTY on a
+        non-empty directory — the race that intermittently killed a
+        survivor mid-recovery)."""
+        em = ElasticManager(str(tmp_path / "ck"), save_interval=1,
+                            keep=10)
+        state = _state_factory()()
+        for step in range(4):
+            _exact_step(state, step)
+            em.save(state, step + 1)
+        # rewind to step 2 and replay: saves 3 and 4 hit existing dirs
+        probe = _state_factory()()
+        assert em.restore_exact(probe, 2) == 2
+        for step in range(2, 4):
+            _exact_step(probe, step)
+            em.save(probe, step + 1)
+        final = _state_factory()()
+        assert em.restore(final) == 4
+        np.testing.assert_array_equal(
+            np.asarray(final["w"].numpy()), _expected_w(4))
+        assert not (tmp_path / "ck" / "step_4.old").exists()
+
+    def test_restore_exact_quarantines_corrupt_agreed_step(
+            self, tmp_path):
+        em = ElasticManager(str(tmp_path / "ck"), save_interval=1)
+        state = _state_factory()()
+        state["w"].data = state["w"].data + 1.0
+        em.save(state, 3)
+        # corrupt the agreed checkpoint
+        _flip_ckpt_blob(tmp_path / "ck" / "step_3")
+        from paddle_tpu.distributed.checkpoint import CheckpointError
+        with pytest.raises(CheckpointError):
+            em.restore_exact(_state_factory()(), 3)
+        assert (tmp_path / "ck" / "step_3.corrupt").exists()
+        # fresh start is step<=0
+        assert em.restore_exact(_state_factory()(), 0) == 0
+
+
+def _flip_ckpt_blob(step_dir):
+    path = step_dir / "shard_0.npz"
+    with np.load(path) as z:
+        data = {k: np.array(z[k]) for k in z.files}
+    k = sorted(data)[0]
+    data[k].reshape(-1).view(np.uint8)[0] ^= 0xFF
+    with open(str(path) + ".tmp", "wb") as f:
+        np.savez(f, **data)
+    os.replace(str(path) + ".tmp", path)
+
+
+# -- background checksum scrubber --------------------------------------------
+
+class TestCheckpointScrubber:
+    def test_scrubber_quarantines_bitrot_before_restore(self, tmp_path):
+        em = ElasticManager(str(tmp_path / "ck"), save_interval=1,
+                            keep=10)
+        state = _state_factory()()
+        for step in (1, 2, 3):
+            state["w"].data = state["w"].data + 1.0
+            em.save(state, step)
+        _flip_ckpt_blob(tmp_path / "ck" / "step_2")
+        scrub = CheckpointScrubber(str(tmp_path / "ck"), interval=30)
+        bad = scrub.scrub_once()
+        assert len(bad) == 1 and "step_2.corrupt" in bad[0]
+        assert (tmp_path / "ck" / "step_2.corrupt").exists()
+        assert not (tmp_path / "ck" / "step_2").exists()
+        # survivors untouched; restore never sees the rotten one
+        probe = _state_factory()()
+        assert em.restore(probe) == 3
+
+    def test_scrubber_memoizes_verified_dirs(self, tmp_path,
+                                             monkeypatch):
+        em = ElasticManager(str(tmp_path / "ck"), save_interval=1)
+        state = _state_factory()()
+        em.save(state, 1)
+        scrub = CheckpointScrubber(str(tmp_path / "ck"), interval=30)
+        assert scrub.scrub_once() == []
+        from paddle_tpu.distributed import checkpoint as dck
+
+        def _must_not_reverify(path, names=None):
+            raise AssertionError("re-verified an unchanged checkpoint")
+
+        monkeypatch.setattr(dck, "verify_checkpoint", _must_not_reverify)
+        assert scrub.scrub_once() == []     # mtime memo: one stat only
+        assert scrub.passes == 2
+
+    def test_periodic_full_rescrub_catches_late_bitrot(self, tmp_path):
+        """Bit-rot lands in blobs whose metadata mtime never changes, so
+        the mtime memo alone would verify each dir exactly once; every
+        full_rescrub_every'th pass drops the memo and re-reads CRCs."""
+        em = ElasticManager(str(tmp_path / "ck"), save_interval=1)
+        em.save(_state_factory()(), 1)
+        scrub = CheckpointScrubber(str(tmp_path / "ck"), interval=30,
+                                   full_rescrub_every=2)
+        assert scrub.scrub_once() == []         # pass 1: clean, memoized
+        _flip_ckpt_blob(tmp_path / "ck" / "step_1")   # metadata untouched
+        bad = scrub.scrub_once()                # pass 2: full re-verify
+        assert len(bad) == 1 and "step_1.corrupt" in bad[0]
+
+    def test_elastic_manager_runs_scrubber(self, tmp_path):
+        em = ElasticManager(str(tmp_path / "ck"), save_interval=2,
+                            scrub_interval=0.02)
+
+        def slow_step(state, s):
+            time.sleep(0.05)
+            return _exact_step(state, s)
+
+        losses = em.run(_state_factory(), slow_step, 10)
+        assert len(losses) == 10
+        assert em.scrubber.passes >= 1      # scrubbed BETWEEN saves
+        assert em.scrubber._stop.is_set()   # stopped on run() exit
+
+
+# -- sampler: degraded-world resharding + seed-divergence detection ----------
+
+class TestSamplerElastic:
+    def test_update_world_reshards_indices(self):
+        s = DistributedBatchSampler(list(range(10)), batch_size=2,
+                                    num_replicas=2, rank=1,
+                                    shuffle=False)
+        before = [i for b in s for i in b]
+        assert before == [1, 3, 5, 7, 9]
+        s.update_world(1, 0)
+        after = [i for b in s for i in b]
+        assert after == list(range(10))
+        assert len(s) == 5
+
+    def test_rank_divergent_seed_raises(self, monkeypatch):
+        import paddle_tpu.io as pio
+        s = DistributedBatchSampler(list(range(8)), batch_size=2,
+                                    num_replicas=2, rank=0, shuffle=True)
+        monkeypatch.setattr(pio, "_all_gather_seeds",
+                            lambda base: [1234, 999])
+        with pytest.raises(RuntimeError, match="differs across ranks"):
+            list(iter(s))
+
+    def test_consistent_seed_checks_once_then_iterates(self, monkeypatch):
+        import paddle_tpu.io as pio
+        s = DistributedBatchSampler(list(range(8)), batch_size=2,
+                                    num_replicas=2, rank=0, shuffle=True)
+        calls = []
+
+        def fake(base):
+            calls.append(base)
+            return [base, base]
+
+        monkeypatch.setattr(pio, "_all_gather_seeds", fake)
+        a = [i for batch in s for i in batch]
+        s.set_epoch(1)
+        b = [i for batch in s for i in batch]
+        assert len(calls) == 1              # consensus checked ONCE
+        assert len(a) == 4 and len(b) == 4  # this rank's half of 8
+
+    def test_single_process_gather_is_none(self):
+        import paddle_tpu.io as pio
+        assert pio._all_gather_seeds(1234) is None
+
+
+# -- ShardingPlan.remesh ------------------------------------------------------
+
+def test_sharding_plan_remesh_rederives_for_smaller_world():
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from paddle_tpu.distributed.sharding import ShardingPlan
+    devs = np.asarray(jax.devices())
+    plan = ShardingPlan(Mesh(devs.reshape(8), ("dp",)), stage=1)
+    plan.pspecs["fc.w"] = P(None, "dp")
+    small = plan.remesh(Mesh(devs[:4].reshape(4), ("dp",)))
+    assert small.mesh.shape["dp"] == 4
+    assert small.stage == 1
+    assert small.data_axes == ("dp",)
+    assert small.pspecs == plan.pspecs
+    arr = np.zeros((8, 16), np.float32)
+    assert tuple(small.batch_spec(arr)) == ("dp",)
+    # degenerate degrade: a 1-device mesh drops the axis from data_axes
+    solo = plan.remesh(Mesh(devs[:1].reshape(1), ("dp",)))
+    assert tuple(solo.batch_spec(arr)) == ("dp",) or \
+        tuple(solo.batch_spec(arr)) == ()
+
+
+# -- health barrier wiring ----------------------------------------------------
+
+class TestHealthBarrierWiring:
+    def test_disarmed_is_immediate_noop(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_ELASTIC_SUPERVISED", raising=False)
+        t0 = time.perf_counter()
+        assert collective.health_barrier("init") is None
+        assert time.perf_counter() - t0 < 0.05
+        assert collective._health_client is None    # no client built
+
+    def test_supervised_init_waits_for_world(self, monkeypatch):
+        port = _free_port()
+        master, ep = _master(world=1, port=port)
+        try:
+            monkeypatch.setenv("PADDLE_ELASTIC_SUPERVISED", "1")
+            monkeypatch.setenv("PADDLE_ELASTIC_ENDPOINT", ep)
+            monkeypatch.setenv("PADDLE_ELASTIC_WORLD", "1")
+            monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+            monkeypatch.setenv("PADDLE_ELASTIC_HEARTBEAT", "0.05")
+            monkeypatch.setattr(collective, "_health_client", None)
+            info = collective.health_barrier("init", timeout=10)
+            assert info["released"] and info["missing"] == []
+        finally:
+            c = collective._health_client
+            if c is not None:
+                c.stop()
+            monkeypatch.setattr(collective, "_health_client", None)
+            master.stop()
+
+
+# -- launch supervisor --------------------------------------------------------
+
+class TestSupervisor:
+    def test_child_env_per_incarnation_flight_recorder(self, tmp_path):
+        from paddle_tpu.distributed.launch.main import (
+            _child_env, _parse)
+        args = _parse(["--elastic_level", "1", "--log_dir",
+                       str(tmp_path), "script.py"])
+        env = {"FLAGS_flight_recorder": str(tmp_path / "fl")}
+        ce = _child_env(env, args, rank=1, world=2, inc=3,
+                        ep="127.0.0.1:1")
+        assert ce["FLAGS_flight_recorder"] == \
+            str(tmp_path / "fl") + ".rank1.inc3.jsonl"
+        assert ce["PADDLE_INCARNATION"] == "3"
+        assert ce["PADDLE_ELASTIC_SUPERVISED"] == "1"
+        assert ce["PADDLE_ELASTIC_WORLD"] == "2"
+        # no explicit base: derived from --log_dir
+        ce2 = _child_env({}, args, rank=0, world=2, inc=0,
+                         ep="127.0.0.1:1")
+        assert ce2["FLAGS_flight_recorder"] == \
+            str(tmp_path / "flight") + ".rank0.inc0.jsonl"
+
+    def test_elastic_endpoint_derivation(self):
+        from paddle_tpu.distributed.launch.main import (
+            _elastic_endpoint, _parse)
+        a = _parse(["--master", "10.0.0.5:7777", "s.py"])
+        assert _elastic_endpoint(a, {}) == "10.0.0.5:7778"
+        assert _elastic_endpoint(a, {"PADDLE_ELASTIC_ENDPOINT":
+                                     "h:1"}) == "h:1"
+        b = _parse(["s.py"])
+        assert _elastic_endpoint(b, {}) == "127.0.0.1:18814"
+
+    def test_spawn_fault_point_relaunches_rank(self, tmp_path,
+                                               monkeypatch):
+        """launch.spawn:raise@1 fails the FIRST spawn; the supervisor
+        treats it as a death and relaunches the rank, which then
+        succeeds — rc 0, with the whole story in the supervisor
+        flight log."""
+        from paddle_tpu.distributed.launch.main import launch
+        script = tmp_path / "ok.py"
+        script.write_text("open(%r, 'w').write('ran')\n"
+                          % str(tmp_path / "marker"))
+        monkeypatch.setenv("PADDLE_ELASTIC_ENDPOINT",
+                           f"127.0.0.1:{_free_port()}")
+        fi.configure("launch.spawn:raise@1")
+        try:
+            rc = launch(["--elastic_level", "1", "--max_restart", "1",
+                         "--nnodes", "1", "--rank", "0",
+                         "--log_dir", str(tmp_path), str(script)])
+        finally:
+            fi.configure(None)
+        assert rc == 0
+        assert (tmp_path / "marker").exists()
+        evs = [json.loads(line) for line in
+               (tmp_path / "supervisor_flight.jsonl")
+               .read_text().splitlines()]
+        kinds = [e["ev"] for e in evs]
+        assert "spawn_failed" in kinds
+        assert "relaunch" in kinds
+        assert "worker_done" in kinds
+        relaunch = next(e for e in evs if e["ev"] == "relaunch")
+        assert relaunch["rank"] == 0 and relaunch["incarnation"] == 1
+
+
+# -- the acceptance scenario: subprocess chaos --------------------------------
+
+def _run_supervisor(out_dir, worker_args, nproc=2, max_restart=2,
+                    degrade_after=None, timeout=240):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_ELASTIC_ENDPOINT"] = f"127.0.0.1:{_free_port()}"
+    env["PADDLE_ELASTIC_HEARTBEAT"] = "0.1"
+    env["FLAGS_metrics"] = "1"
+    env["FLAGS_comm_timeout"] = "120"
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nnodes", "1", "--rank", "0",
+           "--nproc_per_node", str(nproc),
+           "--elastic_level", "1",
+           "--max_restart", str(max_restart),
+           "--log_dir", out_dir]
+    if degrade_after is not None:
+        cmd += ["--degrade_after", str(degrade_after)]
+    cmd += [str(COLL / "chaos_elastic_worker.py")] + worker_args
+    p = subprocess.Popen(cmd, env=env, cwd=str(REPO),
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT)
+    out, _ = p.communicate(timeout=timeout)
+    return p.returncode, out.decode(errors="replace")
+
+
+def _done_records(out_dir):
+    recs = {}
+    for name in os.listdir(out_dir):
+        if name.startswith("done_") and name.endswith(".json"):
+            with open(os.path.join(out_dir, name)) as f:
+                rec = json.load(f)
+            recs[rec["rank"]] = rec
+    return recs
+
+
+def _sup_events(out_dir):
+    path = os.path.join(out_dir, "supervisor_flight.jsonl")
+    assert os.path.exists(path), "no supervisor flight log"
+    return [json.loads(line)
+            for line in open(path).read().splitlines()]
+
+
+@pytest.mark.timeout(240)
+def test_chaos_kill_one_rank_mid_step_recovers_without_job_relaunch(
+        tmp_path):
+    """ISSUE 6 acceptance: SIGKILL one worker mid-step
+    (elastic.heartbeat:crash — os._exit with no cleanup). The
+    supervisor must relaunch ONLY that rank (fresh incarnation id +
+    flight file), the survivor must park at the recovery barrier and
+    resume from the newest complete checkpoint, and both ranks must
+    finish with weights bitwise equal to an uninterrupted run."""
+    d = str(tmp_path)
+    total = 60
+    rc, out = _run_supervisor(
+        d, [d, str(total), "1", "elastic.heartbeat:crash@20"])
+    assert rc == 0, out[-4000:]
+
+    # (a) ONLY rank 1 was relaunched, with a fresh incarnation id
+    pids = sorted(n for n in os.listdir(d) if n.startswith("pid_"))
+    assert "pid_0_inc0" in pids and "pid_1_inc0" in pids
+    assert "pid_1_inc1" in pids, (pids, out[-3000:])
+    assert not any(n.startswith("pid_0_inc1") for n in pids), pids
+
+    evs = _sup_events(d)
+    deaths = [e for e in evs if e["ev"] == "worker_death"]
+    relaunches = [e for e in evs if e["ev"] == "relaunch"]
+    assert len(deaths) == 1 and deaths[0]["rank"] == 1
+    assert deaths[0]["rc"] == 137           # SIGKILL parity
+    assert deaths[0]["generation"] == 1     # named in the flight record
+    assert [e["rank"] for e in relaunches] == [1]
+
+    # (b) per-incarnation flight-recorder files (ISSUE 3 follow-on)
+    assert os.path.exists(os.path.join(d, "flight.rank1.inc0.jsonl"))
+    assert os.path.exists(os.path.join(d, "flight.rank1.inc1.jsonl"))
+    assert os.path.exists(os.path.join(d, "flight.rank0.inc0.jsonl"))
+
+    # (c) both ranks finished; weights bitwise-equal to uninterrupted
+    recs = _done_records(d)
+    assert set(recs) == {0, 1}, (list(recs), out[-3000:])
+    exp = _expected_w(total).tolist()
+    for r, rec in recs.items():
+        assert rec["w"] == exp, (r, rec["w"], exp)
+        assert rec["final_step"] == total
+        assert rec["events"] == []          # world never degraded
+    # the survivor replayed from the agreed step IN PROCESS, so its loss
+    # view covers every step; the relaunched incarnation's view starts
+    # at the agreed resume step (the checkpoint carried the rest)
+    assert recs[0]["losses_len"] == total
+    assert 1 <= recs[1]["losses_len"] <= total
+
+    # (d) the survivor PARKED at the recovery barrier (saw generation 1
+    # and took the coordinated-recovery path, counted under its
+    # incarnation label)
+    assert recs[0]["generation"] >= 1
+    rec0 = recs[0]["counters"].get("elastic.recoveries_total", {})
+    assert any(v >= 1 for v in rec0.values()), recs[0]["counters"]
+    # the relaunched incarnation re-coordinated rather than restarting
+    # the whole job: its record is incarnation 1
+    assert recs[1]["incarnation"] == 1
+
+
+@pytest.mark.timeout(240)
+def test_chaos_degrade_after_budget_survivor_reshards(tmp_path):
+    """A rank that dies with NO restart budget and --degrade_after set
+    is abandoned: the survivor re-forms at world=1, reshards its
+    sampler to the full index space, and the job exits 0."""
+    d = str(tmp_path)
+    total = 40
+    rc, out = _run_supervisor(
+        d, [d, str(total), "1", "elastic.heartbeat:crash@15"],
+        max_restart=0, degrade_after=0.2)
+    assert rc == 0, out[-4000:]
+
+    evs = _sup_events(d)
+    assert any(e["ev"] == "degrade" and e["rank"] == 1 for e in evs), evs
+
+    recs = _done_records(d)
+    assert 0 in recs, (list(recs), out[-3000:])
+    rec = recs[0]
+    assert rec["events"] and rec["events"][-1] == {"world": 1, "rank": 0}
+    # resharded: the survivor now owns the WHOLE index space
+    assert sorted(rec["my_indices"]) == list(range(16))
+    assert rec["w"] == _expected_w(total).tolist()
+    assert rec["losses_len"] == total

@@ -123,6 +123,29 @@ def test_collective_order_negative():
     assert res.active == [], "\n".join(f.render() for f in res.active)
 
 
+def test_collective_order_group_subsets_legal():
+    """ISSUE 6 / MPMD prereq: a collective gated on `rank in
+    group.ranks` (or `.process_ids`, or past a non-member early return)
+    is legal FOR THAT GROUP — subgroup recovery barriers and
+    degraded-world re-formation take exactly this shape."""
+    res = _run([CollectiveOrderPass()],
+               paths=[FIXTURES / "collective_order_subset_ok.py"])
+    assert res.active == [], "\n".join(f.render() for f in res.active)
+
+
+def test_collective_order_group_subsets_still_catch_misuse():
+    """The subset exemption is exact: a different group, no group, a
+    plain rank gate in between, a member early return, or another
+    group's guard all stay flagged."""
+    res = _run([CollectiveOrderPass()],
+               paths=[FIXTURES / "collective_order_subset_bad.py"])
+    msgs = [f.message for f in res.active]
+    assert len(msgs) == 5, "\n".join(msgs)
+    assert sum("inside a rank-conditional branch" in m for m in msgs) == 3
+    assert sum("after the rank-conditional early return" in m
+               for m in msgs) == 2
+
+
 # -- flags-hygiene -----------------------------------------------------------
 
 def test_flags_hygiene_catches_typo():

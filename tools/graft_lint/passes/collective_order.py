@@ -17,6 +17,26 @@ Detection is per function body:
 - a collective call AFTER a rank-conditional branch containing a
   `return` is flagged (the returning ranks never reach it).
 
+PROCESS-GROUP SUBSETS (ISSUE 6 / MPMD prereq): a collective gated on
+group MEMBERSHIP is legal *for that group* — every rank of the group
+does reach it, and the non-members were never party to the collective:
+
+    if rank in group.ranks:
+        dist.all_reduce(t, group=group)        # legal
+
+    if rank not in group.ranks:
+        return                                  # non-members leave
+    dist.all_reduce(t, group=group)             # legal for `group`
+
+The guard must be a literal membership test (`in`/`not in` against
+`<G>.ranks` / `<G>.process_ids`) and the collective must name the SAME
+group expression via its `group=` keyword; under nested guards every
+enclosing rank-conditional frame must be that same group's guard.
+Anything else (a different group, no group, a positional group, a plain
+rank comparison in between) stays flagged — recovery barriers and
+degraded-world re-formation are wall-to-wall subgroup collectives, and
+this is exactly the shape they take.
+
 Call provenance keeps noise down: bare names count only when imported
 from a distributed/collective/communication module, attribute calls
 only on conventional aliases (`dist.all_reduce`, `collective.scatter`)
@@ -100,6 +120,30 @@ def _attr_chain_mentions_dist(node: ast.AST) -> bool:
     return False
 
 
+def _group_guard(test: ast.AST):
+    """(group-expr-key, positive) when `test` is a literal membership
+    gate `<x> in <G>.ranks` / `<G>.process_ids` (positive=True) or the
+    `not in` form (positive=False); None otherwise. The key is the
+    ast.dump of the group expression, so `group`, `self.mp_group`, …
+    each guard exactly themselves."""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 and \
+            isinstance(test.ops[0], (ast.In, ast.NotIn)) and \
+            isinstance(test.comparators[0], ast.Attribute) and \
+            test.comparators[0].attr in ("ranks", "process_ids"):
+        return (ast.dump(test.comparators[0].value),
+                isinstance(test.ops[0], ast.In))
+    return None
+
+
+def _call_group_key(call: ast.Call):
+    """ast.dump key of the collective's `group=` keyword expression
+    (None when absent/positional — stays conservatively flagged)."""
+    for kw in call.keywords:
+        if kw.arg == "group" and not isinstance(kw.value, ast.Constant):
+            return ast.dump(kw.value)
+    return None
+
+
 def _contains_return(node: ast.stmt) -> bool:
     """True if `node` contains a `return` exiting the CURRENT function
     (returns inside nested defs/lambdas don't count)."""
@@ -118,89 +162,139 @@ def _contains_return(node: ast.stmt) -> bool:
 
 
 class _FnChecker:
+    """Walks one function body tracking a stack of rank-conditional
+    FRAMES: each frame is a group-expression key (a `rank in G.ranks`
+    membership guard) or None (any other rank condition). A collective
+    is legal when every enclosing frame is the guard of the SAME group
+    it names via `group=`."""
+
     def __init__(self, lint: "CollectiveOrderPass", ctx: FileContext,
                  imported: Set[str], fn_name: str):
         self.lint = lint
         self.ctx = ctx
         self.imported = imported
         self.fn_name = fn_name
-        self.rank_return_line = None
-        self.findings: List = []
+        self.rank_return_line = None          # first PLAIN rank return
+        self.guard_return_line = None         # first group-guard return
+        self.return_guards: Set[str] = set()  # groups whose non-members
+        self.findings: List = []              # returned early
 
     def check(self, fn):
-        self._block(fn.body, 0)
+        self._block(fn.body, ())
 
-    def _block(self, stmts, rank_depth):
+    def _block(self, stmts, frames):
         for s in stmts:
-            self._stmt(s, rank_depth)
+            self._stmt(s, frames)
 
-    def _stmt(self, s, rank_depth):
+    def _stmt(self, s, frames):
         if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
                           ast.ClassDef)):
             return      # nested scopes get their own checker
         if isinstance(s, (ast.If, ast.While)):
             ranky = _is_rank_expr(s.test)
-            self._exprs(s.test, rank_depth)
-            depth = rank_depth + (1 if ranky else 0)
-            self._block(s.body, depth)
-            self._block(s.orelse, depth)
-            if ranky and self.rank_return_line is None and \
-                    _contains_return(s):
-                self.rank_return_line = s.lineno
+            self._exprs(s.test, frames)
+            if ranky:
+                guard = _group_guard(s.test)
+                if guard is not None:
+                    key, positive = guard
+                    # the member arm is group-guarded; the other arm
+                    # runs on NON-members — a plain rank condition
+                    member = frames + (key,)
+                    other = frames + (None,)
+                    self._block(s.body, member if positive else other)
+                    self._block(s.orelse, other if positive else member)
+                else:
+                    self._block(s.body, frames + (None,))
+                    self._block(s.orelse, frames + (None,))
+            else:
+                self._block(s.body, frames)
+                self._block(s.orelse, frames)
+            if ranky and _contains_return(s):
+                guard = _group_guard(s.test)
+                arm_with_return = (
+                    any(map(_contains_return, s.body)),
+                    any(map(_contains_return, s.orelse)))
+                if guard is not None and (
+                        (not guard[1] and arm_with_return == (True, False))
+                        or (guard[1] and arm_with_return == (False, True))):
+                    # ONLY non-members returned: collectives on that
+                    # group past this point still see every member
+                    self.return_guards.add(guard[0])
+                    if self.guard_return_line is None:
+                        self.guard_return_line = s.lineno
+                elif self.rank_return_line is None:
+                    self.rank_return_line = s.lineno
             return
         if isinstance(s, (ast.For, ast.AsyncFor)):
-            self._exprs(s.iter, rank_depth)
-            self._block(s.body, rank_depth)
-            self._block(s.orelse, rank_depth)
+            self._exprs(s.iter, frames)
+            self._block(s.body, frames)
+            self._block(s.orelse, frames)
             return
         if isinstance(s, (ast.With, ast.AsyncWith)):
             for item in s.items:
-                self._exprs(item.context_expr, rank_depth)
-            self._block(s.body, rank_depth)
+                self._exprs(item.context_expr, frames)
+            self._block(s.body, frames)
             return
         if isinstance(s, ast.Try):
-            self._block(s.body, rank_depth)
+            self._block(s.body, frames)
             for h in s.handlers:
-                self._block(h.body, rank_depth)
-            self._block(s.orelse, rank_depth)
-            self._block(s.finalbody, rank_depth)
+                self._block(h.body, frames)
+            self._block(s.orelse, frames)
+            self._block(s.finalbody, frames)
             return
-        self._exprs(s, rank_depth)
+        self._exprs(s, frames)
 
-    def _exprs(self, node, rank_depth):
+    def _exprs(self, node, frames):
         """Scan an expression tree for collective calls; a ternary with
         a rank test makes its arms rank-conditional too."""
         if isinstance(node, ast.IfExp) and _is_rank_expr(node.test):
-            self._exprs(node.test, rank_depth)
-            self._exprs(node.body, rank_depth + 1)
-            self._exprs(node.orelse, rank_depth + 1)
+            self._exprs(node.test, frames)
+            self._exprs(node.body, frames + (None,))
+            self._exprs(node.orelse, frames + (None,))
             return
         if isinstance(node, ast.Call):
             name = _collective_call_name(node, self.imported)
             if name is not None:
-                self._judge(node, name, rank_depth)
+                self._judge(node, name, frames)
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.Lambda)):
             return
         for child in ast.iter_child_nodes(node):
-            self._exprs(child, rank_depth)
+            self._exprs(child, frames)
 
-    def _judge(self, call, name, rank_depth):
-        if rank_depth > 0:
+    def _judge(self, call, name, frames):
+        if frames:
+            gkey = _call_group_key(call)
+            if gkey is not None and all(f == gkey for f in frames):
+                return      # subgroup collective under its own guard
             self.findings.append(self.lint.finding(
                 self.ctx, call.lineno,
                 f"collective `{name}` inside a rank-conditional branch "
                 f"in `{self.fn_name}` — ranks that skip the branch "
                 f"never enter the collective and the others deadlock "
                 f"waiting; call it on EVERY rank and branch on the "
-                f"result instead"))
-        elif self.rank_return_line is not None:
+                f"result instead (a `rank in group.ranks` guard is "
+                f"legal when the collective names that same group via "
+                f"group=)"))
+        elif self.rank_return_line is not None or self.return_guards:
+            gkey = _call_group_key(call)
+            # safe ONLY when the sole early exit is this group's own
+            # non-member guard — any plain rank return, or a return
+            # guarded on a DIFFERENT group, still splits this group
+            if gkey is not None and self.rank_return_line is None and \
+                    self.return_guards == {gkey}:
+                return
+            line = (self.rank_return_line
+                    if self.rank_return_line is not None
+                    else self.guard_return_line)
             self.findings.append(self.lint.finding(
                 self.ctx, call.lineno,
                 f"collective `{name}` after the rank-conditional early "
-                f"return at line {self.rank_return_line} in "
+                f"return at line {line} in "
                 f"`{self.fn_name}` — the returning ranks never reach "
-                f"it; restructure so every rank calls the collective"))
+                f"it; restructure so every rank calls the collective "
+                f"(or guard on `rank in group.ranks` and name that "
+                f"group via group=)"))
 
 
 class CollectiveOrderPass(LintPass):
