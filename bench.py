@@ -24,6 +24,50 @@ import traceback
 
 _LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "benchmarks", "BENCH_LAST_GOOD.json")
+_TREND = os.path.join(os.path.dirname(_LAST_GOOD), "BENCH_TREND.json")
+
+
+def _attach_trend(record: dict, append: bool):
+    """ROADMAP MFU-campaign item (b): keep the MFU/tokens-per-second
+    SERIES across rounds in benchmarks/BENCH_TREND.json and surface the
+    tail as extra.trend in every emitted record — a regression shows as
+    a falling series in BENCH_*.json instead of hiding behind the
+    latest number. Series are keyed metric PLUS device kind: a CPU
+    re-exec keeps BENCH_MODEL (so the metric name alone would collide)
+    and a smoke number must never read as a chip regression. Stale
+    re-emits attach the series but never append."""
+    base = record.get("metric", "")
+    if base.endswith("_stale"):
+        base = base[: -len("_stale")]
+    if not base or base == "bench_failed":
+        return
+    base = f"{base}@{record.get('extra', {}).get('device', 'unknown')}"
+    try:
+        with open(_TREND) as f:
+            trend = json.load(f)
+    except (OSError, ValueError):
+        trend = {}
+    series = trend.setdefault(base, [])
+    if append:
+        series.append({
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
+            "value": record.get("value"),
+            "unit": record.get("unit"),
+            "mfu": record.get("extra", {}).get("mfu"),
+            "device": record.get("extra", {}).get("device"),
+        })
+        del series[:-50]
+        try:
+            tmp = _TREND + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(trend, f, indent=1)
+            os.replace(tmp, _TREND)
+        except OSError:
+            pass
+    if series:
+        record.setdefault("extra", {})
+        record["extra"]["trend"] = series[-10:]
 
 
 def _helper_alive(timeout: float = 3.0) -> bool:
@@ -99,6 +143,7 @@ def _emit_stale_or_cpu(reason: str):
             # cannot mistake a re-emit for a fresh run (advisor r3)
             if not rec["metric"].endswith("_stale"):
                 rec["metric"] = rec["metric"] + "_stale"
+            _attach_trend(rec, append=False)
             print(f"bench: {reason}; emitting stale last-good on-chip "
                   f"artifact {path}", file=sys.stderr)
             print(json.dumps(rec))
@@ -204,7 +249,9 @@ def _peak_flops(device) -> float | None:
 def _emit(record: dict, on_tpu: bool):
     """Print the driver's JSON line; on-chip measurements also persist as
     the last-good artifact so a later wedged session can re-emit a real
-    chip number (marked stale) instead of a CPU smoke line."""
+    chip number (marked stale) instead of a CPU smoke line. Every fresh
+    emit appends to the cross-round trend series (extra.trend)."""
+    _attach_trend(record, append=True)
     print(json.dumps(record))
     if on_tpu:
         try:

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Serving benchmark: mixed prefill+decode continuous batching, chunked
+ragged regime vs the serialized bucketed-prefill baseline.
+
+The workload is the serving pathology the ISSUE names: short
+conversations are DECODING when long prompts arrive mid-run. The
+baseline engine (`FLAGS_ragged_attention=0` semantics, `ragged=False`)
+admits each long prompt as a separate bucketed single-sequence prefill
+compile + execution that head-of-line-blocks every decoding user; the
+chunked engine packs KV-budgeted prefill chunks into the SAME compiled
+step as the decode slots — ONE compiled shape total, one ragged kernel
+invocation per tick.
+
+Arrivals are TICK-indexed (deterministic), so both engines see the same
+schedule and must produce token-identical greedy outputs. Throughput is
+generated tokens / wall seconds over the drive loop, including each
+engine's own compile behavior after an identical one-request warmup:
+paying a fresh XLA compile per prompt-length bucket IS the serialized
+baseline's cost model, and eliminating it is the chunked regime's win.
+
+Run: JAX_PLATFORMS=cpu python benchmarks/serving_bench.py
+Output: JSON report on stdout + benchmarks/SERVING_BENCH.json; exits 1
+if speedup < MIN_SPEEDUP or outputs diverge, so it regression-guards.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import observability as obs  # noqa: E402
+from paddle_tpu.inference import (ContinuousBatchingEngine,  # noqa: E402
+                                  GenerationRequest)
+from paddle_tpu.models.llama import (LlamaConfig,  # noqa: E402
+                                     LlamaForCausalLM)
+from paddle_tpu.observability import metrics  # noqa: E402
+
+MIN_SPEEDUP = float(os.environ.get("BENCH_MIN_SPEEDUP", "1.5"))
+MAX_SEQ = 128
+BUCKETS = (8, 16, 32, 64, 128)
+CHUNK = int(os.environ.get("BENCH_CHUNK_TOKENS", "32"))
+ARTIFACT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "SERVING_BENCH.json")
+
+
+def _model():
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=128,
+                      intermediate_size=256, num_hidden_layers=2,
+                      num_attention_heads=4,
+                      max_position_embeddings=2 * MAX_SEQ,
+                      use_recompute=False)
+    return LlamaForCausalLM(cfg)
+
+
+def _workload():
+    """(arrival_tick, prompt, max_new) — two short chats decoding from
+    tick 0; long prompts in DISTINCT length buckets arriving mid-decode
+    (each is a fresh (bucket, k) prefill compile for the baseline)."""
+    rng = np.random.RandomState(7)
+    long_lens = (25, 45, 90, 120, 50, 100)
+    jobs = [(0, list(rng.randint(1, 256, 5)), 60),
+            (0, list(rng.randint(1, 256, 6)), 60)]
+    for i, n in enumerate(long_lens):
+        jobs.append((4 + 3 * i, list(rng.randint(1, 256, n)), 8))
+    return jobs
+
+
+def _drive(engine, jobs, max_ticks=4000):
+    """Tick-indexed arrivals: deterministic, identical for both engines."""
+    reqs = [GenerationRequest(list(p), max_new_tokens=n)
+            for _, p, n in jobs]
+    pending = sorted(zip([t for t, _, _ in jobs], reqs),
+                     key=lambda x: x[0])
+    t0 = time.perf_counter()
+    tick = 0
+    while (pending or engine.has_work) and tick < max_ticks:
+        while pending and pending[0][0] <= tick:
+            engine.add_request(pending.pop(0)[1])
+        engine.step()
+        tick += 1
+    dt = time.perf_counter() - t0
+    assert not engine.has_work and not pending, "bench failed to drain"
+    return dt, reqs, tick
+
+
+def _snapshot_serving():
+    snap = metrics.snapshot()
+    out = {}
+    for hist in ("serving.ttft_seconds", "serving.tpot_seconds",
+                 "serving.packed_tokens_per_tick"):
+        cell = snap["histograms"].get(hist, {}).get("")
+        if cell:
+            out[hist] = {"count": cell["count"],
+                         "mean": round(cell["sum"] / max(cell["count"], 1),
+                                       6)}
+    cnt = snap["counters"].get("serving.preemptions_total", {}).get("")
+    out["serving.preemptions_total"] = cnt or 0
+    return out
+
+
+def run(model, jobs, ragged):
+    metrics.reset()
+    eng = ContinuousBatchingEngine(model, max_batch=4, max_seq=MAX_SEQ,
+                                   prefill_buckets=BUCKETS,
+                                   max_chunk_tokens=CHUNK, ragged=ragged)
+    # identical warmup for both regimes: compile the steady-state step
+    w = GenerationRequest([3, 5], max_new_tokens=2)
+    eng.add_request(w)
+    while eng.has_work:
+        eng.step()
+    eng.finished.clear()
+    dt, reqs, ticks = _drive(eng, jobs)
+    tokens = sum(len(r.output) for r in reqs)
+    return {"seconds": dt, "tokens": tokens, "ticks": ticks,
+            "tokens_per_sec": tokens / dt,
+            "prefill_compiles": len(eng._compiled_prefill),
+            "telemetry": _snapshot_serving(),
+            "outputs": [list(r.output) for r in reqs]}
+
+
+def main():
+    obs.enable(True)
+    model = _model()
+    jobs = _workload()
+    base = run(model, jobs, ragged=False)      # serialized bucketed prefill
+    chunked = run(model, jobs, ragged=True)    # ragged chunked prefill
+    identical = base.pop("outputs") == chunked.pop("outputs")
+    speedup = chunked["tokens_per_sec"] / base["tokens_per_sec"]
+    report = {
+        "bench": "serving",
+        "workload": {"requests": len(jobs), "max_batch": 4,
+                     "max_seq": MAX_SEQ, "chunk_tokens": CHUNK,
+                     "long_prompt_buckets": sorted(
+                         {len(p) for t, p, _ in jobs if len(p) > 8})},
+        "serialized_prefill": base,
+        "chunked_prefill": chunked,
+        "speedup": round(speedup, 2),
+        "min_speedup": MIN_SPEEDUP,
+        "token_identical_outputs": bool(identical),
+    }
+    print(json.dumps(report, indent=2))
+    with open(ARTIFACT, "w") as f:
+        json.dump(report, f, indent=2)
+    out = os.environ.get("BENCH_OUT")
+    if out:
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2)
+    if not identical:
+        print("FAIL: chunked outputs diverge from serialized baseline",
+              file=sys.stderr)
+        return 1
+    if speedup < MIN_SPEEDUP:
+        print(f"FAIL: speedup {speedup:.2f}x < required {MIN_SPEEDUP}x",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

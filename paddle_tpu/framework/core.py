@@ -333,6 +333,10 @@ _flags: dict = {
     # (benchmarks/MEASUREMENT_RUNBOOK.md).
     "FLAGS_use_fused_ce": False,       # Pallas blockwise CE vs XLA CE
     "FLAGS_use_flash_attention": True,  # Pallas flash vs dense XLA attn
+    # -- serving (consumed by inference/serving.py): ragged paged
+    # attention + chunked-prefill continuous batching; 0 is the kill
+    # switch restoring the bucketed-prefill engine exactly
+    "FLAGS_ragged_attention": True,
     "FLAGS_cudnn_exhaustive_search": False,     # alias: force sweeps
     # -- numerics (consumed in _apply_flag -> jax matmul precision) ----
     "FLAGS_gemm_use_half_precision_compute_type": True,

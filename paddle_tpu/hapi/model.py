@@ -288,7 +288,30 @@ class Model:
             # process-global state (MetricsCallback)
             for cb in cbs:
                 cb.on_train_begin()
+            # auto-wire epochs into the loader's sampler (the torch
+            # DistributedSampler contract): without this a
+            # DistributedBatchSampler(shuffle=True) replays epoch 0's
+            # permutation forever unless the caller remembered the
+            # manual set_epoch loop. RELATIVE to the sampler's current
+            # epoch so a caller who already advanced it (resume:
+            # sampler.set_epoch(5); fit(epochs=1)) is not clobbered
+            # back to 0. sampler.epoch is ambiguous between "next to
+            # train" (manual resume) and "last trained" (fit's own
+            # wiring left it there) — the private _fit_auto_epoch marker
+            # disambiguates so back-to-back fit() calls CONTINUE the
+            # sequence instead of re-training the last permutation.
+            sampler = getattr(loader, "batch_sampler", None)
+            set_epoch = getattr(sampler, "set_epoch", None)
+            epoch_base = int(getattr(sampler, "epoch", 0) or 0)
+            if getattr(sampler, "_fit_auto_epoch", None) == epoch_base:
+                epoch_base += 1          # untouched since our last wiring
             for epoch in range(epochs):
+                if callable(set_epoch):
+                    set_epoch(epoch_base + epoch)
+                    try:
+                        sampler._fit_auto_epoch = epoch_base + epoch
+                    except AttributeError:
+                        pass             # __slots__ sampler: degrade
                 for cb in cbs:
                     cb.on_epoch_begin(epoch)
                 logs = {}

@@ -7,15 +7,27 @@ block-table allocator in fluid/framework/new_executor/block tables).
 TPU-native design: a global KV PAGE POOL `[L, kvh, n_pages, page, d]`
 (the Pallas paged_attention kernel's pool layout) plus a host-side
 free-list allocator and per-slot block tables — KV memory is
-proportional to live tokens, not batch * max_seq. The scheduler admits
-waiting requests into free slots MID-DECODE when the pool has room (one
-bucketed single-sequence prefill, then a scatter of JUST the prompt's
-pages), every decode tick advances all active slots with ONE compiled
-step that writes each new token's KV as a B-element page scatter
-(donated buffers -> in-place on TPU), finished sequences return their
-pages to the pool, and pool exhaustion preempts the latest-admitted
-sequence (recompute-style resume). All compute is jit-compiled once per
-(bucket/batch) shape; the Python scheduler only moves request metadata.
+proportional to live tokens, not batch * max_seq.
+
+Two scheduler regimes, flag-gated (`FLAGS_ragged_attention`, default on):
+
+* CHUNKED-PREFILL continuous batching (the ragged regime — ref "Ragged
+  Paged Attention", arxiv 2604.15464): admission splits prompts into
+  KV-budgeted prefill CHUNKS (`max_chunk_tokens` per tick) that are
+  packed into the SAME compiled step as the active decode slots — one
+  ragged kernel invocation per tick, one KV page-scatter per tick per
+  layer, ONE compiled shape total (rows pad to a fixed bucket). Prefill
+  no longer head-of-line-blocks decoding users, and pool accounting
+  moves to token granularity (pages are funded chunk by chunk).
+* The legacy bucketed regime (`FLAGS_ragged_attention=0` restores it
+  exactly): each admitted request prefills as a bucketed batched
+  compile, then joins the shared single-token decode tick.
+
+Both regimes: finished sequences return their pages to the pool, and
+pool exhaustion preempts the latest-admitted sequence (recompute-style
+resume). Serving telemetry rides the observability registry
+(serving.ttft_seconds / serving.tpot_seconds / serving.kv_pages_in_use /
+serving.preemptions_total / serving.packed_tokens_per_tick).
 
 Weight-only int8 (PTQ) inference: `quantize="int8"` stores every 2-D
 projection as int8 + per-output-channel scale (the PTQ absmax rule,
@@ -28,14 +40,34 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..framework import core as _core
+from ..observability import metrics as _metrics
+
 __all__ = ["GenerationRequest", "ContinuousBatchingEngine", "PagePool",
            "quantize_state_int8"]
+
+_TTFT = _metrics.histogram(
+    "serving.ttft_seconds",
+    "request arrival to first generated token (time-to-first-token)")
+_TPOT = _metrics.histogram(
+    "serving.tpot_seconds",
+    "mean per-output-token latency after the first token")
+_KV_PAGES = _metrics.gauge(
+    "serving.kv_pages_in_use",
+    "allocated (non-free, non-scratch) pages in the KV page pool")
+_PREEMPTS = _metrics.counter(
+    "serving.preemptions_total",
+    "recompute-style preemptions forced by KV pool pressure")
+_PACKED = _metrics.histogram(
+    "serving.packed_tokens_per_tick",
+    "ragged rows (prefill-chunk + decode) packed into one mixed step",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0))
 
 
 # ---------------- weight-only int8 PTQ ------------------------------------
@@ -86,6 +118,7 @@ class GenerationRequest:
     output: List[int] = field(default_factory=list)
     arrived_s: float = 0.0
     finished_s: Optional[float] = None
+    first_token_s: Optional[float] = None
 
     @property
     def done(self) -> bool:
@@ -93,7 +126,8 @@ class GenerationRequest:
 
 
 class _Slot:
-    __slots__ = ("req", "length", "produced", "last_token", "admit_seq")
+    __slots__ = ("req", "length", "produced", "last_token", "admit_seq",
+                 "pending")
 
     def __init__(self):
         self.req: Optional[GenerationRequest] = None
@@ -101,6 +135,8 @@ class _Slot:
         self.produced = 0
         self.last_token = 0
         self.admit_seq = -1
+        # chunked-prefill regime: effective-prompt tokens not yet in KV
+        self.pending: List[int] = []
 
     @property
     def free(self):
@@ -148,13 +184,16 @@ class ContinuousBatchingEngine:
 
     model: LlamaForCausalLM (any model exposing config + state_dict with
     the llama cache-forward layout). max_batch = decode slots; max_seq =
-    per-slot KV capacity (page-aligned).
+    per-slot KV capacity (page-aligned). max_chunk_tokens bounds the
+    prefill tokens packed into one ragged tick; ragged=None follows
+    FLAGS_ragged_attention (the chunked-prefill kill switch).
     """
 
     def __init__(self, model, max_batch: int = 4, max_seq: int = 256,
                  prefill_buckets=(32, 64, 128, 256), quantize=None,
                  greedy: bool = True, seed: int = 0,
-                 total_pages: Optional[int] = None, page_size: int = 16):
+                 total_pages: Optional[int] = None, page_size: int = 16,
+                 max_chunk_tokens: int = 64, ragged: Optional[bool] = None):
         from ..models import llama as L
         self.cfg = model.cfg
         self.B = int(max_batch)
@@ -169,6 +208,7 @@ class ContinuousBatchingEngine:
         self.greedy = greedy
         self._fwd = L._forward_with_cache
         self._decode_paged = L._decode_step_paged
+        self._ragged_step = L._ragged_step_paged
         raw = {k: t.data for k, t in model.state_dict().items()}
         self.dtype = raw["model.embed_tokens"].dtype
         self.state = (quantize_state_int8(raw) if quantize == "int8"
@@ -197,6 +237,23 @@ class ContinuousBatchingEngine:
         self._compiled_prefill = {}
         self._compiled_decode = None
         self._compiled_write = None
+        self._compiled_ragged = None
+        # chunked-prefill regime: FLAGS_ragged_attention is the kill
+        # switch (0 restores the bucketed-prefill engine exactly)
+        self._ragged = (_core.get_bool_flag("FLAGS_ragged_attention", True)
+                        if ragged is None else bool(ragged))
+        if int(max_chunk_tokens) < 1:
+            # fail fast: a zero budget would make _schedule_chunks park
+            # every prefill forever and preempt-thrash instead of erroring
+            raise ValueError(
+                f"max_chunk_tokens must be >= 1, got {max_chunk_tokens}")
+        self.max_chunk_tokens = int(max_chunk_tokens)
+        # ONE compiled ragged shape: rows pad to a fixed power-of-two
+        # bucket >= decode slots + the chunk budget (the kernel's
+        # autotune size class, so tuned blocks match what we compile)
+        from ..kernels.ragged_paged_attention import _size_class
+        self._T_pack = _size_class(self.B + self.max_chunk_tokens)
+        self.last_packed_tokens = 0
         # donation lets XLA scatter into the pool in place; CPU jit would
         # just warn that the buffers were not donated
         self._donate = jax.default_backend() == "tpu"
@@ -297,6 +354,38 @@ class ContinuousBatchingEngine:
             decode, donate_argnums=(2, 3) if self._donate else ())
         return self._compiled_decode
 
+    def _ragged_fn(self):
+        """(state, toks[T], k_pool, v_pool, page_ids[T], offs[T], pos[T],
+        page_table, q_start[B], q_len[B], kv_len[B], produce[B], prev[B],
+        key) -> (next[B], k_pool, v_pool) — ONE mixed prefill+decode step:
+        every packed row's KV scatters into its page and one ragged paged
+        attention covers both phases; next[b] is sampled from sequence
+        b's last packed row (kept at prev[b] where produce[b] is False:
+        mid-prompt chunks and idle slots)."""
+        if self._compiled_ragged is not None:
+            return self._compiled_ragged
+        cfg, dt = self.cfg, self.dtype
+        dq, quant = _dequant_state, self._quantized
+        step_ragged = self._ragged_step
+        greedy = self.greedy
+
+        def rstep(state, toks, k_pool, v_pool, page_ids, offs, pos,
+                  page_table, q_start, q_len, kv_len, produce, prev, key):
+            st = dq(state, dt) if quant else state
+            lg, k_pool, v_pool = step_ragged(
+                st, cfg, toks, pos, k_pool, v_pool, page_ids, offs,
+                page_table, q_start, q_len, kv_len)
+            if greedy:
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            else:
+                nxt = jax.random.categorical(key, lg).astype(jnp.int32)
+            nxt = jnp.where(produce, nxt, prev)
+            return nxt, k_pool, v_pool
+
+        self._compiled_ragged = jax.jit(
+            rstep, donate_argnums=(2, 3) if self._donate else ())
+        return self._compiled_ragged
+
     # -- scheduler ----------------------------------------------------------
 
     def add_request(self, req: GenerationRequest):
@@ -338,9 +427,34 @@ class ContinuousBatchingEngine:
         slot = self.slots[i]
         req = slot.req
         slot.req = None
+        slot.pending = []
         self._free_slot_pages(i)
         self.waiting.insert(0, req)
         self.preemptions += 1
+        _PREEMPTS.inc()
+
+    def _oversized(self, eff_len: int) -> bool:
+        """A token stream that can NEVER fit: more pages than the pool
+        can allocate, or longer than the per-slot KV capacity."""
+        return (-(-eff_len // self.page) > self.pool.n_pages - 1
+                or eff_len > self.S)
+
+    def _fail_request(self, req):
+        """Defensive terminal path shared by both admission regimes:
+        add_request gates prompts and _maybe_finish caps growth, so an
+        oversized resume stream is unreachable — but if it ever occurs,
+        FINISH the request (empty/partial output) instead of raising
+        out of step() and wedging the queue head."""
+        req.finished_s = time.perf_counter()
+        self.finished.append(req)
+
+    def _note_first_token(self, req):
+        """TTFT bookkeeping: the request's FIRST output token just landed
+        (admission in the bucketed regime, prompt-complete chunk in the
+        ragged one). Resumed requests keep their original stamp."""
+        if len(req.output) == 1 and req.first_token_s is None:
+            req.first_token_s = time.perf_counter()
+            _TTFT.observe(req.first_token_s - req.arrived_s)
 
     def _admit(self):
         """Move waiting requests into free slots, allocating ONLY the
@@ -364,14 +478,9 @@ class ContinuousBatchingEngine:
             eff = list(req.prompt) + list(req.output)
             T = len(eff)
             need = -(-T // self.page)
-            if need > self.pool.n_pages - 1:
-                # defensive: add_request gates prompts and _maybe_finish
-                # caps growth at pool capacity, so this is unreachable —
-                # but if it ever triggers, FAIL this request instead of
-                # raising out of step() and wedging the queue head
+            if self._oversized(T):
                 self.waiting.pop(0)
-                req.finished_s = time.perf_counter()
-                self.finished.append(req)
+                self._fail_request(req)
                 continue
             pages = self.pool.alloc(need)
             if pages is None:
@@ -444,6 +553,7 @@ class ContinuousBatchingEngine:
             slot.admit_seq = self._admit_seq
             self._admit_seq += 1
             req.output.append(tok)
+            self._note_first_token(req)
             self._maybe_finish(i)
 
     def _maybe_finish(self, i):
@@ -461,17 +571,23 @@ class ContinuousBatchingEngine:
         full = slot.length + 1 > cap - 1
         if slot.produced >= req.max_new_tokens or eos_hit or full:
             req.finished_s = time.perf_counter()
+            if req.first_token_s is not None and len(req.output) > 1:
+                _TPOT.observe((req.finished_s - req.first_token_s)
+                              / (len(req.output) - 1))
             self.finished.append(req)
             slot.req = None
+            slot.pending = []
             self._free_slot_pages(i)     # pages back to the pool
 
     def _grow(self):
-        """Before a decode tick: every active slot whose next token
-        crosses a page boundary gets a fresh page; when the pool is dry,
-        preempt the latest-admitted OTHER active slot and retry (the
-        victim resumes later via recompute)."""
+        """Before a decode tick: every active DECODE-phase slot whose
+        next token crosses a page boundary gets a fresh page; when the
+        pool is dry, preempt the latest-admitted OTHER active slot and
+        retry (the victim resumes later via recompute). Prefill-phase
+        slots (ragged regime) fund their pages chunk by chunk in
+        _schedule_chunks instead."""
         for i, slot in enumerate(self.slots):
-            if slot.free:
+            if slot.free or slot.pending:
                 continue
             while slot.req is not None:
                 have = len(self.slot_pages[i]) * self.page
@@ -483,39 +599,193 @@ class ContinuousBatchingEngine:
                     self.slot_pages[i].append(pg[0])
                     self.page_table[i, n] = pg[0]
                     break
+                # only page-HOLDING victims free anything; a freshly
+                # admitted zero-page prefill slot would be a pointless
+                # eviction (pages unchanged, preemption counted)
                 victims = [j for j, s in enumerate(self.slots)
-                           if j != i and not s.free]
+                           if j != i and not s.free and self.slot_pages[j]]
                 if victims:
                     self._preempt(max(
                         victims, key=lambda j: self.slots[j].admit_seq))
                 else:
-                    self._preempt(i)     # nothing else to evict
+                    self._preempt(i)     # nothing else holds pages
+
+    # -- chunked-prefill (ragged) scheduler ---------------------------------
+
+    def _admit_ragged(self):
+        """Token-granular admission: a waiting request takes a free slot
+        as soon as ONE exists and the pool has any free page — its prompt
+        is funded page by page as chunks are scheduled, not reserved
+        up front (the chunked-prefill admission rule)."""
+        free_slots = [i for i, s in enumerate(self.slots) if s.free]
+        while self.waiting and free_slots and self.pool.n_free > 0:
+            req = self.waiting[0]
+            # re-admission after preemption resumes from prompt + output
+            eff = list(req.prompt) + list(req.output)
+            if self._oversized(len(eff)):
+                self.waiting.pop(0)
+                self._fail_request(req)
+                continue
+            self.waiting.pop(0)
+            i = free_slots.pop(0)
+            slot = self.slots[i]
+            slot.req = req
+            slot.length = 0
+            slot.produced = len(req.output)
+            slot.last_token = 0
+            slot.pending = eff
+            slot.admit_seq = self._admit_seq
+            self._admit_seq += 1
+            self.slot_pages[i] = []
+            self.page_table[i, :] = 0
+
+    def _schedule_chunks(self) -> List[Tuple[int, List[int], bool]]:
+        """Build this tick's ragged batch: one decode row per active
+        decode-phase slot plus KV-budgeted prefill chunks (admission
+        order, `max_chunk_tokens` total). Pages are funded at token
+        granularity — a chunk shrinks to what the pool can hold. When
+        every active slot is prefill-parked on a dry pool, the latest
+        admission is preempted (recompute) so the head makes progress.
+        Returns [(slot_idx, row_tokens, is_prefill)]."""
+        while True:
+            entries: List[Tuple[int, List[int], bool]] = []
+            budget = self.max_chunk_tokens
+            for i, slot in enumerate(self.slots):
+                if not slot.free and not slot.pending:
+                    entries.append((i, [slot.last_token], False))
+            order = sorted((i for i, s in enumerate(self.slots)
+                            if not s.free and s.pending),
+                           key=lambda i: self.slots[i].admit_seq)
+            for i in order:
+                if budget <= 0:
+                    break
+                slot = self.slots[i]
+                chunk = min(len(slot.pending), budget,
+                            self.S - slot.length)
+                have = len(self.slot_pages[i]) * self.page
+                fundable = (have + self.pool.n_free * self.page
+                            - slot.length)
+                chunk = min(chunk, fundable)
+                if chunk <= 0:
+                    continue             # parked this tick (pool dry)
+                need = (-(-(slot.length + chunk) // self.page)
+                        - len(self.slot_pages[i]))
+                if need > 0:
+                    pages = self.pool.alloc(need)  # fundable => succeeds
+                    n0 = len(self.slot_pages[i])
+                    self.slot_pages[i].extend(pages)
+                    self.page_table[i, n0:n0 + need] = pages
+                entries.append((i, list(slot.pending[:chunk]), True))
+                budget -= chunk
+            if entries:
+                return entries
+            # prefer page-HOLDING victims (evicting a zero-page slot
+            # frees nothing); fall back to any active slot so the loop
+            # always shrinks the active set and terminates
+            active = [i for i, s in enumerate(self.slots) if not s.free]
+            if not active:
+                return entries
+            victims = [i for i in active if self.slot_pages[i]] or active
+            self._preempt(max(victims,
+                              key=lambda j: self.slots[j].admit_seq))
+
+    def _step_ragged(self):
+        """One chunked-prefill tick: admission, decode page growth, chunk
+        scheduling, then ONE ragged invocation covering every phase."""
+        self._admit_ragged()
+        self._grow()
+        entries = self._schedule_chunks()
+        if not entries:
+            self.last_packed_tokens = 0
+            return
+        B, page, T = self.B, self.page, self._T_pack
+        toks = np.zeros((T,), np.int32)
+        pos = np.zeros((T,), np.int32)
+        page_ids = np.zeros((T,), np.int32)
+        offs = np.zeros((T,), np.int32)
+        q_start = np.zeros((B,), np.int32)
+        q_len = np.zeros((B,), np.int32)
+        kv_len = np.zeros((B,), np.int32)
+        produce = np.zeros((B,), bool)
+        prev = np.zeros((B,), np.int32)
+        cur = 0
+        for i, rows, is_prefill in entries:
+            slot = self.slots[i]
+            n = len(rows)
+            q_start[i] = cur
+            q_len[i] = n
+            kv_len[i] = slot.length + n
+            prev[i] = slot.last_token
+            # only a COMPLETED prompt (or a decode row) yields a token;
+            # mid-prompt chunks keep prev so sampling engines stay
+            # deterministic across chunk splits
+            produce[i] = (not is_prefill) or n == len(slot.pending)
+            for t, tok in enumerate(rows):
+                p = slot.length + t
+                toks[cur] = tok
+                pos[cur] = p
+                page_ids[cur] = self.page_table[i, p // page]
+                offs[cur] = p % page
+                cur += 1
+        self.last_packed_tokens = cur
+        _PACKED.observe(float(cur))
+        self._key, sub = jax.random.split(self._key)
+        nxt, self.k_pool, self.v_pool = self._ragged_fn()(
+            self._state_arg(), jnp.asarray(toks), self.k_pool,
+            self.v_pool, jnp.asarray(page_ids), jnp.asarray(offs),
+            jnp.asarray(pos), jnp.asarray(self.page_table),
+            jnp.asarray(q_start), jnp.asarray(q_len),
+            jnp.asarray(kv_len), jnp.asarray(produce),
+            jnp.asarray(prev), sub)
+        nxt = np.asarray(nxt)
+        for i, rows, is_prefill in entries:
+            slot = self.slots[i]
+            req = slot.req
+            n = len(rows)
+            slot.length += n
+            if is_prefill:
+                del slot.pending[:n]
+                if slot.pending:
+                    continue             # prompt still streaming in
+            tok = int(nxt[i])
+            slot.last_token = tok
+            req.output.append(tok)
+            slot.produced = len(req.output)
+            self._note_first_token(req)
+            self._maybe_finish(i)
 
     def step(self) -> List[GenerationRequest]:
-        """One scheduler tick: admit into free slots, grow pages, then one
-        decode step for every active slot. Returns requests finished this
-        tick."""
+        """One scheduler tick. Ragged regime: admit, grow, then ONE mixed
+        prefill-chunk + decode invocation. Bucketed regime
+        (FLAGS_ragged_attention=0): admit (bucketed prefill compiles),
+        grow, then one decode step for every active slot. Returns
+        requests finished this tick."""
         n_done_before = len(self.finished)
-        self._admit()
-        self._grow()
-        active = np.array([not s.free for s in self.slots])
-        if active.any():
-            toks = np.array([s.last_token for s in self.slots], np.int32)
-            lens = np.array([s.length for s in self.slots], np.int32)
-            self._key, sub = jax.random.split(self._key)
-            nxt, self.k_pool, self.v_pool = self._decode_fn()(
-                self._state_arg(), jnp.asarray(toks), self.k_pool,
-                self.v_pool, jnp.asarray(self.page_table),
-                jnp.asarray(lens), jnp.asarray(active), sub)
-            nxt = np.asarray(nxt)
-            for i, slot in enumerate(self.slots):
-                if slot.free:
-                    continue
-                slot.length += 1
-                slot.produced += 1
-                slot.last_token = int(nxt[i])
-                slot.req.output.append(slot.last_token)
-                self._maybe_finish(i)
+        if self._ragged:
+            self._step_ragged()
+        else:
+            self._admit()
+            self._grow()
+            active = np.array([not s.free for s in self.slots])
+            if active.any():
+                toks = np.array([s.last_token for s in self.slots],
+                                np.int32)
+                lens = np.array([s.length for s in self.slots], np.int32)
+                self._key, sub = jax.random.split(self._key)
+                nxt, self.k_pool, self.v_pool = self._decode_fn()(
+                    self._state_arg(), jnp.asarray(toks), self.k_pool,
+                    self.v_pool, jnp.asarray(self.page_table),
+                    jnp.asarray(lens), jnp.asarray(active), sub)
+                nxt = np.asarray(nxt)
+                for i, slot in enumerate(self.slots):
+                    if slot.free:
+                        continue
+                    slot.length += 1
+                    slot.produced += 1
+                    slot.last_token = int(nxt[i])
+                    slot.req.output.append(slot.last_token)
+                    self._maybe_finish(i)
+        _KV_PAGES.set(float(self.pool.n_pages - 1 - self.pool.n_free))
         self.ticks += 1
         return self.finished[n_done_before:]
 

@@ -709,6 +709,86 @@ def _decode_step_paged(state, cfg, toks, k_pool, v_pool, page_table, lens,
     return logits.astype(jnp.float32)[:, 0], k_pool, v_pool
 
 
+# ---------------------------------------------------------------------------
+# ragged mixed-phase step: prefill CHUNKS and single-token decodes packed
+# into ONE call over the page pool (ref: "Ragged Paged Attention", arxiv
+# 2604.15464 — the chunked-prefill continuous-batching step. Rows are
+# packed [T] with per-sequence (q_start, q_len, kv_len) metadata; each
+# layer scatters the rows' KV into their pages, then one ragged paged
+# attention covers every phase in the same kernel invocation.)
+# ---------------------------------------------------------------------------
+
+
+def _block_ragged(cfg, h, wl, kp, vp, pos, page_ids, offs, page_table,
+                  q_start, q_len, kv_len):
+    """One decoder layer over packed ragged rows against the page pool.
+
+    h: [T, H] packed rows; kp/vp: [kvh, P, page, d] (this layer's pool);
+    pos: i32[T] absolute positions; page_ids/offs: i32[T] page id +
+    in-page offset for each row's KV write (padding rows carry page 0 =
+    scratch); page_table: i32[B, ppmax]; q_start/q_len/kv_len: i32[B]
+    per-sequence row metadata (kv_len INCLUDES this step's rows).
+    """
+    from ..kernels.ragged_paged_attention import ragged_paged_attention
+    from ..kernels.rope import apply_rope
+
+    T = h.shape[0]
+    nh, kvh, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    a = _rms(h, wl["input_layernorm.weight"], cfg.rms_norm_eps)
+    q = (a @ wl["self_attn.q_proj"]).reshape(T, nh, d)
+    k = (a @ wl["self_attn.k_proj"]).reshape(T, kvh, d)
+    v = (a @ wl["self_attn.v_proj"]).reshape(T, kvh, d)
+    max_pos = max(cfg.max_position_embeddings,
+                  page_table.shape[1] * kp.shape[2])
+    q4, k4 = apply_rope(q[None], k[None], position_ids=pos[None],
+                        base=cfg.rope_theta, seq_len=max_pos)
+    q, k = q4[0], k4[0]
+    # ONE T-row page scatter per layer (prefill chunks and decode tokens
+    # alike); duplicate scratch-page writes from padding rows are benign
+    kp = kp.at[:, page_ids, offs].set(jnp.moveaxis(k, 1, 0).astype(kp.dtype))
+    vp = vp.at[:, page_ids, offs].set(jnp.moveaxis(v, 1, 0).astype(vp.dtype))
+    o = ragged_paged_attention(q, kp, vp, q_start, q_len, kv_len,
+                               page_table, scale=1.0 / math.sqrt(d))
+    h = h + o.astype(h.dtype).reshape(T, nh * d) @ wl["self_attn.o_proj"]
+    a2 = _rms(h, wl["post_attention_layernorm.weight"], cfg.rms_norm_eps)
+    up = jax.nn.silu(a2 @ wl["mlp.gate_proj"]) * (a2 @ wl["mlp.up_proj"])
+    return h + up @ wl["mlp.down_proj"], kp, vp
+
+
+def _ragged_step_paged(state, cfg, toks, pos, k_pool, v_pool, page_ids,
+                       offs, page_table, q_start, q_len, kv_len):
+    """Mixed prefill-chunk + decode rows in ONE call over the page pool.
+
+    toks/pos/page_ids/offs: i32[T] packed rows (padding rows: token 0,
+    page 0); k/v_pool: [L, kvh, P, page, d]; page_table: i32[B, ppmax];
+    q_start/q_len/kv_len: i32[B]. Returns (last_logits[B, V], k_pool,
+    v_pool) where last_logits[b] is the logits at each sequence's LAST
+    packed row (garbage for q_len == 0 slots — callers mask)."""
+    T = toks.shape[0]
+    emb = state["model.embed_tokens"]
+    h = jnp.take(emb, toks.astype(jnp.int32), axis=0)        # [T, H]
+    wls = _gather_layer_weights(state, cfg)
+
+    def body(h, xs):
+        wl, kp, vp = xs
+        h, kp, vp = _block_ragged(cfg, h, wl, kp, vp, pos, page_ids, offs,
+                                  page_table, q_start, q_len, kv_len)
+        return h, (kp, vp)
+
+    h, (k_pool, v_pool) = jax.lax.scan(body, h, (wls, k_pool, v_pool))
+    h = _rms(h, state["model.norm.weight"], cfg.rms_norm_eps)
+    last = jnp.clip(q_start + q_len - 1, 0, T - 1)
+    # rank-3 matmul on purpose: XLA CPU's rank-2 bf16 gemm accumulates
+    # differently than the batched form every other decode path uses,
+    # which flips greedy argmax at bf16 logit ties (engine parity bar)
+    h_last = h[last][:, None]                                 # [B, 1, H]
+    if "lm_head" in state:
+        logits = h_last @ state["lm_head"]
+    else:
+        logits = h_last @ jnp.swapaxes(emb, 0, 1)
+    return logits.astype(jnp.float32)[:, 0], k_pool, v_pool
+
+
 def llama_tiny(**kw):
     return LlamaConfig(vocab_size=1024, hidden_size=256, intermediate_size=688,
                        num_hidden_layers=2, num_attention_heads=4,

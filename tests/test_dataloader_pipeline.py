@@ -1078,3 +1078,50 @@ def test_fit_accepts_iterable_dataset_loader():
               F.mse_loss)
     m.fit(DataLoader(Stream(), batch_size=4, num_workers=2), epochs=1,
           verbose=0)
+
+
+def test_fit_autowires_distributed_sampler_set_epoch():
+    """ISSUE 5 carried-over follow-on (shipped in ISSUE 7): Model.fit
+    calls batch_sampler.set_epoch(epoch) itself — a
+    DistributedBatchSampler(shuffle=True) must not replay epoch 0's
+    permutation forever just because the caller forgot the manual
+    set_epoch loop."""
+    m, ds, _, _ = _prepared_model()
+    sampler = DistributedBatchSampler(ds, batch_size=8, num_replicas=1,
+                                      rank=0, shuffle=True)
+    calls = []
+    orig = sampler.set_epoch
+    sampler.set_epoch = lambda e: (calls.append(e), orig(e))[1]
+    loader = DataLoader(ds, batch_sampler=sampler)
+    seen = []
+
+    class Spy:
+        def __getattr__(self, name):
+            if name == "on_epoch_begin":
+                return lambda epoch, logs=None: seen.append(
+                    (epoch, sampler.epoch))
+            return lambda *a, **kw: None
+
+    m.fit(loader, epochs=3, verbose=0, callbacks=[Spy()])
+    # one set_epoch per epoch, BEFORE the epoch's callbacks/iteration
+    assert calls == [0, 1, 2]
+    assert seen == [(0, 0), (1, 1), (2, 2)]
+    # back-to-back fit CONTINUES the sequence (epoch 2's permutation is
+    # not trained twice)
+    calls.clear()
+    m.fit(loader, epochs=2, verbose=0)
+    assert calls == [3, 4]
+    # RELATIVE wiring: a caller who manually advanced the sampler
+    # (resume contract) is honored, not clobbered back to 0
+    sampler.set_epoch(9)
+    calls.clear()                       # drop the manual call itself
+    m.fit(loader, epochs=2, verbose=0)
+    assert calls == [9, 10]
+    # and the wiring actually changes batch order across epochs
+    orders = []
+    sampler2 = DistributedBatchSampler(ds, batch_size=8, num_replicas=1,
+                                       rank=0, shuffle=True)
+    for epoch in (0, 1):
+        sampler2.set_epoch(epoch)
+        orders.append([tuple(b) for b in sampler2])
+    assert orders[0] != orders[1]

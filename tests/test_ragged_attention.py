@@ -1,0 +1,232 @@
+"""Ragged paged attention kernel parity (ISSUE 7 tentpole): the packed
+mixed prefill-chunk + decode contract against a hand-rolled dense
+reference — jnp fallback AND the Pallas path through the interpreter
+(`_FORCE_PALLAS`, the block_attention.py discipline)."""
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels import ragged_paged_attention as rpa
+
+
+def _dense_reference(q, kp, vp, q_start, q_len, kv_len, pt, scale):
+    """Per-row loop reference: gather the row's sequence KV through the
+    block table, causal softmax in f64-ish numpy f32."""
+    T, nh, d = q.shape
+    kvh, _, page, _ = kp.shape
+    B, ppmax = pt.shape
+    S = ppmax * page
+    out = np.zeros((T, nh, d), np.float32)
+    for s in range(B):
+        k = np.zeros((S, kvh, d), np.float32)
+        v = np.zeros_like(k)
+        for j in range(ppmax):
+            k[j * page:(j + 1) * page] = kp[:, pt[s, j]].transpose(1, 0, 2)
+            v[j * page:(j + 1) * page] = vp[:, pt[s, j]].transpose(1, 0, 2)
+        rep = nh // kvh
+        k = np.repeat(k, rep, 1)
+        v = np.repeat(v, rep, 1)
+        for t in range(q_len[s]):
+            row = q_start[s] + t
+            p_abs = kv_len[s] - q_len[s] + t
+            sc = np.einsum("hd,shd->hs", q[row], k) * scale
+            m = (np.arange(S) <= p_abs) & (np.arange(S) < kv_len[s])
+            sc[:, ~m] = -1e30
+            pr = np.exp(sc - sc.max(-1, keepdims=True))
+            pr = pr / pr.sum(-1, keepdims=True)
+            out[row] = np.einsum("hs,shd->hd", pr, v)
+    return out
+
+
+def _case(seed=0, T=12, nh=4, kvh=2, d=64, n_pages=12, page=16, ppmax=4,
+          rows=((0, 5, 21), (5, 1, 7), (0, 0, 0), (6, 6, 6))):
+    """rows: (q_start, q_len, kv_len) per sequence — default mixes a
+    prefill chunk, a decode row, an idle slot, and a from-scratch
+    prefill whose chunk IS the whole sequence."""
+    rng = np.random.RandomState(seed)
+    kp = rng.randn(kvh, n_pages, page, d).astype(np.float32)
+    vp = rng.randn(kvh, n_pages, page, d).astype(np.float32)
+    q = rng.randn(T, nh, d).astype(np.float32)
+    B = len(rows)
+    pt = np.zeros((B, ppmax), np.int32)
+    nxt = 1
+    for s, (_, _, kl) in enumerate(rows):
+        for j in range(-(-max(kl, 1) // page)):
+            pt[s, j] = nxt % n_pages or 1
+            nxt += 1
+    q_start = np.array([r[0] for r in rows], np.int32)
+    q_len = np.array([r[1] for r in rows], np.int32)
+    kv_len = np.array([r[2] for r in rows], np.int32)
+    return q, kp, vp, q_start, q_len, kv_len, pt
+
+
+class TestFallbackParity:
+    def test_mixed_phases_match_reference(self):
+        q, kp, vp, qs, ql, kl, pt = _case()
+        ref = _dense_reference(q, kp, vp, qs, ql, kl, pt,
+                               1.0 / math.sqrt(q.shape[-1]))
+        out = np.asarray(rpa.ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(qs), jnp.asarray(ql), jnp.asarray(kl),
+            jnp.asarray(pt), use_pallas=False))
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_rows_outside_every_sequence_are_zero(self):
+        q, kp, vp, qs, ql, kl, pt = _case()
+        out = np.asarray(rpa.ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(qs), jnp.asarray(ql), jnp.asarray(kl),
+            jnp.asarray(pt), use_pallas=False))
+        # rows 12 > t >= 6+6: none — build an explicit gap instead
+        qs2 = np.array([0, 8, 0, 0], np.int32)
+        ql2 = np.array([4, 2, 0, 0], np.int32)
+        kl2 = np.array([20, 9, 0, 0], np.int32)
+        out = np.asarray(rpa.ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(qs2), jnp.asarray(ql2), jnp.asarray(kl2),
+            jnp.asarray(pt), use_pallas=False))
+        assert np.all(out[4:8] == 0) and np.all(out[10:] == 0)
+        assert np.any(out[:4] != 0) and np.any(out[8:10] != 0)
+
+    def test_causality_within_a_chunk(self):
+        """Perturbing a LATER kv position in the chunk must not change an
+        earlier row's output (strict causal masking inside the chunk)."""
+        q, kp, vp, qs, ql, kl, pt = _case(
+            rows=((0, 8, 8), (0, 0, 0), (0, 0, 0), (0, 0, 0)))
+        run = lambda kpx: np.asarray(rpa.ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kpx), jnp.asarray(vp),
+            jnp.asarray(qs), jnp.asarray(ql), jnp.asarray(kl),
+            jnp.asarray(pt), use_pallas=False))
+        base = run(kp)
+        kp2 = kp.copy()
+        kp2[:, pt[0, 0], 5] += 10.0          # kv position 5
+        pert = run(kp2)
+        # rows 0..4 (positions 0..4) must be untouched; later rows move
+        np.testing.assert_array_equal(base[:5], pert[:5])
+        assert np.abs(pert[5:8] - base[5:8]).max() > 1e-6
+
+    def test_gqa_grouping(self):
+        q, kp, vp, qs, ql, kl, pt = _case(nh=8, kvh=2)
+        ref = _dense_reference(q, kp, vp, qs, ql, kl, pt,
+                               1.0 / math.sqrt(q.shape[-1]))
+        out = np.asarray(rpa.ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(qs), jnp.asarray(ql), jnp.asarray(kl),
+            jnp.asarray(pt), use_pallas=False))
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_chunk_spanning_page_boundary(self):
+        """A chunk whose kv positions straddle pages must land each
+        token on the right page through the block table."""
+        q, kp, vp, qs, ql, kl, pt = _case(
+            T=12, rows=((0, 10, 38), (10, 1, 17), (0, 0, 0), (0, 0, 0)))
+        ref = _dense_reference(q, kp, vp, qs, ql, kl, pt,
+                               1.0 / math.sqrt(q.shape[-1]))
+        out = np.asarray(rpa.ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(qs), jnp.asarray(ql), jnp.asarray(kl),
+            jnp.asarray(pt), use_pallas=False))
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+class TestPallasInterpretParity:
+    """The compiled kernel's math through the Pallas interpreter on CPU
+    (block_attention's _FORCE_PALLAS discipline) — fp32 tolerance vs the
+    dense reference (online-softmax accumulation order differs)."""
+
+    def _run(self, **kw):
+        q, kp, vp, qs, ql, kl, pt = _case(**kw)
+        ref = _dense_reference(q, kp, vp, qs, ql, kl, pt,
+                               1.0 / math.sqrt(q.shape[-1]))
+        out = np.asarray(rpa.ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(qs), jnp.asarray(ql), jnp.asarray(kl),
+            jnp.asarray(pt), use_pallas=True))
+        np.testing.assert_allclose(out, ref, rtol=5e-5, atol=5e-5)
+
+    def test_mixed_phases(self):
+        self._run()
+
+    def test_gqa(self):
+        self._run(nh=8, kvh=2)
+
+    def test_page_boundaries_and_long_chunk(self):
+        self._run(T=12, rows=((0, 10, 38), (10, 1, 17), (0, 0, 0),
+                              (0, 0, 0)))
+
+    def test_force_pallas_hook_dispatches_interpreter(self, monkeypatch):
+        """The auto route honors _FORCE_PALLAS off-TPU (interpret mode),
+        and supported() gates unaligned head dims back to the fallback."""
+        calls = {}
+        real = rpa._pallas_path
+
+        def spy(*a, **kw):
+            calls["hit"] = True
+            return real(*a, **kw)
+
+        monkeypatch.setattr(rpa, "_pallas_path", spy)
+        monkeypatch.setattr(rpa, "_FORCE_PALLAS", True)
+        q, kp, vp, qs, ql, kl, pt = _case()
+        rpa.ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(qs), jnp.asarray(ql), jnp.asarray(kl),
+            jnp.asarray(pt))
+        assert calls.get("hit")
+        calls.clear()
+        q2, kp2, vp2, qs2, ql2, kl2, pt2 = _case(d=48)   # unaligned
+        rpa.ragged_paged_attention(
+            jnp.asarray(q2), jnp.asarray(kp2), jnp.asarray(vp2),
+            jnp.asarray(qs2), jnp.asarray(ql2), jnp.asarray(kl2),
+            jnp.asarray(pt2))
+        assert "hit" not in calls
+
+    def test_block_q_override_any_size(self):
+        """block_q (the autotune sweep's lever) changes blocking, not
+        results."""
+        q, kp, vp, qs, ql, kl, pt = _case()
+        base = np.asarray(rpa.ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(qs), jnp.asarray(ql), jnp.asarray(kl),
+            jnp.asarray(pt), use_pallas=True))
+        for bq in (8, 16):
+            out = np.asarray(rpa.ragged_paged_attention(
+                jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(qs), jnp.asarray(ql), jnp.asarray(kl),
+                jnp.asarray(pt), use_pallas=True, block_q=bq))
+            np.testing.assert_allclose(out, base, rtol=1e-6, atol=1e-6)
+
+
+class TestDispatchAndAutotune:
+    def test_supported_gates(self):
+        assert rpa.supported((8, 4, 64), (2, 10, 16, 64))
+        assert not rpa.supported((8, 4, 48), (2, 10, 16, 48))   # d % 64
+        assert not rpa.supported((8, 4, 64), (2, 10, 12, 64))   # page % 8
+        assert not rpa.supported((8, 3, 64), (2, 10, 16, 64))   # nh % kvh
+
+    def test_explicit_use_pallas_rejects_unaligned(self):
+        """use_pallas=True must RAISE on unsupported shapes, not silently
+        time the fallback (a sweep would record noise winners)."""
+        q, kp, vp, qs, ql, kl, pt = _case(d=48)
+        with pytest.raises(ValueError, match="Mosaic-aligned"):
+            rpa.ragged_paged_attention(
+                jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(qs), jnp.asarray(ql), jnp.asarray(kl),
+                jnp.asarray(pt), use_pallas=True)
+
+    def test_block_q_consults_autotune(self, monkeypatch):
+        from paddle_tpu.kernels import autotune
+        key = autotune.cache_key("ragged_paged_attn",
+                                 T=rpa._size_class(40))
+        monkeypatch.setattr(autotune, "lookup",
+                            lambda k: [16] if k == key else None)
+        assert rpa._block_q(40) == 16
+        # default chain: smallest pow2 covering the packed rows, cap 128
+        monkeypatch.setattr(autotune, "lookup", lambda k: None)
+        assert rpa._block_q(40) == 64
+        assert rpa._block_q(9) == 16
+        assert rpa._block_q(8) == 8
+        assert rpa._block_q(4096) == 128

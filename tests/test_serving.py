@@ -264,13 +264,18 @@ class TestGQAServing:
 
 
 class TestBatchedAdmission:
+    """Bucketed-prefill admission internals: these pin `ragged=False`
+    (the FLAGS_ragged_attention=0 regime) because they assert the legacy
+    engine's compile-cache keys; the chunked-prefill scheduler has its
+    own coverage in tests/test_serving_chunked.py."""
+
     def test_group_admission_one_prefill_call_exact_parity(self):
         """Same-bucket requests admitted in one tick share ONE batched
         prefill (compile cache keyed (bucket, k)) and still produce the
         exact isolated-greedy outputs."""
         model = _tiny_model()
         eng = ContinuousBatchingEngine(model, max_batch=4, max_seq=64,
-                                       prefill_buckets=(8,))
+                                       prefill_buckets=(8,), ragged=False)
         reqs = [GenerationRequest([i + 2, 2 * i + 1], max_new_tokens=5)
                 for i in range(4)]
         for r in reqs:
@@ -289,7 +294,8 @@ class TestBatchedAdmission:
     def test_mixed_buckets_group_separately(self):
         model = _tiny_model()
         eng = ContinuousBatchingEngine(model, max_batch=4, max_seq=64,
-                                       prefill_buckets=(8, 16))
+                                       prefill_buckets=(8, 16),
+                                       ragged=False)
         eng.add_request(GenerationRequest([1, 2], max_new_tokens=3))
         eng.add_request(GenerationRequest(list(range(1, 13)),
                                           max_new_tokens=3))
