@@ -30,8 +30,9 @@ from ..framework import core
 from ..tensor import Tensor
 
 __all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler",
-           "is_bfloat16_supported", "is_float16_supported", "white_list",
-           "black_list", "compute_dtype"]
+           "is_bfloat16_supported", "is_float16_supported",
+           "is_float8_supported", "white_list", "black_list",
+           "compute_dtype"]
 
 # ref: fluid/imperative/amp_auto_cast.cc O1 lists, trimmed + extended with
 # this framework's fused-op tape names (llama_attn, flash_attention, ...)
@@ -97,6 +98,14 @@ def is_bfloat16_supported(device=None):
 
 def is_float16_supported(device=None):
     return True
+
+
+def is_float8_supported(device=None):
+    """fp8-e4m3 availability on this jax/backend — the same probe that
+    gates the quantized collectives' fp8 wire mode (ISSUE 8; the
+    scale/cast plumbing is shared in paddle_tpu/quantization/comm.py)."""
+    from ..quantization import comm as _qcomm
+    return _qcomm.supports_fp8()
 
 
 @contextlib.contextmanager

@@ -14,7 +14,8 @@ from .collective import (  # noqa: F401
     ReduceOp, all_gather, all_gather_object, all_reduce, alltoall,
     alltoall_single, barrier, broadcast, broadcast_object_list,
     destroy_process_group, get_group, health_barrier, irecv, isend,
-    new_group, recv, reduce, reduce_scatter, scatter, send, wait,
+    new_group, quantized_all_reduce, quantized_reduce_scatter, recv,
+    reduce, reduce_scatter, scatter, send, wait,
 )
 from .topology import (  # noqa: F401
     AXES, AxisGroup, CommunicateTopology, HybridCommunicateGroup,

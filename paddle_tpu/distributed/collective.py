@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 import time
 from typing import Optional
 
@@ -40,6 +41,37 @@ _COLL_BYTES = _m.counter("collective.bytes_total",
                          "payload bytes entering collectives by op")
 _COLL_SECONDS = _m.histogram("collective.wall_seconds",
                              "collective wall time by op")
+# WIRE bytes vs the logical payload above (ISSUE 8): for exact ops the
+# two are equal; the quantized paths report what actually crosses the
+# interconnect (1-byte elements + per-block f32 scales, both phases of
+# the reduce_scatter->all_gather chain) — `_payload_nbytes` alone would
+# report the fp32 size and hide the compression win entirely.
+_COLL_WIRE = _m.counter("collective.wire_bytes_total",
+                        "bytes actually put on the wire by op (equals "
+                        "bytes_total for unquantized collectives)")
+_COLL_RATIO = _m.gauge("collective.compression_ratio",
+                       "fp32-equivalent / wire bytes of the last "
+                       "quantized collective by op")
+
+
+class _WireOverride(threading.local):
+    nbytes = None
+
+
+_wire_override = _WireOverride()
+
+
+def _set_wire_bytes(n: int):
+    """Called by a quantized collective body to report its true wire
+    bytes; the telemetry wrapper around it consumes the value (exact
+    ops never set it, so wire falls back to the logical payload)."""
+    _wire_override.nbytes = int(n)
+
+
+def _take_wire_bytes():
+    v = _wire_override.nbytes
+    _wire_override.nbytes = None
+    return v
 
 
 def _payload_nbytes(payload) -> int:
@@ -79,6 +111,7 @@ def _collective_telemetry(op_name: str, payload_arg: Optional[int] = 0):
             if not _m.enabled():
                 return fn(*args, **kwargs)
             _COLL_CALLS.inc(1, op=op_name)
+            nb = 0
             if payload_arg is not None:
                 payload = (args[payload_arg]
                            if len(args) > payload_arg
@@ -86,10 +119,16 @@ def _collective_telemetry(op_name: str, payload_arg: Optional[int] = 0):
                 nb = _payload_nbytes(payload)
                 if nb:
                     _COLL_BYTES.inc(nb, op=op_name)
+            _take_wire_bytes()        # drop any stale override
             t0 = time.perf_counter()
             with _span("collective." + op_name):
                 out = fn(*args, **kwargs)
             _COLL_SECONDS.observe(time.perf_counter() - t0, op=op_name)
+            wire = _take_wire_bytes()
+            if wire is None:
+                wire = nb             # exact op: wire == logical payload
+            if wire:
+                _COLL_WIRE.inc(wire, op=op_name)
             return out
         return wrapper
     return deco
@@ -159,10 +198,113 @@ def _axis_of(group):
     return getattr(group, "axis", None)
 
 
+# ---- quantized collectives (ISSUE 8, EQuARX arxiv 2506.17615) -----------
+# Two-phase blockwise-quantized all-reduce inside shard_map programs:
+# absmax-quantize -> reduce_scatter the int8/fp8 payloads + per-block
+# scales (an all_to_all: per-rank scales make the shards non-summable on
+# the wire) -> dequantize and accumulate the local shard in fp32 ->
+# re-quantize -> all_gather -> dequantize. Opt-in per call/plan and
+# kill-switched by FLAGS_quant_collectives (=0 restores the exact psum
+# paths bitwise). Scale plumbing lives in paddle_tpu/quantization/comm.
+
+
+def _quant_armed() -> bool:
+    from ..framework import core as _core
+    return _core.get_bool_flag("FLAGS_quant_collectives", True)
+
+
+def _quant_reduce_scatter_rows(rows, axis, cfg):
+    """Phase 1 on (nranks, s) f32 rows (s % block == 0): quantize each
+    row blockwise, all_to_all so rank i collects every rank's row i,
+    dequantize and accumulate in fp32. Returns (shard_sum (s,), err1)
+    where err1 = rows - wire_value (None unless cfg.error_feedback)."""
+    from ..quantization import comm as _qc
+    q, sc = _qc.quantize_blocks(rows, cfg.block, cfg.mode)
+    err1 = rows - _qc.dequantize_blocks(q, sc, cfg.block) \
+        if cfg.error_feedback else None
+    q_r = jax.lax.all_to_all(q, axis, split_axis=0, concat_axis=0)
+    sc_r = jax.lax.all_to_all(sc, axis, split_axis=0, concat_axis=0)
+    shard = _qc.dequantize_blocks(q_r, sc_r, cfg.block).sum(axis=0)
+    return shard, err1
+
+
+def _quantized_allreduce_flat(flat, axis, nranks, cfg, residual=None):
+    """SUM all-reduce of a flat f32 vector via the two-phase quantized
+    chain; runs INSIDE a shard_map over `axis`. Returns (summed flat,
+    new padded residual or None, wire_bytes, logical_bytes).
+
+    wire/logical use the same per-phase payload-entering convention
+    (phase-1 full vector + phase-2 shard), so their ratio is the
+    physical compression 4 / (1 + 4/block) independent of world size."""
+    from ..quantization import comm as _qc
+    numel = flat.shape[0]
+    s, padded = _qc.shard_sizes(numel, nranks, cfg.block)
+    x = jnp.pad(flat.astype(jnp.float32), (0, padded - numel))
+    if residual is not None:
+        x = x + residual.reshape(padded)
+    rows = x.reshape(nranks, s)
+    shard, err1 = _quant_reduce_scatter_rows(rows, axis, cfg)
+    # phase 2: re-quantize the reduced shard, gather everyone's
+    q2, sc2 = _qc.quantize_blocks(shard, cfg.block, cfg.mode)
+    q_all = jax.lax.all_gather(q2, axis)
+    sc_all = jax.lax.all_gather(sc2, axis)
+    out = _qc.dequantize_blocks(q_all, sc_all,
+                                cfg.block).reshape(padded)[:numel]
+    new_residual = None
+    if cfg.error_feedback:
+        # each rank keeps its own phase-1 error over the FULL vector and
+        # adds its phase-2 error into the shard it owns (it was the sole
+        # quantizer of that slice — compensation next step re-injects it)
+        err2 = shard - _qc.dequantize_blocks(q2, sc2, cfg.block)
+        r = err1.reshape(padded)
+        start = jax.lax.axis_index(axis) * s
+        seg = jax.lax.dynamic_slice(r, (start,), (s,))
+        new_residual = jax.lax.dynamic_update_slice(r, seg + err2, (start,))
+    per_elem = cfg.wire_bytes_per_element
+    wire = int(round((padded + s) * per_elem))
+    logical = (padded + s) * 4
+    return out, new_residual, wire, logical
+
+
+def _quantized_allreduce_into(tensor, op, group, mode, block, op_label):
+    """Shared body of the quantized all_reduce entry points: quantized
+    SUM/AVG of `tensor` over `group`'s axis, result written back.
+    `op_label` is the wrapping telemetry decorator's op name so the
+    ratio gauge lands on the SAME series as the wire/byte counters."""
+    from ..quantization import comm as _qc
+    axis = _axis_of(group)
+    cfg = _qc.resolve_config(mode, block)
+    data = tensor.data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    flat = data.astype(jnp.float32).ravel()
+    if op == ReduceOp.AVG:
+        flat = flat / group.nranks
+    out, _, wire, logical = _quantized_allreduce_flat(
+        flat, axis, group.nranks, cfg)
+    _set_wire_bytes(wire)
+    _COLL_RATIO.set(logical / wire, op=op_label)
+    result = out.reshape(data.shape).astype(data.dtype)
+    if isinstance(tensor, Tensor):
+        tensor.data = result
+        return tensor
+    return Tensor(result)
+
+
 @_collective_telemetry("all_reduce")
-def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, *,
+               quantized=None):
+    """ref paddle.distributed.all_reduce, plus the opt-in low-precision
+    wire mode: `quantized="int8"|"fp8"` (or True for the default mode)
+    routes SUM/AVG through the blockwise-quantized chain when armed
+    (FLAGS_quant_collectives, shard_map regime only — the eager
+    single-controller reduction moves no bytes, so there is nothing to
+    compress and the exact identity is kept)."""
     axis = _axis_of(group)
     if axis is not None and _in_shard_map(axis):
+        if quantized and _quant_armed() and \
+                op in (ReduceOp.SUM, ReduceOp.AVG):
+            mode = quantized if isinstance(quantized, str) else None
+            return _quantized_allreduce_into(tensor, op, group, mode, None,
+                                             "all_reduce")
         fn = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
               ReduceOp.MIN: jax.lax.pmin,
               ReduceOp.AVG: jax.lax.pmean}[op]
@@ -170,6 +312,38 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         return tensor
     # eager single-controller: world reduction is identity (data is global)
     return tensor
+
+
+@_collective_telemetry("quantized_all_reduce")
+def quantized_all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+                         mode="int8", block=None):
+    """Explicit quantized all-reduce (EQuARX two-phase chain). Exact
+    fallback when FLAGS_quant_collectives=0, outside shard_map, or for
+    non-SUM/AVG ops — callers can leave it in place and flip the flag."""
+    axis = _axis_of(group)
+    if axis is None or not _in_shard_map(axis) or not _quant_armed() \
+            or op not in (ReduceOp.SUM, ReduceOp.AVG):
+        return all_reduce.__wrapped__(tensor, op, group, sync_op)
+    return _quantized_allreduce_into(tensor, op, group, mode, block,
+                                     "quantized_all_reduce")
+
+
+@_collective_telemetry("grad_sync")
+def grad_sync_all_reduce(grad, axis=None, nranks=0, cfg=None,
+                         residual=None):
+    """The TrainStep gradient-sync seam: quantized MEAN-reduction of a
+    local (per-shard) gradient array over the data-parallel `axis`,
+    called inside the shard_map the quantized TrainStep wraps the step
+    in. Pre-scales by 1/nranks so the whole chain (and the
+    error-feedback residual) lives in one space. Returns
+    (mean_grad, new_residual_or_None)."""
+    arr = grad.data if isinstance(grad, Tensor) else grad
+    flat = arr.astype(jnp.float32).ravel() / nranks
+    out, new_residual, wire, logical = _quantized_allreduce_flat(
+        flat, axis, nranks, cfg, residual=residual)
+    _set_wire_bytes(wire)
+    _COLL_RATIO.set(logical / wire, op="grad_sync")
+    return out.reshape(arr.shape).astype(arr.dtype), new_residual
 
 
 @_collective_telemetry("all_gather", payload_arg=1)
@@ -240,11 +414,43 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     return all_reduce.__wrapped__(tensor, op, group, sync_op)
 
 
+def _quantized_reduce_scatter_into(tensor, tensor_list, op, group, mode,
+                                   block):
+    """Quantized phase-1 only: each rank's stacked contributions are
+    blockwise-quantized, exchanged (all_to_all — per-rank scales make
+    the payloads non-summable on the wire) and accumulated in fp32;
+    rank i keeps shard i."""
+    from ..quantization import comm as _qc
+    axis = _axis_of(group)
+    cfg = _qc.resolve_config(mode, block)
+    stacked = jnp.stack([unwrap(t) for t in tensor_list]
+                        ).astype(jnp.float32)
+    if op == ReduceOp.AVG:
+        stacked = stacked / group.nranks
+    n, elem_shape = stacked.shape[0], stacked.shape[1:]
+    numel = int(np.prod(elem_shape)) if elem_shape else 1
+    s = -(-numel // cfg.block) * cfg.block
+    rows = jnp.pad(stacked.reshape(n, numel), ((0, 0), (0, s - numel)))
+    shard, _ = _quant_reduce_scatter_rows(rows, axis, cfg)
+    per_elem = cfg.wire_bytes_per_element
+    wire = int(round(n * s * per_elem))
+    _set_wire_bytes(wire)
+    _COLL_RATIO.set((n * s * 4) / wire, op="quantized_reduce_scatter")
+    out = shard[:numel].reshape(elem_shape)
+    tensor.data = out.astype(unwrap(tensor_list[0]).dtype)
+    return tensor
+
+
 @_collective_telemetry("reduce_scatter", payload_arg=1)
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
-                   sync_op=True):
+                   sync_op=True, *, quantized=None):
     axis = _axis_of(group)
     if axis is not None and _in_shard_map(axis):
+        if quantized and _quant_armed() and \
+                op in (ReduceOp.SUM, ReduceOp.AVG):
+            mode = quantized if isinstance(quantized, str) else None
+            return _quantized_reduce_scatter_into(
+                tensor, tensor_list, op, group, mode, None)
         stacked = jnp.stack([unwrap(t) for t in tensor_list])
         out = jax.lax.psum_scatter(stacked, axis, scatter_dimension=0,
                                    tiled=False)
@@ -252,6 +458,21 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
         return tensor
     tensor.data = sum(unwrap(t) for t in tensor_list)
     return tensor
+
+
+@_collective_telemetry("quantized_reduce_scatter", payload_arg=1)
+def quantized_reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM,
+                             group=None, sync_op=True, mode="int8",
+                             block=None):
+    """Explicit quantized reduce-scatter; exact fallback when disarmed
+    (FLAGS_quant_collectives=0), outside shard_map, or non-SUM/AVG."""
+    axis = _axis_of(group)
+    if axis is None or not _in_shard_map(axis) or not _quant_armed() \
+            or op not in (ReduceOp.SUM, ReduceOp.AVG):
+        return reduce_scatter.__wrapped__(tensor, tensor_list, op, group,
+                                          sync_op)
+    return _quantized_reduce_scatter_into(tensor, tensor_list, op, group,
+                                          mode, block)
 
 
 @_collective_telemetry("scatter", payload_arg=1)

@@ -245,7 +245,9 @@ class ShardingPlan:
     """
 
     def __init__(self, mesh: Mesh, stage: int = 0, param_rules=None,
-                 data_axes=("dp", "sharding"), shard_min_size: int = 2 ** 14):
+                 data_axes=("dp", "sharding"), shard_min_size: int = 2 ** 14,
+                 grad_sync=None, grad_sync_block=None,
+                 grad_sync_error_feedback: bool = False):
         self.mesh = mesh
         self.stage = stage
         self.param_rules = param_rules or {}
@@ -255,6 +257,21 @@ class ShardingPlan:
                                and mesh.shape[a] > 1) or tuple(
                                    a for a in data_axes if a in mesh.axis_names)
         self.shard_min_size = shard_min_size
+        # quantized gradient sync (ISSUE 8, EQuARX): "int8"/"fp8" routes
+        # the data-parallel grad mean through the blockwise-quantized
+        # shard_map chain in collective.py instead of the implicit GSPMD
+        # psum; None (default) keeps today's path. Armed only when
+        # FLAGS_quant_collectives != 0 (evaluated at TrainStep build —
+        # the kill switch restores the GSPMD path bitwise).
+        self.grad_sync = grad_sync
+        self.grad_sync_block = grad_sync_block
+        self.grad_sync_error_feedback = bool(grad_sync_error_feedback)
+        if grad_sync is not None and stage != 0:
+            raise ValueError(
+                "quantized grad sync (grad_sync=...) currently composes "
+                "only with replicated parameters/optimizer state "
+                "(stage=0); ZeRO stages shard state across the same axis "
+                "the quantized chain reduces over")
 
     def remesh(self, mesh: Mesh) -> "ShardingPlan":
         """Re-derive this plan over a DIFFERENT (usually smaller) mesh —
@@ -270,7 +287,11 @@ class ShardingPlan:
         plan = ShardingPlan(mesh, stage=self.stage,
                             param_rules=dict(self.param_rules),
                             data_axes=self._requested_data_axes,
-                            shard_min_size=self.shard_min_size)
+                            shard_min_size=self.shard_min_size,
+                            grad_sync=self.grad_sync,
+                            grad_sync_block=self.grad_sync_block,
+                            grad_sync_error_feedback=self
+                            .grad_sync_error_feedback)
         plan.pspecs = dict(self.pspecs)
         if hasattr(self, "_pid_to_name"):
             plan._pid_to_name = dict(self._pid_to_name)
@@ -524,5 +545,99 @@ class ShardingPlan:
             # place inputs (no-op if already placed)
             return cache[sig](params, buffers, opt_state, master,
                               scaler_state, step_i, lr, key, batch)
+
+        return run
+
+    # -- quantized grad-sync TrainStep hook (ISSUE 8) -----------------------
+    def quant_sync_axis(self):
+        """(axis_name, size) of the single data-parallel mesh axis the
+        quantized grad sync reduces over; raises when the plan has no
+        (or more than one) non-trivial data axis — the chain's
+        all_to_all/all_gather decomposition is built per axis."""
+        axes = [a for a in self.data_axes if self.mesh.shape[a] > 1]
+        if len(axes) != 1:
+            raise ValueError(
+                f"quantized grad sync needs exactly one data-parallel "
+                f"mesh axis of size > 1, plan has {axes or 'none'} "
+                f"(mesh {dict(self.mesh.shape)})")
+        return axes[0], int(self.mesh.shape[axes[0]])
+
+    def compile_quantized_train_step(self, pure_local, donate):
+        """Compile the quantized-grad-sync step: `pure_local` is the
+        PER-SHARD body (jit.TrainStep builds it — step_fn + backward +
+        collective.grad_sync_all_reduce on every grad + update), wrapped
+        here in shard_map over the plan's data axis so each shard sees
+        its local batch slice and the explicit quantized chain replaces
+        the implicit GSPMD psum. Params/optimizer state stay replicated
+        (enforced); the error-feedback residual tree rides sharded on
+        the sync axis (one per-rank residual slice each)."""
+        from jax.experimental.shard_map import shard_map
+
+        mesh = self.mesh
+        axis, _n = self.quant_sync_axis()
+        repl = NamedSharding(mesh, P())
+
+        def _check_replicated(params):
+            for name in params:
+                spec = self.param_spec(name, params[name])
+                if any(e is not None for e in tuple(spec)):
+                    raise ValueError(
+                        f"quantized grad sync requires fully replicated "
+                        f"parameters, but {name!r} has layout {spec} — "
+                        f"drop the TP annotation/param_rules or disable "
+                        f"grad_sync")
+
+        def compiled_factory(params, buffers, opt_state, master,
+                             scaler_state, step_i, lr, key, batch, ef):
+            _check_replicated(params)
+            batch_specs = jax.tree_util.tree_map(
+                lambda a: P(axis) if getattr(a, "ndim", 0) else P(), batch)
+            ef_specs = jax.tree_util.tree_map(lambda a: P(axis), ef)
+            in_specs = (P(), P(), P(), P(), P(), P(), P(), P(),
+                        batch_specs, ef_specs)
+            out_specs = (P(), P(), P(), P(), P(), P(), ef_specs)
+            fn = shard_map(pure_local, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_rep=False)
+            batch_sh = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), batch_specs)
+            ef_sh = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), ef_specs)
+            in_shardings = (
+                {k: repl for k in params}, {k: repl for k in buffers},
+                {k: repl for k in opt_state}, {k: repl for k in master},
+                {k: repl for k in scaler_state}, repl, repl, repl,
+                batch_sh, ef_sh)
+            # opt_state/master can widen inside the first step (lazily
+            # created slots) — shape-infer the output tree abstractly,
+            # same reasoning as compile_train_step
+            out_abs = jax.eval_shape(fn, params, buffers, opt_state,
+                                     master, scaler_state, step_i, lr,
+                                     key, batch, ef)
+            _, p_abs, b_abs, os_abs, mw_abs, sc_abs, _ef_abs = out_abs
+            out_shardings = (
+                repl, {k: repl for k in p_abs}, {k: repl for k in b_abs},
+                {k: repl for k in os_abs}, {k: repl for k in mw_abs},
+                {k: repl for k in sc_abs}, ef_sh)
+            return jax.jit(fn, in_shardings=in_shardings,
+                           out_shardings=out_shardings,
+                           donate_argnums=donate)
+
+        cache = {}
+
+        def run(params, buffers, opt_state, master, scaler_state, step_i,
+                lr, key, batch, ef):
+            struct = jax.tree_util.tree_structure(
+                (params, buffers, opt_state, master, scaler_state, batch,
+                 ef))
+            shapes = tuple(
+                (a.shape, str(a.dtype)) for a in
+                jax.tree_util.tree_leaves((params, opt_state, batch)))
+            sig = (struct, shapes)
+            if sig not in cache:
+                cache[sig] = compiled_factory(params, buffers, opt_state,
+                                              master, scaler_state, step_i,
+                                              lr, key, batch, ef)
+            return cache[sig](params, buffers, opt_state, master,
+                              scaler_state, step_i, lr, key, batch, ef)
 
         return run

@@ -337,6 +337,16 @@ _flags: dict = {
     # attention + chunked-prefill continuous batching; 0 is the kill
     # switch restoring the bucketed-prefill engine exactly
     "FLAGS_ragged_attention": True,
+    # -- quantized collectives (consumed by distributed/collective.py +
+    # the jit.TrainStep/ShardingPlan grad-sync seam): armed capability
+    # for the blockwise int8/fp8 communication path — quantization still
+    # needs an explicit opt-in at the call site (all_reduce(quantized=)
+    # or ShardingPlan(grad_sync=)); 0 is the kill switch restoring the
+    # exact psum/GSPMD paths bitwise even for opted-in callers. The
+    # block knob sets the absmax-scale granularity (elements per f32
+    # scale on the wire).
+    "FLAGS_quant_collectives": True,
+    "FLAGS_quant_collectives_block": 256,
     "FLAGS_cudnn_exhaustive_search": False,     # alias: force sweeps
     # -- numerics (consumed in _apply_flag -> jax matmul precision) ----
     "FLAGS_gemm_use_half_precision_compute_type": True,

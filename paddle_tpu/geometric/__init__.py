@@ -113,31 +113,57 @@ segment_max = _segment_api("max")
 segment_min = _segment_api("min")
 
 
+def _candidate_edge_keys(total):
+    """Device-side sampling keys (ISSUE 4 follow-on, ported in ISSUE 8):
+    ONE jax.random draw of `total` uniforms — one per candidate edge of
+    the batch, consumed segment-by-segment by the caller — pulled to
+    host in a single bulk transfer. Replaces the per-call
+    `int(jax.random.randint(...))` scalar sync (a blocking per-element
+    device->host pull graft-lint grandfathered) that used to seed a
+    host-side numpy Generator — the randomness now comes from the
+    device PRNG stream, and the only host traffic is one bulk copy.
+    Sampling-without-replacement = take the k smallest keys of a node's
+    segment (a random permutation ranked by iid uniforms)."""
+    import numpy as np
+
+    from ..framework import core
+    if not total:
+        return np.zeros(0, np.float32)
+    return np.asarray(jax.random.uniform(
+        core.next_rng_key(), (int(total),), jnp.float32))
+
+
 def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
                      eids=None, return_eids=False, perm_buffer=None,
                      name=None):
     """ref: geometric/sampling/neighbors.py graph_sample_neighbors — CSC
-    neighbor sampling (host-side: sampling sizes are data-dependent, the
-    reference kernel is also a host-driven op)."""
+    neighbor sampling. The ragged gather/assembly is host-side (sampling
+    sizes are data-dependent; the reference kernel is also host-driven),
+    but the randomness is device `jax.random` via _candidate_edge_keys."""
     import numpy as np
 
-    from ..framework import core
     from ..ops._helpers import unwrap
     from ..tensor import Tensor
 
     r = np.asarray(unwrap(row))
     cp = np.asarray(unwrap(colptr))
     nodes = np.asarray(unwrap(input_nodes)).reshape(-1)
-    rng = np.random.default_rng(int(jax.random.randint(
-        core.next_rng_key(), (), 0, 2 ** 31 - 1)))
+    degs = cp[nodes + 1] - cp[nodes] if nodes.size else np.zeros(0, cp.dtype)
+    need_keys = 0 < sample_size
+    keys = _candidate_edge_keys(degs.sum()) if need_keys else None
     out_n, out_count, out_eids = [], [], []
+    off = 0
     for n in nodes:
         beg, end = int(cp[n]), int(cp[n + 1])
+        d = end - beg
         neigh = r[beg:end]
         ids = np.arange(beg, end)
-        if 0 < sample_size < len(neigh):
-            pick = rng.choice(len(neigh), size=sample_size, replace=False)
+        if 0 < sample_size < d:
+            seg = keys[off:off + d]
+            pick = np.argpartition(seg, sample_size)[:sample_size]
             neigh, ids = neigh[pick], ids[pick]
+        if need_keys:
+            off += d
         out_n.append(neigh)
         out_eids.append(ids)
         out_count.append(len(neigh))
@@ -158,10 +184,12 @@ graph_sample_neighbors = sample_neighbors
 def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
                               sample_size=-1, eids=None, return_eids=False,
                               name=None):
-    """ref: geometric weighted_sample_neighbors — weight-proportional."""
+    """ref: geometric weighted_sample_neighbors — weight-proportional,
+    via the Efraimidis–Spirakis exponential-race keys (-log(u)/w, keep
+    the k smallest) over the same device `jax.random` uniforms as
+    sample_neighbors."""
     import numpy as np
 
-    from ..framework import core
     from ..ops._helpers import unwrap
     from ..tensor import Tensor
 
@@ -169,19 +197,28 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
     cp = np.asarray(unwrap(colptr))
     w = np.asarray(unwrap(edge_weight)).astype(np.float64)
     nodes = np.asarray(unwrap(input_nodes)).reshape(-1)
-    rng = np.random.default_rng(int(jax.random.randint(
-        core.next_rng_key(), (), 0, 2 ** 31 - 1)))
+    degs = cp[nodes + 1] - cp[nodes] if nodes.size else np.zeros(0, cp.dtype)
+    need_keys = 0 < sample_size
+    keys = _candidate_edge_keys(degs.sum()) if need_keys else None
     out_n, out_count, out_eids = [], [], []
+    off = 0
     for n in nodes:
         beg, end = int(cp[n]), int(cp[n + 1])
+        d = end - beg
         neigh = r[beg:end]
         ids = np.arange(beg, end)
-        if 0 < sample_size < len(neigh):
-            p = w[beg:end]
-            p = p / p.sum() if p.sum() > 0 else None
-            pick = rng.choice(len(neigh), size=sample_size, replace=False,
-                              p=p)
+        if 0 < sample_size < d:
+            u = keys[off:off + d].astype(np.float64)
+            ws = w[beg:end]
+            if ws.sum() > 0:
+                with np.errstate(divide="ignore"):
+                    race = -np.log(np.maximum(u, 1e-12)) / ws
+            else:
+                race = u          # all-zero weights: uniform fallback
+            pick = np.argpartition(race, sample_size)[:sample_size]
             neigh, ids = neigh[pick], ids[pick]
+        if need_keys:
+            off += d
         out_n.append(neigh)
         out_eids.append(ids)
         out_count.append(len(neigh))

@@ -76,9 +76,12 @@ def quantize_state_int8(state: Dict[str, jax.Array], min_size=4096):
     """Per-output-channel absmax int8 quantization of 2-D+ weights
     (ref: PTQ AbsmaxObserver rule; embeddings/norms stay full precision —
     norm scales are 1-D, embedding rows are gathered not matmul'd).
+    The scale plumbing is quantization/comm.py's — the same rounding/
+    clipping rules the quantized collectives put on the wire (ISSUE 8).
 
     Returns a pytree where quantized entries are `(q_int8, scale_f32)`
-    tuples; `dequantize_entry` restores them in-trace."""
+    tuples; `_dequant_state` restores them in-trace."""
+    from ..quantization import comm as _qcomm
     out = {}
     for k, v in state.items():
         arr = v
@@ -86,11 +89,7 @@ def quantize_state_int8(state: Dict[str, jax.Array], min_size=4096):
                 and jnp.issubdtype(arr.dtype, jnp.floating)
                 and arr.size >= min_size
                 and "embed" not in k and "norm" not in k):
-            a32 = arr.astype(jnp.float32)
-            scale = jnp.max(jnp.abs(a32), axis=0, keepdims=True) / 127.0
-            scale = jnp.maximum(scale, 1e-8)
-            q = jnp.clip(jnp.round(a32 / scale), -127, 127).astype(jnp.int8)
-            out[k] = (q, scale.astype(jnp.float32))
+            out[k] = _qcomm.channelwise_absmax_int8(arr, axis=0)
         else:
             out[k] = arr
     return out
@@ -99,7 +98,8 @@ def quantize_state_int8(state: Dict[str, jax.Array], min_size=4096):
 def _dequant_state(state, dtype):
     """In-trace: (int8, scale) -> dtype weight; XLA fuses the convert +
     scale into the consuming dot's operand read."""
-    return {k: ((v[0].astype(jnp.float32) * v[1]).astype(dtype)
+    from ..quantization import comm as _qcomm
+    return {k: (_qcomm.dequantize_channelwise(v[0], v[1], dtype)
                 if isinstance(v, tuple) else v)
             for k, v in state.items()}
 

@@ -213,6 +213,33 @@ def ignore_module(modules):
     return None
 
 
+def _quant_sync_grads(model, ef, axis, nranks, cfg):
+    """Quantized data-parallel gradient sync (ISSUE 8): inside the
+    shard_map-wrapped step body, replace every trainable param's LOCAL
+    grad with the blockwise-quantized mean over the `axis` shards
+    (collective.grad_sync_all_reduce — the explicit EQuARX chain that
+    stands in for the implicit GSPMD psum). `ef` carries this shard's
+    error-feedback residuals ((1, padded) slices of the dp-sharded
+    state); returns the updated residual tree."""
+    from ..distributed import collective as _coll
+    from ..tensor import Parameter
+    new_ef = dict(ef or {})
+    for k, t in model.state_dict().items():
+        if not (isinstance(t, Parameter) and not t.stop_gradient):
+            continue
+        g = t.grad
+        if g is None:
+            continue
+        garr = g.data if isinstance(g, Tensor) else g
+        res = ef[k].reshape(-1) if ef and k in ef else None
+        synced, new_res = _coll.grad_sync_all_reduce(
+            garr, axis=axis, nranks=nranks, cfg=cfg, residual=res)
+        t.grad = Tensor(synced)
+        if new_res is not None and ef and k in ef:
+            new_ef[k] = new_res.reshape(ef[k].shape)
+    return new_ef
+
+
 class TrainStep:
     """One-call compiled training step: forward + backward + optimizer update
     in a single XLA executable (the TPU-native answer to the reference's
@@ -246,6 +273,18 @@ class TrainStep:
         self.shard = shard
         if shard is not None and hasattr(shard, "attach_model"):
             shard.attach_model(model)
+        if shard is not None and getattr(shard, "grad_sync", None):
+            if scaler is not None:
+                raise ValueError(
+                    "quantized grad sync (ShardingPlan(grad_sync=...)) is "
+                    "incompatible with a GradScaler: the chain reduces "
+                    "unscaled f32 gradients (bf16 training does not need "
+                    "loss scaling)")
+            if int(accumulate_steps) > 1:
+                raise ValueError(
+                    "quantized grad sync does not compose with "
+                    "accumulate_steps > 1 yet — the gradient-merge scan "
+                    "owns the backward/update interleaving")
         # make the plan visible to DataLoader prefetchers so batches
         # stage straight into the mesh layout (io/prefetch.py picks up
         # the active plan's batch_spec at iteration time). Latest step
@@ -257,6 +296,8 @@ class TrainStep:
         self._donate = donate
         self._key_base = None     # per-instance RNG base (see __call__)
         self._accum = int(accumulate_steps)
+        self._quant = None        # (axis, nranks, CommQuantConfig) at build
+        self._ef_state = None     # error-feedback residuals (dp-sharded)
         if self._accum > 1 and scaler is not None:
             raise ValueError(
                 "accumulate_steps > 1 is incompatible with a GradScaler: "
@@ -266,12 +307,51 @@ class TrainStep:
     def _capture_state(self):
         return capture_state(self.model)
 
+    def _ensure_ef_state(self, params):
+        """Allocate the error-feedback residual tree on first use: one
+        zero (nranks, padded) f32 array per trainable param, sharded on
+        the sync axis so each dp shard carries its OWN residual across
+        steps (optimizer-adjacent state — it is this TrainStep's, not
+        the optimizer dict's, because it is per-rank rather than
+        replicated). Empty when error feedback is off."""
+        axis, nranks, cfg = self._quant
+        if not cfg.error_feedback:
+            return {}
+        if self._ef_state is None:
+            import numpy as _np
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+
+            from ..quantization import comm as _qcomm
+            sharding = NamedSharding(self.shard.mesh, _P(axis))
+            self._ef_state = {
+                k: jax.device_put(
+                    _np.zeros(
+                        (nranks,
+                         _qcomm.shard_sizes(v.size, nranks, cfg.block)[1]),
+                        _np.float32), sharding)
+                for k, v in params.items()}
+        return self._ef_state
+
     def _build(self):
         model = self.model
         opt = self.optimizer
         step_fn = self.step_fn
         scaler = self.scaler
         accum = self._accum
+        # quantized grad sync arms at BUILD time so the kill switch
+        # (FLAGS_quant_collectives=0) restores the plain GSPMD-psum
+        # compile path bitwise, opted-in plan or not
+        quant = None
+        if self.shard is not None and \
+                getattr(self.shard, "grad_sync", None) and \
+                core.get_bool_flag("FLAGS_quant_collectives", True):
+            from ..quantization import comm as _qcomm
+            axis, nranks = self.shard.quant_sync_axis()
+            cfg = _qcomm.resolve_config(
+                self.shard.grad_sync, self.shard.grad_sync_block,
+                self.shard.grad_sync_error_feedback)
+            quant = (axis, nranks, cfg)
+        self._quant = quant
 
         def run_accum(batch, key):
             """Gradient-merge path: lax.scan over k micro-batches, grads
@@ -341,7 +421,7 @@ class TrainStep:
             return _TT(loss_sum * inv_k)
 
         def pure(params, buffers, opt_state, master, scaler_state, step_i,
-                 lr, key, batch):
+                 lr, key, batch, ef=None):
             # key travels as raw uint32 key-data (host numpy — typed PRNG
             # keys are committed device arrays, which a multi-process
             # mesh jit cannot accept); rewrap to a typed key here. The
@@ -351,6 +431,12 @@ class TrainStep:
             key = jax.random.wrap_key_data(key)
             key = jax.random.fold_in(
                 jax.random.fold_in(key, 0x54524E), step_i)
+            if quant is not None:
+                # per-shard randomness: the body runs once per dp shard
+                # (shard_map), each on its own batch slice — distinct
+                # dropout masks per shard, like the GSPMD global mask
+                key = jax.random.fold_in(
+                    key, jax.lax.axis_index(quant[0]))
             state = {}
             state.update(params)
             state.update(buffers)
@@ -374,7 +460,18 @@ class TrainStep:
                     if scaler is not None:
                         scaler._set_traced_state(scaler_state)
                     try:
-                        if scaler is not None:
+                        new_ef = ef
+                        if quant is not None:
+                            # quantized DP sync: the body is per-shard
+                            # (shard_map) so backward yields LOCAL
+                            # grads; the explicit quantized chain is
+                            # their mean before the update
+                            loss = step_fn(*_tree_box(batch))
+                            loss.backward()
+                            new_ef = _quant_sync_grads(
+                                model, ef, quant[0], quant[1], quant[2])
+                            opt.step()
+                        elif scaler is not None:
                             loss = step_fn(*_tree_box(batch))
                             scaler.scale(loss).backward()
                             scaler.step(opt)
@@ -402,6 +499,19 @@ class TrainStep:
                         opt._lr = saved_lr
                         if scaler is not None:
                             scaler._set_traced_state(saved_scaler)
+            if quant is not None:
+                # global loss = mean of the per-shard means; float
+                # buffers (BatchNorm running stats) likewise averaged so
+                # the replicated outputs are well-defined — each shard
+                # saw only its batch slice
+                axis = quant[0]
+                new_buffers = {
+                    k: (jax.lax.pmean(v, axis)
+                        if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                    for k, v in new_buffers.items()}
+                return (jax.lax.pmean(loss.data, axis), new_params,
+                        new_buffers, new_opt_state, new_master,
+                        new_scaler, new_ef)
             return (loss.data, new_params, new_buffers, new_opt_state,
                     new_master, new_scaler)
 
@@ -415,7 +525,13 @@ class TrainStep:
         donate_ok = self._donate and (
             force_inplace or float(flag_gb or 0.0) >= 0.0)
         donate = (0, 1, 2, 3) if donate_ok else ()
-        if self.shard is not None:
+        if quant is not None:
+            # the error-feedback residual tree (arg 9) is donated too:
+            # it is consumed and returned every step
+            qdonate = donate + (9,) if donate_ok else ()
+            self._compiled = self.shard.compile_quantized_train_step(
+                pure, qdonate)
+        elif self.shard is not None:
             self._compiled = self.shard.compile_train_step(pure, donate)
         else:
             self._compiled = jax.jit(pure, donate_argnums=donate)
@@ -469,11 +585,21 @@ class TrainStep:
         if bench:
             import time as _time
             _t0 = _time.perf_counter()
-        (loss, new_params, new_buffers, new_opt_state, new_master,
-         new_scaler) = \
-            self._compiled(params, buffers, dict(opt._state),
-                           dict(opt._master_weights), scaler_state, step_i,
-                           lr, key, batch_arrays)
+        if self._quant is not None:
+            ef = self._ensure_ef_state(params)
+            (loss, new_params, new_buffers, new_opt_state, new_master,
+             new_scaler, new_ef) = \
+                self._compiled(params, buffers, dict(opt._state),
+                               dict(opt._master_weights), scaler_state,
+                               step_i, lr, key, batch_arrays, ef)
+            if new_ef:
+                self._ef_state = new_ef
+        else:
+            (loss, new_params, new_buffers, new_opt_state, new_master,
+             new_scaler) = \
+                self._compiled(params, buffers, dict(opt._state),
+                               dict(opt._master_weights), scaler_state,
+                               step_i, lr, key, batch_arrays)
         sd = self.model.state_dict()
         for k, v in new_params.items():
             sd[k].data = v

@@ -19,9 +19,17 @@ from ..nn.layer.layers import Layer
 from ..ops._helpers import to_tensor_like
 from ..tensor import Tensor
 
+from . import comm  # noqa: F401  (communication quantization plumbing)
+from .comm import (  # noqa: F401
+    CommQuantConfig, channelwise_absmax_int8, dequantize_blocks,
+    dequantize_channelwise, quantize_blocks, supports_fp8,
+)
+
 __all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver",
            "MovingAverageObserver", "FakeQuant", "QuantedLinear",
-           "quant_dequant"]
+           "quant_dequant", "comm", "CommQuantConfig", "quantize_blocks",
+           "dequantize_blocks", "channelwise_absmax_int8",
+           "dequantize_channelwise", "supports_fp8"]
 
 
 import functools

@@ -1,0 +1,162 @@
+"""Communication-quantization plumbing (EQuARX, arxiv 2506.17615).
+
+Shared scale/zero-point helpers for every low-precision byte-mover in
+the framework, so the wire format is decided in ONE place:
+
+  * the quantized collectives behind `distributed/collective.py`
+    (blockwise absmax over flat payloads, int8 / fp8-e4m3 wire dtypes,
+    the two-phase reduce_scatter -> all_gather chain's quantize points);
+  * the weight-only int8 serving path (`inference/serving.py`
+    `quantize_state_int8` — per-output-channel absmax, same rounding
+    and clipping rules as the wire path);
+  * AMP capability probes (`paddle_tpu.amp.is_float8_supported`).
+
+Everything here is pure jnp and trace-safe: the collective chain calls
+these INSIDE shard_map/jit bodies.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+#: wire modes -> (qmax, wire dtype name). int8 is symmetric [-127, 127]
+#: (the -128 code is unused so negation round-trips); fp8-e4m3 has no
+#: shared exponent, absmax scaling maps the block max onto +-448 (the
+#: e4m3fn finite max) and the cast does the rounding.
+_QMAX = {"int8": 127.0, "fp8": 448.0}
+
+MODES = tuple(_QMAX)
+
+# floor for absmax so all-zero blocks quantize to exact zeros instead
+# of dividing by zero (any positive value works: 0/scale == 0)
+_EPS = 1e-30
+
+_fp8_supported: Optional[bool] = None
+
+
+def supports_fp8() -> bool:
+    """True when this jax ships float8_e4m3fn and the backend can cast
+    to it (the fp8 wire mode's availability gate; also the probe behind
+    `paddle_tpu.amp.is_float8_supported`)."""
+    global _fp8_supported
+    if _fp8_supported is None:
+        try:
+            jnp.zeros((2,), jnp.float32).astype(jnp.float8_e4m3fn)
+            _fp8_supported = True
+        except (AttributeError, TypeError, RuntimeError):
+            _fp8_supported = False
+    return _fp8_supported
+
+
+def qmax(mode: str) -> float:
+    if mode not in _QMAX:
+        raise ValueError(
+            f"unknown comm-quant mode {mode!r}; expected one of {MODES}")
+    return _QMAX[mode]
+
+
+def wire_dtype(mode: str):
+    """The dtype actually put on the wire for `mode` (1 byte/element
+    for both supported modes)."""
+    qmax(mode)
+    if mode == "fp8":
+        if not supports_fp8():
+            raise ValueError(
+                "fp8 communication quantization needs jnp.float8_e4m3fn "
+                "(unavailable on this jax) — use mode='int8'")
+        return jnp.float8_e4m3fn
+    return jnp.int8
+
+
+@dataclass(frozen=True)
+class CommQuantConfig:
+    """Resolved wire format of one quantized collective: `mode` picks
+    the element dtype, `block` the absmax-scale granularity (one f32
+    scale per `block` contiguous elements of the flattened payload),
+    `error_feedback` whether the caller carries a compensation residual
+    across calls."""
+    mode: str = "int8"
+    block: int = 256
+    error_feedback: bool = False
+
+    def __post_init__(self):
+        qmax(self.mode)
+        if self.block < 1:
+            raise ValueError(f"block must be >= 1, got {self.block}")
+
+    @property
+    def wire_bytes_per_element(self) -> float:
+        """Wire cost per payload element: 1 quantized byte + this
+        element's share of its block's f32 scale."""
+        return 1.0 + 4.0 / self.block
+
+
+def resolve_config(mode=None, block=None,
+                   error_feedback: bool = False) -> CommQuantConfig:
+    """Fill unset knobs from the flag registry (`mode=True` means "the
+    default mode"): block defaults to FLAGS_quant_collectives_block."""
+    from ..framework import core
+    if mode is None or mode is True:
+        mode = "int8"
+    if block is None:
+        block = int(float(core.get_flag("FLAGS_quant_collectives_block",
+                                        256) or 256))
+    return CommQuantConfig(mode=str(mode), block=int(block),
+                           error_feedback=bool(error_feedback))
+
+
+def shard_sizes(numel: int, nranks: int, block: int) -> Tuple[int, int]:
+    """(per-shard elements, padded total) for an `numel`-element payload
+    split across `nranks`: the shard is rounded up to a whole number of
+    scale blocks so every rank quantizes aligned blocks. Shared by the
+    collective chain and the error-feedback state allocator in
+    jit.TrainStep — both must agree on the padded layout."""
+    shard = -(-numel // nranks)
+    shard = -(-shard // block) * block
+    return shard, shard * nranks
+
+
+def quantize_blocks(x, block: int, mode: str):
+    """Blockwise absmax quantization of `x` (..., S) with S % block == 0.
+
+    Returns (q, scales): q has x's shape in the wire dtype, scales is
+    (..., S // block) float32 with scale = absmax / qmax per block —
+    dequantization is `q * scale` elementwise over blocks."""
+    qm = qmax(mode)
+    lead, s = x.shape[:-1], x.shape[-1]
+    if s % block:
+        raise ValueError(f"last dim {s} not a multiple of block {block}")
+    b = x.astype(jnp.float32).reshape(lead + (s // block, block))
+    scales = jnp.maximum(jnp.max(jnp.abs(b), axis=-1), _EPS) / qm
+    y = b / scales[..., None]
+    if mode == "int8":
+        q = jnp.clip(jnp.round(y), -qm, qm).astype(jnp.int8)
+    else:
+        q = y.astype(wire_dtype(mode))
+    return q.reshape(x.shape), scales
+
+
+def dequantize_blocks(q, scales, block: int):
+    """Inverse of quantize_blocks: float32 result of q's shape."""
+    lead, s = q.shape[:-1], q.shape[-1]
+    b = q.astype(jnp.float32).reshape(lead + (s // block, block))
+    return (b * scales[..., None]).reshape(q.shape)
+
+
+def channelwise_absmax_int8(arr, axis: int = 0):
+    """Per-channel absmax int8 quantization (the weight-only serving
+    rule: one f32 scale per output channel, keepdims so `q * scale`
+    broadcasts back). Returns (q_int8, scale_f32)."""
+    a32 = arr.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(a32), axis=axis, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(a32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_channelwise(q, scale, dtype):
+    """Inverse of channelwise_absmax_int8 in the target compute dtype
+    (in-trace: XLA fuses the convert + scale into the consuming dot)."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)

@@ -1,0 +1,23 @@
+"""Known-bad fixture: the QUANTIZED collective chain (ISSUE 8) inside
+rank-conditional code. The quantize -> reduce_scatter -> all_gather
+decomposition deadlocks across ranks exactly like its exact
+counterparts — the new call names must not be a lint blind spot."""
+import jax
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.collective import quantized_all_reduce
+
+
+def rank_gated_quant_chain(t, parts, rank, group):
+    if rank == 0:
+        # the EQuARX two-phase shape, all three calls divergent: ranks
+        # != 0 never quantize/exchange and the others park forever
+        dist.quantized_reduce_scatter(t, parts, group=group)  # phase 1
+        t.data = jax.lax.all_gather(t.data, "dp")             # phase 2
+    return t
+
+
+def early_return_then_quant_reduce(t, group):
+    if dist.get_rank() != 0:
+        return t
+    return quantized_all_reduce(t, group=group)   # rank 0 waits forever

@@ -146,6 +146,21 @@ def test_collective_order_group_subsets_still_catch_misuse():
                for m in msgs) == 2
 
 
+def test_collective_order_covers_quantized_collectives():
+    """ISSUE 8: the quantized chain's call names (quantized_all_reduce /
+    quantized_reduce_scatter + the lax phase-2 all_gather) are flagged
+    inside rank-conditional code — no blind spot for the new ops."""
+    res = _run([CollectiveOrderPass()],
+               paths=[FIXTURES / "collective_order_quant_bad.py"])
+    msgs = [f.message for f in res.active]
+    assert len(msgs) == 3, "\n".join(msgs)
+    assert any("quantized_reduce_scatter" in m for m in msgs)
+    assert any("lax.all_gather" in m for m in msgs)
+    assert any("quantized_all_reduce" in m and
+               "after the rank-conditional early return" in m
+               for m in msgs)
+
+
 # -- flags-hygiene -----------------------------------------------------------
 
 def test_flags_hygiene_catches_typo():

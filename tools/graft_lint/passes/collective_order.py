@@ -57,6 +57,12 @@ COLLECTIVES = {
     "broadcast_object_list", "reduce", "reduce_scatter", "scatter",
     "alltoall", "alltoall_single", "barrier", "send", "recv", "isend",
     "irecv",
+    # quantized collectives (ISSUE 8): the two-phase quantize ->
+    # reduce_scatter -> all_gather chain deadlocks across ranks exactly
+    # like its exact counterparts — the new call names must not be a
+    # blind spot
+    "quantized_all_reduce", "quantized_reduce_scatter",
+    "grad_sync_all_reduce",
 }
 LAX_COLLECTIVES = {
     "psum", "pmax", "pmin", "pmean", "psum_scatter", "all_gather",
