@@ -87,6 +87,38 @@ def _helper_alive(timeout: float = 3.0) -> bool:
         s.close()
 
 
+def _reprobe_helper_and_unpin() -> bool:
+    """ROADMAP MFU item (b), second half: the bench already self-defends
+    when the axon compile helper is DOWN (stale re-emit / CPU smoke);
+    this is the recovery edge. When a driver environment carries a
+    JAX_PLATFORMS=cpu pin from an earlier wedged round while the axon
+    pool is still configured, probe 127.0.0.1:8083 at the TOP of every
+    run — the moment the helper answers again, re-exec WITHOUT the cpu
+    pin (sitecustomize re-pins axon,cpu at interpreter start) so this
+    round re-measures ON-CHIP instead of appending another stale CPU
+    line to BENCH_TREND. Returns False when no re-exec applies; on
+    re-exec it never returns."""
+    if os.environ.get("BENCH_NO_FALLBACK"):
+        return False                 # explicit "stay where you are"
+    if os.environ.get("BENCH_HELPER_REPROBED"):
+        return False                 # one re-exec per run: no loops
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        return False                 # not pinned off the chip
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return False                 # no axon pool: the cpu pin is real
+    if not _helper_alive():
+        return False                 # still down: CPU run proceeds
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["BENCH_HELPER_REPROBED"] = "1"
+    print("bench: axon compile helper is back on 127.0.0.1:8083 — "
+          "re-exec without the cpu pin for a fresh on-chip measurement",
+          file=sys.stderr)
+    os.execve(sys.executable,
+              [sys.executable, os.path.abspath(__file__)], env)
+    return True                      # unreachable (execve replaces us)
+
+
 def _emit_stale_or_cpu(reason: str):
     """TPU path is unusable: prefer re-emitting the LAST GOOD on-chip
     artifact with a stale marker (a real chip number, clearly labelled)
@@ -251,6 +283,12 @@ def _emit(record: dict, on_tpu: bool):
     the last-good artifact so a later wedged session can re-emit a real
     chip number (marked stale) instead of a CPU smoke line. Every fresh
     emit appends to the cross-round trend series (extra.trend)."""
+    if os.environ.get("BENCH_HELPER_REPROBED"):
+        # this run exists because the top-of-run probe found the axon
+        # helper back up — say so in the artifact (trend readers see
+        # WHY the series resumed on-chip)
+        record.setdefault("extra", {})
+        record["extra"]["helper_recovered"] = True
     _attach_trend(record, append=True)
     print(json.dumps(record))
     if on_tpu:
@@ -411,6 +449,8 @@ def _bench_other(size, devs, on_tpu):
 
 def main():
     import numpy as np
+
+    _reprobe_helper_and_unpin()
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         # honor the CPU-fallback re-exec even though sitecustomize force-

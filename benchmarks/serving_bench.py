@@ -1,6 +1,13 @@
 #!/usr/bin/env python
 """Serving benchmark: mixed prefill+decode continuous batching, chunked
-ragged regime vs the serialized bucketed-prefill baseline.
+ragged regime vs the serialized bucketed-prefill baseline — plus the
+ISSUE 10 resilience guards: `FLAGS_serving_slo=0` kill-switch parity on
+the mixed workload (token-identical outputs AND an identical scheduling
+trace vs the SLO engine with inert defaults) and an OVERLOAD scenario
+(arrival rate ~2x capacity, mixed priorities) guarding that
+high-priority p99 TTFT beats the FIFO baseline by >= SLO_MIN_TTFT_RATIO
+and that zero requests wedge: every accepted submit terminates in
+served / shed / deadline-missed.
 
 The workload is the serving pathology the ISSUE names: short
 conversations are DECODING when long prompts arrive mid-run. The
@@ -43,6 +50,7 @@ from paddle_tpu.models.llama import (LlamaConfig,  # noqa: E402
 from paddle_tpu.observability import metrics  # noqa: E402
 
 MIN_SPEEDUP = float(os.environ.get("BENCH_MIN_SPEEDUP", "1.5"))
+MIN_TTFT_RATIO = float(os.environ.get("SLO_MIN_TTFT_RATIO", "2.0"))
 MAX_SEQ = 128
 BUCKETS = (8, 16, 32, 64, 128)
 CHUNK = int(os.environ.get("BENCH_CHUNK_TOKENS", "32"))
@@ -74,21 +82,26 @@ def _workload():
 
 
 def _drive(engine, jobs, max_ticks=4000):
-    """Tick-indexed arrivals: deterministic, identical for both engines."""
+    """Tick-indexed arrivals: deterministic, identical for both engines.
+    Also records the per-tick scheduling TRACE (packed tokens, finished
+    count, preemptions) — the kill-switch parity evidence."""
     reqs = [GenerationRequest(list(p), max_new_tokens=n)
             for _, p, n in jobs]
     pending = sorted(zip([t for t, _, _ in jobs], reqs),
                      key=lambda x: x[0])
     t0 = time.perf_counter()
     tick = 0
+    trace = []
     while (pending or engine.has_work) and tick < max_ticks:
         while pending and pending[0][0] <= tick:
             engine.add_request(pending.pop(0)[1])
         engine.step()
+        trace.append((engine.last_packed_tokens, len(engine.finished),
+                      engine.preemptions))
         tick += 1
     dt = time.perf_counter() - t0
     assert not engine.has_work and not pending, "bench failed to drain"
-    return dt, reqs, tick
+    return dt, reqs, tick, trace
 
 
 def _snapshot_serving():
@@ -96,34 +109,115 @@ def _snapshot_serving():
     out = {}
     for hist in ("serving.ttft_seconds", "serving.tpot_seconds",
                  "serving.packed_tokens_per_tick"):
-        cell = snap["histograms"].get(hist, {}).get("")
-        if cell:
-            out[hist] = {"count": cell["count"],
-                         "mean": round(cell["sum"] / max(cell["count"], 1),
-                                       6)}
+        # TTFT/TPOT carry a priority label when the SLO layer is armed
+        # (the default) — aggregate across label cells
+        cells = list(snap["histograms"].get(hist, {}).values())
+        if cells:
+            count = sum(c["count"] for c in cells)
+            total = sum(c["sum"] for c in cells)
+            out[hist] = {"count": count,
+                         "mean": round(total / max(count, 1), 6)}
     cnt = snap["counters"].get("serving.preemptions_total", {}).get("")
     out["serving.preemptions_total"] = cnt or 0
     return out
 
 
-def run(model, jobs, ragged):
+def run(model, jobs, ragged, slo=None):
     metrics.reset()
+    kw = {} if slo is None else {"slo": slo}
+    # degradation pinned OFF for the mixed-workload runs: this bench is
+    # the PR 7 throughput regression guard AND the kill-switch parity
+    # trace — pool-pressure-driven chunk shrinking would make the armed
+    # run legitimately diverge from the FIFO trace the moment the
+    # workload fills the pool (the overload scenario below exercises
+    # the SLO policies on purpose)
     eng = ContinuousBatchingEngine(model, max_batch=4, max_seq=MAX_SEQ,
                                    prefill_buckets=BUCKETS,
-                                   max_chunk_tokens=CHUNK, ragged=ragged)
+                                   max_chunk_tokens=CHUNK, ragged=ragged,
+                                   degrade_high_water=2.0, **kw)
     # identical warmup for both regimes: compile the steady-state step
     w = GenerationRequest([3, 5], max_new_tokens=2)
     eng.add_request(w)
     while eng.has_work:
         eng.step()
     eng.finished.clear()
-    dt, reqs, ticks = _drive(eng, jobs)
+    dt, reqs, ticks, trace = _drive(eng, jobs)
     tokens = sum(len(r.output) for r in reqs)
     return {"seconds": dt, "tokens": tokens, "ticks": ticks,
             "tokens_per_sec": tokens / dt,
             "prefill_compiles": len(eng._compiled_prefill),
             "telemetry": _snapshot_serving(),
+            "trace": trace,
             "outputs": [list(r.output) for r in reqs]}
+
+
+# -- ISSUE 10: overload scenario ---------------------------------------------
+
+def _overload_workload():
+    """(arrival_tick, prompt, max_new, priority): 24 requests over 12
+    ticks (2 per tick) against 4 slots + a 32-token chunk budget —
+    arrival token rate ~2x what the engine can service, with every 4th
+    request priority 2 (the latency-SLO class) and the rest priority 0
+    carrying a loose deadline."""
+    rng = np.random.RandomState(11)
+    jobs = []
+    for i in range(24):
+        plen = int(rng.randint(12, 28))
+        jobs.append((i // 2, list(rng.randint(1, 256, plen)), 10,
+                     2 if i % 4 == 0 else 0))
+    return jobs
+
+
+def run_overload(model, jobs, slo):
+    """Drive the overload workload; slo=False is the FIFO baseline."""
+    from paddle_tpu.inference import QueueFull
+    metrics.reset()
+    eng = ContinuousBatchingEngine(
+        model, max_batch=4, max_seq=MAX_SEQ, prefill_buckets=BUCKETS,
+        max_chunk_tokens=CHUNK, ragged=True, slo=slo,
+        max_queue_tokens=(512 if slo else None), shed_patience=6)
+    w = GenerationRequest([3, 5], max_new_tokens=2)
+    eng.add_request(w)
+    while eng.has_work:
+        eng.step()
+    eng.finished.clear()
+    reqs = [GenerationRequest(list(p), max_new_tokens=n, priority=prio,
+                              deadline_s=(None if prio else 30.0))
+            for _, p, n, prio in jobs]
+    pending = sorted(zip([t for t, _, _, _ in jobs], reqs),
+                     key=lambda x: x[0])
+    t0 = time.perf_counter()
+    tick, rejected, max_depth = 0, [], 0
+    accepted = []
+    while (pending or eng.has_work) and tick < 4000:
+        while pending and pending[0][0] <= tick:
+            r = pending.pop(0)[1]
+            try:
+                eng.add_request(r)
+                accepted.append(r)
+            except QueueFull as e:
+                rejected.append((r, e.retry_after_s))
+        eng.step()
+        max_depth = max(max_depth, len(eng.waiting))
+        tick += 1
+    dt = time.perf_counter() - t0
+    wedged = [r for r in accepted if r.status in ("queued", "running")]
+    statuses = {}
+    for r in accepted:
+        statuses[r.status] = statuses.get(r.status, 0) + 1
+    hi = [r.first_token_s - r.arrived_s for r in accepted
+          if r.priority == 2 and r.first_token_s is not None]
+    return {
+        "seconds": dt, "ticks": tick,
+        "accepted": len(accepted), "rejected": len(rejected),
+        "statuses": statuses,
+        "max_queue_depth": max_depth,
+        "wedged": len(wedged),
+        "hi_prio_ttft_p99": (float(np.percentile(hi, 99)) if hi
+                             else None),
+        "hi_prio_served": len(hi),
+        "sheds": eng.sheds, "deadline_misses": eng.deadline_misses,
+    }
 
 
 def main():
@@ -132,8 +226,32 @@ def main():
     jobs = _workload()
     base = run(model, jobs, ragged=False)      # serialized bucketed prefill
     chunked = run(model, jobs, ragged=True)    # ragged chunked prefill
-    identical = base.pop("outputs") == chunked.pop("outputs")
+    base.pop("trace")
+    chunk_trace = chunked.pop("trace")
+    identical = base.pop("outputs") == chunked["outputs"]
     speedup = chunked["tokens_per_sec"] / base["tokens_per_sec"]
+
+    # ISSUE 10 guard 1 — kill-switch parity: FLAGS_serving_slo=0 must
+    # be the exact pre-SLO FIFO engine. The SLO run above used the
+    # default (armed, inert defaults); the disarmed run must match it
+    # token for token AND tick for tick (packed tokens / finish counts
+    # / preemptions — the scheduling trace).
+    slo_off = run(model, jobs, ragged=True, slo=False)
+    slo_parity = (slo_off.pop("outputs") == chunked.pop("outputs")
+                  and slo_off.pop("trace") == chunk_trace)
+
+    # ISSUE 10 guard 2 — overload: ~2x-capacity arrivals, mixed
+    # priorities; SLO scheduling must hold high-priority p99 TTFT
+    # >= MIN_TTFT_RATIO better than FIFO, with zero wedged requests
+    # and a bounded queue.
+    ojobs = _overload_workload()
+    fifo_over = run_overload(model, ojobs, slo=False)
+    slo_over = run_overload(model, ojobs, slo=True)
+    ttft_ratio = (fifo_over["hi_prio_ttft_p99"]
+                  / max(slo_over["hi_prio_ttft_p99"], 1e-9)
+                  if fifo_over["hi_prio_ttft_p99"] is not None
+                  and slo_over["hi_prio_ttft_p99"] is not None else 0.0)
+
     report = {
         "bench": "serving",
         "workload": {"requests": len(jobs), "max_batch": 4,
@@ -145,6 +263,16 @@ def main():
         "speedup": round(speedup, 2),
         "min_speedup": MIN_SPEEDUP,
         "token_identical_outputs": bool(identical),
+        "slo_kill_switch_parity": bool(slo_parity),
+        "overload": {
+            "workload": {"requests": len(ojobs),
+                         "arrivals_per_tick": 2,
+                         "priorities": [0, 2]},
+            "fifo": fifo_over,
+            "slo": slo_over,
+            "hi_prio_p99_ttft_ratio": round(ttft_ratio, 2),
+            "min_ttft_ratio": MIN_TTFT_RATIO,
+        },
     }
     print(json.dumps(report, indent=2))
     with open(ARTIFACT, "w") as f:
@@ -160,6 +288,19 @@ def main():
     if speedup < MIN_SPEEDUP:
         print(f"FAIL: speedup {speedup:.2f}x < required {MIN_SPEEDUP}x",
               file=sys.stderr)
+        return 1
+    if not slo_parity:
+        print("FAIL: FLAGS_serving_slo=0 diverges from the FIFO "
+              "engine (outputs or scheduling trace)", file=sys.stderr)
+        return 1
+    if slo_over["wedged"] or fifo_over["wedged"]:
+        print(f"FAIL: wedged requests under overload "
+              f"(slo={slo_over['wedged']}, fifo={fifo_over['wedged']})",
+              file=sys.stderr)
+        return 1
+    if ttft_ratio < MIN_TTFT_RATIO:
+        print(f"FAIL: high-priority p99 TTFT ratio {ttft_ratio:.2f}x "
+              f"< required {MIN_TTFT_RATIO}x", file=sys.stderr)
         return 1
     return 0
 

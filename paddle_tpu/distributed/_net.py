@@ -55,8 +55,8 @@ def enable_nodelay(conn) -> None:
 
 def connect_with_retry(addr, authkey_fn, timeout_s: float,
                        describe: str = "endpoint",
-                       auth_hint=None,
-                       fault_name: str = "rpc.connect"):
+                       auth_hint=None, *,
+                       fault_name: str):
     """Authenticated Client(addr) with exponential backoff.
 
     Transient failures (ConnectionError/OSError) retry up to `timeout_s`;

@@ -337,6 +337,12 @@ _flags: dict = {
     # attention + chunked-prefill continuous batching; 0 is the kill
     # switch restoring the bucketed-prefill engine exactly
     "FLAGS_ragged_attention": True,
+    # SLO resilience layer over the serving engine: priority/deadline
+    # scheduling, admission control + shedding, adaptive degradation,
+    # per-request fault isolation. 0 is the kill switch restoring the
+    # FIFO scheduler exactly (same admission order, same preemption
+    # victims, same compiled step signatures)
+    "FLAGS_serving_slo": True,
     # -- quantized collectives (consumed by distributed/collective.py +
     # the jit.TrainStep/ShardingPlan grad-sync seam): armed capability
     # for the blockwise int8/fp8 communication path — quantization still

@@ -133,6 +133,57 @@ def _candidate_edge_keys(total):
         core.next_rng_key(), (int(total),), jnp.float32))
 
 
+def _sample_neighbors_host(r, cp, nodes, sample_size, weights=None):
+    """Host-side CSC neighbor-sampling core shared by sample_neighbors /
+    weighted_sample_neighbors / khop_sampler (ISSUE 10 satellite: khop
+    previously called the Tensor-returning API and immediately pulled
+    the results back with three `.numpy()` syncs per hop — the core
+    works in numpy end to end, so multi-hop composition never
+    round-trips through the device). Randomness stays device
+    `jax.random` via _candidate_edge_keys; `weights` switches the
+    selection to Efraimidis–Spirakis exponential-race keys.
+
+    Returns (neighbors, counts, eid_positions) as numpy arrays; the
+    positions index the CSC edge space (callers map them through
+    user-provided eids)."""
+    import numpy as np
+
+    degs = cp[nodes + 1] - cp[nodes] if nodes.size else np.zeros(0, cp.dtype)
+    need_keys = 0 < sample_size
+    keys = _candidate_edge_keys(degs.sum()) if need_keys else None
+    out_n, out_count, out_eids = [], [], []
+    off = 0
+    for n in nodes:
+        beg, end = int(cp[n]), int(cp[n + 1])
+        d = end - beg
+        neigh = r[beg:end]
+        ids = np.arange(beg, end)
+        if 0 < sample_size < d:
+            u = keys[off:off + d]
+            if weights is None:
+                race = u
+            else:
+                ws = weights[beg:end]
+                if ws.sum() > 0:
+                    with np.errstate(divide="ignore"):
+                        race = (-np.log(np.maximum(u.astype(np.float64),
+                                                   1e-12)) / ws)
+                else:
+                    race = u      # all-zero weights: uniform fallback
+            pick = np.argpartition(race, sample_size)[:sample_size]
+            neigh, ids = neigh[pick], ids[pick]
+        if need_keys:
+            off += d
+        out_n.append(neigh)
+        out_eids.append(ids)
+        out_count.append(len(neigh))
+    nb = np.concatenate(out_n) if out_n else np.array([], r.dtype)
+    ct = np.array(out_count, np.int32)
+    ep = (np.concatenate(out_eids) if out_eids
+          else np.array([], np.int64))
+    return nb, ct, ep
+
+
 def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
                      eids=None, return_eids=False, perm_buffer=None,
                      name=None):
@@ -148,32 +199,11 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
     r = np.asarray(unwrap(row))
     cp = np.asarray(unwrap(colptr))
     nodes = np.asarray(unwrap(input_nodes)).reshape(-1)
-    degs = cp[nodes + 1] - cp[nodes] if nodes.size else np.zeros(0, cp.dtype)
-    need_keys = 0 < sample_size
-    keys = _candidate_edge_keys(degs.sum()) if need_keys else None
-    out_n, out_count, out_eids = [], [], []
-    off = 0
-    for n in nodes:
-        beg, end = int(cp[n]), int(cp[n + 1])
-        d = end - beg
-        neigh = r[beg:end]
-        ids = np.arange(beg, end)
-        if 0 < sample_size < d:
-            seg = keys[off:off + d]
-            pick = np.argpartition(seg, sample_size)[:sample_size]
-            neigh, ids = neigh[pick], ids[pick]
-        if need_keys:
-            off += d
-        out_n.append(neigh)
-        out_eids.append(ids)
-        out_count.append(len(neigh))
-    nb = np.concatenate(out_n) if out_n else np.array([], r.dtype)
-    ct = np.array(out_count, np.int32)
+    nb, ct, pos = _sample_neighbors_host(r, cp, nodes, sample_size)
     res = [Tensor(jnp.asarray(nb), stop_gradient=True),
            Tensor(jnp.asarray(ct), stop_gradient=True)]
     if return_eids:
-        ev = (np.asarray(unwrap(eids))[np.concatenate(out_eids)]
-              if eids is not None else np.concatenate(out_eids))
+        ev = np.asarray(unwrap(eids))[pos] if eids is not None else pos
         res.append(Tensor(jnp.asarray(ev), stop_gradient=True))
     return tuple(res)
 
@@ -197,38 +227,11 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
     cp = np.asarray(unwrap(colptr))
     w = np.asarray(unwrap(edge_weight)).astype(np.float64)
     nodes = np.asarray(unwrap(input_nodes)).reshape(-1)
-    degs = cp[nodes + 1] - cp[nodes] if nodes.size else np.zeros(0, cp.dtype)
-    need_keys = 0 < sample_size
-    keys = _candidate_edge_keys(degs.sum()) if need_keys else None
-    out_n, out_count, out_eids = [], [], []
-    off = 0
-    for n in nodes:
-        beg, end = int(cp[n]), int(cp[n + 1])
-        d = end - beg
-        neigh = r[beg:end]
-        ids = np.arange(beg, end)
-        if 0 < sample_size < d:
-            u = keys[off:off + d].astype(np.float64)
-            ws = w[beg:end]
-            if ws.sum() > 0:
-                with np.errstate(divide="ignore"):
-                    race = -np.log(np.maximum(u, 1e-12)) / ws
-            else:
-                race = u          # all-zero weights: uniform fallback
-            pick = np.argpartition(race, sample_size)[:sample_size]
-            neigh, ids = neigh[pick], ids[pick]
-        if need_keys:
-            off += d
-        out_n.append(neigh)
-        out_eids.append(ids)
-        out_count.append(len(neigh))
-    nb = np.concatenate(out_n) if out_n else np.array([], r.dtype)
-    ct = np.array(out_count, np.int32)
+    nb, ct, pos = _sample_neighbors_host(r, cp, nodes, sample_size,
+                                         weights=w)
     res = [Tensor(jnp.asarray(nb), stop_gradient=True),
            Tensor(jnp.asarray(ct), stop_gradient=True)]
     if return_eids:
-        pos = (np.concatenate(out_eids) if out_eids
-               else np.array([], np.int64))
         # map CSC positions through user-provided edge ids, like
         # sample_neighbors does
         ev = (np.asarray(unwrap(eids))[pos] if eids is not None else pos)
@@ -275,17 +278,20 @@ def khop_sampler(row, colptr, input_nodes, sample_sizes, sorted_eids=None,
     from ..ops._helpers import unwrap
     from ..tensor import Tensor
 
+    r = np.asarray(unwrap(row))
+    cp = np.asarray(unwrap(colptr))
+    ev_map = (np.asarray(unwrap(sorted_eids))
+              if sorted_eids is not None else None)
     centers = np.asarray(unwrap(input_nodes)).reshape(-1)
     cur = centers
     hop_src, hop_dst, hop_eids = [], [], []
     for k in (sample_sizes if isinstance(sample_sizes, (list, tuple))
               else [sample_sizes]):
-        res = sample_neighbors(row, colptr, jnp.asarray(cur),
-                               sample_size=int(k), eids=sorted_eids,
-                               return_eids=True)
-        nb = np.asarray(res[0].numpy())
-        ct = np.asarray(res[1].numpy())
-        ei = np.asarray(res[2].numpy())
+        # host core directly: multi-hop composition is host-side work,
+        # a per-hop Tensor round-trip bought three device syncs per hop
+        nb, ct, pos = _sample_neighbors_host(r, cp, np.asarray(cur),
+                                             int(k))
+        ei = ev_map[pos] if ev_map is not None else pos
         hop_src.append(nb)
         hop_dst.append(np.repeat(cur, ct))
         hop_eids.append(ei)

@@ -233,7 +233,8 @@ def create_predictor(config: Config) -> Predictor:
 
 
 from .serving import (ContinuousBatchingEngine,  # noqa: E402,F401
-                      GenerationRequest, PagePool, quantize_state_int8)
+                      DeadlineExceeded, GenerationRequest, PagePool,
+                      QueueFull, quantize_state_int8)
 
 
 def convert_to_mixed_precision(*a, **kw):

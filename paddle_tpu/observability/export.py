@@ -155,10 +155,40 @@ def append_jsonl(path: str, record: dict) -> None:
 _server = None
 _server_thread = None
 
+# -- health/readiness providers (ISSUE 10): subsystems (e.g. the serving
+# engine's health_snapshot) register a zero-arg dict provider; the
+# metrics endpoint serves the merged view at /healthz so a future HTTP
+# front-end gets a readiness probe for free next to /metrics.
+_health_providers: dict = {}
+
+
+def register_health_provider(name: str, fn) -> None:
+    """Register (or replace) a named zero-arg provider returning a
+    JSON-serializable dict for the /healthz payload."""
+    _health_providers[name] = fn
+
+
+def unregister_health_provider(name: str) -> None:
+    _health_providers.pop(name, None)
+
+
+def health_payload() -> dict:
+    """The merged /healthz body. A broken provider reports its error
+    under its own key instead of failing the whole probe."""
+    out = {"ok": True}
+    for name, fn in sorted(_health_providers.items()):
+        try:
+            out[name] = fn()
+        except Exception as e:        # readiness must not 500 on one bad hook
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+            out["ok"] = False
+    return out
+
 
 def serve_metrics(port: int, host: Optional[str] = None) -> Optional[int]:
-    """Start (or move) the background /metrics HTTP endpoint; port 0
-    stops it. Returns the bound port. Consumed by FLAGS_metrics_port."""
+    """Start (or move) the background /metrics (+ /healthz) HTTP
+    endpoint; port 0 stops it. Returns the bound port. Consumed by
+    FLAGS_metrics_port."""
     global _server, _server_thread
     stop_metrics_server()
     if not port:
@@ -167,13 +197,24 @@ def serve_metrics(port: int, host: Optional[str] = None) -> Optional[int]:
 
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):
-            if self.path.rstrip("/") not in ("", "/metrics"):
+            path = self.path.rstrip("/")
+            status = 200
+            if path == "/healthz":
+                payload = health_payload()
+                body = json.dumps(payload, indent=1).encode()
+                ctype = "application/json"
+                if not payload.get("ok", False):
+                    # readiness probes key on the STATUS code — a
+                    # broken provider must read as unready, not 200
+                    status = 503
+            elif path in ("", "/metrics"):
+                body = prometheus_text().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            else:
                 self.send_error(404)
                 return
-            body = prometheus_text().encode()
-            self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

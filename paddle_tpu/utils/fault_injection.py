@@ -42,7 +42,16 @@ worker mid-training like a preemption, ``raise`` kills only the beat
 thread, simulating a zombie whose TTL expires), ``elastic.barrier``
 (each recovery/health-barrier poll), ``elastic.connect`` (the
 authenticated client connect), and ``launch.spawn`` (the supervisor's
-per-incarnation worker spawn).
+per-incarnation worker spawn). The serving engine (ISSUE 10) adds
+``serving.tick`` (top of every scheduler tick, inside the isolation
+boundary — an armed ``raise`` exercises per-request quarantine, a
+``delay`` a wedged tick the engine watchdog must catch),
+``serving.admit`` (``add_request`` under the SLO layer), and
+``serving.page_alloc`` (every KV page-pool allocation).
+
+Every point literal is linted by graft-lint's ``fault-point-hygiene``
+pass: unique to one module, ``subsystem.name`` snake_case, and listed
+in the fault-point table of ``benchmarks/MEASUREMENT_RUNBOOK.md``.
 """
 from __future__ import annotations
 

@@ -1,0 +1,16 @@
+"""Fixture: fault-point-hygiene violations (parsed, never imported)."""
+from paddle_tpu.utils.fault_injection import fault_point
+
+
+def bad_sites(suffix):
+    name = "computed." + suffix
+    fault_point(name)                      # non-literal point name
+    fault_point("NotSnake.Case")           # bad shape (CamelCase)
+    fault_point("nodots")                  # bad shape (no subsystem)
+    fault_point("totally.undocumented")    # missing from runbook table
+
+
+def forwarder(fault_name: str = "also.undocumented"):
+    # the forwarding form itself is legal; the DEFAULT is still a
+    # literal entry point and must be documented
+    fault_point(fault_name)

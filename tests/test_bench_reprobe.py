@@ -1,0 +1,101 @@
+"""bench.py axon-helper re-probe (ISSUE 10 satellite, ROADMAP MFU item
+b): a run pinned to CPU by an earlier wedged round must return to the
+chip the moment the compile helper answers again — and must NOT loop,
+re-exec without an axon pool, or override an explicit no-fallback."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    """Load bench.py as a throwaway module (it only runs the benchmark
+    under __main__, so import is side-effect free)."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _ExecCalled(Exception):
+    pass
+
+
+@pytest.fixture()
+def trap_exec(monkeypatch):
+    calls = []
+
+    def fake_execve(exe, argv, env):
+        calls.append((exe, argv, env))
+        raise _ExecCalled
+
+    monkeypatch.setattr(os, "execve", fake_execve)
+    return calls
+
+
+def _env(monkeypatch, **kv):
+    for k in ("BENCH_NO_FALLBACK", "BENCH_HELPER_REPROBED",
+              "JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS"):
+        monkeypatch.delenv(k, raising=False)
+    for k, v in kv.items():
+        monkeypatch.setenv(k, v)
+
+
+def test_reexecs_onto_chip_when_helper_returns(bench, trap_exec,
+                                               monkeypatch):
+    _env(monkeypatch, JAX_PLATFORMS="cpu",
+         PALLAS_AXON_POOL_IPS="10.0.0.1")
+    monkeypatch.setattr(bench, "_helper_alive", lambda *a, **kw: True)
+    with pytest.raises(_ExecCalled):
+        bench._reprobe_helper_and_unpin()
+    (_, argv, env), = trap_exec
+    assert argv[0] == sys.executable
+    # the cpu pin is GONE (sitecustomize re-pins axon,cpu at start) and
+    # the loop guard is set so the child never re-execs again
+    assert "JAX_PLATFORMS" not in env
+    assert env["BENCH_HELPER_REPROBED"] == "1"
+
+
+@pytest.mark.parametrize("env_kw,alive", [
+    # helper still down: stay on the CPU smoke path
+    (dict(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="10.0.0.1"), False),
+    # not pinned to cpu: nothing to undo
+    (dict(PALLAS_AXON_POOL_IPS="10.0.0.1"), True),
+    # no axon pool configured: the cpu pin is intentional
+    (dict(JAX_PLATFORMS="cpu"), True),
+    # explicit no-fallback wins over everything
+    (dict(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="10.0.0.1",
+          BENCH_NO_FALLBACK="1"), True),
+    # loop guard: a re-exec'd child must not re-exec again
+    (dict(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="10.0.0.1",
+          BENCH_HELPER_REPROBED="1"), True),
+])
+def test_no_reexec_outside_the_recovery_edge(bench, trap_exec,
+                                             monkeypatch, env_kw, alive):
+    _env(monkeypatch, **env_kw)
+    monkeypatch.setattr(bench, "_helper_alive", lambda *a, **kw: alive)
+    assert bench._reprobe_helper_and_unpin() is False
+    assert trap_exec == []
+
+
+def test_emit_marks_helper_recovered(bench, monkeypatch, tmp_path,
+                                     capsys):
+    """A post-recovery emit carries extra.helper_recovered so the trend
+    series explains why it resumed on-chip."""
+    monkeypatch.setenv("BENCH_HELPER_REPROBED", "1")
+    monkeypatch.setattr(bench, "_LAST_GOOD",
+                        str(tmp_path / "BENCH_LAST_GOOD.json"))
+    monkeypatch.setattr(bench, "_TREND", str(tmp_path / "TREND.json"))
+    rec = {"metric": "llama_350m_train_tokens_per_sec_per_chip",
+           "value": 1.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+           "extra": {"device": "tpu v5p"}}
+    bench._emit(rec, on_tpu=False)
+    assert rec["extra"]["helper_recovered"] is True

@@ -28,6 +28,9 @@ from tools.graft_lint import (  # noqa: E402
 from tools.graft_lint.passes.collective_order import (  # noqa: E402
     CollectiveOrderPass,
 )
+from tools.graft_lint.passes.fault_points import (  # noqa: E402
+    FaultPointsPass,
+)
 from tools.graft_lint.passes.flags_hygiene import (  # noqa: E402
     FlagsHygienePass,
 )
@@ -203,6 +206,59 @@ def test_flags_registry_parse_matches_runtime():
         REPO / "paddle_tpu" / "framework" / "core.py"))
     from paddle_tpu.framework import core as runtime_core
     assert static_keys == set(runtime_core._flags.keys())
+
+
+# -- fault-point-hygiene -----------------------------------------------------
+
+def test_fault_point_hygiene_catches_bug_classes():
+    res = _run([FaultPointsPass()],
+               paths=[FIXTURES / "fault_points_bad.py"])
+    msgs = [f.message for f in res.active]
+    assert sum("LITERAL" in m for m in msgs) == 1
+    assert sum("snake_case" in m for m in msgs) == 2
+    # the direct undocumented literal AND the fault_name= default
+    assert sum("not listed in the fault-point table" in m
+               for m in msgs) == 2
+    assert len(msgs) == 5
+
+
+def test_fault_point_one_module_rule(tmp_path):
+    """The same point name in two FILES is an error (ambiguous @N hit
+    counts); several sites in one file stay legal (elastic.restore
+    fires from two branches of one operation)."""
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text('fault_point("serving.tick")\n'
+                 'fault_point("serving.tick")\n')      # same-file: fine
+    b.write_text('fault_point("serving.tick")\n')      # cross-file: not
+    res = _run([FaultPointsPass()], paths=[a, b])
+    assert len(res.active) == 1
+    assert "already lives in" in res.active[0].message
+    assert res.active[0].path.endswith("b.py")
+
+
+def test_fault_point_serving_sites_documented_and_clean():
+    """The new serving.* chaos levers exist, are documented, and the
+    serving module passes the hygiene bar."""
+    from tools.graft_lint.passes.fault_points import parse_runbook_table
+    table = parse_runbook_table(
+        REPO / "benchmarks" / "MEASUREMENT_RUNBOOK.md")
+    assert {"serving.tick", "serving.admit",
+            "serving.page_alloc"} <= table
+    res = _run([FaultPointsPass()],
+               paths=[REPO / "paddle_tpu" / "inference" / "serving.py"])
+    assert res.active == [], "\n".join(f.render() for f in res.active)
+
+
+def test_fault_point_table_vs_live_sites_round_trip():
+    """Full-scope inverse check: every documented point has a live
+    site TODAY (a dead row would warn through the tier-1 full-repo
+    gate, so catch it here with a readable message)."""
+    res = _run([FaultPointsPass()],
+               paths=[REPO / "paddle_tpu"])
+    dead = [f.message for f in res.active
+            if "has no live" in f.message]
+    assert dead == [], dead
 
 
 # -- suppressions ------------------------------------------------------------

@@ -261,11 +261,19 @@ class TestServingTelemetry:
             eng.add_request(GenerationRequest([11 + i, 5], max_new_tokens=38))
         _drain(eng)
         snap = metrics.snapshot()
-        ttft = snap["histograms"]["serving.ttft_seconds"][""]
-        tpot = snap["histograms"]["serving.tpot_seconds"][""]
+
+        def agg(hist_id):
+            # the SLO layer (default armed, ISSUE 10) labels TTFT/TPOT
+            # by priority — aggregate across label cells
+            cells = snap["histograms"][hist_id].values()
+            return (sum(c["count"] for c in cells),
+                    sum(c["sum"] for c in cells))
+
+        ttft = agg("serving.ttft_seconds")
+        tpot = agg("serving.tpot_seconds")
         packed = snap["histograms"]["serving.packed_tokens_per_tick"][""]
-        assert ttft["count"] == 2 and ttft["sum"] > 0
-        assert tpot["count"] == 2 and tpot["sum"] > 0
+        assert ttft[0] == 2 and ttft[1] > 0
+        assert tpot[0] == 2 and tpot[1] > 0
         assert 1 <= packed["count"] <= eng.ticks
         assert snap["counters"]["serving.preemptions_total"][""] >= 1
         # drained engine: gauge back to zero pages in use

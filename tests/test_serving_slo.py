@@ -1,0 +1,416 @@
+"""SLO-aware serving resilience (ISSUE 10): priority/EDF scheduling,
+deadline fail-fast, admission control + shedding, adaptive degradation,
+per-request fault isolation driven through the serving.* fault points,
+the engine watchdog, /healthz, and the FLAGS_serving_slo kill switch."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import (ContinuousBatchingEngine,
+                                  GenerationRequest, QueueFull)
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.utils import fault_injection as fi
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    fi.configure(None)
+    obs.enable(False)
+
+
+def _tiny_model(seed=0, **kw):
+    paddle.seed(seed)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=128, use_recompute=False,
+                      **kw)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+def _drain(eng, cap=2000):
+    n = 0
+    while eng.has_work and n < cap:
+        eng.step()
+        n += 1
+    assert not eng.has_work, "engine failed to drain"
+    return n
+
+
+def _reference_generate(model, prompt, n_new):
+    out = model.generate(paddle.to_tensor(np.array([prompt], np.int32)),
+                         max_new_tokens=n_new, do_sample=False)
+    return [int(t) for t in np.asarray(out.numpy())[0][:n_new]]
+
+
+class TestSloScheduling:
+    def test_priority_jumps_the_queue(self, model):
+        """One slot; a high-priority request submitted LAST is admitted
+        first (strict priority), and equal-priority requests keep FIFO
+        order (stable sort)."""
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                       max_chunk_tokens=8, slo=True)
+        lo1 = GenerationRequest([3, 5], max_new_tokens=3, priority=0)
+        lo2 = GenerationRequest([7, 9], max_new_tokens=3, priority=0)
+        hi = GenerationRequest([11, 2], max_new_tokens=3, priority=5)
+        for r in (lo1, lo2, hi):
+            eng.add_request(r)
+        _drain(eng)
+        order = [r.request_id for r in eng.finished]
+        assert order == [hi.request_id, lo1.request_id, lo2.request_id]
+        assert all(r.status == "served" for r in (lo1, lo2, hi))
+
+    def test_edf_within_a_priority_class(self, model):
+        """Same priority: the earlier deadline is admitted first, and a
+        request with no deadline (infinite slack) goes last."""
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                       max_chunk_tokens=8, slo=True)
+        loose = GenerationRequest([3, 5], max_new_tokens=2, deadline_s=60.0)
+        none = GenerationRequest([4, 6], max_new_tokens=2)
+        tight = GenerationRequest([7, 9], max_new_tokens=2, deadline_s=20.0)
+        for r in (loose, none, tight):
+            eng.add_request(r)
+        _drain(eng)
+        order = [r.request_id for r in eng.finished]
+        assert order == [tight.request_id, loose.request_id,
+                         none.request_id]
+
+    def test_deadline_expired_waiter_fails_fast(self, model):
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                       max_chunk_tokens=8, slo=True)
+        running = GenerationRequest([3, 5], max_new_tokens=6)
+        dead = GenerationRequest([7, 9], max_new_tokens=6,
+                                 deadline_s=1e-9)
+        eng.add_request(running)
+        eng.add_request(dead)       # expires before a slot frees
+        _drain(eng)
+        assert dead.status == "deadline_missed"
+        assert "DeadlineExceeded" in dead.error
+        assert dead.output == []
+        assert running.status == "served"
+        assert eng.deadline_misses == 1
+        assert eng.pool.n_free == eng.pool.n_pages - 1
+
+    def test_deadline_expired_inflight_releases_pages(self, model):
+        """An admitted request whose deadline passes mid-generation is
+        cancelled and its slot + pages reclaimed."""
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                       max_chunk_tokens=8, slo=True)
+        req = GenerationRequest([3, 5, 7], max_new_tokens=500,
+                                deadline_s=0.05)
+        eng.add_request(req)
+        import time
+        n = 0
+        while eng.has_work and n < 2000:
+            eng.step()
+            n += 1
+            if not eng.has_work:
+                break
+            time.sleep(0.01)
+        assert req.status == "deadline_missed"
+        assert len(req.output) < 500
+        assert eng.pool.n_free == eng.pool.n_pages - 1
+        assert all(s.free for s in eng.slots)
+
+    def test_preemption_never_evicts_higher_priority_holder(self, model):
+        """Tiny pool, a high-priority and a low-priority decoder: every
+        preemption victim is the LOW-priority request; the high-priority
+        one is never evicted and still matches its isolated output."""
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                       total_pages=5, max_chunk_tokens=8,
+                                       slo=True)
+        hi = GenerationRequest([11, 5], max_new_tokens=38, priority=3)
+        lo = GenerationRequest([7, 19], max_new_tokens=38, priority=0)
+        eng.add_request(hi)
+        eng.add_request(lo)
+        preempted = []
+        real = eng._preempt
+
+        def spy(i):
+            preempted.append(eng.slots[i].req.request_id)
+            real(i)
+
+        eng._preempt = spy
+        _drain(eng)
+        assert preempted, "tiny pool must force preemption"
+        assert set(preempted) == {lo.request_id}
+        assert hi.output == _reference_generate(model, hi.prompt, 38)
+        assert lo.output == _reference_generate(model, lo.prompt, 38)
+
+
+class TestAdmissionControl:
+    def test_queue_full_rejects_with_retry_hint(self, model):
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                       slo=True, max_queue_tokens=8)
+        eng.add_request(GenerationRequest([1] * 6, max_new_tokens=2))
+        with pytest.raises(QueueFull) as ei:
+            eng.add_request(GenerationRequest([1] * 6, max_new_tokens=2))
+        assert ei.value.retry_after_s > 0
+        assert len(eng.waiting) == 1          # rejected request never entered
+        _drain(eng)
+
+    def test_sheds_lowest_priority_most_slack_first(self, model):
+        """Sustained admission starvation shed the low-priority waiters,
+        never the high-priority one; everything terminates (no wedge)."""
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                       max_chunk_tokens=8, slo=True,
+                                       max_queue_tokens=200,
+                                       shed_patience=2)
+        first = GenerationRequest([3, 5], max_new_tokens=30)
+        hi = GenerationRequest([4, 9], max_new_tokens=4, priority=2)
+        lows = [GenerationRequest([6 + i, 2], max_new_tokens=4)
+                for i in range(3)]
+        eng.add_request(first)
+        eng.add_request(hi)
+        for r in lows:
+            eng.add_request(r)
+        _drain(eng)
+        assert eng.sheds >= 1
+        assert hi.status == "served"
+        assert all(r.status in ("served", "shed") for r in lows)
+        shed = [r for r in lows if r.status == "shed"]
+        assert shed, "low-priority requests shed first"
+        terminal = {"served", "shed", "deadline_missed", "failed"}
+        assert all(r.status in terminal
+                   for r in [first, hi] + lows)
+
+    def test_degradation_shrinks_and_recovers_with_hysteresis(self, model):
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                       max_chunk_tokens=32,
+                                       min_chunk_tokens=8,
+                                       degrade_hysteresis=3, slo=True)
+        held = eng.pool.alloc(eng.pool.n_free - 1)   # util ~> high water
+        eng._slo_pre_tick()
+        assert eng._eff_chunk == 16
+        eng._slo_pre_tick()
+        assert eng._eff_chunk == 8                   # floor
+        eng._slo_pre_tick()
+        assert eng._eff_chunk == 8
+        eng.pool.free(held)                          # pressure gone
+        for _ in range(2):
+            eng._slo_pre_tick()
+            assert eng._eff_chunk == 8               # hysteresis holds
+        eng._slo_pre_tick()
+        assert eng._eff_chunk == 16                  # grew one step
+        for _ in range(3):
+            eng._slo_pre_tick()
+        assert eng._eff_chunk == 32                  # fully recovered
+
+
+class TestFaultIsolation:
+    def test_poisoned_tick_fails_alone(self, model):
+        """Acceptance: serving.tick:raise@N fails ONE request (slot +
+        pages reclaimed, terminal error) while every other in-flight
+        request completes token-identical to the clean run."""
+        prompts = [[3, 5, 7], [9, 2], [4, 4, 6]]
+
+        def run(chaos):
+            fi.configure("serving.tick:raise@3" if chaos else None)
+            try:
+                eng = ContinuousBatchingEngine(
+                    model, max_batch=3, max_seq=64, max_chunk_tokens=16,
+                    slo=True)
+                reqs = [GenerationRequest(list(p), max_new_tokens=6)
+                        for p in prompts]
+                for r in reqs:
+                    eng.add_request(r)
+                _drain(eng)
+                return eng, reqs
+            finally:
+                fi.configure(None)
+
+        _, clean = run(chaos=False)
+        eng, reqs = run(chaos=True)
+        # suspicion falls on the LATEST admission: the third request
+        assert reqs[2].status == "failed"
+        assert "FaultInjected" in reqs[2].error
+        assert reqs[0].status == reqs[1].status == "served"
+        assert reqs[0].output == clean[0].output
+        assert reqs[1].output == clean[1].output
+        assert eng.quarantines == 1
+        assert eng.pool.n_free == eng.pool.n_pages - 1
+        assert all(s.free for s in eng.slots)
+
+    def test_nonfinite_logits_quarantined_exactly(self, model):
+        """A row whose logits go non-finite is attributed EXACTLY (not
+        by suspicion): the poisoned slot fails, the other request's
+        output is token-identical to its isolated run."""
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                       max_chunk_tokens=16, slo=True)
+        a = GenerationRequest([3, 5], max_new_tokens=8)
+        b = GenerationRequest([7, 9], max_new_tokens=8)
+        eng.add_request(a)
+        eng.add_request(b)
+        real = eng._ragged_fn()
+        state = {"calls": 0}
+
+        def poisoned(*args):
+            nxt, ok, kp, vp = real(*args)
+            state["calls"] += 1
+            if state["calls"] == 3:
+                ok = np.asarray(ok).copy()
+                ok[1] = False                  # slot 1 = request b
+            return nxt, ok, kp, vp
+
+        eng._compiled_ragged = poisoned
+        _drain(eng)
+        assert b.status == "failed" and b.error == "non-finite logits"
+        assert a.status == "served"
+        assert a.output == _reference_generate(model, a.prompt, 8)
+        assert eng.quarantines == 1
+        assert eng.pool.n_free == eng.pool.n_pages - 1
+
+    def test_page_alloc_fault_fails_one_engine_survives(self, model):
+        fi.configure("serving.page_alloc:raise@2")
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                       max_chunk_tokens=8, slo=True)
+        reqs = [GenerationRequest([3 + i, 5], max_new_tokens=6)
+                for i in range(3)]
+        for r in reqs:
+            eng.add_request(r)
+        _drain(eng)
+        fi.configure(None)
+        statuses = sorted(r.status for r in reqs)
+        assert statuses.count("failed") == 1
+        assert statuses.count("served") == 2
+        assert eng.pool.n_free == eng.pool.n_pages - 1
+
+    def test_admit_fault_raises_to_caller(self, model):
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                       slo=True)
+        fi.configure("serving.admit:raise@1")
+        with pytest.raises(fi.FaultInjected):
+            eng.add_request(GenerationRequest([3, 5], max_new_tokens=2))
+        fi.configure(None)
+        assert eng.waiting == []              # nothing half-admitted
+        eng.add_request(GenerationRequest([3, 5], max_new_tokens=2))
+        _drain(eng)                           # engine unaffected
+
+    def test_unattributable_tick_fault_reraises(self, model):
+        """No active slot, no waiter: nothing to quarantine — the
+        exception propagates (engine-level fault, not a poisoned
+        request)."""
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                       slo=True)
+        fi.configure("serving.tick:raise@1")
+        with pytest.raises(fi.FaultInjected):
+            eng.step()
+        fi.configure(None)
+
+    def test_delay_fault_trips_engine_watchdog(self, model):
+        """serving.tick:delay simulates a wedged tick; the per-tick
+        watchdog (private CommWatchdog) must detect the overrun."""
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                       max_chunk_tokens=8, slo=True,
+                                       tick_timeout_s=0.1)
+        eng.add_request(GenerationRequest([3, 5], max_new_tokens=2))
+        fi.configure("serving.tick:delay:0.4@2")
+        with pytest.warns(RuntimeWarning, match="serving.tick"):
+            _drain(eng)
+        fi.configure(None)
+        assert eng._wd.timeouts >= 1
+        eng._wd.shutdown()
+
+
+class TestKillSwitch:
+    def test_flag_off_is_the_fifo_engine(self, model):
+        """FLAGS_serving_slo=0: token-identical outputs AND an identical
+        scheduling trace (per-tick packed tokens, finish counts,
+        preemptions) vs the armed engine with inert defaults on a mixed
+        workload — the disarmed path IS the pre-SLO FIFO engine."""
+        prompts = [[9, 4, 2], list(range(1, 20)), [3, 3, 5, 8],
+                   list(range(2, 30))]
+
+        def run(**kw):
+            eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                           total_pages=6,
+                                           max_chunk_tokens=8, **kw)
+            reqs = [GenerationRequest(list(p), max_new_tokens=6)
+                    for p in prompts]
+            for r in reqs:
+                eng.add_request(r)
+            trace = []
+            n = 0
+            while eng.has_work and n < 2000:
+                eng.step()
+                trace.append((eng.last_packed_tokens, len(eng.finished),
+                              eng.preemptions))
+                n += 1
+            return eng, [r.output for r in reqs], trace
+
+        paddle.set_flags({"FLAGS_serving_slo": False})
+        try:
+            off_eng, off_out, off_trace = run()
+        finally:
+            paddle.set_flags({"FLAGS_serving_slo": True})
+        on_eng, on_out, on_trace = run()
+        assert not off_eng._slo and on_eng._slo
+        assert off_out == on_out
+        assert off_trace == on_trace
+
+    def test_explicit_kwarg_overrides_flag(self, model):
+        paddle.set_flags({"FLAGS_serving_slo": False})
+        try:
+            eng = ContinuousBatchingEngine(model, slo=True)
+            assert eng._slo
+        finally:
+            paddle.set_flags({"FLAGS_serving_slo": True})
+        assert not ContinuousBatchingEngine(model, slo=False)._slo
+
+    def test_disarmed_fault_points_are_inert(self, model):
+        """With FLAGS_serving_slo=0 and no schedule armed, the serving
+        fault points stay single-bool no-ops and the engine serves
+        normally (the parity run above measures the trace; this pins
+        the fault-injection counters)."""
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                       slo=False)
+        eng.add_request(GenerationRequest([3, 5], max_new_tokens=2))
+        _drain(eng)
+        assert not fi.stats()["enabled"]
+
+
+class TestHealthAndTelemetry:
+    def test_health_snapshot_and_healthz_payload(self, model):
+        from paddle_tpu.observability import export as oexp
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                       slo=True, max_queue_tokens=100)
+        eng.add_request(GenerationRequest([3, 5], max_new_tokens=2))
+        snap = eng.health_snapshot()
+        assert snap["ready"] and snap["slo_armed"] and snap["accepting"]
+        assert snap["queue_depth"] == 1 and snap["queued_tokens"] == 2
+        assert snap["kv_pages"]["total"] == eng.pool.n_pages - 1
+        assert snap["effective_chunk_tokens"] == eng.max_chunk_tokens
+        payload = oexp.health_payload()
+        assert payload["ok"]
+        engines = payload["serving"]["engines"]
+        assert any(e["queue_depth"] == 1 for e in engines)
+        _drain(eng)
+
+    def test_slo_counters_and_priority_labels(self, model):
+        from paddle_tpu.observability import metrics
+        obs.enable(True)
+        metrics.reset()
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                       max_chunk_tokens=8, slo=True,
+                                       max_queue_tokens=200,
+                                       shed_patience=2)
+        eng.add_request(GenerationRequest([3, 5], max_new_tokens=25,
+                                          priority=1))
+        for i in range(3):
+            eng.add_request(GenerationRequest([6 + i, 2],
+                                              max_new_tokens=4))
+        eng.add_request(GenerationRequest([2, 2], max_new_tokens=4,
+                                          deadline_s=1e-9))
+        _drain(eng)
+        snap = metrics.snapshot()
+        assert snap["counters"]["serving.deadline_misses_total"][""] >= 1
+        assert snap["counters"]["serving.sheds_total"][""] >= 1
+        assert "serving.queue_depth" in snap["gauges"]
+        ttft = snap["histograms"]["serving.ttft_seconds"]
+        assert any("priority=" in k for k in ttft)

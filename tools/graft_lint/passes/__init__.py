@@ -9,6 +9,7 @@ from ..core import LintPass
 from .apply_op_closures import ApplyOpClosuresPass
 from .atomic_writes import AtomicWritesPass
 from .collective_order import CollectiveOrderPass
+from .fault_points import FaultPointsPass
 from .flags_hygiene import FlagsHygienePass
 from .host_sync import HostSyncPass
 from .metric_names import MetricNamesPass
@@ -22,6 +23,7 @@ ALL_PASSES: List[LintPass] = [
     HostSyncPass(),
     CollectiveOrderPass(),
     FlagsHygienePass(),
+    FaultPointsPass(),
 ]
 
 
