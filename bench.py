@@ -55,6 +55,10 @@ def _attach_trend(record: dict, append: bool):
             "value": record.get("value"),
             "unit": record.get("unit"),
             "mfu": record.get("extra", {}).get("mfu"),
+            # the goodput series (ISSUE 11): the ledger's breakdown
+            # rides the trend so badput regressions show as a series,
+            # not just a falling tokens/s tail
+            "goodput": record.get("extra", {}).get("goodput"),
             "device": record.get("extra", {}).get("device"),
         })
         del series[:-50]
@@ -305,8 +309,14 @@ def _emit(record: dict, on_tpu: bool):
 
 
 def _time_steps(step, args, steps):
-    """Warmup until the jit cache stops growing, then time `steps`."""
+    """Warmup until the jit cache stops growing, then time `steps`.
+    The timed loop runs with the goodput ledger armed, so every BENCH
+    artifact carries the step-time decomposition (productive vs badput
+    buckets + the ledger's own MFU reading) next to tokens/s."""
     import time as _time
+
+    from paddle_tpu import observability as _obs
+    from paddle_tpu.observability import goodput as _goodput
     prev_cache = -1
     warmup = 0
     while warmup < 6:
@@ -317,14 +327,37 @@ def _time_steps(step, args, steps):
             break
         prev_cache = cache
     float(loss.numpy())
+    restore = _obs.arm()
+    # one armed warmup step OUTSIDE the timed loop: the first armed call
+    # pays the one-off cost_analysis lowering for the MFU gauge
+    loss = step(*args)
+    float(loss.numpy())
+    _goodput.reset()
+    _goodput.open_window()
     t0 = _time.perf_counter()
     for _ in range(steps):
         loss = step(*args)
     last = float(loss.numpy())
     dt = _time.perf_counter() - t0
+    # under async dispatch the per-step windows measure DISPATCH wall;
+    # the final blocking pull drains the queued device work — close one
+    # more window over it so the drain reads as device-execute time
+    # instead of vanishing from the attribution
+    _goodput.step_boundary()
+    gp = _goodput.summary()
+    restore()
     n_compiles = (getattr(step._compiled, "_cache_size",
                           lambda: None)() or 0) - (prev_cache or 0)
-    return dt, last, n_compiles
+    goodput = {
+        "productive_seconds": round(gp["productive_seconds"], 4),
+        "badput_seconds": {k: round(v, 4)
+                           for k, v in gp["badput_seconds"].items()},
+        "productive_fraction": round(gp["productive_fraction"], 4),
+        "attributed_fraction": round(gp["wall_seconds"] / dt, 4)
+                               if dt else 0.0,
+        "mfu": round(gp["mfu"], 4),
+    }
+    return dt, last, n_compiles, goodput
 
 
 def _measured_fwd_flops(model, *example):
@@ -429,7 +462,7 @@ def _bench_other(size, devs, on_tpu):
     opt = popt.AdamW(learning_rate=1e-4, parameters=model.parameters(),
                      weight_decay=0.01)
     step = paddle.jit.TrainStep(model, opt, step_fn)
-    dt, last, n_compiles = _time_steps(step, args, steps)
+    dt, last, n_compiles, goodput = _time_steps(step, args, steps)
 
     n_chips = len(devs)
     rate = items * steps / dt / n_chips
@@ -442,6 +475,7 @@ def _bench_other(size, devs, on_tpu):
         "extra": {"mfu": round(mfu, 4), "loss": round(last, 4),
                   "steps": steps, "n_chips": n_chips,
                   "compiles_in_timed_loop": n_compiles,
+                  "goodput": goodput,
                   "device": getattr(devs[0], "device_kind",
                                     devs[0].platform)},
     }, on_tpu)
@@ -531,7 +565,8 @@ def main():
     # warmup-until-cache-stable + timing shared with _bench_other: the
     # state tree widens twice (moments, then master weights), each
     # widening = a recompile; the timed loop must see zero compiles
-    dt, last, n_compiles_timed = _time_steps(step, (ids, ids), steps)
+    dt, last, n_compiles_timed, goodput = _time_steps(step, (ids, ids),
+                                                      steps)
 
     n_chips = len(devs)
     tokens = batch * seq * steps
@@ -555,6 +590,7 @@ def main():
             "batch": batch, "seq": seq, "steps": steps,
             "n_params": n_params, "n_chips": n_chips,
             "compiles_in_timed_loop": n_compiles_timed,
+            "goodput": goodput,
             "device": getattr(devs[0], "device_kind", devs[0].platform),
             # self-describing kernel routes: r2 measured with XLA CE,
             # r3/r4 with fused CE — artifacts must say which ran

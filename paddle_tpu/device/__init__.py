@@ -87,6 +87,36 @@ def current_stream(device=None):
     return Stream(device)
 
 
+def _memory_stats(device=None):
+    """memory_stats() of the ADDRESSED device — `device` may be an int
+    index, a 'platform:idx' string ('tpu:2', 'gpu:0'), or a jax Device;
+    None means device 0 (the paddle default-device convention). The old
+    helpers read devices()[0] no matter what was asked, so a multi-chip
+    host reported chip 0 as every chip. Indexes LOCAL devices: on a
+    multi-host job the global list's entry i may be another host's
+    non-addressable chip (same population update_device_memory_gauges
+    reports)."""
+    devs = jax.local_devices()
+    idx = 0
+    if isinstance(device, int):
+        idx = device
+    elif isinstance(device, str):
+        tail = device.rsplit(":", 1)[-1]
+        if tail.isdigit():
+            idx = int(tail)
+    elif device is not None and hasattr(device, "memory_stats"):
+        try:
+            return device.memory_stats() or {}
+        except Exception:
+            return {}
+    if not 0 <= idx < len(devs):
+        return {}
+    try:
+        return devs[idx].memory_stats() or {}
+    except Exception:
+        return {}
+
+
 class cuda:
     """paddle.device.cuda compat shims (report TPU facts)."""
 
@@ -96,22 +126,19 @@ class cuda:
 
     @staticmethod
     def max_memory_allocated(device=None):
-        stats = jax.devices()[0].memory_stats() or {}
-        return stats.get("peak_bytes_in_use", 0)
+        return _memory_stats(device).get("peak_bytes_in_use", 0)
 
     @staticmethod
     def memory_allocated(device=None):
-        stats = jax.devices()[0].memory_stats() or {}
-        return stats.get("bytes_in_use", 0)
+        return _memory_stats(device).get("bytes_in_use", 0)
 
     @staticmethod
     def max_memory_reserved(device=None):
-        stats = jax.devices()[0].memory_stats() or {}
-        return stats.get("bytes_limit", 0)
+        return _memory_stats(device).get("bytes_limit", 0)
 
     @staticmethod
     def memory_reserved(device=None):
-        return cuda.max_memory_reserved()
+        return cuda.max_memory_reserved(device)
 
     @staticmethod
     def empty_cache():

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.io import atomic_write
+from ..observability import goodput as _goodput
 from ..observability import metrics as _m
 from ..observability.spans import span as _span
 from ..tensor import Tensor
@@ -128,7 +129,11 @@ _async_errors: List[BaseException] = []
 
 
 def _join(rec: _PendingSave):
-    rec.thread.join()
+    # the caller (trainer) blocks here on an in-flight async write —
+    # checkpoint stall in the goodput ledger (a finished thread joins
+    # instantly and attributes ~0)
+    with _goodput.time_section("checkpoint_stall"):
+        rec.thread.join()
     if rec in _pending:
         _pending.remove(rec)
     if rec.error is not None:
@@ -238,7 +243,9 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
         _join(rec)
     if not async_save:
         _raise_async_errors()
-        _write()
+        # synchronous commit blocks the trainer for the whole write
+        with _goodput.time_section("checkpoint_stall"):
+            _write()
         return
 
     while len(_pending) >= _MAX_PENDING:    # bounded in-flight window

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import device_events as _devev
+from ..observability import goodput as _goodput
 from ..observability import metrics as _m
 from ..observability.spans import span as _span
 from ..tensor import Tensor
@@ -29,12 +31,17 @@ from ..ops._helpers import to_tensor_like, unwrap
 # per-collective telemetry (ISSUE 3; EQuARX-style bytes/latency
 # accounting is the prerequisite for measuring any future comms
 # optimization). Disarmed: one wrapper frame + bool check per call.
-# CAVEAT: these are HOST-side counters. For the shard_map regime the
-# wrapper runs at TRACE time — one count per compile, not per executed
-# step, and wall_seconds measures tracing, not ICI communication; true
-# per-execution device numbers need an XLA-metrics bridge (ROADMAP
-# observability follow-on). Eager host-channel paths (send/recv,
-# object exchange, single-controller calls) count per call as expected.
+# These are HOST-side counters: for the shard_map regime the wrapper
+# runs at TRACE time — one count per compile, not per executed step,
+# and wall_seconds measures tracing, not ICI communication. The
+# PER-EXECUTION view (ISSUE 11) is `collective.executed_calls_total`
+# {op,executable}: the wrapper notes every collective traced inside an
+# open execution window (observability/device_events.py) into that
+# executable's composition, and each later execution of the tagged
+# program replays the composition into the counter — compiled
+# collectives are now counted per executed step, not per compile.
+# Eager host-channel paths (send/recv, object exchange,
+# single-controller calls) count per call as expected.
 _COLL_CALLS = _m.counter("collective.calls_total",
                          "collective op invocations by op")
 _COLL_BYTES = _m.counter("collective.bytes_total",
@@ -111,6 +118,10 @@ def _collective_telemetry(op_name: str, payload_arg: Optional[int] = 0):
             if not _m.enabled():
                 return fn(*args, **kwargs)
             _COLL_CALLS.inc(1, op=op_name)
+            # trace-time composition for per-execution accounting: a
+            # no-op unless a trace is in progress inside an execution
+            # window (jit.TrainStep / the serving tick)
+            _devev.note_traced_collective(op_name)
             nb = 0
             if payload_arg is not None:
                 payload = (args[payload_arg]
@@ -180,6 +191,9 @@ def health_barrier(tag: str = "init", timeout: Optional[float] = None):
     one env lookup)."""
     if not os.environ.get("PADDLE_ELASTIC_SUPERVISED"):
         return None
+    # goodput attribution happens INSIDE MembershipManager.health_barrier
+    # (elastic.py) — a second time_section here would double-count the
+    # same wait and break the ledger's buckets-sum-to-wall invariant
     with _span("collective.health_barrier", tag=tag):
         return _membership_client().health_barrier(timeout=timeout)
 

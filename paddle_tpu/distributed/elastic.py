@@ -56,6 +56,7 @@ import time
 import warnings
 from typing import Callable, List, Optional
 
+from ..observability import goodput as _goodput
 from ..observability import metrics as _m
 from ..observability.spans import span as _span
 from ..utils.fault_injection import fault_point
@@ -347,7 +348,8 @@ class ElasticManager:
                 # load_state_dict verifies everything it reads (tiling +
                 # CRCs) BEFORE mutating any target tensor — a separate
                 # verify_checkpoint pass would read every blob twice
-                with _span("elastic.restore", path=path):
+                with _span("elastic.restore", path=path), \
+                        _goodput.time_section("elastic_recovery"):
                     dck.load_state_dict(self._tensors_of(state_dict), path)
                 _EL_RESTORES.inc(1, incarnation=_inc_label())
                 return step
@@ -368,7 +370,8 @@ class ElasticManager:
         path = os.path.join(self.ckpt_dir, f"step_{step}")
         fault_point("elastic.restore")
         try:
-            with _span("elastic.restore", path=path, agreed=step):
+            with _span("elastic.restore", path=path, agreed=step), \
+                    _goodput.time_section("elastic_recovery"):
                 dck.load_state_dict(self._tensors_of(state_dict), path)
         except dck.CheckpointError as e:
             self._quarantine(path, e)
@@ -1035,7 +1038,8 @@ class MembershipManager:
         _EL_BARRIER_WAITS.inc(1, kind="recovery", incarnation=_inc_label())
         t0 = time.perf_counter()
         gen = None
-        with _span("elastic.barrier", kind="recovery", rank=self.rank):
+        with _span("elastic.barrier", kind="recovery", rank=self.rank), \
+                _goodput.time_section("elastic_barrier"):
             while True:
                 fault_point("elastic.barrier")
                 status, info = self._call(
@@ -1069,7 +1073,8 @@ class MembershipManager:
         _EL_BARRIER_WAITS.inc(1, kind="health", incarnation=_inc_label())
         t0 = time.perf_counter()
         info = {}
-        with _span("elastic.barrier", kind="health", rank=self.rank):
+        with _span("elastic.barrier", kind="health", rank=self.rank), \
+                _goodput.time_section("elastic_barrier"):
             while True:
                 fault_point("elastic.barrier")
                 status, info = self._call(("hbar",))

@@ -65,6 +65,19 @@ def _parse(argv):
                    help="master endpoint of the elastic control plane "
                         "(default: PADDLE_ELASTIC_ENDPOINT env, else "
                         "--master host at port+1, else 127.0.0.1:18814)")
+    p.add_argument("--metrics_port", type=int, default=0,
+                   help="with --elastic_level >= 1: serve ONE job-level "
+                        "/metrics + /healthz on the master at this port, "
+                        "federated over every child snapshot under "
+                        "--log_dir (each child gets FLAGS_metrics=1 and "
+                        "a per-incarnation FLAGS_metrics_snapshot file; "
+                        "counters sum, gauges keep per-rank cells, "
+                        "histograms merge buckets; dead ranks go stale "
+                        "instead of wedging the scrape). Multi-NODE "
+                        "jobs need --log_dir on a shared filesystem — "
+                        "the master merges only the snapshots it can "
+                        "read; node-local dirs leave remote ranks "
+                        "absent (ROADMAP cross-host follow-on)")
     p.add_argument("--degrade_after", type=float, default=None,
                    help="seconds a rank may stay dead after exhausting "
                         "--max_restart before the job DEGRADES to the "
@@ -214,6 +227,16 @@ def _child_env(env, args, rank, world, inc, ep):
         os.path.join(args.log_dir, "flight") if args.log_dir else "")
     if base:
         ce["FLAGS_flight_recorder"] = f"{base}.rank{rank}.inc{inc}.jsonl"
+    if getattr(args, "metrics_port", 0) and args.log_dir:
+        # metric federation (ISSUE 11): each incarnation publishes its
+        # registry snapshot to its own file; the master's federation
+        # server merges them into the job-level /metrics. The child must
+        # NOT inherit FLAGS_metrics_port — every rank binding the same
+        # HTTP port would fail on one host.
+        ce["FLAGS_metrics"] = "1"
+        ce.pop("FLAGS_metrics_port", None)
+        ce["FLAGS_metrics_snapshot"] = os.path.join(
+            args.log_dir, f"metrics.rank{rank}.inc{inc}.json")
     return ce
 
 
@@ -266,6 +289,27 @@ def _supervise(args, env):
     dead_since = {}
     rc_last = 1
 
+    fed = None
+    if args.metrics_port and args.rank == 0:
+        if not args.log_dir:
+            # snapshots need a directory the children can write to
+            import tempfile
+            args.log_dir = tempfile.mkdtemp(prefix="paddle_federation_")
+        from paddle_tpu.observability import federation
+        fed = federation.FederationServer(
+            args.log_dir, args.metrics_port,
+            status_provider=lambda: {
+                "world": world, "status": dict(status),
+                "incarnations": dict(inc), "restarts": dict(restarts)})
+        try:
+            port = fed.start()
+            print(f"launch: job-level /metrics + /healthz on port {port}",
+                  file=sys.stderr)
+        except OSError as e:
+            print(f"launch: federation server failed to bind "
+                  f"port {args.metrics_port}: {e}", file=sys.stderr)
+            fed = None
+
     def spawn(r):
         try:
             fault_point("launch.spawn")
@@ -297,85 +341,89 @@ def _supervise(args, env):
                   f"{e}", file=sys.stderr)
             return None
 
-    for r in local_ranks:
-        _sup_record(args, {"ev": "spawn", "rank": r, "incarnation": 0})
-        procs[r] = spawn(r)
-
-    while any(st == "running" for st in status.values()):
-        time.sleep(0.15)
+    try:
         for r in local_ranks:
-            if status[r] != "running":
-                continue
-            p = procs[r]
-            rc = 1 if p is None else p.poll()
-            if rc is None:
-                continue                     # still alive
-            if rc == 0:
-                status[r] = "done"
-                _sup_record(args, {"ev": "worker_done", "rank": r,
-                                   "incarnation": inc[r]})
-                continue
-            rc_last = rc
-            now = time.time()
-            if r not in dead_since:          # first notice of THIS death
-                dead_since[r] = now
-                gen = notify_bump(r, rc)
-                print(f"launch: rank {r} died rc={rc} "
-                      f"(incarnation {inc[r]}, generation {gen})",
-                      file=sys.stderr)
-                _sup_record(args, {"ev": "worker_death", "rank": r,
-                                   "rc": rc, "incarnation": inc[r],
-                                   "generation": gen})
-            if restarts[r] < args.max_restart:
-                restarts[r] += 1
-                inc[r] += 1
-                print(f"launch: relaunching ONLY rank {r} "
-                      f"(incarnation {inc[r]}, restart "
-                      f"{restarts[r]}/{args.max_restart})",
-                      file=sys.stderr)
-                _sup_record(args, {"ev": "relaunch", "rank": r,
-                                   "incarnation": inc[r],
-                                   "restart": restarts[r]})
-                procs[r] = spawn(r)
-                if procs[r] is not None:
-                    dead_since.pop(r, None)
-            elif args.degrade_after is not None:
-                if now - dead_since[r] >= args.degrade_after:
-                    try:
-                        info = mm.abandon(r)
-                    except Exception as e:
-                        # the master must LEARN about the abandonment or
-                        # survivors wait for this rank until their
-                        # barrier timeout — keep the rank 'running' so
-                        # the next 0.15s poll retries the notification
-                        print(f"launch: degrade notification for rank "
-                              f"{r} failed ({e!r}); retrying",
-                              file=sys.stderr)
-                        continue
-                    status[r] = "abandoned"
-                    print(f"launch: rank {r} dead past budget — "
-                          f"DEGRADING world: {info}", file=sys.stderr)
-                    _sup_record(args, {"ev": "degrade", "rank": r,
-                                       "incarnation": inc[r],
-                                       "world": info.get("world"),
-                                       "generation": info.get("gen")})
-            else:
-                # legacy policy: restarts exhausted fails the whole job
-                print(f"launch: rank {r} failed rc={rc}, restarts "
-                      f"exhausted", file=sys.stderr)
-                for r2 in local_ranks:
-                    p2 = procs.get(r2)
-                    if status[r2] == "running" and p2 is not None \
-                            and p2.poll() is None:
-                        p2.kill()
-                        p2.wait()
-                mm.stop()
-                return rc
+            _sup_record(args, {"ev": "spawn", "rank": r, "incarnation": 0})
+            procs[r] = spawn(r)
 
-    mm.stop()
-    if any(st == "done" for st in status.values()):
-        return 0            # abandoned ranks don't fail a degraded job
-    return rc_last
+        while any(st == "running" for st in status.values()):
+            time.sleep(0.15)
+            for r in local_ranks:
+                if status[r] != "running":
+                    continue
+                p = procs[r]
+                rc = 1 if p is None else p.poll()
+                if rc is None:
+                    continue                     # still alive
+                if rc == 0:
+                    status[r] = "done"
+                    _sup_record(args, {"ev": "worker_done", "rank": r,
+                                       "incarnation": inc[r]})
+                    continue
+                rc_last = rc
+                now = time.time()
+                if r not in dead_since:      # first notice of THIS death
+                    dead_since[r] = now
+                    gen = notify_bump(r, rc)
+                    print(f"launch: rank {r} died rc={rc} "
+                          f"(incarnation {inc[r]}, generation {gen})",
+                          file=sys.stderr)
+                    _sup_record(args, {"ev": "worker_death", "rank": r,
+                                       "rc": rc, "incarnation": inc[r],
+                                       "generation": gen})
+                if restarts[r] < args.max_restart:
+                    restarts[r] += 1
+                    inc[r] += 1
+                    print(f"launch: relaunching ONLY rank {r} "
+                          f"(incarnation {inc[r]}, restart "
+                          f"{restarts[r]}/{args.max_restart})",
+                          file=sys.stderr)
+                    _sup_record(args, {"ev": "relaunch", "rank": r,
+                                       "incarnation": inc[r],
+                                       "restart": restarts[r]})
+                    procs[r] = spawn(r)
+                    if procs[r] is not None:
+                        dead_since.pop(r, None)
+                elif args.degrade_after is not None:
+                    if now - dead_since[r] >= args.degrade_after:
+                        try:
+                            info = mm.abandon(r)
+                        except Exception as e:
+                            # the master must LEARN about the abandonment
+                            # or survivors wait for this rank until their
+                            # barrier timeout — keep the rank 'running'
+                            # so the next 0.15s poll retries
+                            print(f"launch: degrade notification for "
+                                  f"rank {r} failed ({e!r}); retrying",
+                                  file=sys.stderr)
+                            continue
+                        status[r] = "abandoned"
+                        print(f"launch: rank {r} dead past budget — "
+                              f"DEGRADING world: {info}", file=sys.stderr)
+                        _sup_record(args, {"ev": "degrade", "rank": r,
+                                           "incarnation": inc[r],
+                                           "world": info.get("world"),
+                                           "generation": info.get("gen")})
+                else:
+                    # legacy policy: restarts exhausted fails the job
+                    print(f"launch: rank {r} failed rc={rc}, restarts "
+                          f"exhausted", file=sys.stderr)
+                    for r2 in local_ranks:
+                        p2 = procs.get(r2)
+                        if status[r2] == "running" and p2 is not None \
+                                and p2.poll() is None:
+                            p2.kill()
+                            p2.wait()
+                    mm.stop()
+                    return rc
+
+        mm.stop()
+        if any(st == "done" for st in status.values()):
+            return 0        # abandoned ranks don't fail a degraded job
+        return rc_last
+    finally:
+        if fed is not None:
+            fed.stop()
 
 
 def launch(argv=None):

@@ -316,6 +316,12 @@ _flags: dict = {
     "FLAGS_metrics_port": 0,
     "FLAGS_flight_recorder": "",
     "FLAGS_span_ring_size": 512,
+    # federation (consumed by observability/federation.py): path of this
+    # process's atomically-rewritten registry-snapshot JSON (empty =
+    # off; the launch supervisor sets it per child so the master can
+    # merge one job-level /metrics), and the rewrite interval in seconds
+    "FLAGS_metrics_snapshot": "",
+    "FLAGS_metrics_snapshot_interval": 2.0,
     # -- input pipeline (consumed by io/prefetch.py + io DataLoader):
     # device-side double-buffered batch staging via jax.device_put; false
     # restores the synchronous un-staged loader path (the debugging kill
@@ -436,6 +442,16 @@ def _apply_flag(key, value):
     elif key == "FLAGS_span_ring_size":
         from ..observability import spans as _ospans
         _ospans.set_ring_size(int(value))
+    elif key == "FLAGS_metrics_snapshot":
+        from ..observability import federation as _ofed
+        if value:
+            _ofed.start_publisher(str(value))
+        else:
+            _ofed.stop_publisher(final=False)
+    elif key == "FLAGS_metrics_snapshot_interval":
+        from ..observability import federation as _ofed
+        if _ofed._publisher is not None:
+            _ofed._publisher.interval = max(0.05, float(value))
     elif key == "FLAGS_eager_dispatch_cache_size":
         from ..autograd import tape  # late: tape imports this module
         tape._dispatch_cache.resize(int(value))

@@ -9,12 +9,15 @@ reference's interface."""
 from __future__ import annotations
 
 import operator
+import time
 import weakref
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from ..framework import core
+from ..observability import goodput as _goodput
+from ..observability import metrics as _om
 from ..tensor import Tensor
 from .callbacks import config_callbacks
 
@@ -40,9 +43,15 @@ def _host_pull(tree):
     outputs, predictions) per log interval — never one blocking
     `.numpy()` per batch, which would stall the async dispatch queue
     and idle the device behind the host (tests monkeypatch this to
-    count syncs)."""
+    count syncs). When telemetry is armed, the blocking wall time is
+    attributed to the goodput ledger's host_pull bucket."""
     import jax
-    return jax.device_get(tree)
+    if not _om.enabled():
+        return jax.device_get(tree)
+    t0 = time.perf_counter()
+    out = jax.device_get(tree)
+    _goodput.attribute("host_pull", time.perf_counter() - t0)
+    return out
 
 
 def _unbox_tree(obj):
@@ -305,6 +314,12 @@ class Model:
             epoch_base = int(getattr(sampler, "epoch", 0) or 0)
             if getattr(sampler, "_fit_auto_epoch", None) == epoch_base:
                 epoch_base += 1          # untouched since our last wiring
+            # goodput: open the first step window at loop start so the
+            # first step's data wait + compile land inside a window, and
+            # time every loader next() as the data_wait bucket
+            # (timed_iter's thread guard keeps the DevicePrefetcher's
+            # starved/warmup seam from double-attributing the same wait)
+            _goodput.open_window()
             for epoch in range(epochs):
                 if callable(set_epoch):
                     set_epoch(epoch_base + epoch)
@@ -315,7 +330,7 @@ class Model:
                 for cb in cbs:
                     cb.on_epoch_begin(epoch)
                 logs = {}
-                for step, batch in enumerate(loader):
+                for step, batch in enumerate(_goodput.timed_iter(loader)):
                     for cb in cbs:
                         cb.on_train_batch_begin(step)
                     xs, ys = self._split_batch(batch)
@@ -351,6 +366,10 @@ class Model:
                                  for k, v in eval_logs.items()})
                     for cb in cbs:
                         cb.on_eval_end(eval_logs)
+                    # the eval pass is not train-step time: restart the
+                    # goodput window so it doesn't masquerade as the
+                    # next step's device-execute seconds
+                    _goodput.open_window()
                 for cb in cbs:
                     cb.on_epoch_end(epoch, logs)
                 if self.stop_training:

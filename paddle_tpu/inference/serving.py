@@ -84,6 +84,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import core as _core
+from ..observability import device_events as _devev
 from ..observability import metrics as _metrics
 from ..utils.fault_injection import fault_point
 
@@ -697,8 +698,11 @@ class ContinuousBatchingEngine:
         for j, (_, _, eff, T, _, _) in enumerate(group):
             ids[j, :T] = eff
             n_valid[j] = T
-        last, k_new, v_new = self._prefill_fn(bucket, k)(
-            self._state_arg(), jnp.asarray(ids), jnp.asarray(n_valid))
+        # per-execution device telemetry: stable executable tag stamped
+        # at trace time (xla.execute_seconds / compile attribution)
+        with _devev.execution("serving.prefill"):
+            last, k_new, v_new = self._prefill_fn(bucket, k)(
+                self._state_arg(), jnp.asarray(ids), jnp.asarray(n_valid))
         # ONE flat scatter for the whole group: [L, k, T, kvh, d] ->
         # [L, k*T, kvh, d]; padding rows and beyond-prompt positions
         # land on the scratch page
@@ -954,13 +958,14 @@ class ContinuousBatchingEngine:
         _PACKED.observe(float(cur))
         key_before = self._key
         self._key, sub = jax.random.split(self._key)
-        out = self._ragged_fn()(
-            self._state_arg(), jnp.asarray(toks), self.k_pool,
-            self.v_pool, jnp.asarray(page_ids), jnp.asarray(offs),
-            jnp.asarray(pos), jnp.asarray(self.page_table),
-            jnp.asarray(q_start), jnp.asarray(q_len),
-            jnp.asarray(kv_len), jnp.asarray(produce),
-            jnp.asarray(prev), sub)
+        with _devev.execution("serving.ragged_step"):
+            out = self._ragged_fn()(
+                self._state_arg(), jnp.asarray(toks), self.k_pool,
+                self.v_pool, jnp.asarray(page_ids), jnp.asarray(offs),
+                jnp.asarray(pos), jnp.asarray(self.page_table),
+                jnp.asarray(q_start), jnp.asarray(q_len),
+                jnp.asarray(kv_len), jnp.asarray(produce),
+                jnp.asarray(prev), sub)
         if self._slo:
             nxt, ok, self.k_pool, self.v_pool = out
             ok = np.asarray(ok)
@@ -1209,10 +1214,11 @@ class ContinuousBatchingEngine:
             lens = np.array([s.length for s in self.slots], np.int32)
             key_before = self._key
             self._key, sub = jax.random.split(self._key)
-            out = self._decode_fn()(
-                self._state_arg(), jnp.asarray(toks), self.k_pool,
-                self.v_pool, jnp.asarray(self.page_table),
-                jnp.asarray(lens), jnp.asarray(active), sub)
+            with _devev.execution("serving.decode"):
+                out = self._decode_fn()(
+                    self._state_arg(), jnp.asarray(toks), self.k_pool,
+                    self.v_pool, jnp.asarray(self.page_table),
+                    jnp.asarray(lens), jnp.asarray(active), sub)
             if self._slo:
                 nxt, ok, self.k_pool, self.v_pool = out
                 ok = np.asarray(ok)

@@ -31,6 +31,7 @@ import weakref
 from typing import Any, Optional
 
 from ..framework import core
+from ..observability import goodput as _goodput
 from ..observability import metrics as _m
 from ..tensor import Tensor
 
@@ -197,6 +198,10 @@ class DevicePrefetcher:
                 # steady-state starvation — fold it into warmup_seconds
                 # so starved_seconds stays a clean scale-up signal
                 (_WARMUP if first else _STARVED).inc(waited)
+                # feed the goodput ledger's data_wait bucket (skipped
+                # when a timed_iter on this thread already times the
+                # enclosing next() — the hapi fit path)
+                _goodput.consumer_wait(waited)
                 first = False
                 _PREFETCH_DEPTH.set(q.qsize())
                 yield item

@@ -15,12 +15,16 @@ SOT's static path.
 from __future__ import annotations
 
 import functools
+import itertools
 from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 
 from ..framework import core
+from ..observability import device_events as _devev
+from ..observability import goodput as _goodput
+from ..observability import metrics as _om
 from ..tensor import Tensor
 
 __all__ = ["to_static", "not_to_static", "TrainStep", "train_step", "save",
@@ -240,6 +244,10 @@ def _quant_sync_grads(model, ef, axis, nranks, cfg):
     return new_ef
 
 
+# ordinal suffixes for TrainStep executable tags (see _exec_tag)
+_TRAIN_STEP_TAGS = itertools.count(1)
+
+
 class TrainStep:
     """One-call compiled training step: forward + backward + optimizer update
     in a single XLA executable (the TPU-native answer to the reference's
@@ -295,6 +303,13 @@ class TrainStep:
         self._compiled = None
         self._donate = donate
         self._key_base = None     # per-instance RNG base (see __call__)
+        # stable executable tag stamped at trace time: per-execution
+        # device telemetry (xla.execute_seconds, per-execution collective
+        # counts) and compile attribution key on it. First instance is
+        # plain "train_step" so single-step jobs need no label juggling.
+        n = next(_TRAIN_STEP_TAGS)
+        self._exec_tag = "train_step" if n == 1 else f"train_step_{n}"
+        self._step_flops = None   # executable cost_analysis FLOPs (MFU)
         self._accum = int(accumulate_steps)
         self._quant = None        # (axis, nranks, CommQuantConfig) at build
         self._ef_state = None     # error-feedback residuals (dp-sharded)
@@ -585,21 +600,31 @@ class TrainStep:
         if bench:
             import time as _time
             _t0 = _time.perf_counter()
+        armed = _om.enabled()
+        call_args = (params, buffers, dict(opt._state),
+                     dict(opt._master_weights), scaler_state,
+                     step_i, lr, key, batch_arrays)
         if self._quant is not None:
-            ef = self._ensure_ef_state(params)
+            call_args = call_args + (self._ensure_ef_state(params),)
+        if armed and self._step_flops is None:
+            # must run BEFORE the call: args 0-3 are donated by it
+            self._step_flops = self._lower_flops(call_args)
+        if armed:
+            # execution window: xla.execute_seconds{executable=tag} +
+            # per-execution collective counts replayed from the tag's
+            # trace-time composition (observability/device_events.py)
+            with _devev.execution(self._exec_tag):
+                outs = self._compiled(*call_args)
+        else:
+            outs = self._compiled(*call_args)
+        if self._quant is not None:
             (loss, new_params, new_buffers, new_opt_state, new_master,
-             new_scaler, new_ef) = \
-                self._compiled(params, buffers, dict(opt._state),
-                               dict(opt._master_weights), scaler_state,
-                               step_i, lr, key, batch_arrays, ef)
+             new_scaler, new_ef) = outs
             if new_ef:
                 self._ef_state = new_ef
         else:
             (loss, new_params, new_buffers, new_opt_state, new_master,
-             new_scaler) = \
-                self._compiled(params, buffers, dict(opt._state),
-                               dict(opt._master_weights), scaler_state,
-                               step_i, lr, key, batch_arrays)
+             new_scaler) = outs
         sd = self.model.state_dict()
         for k, v in new_params.items():
             sd[k].data = v
@@ -646,7 +671,27 @@ class TrainStep:
                 raise FloatingPointError(
                     f"NaN or Inf in updated parameters {bad[:5]} "
                     "(FLAGS_check_nan_inf)")
+        if armed:
+            # close this step's goodput window: whatever the window's
+            # wall wasn't attributed (data wait, host pulls, compile,
+            # checkpoint/elastic stalls) is productive device-execute;
+            # the executable's own FLOPs feed the live MFU gauge
+            _goodput.step_boundary(flops=self._step_flops)
         return Tensor(loss)
+
+    def _lower_flops(self, call_args):
+        """The executable's own FLOP count via lowered.cost_analysis()
+        (the distributed/auto_parallel/cost_model.py seam) — one extra
+        abstract trace, paid only on the first ARMED call."""
+        try:
+            with _devev.tagged(self._exec_tag):
+                lowered = self._compiled.lower(*call_args)
+            ca = lowered.cost_analysis() or {}
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            return float(ca.get("flops", 0.0) or 0.0)
+        except Exception:
+            return 0.0
 
 
 def train_step(model, optimizer, step_fn, **kw):

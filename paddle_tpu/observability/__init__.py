@@ -1,6 +1,6 @@
 """paddle_tpu.observability — unified runtime telemetry.
 
-One subsystem, three parts (see the per-module docstrings):
+One subsystem (see the per-module docstrings):
 
 - `metrics`  — process-wide registry of counters/gauges/fixed-bucket
   histograms with labels; disarmed by default (single bool check per
@@ -11,20 +11,30 @@ One subsystem, three parts (see the per-module docstrings):
   FLAGS_metrics_port), atomic JSON / append-only JSONL writers, and the
   crash flight recorder (FLAGS_flight_recorder) that leaves a
   post-mortem artifact when a trainer hangs, crashes or is killed.
+- `goodput`  — the goodput ledger: step-window wall time decomposed into
+  labeled productive/badput buckets + a live MFU gauge.
+- `device_events` — per-execution device telemetry: jax.monitoring
+  compile-duration bridge + per-executable execute accounting keyed by
+  a trace-time tag (closes the trace-time-only collective caveat).
+- `federation` — per-rank snapshot publishing (FLAGS_metrics_snapshot)
+  + the launch supervisor's job-level merged /metrics.
+- `view`     — `python -m paddle_tpu.observability.view`: merge flight
+  JSONL files across ranks/incarnations into one post-mortem timeline.
 
 Arm everything with `FLAGS_metrics=1` (env var — read at import so
 subprocess chaos tests inherit it — or paddle.set_flags) or
 `observability.enable()`. Instrumented call sites live in
 autograd/tape (dispatch cache, via collector), distributed/{collective,
 checkpoint, elastic, _net, rpc, watchdog}, utils/fault_injection (via
-collector), jit.TrainStep and profiler.Profiler.
+collector), io/prefetch, hapi/model, jit.TrainStep, inference/serving
+and profiler.Profiler.
 """
 from __future__ import annotations
 
 import os
 import threading
 
-from . import export, metrics, spans  # noqa: F401
+from . import device_events, export, goodput, metrics, spans  # noqa: F401
 from .export import (append_jsonl, flight_dump,  # noqa: F401
                      install_flight_recorder, prometheus_text,
                      serve_metrics, uninstall_flight_recorder,
@@ -32,7 +42,8 @@ from .export import (append_jsonl, flight_dump,  # noqa: F401
 from .metrics import counter, gauge, histogram, snapshot  # noqa: F401
 from .spans import span  # noqa: F401
 
-__all__ = ["metrics", "spans", "export", "enable", "enabled", "arm", "span",
+__all__ = ["metrics", "spans", "export", "goodput", "device_events",
+           "enable", "enabled", "arm", "span",
            "counter", "gauge", "histogram", "snapshot", "prometheus_text",
            "write_snapshot", "append_jsonl", "serve_metrics",
            "install_flight_recorder", "uninstall_flight_recorder",
@@ -40,9 +51,14 @@ __all__ = ["metrics", "spans", "export", "enable", "enabled", "arm", "span",
 
 
 def enable(on: bool = True) -> None:
-    """Arm (or disarm) the metrics registry and span tracing together."""
+    """Arm (or disarm) the metrics registry and span tracing together.
+    Arming also installs the jax.monitoring duration listener once (it
+    bails on the armed bool when disarmed, so there is nothing to
+    uninstall)."""
     metrics.enable(on)
     spans.enable(on)
+    if on:
+        device_events.install_listener()
 
 
 def enabled() -> bool:
@@ -87,29 +103,52 @@ def arm():
 # device-memory gauges (FLAGS_log_memory_stats + Profiler.step); created
 # here once — consumers import the helper, not their own instruments
 _G_MEM_IN_USE = metrics.gauge("device.bytes_in_use",
-                              "device memory currently allocated (bytes)")
+                              "device memory currently allocated (bytes); "
+                              "unlabeled cell = host total, device=... "
+                              "cells = per chip")
 _G_MEM_PEAK = metrics.gauge("device.peak_bytes_in_use",
-                            "peak device memory allocated (bytes)")
+                            "peak device memory allocated (bytes); "
+                            "unlabeled cell = host total, device=... "
+                            "cells = per chip")
 
 
 def update_device_memory_gauges():
-    """Refresh device.bytes_in_use / device.peak_bytes_in_use from
-    jax.local_devices()[0].memory_stats() and return
-    {'bytes_in_use', 'peak_bytes_in_use'} — or None on backends without
-    memory_stats (a clean no-op; CPU jaxlib returns None)."""
+    """Refresh device.bytes_in_use / device.peak_bytes_in_use from EVERY
+    local device's memory_stats(): per-device labeled cells
+    (device="tpu:0", ...) plus the unlabeled host-total cell — a
+    multi-chip host no longer reports device 0 as the whole host.
+    Returns {'bytes_in_use', 'peak_bytes_in_use', 'per_device'} (totals
+    + the per-device map) — or None on backends without memory_stats
+    (a clean no-op; CPU jaxlib returns None)."""
     try:
         import jax
-        st = jax.local_devices()[0].memory_stats()
+        devs = jax.local_devices()
     except Exception:
         return None
-    if not st:
+    total_in = total_peak = 0
+    per_device = {}
+    for d in devs:
+        try:
+            st = d.memory_stats()
+        except Exception:
+            st = None
+        if not st:
+            continue
+        in_use = int(st.get("bytes_in_use", 0))
+        peak = int(st.get("peak_bytes_in_use", in_use))
+        label = f"{d.platform}:{d.id}"
+        per_device[label] = {"bytes_in_use": in_use,
+                             "peak_bytes_in_use": peak}
+        _G_MEM_IN_USE.set(in_use, device=label)
+        _G_MEM_PEAK.set(peak, device=label)
+        total_in += in_use
+        total_peak += peak
+    if not per_device:
         return None
-    mem = {"bytes_in_use": int(st.get("bytes_in_use", 0)),
-           "peak_bytes_in_use": int(st.get("peak_bytes_in_use",
-                                           st.get("bytes_in_use", 0)))}
-    _G_MEM_IN_USE.set(mem["bytes_in_use"])
-    _G_MEM_PEAK.set(mem["peak_bytes_in_use"])
-    return mem
+    _G_MEM_IN_USE.set(total_in)
+    _G_MEM_PEAK.set(total_peak)
+    return {"bytes_in_use": total_in, "peak_bytes_in_use": total_peak,
+            "per_device": per_device}
 
 
 # env arming at import (the fault_injection.py pattern): subprocess chaos
@@ -133,4 +172,11 @@ if _flight_path:
     try:
         install_flight_recorder(_flight_path)
     except OSError:
+        pass    # unwritable path must not break `import paddle_tpu`
+_snapshot_path = os.environ.get("FLAGS_metrics_snapshot")
+if _snapshot_path:
+    try:
+        from . import federation as _federation
+        _federation.start_publisher(_snapshot_path)
+    except Exception:
         pass    # unwritable path must not break `import paddle_tpu`

@@ -1,0 +1,213 @@
+"""Per-execution device telemetry: a jax.monitoring duration-event
+listener bridged into registry histograms, plus per-executable execution
+accounting keyed by a stable tag stamped at trace time.
+
+Closes the documented trace-time-only caveat on in-shard_map collective
+accounting (distributed/collective.py): the host-side telemetry wrapper
+there runs once per COMPILE for compiled collectives, so
+`collective.calls_total` under-counts executed steps. The fix rides two
+seams:
+
+- `execution(tag)` — a context manager the owner of a compiled callable
+  wraps around each invocation (jit.TrainStep stamps "train_step*"; the
+  serving engine stamps "serving.decode"/"serving.ragged_step"/
+  "serving.prefill"). Each exit observes `xla.execute_seconds{executable=
+  tag}` — host-observed dispatch+execute wall: exact on synchronous
+  backends, a dispatch-side lower bound under async TPU dispatch.
+- `note_traced_collective(op)` — called by the collective wrapper while
+  a TRACE is in progress inside an open execution window. The noted ops
+  become the tag's composition; every later execution of that tag then
+  increments `collective.executed_calls_total{op=..., executable=tag}`
+  by the composition counts — per-execution numbers derived from
+  trace-time composition x execution count. A re-trace (new shapes)
+  REPLACES the composition, so recompiles never double it.
+
+The jax.monitoring listener feeds `xla.compile_seconds{executable=tag}`
+(and the goodput ledger's `compile` bucket) from the
+`/jax/core/compile/*` duration events; it is registered once on first
+arming and bails on the armed bool when disarmed.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+from . import goodput as _goodput
+from . import metrics as _m
+
+__all__ = ["execution", "tagged", "note_traced_collective",
+           "install_listener", "current_tag", "tag_composition"]
+
+# wide-range buckets: compiles run seconds-to-minutes, executes ms-to-s
+_H_COMPILE = _m.histogram(
+    "xla.compile_seconds",
+    "XLA compile-phase durations (jax.monitoring events) by the "
+    "executable tag active when they fired",
+    buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0, 300.0))
+_H_EXECUTE = _m.histogram(
+    "xla.execute_seconds",
+    "host-observed wall seconds per execution of a tagged executable "
+    "(dispatch-side lower bound under async dispatch)")
+_C_COLL_EXEC = _m.counter(
+    "collective.executed_calls_total",
+    "per-EXECUTION collective counts: trace-time composition of a "
+    "tagged executable x its execution count (closes the trace-time-"
+    "only caveat on collective.calls_total for compiled collectives)")
+
+_lock = threading.RLock()
+# executable tag -> {op: count} recorded at its last trace
+_tag_ops: Dict[str, Dict[str, int]] = {}
+
+_tl = threading.local()          # .stack: [execution frames]
+
+_listener_installed = False
+
+
+class _Frame:
+    __slots__ = ("tag", "t0", "fresh")
+
+    def __init__(self, tag: str):
+        self.tag = tag
+        self.t0 = time.perf_counter()
+        self.fresh: Dict[str, int] = {}
+
+
+def current_tag():
+    """The innermost open execution tag on this thread, or None."""
+    stack = getattr(_tl, "stack", None)
+    return stack[-1].tag if stack else None
+
+
+def tag_composition(tag: str) -> Dict[str, int]:
+    """The collective composition recorded at `tag`'s last trace."""
+    with _lock:
+        return dict(_tag_ops.get(tag, {}))
+
+
+class execution:
+    """`with execution("train_step"): compiled(...)` — times the call
+    into xla.execute_seconds{executable=tag} and replays the tag's
+    traced collective composition into per-execution counters.
+    Disarmed: an object allocation + one bool check."""
+
+    __slots__ = ("tag", "_frame")
+
+    def __init__(self, tag: str):
+        self.tag = tag
+        self._frame = None
+
+    def __enter__(self):
+        if not _m.enabled():
+            return self
+        self._frame = _Frame(self.tag)
+        stack = getattr(_tl, "stack", None)
+        if stack is None:
+            stack = _tl.stack = []
+        stack.append(self._frame)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        f = self._frame
+        if f is None:
+            return False
+        stack = getattr(_tl, "stack", None)
+        if stack and stack[-1] is f:
+            stack.pop()
+        self._frame = None
+        _H_EXECUTE.observe(time.perf_counter() - f.t0, executable=f.tag)
+        with _lock:
+            if f.fresh:
+                # this execution TRACED (first call or a re-trace):
+                # the fresh note set IS the composition now — replace,
+                # never append, so recompiles cannot double it
+                _tag_ops[f.tag] = dict(f.fresh)
+            comp = _tag_ops.get(f.tag)
+        if comp and exc_type is None:
+            for op, n in comp.items():
+                _C_COLL_EXEC.inc(n, op=op, executable=f.tag)
+        return False
+
+
+class tagged:
+    """Trace-only tag window: compile durations and traced-collective
+    notes attribute to `tag`, but NO execution is counted (no
+    xla.execute_seconds sample, no composition replay). Wraps explicit
+    `.lower()` calls — which may populate the jit trace cache, so the
+    composition they trace must be kept for later executions."""
+
+    __slots__ = ("tag", "_frame")
+
+    def __init__(self, tag: str):
+        self.tag = tag
+        self._frame = None
+
+    def __enter__(self):
+        if not _m.enabled():
+            return self
+        self._frame = _Frame(self.tag)
+        stack = getattr(_tl, "stack", None)
+        if stack is None:
+            stack = _tl.stack = []
+        stack.append(self._frame)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        f = self._frame
+        if f is None:
+            return False
+        stack = getattr(_tl, "stack", None)
+        if stack and stack[-1] is f:
+            stack.pop()
+        self._frame = None
+        if f.fresh:
+            with _lock:
+                _tag_ops[f.tag] = dict(f.fresh)
+        return False
+
+
+def note_traced_collective(op: str) -> None:
+    """Record that a collective op was traced into the executable whose
+    execution window is open on this thread. No-op outside a window or
+    outside tracing."""
+    if not _m.enabled():
+        return
+    stack = getattr(_tl, "stack", None)
+    if not stack:
+        return
+    try:
+        import jax
+        if jax.core.trace_state_clean():
+            return                   # eager call, not a trace
+    except Exception:
+        return
+    f = stack[-1]
+    f.fresh[op] = f.fresh.get(op, 0) + 1
+
+
+def _on_duration(event, duration, **kw) -> None:
+    if not _m.enabled():
+        return
+    # exact compile-phase events only: a bare "compile" substring would
+    # also match /jax/compilation_cache/compile_time_saved_sec — time
+    # that was NOT spent (warm persistent cache), which would inject a
+    # phantom compile stall bigger than the window wall
+    if not event.startswith("/jax/core/compile/"):
+        return
+    tag = current_tag() or "untagged"
+    _H_COMPILE.observe(float(duration), executable=tag)
+    _goodput.attribute("compile", float(duration))
+
+
+def install_listener() -> None:
+    """Register the jax.monitoring duration listener once per process
+    (jax has no unregister; the callback bails on the armed bool)."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    _listener_installed = True
+    try:
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        pass                         # jax absent/old: histograms stay 0

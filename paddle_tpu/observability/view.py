@@ -1,0 +1,223 @@
+"""`python -m paddle_tpu.observability.view` — merge flight-recorder
+JSONL files across ranks and incarnations into ONE time-ordered
+post-mortem timeline.
+
+A supervised elastic job leaves a pile of artifacts under --log_dir:
+`flight.rank{R}.inc{K}.jsonl` per worker incarnation (write-through span
+events + dump records, observability/export.py) and
+`supervisor_flight.jsonl` (spawn/death/relaunch/degrade transitions,
+distributed/launch/main.py). Reading WHY a job died means correlating
+all of them by wall clock — this CLI does the merge:
+
+    python -m paddle_tpu.observability.view <log_dir>
+    python -m paddle_tpu.observability.view flight.rank0.inc0.jsonl \\
+        flight.rank1.inc*.jsonl supervisor_flight.jsonl
+
+Output: one line per event, time-ordered across every file, tagged with
+its origin (`r1.i0` = rank 1 incarnation 0, `sup` = supervisor),
+followed by a post-mortem summary — per-origin last-event time, spans
+still OPEN at the end of each file (the begin line without its end:
+what a SIGKILLed worker was doing when it died), dump reasons, and the
+supervisor's death/relaunch/degrade record. `--json` emits the merged
+records as JSONL instead for machine consumption.
+
+Non-JSON lines (faulthandler tracebacks share the flight file) are
+skipped; files that fail to parse entirely are reported, not fatal.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import time
+from typing import List, Optional, Tuple
+
+__all__ = ["main", "collect_files", "load_events"]
+
+_FLIGHT_NAME_RE = re.compile(r"\.rank(\d+)\.inc(\d+)\.jsonl$")
+
+
+def collect_files(args_paths: List[str]) -> List[str]:
+    """Expand directories (all *.jsonl under them) and globs into a
+    sorted, de-duplicated file list."""
+    out = []
+    for p in args_paths:
+        if os.path.isdir(p):
+            out.extend(sorted(glob.glob(os.path.join(p, "*.jsonl"))))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(glob.glob(p)))
+        else:
+            out.append(p)
+    seen = set()
+    uniq = []
+    for p in out:
+        ap = os.path.abspath(p)
+        if ap not in seen:
+            seen.add(ap)
+            uniq.append(p)
+    return uniq
+
+
+def _origin_of(path: str, rec: dict) -> str:
+    base = os.path.basename(path)
+    if base == "supervisor_flight.jsonl":
+        return "sup"
+    m = _FLIGHT_NAME_RE.search(base)
+    if m:
+        return f"r{m.group(1)}.i{m.group(2)}"
+    rank = rec.get("rank")
+    inc = rec.get("incarnation")
+    if rank is not None:
+        return f"r{rank}.i{inc if inc is not None else '?'}"
+    return base
+
+
+def load_events(paths: List[str]) -> Tuple[List[dict], List[str]]:
+    """Parse every file's JSONL records, tagging each with `_origin` and
+    `_file`. Returns (time-sorted records, per-file problems)."""
+    events = []
+    problems = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError as e:
+            problems.append(f"{path}: {e}")
+            continue
+        n_bad = 0
+        for ln in lines:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                n_bad += 1        # faulthandler traceback text: expected
+                continue
+            if not isinstance(rec, dict):
+                continue
+            rec["_origin"] = _origin_of(path, rec)
+            rec["_file"] = path
+            events.append(rec)
+        if n_bad:
+            problems.append(
+                f"{path}: {n_bad} non-JSON line(s) skipped "
+                f"(faulthandler traceback?)")
+    events.sort(key=lambda r: (r.get("ts") or 0.0))
+    return events, problems
+
+
+def _fmt_ts(ts: Optional[float]) -> str:
+    if not ts:
+        return "--:--:--.---"
+    frac = int((ts - int(ts)) * 1000)
+    return time.strftime("%H:%M:%S", time.localtime(ts)) + f".{frac:03d}"
+
+
+def _fmt_event(rec: dict) -> str:
+    ev = rec.get("ev", "?")
+    bits = [f"{_fmt_ts(rec.get('ts')):>12}", f"[{rec['_origin']:>7}]",
+            f"{ev:<18}"]
+    if ev in ("span_begin", "span_end"):
+        bits.append(rec.get("name", ""))
+        if ev == "span_end" and "dur_s" in rec:
+            bits.append(f"dur={rec['dur_s']:.4f}s")
+        if rec.get("error"):
+            bits.append(f"error={rec['error']}")
+        attrs = rec.get("attrs")
+        if attrs:
+            bits.append(" ".join(f"{k}={v}" for k, v in
+                                 sorted(attrs.items())))
+    elif ev == "dump":
+        bits.append(f"reason={rec.get('reason')}")
+        open_spans = rec.get("open_spans") or []
+        if open_spans:
+            bits.append("open=" +
+                        ",".join(s.get("name", "?") for s in open_spans))
+    else:
+        for k in ("rank", "incarnation", "rc", "generation", "restart",
+                  "world", "error", "pid"):
+            if k in rec:
+                bits.append(f"{k}={rec[k]}")
+    return " ".join(str(b) for b in bits if b != "")
+
+
+def _open_spans(events: List[dict]) -> dict:
+    """Per origin: span begin events whose sid never saw an end — what
+    each worker was doing at the end of its file."""
+    by_origin: dict = {}
+    for rec in events:
+        o = rec["_origin"]
+        ev = rec.get("ev")
+        if ev == "span_begin":
+            by_origin.setdefault(o, {})[rec.get("sid")] = rec
+        elif ev == "span_end":
+            by_origin.setdefault(o, {}).pop(rec.get("sid"), None)
+    return {o: sorted(s.get("name", "?") for s in sids.values())
+            for o, sids in by_origin.items() if sids}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.observability.view",
+        description="Merge flight-recorder JSONL files across "
+                    "ranks/incarnations into one post-mortem timeline")
+    p.add_argument("paths", nargs="+",
+                   help="flight JSONL files, globs, or a log_dir")
+    p.add_argument("--json", action="store_true",
+                   help="emit merged records as JSONL instead of text")
+    p.add_argument("--limit", type=int, default=0,
+                   help="print only the LAST N timeline events")
+    args = p.parse_args(argv)
+
+    files = collect_files(args.paths)
+    if not files:
+        print("view: no flight files found", file=sys.stderr)
+        return 1
+    events, problems = load_events(files)
+    for w in problems:
+        print(f"view: {w}", file=sys.stderr)
+    if not events:
+        print("view: no parseable events", file=sys.stderr)
+        return 1
+
+    if args.json:
+        for rec in events:
+            print(json.dumps(rec))
+        return 0
+
+    shown = events[-args.limit:] if args.limit else events
+    print(f"== timeline ({len(events)} events from {len(files)} files"
+          f"{f', last {len(shown)}' if args.limit else ''}) ==")
+    for rec in shown:
+        print(_fmt_event(rec))
+
+    print("\n== post-mortem ==")
+    origins = sorted({r["_origin"] for r in events})
+    last_ts = {o: max((r.get("ts") or 0.0) for r in events
+                      if r["_origin"] == o) for o in origins}
+    open_by = _open_spans(events)
+    for o in origins:
+        line = f"{o:>8}: last event {_fmt_ts(last_ts[o])}"
+        if o in open_by:
+            line += "  OPEN at end: " + ", ".join(open_by[o])
+        print(line)
+    dumps = [r for r in events if r.get("ev") == "dump"]
+    for d in dumps:
+        print(f"  dump [{d['_origin']}] reason={d.get('reason')} "
+              f"at {_fmt_ts(d.get('ts'))}")
+    for ev_name in ("worker_death", "relaunch", "degrade",
+                    "spawn_failed"):
+        for r in events:
+            if r.get("ev") == ev_name:
+                print(f"  {ev_name} [{r['_origin']}] rank={r.get('rank')}"
+                      f" inc={r.get('incarnation')} rc={r.get('rc', '-')}"
+                      f" at {_fmt_ts(r.get('ts'))}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -316,6 +316,7 @@ class Profiler:
                 f"{1.0/avg if avg else 0:.2f} steps/s")
 
     def _summary_payload(self, snap: Optional[dict] = None) -> dict:
+        from ..observability import goodput as _goodput
         n = len(self._step_times)
         tot = sum(self._step_times)
         return {
@@ -325,6 +326,7 @@ class Profiler:
             "step_times_seconds": list(self._step_times),
             "eager_dispatch_cache": eager_dispatch_cache_stats(),
             "fault_injection": fault_injection_stats(),
+            "goodput": _goodput.summary(),
             "metrics": snap if snap is not None else metrics_snapshot(),
         }
 
@@ -351,6 +353,16 @@ class Profiler:
                 for n, v in fi["points"].items())
             print(f"fault injection ({'armed' if fi['enabled'] else 'off'}; "
                   f"point=hits/triggered): {pts}")
+        from ..observability import goodput as _goodput
+        gp = _goodput.summary()
+        if gp["steps"]:
+            bad = "  ".join(f"{k}={v*1000:.1f}ms" for k, v in
+                            sorted(gp["badput_seconds"].items()))
+            print(f"goodput ledger: {gp['steps']} windows  "
+                  f"productive {gp['productive_seconds']*1000:.1f} ms "
+                  f"({gp['productive_fraction']*100:.1f}%)"
+                  + (f"  mfu {gp['mfu']:.4f}" if gp["mfu"] else "")
+                  + (f"  badput: {bad}" if bad else ""))
         snap = metrics_snapshot()   # once: reused for the JSON artifact
         n_series = sum(len(v) for kind in snap.values()
                        for v in kind.values())
@@ -384,7 +396,10 @@ class RecordEvent:
     def begin(self):
         if _spans.enabled():
             # spans.span carries its own TraceAnnotation — one XProf
-            # annotation, plus the ring/flight-recorder record
+            # annotation, plus the ring/flight-recorder record.
+            # RecordEvent forwards USER-chosen names: dynamism is the
+            # API here, not a hygiene hole.
+            # graft-lint: disable=metric-names
             self._span = _spans.span(self.name)
             self._span.__enter__()
             return

@@ -1,0 +1,56 @@
+"""Federation churn worker (ISSUE 11).
+
+Launched under the supervisor (`launch --elastic_level 1 --metrics_port
+P --nproc_per_node N`): each rank's registry is armed and snapshot-
+published by the supervisor-provided env (FLAGS_metrics=1 +
+FLAGS_metrics_snapshot per incarnation). The loop records goodput
+windows and eager collective calls so the job-level /metrics has both
+`goodput.*` and `collective.*` series per rank; the designated fault
+rank kills itself with os._exit(137) (the SIGKILL shape — no atexit, no
+final snapshot) mid-run on its FIRST incarnation, so the test can watch
+its inc0 series go stale while the relaunched inc1 series appear.
+
+argv: out_dir total_iters [fault_rank fault_iter]
+Writes done_{rank}_inc{inc}.json at the end of a surviving run.
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import distributed as dist
+from paddle_tpu.observability import goodput, metrics
+
+
+def main():
+    out_dir = sys.argv[1]
+    total = int(sys.argv[2])
+    fault_rank = int(sys.argv[3]) if len(sys.argv) > 3 else -1
+    fault_iter = int(sys.argv[4]) if len(sys.argv) > 4 else -1
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    inc = int(os.environ.get("PADDLE_INCARNATION", "0"))
+
+    assert metrics.enabled(), "supervisor must arm FLAGS_metrics"
+    t = paddle.to_tensor(np.ones(8, np.float32))
+    goodput.open_window()
+    for i in range(total):
+        time.sleep(0.12)
+        dist.all_reduce(t)                       # collective.* series
+        goodput.attribute("data_wait", 0.01)     # goodput.* series
+        goodput.step_boundary()
+        if rank == fault_rank and inc == 0 and i == fault_iter:
+            os._exit(137)        # SIGKILL shape: no cleanup, no snapshot
+
+    with open(os.path.join(out_dir, f"done_{rank}_inc{inc}.json"),
+              "w") as f:
+        json.dump({"rank": rank, "incarnation": inc,
+                   "steps": goodput.summary()["steps"]}, f)
+
+
+if __name__ == "__main__":
+    main()

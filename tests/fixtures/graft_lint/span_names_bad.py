@@ -1,0 +1,25 @@
+"""Fixture: span-name violations for the metric-names pass (ISSUE 11).
+Parsed, never imported."""
+from paddle_tpu.observability.spans import span
+
+
+def _dynamic(name):
+    with span(name):                      # fully dynamic name
+        pass
+
+
+def _bad_shape():
+    with span("NoDotCamel"):              # not subsystem.name
+        pass
+
+
+def _bad_prefix(op):
+    with span("UPPER" + op):              # prefix doesn't pin a subsystem
+        pass
+
+
+def _ok(op):
+    with span("ckptfixture.save"):        # fine: literal snake_case
+        pass
+    with span("collectivefixture." + op):  # fine: literal subsystem prefix
+        pass

@@ -1,0 +1,283 @@
+"""Multi-host metric federation (ISSUE 11): merge semantics (counters
+sum, gauges keep per-rank cells, histograms merge buckets), snapshot
+publishing, the job-level /metrics server, and the acceptance scenario —
+a 2-process `launch` run whose master serves ONE merged /metrics with
+both ranks' goodput.*/collective.* series, staying serveable while a
+rank is killed mid-scrape, marking the dead incarnation stale and
+surfacing the relaunch under a new incarnation label."""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import export, federation, goodput, metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "collective", "federation_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    federation.stop_publisher(final=False)
+    obs.enable(False)
+    metrics.reset()
+    goodput.reset()
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _snap(rank, inc, ts, counters=None, gauges=None, hists=None):
+    return {"rank": str(rank), "incarnation": str(inc), "ts": ts,
+            "metrics": {"counters": counters or {},
+                        "gauges": gauges or {},
+                        "histograms": hists or {}}}
+
+
+class TestMergeSemantics:
+    def test_counters_sum_gauges_per_rank_hists_merge(self):
+        h0 = {"buckets": [[0.1, 2], [1.0, 1], ["+Inf", 0]],
+              "sum": 0.7, "count": 3}
+        h1 = {"buckets": [[0.1, 1], [1.0, 0], ["+Inf", 2]],
+              "sum": 9.0, "count": 3}
+        now = 1000.0
+        merged = federation.merge_snapshots([
+            _snap(0, 0, now, counters={"c.total": {"": 5, "op=x": 2}},
+                  gauges={"g.depth": {"": 7}},
+                  hists={"h.lat_seconds": {"": h0}}),
+            _snap(1, 0, now, counters={"c.total": {"": 3}},
+                  gauges={"g.depth": {"": 9}},
+                  hists={"h.lat_seconds": {"": h1}}),
+        ], stale_after=10.0, now=now)
+        c = merged["counters"]["c.total"]
+        # per-rank cells labeled, job rollup = sum
+        assert c["incarnation=0,rank=0"] == 5
+        assert c["incarnation=0,rank=1"] == 3
+        assert c[""] == 8
+        assert c["op=x"] == 2
+        g = merged["gauges"]["g.depth"]
+        assert g["incarnation=0,rank=0"] == 7
+        assert g["incarnation=0,rank=1"] == 9
+        assert "" not in g                   # gauges never roll up
+        h = merged["histograms"]["h.lat_seconds"]
+        assert h[""]["count"] == 6
+        assert h[""]["sum"] == pytest.approx(9.7)
+        assert h[""]["buckets"][0] == [0.1, 3]
+        assert h["incarnation=0,rank=1"]["count"] == 3
+
+    def test_incarnations_kept_separate_and_counters_sum_across(self):
+        now = 1000.0
+        merged = federation.merge_snapshots([
+            _snap(1, 0, now - 60, counters={"c.total": {"": 10}}),
+            _snap(1, 1, now, counters={"c.total": {"": 4}}),
+        ], stale_after=10.0, now=now)
+        c = merged["counters"]["c.total"]
+        assert c["incarnation=0,rank=1"] == 10
+        assert c["incarnation=1,rank=1"] == 4
+        assert c[""] == 14                   # job total stays monotone
+        fresh = merged["gauges"]["federation.snapshot_fresh"]
+        assert fresh["incarnation=0,rank=1"] == 0.0     # stale
+        assert fresh["incarnation=1,rank=1"] == 1.0
+        assert "federation.last_seen_ts" in merged["gauges"]
+
+    def test_merged_snapshot_renders_as_prometheus(self):
+        merged = federation.merge_snapshots(
+            [_snap(0, 0, 1000.0, counters={"c.total": {"": 5}})],
+            stale_after=10.0, now=1000.0)
+        text = export.prometheus_text(merged)
+        assert 'c_total{incarnation="0",rank="0"} 5' in text
+        assert "c_total 5" in text           # job rollup cell
+
+    def test_corrupt_and_missing_snapshots_skipped(self, tmp_path):
+        (tmp_path / "metrics.rank0.inc0.json").write_text("{ torn")
+        (tmp_path / "metrics.rank1.inc0.json").write_text(json.dumps(
+            _snap(1, 0, time.time(),
+                  counters={"c.total": {"": 1}})))
+        snaps = federation.read_snapshots(str(tmp_path))
+        assert len(snaps) == 1 and snaps[0]["rank"] == "1"
+
+    def test_filename_provides_identity_fallback(self, tmp_path):
+        p = tmp_path / "metrics.rank3.inc2.json"
+        p.write_text(json.dumps({"ts": 1.0, "metrics": {}}))
+        snaps = federation.read_snapshots(str(tmp_path))
+        assert snaps[0]["rank"] == "3"
+        assert snaps[0]["incarnation"] == "2"
+
+
+class TestPublisher:
+    def test_publishes_identity_stamped_snapshots(self, tmp_path,
+                                                  monkeypatch):
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "4")
+        monkeypatch.setenv("PADDLE_INCARNATION", "1")
+        path = str(tmp_path / "metrics.rank4.inc1.json")
+        metrics.counter("testfed.pub_total", "p")
+        pub = federation.start_publisher(path, interval=0.1)
+        try:
+            assert metrics.enabled()         # publisher arms
+            metrics.counter("testfed.pub_total", "p").inc(3)
+            deadline = time.time() + 5
+            seen = None
+            while time.time() < deadline:
+                try:
+                    with open(path) as f:
+                        seen = json.load(f)
+                    if seen["metrics"]["counters"].get(
+                            "testfed.pub_total", {}).get("") == 3:
+                        break
+                except (OSError, ValueError, KeyError):
+                    pass
+                time.sleep(0.05)
+            assert seen is not None
+            assert seen["rank"] == "4" and seen["incarnation"] == "1"
+            assert seen["metrics"]["counters"]["testfed.pub_total"][""] == 3
+        finally:
+            pub.stop()
+
+    def test_flag_round_trip_starts_and_stops(self, tmp_path):
+        path = str(tmp_path / "metrics.rank0.inc0.json")
+        paddle.set_flags({"FLAGS_metrics_snapshot": path})
+        try:
+            assert federation._publisher is not None
+            paddle.set_flags({"FLAGS_metrics_snapshot_interval": 0.5})
+            assert federation._publisher.interval == 0.5
+        finally:
+            paddle.set_flags({"FLAGS_metrics_snapshot": ""})
+        assert federation._publisher is None
+        assert os.path.exists(path)
+
+
+class TestFederationServer:
+    def test_serves_merged_metrics_and_healthz(self, tmp_path):
+        now = time.time()
+        (tmp_path / "metrics.rank0.inc0.json").write_text(json.dumps(
+            _snap(0, 0, now, counters={"goodput.steps_total": {"": 7}})))
+        (tmp_path / "metrics.rank1.inc0.json").write_text(json.dumps(
+            _snap(1, 0, now - 99,
+                  counters={"goodput.steps_total": {"": 2}})))
+        srv = federation.FederationServer(
+            str(tmp_path), _free_port(), stale_after=5.0,
+            status_provider=lambda: {"world": 2})
+        port = srv.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ).read().decode()
+            assert ('goodput_steps_total{incarnation="0",rank="0"} 7'
+                    in body)
+            assert ('goodput_steps_total{incarnation="0",rank="1"} 2'
+                    in body)
+            assert "goodput_steps_total 9" in body
+            assert ('federation_snapshot_fresh{incarnation="0",'
+                    'rank="1"} 0' in body)
+            health = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+            assert health["ranks"]["0"]["fresh"] is True
+            assert health["ranks"]["1"]["fresh"] is False
+            assert health["supervisor"] == {"world": 2}
+        finally:
+            srv.stop()
+
+
+# -- acceptance: 2-process launch, SIGKILL mid-scrape ------------------------
+
+@pytest.mark.timeout(240)
+def test_two_process_federated_metrics_survive_rank_kill(tmp_path):
+    """ISSUE 11 acceptance: `launch --elastic_level 1 --metrics_port`
+    serves ONE merged /metrics on the master with both ranks' goodput.*
+    and collective.* series under rank labels; a rank SIGKILLing itself
+    mid-run never breaks the scrape, its inc0 series go stale, and the
+    relaunched incarnation's series appear under incarnation="1"."""
+    d = str(tmp_path)
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_ELASTIC_ENDPOINT"] = f"127.0.0.1:{_free_port()}"
+    env["FLAGS_metrics_snapshot_interval"] = "0.2"
+    env["PADDLE_FEDERATION_STALE_AFTER"] = "1.0"
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nnodes", "1", "--rank", "0", "--nproc_per_node", "2",
+           "--elastic_level", "1", "--max_restart", "1",
+           "--metrics_port", str(port), "--log_dir", d,
+           WORKER, d, "30", "1", "6"]
+    proc = subprocess.Popen(cmd, env=env, cwd=REPO,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+    url = f"http://127.0.0.1:{port}/metrics"
+    conditions = {
+        "rank0_goodput": False, "rank1_goodput": False,
+        "rank_labeled_collective": False, "inc1_series": False,
+        "inc0_stale": False,
+    }
+    scrapes = 0
+    failures = 0
+    try:
+        deadline = time.time() + 180
+        while proc.poll() is None and time.time() < deadline:
+            if all(conditions.values()):
+                break           # seen everything; stop before the
+                                # server's shutdown window opens
+            time.sleep(0.2)
+            try:
+                body = urllib.request.urlopen(url, timeout=5).read() \
+                    .decode()
+            except OSError:
+                # tolerate the server's start window only: once we have
+                # scraped successfully, a failure while the job is still
+                # running is a wedged scrape — exactly what the dead
+                # rank must NOT cause
+                if scrapes and proc.poll() is None:
+                    failures += 1
+                continue
+            scrapes += 1
+            if 'goodput_steps_total{incarnation="0",rank="0"}' in body:
+                conditions["rank0_goodput"] = True
+            if ('goodput_steps_total{incarnation="0",rank="1"}' in body
+                    or 'goodput_steps_total{incarnation="1",rank="1"}'
+                    in body):
+                conditions["rank1_goodput"] = True
+            if 'collective_calls_total{incarnation=' in body and \
+                    'rank="1"' in body:
+                conditions["rank_labeled_collective"] = True
+            if 'incarnation="1",rank="1"' in body:
+                conditions["inc1_series"] = True
+            if ('federation_snapshot_fresh{incarnation="0",rank="1"} 0'
+                    in body):
+                conditions["inc0_stale"] = True
+        out, _ = proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    text = out.decode(errors="replace")
+    assert proc.returncode == 0, text[-4000:]
+    assert scrapes > 5, (scrapes, text[-2000:])
+    assert failures == 0, f"{failures} scrape(s) failed mid-churn"
+    missing = [k for k, v in conditions.items() if not v]
+    assert not missing, (missing, text[-3000:])
+
+    # deterministic post-exit check straight off the snapshot files:
+    # counters sum across rank 1's two incarnations in the job rollup
+    snaps = federation.read_snapshots(d)
+    ranks = {(s["rank"], s["incarnation"]) for s in snaps}
+    assert ("1", "0") in ranks and ("1", "1") in ranks, ranks
+    merged = federation.merge_snapshots(snaps, stale_after=1e9)
+    steps = merged["counters"]["goodput.steps_total"]
+    assert steps[""] == sum(v for k, v in steps.items() if k != "")
+    assert "collective.calls_total" in merged["counters"]
+    # both ranks finished (rank 1 as incarnation 1)
+    assert os.path.exists(os.path.join(d, "done_0_inc0.json"))
+    assert os.path.exists(os.path.join(d, "done_1_inc1.json"))

@@ -387,6 +387,59 @@ def test_metric_names_shim_threads_seen_across_files(tmp_path):
     second = shim.check_file(b, seen)
     assert first == []
     assert len(second) == 1 and "duplicate" in second[0][2]
+    # span home-module state rides the same seen dict: a cross-file
+    # span fork is caught through the legacy API too
+    sa = tmp_path / "sa.py"
+    sb = tmp_path / "sb.py"
+    sa.write_text("from x import span\n"
+                  "def f():\n"
+                  "    with span('subspan.phase'):\n"
+                  "        pass\n")
+    sb.write_text("from x import span\n"
+                  "def g():\n"
+                  "    with span('subspan.phase'):\n"
+                  "        pass\n")
+    assert shim.check_file(sa, seen) == []
+    forked = shim.check_file(sb, seen)
+    assert len(forked) == 1 and "one span name" in forked[0][2]
+
+
+def test_metric_names_covers_span_literals():
+    """ISSUE 11 satellite: span("...") names ride the same
+    snake_case/uniqueness discipline as metric ids — the fixture's
+    dynamic name, bad shape and bad concatenation prefix are each
+    caught; the literal + literal-prefix forms pass."""
+    from tools.graft_lint.passes.metric_names import MetricNamesPass
+    fixture = FIXTURES / "span_names_bad.py"
+    res = _run([MetricNamesPass()], paths=[fixture])
+    msgs = [f.message for f in res.active]
+    assert len(msgs) == 3, msgs
+    assert any("string literal" in m for m in msgs)          # dynamic
+    assert any("snake_case" in m for m in msgs)              # bad shape
+    assert any("prefix" in m for m in msgs)                  # bad concat
+
+
+def test_metric_names_span_home_module_uniqueness(tmp_path):
+    """One span name, one home module: the same literal from two
+    different files is flagged; repeats within one file are fine (a
+    retry loop spans the same name at several sites)."""
+    from tools.graft_lint.passes.metric_names import MetricNamesPass
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text("from x import span\n"
+                 "def f():\n"
+                 "    with span('sub.phase'):\n"
+                 "        pass\n"
+                 "    with span('sub.phase'):\n"    # same file: OK
+                 "        pass\n")
+    b.write_text("from x import span\n"
+                 "def g():\n"
+                 "    with span('sub.phase'):\n"    # other file: forked
+                 "        pass\n")
+    p = MetricNamesPass()
+    res = _run([p], paths=[a, b])
+    assert len(res.active) == 1
+    assert "one span name, one home module" in res.active[0].message
 
 
 # -- --changed mode ----------------------------------------------------------

@@ -29,12 +29,15 @@ __all__ = ["check_file", "main"]
 def check_file(path: Path, seen: dict = None) -> list:
     """Old-API entry: callers thread one `seen` dict across files to get
     cross-file duplicate detection, exactly as the standalone checker
-    did."""
+    did. Span home-module state rides the same dict (under a reserved
+    string key — metric entries are (kind, id) tuples, no collision) so
+    the one-span-name-one-module rule also works across files here."""
     from tools.graft_lint.core import FileContext
     p = MetricNamesPass()
     p.begin(REPO)
     if seen is not None:
         p._seen = seen
+        p._span_seen = seen.setdefault("__spans__", {})
     ctx = FileContext.load(Path(path), REPO)
     findings = [f for f in p.check_file(ctx)
                 if not ctx.suppressed(f.line, p.name)]

@@ -42,6 +42,9 @@ CHECKED_MODULES = (
     "paddle_tpu/profiler/__init__.py",
     # jit.save's .pdmodel inference artifact (converted in ISSUE 3)
     "paddle_tpu/jit/__init__.py",
+    # ISSUE 11: federation snapshot files (own stdlib atomic commit —
+    # the publisher thread must not import framework.io mid-import)
+    "paddle_tpu/observability/federation.py",
     # ISSUE 4: static.save_inference_model + onnx.export artifacts
     # (converted this PR — closes the ROADMAP open item from ISSUE 2/3)
     "paddle_tpu/static/__init__.py",

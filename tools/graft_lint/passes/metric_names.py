@@ -15,6 +15,18 @@ Every instrument-creating call site in `paddle_tpu/` —
    so a typo'd near-duplicate cannot silently fork a metric into two
    series.
 
+SPAN names ride the same namespace discipline (ISSUE 11): a
+`span("...")` / `_span("...")` first argument that is a string literal
+must be snake_case 'subsystem.name', and one span name has ONE home
+module — the same literal from two different files forks a span family
+the post-mortem tooling would have to re-merge (repeats within one
+module are fine: a retry loop spans the same name at several sites).
+Computed span names are allowed only as a literal-prefix concatenation
+(`span("collective." + op)`): the prefix pins the subsystem while the
+tail stays dynamic. Fully dynamic names (a bare variable/attribute) are
+flagged — suppress with a rationale where the dynamism is the API
+(profiler.RecordEvent forwarding user names).
+
 Collector-bridged ids (register_collector rows) are data, not creation
 sites, and are out of scope here; the registry's own name validation
 still covers them at runtime.
@@ -30,6 +42,13 @@ KINDS = ("counter", "gauge", "histogram")
 # module aliases the registry is conventionally imported under
 ALIASES = {"metrics", "_m", "_om", "_metrics", "observability"}
 NAME_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*$")
+# the 'subsystem.' (or 'subsystem.partial_') left part of a
+# concatenated span name
+SPAN_PREFIX_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z0-9_]*$")
+# callables that open a span; attribute form also matches
+# `spans.span(...)` / `_spans.span(...)` / `obs.span(...)`
+SPAN_FUNCS = {"span", "_span"}
+SPAN_MODULES = {"spans", "_spans", "obs", "observability"}
 
 
 def _creation_calls(tree):
@@ -42,15 +61,30 @@ def _creation_calls(tree):
             yield node, fn.attr
 
 
+def _span_calls(tree):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in SPAN_FUNCS:
+            yield node
+        elif isinstance(fn, ast.Attribute) and fn.attr == "span" and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id in SPAN_MODULES:
+            yield node
+
+
 class MetricNamesPass(LintPass):
     name = "metric-names"
     description = ("metric ids must be literal, unique, snake_case "
-                   "'subsystem.name'")
+                   "'subsystem.name'; span names literal (or literal-"
+                   "prefixed) with one home module per name")
     severity = "error"
     scope = ("paddle_tpu/",)
 
     def begin(self, repo):
         self._seen = {}     # (kind, id) -> (relpath, line)
+        self._span_seen = {}    # span name -> (relpath, line)
 
     def check_file(self, ctx: FileContext):
         out = []
@@ -86,4 +120,43 @@ class MetricNamesPass(LintPass):
                     f"existing instrument instead of re-requesting it"))
             else:
                 self._seen[key] = (ctx.relpath, node.lineno)
+        for node in _span_calls(ctx.tree):
+            if not node.args:
+                out.append(self.finding(
+                    ctx, node.lineno, "span(...) with no name argument"))
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                name = arg.value
+                if not NAME_RE.match(name):
+                    out.append(self.finding(
+                        ctx, node.lineno,
+                        f"span name {name!r} must be snake_case "
+                        f"'subsystem.name' (e.g. 'ckpt.save')"))
+                    continue
+                prev = self._span_seen.get(name)
+                if prev is not None and prev[0] != ctx.relpath:
+                    out.append(self.finding(
+                        ctx, node.lineno,
+                        f"span name {name!r} already used in "
+                        f"{prev[0]}:{prev[1]} — one span name, one home "
+                        f"module (rename, or hoist the shared site)"))
+                else:
+                    self._span_seen.setdefault(
+                        name, (ctx.relpath, node.lineno))
+            elif isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add) \
+                    and isinstance(arg.left, ast.Constant) and \
+                    isinstance(arg.left.value, str):
+                if not SPAN_PREFIX_RE.match(arg.left.value):
+                    out.append(self.finding(
+                        ctx, node.lineno,
+                        f"span name prefix {arg.left.value!r} must pin "
+                        f"the subsystem as \"subsystem.\" + dynamic_tail"))
+            else:
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    "span name must be a string literal (or a "
+                    "\"subsystem.\" + tail concatenation) — fully "
+                    "dynamic names defeat grep and the post-mortem "
+                    "tooling"))
         return out
