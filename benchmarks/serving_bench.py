@@ -151,6 +151,80 @@ def run(model, jobs, ragged, slo=None):
             "outputs": [list(r.output) for r in reqs]}
 
 
+# -- ISSUE 12: shared-prefix (prefix cache) scenario -------------------------
+
+PREFIX_MIN_TTFT_RATIO = float(os.environ.get("PREFIX_MIN_TTFT_RATIO", "2.0"))
+
+
+def _prefix_workload(page=16):
+    """Realistic chat traffic: EVERY request repeats one 48-token
+    system-prompt + few-shot prefix (3 full KV pages) and appends a
+    short distinct user suffix. Request 0 warms the cache; 1..7 arrive
+    while earlier ones are still decoding (2-tick spacing, 4 slots) so
+    the cache is exercised under concurrency."""
+    rng = np.random.RandomState(23)
+    prefix = list(rng.randint(1, 256, 3 * page))
+    jobs = []
+    for i in range(8):
+        suffix = list(rng.randint(1, 256, 5 + (i % 4)))
+        jobs.append(((0 if i == 0 else 8 + 2 * i), prefix + suffix, 8))
+    return prefix, jobs
+
+
+def run_prefix(model, jobs, cache_on):
+    """Drive the shared-prefix workload and measure per-request TTFT in
+    TICKS (deterministic: every tick is the same compiled shape) plus
+    wall seconds; returns outputs + the engine's prefix-cache stats."""
+    metrics.reset()
+    eng = ContinuousBatchingEngine(model, max_batch=4, max_seq=MAX_SEQ,
+                                   prefill_buckets=BUCKETS, page_size=16,
+                                   max_chunk_tokens=16, ragged=True,
+                                   prefix_cache=cache_on)
+    w = GenerationRequest([3, 5], max_new_tokens=2)
+    eng.add_request(w)
+    while eng.has_work:
+        eng.step()
+    eng.finished.clear()
+    reqs = [GenerationRequest(list(p), max_new_tokens=n)
+            for _, p, n in jobs]
+    pending = sorted(zip([t for t, _, _ in jobs], reqs),
+                     key=lambda x: x[0])
+    arrive_tick = {}
+    first_tick = {}
+    t0 = time.perf_counter()
+    tick = 0
+    while (pending or eng.has_work) and tick < 4000:
+        while pending and pending[0][0] <= tick:
+            _, r = pending.pop(0)
+            eng.add_request(r)
+            arrive_tick[r.request_id] = tick
+        eng.step()
+        for r in reqs:
+            if r.output and r.request_id not in first_tick:
+                first_tick[r.request_id] = tick
+        tick += 1
+    dt = time.perf_counter() - t0
+    assert not eng.has_work and not pending, "prefix bench failed to drain"
+    ttft_ticks = [first_tick[r.request_id] - arrive_tick[r.request_id] + 1
+                  for r in reqs]
+    ttft_wall = [r.first_token_s - r.arrived_s for r in reqs]
+    out = {
+        "seconds": round(dt, 4), "ticks": tick,
+        "prefill_tokens_total": eng.prefill_tokens_total,
+        "ttft_ticks": ttft_ticks,
+        # request 0 always pays the full prefill (it WARMS the cache);
+        # the guard is about the beneficiaries
+        "ttft_ticks_mean_later": round(
+            float(np.mean(ttft_ticks[1:])), 3),
+        "ttft_wall_mean_later": round(
+            float(np.mean(ttft_wall[1:])), 5),
+        "outputs": [list(r.output) for r in reqs],
+    }
+    if cache_on:
+        out["prefix_cache"] = eng._pcache.stats()
+    return out
+
+
 # -- ISSUE 10: overload scenario ---------------------------------------------
 
 def _overload_workload():
@@ -252,6 +326,21 @@ def main():
                   if fifo_over["hi_prio_ttft_p99"] is not None
                   and slo_over["hi_prio_ttft_p99"] is not None else 0.0)
 
+    # ISSUE 12 guard — shared-prefix traffic: cache on must cut later
+    # requests' TTFT >= PREFIX_MIN_TTFT_RATIO (tick-measured, so the
+    # guard is deterministic), keep greedy outputs token-identical, and
+    # prefill the shared pages EXACTLY once (7 beneficiaries x 48
+    # prefix tokens of prefill work saved, to the token).
+    prefix_toks, pjobs = _prefix_workload()
+    pfx_off = run_prefix(model, pjobs, cache_on=False)
+    pfx_on = run_prefix(model, pjobs, cache_on=True)
+    prefix_identical = pfx_off.pop("outputs") == pfx_on.pop("outputs")
+    prefix_ttft_ratio = (pfx_off["ttft_ticks_mean_later"]
+                         / max(pfx_on["ttft_ticks_mean_later"], 1e-9))
+    prefill_saved = (pfx_off["prefill_tokens_total"]
+                     - pfx_on["prefill_tokens_total"])
+    prefix_expected_saved = (len(pjobs) - 1) * len(prefix_toks)
+
     report = {
         "bench": "serving",
         "workload": {"requests": len(jobs), "max_batch": 4,
@@ -272,6 +361,19 @@ def main():
             "slo": slo_over,
             "hi_prio_p99_ttft_ratio": round(ttft_ratio, 2),
             "min_ttft_ratio": MIN_TTFT_RATIO,
+        },
+        "shared_prefix": {
+            "workload": {"requests": len(pjobs),
+                         "prefix_tokens": len(prefix_toks),
+                         "prefix_pages": len(prefix_toks) // 16},
+            "cache_off": pfx_off,
+            "cache_on": pfx_on,
+            "ttft_tick_ratio_later": round(prefix_ttft_ratio, 2),
+            "min_ttft_ratio": PREFIX_MIN_TTFT_RATIO,
+            "token_identical_outputs": bool(prefix_identical),
+            "prefill_tokens_saved": int(prefill_saved),
+            "prefill_tokens_saved_expected": int(prefix_expected_saved),
+            "reuse_ratio": pfx_on["prefix_cache"]["reuse_ratio"],
         },
     }
     print(json.dumps(report, indent=2))
@@ -301,6 +403,19 @@ def main():
     if ttft_ratio < MIN_TTFT_RATIO:
         print(f"FAIL: high-priority p99 TTFT ratio {ttft_ratio:.2f}x "
               f"< required {MIN_TTFT_RATIO}x", file=sys.stderr)
+        return 1
+    if not prefix_identical:
+        print("FAIL: prefix-cache outputs diverge from the uncached "
+              "engine", file=sys.stderr)
+        return 1
+    if prefix_ttft_ratio < PREFIX_MIN_TTFT_RATIO:
+        print(f"FAIL: shared-prefix TTFT ratio {prefix_ttft_ratio:.2f}x "
+              f"< required {PREFIX_MIN_TTFT_RATIO}x", file=sys.stderr)
+        return 1
+    if prefill_saved != prefix_expected_saved:
+        print(f"FAIL: prefix cache saved {prefill_saved} prefill tokens, "
+              f"expected exactly {prefix_expected_saved} (shared pages "
+              f"must prefill once)", file=sys.stderr)
         return 1
     return 0
 

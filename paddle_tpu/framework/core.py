@@ -349,6 +349,13 @@ _flags: dict = {
     # FIFO scheduler exactly (same admission order, same preemption
     # victims, same compiled step signatures)
     "FLAGS_serving_slo": True,
+    # prefix caching over the KV page pool (chunked-prefill regime
+    # only): a content-hash index of fully-written prompt pages with
+    # refcounted sharing, so a repeated system-prompt/few-shot prefix
+    # is prefilled once and later admissions attach the cached pages.
+    # 0 is the kill switch: no index, every page refcount-1, the engine
+    # is token-identical AND allocation-identical to the uncached one
+    "FLAGS_prefix_cache": True,
     # -- quantized collectives (consumed by distributed/collective.py +
     # the jit.TrainStep/ShardingPlan grad-sync seam): armed capability
     # for the blockwise int8/fp8 communication path — quantization still

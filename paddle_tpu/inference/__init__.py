@@ -235,6 +235,16 @@ def create_predictor(config: Config) -> Predictor:
 from .serving import (ContinuousBatchingEngine,  # noqa: E402,F401
                       DeadlineExceeded, GenerationRequest, PagePool,
                       QueueFull, quantize_state_int8)
+from .gateway import (EngineRunner, ServingGateway,  # noqa: E402,F401
+                      build_engine, load_generation_model,
+                      load_static_model, resolve_config,
+                      save_for_serving)
+
+__all__ += ["ContinuousBatchingEngine", "GenerationRequest", "PagePool",
+            "DeadlineExceeded", "QueueFull", "quantize_state_int8",
+            "EngineRunner", "ServingGateway", "build_engine",
+            "load_generation_model", "load_static_model",
+            "resolve_config", "save_for_serving"]
 
 
 def convert_to_mixed_precision(*a, **kw):

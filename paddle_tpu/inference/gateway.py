@@ -1,0 +1,617 @@
+"""Streaming HTTP serving front-end over the continuous-batching engine
+(ISSUE 12 — ref the reference's inference server surface
+(fluid/inference/api + the paddle serving HTTP layer) and the
+Gemma-on-Cloud-TPU serving comparison, arxiv 2605.25645, whose
+end-to-end request latency is the measurement frame).
+
+The engine (`inference/serving.py`) already speaks every contract a
+network edge needs — this module only translates them to the wire,
+stdlib-only (ThreadingHTTPServer; no framework deps to bake into a
+serving image):
+
+* `POST /v1/generate` — submit one generation request (JSON body:
+  `prompt` token ids, `max_new_tokens`, `priority`, `deadline_s`,
+  `eos_token_id`, `stream`). `stream` (default true) answers
+  Server-Sent Events over a close-delimited HTTP/1.0 body: one
+  `data: {"token": t}` frame per generated token, then a terminal
+  `event: end` (served) or `event: error` (failed / shed /
+  deadline_missed / cancelled) frame carrying the engine's terminal
+  status — the structured error frame contract. `stream: false`
+  collects and answers one JSON document.
+* Backpressure: `QueueFull` at submit becomes **429** with a
+  `Retry-After` header from the engine's `retry_after_s` throughput
+  hint; a draining gateway answers **503** the same way.
+* `GET /healthz` — readiness keyed on the engine's `accepting` /
+  `retry_after_s` health snapshot (200 accepting, 503 not — what a
+  load balancer or k8s probe consumes); `GET /metrics` — the shared
+  observability registry (observability.export.http_get_payload), so
+  gateway.* and serving.* series ride one exposition surface.
+* A mid-stream client disconnect CANCELS the request in the engine
+  (slot + pages reclaimed via `cancel_request`) instead of decoding an
+  answer nobody will read — the tick loop never wedges on a dead
+  socket because all socket I/O lives on the per-request handler
+  thread, never the tick thread.
+* Graceful drain (SIGTERM in `python -m paddle_tpu.inference.serve`):
+  stop accepting (submits and /healthz flip to 503 + Retry-After),
+  finish in-flight streams, then stop.
+
+Model loading glue: `save_for_serving` persists a causal-LM via
+`jit.save` (.pdparams) plus a `<prefix>.config.json` sidecar;
+`load_generation_model` rebuilds the model from those artifacts (or an
+explicit preset/JSON config). A `save_inference_model` artifact pair
+(.pdmodel/.pdiparams) loads headless through
+`static.load_inference_model` and serves at `POST /v1/infer`
+(feeds in, fetches out — no Executor, no model code).
+
+Threading model: ONE dedicated tick thread owns the engine loop
+(`EngineRunner`); HTTP handler threads talk to it only through the
+runner lock (submit/cancel) and per-request event queues (token
+delivery). The compiled step runs on the tick thread under the lock, so
+a submit admits between ticks — exactly the engine's single-threaded
+scheduling contract, preserved under concurrency.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+from ..observability import export as _oexp
+from ..observability import metrics as _metrics
+from ..utils.fault_injection import fault_point
+from .serving import ContinuousBatchingEngine, GenerationRequest, QueueFull
+
+__all__ = ["EngineRunner", "ServingGateway", "resolve_config",
+           "save_for_serving", "load_generation_model",
+           "load_static_model", "build_engine"]
+
+_REQS = _metrics.counter(
+    "gateway.requests_total",
+    "HTTP requests answered, labeled by response code")
+_STREAM_SECONDS = _metrics.histogram(
+    "gateway.stream_seconds",
+    "wall seconds a /v1/generate response stream stayed open")
+
+
+# ---------------- model-loading glue ---------------------------------------
+
+def resolve_config(spec):
+    """LlamaConfig from a preset name ('llama_tiny'), a JSON file path,
+    a dict of LlamaConfig fields, or an existing LlamaConfig. None
+    passes through (caller falls back to the artifact sidecar)."""
+    from ..models import llama as L
+    if spec is None or isinstance(spec, L.LlamaConfig):
+        return spec
+    if isinstance(spec, dict):
+        return L.LlamaConfig(**spec)
+    if isinstance(spec, str):
+        if spec.endswith(".json") or os.path.exists(spec):
+            with open(spec) as f:
+                return L.LlamaConfig(**json.load(f))
+        factory = getattr(L, spec, None)
+        if callable(factory):
+            return factory()
+        raise ValueError(
+            f"config {spec!r} is neither a JSON file nor a preset "
+            f"(llama_tiny / llama_350m / llama_1b / llama_7b)")
+    raise TypeError(f"unsupported config spec: {type(spec).__name__}")
+
+
+def save_for_serving(model, path_prefix: str) -> None:
+    """Persist a causal LM the gateway can reload headless: weights via
+    `jit.save` (.pdparams, the atomic-commit path) + the model config
+    as a `<prefix>.config.json` sidecar."""
+    import dataclasses
+
+    from .. import jit
+    from ..framework.io import atomic_write
+    jit.save(model, path_prefix)
+    blob = json.dumps(dataclasses.asdict(model.cfg), indent=1).encode()
+    atomic_write(path_prefix + ".config.json", lambda f: f.write(blob))
+
+
+def load_generation_model(path_prefix: str, config=None):
+    """Rebuild a LlamaForCausalLM from `jit.save` artifacts: weights
+    from `<prefix>.pdparams`, config from `config` (preset name / JSON
+    path / dict) or the `<prefix>.config.json` sidecar."""
+    from ..models import llama as L
+    cfg = resolve_config(config)
+    if cfg is None:
+        sidecar = path_prefix + ".config.json"
+        if not os.path.exists(sidecar):
+            raise FileNotFoundError(
+                f"no config given and no sidecar at {sidecar} — pass "
+                f"config= (preset/JSON) or export with save_for_serving")
+        with open(sidecar) as f:
+            cfg = L.LlamaConfig(**json.load(f))
+    from ..framework import io as fio
+    state = fio.load(path_prefix + ".pdparams")
+    model = L.LlamaForCausalLM(cfg)
+    model.set_state_dict(state)
+    return model
+
+
+def load_static_model(path_prefix: str):
+    """Headless `save_inference_model` artifact: the returned program
+    exposes `feed_names` / `fetch_vars` / `run(feed_dict)` — no
+    Executor, no model code (the ISSUE 12 static-loading satellite)."""
+    from ..static import load_inference_model
+    prog, _, _ = load_inference_model(path_prefix)
+    return prog
+
+
+def build_engine(model, **knobs) -> ContinuousBatchingEngine:
+    """ContinuousBatchingEngine with serving-front-end defaults: a
+    BOUNDED queue (finite 429 Retry-After is the acceptance contract)
+    unless the caller chose otherwise."""
+    if knobs.get("max_queue_tokens", None) is None:
+        knobs["max_queue_tokens"] = 8 * int(knobs.get("max_seq", 256))
+    return ContinuousBatchingEngine(model, **knobs)
+
+
+# ---------------- engine runner --------------------------------------------
+
+class _TokenStream:
+    """Per-request event funnel from the tick thread to one handler
+    thread: ('token', id) frames then one ('end', status, error)."""
+
+    def __init__(self, req: GenerationRequest):
+        self.req = req
+        self.q: queue.Queue = queue.Queue()
+        self.sent = 0
+
+
+class EngineRunner:
+    """Owns the engine tick loop on a dedicated thread. HTTP handlers
+    submit/cancel under `lock` and consume tokens from their request's
+    `_TokenStream` queue — the engine itself is only ever touched from
+    one thread at a time."""
+
+    def __init__(self, engine: ContinuousBatchingEngine,
+                 idle_wait_s: float = 0.02):
+        self.engine = engine
+        self.lock = threading.RLock()
+        self.idle_wait_s = float(idle_wait_s)
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._streams: dict = {}          # request_id -> _TokenStream
+        self._thread: Optional[threading.Thread] = None
+        self.draining = False
+        self.fatal: Optional[BaseException] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "EngineRunner":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="engine-tick", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop accepting and wait for in-flight work to finish.
+        Returns True when the engine went idle within the timeout,
+        False on timeout or after an engine fault (the dead tick
+        thread can make no further progress — waiting is pointless)."""
+        self.draining = True
+        t0 = time.monotonic()
+        while True:
+            with self.lock:
+                if self.fatal is not None:
+                    return False
+                busy = self.engine.has_work
+            if not busy:
+                return True
+            if timeout is not None and time.monotonic() - t0 > timeout:
+                return False
+            time.sleep(0.01)
+
+    # -- request plane -------------------------------------------------------
+
+    def submit(self, req: GenerationRequest) -> _TokenStream:
+        """Admit one request (QueueFull propagates — the 429 path) and
+        return its token stream."""
+        with self.lock:
+            # fatal check INSIDE the lock: racing the tick thread's
+            # fatal transition must not register a stream on a dead
+            # engine (its queue would never receive an end frame)
+            if self.fatal is not None:
+                raise RuntimeError(
+                    f"engine failed: {type(self.fatal).__name__}: "
+                    f"{self.fatal}")
+            self.engine.add_request(req)
+            st = _TokenStream(req)
+            self._streams[req.request_id] = st
+        self._wake.set()
+        return st
+
+    def cancel(self, req: GenerationRequest,
+               reason: str = "client disconnected") -> None:
+        with self.lock:
+            self._streams.pop(req.request_id, None)
+            self.engine.cancel_request(req, reason=reason)
+
+    def health(self) -> dict:
+        with self.lock:
+            snap = self.engine.health_snapshot()
+        snap["draining"] = self.draining
+        if self.fatal is not None:
+            snap["ready"] = False
+            snap["fatal"] = f"{type(self.fatal).__name__}: {self.fatal}"
+        if self.draining or self.fatal is not None:
+            snap["accepting"] = False
+            snap.setdefault("retry_after_s", 1.0)
+        return snap
+
+    @property
+    def accepting(self) -> bool:
+        return self.fatal is None and not self.draining
+
+    # -- tick loop -----------------------------------------------------------
+
+    def _loop(self):
+        while not self._stop.is_set():
+            with self.lock:
+                busy = self.engine.has_work
+                if busy:
+                    try:
+                        self.engine.step()
+                    except Exception as exc:
+                        # engine-level fault (the isolation boundary
+                        # already exhausted per-request attribution):
+                        # fail every open stream loudly, flip /healthz
+                        # unready — never die silently with clients
+                        # parked on their queues
+                        self.fatal = exc
+                        for st in self._streams.values():
+                            st.q.put(("end", "failed",
+                                      f"engine fault: {exc}"))
+                        self._streams.clear()
+                        return
+                    self._dispatch()
+            if not busy:
+                self._wake.wait(self.idle_wait_s)
+                self._wake.clear()
+
+    def _dispatch(self):
+        """Push newly generated tokens (and terminal status) to each
+        open stream; consume the engine's finished list so a
+        long-running server does not accumulate every request ever
+        served."""
+        done = []
+        for rid, st in self._streams.items():
+            out = st.req.output
+            while st.sent < len(out):
+                st.q.put(("token", out[st.sent]))
+                st.sent += 1
+            if st.req.done:
+                st.q.put(("end", st.req.status, st.req.error))
+                done.append(rid)
+        for rid in done:
+            self._streams.pop(rid, None)
+        self.engine.finished.clear()
+
+
+# ---------------- the HTTP gateway -----------------------------------------
+
+_STATUS_HTTP = {"served": 200, "deadline_missed": 504, "shed": 503,
+                "failed": 500, "cancelled": 500}
+
+
+class ServingGateway:
+    """stdlib ThreadingHTTPServer front-end over an EngineRunner (and
+    optionally a headless static inference program). See the module
+    docstring for the wire contract."""
+
+    def __init__(self, runner: Optional[EngineRunner] = None,
+                 static_model=None, host: str = "127.0.0.1",
+                 port: int = 0, keepalive_s: float = 0.5):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        if runner is None and static_model is None:
+            raise ValueError("gateway needs a runner (generate) and/or "
+                             "a static_model (infer)")
+        self.runner = runner
+        self.static_model = static_model
+        self.keepalive_s = float(keepalive_s)
+        self.draining = False
+        gw = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            # close-delimited bodies: the SSE stream ends when the
+            # handler closes the socket, no chunked framing needed
+            protocol_version = "HTTP/1.0"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                gw._handle_get(self)
+
+            def do_POST(self):
+                gw._handle_post(self)
+
+        self._server = ThreadingHTTPServer((host, int(port)), _Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+        _oexp.register_health_provider("gateway", self._health_provider)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> int:
+        if self.runner is not None:
+            self.runner.start()
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._server.serve_forever, name="gateway-http",
+                daemon=True)
+            self._thread.start()
+        return self.port
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown, phase 1 (the SIGTERM contract): stop
+        accepting — /healthz and new submits answer 503 + Retry-After —
+        and wait for in-flight generations to finish streaming."""
+        self.draining = True
+        if self.runner is not None:
+            return self.runner.drain(timeout)
+        return True
+
+    def stop(self) -> None:
+        _oexp.unregister_health_provider("gateway")
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass
+        if self.runner is not None:
+            self.runner.stop()
+
+    @property
+    def accepting(self) -> bool:
+        return (not self.draining
+                and (self.runner is None or self.runner.accepting))
+
+    def _health_provider(self) -> dict:
+        out = {"accepting": self.accepting, "draining": self.draining,
+               "port": self.port}
+        if self.runner is not None:
+            out["engine"] = self.runner.health()
+        return out
+
+    # -- GET -----------------------------------------------------------------
+
+    def _handle_get(self, h):
+        path = h.path.split("?", 1)[0].rstrip("/")
+        if path == "/healthz":
+            body = dict(self._health_provider())
+            # readiness keys on BOTH gates: the gateway's own
+            # (draining/fatal) AND the engine's `accepting` (queue
+            # full) — a saturated instance must read 503 so the load
+            # balancer stops routing to it (the documented contract)
+            engine_ok = body.get("engine", {}).get("accepting", True)
+            status = 200 if body["accepting"] and engine_ok else 503
+            extra = {}
+            if status != 200:
+                retry = body.get("engine", {}).get("retry_after_s", 1.0)
+                extra["Retry-After"] = str(max(1, math.ceil(retry)))
+            self._json(h, status, body, extra)
+            return
+        if path in ("", "/metrics"):
+            got = _oexp.http_get_payload("/metrics")
+            status, ctype, body = got
+            self._raw(h, status, ctype, body)
+            return
+        self._json(h, 404, {"error": f"no route for {h.path!r}"})
+
+    # -- POST ----------------------------------------------------------------
+
+    def _handle_post(self, h):
+        path = h.path.split("?", 1)[0].rstrip("/")
+        try:
+            fault_point("serving.http_request")
+            n = int(h.headers.get("Content-Length") or 0)
+            try:
+                spec = json.loads(h.rfile.read(n) or b"{}")
+            except ValueError:
+                self._json(h, 400, {"error": "body is not valid JSON"})
+                return
+            if path == "/v1/generate":
+                self._generate(h, spec)
+            elif path == "/v1/infer":
+                self._infer(h, spec)
+            else:
+                self._json(h, 404, {"error": f"no route for {h.path!r}"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass                        # client left before the answer
+        except Exception as exc:        # one request fails, not the server
+            try:
+                self._json(h, 500,
+                           {"error": f"{type(exc).__name__}: {exc}"})
+            except Exception:
+                pass
+
+    def _generate(self, h, spec):
+        if self.runner is None:
+            self._json(h, 501, {"error": "no generation model loaded "
+                                "(static /v1/infer artifact only)"})
+            return
+        if not self.accepting:
+            self._json(h, 503, {"error": "gateway is draining"},
+                       {"Retry-After": "1"})
+            return
+        prompt = spec.get("prompt")
+        if (not isinstance(prompt, list) or not prompt
+                or not all(isinstance(t, int) for t in prompt)):
+            self._json(h, 400, {"error": "prompt must be a non-empty "
+                                "list of token ids"})
+            return
+        # validate the numeric fields HERE: garbage from the wire must
+        # answer 400, never reach the engine — a non-numeric deadline_s
+        # would blow up deadline_at inside _slo_pre_tick, which runs
+        # OUTSIDE the tick isolation boundary and would take the whole
+        # tick loop (and every client) down
+        try:
+            max_new = int(spec.get("max_new_tokens", 32))
+            priority = int(spec.get("priority", 0))
+            eos = spec.get("eos_token_id")
+            eos = None if eos is None else int(eos)
+            deadline = spec.get("deadline_s")
+            deadline = None if deadline is None else float(deadline)
+            if max_new < 1:
+                raise ValueError("max_new_tokens must be >= 1")
+        except (TypeError, ValueError) as e:
+            self._json(h, 400, {"error": "bad max_new_tokens/priority/"
+                                f"eos_token_id/deadline_s: {e}"})
+            return
+        req = GenerationRequest(
+            prompt=[int(t) for t in prompt],
+            max_new_tokens=max_new,
+            eos_token_id=eos,
+            priority=priority,
+            deadline_s=deadline)
+        try:
+            stream = self.runner.submit(req)
+        except QueueFull as e:
+            # the engine's backpressure contract on the wire: finite
+            # Retry-After from the observed token throughput
+            self._json(h, 429,
+                       {"error": str(e),
+                        "retry_after_s": round(e.retry_after_s, 3)},
+                       {"Retry-After":
+                        str(max(1, math.ceil(e.retry_after_s)))})
+            return
+        except ValueError as e:         # oversized prompt, rejected at submit
+            self._json(h, 400, {"error": str(e)})
+            return
+        except RuntimeError as e:       # engine went fatal
+            self._json(h, 503, {"error": str(e)}, {"Retry-After": "1"})
+            return
+        if spec.get("stream", True):
+            self._stream_sse(h, req, stream)
+        else:
+            self._collect(h, req, stream)
+
+    def _stream_sse(self, h, req, stream):
+        """SSE over a close-delimited body: token frames as they land,
+        keepalive comments while decode is parked (they double as the
+        disconnect probe), one terminal end/error frame."""
+        t0 = time.perf_counter()
+        code = "200"
+        try:
+            h.send_response(200)
+            h.send_header("Content-Type", "text/event-stream")
+            h.send_header("Cache-Control", "no-cache")
+            h.send_header("Connection", "close")
+            h.end_headers()
+            while True:
+                try:
+                    ev = stream.q.get(timeout=self.keepalive_s)
+                except queue.Empty:
+                    # probes the socket: a gone client raises here and
+                    # the except below reclaims its slot + pages
+                    h.wfile.write(b": keepalive\n\n")
+                    h.wfile.flush()
+                    continue
+                fault_point("serving.http_request")
+                if ev[0] == "token":
+                    h.wfile.write(
+                        b"data: " + json.dumps(
+                            {"token": ev[1]}).encode() + b"\n\n")
+                    h.wfile.flush()
+                    continue
+                _, status, error = ev
+                payload = {"status": status, "n_tokens": len(req.output)}
+                name = b"end"
+                if status != "served":
+                    payload["error"] = error
+                    name = b"error"
+                h.wfile.write(b"event: " + name + b"\ndata: "
+                              + json.dumps(payload).encode() + b"\n\n")
+                h.wfile.flush()
+                break
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            code = "499"                # client closed mid-stream
+            self.runner.cancel(req)
+        except Exception as exc:
+            # e.g. an armed serving.http_request fault mid-stream: fail
+            # THIS request (structured error frame if the socket still
+            # works) and free its engine resources
+            code = "500"
+            self.runner.cancel(req, reason=f"handler fault: {exc}")
+            try:
+                h.wfile.write(b"event: error\ndata: " + json.dumps(
+                    {"status": "failed",
+                     "error": f"{type(exc).__name__}: {exc}"}).encode()
+                    + b"\n\n")
+                h.wfile.flush()
+            except Exception:
+                pass
+        finally:
+            _STREAM_SECONDS.observe(time.perf_counter() - t0)
+            _REQS.inc(code=code)
+
+    def _collect(self, h, req, stream):
+        """stream:false — block until terminal, answer one document."""
+        t0 = time.perf_counter()
+        status, error = "failed", "stream closed"
+        while True:
+            ev = stream.q.get()
+            if ev[0] == "end":
+                _, status, error = ev
+                break
+        body = {"status": status, "output": list(req.output)}
+        if error:
+            body["error"] = error
+        _STREAM_SECONDS.observe(time.perf_counter() - t0)
+        self._json(h, _STATUS_HTTP.get(status, 500), body)
+
+    def _infer(self, h, spec):
+        if self.static_model is None:
+            self._json(h, 501, {"error": "no static inference artifact "
+                                "loaded (generate-only gateway)"})
+            return
+        import numpy as np
+        feeds = spec.get("feeds")
+        if not isinstance(feeds, dict):
+            self._json(h, 400, {"error": "body must carry feeds: "
+                                "{name: nested-list}"})
+            return
+        missing = [n for n in self.static_model.feed_names
+                   if n not in feeds]
+        if missing:
+            self._json(h, 400, {"error": f"missing feeds: {missing}; "
+                                f"expected {self.static_model.feed_names}"})
+            return
+        outs = self.static_model.run(
+            {k: np.asarray(v) for k, v in feeds.items()})
+        self._json(h, 200,
+                   {"fetches": [np.asarray(o).tolist() for o in outs]})
+
+    # -- response helpers ----------------------------------------------------
+
+    def _json(self, h, status, obj, extra_headers=None):
+        self._raw(h, status, "application/json",
+                  json.dumps(obj).encode(), extra_headers)
+
+    def _raw(self, h, status, ctype, body, extra_headers=None):
+        try:
+            h.send_response(status)
+            h.send_header("Content-Type", ctype)
+            h.send_header("Content-Length", str(len(body)))
+            for k, v in (extra_headers or {}).items():
+                h.send_header(k, v)
+            h.end_headers()
+            h.wfile.write(body)
+        finally:
+            _REQS.inc(code=str(status))

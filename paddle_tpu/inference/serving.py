@@ -65,6 +65,25 @@ held to the `FLAGS_ragged_attention=0` bar):
   `health_snapshot()` (also exported at /healthz next to /metrics) as
   the readiness view for a future HTTP front-end.
 
+Prefix caching (`FLAGS_prefix_cache`, default on — ISSUE 12; ref the
+vLLM automatic-prefix-cache / RadixAttention design over the paged
+pool): the ragged kernel already reads ARBITRARY per-sequence block
+tables (arxiv 2604.15464), so sharing a prompt prefix is pure pool
+accounting. A content-hash chain index maps each fully-written PAGE of
+an admitted prompt to its physical page; a later admission whose prompt
+starts with the same token pages attaches the cached pages (refcount++)
+and prefills only the uncached suffix — the shared system-prompt/
+few-shot prefix every chat request repeats is computed ONCE. Sharing is
+full-page granular, so a shared page is never written again (the
+copy-on-write degenerate case: appends always land in a fresh page) and
+greedy outputs stay token-identical to the uncached engine. Eviction is
+refcount-aware LRU: only pages NO running sequence holds (refcount 0)
+are reclaimable, on demand from `PagePool.alloc`, so the cache never
+competes with live sequences and the priority-aware preemption contract
+is untouched. `FLAGS_prefix_cache=0` (or the bucketed regime) drops the
+index entirely — every page is refcount-1 and the allocator is
+bitwise the pre-cache free list.
+
 Weight-only int8 (PTQ) inference: `quantize="int8"` stores every 2-D
 projection as int8 + per-output-channel scale (the PTQ absmax rule,
 ref quantization post-training observers; inference int8 path
@@ -74,6 +93,7 @@ width, which is what decode (memory-bound) is priced by.
 """
 from __future__ import annotations
 
+import hashlib
 import time
 import weakref
 from dataclasses import dataclass, field
@@ -122,6 +142,19 @@ _DEGRADED = _metrics.gauge(
     "serving.degraded",
     "1 while adaptive degradation holds the effective prefill chunk "
     "budget below max_chunk_tokens")
+_PREFIX_HITS = _metrics.counter(
+    "serving.prefix_hits_total",
+    "admissions that attached at least one cached prefix page")
+_PREFIX_MISSES = _metrics.counter(
+    "serving.prefix_misses_total",
+    "admissions that found no cached prefix page")
+_PREFIX_REUSED = _metrics.counter(
+    "serving.prefix_pages_reused_total",
+    "KV pages attached from the prefix cache instead of prefilled")
+_PREFIX_RATIO = _metrics.gauge(
+    "serving.prefix_reuse_ratio",
+    "cumulative cacheable-prompt-pages served from the prefix cache "
+    "(reused / seen)")
 
 
 class DeadlineExceeded(RuntimeError):
@@ -189,8 +222,8 @@ class GenerationRequest:
     priorities keep FIFO order. `deadline_s` — seconds from arrival
     after which the request is failed fast with DeadlineExceeded.
     `status` tracks the lifecycle: queued -> running -> one of
-    served / shed / deadline_missed / failed; `error` carries the
-    terminal error text for the non-served outcomes."""
+    served / shed / deadline_missed / failed / cancelled; `error`
+    carries the terminal error text for the non-served outcomes."""
     prompt: List[int]
     max_new_tokens: int = 32
     eos_token_id: Optional[int] = None
@@ -219,7 +252,7 @@ class GenerationRequest:
 
 class _Slot:
     __slots__ = ("req", "length", "produced", "last_token", "admit_seq",
-                 "pending")
+                 "pending", "prefix_tokens", "cache_upto", "cache_key")
 
     def __init__(self):
         self.req: Optional[GenerationRequest] = None
@@ -229,6 +262,12 @@ class _Slot:
         self.admit_seq = -1
         # chunked-prefill regime: effective-prompt tokens not yet in KV
         self.pending: List[int] = []
+        # prefix cache: the full effective prompt at admission, how many
+        # of its pages were already offered to the index, and the chain
+        # hash key up to that page (set by _admit_ragged when armed)
+        self.prefix_tokens: List[int] = []
+        self.cache_upto = 0
+        self.cache_key = b""
 
     @property
     def free(self):
@@ -246,7 +285,16 @@ class PagePool:
     so KV memory is proportional to LIVE tokens, not batch * max_seq).
 
     Page 0 is reserved as a scratch page: inactive slots and padding
-    positions write there; it is never allocated."""
+    positions write there; it is never allocated.
+
+    Refcounts (ISSUE 12): every allocated page carries a slot-holder
+    count. `alloc` hands pages out at refcount 1, `share` attaches an
+    additional holder (a prefix-cache hit), and `free` only returns a
+    page to the free list when its LAST holder releases it — unless an
+    attached prefix cache still indexes the page, in which case it goes
+    idle-cached (reclaimable on demand, counted by `n_free`). With no
+    cache attached every page is refcount-1 and alloc/free are bitwise
+    the pre-cache free list (same pop order, same append order)."""
 
     def __init__(self, n_pages: int, page_size: int = 16):
         if n_pages < 2:
@@ -254,20 +302,244 @@ class PagePool:
         self.n_pages = int(n_pages)
         self.page_size = int(page_size)
         self._free = list(range(self.n_pages - 1, 0, -1))  # pop() -> low ids
+        self._refs: Dict[int, int] = {}      # page -> slot-holder count
+        self._cache = None                   # attached _PrefixCache
+
+    def attach_cache(self, cache) -> None:
+        self._cache = cache
 
     @property
     def n_free(self) -> int:
-        return len(self._free)
+        """Immediately-free pages plus idle-cached pages the attached
+        prefix cache would evict on demand — the scheduler's funding
+        math must see cached-idle capacity as available, or an idle
+        cache would starve admission."""
+        n = len(self._free)
+        if self._cache is not None:
+            n += self._cache.evictable_count()
+        return n
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """n pages or None (caller keeps the request waiting / preempts)."""
+        """n pages or None (caller keeps the request waiting / preempts).
+        Shortfalls first reclaim idle-cached pages (refcount-0 LRU) from
+        the attached prefix cache; pages a running sequence holds are
+        never touched."""
         fault_point("serving.page_alloc")
+        if n > len(self._free) and self._cache is not None:
+            self._cache.evict(n - len(self._free))
         if n > len(self._free):
             return None
-        return [self._free.pop() for _ in range(n)]
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refs[p] = 1
+        return pages
 
     def free(self, pages: List[int]) -> None:
-        self._free.extend(pages)
+        """Release one holder of each page; the page returns to the free
+        list only when no holder remains and the prefix cache does not
+        index it (then it stays idle-cached until evicted or re-shared)."""
+        for p in pages:
+            r = self._refs.get(p, 1) - 1
+            if r > 0:
+                self._refs[p] = r
+                continue
+            self._refs.pop(p, None)
+            if self._cache is not None and self._cache.owns(p):
+                continue
+            self._free.append(p)
+
+    def share(self, pages: List[int]) -> None:
+        """Attach an additional holder to each page (prefix-cache hit);
+        an idle-cached page (refcount 0) comes back live here."""
+        for p in pages:
+            self._refs[p] = self._refs.get(p, 0) + 1
+
+    def release_unindexed(self, page: int) -> None:
+        """The cache dropped its claim on `page`; if no slot holds it
+        either, it is free again."""
+        if self._refs.get(page, 0) == 0:
+            self._free.append(page)
+
+
+# ---------------- prefix cache ---------------------------------------------
+
+
+class _PrefixEntry:
+    __slots__ = ("key", "page", "parent", "children", "last_use")
+
+    def __init__(self, key: bytes, page: int, parent: bytes):
+        self.key = key
+        self.page = page
+        self.parent = parent
+        self.children: set = set()
+        self.last_use = 0
+
+
+class _PrefixCache:
+    """Content-hash chain index of fully-written prompt pages over a
+    PagePool (ISSUE 12; the vLLM automatic-prefix-cache idea expressed
+    as pool accounting — the ragged kernel reads arbitrary block tables,
+    so a shared page needs no kernel support at all).
+
+    Each entry maps `blake2(parent_key || page_tokens)` to the physical
+    page holding those tokens' KV, chaining from the prompt start, so a
+    lookup walks the prompt page by page and stops at the first miss —
+    the longest cached prefix. Pages are shared at FULL-page granularity
+    only: a shared page is never appended to (the next token lands in a
+    fresh page), which is what keeps shared-prefix decoding bitwise
+    identical to the uncached engine without copy-on-write data moves —
+    the refcounts carry the ownership story and a would-be "write" is
+    simply a fresh allocation.
+
+    Eviction is refcount-aware LRU, on demand from `PagePool.alloc`:
+    only pages with NO slot holder (refcount 0) are candidates, so a
+    running sequence's pages are never reclaimed and the engine's
+    priority-aware preemption contract is untouched. Evicting an entry
+    drops its whole cached subtree (children are unreachable once the
+    chain breaks); subtree pages a slot still holds are merely
+    unindexed and return to the free list when that slot releases them.
+    """
+
+    def __init__(self, pool: PagePool, page_size: int):
+        self.pool = pool
+        self.page = int(page_size)
+        self.entries: Dict[bytes, _PrefixEntry] = {}
+        self.by_page: Dict[int, bytes] = {}
+        # children of the chain root (parent key b"")
+        self._root_children: set = set()
+        self._clock = 0
+        self.hits = 0
+        self.misses = 0
+        self.pages_reused = 0
+        self.pages_seen = 0          # cacheable prompt pages offered to lookup
+        self.evictions = 0
+        pool.attach_cache(self)
+
+    def _key(self, parent: bytes, toks: List[int]) -> bytes:
+        h = hashlib.blake2b(parent, digest_size=16)
+        h.update(np.asarray(toks, np.int64).tobytes())
+        return h.digest()
+
+    def owns(self, page: int) -> bool:
+        return page in self.by_page
+
+    def evictable_count(self) -> int:
+        return sum(1 for p in self.by_page
+                   if self.pool.refcount(p) == 0)
+
+    # -- lookup / insert -----------------------------------------------------
+
+    def lookup(self, eff: List[int]) -> Tuple[List[int], bytes]:
+        """Longest cached full-page prefix of token stream `eff`:
+        increfs and returns (page ids, chain key up to them). At least
+        one trailing token always stays uncached so the admitted slot
+        still has a query row to produce its next token from."""
+        self._clock += 1
+        n = (len(eff) - 1) // self.page
+        self.pages_seen += n
+        key = b""
+        pages: List[int] = []
+        for j in range(n):
+            nxt = self._key(key, eff[j * self.page:(j + 1) * self.page])
+            e = self.entries.get(nxt)
+            if e is None:
+                break
+            e.last_use = self._clock
+            key = nxt
+            pages.append(e.page)
+        if pages:
+            self.pool.share(pages)
+            self.hits += 1
+            self.pages_reused += len(pages)
+            _PREFIX_HITS.inc()
+            _PREFIX_REUSED.inc(len(pages))
+        else:
+            self.misses += 1
+            _PREFIX_MISSES.inc()
+        if self.pages_seen:
+            _PREFIX_RATIO.set(self.pages_reused / self.pages_seen)
+        return pages, key
+
+    def insert(self, parent: bytes, toks: List[int], page: int) -> bytes:
+        """Offer one fully-written page to the index. First writer wins:
+        if the chain key already exists (another slot prefilled the same
+        content concurrently) the duplicate physical page stays plainly
+        slot-owned and is freed normally. Returns the chain key — the
+        caller threads it through successive offers."""
+        key = self._key(parent, toks)
+        if key in self.entries:
+            return key
+        e = _PrefixEntry(key, page, parent)
+        self._clock += 1
+        e.last_use = self._clock
+        self.entries[key] = e
+        self.by_page[page] = key
+        if parent:
+            pe = self.entries.get(parent)
+            if pe is not None:
+                pe.children.add(key)
+        else:
+            self._root_children.add(key)
+        return key
+
+    # -- eviction ------------------------------------------------------------
+
+    def evict(self, need: int) -> int:
+        """Reclaim up to `need` idle-cached pages (refcount 0) into the
+        pool's free list. Never touches a page a running sequence
+        holds. LEAVES go first (deepest chain tail, LRU among leaves):
+        evicting from the tail frees exactly one page per step and
+        preserves the chain HEAD — the most shareable part of a prefix
+        — as long as possible (the vLLM eviction order). Only when no
+        idle leaf exists does a ref-0 inner entry go, taking its now
+        unreachable cached subtree with it."""
+        fault_point("serving.prefix_evict")
+        freed = 0
+        while freed < need:
+            cands = [e for e in self.entries.values()
+                     if self.pool.refcount(e.page) == 0]
+            if not cands:
+                break
+            leaves = [e for e in cands
+                      if not any(k in self.entries for k in e.children)]
+            victim = min(leaves or cands, key=lambda e: e.last_use)
+            freed += self._drop_subtree(victim)
+        return freed
+
+    def _drop_subtree(self, entry: _PrefixEntry) -> int:
+        """Unindex `entry` and every cached descendant (unreachable once
+        the chain breaks). Returns how many pages landed back on the
+        free list (refcount-0 ones; slot-held pages are only unindexed)."""
+        parent = self.entries.get(entry.parent)
+        if parent is not None:
+            parent.children.discard(entry.key)
+        self._root_children.discard(entry.key)
+        freed = 0
+        stack = [entry]
+        while stack:
+            e = stack.pop()
+            self.entries.pop(e.key, None)
+            self.by_page.pop(e.page, None)
+            self.evictions += 1
+            if self.pool.refcount(e.page) == 0:
+                self.pool.release_unindexed(e.page)
+                freed += 1
+            stack.extend(self.entries[k] for k in e.children
+                         if k in self.entries)
+        return freed
+
+    def stats(self) -> dict:
+        return {"entries": len(self.entries),
+                "hits": self.hits, "misses": self.misses,
+                "pages_reused": self.pages_reused,
+                "pages_seen": self.pages_seen,
+                "evictions": self.evictions,
+                "reuse_ratio": round(
+                    self.pages_reused / self.pages_seen, 4)
+                if self.pages_seen else 0.0}
 
 
 # ---------------- engine ---------------------------------------------------
@@ -280,6 +552,12 @@ class ContinuousBatchingEngine:
     per-slot KV capacity (page-aligned). max_chunk_tokens bounds the
     prefill tokens packed into one ragged tick; ragged=None follows
     FLAGS_ragged_attention (the chunked-prefill kill switch).
+
+    prefix_cache=None follows FLAGS_prefix_cache: in the ragged regime,
+    admissions attach cached pages for any previously-prefilled
+    full-page prompt prefix and fully-written prompt pages enter the
+    content-hash index (see _PrefixCache); =False (or the bucketed
+    regime) drops the cache entirely — bitwise the uncached allocator.
 
     SLO layer (slo=None follows FLAGS_serving_slo; see the module
     docstring): max_queue_tokens bounds the wait queue (None =
@@ -297,6 +575,7 @@ class ContinuousBatchingEngine:
                  greedy: bool = True, seed: int = 0,
                  total_pages: Optional[int] = None, page_size: int = 16,
                  max_chunk_tokens: int = 64, ragged: Optional[bool] = None,
+                 prefix_cache: Optional[bool] = None,
                  slo: Optional[bool] = None,
                  max_queue_tokens: Optional[int] = None,
                  shed_patience: int = 8, min_chunk_tokens: int = 8,
@@ -364,6 +643,15 @@ class ContinuousBatchingEngine:
         from ..kernels.ragged_paged_attention import _size_class
         self._T_pack = _size_class(self.B + self.max_chunk_tokens)
         self.last_packed_tokens = 0
+        self.prefill_tokens_total = 0    # prompt tokens actually prefilled
+        # prefix caching (ISSUE 12): ragged regime only — the bucketed
+        # prefill computes whole prompts in one batched call, so there
+        # is no seam to skip cached pages through (and the =0 kill
+        # switch must stay bitwise either way)
+        pfx = (_core.get_bool_flag("FLAGS_prefix_cache", True)
+               if prefix_cache is None else bool(prefix_cache))
+        self._pcache = (_PrefixCache(self.pool, page)
+                        if pfx and self._ragged else None)
         # donation lets XLA scatter into the pool in place; CPU jit would
         # just warn that the buffers were not donated
         self._donate = jax.default_backend() == "tpu"
@@ -733,6 +1021,7 @@ class ContinuousBatchingEngine:
             sampled = np.asarray(jax.random.categorical(sub, last))
         for j, (i, req, eff, T, need, pages) in enumerate(group):
             slot = self.slots[i]
+            self.prefill_tokens_total += T
             self.slot_pages[i] = pages
             self.page_table[i, :] = 0
             self.page_table[i, :need] = pages
@@ -843,17 +1132,28 @@ class ContinuousBatchingEngine:
             self.waiting.pop(0)
             i = free_slots.pop(0)
             slot = self.slots[i]
+            # cache-aware admission: attach the longest cached full-page
+            # prefix (refcount++) and prefill only the uncached suffix
+            cached: List[int] = []
+            ckey = b""
+            if self._pcache is not None:
+                cached, ckey = self._pcache.lookup(eff)
             slot.req = req
             req.status = "running"
             self._admitted_this_tick = True
-            slot.length = 0
+            slot.length = len(cached) * self.page
             slot.produced = len(req.output)
             slot.last_token = 0
-            slot.pending = eff
+            slot.pending = eff[slot.length:]
+            slot.prefix_tokens = eff
+            slot.cache_upto = len(cached)
+            slot.cache_key = ckey
             slot.admit_seq = self._admit_seq
             self._admit_seq += 1
-            self.slot_pages[i] = []
+            self.slot_pages[i] = list(cached)
             self.page_table[i, :] = 0
+            if cached:
+                self.page_table[i, :len(cached)] = cached
 
     def _schedule_chunks(self) -> List[Tuple[int, List[int], bool]]:
         """Build this tick's ragged batch: one decode row per active
@@ -895,6 +1195,7 @@ class ContinuousBatchingEngine:
                     self.slot_pages[i].extend(pages)
                     self.page_table[i, n0:n0 + need] = pages
                 entries.append((i, list(slot.pending[:chunk]), True))
+                self.prefill_tokens_total += chunk
                 budget -= chunk
             if entries:
                 return entries
@@ -915,6 +1216,22 @@ class ContinuousBatchingEngine:
             else:
                 self._preempt(max(victims,
                                   key=lambda j: self.slots[j].admit_seq))
+
+    def _offer_prefix(self, i: int):
+        """Offer slot i's newly COMPLETED prompt pages to the prefix
+        index (chain order, at most through the prompt's last full
+        page). Generated-token pages are never offered — only the
+        effective prompt captured at admission is content-addressable."""
+        slot = self.slots[i]
+        page = self.page
+        limit = min(slot.length, len(slot.prefix_tokens)) // page
+        while slot.cache_upto < limit:
+            j = slot.cache_upto
+            slot.cache_key = self._pcache.insert(
+                slot.cache_key,
+                slot.prefix_tokens[j * page:(j + 1) * page],
+                self.slot_pages[i][j])
+            slot.cache_upto += 1
 
     def _step_ragged(self):
         """One chunked-prefill tick: admission, decode page growth, chunk
@@ -991,6 +1308,10 @@ class ContinuousBatchingEngine:
             slot.length += n
             if is_prefill:
                 del slot.pending[:n]
+                if self._pcache is not None:
+                    # the tick's compiled call has committed these rows'
+                    # KV: fully-written prompt pages join the index
+                    self._offer_prefix(i)
                 if slot.pending:
                     continue             # prompt still streaming in
             tok = int(nxt[i])
@@ -1165,6 +1486,31 @@ class ContinuousBatchingEngine:
         else:
             raise                        # nothing to attribute the fault to
 
+    def cancel_request(self, req: GenerationRequest,
+                       reason: str = "cancelled") -> bool:
+        """Terminal 'cancelled' path for a client that went away (the
+        gateway's mid-stream disconnect contract): a waiting request
+        leaves the queue, a running one releases its slot + pages —
+        either way the engine keeps serving everyone else and nothing
+        wedges on an answer nobody will read. Returns False if the
+        request was not live (already terminal / never submitted)."""
+        if req in self.waiting:
+            self.waiting.remove(req)
+        else:
+            for i, slot in enumerate(self.slots):
+                if slot.req is req:
+                    slot.req = None
+                    slot.pending = []
+                    self._free_slot_pages(i)
+                    break
+            else:
+                return False
+        req.status = "cancelled"
+        req.error = reason
+        req.finished_s = time.perf_counter()
+        self.finished.append(req)
+        return True
+
     def health_snapshot(self) -> dict:
         """Readiness/health view for an HTTP front-end (also served at
         /healthz next to /metrics when FLAGS_metrics_port is up). Pure
@@ -1193,6 +1539,8 @@ class ContinuousBatchingEngine:
                          "quarantines": self.quarantines,
                          "preemptions": self.preemptions},
         }
+        if self._pcache is not None:
+            snap["prefix_cache"] = self._pcache.stats()
         if not accepting:
             snap["retry_after_s"] = round(self._retry_after_hint(
                 max(queued - self.max_queue_tokens, 1)), 3)

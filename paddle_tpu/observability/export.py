@@ -39,6 +39,8 @@ from typing import Optional
 from . import metrics, spans
 
 __all__ = ["prometheus_text", "serve_metrics", "stop_metrics_server",
+           "http_get_payload", "register_health_provider",
+           "unregister_health_provider", "health_payload",
            "write_snapshot", "append_jsonl", "install_flight_recorder",
            "uninstall_flight_recorder", "flight_recorder_path",
            "flight_dump"]
@@ -185,6 +187,25 @@ def health_payload() -> dict:
     return out
 
 
+def http_get_payload(path: str):
+    """The shared GET surface over the registry: (status, content_type,
+    body bytes) for '/metrics' (or '') and '/healthz', None for unknown
+    paths. One implementation worn by the FLAGS_metrics_port endpoint
+    AND the serving gateway (inference/gateway.py), so both speak the
+    same exposition format and the same readiness semantics (a broken
+    health provider reads 503 — probes key on the STATUS code)."""
+    path = path.split("?", 1)[0].rstrip("/")
+    if path == "/healthz":
+        payload = health_payload()
+        status = 200 if payload.get("ok", False) else 503
+        return (status, "application/json",
+                json.dumps(payload, indent=1).encode())
+    if path in ("", "/metrics"):
+        return (200, "text/plain; version=0.0.4; charset=utf-8",
+                prometheus_text().encode())
+    return None
+
+
 def serve_metrics(port: int, host: Optional[str] = None) -> Optional[int]:
     """Start (or move) the background /metrics (+ /healthz) HTTP
     endpoint; port 0 stops it. Returns the bound port. Consumed by
@@ -197,22 +218,11 @@ def serve_metrics(port: int, host: Optional[str] = None) -> Optional[int]:
 
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):
-            path = self.path.rstrip("/")
-            status = 200
-            if path == "/healthz":
-                payload = health_payload()
-                body = json.dumps(payload, indent=1).encode()
-                ctype = "application/json"
-                if not payload.get("ok", False):
-                    # readiness probes key on the STATUS code — a
-                    # broken provider must read as unready, not 200
-                    status = 503
-            elif path in ("", "/metrics"):
-                body = prometheus_text().encode()
-                ctype = "text/plain; version=0.0.4; charset=utf-8"
-            else:
+            got = http_get_payload(self.path)
+            if got is None:
                 self.send_error(404)
                 return
+            status, ctype, body = got
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))

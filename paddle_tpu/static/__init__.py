@@ -401,7 +401,59 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
                  fault_name="static.save_model")
 
 
+class _FetchVar:
+    """Shape/dtype handle for one output of a loaded inference program
+    (the fetch-target stand-in a headless caller — e.g. the serving
+    gateway — introspects instead of recorded Variables)."""
+
+    __slots__ = ("name", "shape", "dtype")
+
+    def __init__(self, name, shape, dtype):
+        self.name = name
+        self.shape = shape
+        self.dtype = dtype
+
+    def __repr__(self):
+        return f"_FetchVar({self.name!r}, {self.shape}, {self.dtype})"
+
+
+class _InferenceProgram:
+    """A deserialized `save_inference_model` artifact, runnable with no
+    Executor and no model code: `run(feed_dict)` replays the exported
+    StableHLO on the named feeds and returns numpy fetches. `feed_names`
+    / `fetch_vars` are the handles a serving front-end binds wire
+    requests to (ISSUE 12 headless-loading satellite)."""
+
+    def __init__(self, exported, feed_names):
+        self.exported = exported
+        self.feed_names = list(feed_names)
+        self.fetch_vars = []
+        for i, aval in enumerate(getattr(exported, "out_avals", ())):
+            shape = tuple(
+                d if isinstance(d, int) else str(d)
+                for d in getattr(aval, "shape", ()))
+            self.fetch_vars.append(_FetchVar(
+                f"fetch_{i}", shape, str(getattr(aval, "dtype", "?"))))
+
+    def run(self, feed):
+        missing = [n for n in self.feed_names if n not in feed]
+        if missing:
+            raise KeyError(
+                f"inference program missing feeds {missing}; expected "
+                f"exactly {self.feed_names}")
+        outs = self.exported.call(
+            *[jnp.asarray(feed[n]) for n in self.feed_names])
+        return [np.asarray(o) for o in outs]
+
+
 def load_inference_model(path_prefix, executor=None, **kw):
+    """ref: static/io.py load_inference_model. `executor` is accepted
+    for API compatibility but NOT required: the returned
+    `_InferenceProgram` runs headless — `prog.run({name: array})` —
+    which is what lets a serving process drive the artifact without
+    constructing the whole static-graph stack. Returns
+    `(program, feed_names, fetch_vars)` where `fetch_vars` are
+    shape/dtype handles for the program's outputs."""
     import hashlib
     import pickle
 
@@ -418,17 +470,8 @@ def load_inference_model(path_prefix, executor=None, **kw):
             f"landed between the two commits) — re-export with "
             f"save_inference_model")
     exp = jexport.deserialize(raw)
-
-    class _Prog:
-        def __init__(self):
-            self.exported = exp
-
-    def run_shim(feed):
-        return [np.asarray(o) for o in exp.call(*[jnp.asarray(feed[n])
-                                                  for n in meta["feed_names"]])]
-    prog = _Prog()
-    prog.run = run_shim
-    return prog, meta["feed_names"], None
+    prog = _InferenceProgram(exp, meta["feed_names"])
+    return prog, prog.feed_names, prog.fetch_vars
 
 
 class name_scope:

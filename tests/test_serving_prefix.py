@@ -1,0 +1,266 @@
+"""Prefix caching over the KV page pool (ISSUE 12): content-hash page
+sharing with refcounts, cache-aware admission, refcount-aware LRU
+eviction, the FLAGS_prefix_cache kill switch, the serving.prefix_evict
+chaos point, and request cancellation."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import (ContinuousBatchingEngine,
+                                  GenerationRequest)
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.utils import fault_injection as fi
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    fi.configure(None)
+    obs.enable(False)
+
+
+def _tiny_model(seed=0, **kw):
+    paddle.seed(seed)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=256, use_recompute=False,
+                      **kw)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+PAGE = 16
+RNG = np.random.RandomState(7)
+PREFIX = [int(t) for t in RNG.randint(1, 128, 3 * PAGE)]   # 3 full pages
+SUF_A = [int(t) for t in RNG.randint(1, 128, 5)]
+SUF_B = [int(t) for t in RNG.randint(1, 128, 7)]
+
+
+def _drain(eng, cap=2000):
+    n = 0
+    while eng.has_work and n < cap:
+        eng.step()
+        n += 1
+    assert not eng.has_work, "engine failed to drain"
+    return n
+
+
+def _engine(model, cache, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq", 128)
+    kw.setdefault("max_chunk_tokens", 16)
+    kw.setdefault("page_size", PAGE)
+    return ContinuousBatchingEngine(model, prefix_cache=cache, **kw)
+
+
+def _reference_generate(model, prompt, n_new):
+    out = model.generate(paddle.to_tensor(np.array([prompt], np.int32)),
+                         max_new_tokens=n_new, do_sample=False)
+    return [int(t) for t in np.asarray(out.numpy())[0][:n_new]]
+
+
+class TestPrefixSharing:
+    def test_second_request_reuses_cached_pages(self, model):
+        """After request A completes, request B with the same 3-page
+        prefix attaches A's physical pages at admission and prefills
+        ONLY its suffix."""
+        eng = _engine(model, cache=True)
+        a = GenerationRequest(PREFIX + SUF_A, max_new_tokens=4)
+        eng.add_request(a)
+        _drain(eng)
+        cached_pages = set(eng._pcache.by_page)
+        assert len(cached_pages) == 3
+        tokens_before = eng.prefill_tokens_total
+        b = GenerationRequest(PREFIX + SUF_B, max_new_tokens=4)
+        eng.add_request(b)
+        eng.step()                      # admission + first chunk
+        i = next(i for i, s in enumerate(eng.slots) if s.req is b)
+        assert eng.slot_pages[i][:3] == list(eng.page_table[i, :3])
+        assert set(eng.slot_pages[i][:3]) == cached_pages
+        assert eng._pcache.hits == 1 and eng._pcache.pages_reused == 3
+        _drain(eng)
+        # B prefilled exactly its suffix — the shared pages once, ever
+        assert eng.prefill_tokens_total - tokens_before == len(SUF_B)
+        assert b.status == "served"
+
+    def test_outputs_token_identical_cache_on_off_and_reference(self, model):
+        outs = {}
+        for cache in (True, False):
+            eng = _engine(model, cache=cache)
+            a = GenerationRequest(PREFIX + SUF_A, max_new_tokens=6)
+            eng.add_request(a)
+            _drain(eng)
+            b = GenerationRequest(PREFIX + SUF_B, max_new_tokens=6)
+            c = GenerationRequest(PREFIX + SUF_A + [9], max_new_tokens=6)
+            eng.add_request(b)
+            eng.add_request(c)
+            _drain(eng)
+            outs[cache] = (list(a.output), list(b.output), list(c.output))
+        assert outs[True] == outs[False]
+        assert outs[True][1] == _reference_generate(
+            model, PREFIX + SUF_B, 6)
+
+    def test_kill_switch_disables_index(self, model):
+        paddle.set_flags({"FLAGS_prefix_cache": 0})
+        try:
+            eng = ContinuousBatchingEngine(model, max_batch=2,
+                                           max_seq=128,
+                                           max_chunk_tokens=16,
+                                           page_size=PAGE)
+            assert eng._pcache is None
+        finally:
+            paddle.set_flags({"FLAGS_prefix_cache": 1})
+        # bucketed regime never builds the index either
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=128,
+                                       ragged=False, prefix_cache=True)
+        assert eng._pcache is None
+
+    def test_refcount_keeps_shared_pages_alive(self, model):
+        """A finishes while B still decodes over the shared pages: the
+        pages must not return to the free list until B releases them,
+        and B's output must stay correct."""
+        eng = _engine(model, cache=True)
+        a = GenerationRequest(PREFIX + SUF_A, max_new_tokens=3)
+        eng.add_request(a)
+        _drain(eng)
+        shared = set(eng._pcache.by_page)
+        b = GenerationRequest(PREFIX + SUF_B, max_new_tokens=12)
+        eng.add_request(b)
+        eng.step()
+        assert all(eng.pool.refcount(p) == 1 for p in shared)
+        _drain(eng)
+        assert b.output == _reference_generate(model, PREFIX + SUF_B, 12)
+        # all holders gone: pages idle-cached, still counted reclaimable
+        assert all(eng.pool.refcount(p) == 0 for p in shared)
+        assert eng.pool.n_free == eng.pool.n_pages - 1
+
+    def test_preempt_resume_hits_own_cached_prefix(self, model):
+        """A preempted request's re-admission finds its own prompt
+        pages in the index — recompute skips the cached prefix and the
+        resumed output is exact."""
+        eng = _engine(model, cache=True, max_batch=2, max_seq=96,
+                      total_pages=7, max_chunk_tokens=16)
+        # A grows from 4 to 5 pages mid-decode on a 6-page pool while B
+        # holds 2: B is preempted, leaf-first eviction takes ONE of its
+        # pages for A's growth, and B's re-admission hits the surviving
+        # chain head
+        long_a = GenerationRequest(PREFIX + SUF_A, max_new_tokens=20)
+        long_b = GenerationRequest(PREFIX[::-1] + SUF_B,
+                                   max_new_tokens=8)
+        eng.add_request(long_a)
+        eng.add_request(long_b)
+        _drain(eng)
+        assert eng.preemptions > 0
+        assert eng._pcache.hits > 0
+        for r in (long_a, long_b):
+            want = _reference_generate(model, r.prompt,
+                                       len(r.output))
+            assert r.output == want
+
+
+class TestEviction:
+    def test_lru_eviction_never_touches_held_pages(self, model):
+        """Small pool, distinct cached prefixes: a new admission evicts
+        idle cached pages (LRU), never a running sequence's, and the
+        new request's output is exact."""
+        eng = _engine(model, cache=True, max_batch=2, max_seq=64,
+                      total_pages=9, max_chunk_tokens=16)
+        rng = np.random.RandomState(3)
+        for k in range(3):
+            p = [int(t) for t in rng.randint(1, 128, 33 + k)]
+            eng.add_request(GenerationRequest(p, max_new_tokens=3))
+            _drain(eng)
+        assert len(eng._pcache.by_page) >= 4     # idle cached pages
+        big = GenerationRequest(
+            [int(t) for t in rng.randint(1, 128, 60)], max_new_tokens=3)
+        eng.add_request(big)
+        _drain(eng)
+        assert eng._pcache.evictions > 0
+        assert big.status == "served"
+        assert big.output == _reference_generate(model, big.prompt, 3)
+        assert eng.pool.n_free == eng.pool.n_pages - 1
+
+    def test_prefix_evict_fault_isolated(self, model):
+        """serving.prefix_evict raising inside the tick's allocator
+        path fails ONE request through the isolation boundary; the
+        engine keeps serving."""
+        eng = _engine(model, cache=True, max_batch=2, max_seq=64,
+                      total_pages=9, max_chunk_tokens=16, slo=True)
+        rng = np.random.RandomState(3)
+        for k in range(3):
+            p = [int(t) for t in rng.randint(1, 128, 33 + k)]
+            eng.add_request(GenerationRequest(p, max_new_tokens=3))
+            _drain(eng)
+        fi.configure("serving.prefix_evict:raise@1")
+        r1 = GenerationRequest(
+            [int(t) for t in rng.randint(1, 128, 60)], max_new_tokens=3)
+        r2 = GenerationRequest([3, 5], max_new_tokens=3)
+        eng.add_request(r1)
+        eng.add_request(r2)
+        _drain(eng)
+        stats = fi.stats()
+        assert stats["points"]["serving.prefix_evict"]["triggered"] >= 1
+        # the isolation boundary attributes the fault to ONE request
+        # (suspicion falls on the latest admission); the other is served
+        # and the tick loop survives
+        statuses = sorted((r1.status, r2.status))
+        assert statuses == ["failed", "served"], statuses
+        failed = r1 if r1.status == "failed" else r2
+        assert "FaultInjected" in failed.error
+        fi.configure(None)
+
+    def test_dropped_subtree_returns_pages(self, model):
+        """Evicting a chain root drops its cached descendants too —
+        no orphaned idle pages that lookups can never reach."""
+        eng = _engine(model, cache=True)
+        a = GenerationRequest(PREFIX + SUF_A, max_new_tokens=3)
+        eng.add_request(a)
+        _drain(eng)
+        assert len(eng._pcache.entries) == 3
+        root_key = next(iter(eng._pcache._root_children))
+        eng._pcache._drop_subtree(eng._pcache.entries[root_key])
+        assert not eng._pcache.entries       # whole chain gone
+        assert eng.pool.n_free == eng.pool.n_pages - 1
+
+
+class TestCancelAndTelemetry:
+    def test_cancel_waiting_and_running(self, model):
+        eng = _engine(model, cache=True, max_batch=1, max_seq=64)
+        r1 = GenerationRequest([3, 5, 7], max_new_tokens=50)
+        r2 = GenerationRequest([9, 11], max_new_tokens=5)
+        eng.add_request(r1)
+        eng.add_request(r2)
+        eng.step()
+        assert eng.cancel_request(r1)        # running
+        assert eng.cancel_request(r2)        # waiting
+        assert r1.status == "cancelled" and r2.status == "cancelled"
+        assert not eng.has_work
+        assert eng.pool.n_free == eng.pool.n_pages - 1
+        assert not eng.cancel_request(r1)    # already terminal
+
+    def test_prefix_counters_and_health(self, model):
+        obs.enable(True)
+        from paddle_tpu.observability import metrics
+        metrics.reset()
+        eng = _engine(model, cache=True)
+        eng.add_request(GenerationRequest(PREFIX + SUF_A,
+                                          max_new_tokens=3))
+        _drain(eng)
+        eng.add_request(GenerationRequest(PREFIX + SUF_B,
+                                          max_new_tokens=3))
+        _drain(eng)
+        snap = metrics.snapshot()
+        assert snap["counters"]["serving.prefix_hits_total"][""] == 1
+        assert snap["counters"]["serving.prefix_misses_total"][""] >= 1
+        assert snap["counters"][
+            "serving.prefix_pages_reused_total"][""] == 3
+        ratio = snap["gauges"]["serving.prefix_reuse_ratio"][""]
+        assert 0.0 < ratio <= 1.0
+        health = eng.health_snapshot()
+        assert health["prefix_cache"]["hits"] == 1
+        assert health["prefix_cache"]["reuse_ratio"] == ratio

@@ -104,6 +104,34 @@ def test_save_load_inference_model(static_mode):
     np.testing.assert_allclose(got, want, rtol=1e-6)
 
 
+def test_load_inference_model_headless_handles(static_mode):
+    """ISSUE 12 satellite: the loader needs NO Executor — the returned
+    program runs standalone and exposes feed/fetch handles a serving
+    front-end can bind wire requests to."""
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data("x", [2, 8], "float32")
+        paddle.seed(3)
+        y = paddle.tanh(nn.Linear(8, 3)(x))
+    exe = paddle.static.Executor()
+    feed = np.random.default_rng(5).standard_normal((2, 8)).astype(np.float32)
+    want, = exe.run(prog, feed={"x": feed}, fetch_list=[y])
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "model")
+        paddle.static.save_inference_model(path, [x], [y], exe,
+                                           program=prog)
+        loaded, feed_names, fetch_vars = \
+            paddle.static.load_inference_model(path)   # no executor
+        assert feed_names == ["x"] == loaded.feed_names
+        assert len(fetch_vars) == 1
+        assert fetch_vars[0].shape == (2, 3)
+        assert "float32" in fetch_vars[0].dtype
+        np.testing.assert_allclose(loaded.run({"x": feed})[0], want,
+                                   rtol=1e-6)
+        with pytest.raises(KeyError, match="missing feeds"):
+            loaded.run({})
+
+
 def test_load_inference_model_detects_torn_pair(static_mode):
     """ISSUE 4: a crash between the .pdiparams and .pdmodel commits can
     mix export generations; the loader must refuse the pair loudly (the
