@@ -26,6 +26,7 @@ from ..observability import goodput as _goodput
 from ..observability import metrics as _m
 from ..observability.spans import span as _span
 from ..tensor import Tensor
+from ..utils.fault_injection import fault_point
 from ..ops._helpers import to_tensor_like, unwrap
 
 # per-collective telemetry (ISSUE 3; EQuARX-style bytes/latency
@@ -151,6 +152,112 @@ class ReduceOp:
     MIN = "min"
     PROD = "prod"
     AVG = "avg"
+
+
+# ---- collective abort (ISSUE 13): interrupt a survivor parked inside an
+# in-flight collective. A dead peer leaves the survivor blocked until the
+# full FLAGS_comm_timeout (or PADDLE_P2P_TIMEOUT) elapses — recovery then
+# starts comm-timeout-bounded instead of watchdog-bounded. `abort()` sets
+# a process-wide abort request consulted by every HOST-CHANNEL wait
+# (send/recv retry loops, per-sender inbox gets): the blocked wait raises
+# `CollectiveAborted` within one poll granularity, the supervised
+# ElasticManager treats it like a peer failure (coordinated recovery, no
+# restart budget burned) and the rank reaches the recovery barrier in
+# watchdog/heartbeat-bounded time. Compiled (shard_map/XLA) collectives
+# cannot be interrupted in-place — for those the CommWatchdog's
+# on_timeout='abort' process-exit path remains the escape hatch; abort()
+# wired to CommWatchdog.on_fire still converts the *host*-side waits
+# around the step. In-flight host-channel payloads are DRAINED on abort:
+# an aborted collective's partial messages are poisoned (the peers will
+# rewind and re-send them after the recovery barrier agreement).
+
+class CollectiveAborted(RuntimeError):
+    """A blocked host-channel collective was interrupted by
+    `collective.abort()` (watchdog fire or restart-generation bump) —
+    the caller should park at the recovery barrier, not retry."""
+
+
+_ABORTS = _m.counter(
+    "collective.aborts_total",
+    "collective.abort() interruptions by requesting source")
+
+_abort_lock = threading.Lock()
+_abort_event = threading.Event()
+_abort_reason: Optional[str] = None
+
+# host-wait poll granularity while an abort may arrive: bounds the
+# latency between abort() and the blocked collective raising
+_ABORT_POLL_S = 0.05
+
+
+def abort(reason: str = "", source: str = "manual") -> None:
+    """Request interruption of every blocked host-channel collective in
+    this process. Idempotent (re-aborting while one is pending only
+    updates the reason); `clear_abort()` re-arms normal operation —
+    the supervised ElasticManager clears it at the recovery barrier."""
+    global _abort_reason
+    fault_point("collective.abort")
+    with _abort_lock:
+        _abort_reason = reason or "collective.abort()"
+        already = _abort_event.is_set()
+        _abort_event.set()
+    if not already:
+        _ABORTS.inc(1, source=source)
+        # drain in-flight host-channel payloads: messages produced under
+        # the aborted world are poisoned — after the recovery barrier the
+        # peers rewind to the agreed step and re-send everything
+        inbox = _p2p_inbox
+        if inbox is not None:
+            import queue as _q
+            for box in list(inbox.values()):
+                while True:
+                    try:
+                        box.get_nowait()
+                    except _q.Empty:
+                        break
+
+
+def abort_requested() -> Optional[str]:
+    """The pending abort reason, or None when operation is normal."""
+    if not _abort_event.is_set():
+        return None
+    with _abort_lock:
+        return _abort_reason or "collective.abort()"
+
+
+def clear_abort() -> None:
+    global _abort_reason
+    with _abort_lock:
+        _abort_event.clear()
+        _abort_reason = None
+
+
+def _check_abort(what: str) -> None:
+    r = abort_requested()
+    if r is not None:
+        raise CollectiveAborted(f"{what} interrupted: {r}")
+
+
+# world-generation stamp for host-channel payloads: the abort-time inbox
+# drain cannot catch a payload still in flight from a peer that has not
+# yet parked (it lands AFTER the drain), so every send carries the
+# sender's last-seen restart generation and recv DISCARDS payloads
+# stamped older than the local generation — a rewound peer's re-sends
+# carry the new generation and pair correctly. None (unsupervised /
+# pre-ISSUE-6 jobs) stamps nothing and discards nothing: bitwise the old
+# channel. The supervised ElasticManager advances this via its
+# generation listener and at every recovery-barrier release.
+_world_gen: Optional[int] = None
+
+
+def note_world_generation(gen: Optional[int]) -> None:
+    global _world_gen
+    _world_gen = gen
+
+
+def _stale_payload(tag) -> bool:
+    return (tag is not None and _world_gen is not None
+            and tag < _world_gen)
 
 
 # ---- coordinated elastic recovery (ISSUE 6): preflight health barrier.
@@ -632,10 +739,26 @@ def _ensure_p2p_server():
 
     _p2p_inbox = _SenderQueues()
     # bind this rank's configured interface (loopback unless the launcher
-    # published endpoints) — never wildcard
+    # published endpoints) — never wildcard. Bounded bind retry: a
+    # relaunched incarnation racing its predecessor's dying socket, or a
+    # transient ephemeral-port collision (EADDRINUSE), must not surface
+    # as a silent local fault that burns the elastic restart budget.
+    import errno
     _bind = _p2p_host(_env_rank())
-    _p2p_listener = Listener((_bind, _p2p_port(_env_rank())),
-                             authkey=_p2p_auth(bind_host=_bind))
+    deadline = time.monotonic() + float(
+        os.environ.get("PADDLE_P2P_BIND_TIMEOUT", "10"))
+    while True:
+        try:
+            _p2p_listener = Listener((_bind, _p2p_port(_env_rank())),
+                                     authkey=_p2p_auth(bind_host=_bind))
+            break
+        except OSError as e:
+            # only EADDRINUSE is transient here; EACCES/EADDRNOTAVAIL
+            # are misconfiguration that retrying can never heal
+            if e.errno != errno.EADDRINUSE or \
+                    time.monotonic() > deadline:
+                raise
+            time.sleep(0.1)
     _p2p_listener._paddle_shutdown = _p2p_shutdown
 
     def loop():
@@ -663,8 +786,12 @@ def _ensure_p2p_server():
             def drain(c=conn):
                 try:
                     while True:
-                        sender, arr = c.recv()
-                        _p2p_inbox[int(sender)].put(arr)
+                        msg = c.recv()
+                        # (sender, arr, gen_tag); 2-tuples kept readable
+                        # for any straggler peer mid-upgrade
+                        sender, arr = msg[0], msg[1]
+                        tag = msg[2] if len(msg) > 2 else None
+                        _p2p_inbox[int(sender)].put((arr, tag))
                 except (EOFError, OSError):
                     c.close()
 
@@ -689,13 +816,24 @@ def send(tensor, dst=0, group=None, sync_op=True):
     last = None
     # retry until the peer's (lazily started) listener is up, bounded by
     # the same timeout the receive side honors
+    # stamp captured ONCE at entry, before the abort check: the
+    # generation listener stamps-then-aborts, so a payload produced
+    # under the old world must never pick up the NEW generation from a
+    # bump that lands mid-retry (the receiver would accept it next to
+    # the rewound re-send). Unsupervised (None): legacy 2-tuple wire —
+    # bitwise the pre-ISSUE-13 channel, and an un-upgraded peer's
+    # 2-tuple drain unpack keeps working.
+    tag = _world_gen
+    payload = (_env_rank(), arr) if tag is None else \
+        (_env_rank(), arr, tag)
     deadline = _time.monotonic() + float(
         os.environ.get("PADDLE_P2P_TIMEOUT", "120"))
     while _time.monotonic() < deadline:
+        _check_abort(f"send(dst={dst})")
         try:
             conn = Client((_p2p_host(dst), _p2p_port(dst)),
                           authkey=_p2p_auth())
-            conn.send((_env_rank(), arr))
+            conn.send(payload)
             conn.close()
             return
         except (ConnectionError, OSError, AuthenticationError) as e:
@@ -723,23 +861,40 @@ def recv(tensor, src=0, group=None, sync_op=True):
     import time as _time
     timeout = float(os.environ.get("PADDLE_P2P_TIMEOUT", "120"))
     if src is not None:
-        try:
-            arr = _p2p_inbox[int(src)].get(timeout=timeout)
-        except _queue.Empty:
-            raise TimeoutError(
-                f"recv(src={src}) timed out after {timeout}s — peer "
-                "desync or dead sender")
+        # abort-aware blocking get: q.get wakes immediately when a
+        # message lands, so the short poll window only bounds how long
+        # a PENDING abort() can go unnoticed — not message latency.
+        # Payloads stamped with a PRE-recovery generation are dropped:
+        # the rewound sender re-sends them under the new one.
+        deadline = _time.monotonic() + timeout
+        q = _p2p_inbox[int(src)]
+        while True:
+            _check_abort(f"recv(src={src})")
+            try:
+                arr, tag = q.get(timeout=_ABORT_POLL_S)
+            except _queue.Empty:
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"recv(src={src}) timed out after {timeout}s — "
+                        "peer desync or dead sender")
+                continue
+            if not _stale_payload(tag):
+                break
     else:
         # any-source: poll the per-sender queues round-robin
         deadline = _time.monotonic() + timeout
         arr = None
         while arr is None:
+            _check_abort("recv(src=None)")
             for q in list(_p2p_inbox.values()):
                 try:
-                    arr = q.get_nowait()
-                    break
+                    arr, tag = q.get_nowait()
                 except _queue.Empty:
                     continue
+                if _stale_payload(tag):
+                    arr = None
+                    continue
+                break
             if arr is None:
                 if _time.monotonic() > deadline:
                     raise TimeoutError(

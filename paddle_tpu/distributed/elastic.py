@@ -45,7 +45,34 @@ the expected world, the next barrier releases at the smaller world size,
 and `DistributedBatchSampler.update_world` / `ShardingPlan.remesh`
 reshard to it. Everything here is DISARMED unless the supervisor set
 PADDLE_ELASTIC_SUPERVISED / a `membership=` was passed explicitly —
-the unsupervised code paths are bitwise the pre-ISSUE-6 behavior."""
+the unsupervised code paths are bitwise the pre-ISSUE-6 behavior.
+
+ELASTIC SCALE-UP + master resilience (ISSUE 13) close the loop:
+
+- *rejoin*: the supervisor keeps probing abandoned ranks
+  (`--rejoin_after`); a relaunched child announces `rejoin` on the
+  authenticated channel at the top of its supervised run. If its rank
+  was abandoned the master RE-ADMITS it — a *grow* generation bump —
+  survivors park at the recovery barrier, the world re-forms at the
+  larger size (contiguous remap again), and everyone resumes from the
+  newest step every rank of the grown world holds verified-complete.
+- *collective abort*: the supervised loop registers
+  `collective.abort` on generation-change notifications (carried by
+  heartbeat replies) and chains it onto the CommWatchdog's on_fire, so
+  a survivor blocked inside an in-flight host-channel collective is
+  interrupted in heartbeat/watchdog-bounded time instead of waiting
+  out FLAGS_comm_timeout; the raised `CollectiveAborted` is treated
+  exactly like a peer failure (coordinated recovery, no restart budget
+  burned).
+- *master journal*: with `journal=` (PADDLE_ELASTIC_JOURNAL in the
+  standalone `elastic_master` process) every durable coordination
+  mutation — generation bumps, abandon/rejoin, completions, cached
+  barrier releases — commits through `framework.io.atomic_write`. The
+  launch supervisor runs the master as a SUPERVISED SUBPROCESS and
+  restarts it from the journal on death; clients ride
+  `_net.connect_with_retry` plus a bounded re-send window in `_call`,
+  so a master SIGKILL mid-job is a blip (heartbeats fail silently and
+  resume), not a wedge."""
 from __future__ import annotations
 
 import glob
@@ -111,6 +138,13 @@ _EL_DEGRADED = _m.counter(
 _EL_SCRUBS = _m.counter(
     "elastic.scrub_passes_total",
     "background checksum-scrubber passes over retained checkpoints")
+_EL_GROWN = _m.counter(
+    "elastic.grown_total",
+    "grow-generation transitions (job re-formed at a LARGER world size "
+    "after a rank rejoined)")
+_EL_REJOINS = _m.counter(
+    "elastic.rejoins_total",
+    "successful re-admissions of this rank after abandonment")
 
 
 def _quarantine_dir(path: str, err) -> str:
@@ -465,9 +499,15 @@ class ElasticManager:
                     if nxt % self.save_interval == 0 or nxt == total_steps:
                         self.save(state, nxt)
                 return [losses[s] for s in sorted(losses)]
-            except Exception:
+            except Exception as e:
                 restarts += 1
                 _EL_RESTARTS.inc(1, incarnation=_inc_label())
+                # a SILENT restart loop is undebuggable post-mortem: a
+                # rank that exits ELASTIC_EXIT_CODE after N swallowed
+                # exceptions must leave their shapes in its log
+                warnings.warn(
+                    f"[elastic] restart {restarts}/{self.max_restarts} "
+                    f"after {type(e).__name__}: {e}", RuntimeWarning)
                 if restarts > self.max_restarts:
                     raise SystemExit(ELASTIC_EXIT_CODE)
                 if on_restart is not None:
@@ -481,8 +521,32 @@ class ElasticManager:
         """Park at the recovery barrier reporting this rank's verified
         checkpoint steps; returns the release info (gen, resume_step,
         world, rank_map)."""
+        from . import collective as _coll
+        # a pending abort belongs to the OLD world; the barrier is the
+        # sync point that re-forms it
+        _coll.clear_abort()
         release = mm.recovery_barrier(steps=self.verified_steps())
+        if mm.rank in (release.get("abandoned") or []):
+            # a failed/lost rejoin left this relaunch OUT of the world:
+            # training on anyway would make it a ghost rank silently
+            # duplicating a survivor's shard. SystemExit on purpose — a
+            # plain exception would be swallowed by the supervised
+            # loop's local-fault handler, which restores locally and
+            # trains the ghost to completion. Die (ELASTIC_EXIT_CODE);
+            # the supervisor's next probe relaunches and re-announces.
+            warnings.warn(
+                f"[elastic] rank {mm.rank} is abandoned at generation "
+                f"{release.get('gen')} — rejoin was not admitted; "
+                f"refusing to train as a ghost rank", RuntimeWarning)
+            raise SystemExit(ELASTIC_EXIT_CODE)
         self._apply_world(mm, release)
+        # host-channel payloads stamped before this release's generation
+        # are now provably old-world: recv discards them on sight
+        _coll.note_world_generation(release.get("gen"))
+        # bumps that landed while we were parked re-coordinate via the
+        # between-step generation check; the event itself must not leak
+        # into the first collective of the re-formed world
+        _coll.clear_abort()
         return release
 
     def _apply_world(self, mm: "MembershipManager", release: dict):
@@ -496,10 +560,17 @@ class ElasticManager:
         full = mm.world
         degraded = ((prev_w is not None and world < prev_w) or
                     (prev_w is None and full is not None and world < full))
+        grown = prev_w is not None and world > prev_w
         if degraded:
             _EL_DEGRADED.inc(1, incarnation=_inc_label())
             warnings.warn(
                 f"[elastic] world degraded: now {world} rank(s), this "
+                f"rank remapped {mm.rank} -> {new_rank} "
+                f"(generation {release.get('gen')})", RuntimeWarning)
+        elif grown:
+            _EL_GROWN.inc(1, incarnation=_inc_label())
+            warnings.warn(
+                f"[elastic] world grew back: now {world} rank(s), this "
                 f"rank remapped {mm.rank} -> {new_rank} "
                 f"(generation {release.get('gen')})", RuntimeWarning)
         self._world, self._rank = world, new_rank
@@ -511,15 +582,61 @@ class ElasticManager:
         initial_full = (prev_w is None and
                         (full is None or (world == full and
                                           new_rank == mm.rank)))
-        if not initial_full and self.on_world_change is not None:
+        if initial_full:
+            return
+        # multi-process jobs: the jax.distributed rendezvous must
+        # re-form at the new (world, rank) before any cross-process
+        # collective compiles against the old membership. No-op — one
+        # flag check — everywhere jax.distributed never initialized.
+        from .env import reinit_coordinator
+        try:
+            reinit_coordinator(world, new_rank)
+        except Exception as e:
+            warnings.warn(
+                f"[elastic] jax.distributed re-init at world={world} "
+                f"rank={new_rank} failed: {e!r}", RuntimeWarning)
+        if self.on_world_change is not None:
             self.on_world_change(world, new_rank)
 
     def _run_supervised(self, mm, make_state, train_step, total_steps,
                         on_restart):
+        from . import collective as _coll
         restarts = 0
         losses: dict = {}
         step_fn = self._wrap_step(train_step)
         mm.start_heartbeat()
+        # scale-up announce (ISSUE 13): tell the master we are here. An
+        # abandoned rank's relaunch gets re-admitted under a grow
+        # generation; everyone else it's a no-op. Raises if the master
+        # stays unreachable — this child then dies and the supervisor's
+        # next rejoin probe retries, which beats training as a ghost.
+        mm.rejoin()
+        # AFTER the announce (our own grow bump must not self-abort):
+        # generation bumps observed from here on interrupt blocked
+        # host-channel collectives, and a watchdog overrun does the same
+        # — recovery is heartbeat/watchdog-bounded, not comm-timeout-
+        # bounded.
+        def _on_gen_moved(gen):
+            # stamp FIRST: payloads a peer sent under the old world must
+            # read as stale from the instant we know the world moved
+            _coll.note_world_generation(gen)
+            _coll.abort(f"restart generation moved to {gen}",
+                        source="generation")
+
+        # idempotent wiring: a second run() on the same membership/
+        # watchdog (multi-phase training, retry harnesses) must not
+        # stack duplicate abort closures that fire forever after
+        if not getattr(mm, "_abort_listener_armed", False):
+            mm._abort_listener_armed = True
+            mm.add_generation_listener(_on_gen_moved)
+        from .watchdog import CommWatchdog
+        if isinstance(self.watchdog, CommWatchdog) and \
+                not getattr(self.watchdog, "_abort_chained", False):
+            self.watchdog._abort_chained = True
+            self.watchdog.add_on_fire(
+                lambda name, elapsed: _coll.abort(
+                    f"watchdog fired on {name!r} after {elapsed:.0f}s",
+                    source="watchdog"))
         try:
             return self._supervised_loop(mm, make_state, step_fn,
                                          total_steps, on_restart,
@@ -598,9 +715,40 @@ class ElasticManager:
                 _EL_GENERATION.set(e.generation, incarnation=_inc_label())
                 coordinate = True
                 continue
-            except Exception:
+            except _coll_aborted() as e:
+                # an aborted collective IS a peer failure observed from
+                # inside the blocked wait (generation bump or watchdog
+                # fire interrupted it): same coordinated recovery, no
+                # restart budget burned. _coordinate clears the abort.
+                _EL_RECOVERIES.inc(1, incarnation=_inc_label())
+                seen = mm.last_generation()
+                if seen is None or seen == gen:
+                    # WATCHDOG-sourced abort with no observed bump (a
+                    # local stall, not a peer death): the current
+                    # generation's release is CACHED, so re-arriving
+                    # would hand back the stale agreement and silently
+                    # rewind this rank past its peers. Force a NEW
+                    # generation so the whole world re-parks and
+                    # re-agrees (the corrupt-agreed-checkpoint
+                    # precedent).
+                    try:
+                        mm.notify_failure(
+                            None, reason=f"collective abort at rank "
+                            f"{mm.rank}: {e}")
+                    except Exception:
+                        pass    # master unreachable: the barrier's
+                        # stale-stamp reconcile converges us anyway
+                warnings.warn(f"[elastic] collective aborted ({e}); "
+                              f"parking at the recovery barrier",
+                              RuntimeWarning)
+                coordinate = True
+                continue
+            except Exception as e:
                 restarts += 1
                 _EL_RESTARTS.inc(1, incarnation=_inc_label())
+                warnings.warn(
+                    f"[elastic] restart {restarts}/{self.max_restarts} "
+                    f"after {type(e).__name__}: {e}", RuntimeWarning)
                 if restarts > self.max_restarts:
                     raise SystemExit(ELASTIC_EXIT_CODE)
                 if on_restart is not None:
@@ -609,6 +757,14 @@ class ElasticManager:
                 delay = self._restart_delay(restarts)
                 _EL_BACKOFF.set(delay)
                 time.sleep(delay)
+
+
+def _coll_aborted():
+    """The CollectiveAborted type, imported lazily: elastic must stay
+    importable without dragging collective (and jax) in at module load
+    — the launch supervisor imports this module in-process."""
+    from .collective import CollectiveAborted
+    return CollectiveAborted
 
 
 class MembershipManager:
@@ -645,7 +801,8 @@ class MembershipManager:
     def __init__(self, master_endpoint=None, name=None, rank=0,
                  ttl: Optional[float] = None,
                  interval: Optional[float] = None,
-                 world: Optional[int] = None):
+                 world: Optional[int] = None,
+                 journal: Optional[str] = None):
         import threading
 
         self.master_endpoint = master_endpoint or os.environ.get(
@@ -680,8 +837,22 @@ class MembershipManager:
         self._dead = {}                # rank -> (gen, reason, t) forensics
         self._arrived = {}             # gen -> {rank: steps-or-None}
         self._released = {}            # gen -> release info dict
+        # -- master resilience (ISSUE 13): journal of the DURABLE
+        # coordination state (generation, abandoned/completed sets, dead
+        # forensics, cached barrier releases) — everything a restarted
+        # master cannot rebuild from client polling alone. None = pure
+        # in-memory (the pre-ISSUE-13 behavior, and every client).
+        self.journal = journal
+        self._journal_wlock = threading.Lock()
+        self._journal_seq = 0          # stamped under _lock at snapshot
+        self._journal_written = 0      # guarded by _journal_wlock
         # -- client-side generation cache (updated by heartbeat replies)
         self._seen_gen = None
+        # generation-change listeners (ISSUE 13): fired from whichever
+        # thread first observes a bump (usually the heartbeat thread) —
+        # the supervised ElasticManager wires collective.abort here so a
+        # survivor blocked in a host-channel collective is interrupted
+        self._gen_listeners = []
 
     @staticmethod
     def _addr(endpoint):
@@ -781,7 +952,11 @@ class MembershipManager:
     def _handle(self, msg):
         """One request -> one reply (master side). Unknown messages get
         ("err", ...) instead of a dropped connection so a version-skewed
-        client fails loudly."""
+        client fails loudly. The `elastic.master_serve` fault point hits
+        once per handled message — `crash@N` SIGKILLs the master process
+        mid-job, the master-outage chaos drill (the supervisor must
+        restart it from the journal with no survivor restart)."""
+        fault_point("elastic.master_serve")
         kind = msg[0]
         if kind == "beat":
             name, rank = msg[1], msg[2]
@@ -799,10 +974,14 @@ class MembershipManager:
             return ("ok", self._bump(dead_rank, reason))
         if kind == "abandon":
             return ("ok", self._abandon(msg[1]))
+        if kind == "rejoin":
+            return ("ok", self._rejoin(msg[1]))
         if kind == "done":
             with self._lock:
                 self._completed.add(msg[1])
-                return ("ok", None)
+                payload = self._journal_snapshot_locked()
+            self._journal_write(payload)
+            return ("ok", None)
         if kind == "world":
             with self._lock:
                 return ("ok", self._world_info())
@@ -827,7 +1006,10 @@ class MembershipManager:
                 for n, (r, _t, _i) in list(self._beats.items()):
                     if r == dead_rank:
                         del self._beats[n]
-            return self._generation
+            gen = self._generation
+            payload = self._journal_snapshot_locked()
+        self._journal_write(payload)
+        return gen
 
     def _abandon(self, rank) -> dict:
         """Degrade: remove `rank` from the expected world for good. Bumps
@@ -839,7 +1021,107 @@ class MembershipManager:
             for n, (r, _t, _i) in list(self._beats.items()):
                 if r == rank:
                     del self._beats[n]
-            return self._world_info()
+            info = self._world_info()
+            payload = self._journal_snapshot_locked()
+        self._journal_write(payload)
+        return info
+
+    def _rejoin(self, rank) -> dict:
+        """Scale-UP (ISSUE 13): a relaunched child of an ABANDONED rank
+        is healthy again — re-admit it. Bumps the generation (a *grow*
+        generation: survivors park, the next barrier awaits and releases
+        at the LARGER world size with the re-admitted rank back in the
+        contiguous remap). Idempotent: a rank that is not abandoned —
+        every fresh/merely-relaunched rank announces at startup — gets
+        `readmitted: False` and the current world, with NO bump."""
+        with self._lock:
+            if rank not in self._abandoned:
+                return dict(self._world_info(), readmitted=False)
+            self._abandoned.discard(rank)
+            self._completed.discard(rank)
+            self._generation += 1
+            info = dict(self._world_info(), readmitted=True)
+            payload = self._journal_snapshot_locked()
+        self._journal_write(payload)
+        return info
+
+    # -- master journal (ISSUE 13) -------------------------------------
+    def _journal_snapshot_locked(self):
+        """Build the durable-state payload (callers hold _lock); the
+        WRITE happens outside the lock via `_journal_write` — an fsync
+        stall (slow/NFS log dir) while holding the master lock would
+        block heartbeat recording and TTL-expire live ranks. None when
+        journaling is disabled."""
+        if not self.journal:
+            return None
+        self._journal_seq += 1
+        return {
+            "_seq": self._journal_seq,
+            "generation": self._generation,
+            "world": self.world,
+            "abandoned": sorted(self._abandoned),
+            "completed": sorted(self._completed),
+            "dead": {str(r): list(v) for r, v in self._dead.items()},
+            "released": {str(g): info
+                         for g, info in self._released.items()},
+        }
+
+    def _journal_write(self, payload):
+        """Commit a snapshot built under the lock — called WITHOUT the
+        lock, in the mutating request's own thread, so the state is
+        durable BEFORE the reply reaches the client. Atomic
+        (framework.io.atomic_write): a crash at any instant leaves the
+        previous complete journal. Serialized by _journal_wlock, and
+        snapshot-sequence-checked so two mutating requests racing here
+        can never commit an OLDER snapshot over a newer one.
+        Best-effort: a full disk must degrade durability, not wedge the
+        control plane."""
+        if payload is None:
+            return
+        import json
+        try:
+            from ..framework.io import atomic_write
+            with self._journal_wlock:
+                if payload["_seq"] <= self._journal_written:
+                    return      # a newer snapshot already committed
+                atomic_write(
+                    self.journal,
+                    lambda f: f.write(json.dumps(payload).encode()),
+                    fault_name="elastic.journal")
+                self._journal_written = payload["_seq"]
+        except Exception as e:
+            warnings.warn(f"[elastic] master journal write failed "
+                          f"({e!r}) — a master restart would lose "
+                          f"coordination state", RuntimeWarning)
+
+    def load_journal(self) -> bool:
+        """Restore coordination state from `journal` (master restart).
+        JSON round-trips every int key through str, so ranks/generations
+        (and the rank_map inside cached releases) are re-int'd here —
+        clients index rank_map by their integer rank. Returns True when
+        a journal was loaded."""
+        if not self.journal or not os.path.exists(self.journal):
+            return False
+        import json
+        with open(self.journal) as f:
+            payload = json.load(f)
+        released = {}
+        for g, info in (payload.get("released") or {}).items():
+            info = dict(info)
+            if isinstance(info.get("rank_map"), dict):
+                info["rank_map"] = {int(k): v
+                                    for k, v in info["rank_map"].items()}
+            released[int(g)] = info
+        with self._lock:
+            self._generation = int(payload.get("generation", 0))
+            self._abandoned = {int(r)
+                               for r in payload.get("abandoned", [])}
+            self._completed = {int(r)
+                               for r in payload.get("completed", [])}
+            self._dead = {int(r): tuple(v)
+                          for r, v in (payload.get("dead") or {}).items()}
+            self._released = released
+        return True
 
     def _expected_ranks(self):
         # callers hold _lock. World membership: every rank not degraded
@@ -861,19 +1143,33 @@ class MembershipManager:
     def _world_info(self):
         # callers hold _lock
         expected = self._expected_ranks()
+        awaited = self._awaited_ranks()
         rank_map = ({r: i for i, r in enumerate(expected)}
                     if expected is not None else {})
         return {"gen": self._generation,
                 "world": len(expected) if expected is not None else None,
                 "abandoned": sorted(self._abandoned),
+                # ranks that still have WORK (expected minus completed)
+                # and ranks that FINISHED: the supervisor stops
+                # rejoin-probing once nothing is awaited AND someone
+                # completed (re-growing a finished job is pointless) —
+                # but keeps probing a TOTAL outage (all abandoned,
+                # nobody ever completed), where recovery matters most
+                "awaited": len(awaited) if awaited is not None else None,
+                "completed": len(self._completed),
                 "rank_map": rank_map}
 
     def _barrier_arrive(self, name, rank, gen, steps):
         """Arrival-barrier bookkeeping: record (rank -> verified steps)
         for `gen`; release once every expected rank arrived. The release
         answer is cached per generation so late/duplicate arrivals (and
-        the releases' own polls) are idempotent."""
+        the releases' own polls) are idempotent. A NEW release is an
+        AGREEMENT some ranks may act on before others poll: it is
+        journaled (outside the lock, before the reply) so a master
+        restart in that window hands late pollers the same cached
+        answer instead of waiting forever for ranks that moved on."""
         now = time.time()
+        payload = None
         with self._lock:
             self._beats[name] = (rank, now, self._beats.get(name, (0, 0, 0))[2])
             if gen != self._generation:
@@ -900,7 +1196,9 @@ class MembershipManager:
             info.update({"released": True,
                          "resume_step": max(common) if common else 0})
             self._released[gen] = info
-            return info
+            payload = self._journal_snapshot_locked()
+        self._journal_write(payload)
+        return info
 
     def _health_check(self):
         """Health-barrier poll: released once every expected rank has a
@@ -928,15 +1226,42 @@ class MembershipManager:
     # -- node side ----------------------------------------------------------
     def _call(self, msg, timeout_s: Optional[float] = None):
         """One request/reply round trip — local when this instance hosts
-        the master, over the authenticated channel otherwise."""
+        the master, over the authenticated channel otherwise. A master
+        dying between send and recv (SIGKILL mid-restart, ISSUE 13)
+        surfaces as EOF/reset: the request is RE-SENT against the
+        restarted master inside a bounded window
+        (PADDLE_ELASTIC_CALL_TIMEOUT, default 15s) — every message is
+        idempotent except `bump`/`abandon`/`rejoin`, where a replayed
+        mutation only over-advances the generation (survivors re-park
+        once more and converge; a wedge is the failure mode to avoid,
+        not an extra barrier round trip)."""
         if self._listener is not None:
             return self._handle(msg)
-        c = self._connect(timeout_s=timeout_s)
-        try:
-            c.send(msg)
-            return c.recv()
-        finally:
-            c.close()
+        window = timeout_s
+        if window is None:
+            window = float(os.environ.get(
+                "PADDLE_ELASTIC_CALL_TIMEOUT", "15"))
+        deadline = time.monotonic() + window
+        while True:
+            try:
+                # the connect sits INSIDE the window too: _connect's own
+                # retry ceiling (PADDLE_ELASTIC_CONNECT_TIMEOUT, 5s) is
+                # shorter than a worst-case master respawn, and a
+                # refused connect must not abort the re-send window
+                # early (AuthenticationError still propagates — a wrong
+                # key never heals by retrying)
+                c = self._connect(timeout_s=timeout_s)
+                try:
+                    c.send(msg)
+                    return c.recv()
+                finally:
+                    c.close()
+            except (EOFError, ConnectionError, OSError) as e:
+                if time.monotonic() > deadline:
+                    raise ConnectionError(
+                        f"elastic master dropped {msg[0]!r} and stayed "
+                        f"unreachable for {window:.0f}s: {e}") from e
+                time.sleep(0.1)
 
     def start_heartbeat(self):
         import threading
@@ -975,7 +1300,26 @@ class MembershipManager:
 
     def _note_gen(self, gen: int):
         with self._lock:
+            prev = self._seen_gen
             self._seen_gen = gen
+        if prev is not None and gen != prev:
+            # a generation MOVED under us: notify listeners (fired from
+            # the observing thread — usually the heartbeat) so a rank
+            # blocked inside a host-channel collective can be aborted
+            # instead of waiting out FLAGS_comm_timeout
+            for cb in list(self._gen_listeners):
+                try:
+                    cb(gen)
+                except Exception as e:
+                    warnings.warn(
+                        f"[elastic] generation listener failed: {e!r}",
+                        RuntimeWarning)
+
+    def add_generation_listener(self, cb) -> None:
+        """Register cb(gen) to fire whenever a reply carries a DIFFERENT
+        generation than the last one seen (ISSUE 13: the supervised
+        ElasticManager wires collective.abort here)."""
+        self._gen_listeners.append(cb)
 
     def last_generation(self) -> Optional[int]:
         """Most recent restart generation carried back by a heartbeat
@@ -1008,6 +1352,27 @@ class MembershipManager:
         status, info = self._call(("abandon", rank))
         if status != "ok":
             raise RuntimeError(f"elastic master error: {info}")
+        return info
+
+    def rejoin(self) -> dict:
+        """Announce this (re)launched rank on the authenticated channel
+        (ISSUE 13). If the rank was ABANDONED the master re-admits it
+        under a grow generation and the returned info carries
+        `readmitted: True`; otherwise it is a no-op returning the
+        current world. Called unconditionally at the top of every
+        supervised run — re-admission must not depend on the child
+        knowing its own history."""
+        fault_point("elastic.rejoin")
+        status, info = self._call(("rejoin", self.rank))
+        if status != "ok":
+            raise RuntimeError(f"elastic master error: {info}")
+        if info.get("readmitted"):
+            _EL_REJOINS.inc(1, incarnation=_inc_label())
+            warnings.warn(
+                f"[elastic] rank {self.rank} re-admitted: world grows "
+                f"back to {info.get('world')} at generation "
+                f"{info.get('gen')}", RuntimeWarning)
+        self._note_gen(info["gen"])
         return info
 
     def notify_done(self) -> None:

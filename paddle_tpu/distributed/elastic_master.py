@@ -1,0 +1,104 @@
+"""Standalone elastic-coordination master process (ISSUE 13).
+
+PR 6 hosted the master-side MembershipManager inside the rank-0 launch
+supervisor, which made it a single point of failure the supervisor could
+not restart (killing the master meant killing the supervisor). This
+module is the fix: `python -m paddle_tpu.distributed.elastic_master`
+serves the coordination plane in its OWN supervised subprocess —
+
+- state journals through `framework.io.atomic_write`
+  (PADDLE_ELASTIC_JOURNAL): generation, abandoned/completed sets, dead
+  forensics and cached barrier releases survive a SIGKILL;
+- on start the journal (if any) is restored BEFORE the listener binds,
+  so the first client poll after a restart already sees the
+  pre-crash generation — no stale-generation window;
+- heartbeat freshness and in-flight barrier arrivals are NOT journaled
+  by design: beats re-register within one interval and every parked
+  rank re-sends its arrival on each 0.25s barrier poll, so that state
+  self-heals through the normal client cadence;
+- the bind retries briefly (PADDLE_ELASTIC_BIND_TIMEOUT, default 10s):
+  a SIGKILLed predecessor's port can lag a moment even with
+  SO_REUSEADDR.
+
+The launch supervisor (`--elastic_level 1`, rank 0) spawns and monitors
+this process exactly like a worker: on death it appends a
+`master_death`/`master_relaunch` record to supervisor_flight.jsonl and
+respawns it from the journal — a master SIGKILL mid-job is a blip
+(client beats fail silently and resume; `MembershipManager._call`
+re-sends dropped requests), not a wedge.
+
+Chaos lever: the `elastic.master_serve` fault point hits once per
+handled message inside `MembershipManager._handle`, so
+`elastic.master_serve:crash@N` (passed by the supervisor via
+PADDLE_ELASTIC_MASTER_FAULT, armed on the FIRST master incarnation
+only) SIGKILLs the master deterministically mid-job.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+__all__ = ["main"]
+
+
+def main(argv=None) -> int:
+    # the master never touches accelerators; grabbing the TPU here would
+    # steal the chips from the actual workers
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu.distributed.elastic import MembershipManager
+
+    endpoint = os.environ.get("PADDLE_ELASTIC_ENDPOINT",
+                              "127.0.0.1:18814")
+    world = os.environ.get("PADDLE_ELASTIC_WORLD")
+    journal = os.environ.get("PADDLE_ELASTIC_JOURNAL") or None
+    mm = MembershipManager(master_endpoint=endpoint, name="_master",
+                           rank=-1, world=int(world) if world else None,
+                           journal=journal)
+    restored = False
+    try:
+        restored = mm.load_journal()
+    except Exception as e:
+        # a torn/corrupt journal must not crash-loop the master forever:
+        # serve from generation 0 (clients re-park and re-agree) and say
+        # so loudly
+        print(f"elastic_master: journal {journal} unreadable ({e!r}); "
+              f"serving fresh state", file=sys.stderr, flush=True)
+    import errno
+    deadline = time.time() + float(
+        os.environ.get("PADDLE_ELASTIC_BIND_TIMEOUT", "10"))
+    while True:
+        try:
+            mm.start_master()
+            break
+        except OSError as e:
+            # retry only the SIGKILLed-predecessor port lag; a
+            # misconfigured endpoint (EACCES/EADDRNOTAVAIL) can never
+            # heal by waiting
+            if e.errno != errno.EADDRINUSE or time.time() > deadline:
+                print(f"elastic_master: cannot bind {endpoint}: {e}",
+                      file=sys.stderr, flush=True)
+                return 1
+            time.sleep(0.1)
+    print(f"elastic_master: serving {endpoint} world={mm.world} "
+          + (f"(journal restored, generation {mm._generation})"
+             if restored else "(fresh state)"),
+          file=sys.stderr, flush=True)
+
+    stop = threading.Event()
+
+    def _graceful(signum, frame):
+        stop.set()
+
+    import signal
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    while not stop.wait(0.2):
+        pass
+    mm.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,11 +13,12 @@ from typing import Optional
 import jax
 
 _initialized = False
+_jax_coordinated = False    # init_parallel_env actually ran jax.distributed
 
 
 def init_parallel_env(strategy=None):
     """ref: paddle.distributed.init_parallel_env."""
-    global _initialized
+    global _initialized, _jax_coordinated
     if _initialized:
         return
     coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
@@ -36,7 +37,40 @@ def init_parallel_env(strategy=None):
     if coord and nproc > 1:
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=nproc, process_id=pid)
+        _jax_coordinated = True
     _initialized = True
+
+
+def reinit_coordinator(world: int, rank: int) -> bool:
+    """Re-initialize the jax.distributed coordination service across an
+    ELASTIC world change (ISSUE 13): a degraded/grown world has a
+    different process count and (contiguous-remapped) process ids, and
+    the old coordinator membership would reject or wedge the next
+    cross-process rendezvous. Tears the client down and re-runs the
+    rendezvous at the new (world, rank). No-op — returns False — when
+    this process never ran a multi-process `jax.distributed.initialize`
+    (single-controller jobs, the host-channel CPU test world), so the
+    unsupervised paths stay bitwise untouched."""
+    if not _jax_coordinated:
+        return False
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if not coord:
+        return False
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        # a dead coordinator makes shutdown raise; the re-init below is
+        # the actual recovery, so a noisy teardown must not stop it
+        pass
+    # _jax_coordinated stays ARMED across a failed initialize: a
+    # transiently unreachable coordinator must not latch re-init off
+    # for the rest of the process — the next world change retries (the
+    # caller warns about this failure)
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=int(world),
+                               process_id=int(rank))
+    return True
 
 
 def is_initialized() -> bool:

@@ -25,7 +25,19 @@ stays dead past --degrade_after seconds is ABANDONED: the master
 shrinks the expected world and survivors re-form at the smaller world
 size (degraded-world resharding) rather than the whole job aborting.
 Every transition is appended to <log_dir>/supervisor_flight.jsonl,
-naming the dead rank, rc, incarnation and generation."""
+naming the dead rank, rc, incarnation and generation.
+
+ISSUE 13 closes the elastic loop upward: the rank-0 supervisor now runs
+the elastic master as its own SUPERVISED SUBPROCESS
+(`paddle_tpu.distributed.elastic_master`, journaling through
+framework.io.atomic_write) and restarts it from the journal on death
+(`master_death`/`master_relaunch` flight records) — a master SIGKILL is
+a blip, not a wedge. With `--rejoin_after S` an ABANDONED rank keeps
+being probed: every S seconds the supervisor relaunches it
+(`rejoin_probe`); the child announces `rejoin` on the authenticated
+channel, the master re-admits it under a *grow* generation, the
+supervisor notices (`rejoined`, restart budget reset) and the world
+re-forms at full size — scale-UP, the inverse of --degrade_after."""
 from __future__ import annotations
 
 import argparse
@@ -84,6 +96,19 @@ def _parse(argv):
                         "surviving world instead of failing (default: "
                         "never degrade — restarts exhausted fails the "
                         "job, the legacy policy)")
+    p.add_argument("--rejoin_after", type=float, default=None,
+                   help="with --degrade_after: keep PROBING an abandoned "
+                        "rank every this-many seconds — its relaunched "
+                        "child announces `rejoin` and, once the master "
+                        "re-admits it, the world GROWS back to full size "
+                        "(scale-up; default: abandoned is forever, the "
+                        "PR 6 policy)")
+    p.add_argument("--master_journal", default=None,
+                   help="path the elastic master journals its "
+                        "coordination state to (atomic commits; the "
+                        "supervisor restarts a crashed master from it). "
+                        "Default: <log_dir>/elastic_master.journal, or a "
+                        "temp file without --log_dir")
     p.add_argument("--auto_tuner_json", default=None,
                    help="ref distributed/launch + auto_tuner: JSON config "
                         "driving a launch-level grid search — each pruned "
@@ -258,11 +283,62 @@ def _sup_record(args, record):
         pass        # forensics must not kill the supervisor
 
 
+def _master_journal_path(args):
+    if args.master_journal:
+        return args.master_journal
+    if args.log_dir:
+        return os.path.join(args.log_dir, "elastic_master.journal")
+    import tempfile
+    fd, path = tempfile.mkstemp(prefix="paddle_elastic_",
+                                suffix=".journal")
+    os.close(fd)
+    os.unlink(path)          # the master writes it atomically itself
+    return path
+
+
+def _spawn_master(args, env, ep, world, minc, journal=None):
+    """Spawn the standalone elastic master (ISSUE 13) as a supervised
+    subprocess. `journal` must be the SAME path for every incarnation
+    (the supervisor computes it once) — re-deriving it here would mint
+    a fresh temp file per respawn in the no---log_dir case and the
+    restarted master would restore nothing. Chaos schedules reach it
+    ONLY via PADDLE_ELASTIC_MASTER_FAULT (armed on incarnation 0) — a
+    worker fault schedule in FLAGS_fault_inject must not also crash the
+    coordination plane."""
+    me = dict(env)
+    me["PADDLE_ELASTIC_ENDPOINT"] = ep
+    me["PADDLE_ELASTIC_WORLD"] = str(world)
+    me["PADDLE_ELASTIC_JOURNAL"] = journal or _master_journal_path(args)
+    me["JAX_PLATFORMS"] = "cpu"      # never grab the workers' chips
+    me.pop("FLAGS_fault_inject", None)
+    if minc == 0 and env.get("PADDLE_ELASTIC_MASTER_FAULT"):
+        me["FLAGS_fault_inject"] = env["PADDLE_ELASTIC_MASTER_FAULT"]
+    # `-m` needs the package importable in the child regardless of cwd
+    import paddle_tpu
+    pkg_root = os.path.dirname(os.path.dirname(paddle_tpu.__file__))
+    me["PYTHONPATH"] = pkg_root + os.pathsep + me.get("PYTHONPATH", "")
+    logf = None
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+        logf = open(os.path.join(args.log_dir,
+                                 f"master.inc{minc}.log"), "ab")
+    try:
+        return subprocess.Popen(
+            [sys.executable, "-m",
+             "paddle_tpu.distributed.elastic_master"],
+            env=me, stdout=logf, stderr=logf)
+    finally:
+        if logf is not None:
+            logf.close()
+
+
 def _supervise(args, env):
     """Run this node's ranks as supervised children; relaunch ONLY the
     rank that died (broadcasting a restart generation so survivors park
     at the recovery barrier), degrade the world when a rank stays dead
-    past the budget. Returns the job's exit code."""
+    past the budget, keep probing abandoned ranks for rejoin
+    (--rejoin_after) so the world can GROW back, and restart the
+    journaled elastic master if it dies. Returns the job's exit code."""
     from paddle_tpu.distributed.elastic import MembershipManager
     from paddle_tpu.utils.fault_injection import fault_point
 
@@ -271,7 +347,7 @@ def _supervise(args, env):
     ep = _elastic_endpoint(args, env)
     env = dict(env)
     env["PADDLE_ELASTIC_ENDPOINT"] = ep
-    # the in-process master/client must share the children's channel
+    # the supervisor's own client must share the children's channel
     # secret: _bootstrap_env minted PADDLE_JOB_AUTHKEY into the CHILD
     # env only, while derive_authkey reads this process's os.environ
     if env.get("PADDLE_JOB_AUTHKEY"):
@@ -279,14 +355,37 @@ def _supervise(args, env):
     mm = MembershipManager(master_endpoint=ep,
                            name=f"_supervisor{args.rank}", rank=-1,
                            world=world)
+    master_proc = None
+    master_inc = 0
+    master_restarts = 0
+    master_journal = _master_journal_path(args)   # ONE path, all incs
+    master_budget = int(os.environ.get(
+        "PADDLE_ELASTIC_MASTER_MAX_RESTARTS", "20"))
     if args.rank == 0:
-        mm.start_master()
+        # a journal left by a PREVIOUS job reusing this --log_dir would
+        # start the new job with the old run's generation/abandoned/
+        # completed state (e.g. instantly-releasing barriers because
+        # every rank reads as completed) — the journal's lifetime is ONE
+        # job: fresh at incarnation 0, restored only across respawns
+        try:
+            if os.path.exists(master_journal):
+                os.unlink(master_journal)
+        except OSError as e:
+            print(f"launch: could not clear stale master journal "
+                  f"{master_journal}: {e}", file=sys.stderr)
+        # ISSUE 13: the master is a SUPERVISED SUBPROCESS, not part of
+        # this process — a master death is recoverable from its journal
+        master_proc = _spawn_master(args, env, ep, world, master_inc,
+                                    master_journal)
+        _sup_record(args, {"ev": "master_spawn", "incarnation": 0})
     local_ranks = [args.rank * nproc + j for j in range(nproc)]
     procs = {}
     inc = {r: 0 for r in local_ranks}         # incarnation ids
     restarts = {r: 0 for r in local_ranks}
     status = {r: "running" for r in local_ranks}
     dead_since = {}
+    next_probe = {}          # abandoned rank -> monotonic rejoin-probe due
+    next_world_poll = 0.0    # rejoining ranks: next world_view reconcile
     rc_last = 1
 
     fed = None
@@ -341,14 +440,163 @@ def _supervise(args, env):
                   f"{e}", file=sys.stderr)
             return None
 
+    def check_master():
+        """Respawn a dead master from its journal (rank 0 only). A
+        crash-looping master (corrupt binary, unbindable port) fails the
+        job after a bounded budget instead of wedging it forever."""
+        nonlocal master_proc, master_inc, master_restarts
+        if master_proc is None:
+            return True
+        rc_m = master_proc.poll()
+        if rc_m is None:
+            return True
+        _sup_record(args, {"ev": "master_death", "rc": rc_m,
+                           "incarnation": master_inc})
+        print(f"launch: elastic master died rc={rc_m} "
+              f"(incarnation {master_inc}); restarting from journal",
+              file=sys.stderr)
+        master_restarts += 1
+        if master_restarts > master_budget:
+            print(f"launch: elastic master crash-looping "
+                  f"({master_restarts} restarts) — failing the job",
+                  file=sys.stderr)
+            return False
+        master_inc += 1
+        master_proc = _spawn_master(args, env, ep, world, master_inc,
+                                    master_journal)
+        _sup_record(args, {"ev": "master_relaunch",
+                           "incarnation": master_inc,
+                           "restart": master_restarts})
+        return True
+
+    def mark_rejoined(r):
+        """Re-admission bookkeeping: the rank is a full member again
+        with a FRESH restart budget (shared by the world_view reconcile
+        and the admitted-then-died probe path)."""
+        status[r] = "running"
+        restarts[r] = 0
+        dead_since.pop(r, None)
+        _sup_record(args, {"ev": "rejoined", "rank": r,
+                           "incarnation": inc[r]})
+        print(f"launch: rank {r} re-admitted — world grows back",
+              file=sys.stderr)
+
+    def reconcile_rejoining(now):
+        """Flip 'rejoining' ranks whose announce the master admitted
+        back to 'running' (fresh restart budget), and schedule rejoin
+        probes for abandoned ranks."""
+        nonlocal next_world_poll
+        if args.rejoin_after is not None:
+            for r in local_ranks:
+                if status[r] == "abandoned" and \
+                        now >= next_probe.get(r, float("inf")):
+                    inc[r] += 1
+                    status[r] = "rejoining"
+                    next_probe.pop(r, None)
+                    _sup_record(args, {"ev": "rejoin_probe", "rank": r,
+                                       "incarnation": inc[r]})
+                    print(f"launch: probing abandoned rank {r} for "
+                          f"rejoin (incarnation {inc[r]})",
+                          file=sys.stderr)
+                    procs[r] = spawn(r)
+                    if procs[r] is None:
+                        status[r] = "abandoned"
+                        next_probe[r] = now + args.rejoin_after
+        if not any(st == "rejoining" for st in status.values()) or \
+                now < next_world_poll:
+            return
+        next_world_poll = now + 0.5
+        try:
+            ab = set(mm.world_view().get("abandoned", []))
+        except Exception:
+            return              # master mid-restart: reconcile next poll
+        for r in local_ranks:
+            if status[r] == "rejoining" and r not in ab:
+                mark_rejoined(r)
+
+    probe_cache = {"t": 0.0, "alive": True}
+
+    def probing_keeps_alive():
+        """With --rejoin_after, a node whose local ranks are ALL
+        abandoned must keep probing as long as the master still awaits
+        work somewhere (multi-node: the survivors live elsewhere) OR
+        nothing ever completed (a TOTAL outage — every rank abandoned —
+        is exactly where recovery matters most); it stops once nothing
+        is awaited and at least one rank finished — re-growing a
+        finished job is pointless. Throttled to one master poll/s."""
+        if args.rejoin_after is None or \
+                not any(st == "abandoned" for st in status.values()):
+            return False
+        now = time.monotonic()
+        if now - probe_cache["t"] >= 1.0:
+            probe_cache["t"] = now
+            try:
+                info = mm.world_view()
+            except Exception:
+                probe_cache["alive"] = True   # master mid-restart
+            else:
+                probe_cache["alive"] = bool(info.get("awaited")) or \
+                    not info.get("completed")
+        return probe_cache["alive"]
+
     try:
         for r in local_ranks:
             _sup_record(args, {"ev": "spawn", "rank": r, "incarnation": 0})
             procs[r] = spawn(r)
 
-        while any(st == "running" for st in status.values()):
+        while any(st in ("running", "rejoining")
+                  for st in status.values()) or probing_keeps_alive():
             time.sleep(0.15)
+            if not check_master():
+                for r2 in local_ranks:
+                    p2 = procs.get(r2)
+                    if p2 is not None and p2.poll() is None:
+                        p2.kill()
+                        p2.wait()
+                return 1
+            now_loop = time.monotonic()
+            reconcile_rejoining(now_loop)
             for r in local_ranks:
+                if status[r] == "rejoining":
+                    p = procs[r]
+                    rc = 1 if p is None else p.poll()
+                    if rc is None:
+                        continue
+                    if rc == 0:
+                        # probe child was re-admitted AND finished
+                        status[r] = "done"
+                        _sup_record(args, {"ev": "worker_done",
+                                           "rank": r,
+                                           "incarnation": inc[r]})
+                        continue
+                    # died during the probe: if the master never
+                    # admitted it the world is unchanged — no bump, just
+                    # schedule the next probe. If it WAS admitted, it is
+                    # a real member again: hand it to the normal
+                    # death path below. An UNREACHABLE master defaults
+                    # to member: if it had been admitted, demoting it to
+                    # 'abandoned' would leave survivors parked at a
+                    # barrier awaiting a rank nobody respawns until the
+                    # next probe; if it had not, the immediate relaunch
+                    # just re-announces rejoin (idempotent) — a
+                    # gratuitous bump beats a wedge.
+                    try:
+                        still_out = r in set(
+                            mm.world_view().get("abandoned", []))
+                    except Exception:
+                        still_out = False
+                    if still_out:
+                        status[r] = "abandoned"
+                        next_probe[r] = now_loop + args.rejoin_after
+                        _sup_record(args, {"ev": "rejoin_probe_failed",
+                                           "rank": r, "rc": rc,
+                                           "incarnation": inc[r]})
+                        continue
+                    # admitted then died: it is a full member again and
+                    # entitled to the fresh budget — the normal death
+                    # handling picks it up next loop iteration
+                    mark_rejoined(r)
+                    continue
                 if status[r] != "running":
                     continue
                 p = procs[r]
@@ -398,6 +646,9 @@ def _supervise(args, env):
                                   file=sys.stderr)
                             continue
                         status[r] = "abandoned"
+                        if args.rejoin_after is not None:
+                            next_probe[r] = time.monotonic() + \
+                                args.rejoin_after
                         print(f"launch: rank {r} dead past budget — "
                               f"DEGRADING world: {info}", file=sys.stderr)
                         _sup_record(args, {"ev": "degrade", "rank": r,
@@ -422,6 +673,13 @@ def _supervise(args, env):
             return 0        # abandoned ranks don't fail a degraded job
         return rc_last
     finally:
+        if master_proc is not None and master_proc.poll() is None:
+            master_proc.terminate()
+            try:
+                master_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                master_proc.kill()
+                master_proc.wait()
         if fed is not None:
             fed.stop()
 

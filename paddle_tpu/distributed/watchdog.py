@@ -142,6 +142,26 @@ class CommWatchdog:
                 if self.on_timeout == "abort":
                     os._exit(self.FAULT_EXIT_CODE)
 
+    def add_on_fire(self, cb: Callable[[str, float], None]) -> None:
+        """Chain an ADDITIONAL fire hook after any existing one(s); each
+        hook is isolated (one raising does not skip the rest). ISSUE 13
+        wires `collective.abort` here so a survivor parked in a
+        host-channel collective is interrupted in watchdog-bounded (not
+        comm-timeout-bounded) time when the step overruns."""
+        prev = self.on_fire
+        if prev is None:
+            self.on_fire = cb
+            return
+
+        def chained(name, elapsed, _prev=prev, _cb=cb):
+            try:
+                _prev(name, elapsed)
+            except Exception:
+                pass        # a broken hook must not starve the next one
+            _cb(name, elapsed)
+
+        self.on_fire = chained
+
     # -- section API -------------------------------------------------------
     @contextlib.contextmanager
     def section(self, name: str = "step"):

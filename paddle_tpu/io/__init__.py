@@ -362,15 +362,29 @@ class DistributedBatchSampler(BatchSampler):
         `num_replicas` with their remapped `rank`. The shuffle base seed
         is unchanged (it was rank-constant by contract), so the global
         permutation stays identical — only the per-rank slice moves.
-        The seed-consensus check is DISABLED from here on: it is a
-        whole-world collective (all_gather over jax.process_count()),
-        and in a degraded world the abandoned rank would never arrive —
-        the very deadlock this path exists to avoid. Degrade does not
-        change the seed, so whatever consensus held (or would have
-        held) still does."""
+        On a SHRINK the seed-consensus check is DISABLED from here on:
+        it is a whole-world collective (all_gather over
+        jax.process_count()), and in a degraded world the abandoned
+        rank would never arrive — the very deadlock this path exists to
+        avoid. Degrade does not change the seed, so whatever consensus
+        held (or would have held) still does.
+        On a GROW back to the FULL world (scale-up re-admission,
+        ISSUE 13) the check is RE-ARMED: the re-admitted rank's fresh
+        incarnation derives its base seed anew, and a divergent seed
+        would silently desynchronize the shuffles — with every process
+        back, the whole-world gather is safe again. A PARTIAL grow
+        (some ranks still abandoned) keeps it disabled on every member:
+        the gather spans jax.process_count() and the still-dead
+        processes would never arrive."""
+        grew = int(num_replicas) > int(self.nranks)
+        try:
+            import jax
+            full_world = int(num_replicas) >= jax.process_count()
+        except Exception:
+            full_world = True
         self.nranks = int(num_replicas)
         self.local_rank = int(rank)
-        self._seed_checked = True
+        self._seed_checked = not (grew and full_world)
         self.num_samples = int(np.ceil(len(self.dataset) / self.nranks))
         self.total_size = self.num_samples * self.nranks
 

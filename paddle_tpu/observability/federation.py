@@ -226,20 +226,40 @@ def _merge_hist_cells(a: dict, b: dict) -> Optional[dict]:
             "sum": a["sum"] + b["sum"], "count": a["count"] + b["count"]}
 
 
+def _int_inc(snap) -> int:
+    try:
+        return int(snap.get("incarnation", 0))
+    except (TypeError, ValueError):
+        return 0
+
+
 def merge_snapshots(snaps: List[dict],
                     stale_after: float = DEFAULT_STALE_AFTER,
                     now: Optional[float] = None) -> dict:
     """Merge per-rank snapshot payloads into one registry-shaped dict
     (see the module docstring for the semantics). The result feeds
-    export.prometheus_text(snap) directly."""
+    export.prometheus_text(snap) directly.
+
+    Staleness is both time- AND succession-based (ISSUE 13): a rank's
+    superseded incarnations are marked stale the moment a NEWER
+    incarnation publishes its first snapshot, so a re-admitted rank's
+    rejoin flips the grown world into /metrics within one scrape
+    instead of waiting out PADDLE_FEDERATION_STALE_AFTER on the dead
+    incarnation's last snapshot."""
     now = time.time() if now is None else now
+    newest_inc: Dict[str, int] = {}
+    for snap in snaps:
+        r = snap["rank"]
+        newest_inc[r] = max(newest_inc.get(r, 0), _int_inc(snap))
     merged: dict = {"counters": {}, "gauges": {}, "histograms": {}}
     job_counters: Dict[str, Dict[str, float]] = {}
     job_hists: Dict[str, Dict[str, dict]] = {}
     for snap in snaps:
         rank, inc = snap["rank"], snap["incarnation"]
         ts = float(snap.get("ts", 0.0))
-        fresh = 1.0 if (now - ts) <= stale_after else 0.0
+        superseded = _int_inc(snap) < newest_inc[rank]
+        fresh = 1.0 if (now - ts) <= stale_after and not superseded \
+            else 0.0
         key = _relabel("", rank, inc)
         merged["gauges"].setdefault(
             "federation.last_seen_ts", {})[key] = ts
@@ -321,8 +341,11 @@ class FederationServer:
             cell = {"incarnation": s["incarnation"], "ts": ts,
                     "fresh": (now - ts) <= self.stale_after}
             prev = ranks.get(s["rank"])
-            # a rank's health is its NEWEST incarnation's freshness
-            if prev is None or ts >= prev["ts"]:
+            # a rank's health is its NEWEST incarnation's freshness —
+            # ordered by incarnation first (a rejoined rank's fresh
+            # incarnation wins immediately), snapshot time as tiebreak
+            if prev is None or (_int_inc(s), ts) >= \
+                    (_int_inc(prev), prev["ts"]):
                 ranks[s["rank"]] = cell
         out = {"ok": True, "ranks": ranks,
                "fresh_ranks": sum(1 for c in ranks.values() if c["fresh"]),

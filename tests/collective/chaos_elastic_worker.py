@@ -12,7 +12,13 @@ to an uninterrupted run (the per-step update is exact dyadic float32
 arithmetic: w += (step+1) * 0.25, so any skipped or double-applied step
 shows).
 
-argv: out_dir total_steps [fault_rank fault_spec]
+argv: out_dir total_steps [fault_rank fault_spec [mode]]
+mode "p2p" (ISSUE 13) adds a host-channel collective to every step —
+rank 1 sends a step-tagged probe, rank 0 blocks in recv — so killing
+rank 1 leaves rank 0 parked INSIDE an in-flight collective with
+PADDLE_P2P_TIMEOUT set far above FLAGS_comm_timeout: only
+collective.abort (wired to generation bumps) can unblock it in bounded
+time. The abort-to-resume latencies land in the done record.
 Writes done_{rank}_{pid}.json with the final restored weights, the
 world-change events, the last seen generation and a metrics snapshot.
 """
@@ -27,6 +33,7 @@ import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu import distributed as dist
+from paddle_tpu.distributed import collective
 from paddle_tpu.distributed.elastic import ElasticManager, incarnation
 from paddle_tpu.io import DistributedBatchSampler
 
@@ -36,6 +43,7 @@ def main():
     total = int(sys.argv[2])
     fault_rank = int(sys.argv[3]) if len(sys.argv) > 3 else -1
     fault_spec = sys.argv[4] if len(sys.argv) > 4 else ""
+    mode = sys.argv[5] if len(sys.argv) > 5 else ""
     rank = int(os.environ["PADDLE_TRAINER_ID"])
     world = int(os.environ["PADDLE_TRAINERS_NUM"])
     inc = incarnation()
@@ -71,10 +79,60 @@ def main():
     def make_state():
         return {"w": paddle.to_tensor(np.zeros(4, np.float32))}
 
+    blocked = {}                      # abort/resume latency bookkeeping
+
+    # the faulted FIRST incarnation goes quiet a few steps before its
+    # death: rank 0 is then deterministically parked inside an
+    # unsatisfiable recv when the kill lands (an abort racing the
+    # between-step generation check would sometimes never interrupt an
+    # in-flight wait, which is the very thing the drill asserts);
+    # relaunched incarnations send for every step again
+    P2P_QUIET_AFTER = 8
+
+    def p2p_exchange(step):
+        """Step-paced host-channel collective (mode 'p2p'): rank 1
+        produces a step-tagged probe, rank 0 consumes it. Skipped once
+        the world degraded (the peer is gone for good)."""
+        if world != 2 or events:
+            return
+        if rank == 1:
+            if not (rank == fault_rank and inc == 0 and fault_spec
+                    and step >= P2P_QUIET_AFTER):
+                dist.send(paddle.to_tensor(
+                    np.full(2, float(step), np.float32)), dst=0)
+            return
+        if "abort_ts" in blocked and "resumed_after" not in blocked:
+            # first step after the aborted collective: barrier wait +
+            # peer relaunch are inside this latency
+            blocked["resumed_after"] = time.monotonic() - \
+                blocked["abort_ts"]
+        probe = paddle.to_tensor(np.zeros(2, np.float32))
+        t0 = time.monotonic()
+        try:
+            while True:
+                dist.recv(probe, src=1)
+                # replayed steps re-produce their probes; drop any
+                # stale one that slipped past the abort-time drain
+                if int(np.asarray(probe.numpy())[0]) >= step:
+                    return
+        except collective.CollectiveAborted:
+            blocked["aborted_after"] = time.monotonic() - t0
+            blocked["abort_ts"] = time.monotonic()
+            raise
+
     def train_step(state, step):
         # exact dyadic update: bitwise-reproducible across replays
         state["w"].data = state["w"].data + (step + 1) * 0.25
-        time.sleep(0.05)
+        if mode == "p2p":
+            p2p_exchange(step)
+            # the PRODUCER (rank 1) paces slower than the consumer, so
+            # rank 0 is deterministically PARKED inside recv awaiting
+            # the next probe whenever the peer dies — the drill must
+            # abort a wait that is actually in flight, not race the
+            # between-step generation check
+            time.sleep(0.12 if rank == 1 else 0.02)
+        else:
+            time.sleep(0.05)
         return float(step)
 
     with open(os.path.join(out_dir,
@@ -94,6 +152,7 @@ def main():
            "events": events,
            "generation": mm.last_generation() if mm else None,
            "my_indices": [i for b in sampler for i in b],
+           "blocked": dict(blocked),
            "counters": snap.get("counters", {})}
     path = os.path.join(out_dir, f"done_{rank}_{os.getpid()}.json")
     with open(path + ".tmp", "w") as f:

@@ -142,7 +142,12 @@ class TestP2PAcceptLoop:
             while time.monotonic() < deadline:
                 q = C._p2p_inbox[1]
                 if not q.empty():
-                    np.testing.assert_array_equal(q.get(), np.arange(4))
+                    # inbox entries are (payload, generation_tag) since
+                    # ISSUE 13; an untagged legacy 2-tuple send lands
+                    # with tag None
+                    arr, tag = q.get()
+                    np.testing.assert_array_equal(arr, np.arange(4))
+                    assert tag is None
                     return
                 time.sleep(0.05)
             pytest.fail("message from honest peer never arrived — "

@@ -42,14 +42,44 @@ def _free_port():
     return port
 
 
+def _free_port_pair():
+    """A listener base port with base AND base+1 free, chosen BELOW the
+    ephemeral range (like the p2p default 29900): _free_port()'s bind-0
+    trick returns the kernel's next-ephemeral cursor, so base+1 would be
+    handed to one of the job's own short-lived client connections
+    (heartbeat churn) moments later and EADDRINUSE-wedge the rank-1
+    listener for a TIME_WAIT period."""
+    import random
+    for _ in range(64):
+        base = random.randint(20000, 28999)
+        try:
+            s0 = socket.socket()
+            try:
+                s0.bind(("127.0.0.1", base))
+                s1 = socket.socket()
+                try:
+                    s1.bind(("127.0.0.1", base + 1))
+                finally:
+                    s1.close()
+            finally:
+                s0.close()
+        except OSError:
+            continue
+        return base
+    raise RuntimeError("no free port pair found")
+
+
 @pytest.fixture(autouse=True)
 def _bounded_and_disarmed(monkeypatch):
     """Every barrier in this module is bounded (a wedged barrier must
-    fail the test, not hang the suite) and faults are disarmed after."""
+    fail the test, not hang the suite), faults are disarmed after, and
+    the process-wide collective-abort latch never leaks across tests."""
     monkeypatch.setenv("FLAGS_comm_timeout", "30")
     monkeypatch.setenv("PADDLE_ELASTIC_CONNECT_TIMEOUT", "5")
+    monkeypatch.setenv("PADDLE_ELASTIC_CALL_TIMEOUT", "5")
     yield
     fi.configure(None)
+    collective.clear_abort()
 
 
 def _master(world, port=None):
@@ -668,7 +698,8 @@ class TestSupervisor:
 # -- the acceptance scenario: subprocess chaos --------------------------------
 
 def _run_supervisor(out_dir, worker_args, nproc=2, max_restart=2,
-                    degrade_after=None, timeout=240):
+                    degrade_after=None, rejoin_after=None,
+                    extra_env=None, timeout=240):
     env = dict(os.environ)
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
@@ -676,6 +707,8 @@ def _run_supervisor(out_dir, worker_args, nproc=2, max_restart=2,
     env["PADDLE_ELASTIC_HEARTBEAT"] = "0.1"
     env["FLAGS_metrics"] = "1"
     env["FLAGS_comm_timeout"] = "120"
+    if extra_env:
+        env.update(extra_env)
     cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
            "--nnodes", "1", "--rank", "0",
            "--nproc_per_node", str(nproc),
@@ -684,6 +717,8 @@ def _run_supervisor(out_dir, worker_args, nproc=2, max_restart=2,
            "--log_dir", out_dir]
     if degrade_after is not None:
         cmd += ["--degrade_after", str(degrade_after)]
+    if rejoin_after is not None:
+        cmd += ["--rejoin_after", str(rejoin_after)]
     cmd += [str(COLL / "chaos_elastic_worker.py")] + worker_args
     p = subprocess.Popen(cmd, env=env, cwd=str(REPO),
                          stdout=subprocess.PIPE,
@@ -709,6 +744,8 @@ def _sup_events(out_dir):
             for line in open(path).read().splitlines()]
 
 
+@pytest.mark.chaos
+@pytest.mark.slow
 @pytest.mark.timeout(240)
 def test_chaos_kill_one_rank_mid_step_recovers_without_job_relaunch(
         tmp_path):
@@ -768,6 +805,8 @@ def test_chaos_kill_one_rank_mid_step_recovers_without_job_relaunch(
     assert recs[1]["incarnation"] == 1
 
 
+@pytest.mark.chaos
+@pytest.mark.slow
 @pytest.mark.timeout(240)
 def test_chaos_degrade_after_budget_survivor_reshards(tmp_path):
     """A rank that dies with NO restart budget and --degrade_after set
@@ -791,3 +830,805 @@ def test_chaos_degrade_after_budget_survivor_reshards(tmp_path):
     assert sorted(rec["my_indices"]) == list(range(16))
     assert rec["w"] == _expected_w(total).tolist()
     assert rec["losses_len"] == total
+
+
+# -- ISSUE 13: rejoin / grow plane --------------------------------------------
+
+class TestRejoinPlane:
+    def test_rejoin_readmits_abandoned_rank_with_grow_generation(self):
+        master, ep = _master(world=3)
+        try:
+            info = master._abandon(1)
+            assert info["world"] == 2 and master._generation == 1
+            mm1 = MembershipManager(ep, rank=1, interval=0.05)
+            info = mm1.rejoin()
+            assert info["readmitted"] is True
+            assert info["gen"] == 2            # a GROW generation bump
+            assert info["world"] == 3
+            assert info["abandoned"] == []
+            assert info["rank_map"] == {0: 0, 1: 1, 2: 2}
+            # idempotent: announcing again is a no-op, no extra bump
+            info2 = mm1.rejoin()
+            assert info2["readmitted"] is False
+            assert info2["gen"] == 2
+        finally:
+            master.stop()
+
+    def test_rejoin_of_active_rank_is_noop(self):
+        master, ep = _master(world=2)
+        try:
+            mm = MembershipManager(ep, rank=0, interval=0.05)
+            info = mm.rejoin()
+            assert info["readmitted"] is False
+            assert info["gen"] == 0 and info["world"] == 2
+        finally:
+            master.stop()
+
+    def test_barrier_after_rejoin_awaits_full_world(self):
+        """After a degrade + rejoin, the next barrier must await BOTH
+        ranks again and release at the grown world size."""
+        master, ep = _master(world=2)
+        try:
+            master._abandon(1)                      # world 1, gen 1
+            m0 = MembershipManager(ep, rank=0, interval=0.05)
+            rel = m0.recovery_barrier(steps=[4], timeout=10)
+            assert rel["world"] == 1
+            m1 = MembershipManager(ep, rank=1, interval=0.05)
+            assert m1.rejoin()["readmitted"]        # world 2, gen 2
+            out = {}
+
+            def enter(mm, steps, key):
+                out[key] = mm.recovery_barrier(steps=steps, timeout=10)
+
+            t0 = threading.Thread(target=enter, args=(m0, [3, 4], 0),
+                                  daemon=True)
+            t0.start()
+            time.sleep(0.3)
+            assert 0 not in out            # rank 0 PARKED awaiting rank 1
+            t1 = threading.Thread(target=enter, args=(m1, [2, 3], 1),
+                                  daemon=True)
+            t1.start()
+            t0.join(15), t1.join(15)
+            assert out[0]["released"] and out[1]["released"]
+            assert out[0]["world"] == 2
+            assert out[0]["rank_map"] == {0: 0, 1: 1}
+            assert out[0]["resume_step"] == 3      # newest common again
+        finally:
+            master.stop()
+
+    def test_supervised_managers_degrade_then_grow_back(self, tmp_path):
+        """In-process scale-up round trip: rank 0 degrades to world 1
+        when rank 1 never shows, keeps training, then rank 1 rejoins
+        mid-run — rank 0 parks at the grow barrier, reshards back to
+        world 2, and BOTH finish with exact weights."""
+        master, ep = _master(world=2)
+        total = 14
+        results, events = {}, []
+        try:
+            def run_rank(rank, on_change=None):
+                mm = MembershipManager(ep, rank=rank, interval=0.05,
+                                       world=2)
+                em = ElasticManager(str(tmp_path / f"ck{rank}"),
+                                    save_interval=1, keep=50,
+                                    max_restarts=0, membership=mm,
+                                    on_world_change=on_change)
+
+                def step(state, s):
+                    time.sleep(0.03)
+                    return _exact_step(state, s)
+
+                results[rank] = em.run(_state_factory(), step, total)
+
+            def on_change(world, rank):
+                events.append((world, rank))
+
+            t0 = threading.Thread(target=run_rank, args=(0, on_change),
+                                  daemon=True)
+            t0.start()
+            time.sleep(0.4)                 # rank 0 parked at gen 0
+            master._abandon(1)              # degrade to world 1
+            # wait until rank 0 demonstrably trains alone
+            deadline = time.time() + 15
+            while not (tmp_path / "ck0" / "step_3" /
+                       "metadata.json").exists() \
+                    and time.time() < deadline:
+                time.sleep(0.02)
+            assert (tmp_path / "ck0" / "step_3" /
+                    "metadata.json").exists()
+            # rank 1 comes back: announce + run — the GROW path
+            t1 = threading.Thread(target=run_rank, args=(1,),
+                                  daemon=True)
+            t1.start()
+            t0.join(30), t1.join(30)
+            assert not t0.is_alive() and not t1.is_alive(), \
+                "scale-up wedged"
+            for r in (0, 1):
+                assert len(results[r]) == total
+                probe = _state_factory()()
+                em = ElasticManager(str(tmp_path / f"ck{r}"))
+                assert em.restore(probe) == total
+                np.testing.assert_array_equal(
+                    np.asarray(probe["w"].numpy()), _expected_w(total))
+            assert (1, 0) in events and (2, 0) in events, events
+            assert events.index((1, 0)) < events.index((2, 0))
+            assert master._abandoned == set()
+        finally:
+            master.stop()
+
+
+# -- ISSUE 13: master journal + restart resilience ----------------------------
+
+class TestMasterJournal:
+    def test_journal_roundtrip_restores_coordination_state(self,
+                                                           tmp_path):
+        journal = str(tmp_path / "m.journal")
+        a = MembershipManager(world=3, journal=journal)
+        a._bump(2, "rc=137")
+        a._abandon(2)
+        a._handle(("done", 0))
+        rel = a._barrier_arrive("node0", 0, 2, [5, 6])
+        assert not rel["released"]          # rank 1 not arrived yet
+        rel = a._barrier_arrive("node1", 1, 2, [4, 5])
+        assert rel["released"] and rel["resume_step"] == 5
+        assert os.path.exists(journal)
+
+        b = MembershipManager(world=3, journal=journal)
+        assert b.load_journal() is True
+        assert b._generation == 2
+        assert b._abandoned == {2}
+        assert b._completed == {0}
+        assert 2 in b._dead and b._dead[2][1] == "rc=137"
+        # cached release survives with INT generation and rank_map keys
+        assert 2 in b._released
+        cached = b._barrier_arrive("node1", 1, 2, [4, 5])
+        assert cached["released"] and cached["resume_step"] == 5
+        assert cached["rank_map"] == {0: 0, 1: 1}
+        assert cached["rank_map"][1] == 1   # int key, not "1"
+
+    def test_missing_or_disabled_journal_is_noop(self, tmp_path):
+        assert MembershipManager(world=1).load_journal() is False
+        mm = MembershipManager(world=1,
+                               journal=str(tmp_path / "absent.journal"))
+        assert mm.load_journal() is False
+        mm._bump(None, "x")                 # journals without error
+        assert mm.load_journal() is True
+
+    def test_corrupt_journal_raises_for_caller_policy(self, tmp_path):
+        journal = tmp_path / "bad.journal"
+        journal.write_text("{torn")
+        mm = MembershipManager(world=1, journal=str(journal))
+        with pytest.raises(ValueError):
+            mm.load_journal()   # elastic_master catches + serves fresh
+
+    def test_client_call_retries_across_master_restart(self,
+                                                       monkeypatch):
+        """A master dying between requests must look like a blip: the
+        client re-sends inside PADDLE_ELASTIC_CALL_TIMEOUT and the
+        restarted (journal-restored) master answers with the pre-crash
+        generation."""
+        port = _free_port()
+        master, ep = _master(world=1, port=port)
+        master._bump(None, "pre-crash")
+        mm = MembershipManager(ep, rank=0, interval=0.05)
+        assert mm.generation() == 1
+        master.stop()
+        out = {}
+
+        def call():
+            out["gen"] = mm.generation()
+
+        t = threading.Thread(target=call, daemon=True)
+        t.start()
+        time.sleep(0.4)                     # client is retrying now
+        master2 = MembershipManager(master_endpoint=ep, name="_master",
+                                    rank=-1, world=1)
+        master2._generation = 1             # what a journal restore does
+        master2.start_master()
+        try:
+            t.join(10)
+            assert not t.is_alive(), "client never reconnected"
+            assert out["gen"] == 1          # stale-generation reconcile
+        finally:
+            master2.stop()
+
+
+# -- ISSUE 13: collective abort -----------------------------------------------
+
+@pytest.fixture
+def _p2p_env(monkeypatch):
+    """A world-2 rank-0 host-channel environment on a private port with
+    a clean abort latch and a torn-down listener afterwards."""
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    monkeypatch.setenv("PADDLE_P2P_BASE_PORT", str(_free_port()))
+    monkeypatch.delenv("PADDLE_TRAINER_ENDPOINTS", raising=False)
+    collective.clear_abort()
+    yield
+    collective.destroy_process_group()
+    collective.clear_abort()
+
+
+class TestCollectiveAbort:
+    def test_abort_interrupts_blocked_recv(self, _p2p_env, monkeypatch):
+        monkeypatch.setenv("PADDLE_P2P_TIMEOUT", "30")
+        out = {}
+
+        def blocked():
+            t0 = time.monotonic()
+            try:
+                collective.recv(paddle.to_tensor(np.zeros(2)), src=1)
+            except collective.CollectiveAborted as e:
+                out["aborted_after"] = time.monotonic() - t0
+                out["err"] = str(e)
+
+        t = threading.Thread(target=blocked, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        assert t.is_alive()                 # genuinely parked in recv
+        collective.abort("peer died", source="test")
+        t.join(5)
+        assert not t.is_alive(), "abort did not interrupt recv"
+        assert out["aborted_after"] < 2.0   # poll-granularity, not 30s
+        assert "peer died" in out["err"]
+        assert collective.abort_requested() is not None
+        collective.clear_abort()
+        assert collective.abort_requested() is None
+
+    def test_abort_drains_inflight_inbox(self, _p2p_env):
+        collective._ensure_p2p_server()
+        collective._p2p_inbox[1].put(np.zeros(2))
+        collective.abort("poisoned world", source="test")
+        assert collective._p2p_inbox[1].qsize() == 0
+
+    def test_send_checks_abort_in_retry_loop(self, _p2p_env,
+                                             monkeypatch):
+        monkeypatch.setenv("PADDLE_P2P_TIMEOUT", "30")
+        collective.abort("already aborting", source="test")
+        t0 = time.monotonic()
+        with pytest.raises(collective.CollectiveAborted):
+            collective.send(paddle.to_tensor(np.zeros(2)), dst=1)
+        assert time.monotonic() - t0 < 2.0
+
+    def test_watchdog_fire_chain_aborts_blocked_collective(
+            self, _p2p_env, monkeypatch):
+        """CommWatchdog.on_fire -> collective.abort: a step stuck in a
+        host-channel collective is interrupted in watchdog-bounded (not
+        PADDLE_P2P_TIMEOUT-bounded) time."""
+        from paddle_tpu.distributed.watchdog import CommWatchdog
+        monkeypatch.setenv("PADDLE_P2P_TIMEOUT", "60")
+        wd = CommWatchdog(timeout=0.3, on_timeout="warn")
+        fired = []
+        wd.add_on_fire(lambda name, el: fired.append(name))
+        wd.add_on_fire(lambda name, el: collective.abort(
+            f"watchdog fired on {name}", source="watchdog"))
+
+        def stuck_step():
+            collective.recv(paddle.to_tensor(np.zeros(2)), src=1)
+
+        t0 = time.monotonic()
+        try:
+            with pytest.warns(RuntimeWarning):
+                with pytest.raises(collective.CollectiveAborted):
+                    wd.wrap(stuck_step, name="stuck")()
+        finally:
+            wd.shutdown()
+        assert time.monotonic() - t0 < 10   # << PADDLE_P2P_TIMEOUT
+        assert fired == ["stuck"]           # earlier hooks still ran
+
+    def test_generation_bump_fires_listener(self):
+        master, ep = _master(world=1)
+        try:
+            mm = MembershipManager(ep, rank=0, interval=0.05)
+            seen = []
+            mm.add_generation_listener(seen.append)
+            mm.start_heartbeat()
+            deadline = time.time() + 5
+            while mm.last_generation() != 0 and time.time() < deadline:
+                time.sleep(0.02)
+            assert seen == []               # initial sync is no change
+            master._bump(None, "peer death")
+            deadline = time.time() + 5
+            while not seen and time.time() < deadline:
+                time.sleep(0.02)
+            assert seen == [1]
+            mm.stop()
+        finally:
+            master.stop()
+
+    def test_supervised_loop_treats_abort_as_peer_failure(self,
+                                                          tmp_path):
+        """CollectiveAborted from inside a step must trigger coordinated
+        recovery WITHOUT burning restart budget (max_restarts=0), and
+        the latch must be cleared by the recovery barrier."""
+        master, ep = _master(world=1)
+        try:
+            mm = MembershipManager(ep, rank=0, interval=0.05, world=1)
+            em = ElasticManager(str(tmp_path / "ck"), save_interval=1,
+                                keep=20, max_restarts=0, membership=mm)
+            boom = {"armed": True}
+
+            def step(state, s):
+                if s == 3 and boom.pop("armed", False):
+                    collective.abort("simulated blocked collective",
+                                     source="test")
+                    raise collective.CollectiveAborted("simulated")
+                return _exact_step(state, s)
+
+            losses = em.run(_state_factory(), step, 6)
+            assert len(losses) == 6
+            assert collective.abort_requested() is None  # latch cleared
+            probe = _state_factory()()
+            assert em.restore(probe) == 6
+            np.testing.assert_array_equal(
+                np.asarray(probe["w"].numpy()), _expected_w(6))
+        finally:
+            master.stop()
+
+
+# -- ISSUE 13: sampler seed-consensus re-arm + remesh on GROW -----------------
+
+class TestScaleUpResharding:
+    def test_update_world_rearms_seed_consensus_on_grow(self,
+                                                        monkeypatch):
+        import paddle_tpu.io as pio
+        s = DistributedBatchSampler(list(range(8)), batch_size=2,
+                                    num_replicas=2, rank=0, shuffle=True)
+        s.update_world(1, 0)                # shrink: check disabled
+        assert s._seed_checked is True
+        monkeypatch.setattr(pio, "_all_gather_seeds",
+                            lambda base: [1234, 999])
+        list(iter(s))                       # no gather, no raise
+        s.update_world(2, 0)                # GROW: check re-armed
+        assert s._seed_checked is False
+        with pytest.raises(RuntimeError, match="differs across ranks"):
+            list(iter(s))
+
+    def test_update_world_same_size_keeps_check_disabled(self):
+        s = DistributedBatchSampler(list(range(8)), batch_size=2,
+                                    num_replicas=2, rank=0, shuffle=True)
+        s.update_world(2, 1)                # pure remap, no grow
+        assert s._seed_checked is True
+
+    def test_sharding_plan_remesh_grow_rederives_for_larger_world(self):
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+        from paddle_tpu.distributed.sharding import ShardingPlan
+        devs = np.asarray(jax.devices())
+        small = ShardingPlan(Mesh(devs[:4].reshape(4), ("dp",)), stage=1)
+        small.pspecs["fc.w"] = P(None, "dp")
+        grown = small.remesh(Mesh(devs.reshape(8), ("dp",)))
+        assert grown.mesh.shape["dp"] == 8
+        assert grown.stage == 1
+        assert grown.data_axes == ("dp",)
+        assert grown.pspecs == small.pspecs
+        # batch-spec divisibility re-validation: a batch divisible by
+        # the grown axis shards; the spec itself is mesh-agnostic
+        arr = np.zeros((8, 16), np.float32)
+        assert tuple(grown.batch_spec(arr)) == ("dp",)
+        # grow from a DEGENERATE (1-device) mesh re-acquires the axis
+        solo = small.remesh(Mesh(devs[:1].reshape(1), ("dp",)))
+        regrown = solo.remesh(Mesh(devs.reshape(8), ("dp",)))
+        assert regrown.mesh.shape["dp"] == 8
+        assert tuple(regrown.batch_spec(arr)) == ("dp",)
+
+    def test_prefetcher_refreshes_active_plan_after_grow(self):
+        """DevicePrefetcher consults the ACTIVE plan at stage time: a
+        grow remesh registered as the active plan moves staging onto
+        the larger mesh, and an indivisible batch falls back unsharded
+        (counted) instead of poisoning the epoch."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from paddle_tpu.distributed.sharding import ShardingPlan
+        from paddle_tpu.io.prefetch import DevicePrefetcher, \
+            set_active_plan
+        devs = np.asarray(jax.devices())
+        small = ShardingPlan(Mesh(devs[:4].reshape(4), ("dp",)))
+        grown = small.remesh(Mesh(devs.reshape(8), ("dp",)))
+        try:
+            def stage_one(batch):
+                return next(iter(DevicePrefetcher([batch],
+                                                  prefetch_factor=1)))
+
+            set_active_plan(small)
+            x = {"x": paddle.to_tensor(np.zeros((8, 4), np.float32))}
+            staged = stage_one(x)
+            assert staged["x"].data.sharding == NamedSharding(
+                small.mesh, P("dp"))
+            # active-plan refresh: the grown plan takes over staging
+            set_active_plan(grown)
+            staged = stage_one(x)
+            assert staged["x"].data.sharding == NamedSharding(
+                grown.mesh, P("dp"))
+            # divisibility re-validation: leading dim 4 shards on the
+            # 4-way mesh but NOT on the grown 8-way one -> fallback
+            y = {"x": paddle.to_tensor(np.zeros((4, 4), np.float32))}
+            with pytest.warns(UserWarning, match="not placeable"):
+                import paddle_tpu.io.prefetch as pf
+                pf._fallback_warned = False
+                staged = stage_one(y)
+            assert staged["x"].data.sharding != NamedSharding(
+                grown.mesh, P("dp"))
+        finally:
+            set_active_plan(None)
+
+
+# -- ISSUE 13: launch-level scale-up plumbing ---------------------------------
+
+class TestSupervisorScaleUp:
+    def test_parse_rejoin_and_journal_flags(self, tmp_path):
+        from paddle_tpu.distributed.launch.main import (
+            _master_journal_path, _parse)
+        a = _parse(["--elastic_level", "1", "--degrade_after", "1",
+                    "--rejoin_after", "2.5", "--log_dir",
+                    str(tmp_path), "s.py"])
+        assert a.rejoin_after == 2.5
+        assert _master_journal_path(a) == \
+            str(tmp_path / "elastic_master.journal")
+        b = _parse(["--master_journal", "/tmp/x.journal", "s.py"])
+        assert _master_journal_path(b) == "/tmp/x.journal"
+        c = _parse(["s.py"])
+        assert c.rejoin_after is None
+        assert _master_journal_path(c).endswith(".journal")
+
+    def test_spawn_master_env_scopes_fault_schedule(self, tmp_path):
+        """The master subprocess must see a chaos schedule ONLY via
+        PADDLE_ELASTIC_MASTER_FAULT (first incarnation), never the
+        workers' FLAGS_fault_inject."""
+        from paddle_tpu.distributed.launch import main as lm
+
+        captured = {}
+
+        class _FakeProc:
+            pass
+
+        def fake_popen(cmd, env=None, stdout=None, stderr=None):
+            captured["cmd"], captured["env"] = cmd, env
+            return _FakeProc()
+
+        orig = lm.subprocess.Popen
+        lm.subprocess.Popen = fake_popen
+        try:
+            args = lm._parse(["--elastic_level", "1", "--log_dir",
+                              str(tmp_path), "s.py"])
+            env = {"FLAGS_fault_inject": "elastic.heartbeat:crash@5",
+                   "PADDLE_ELASTIC_MASTER_FAULT":
+                       "elastic.master_serve:crash@9"}
+            lm._spawn_master(args, env, "127.0.0.1:1", 2, 0)
+            e0 = captured["env"]
+            assert e0["FLAGS_fault_inject"] == \
+                "elastic.master_serve:crash@9"
+            assert e0["PADDLE_ELASTIC_WORLD"] == "2"
+            assert e0["PADDLE_ELASTIC_JOURNAL"] == \
+                str(tmp_path / "elastic_master.journal")
+            assert captured["cmd"][1:] == \
+                ["-m", "paddle_tpu.distributed.elastic_master"]
+            # incarnation 1 (the respawn) must NOT re-arm the crash
+            lm._spawn_master(args, env, "127.0.0.1:1", 2, 1)
+            assert "FLAGS_fault_inject" not in captured["env"]
+        finally:
+            lm.subprocess.Popen = orig
+
+    def test_stale_journal_from_previous_job_cleared_at_start(
+            self, tmp_path, monkeypatch):
+        """A journal left by a PREVIOUS run reusing --log_dir must not
+        seed the new job's master with the old run's generation and
+        completed set (instantly-releasing barriers)."""
+        from paddle_tpu.distributed.launch.main import launch
+        journal = tmp_path / "elastic_master.journal"
+        journal.write_text(json.dumps(
+            {"generation": 7, "completed": [0], "abandoned": [],
+             "dead": {}, "released": {}}))
+        script = tmp_path / "ok.py"
+        script.write_text("print('ok')\n")
+        monkeypatch.setenv("PADDLE_ELASTIC_ENDPOINT",
+                           f"127.0.0.1:{_free_port()}")
+        rc = launch(["--elastic_level", "1", "--max_restart", "0",
+                     "--log_dir", str(tmp_path), str(script)])
+        assert rc == 0
+        if journal.exists():
+            # only THIS job's state may be in it (the worker's own
+            # "done" can legitimately land); generation 7 must not
+            data = json.loads(journal.read_text())
+            assert data.get("generation", 0) == 0, data
+
+
+# -- ISSUE 13 chaos drills (slow gate: tools/run_chaos_suite.py) --------------
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.timeout(240)
+def test_chaos_rejoin_world_grows_back_bitwise(tmp_path):
+    """ISSUE 13 acceptance (scale-up): SIGKILL rank 1 mid-step with NO
+    restart budget -> degrade to world 1 -> keep training -> the
+    supervisor's rejoin probe relaunches rank 1 -> grow generation ->
+    world re-forms at 2 -> both ranks finish with weights bitwise-equal
+    to an uninterrupted run."""
+    d = str(tmp_path)
+    total = 160
+    rc, out = _run_supervisor(
+        d, [d, str(total), "1", "elastic.heartbeat:crash@15"],
+        max_restart=0, degrade_after=0.2, rejoin_after=1.0)
+    assert rc == 0, out[-4000:]
+
+    evs = _sup_events(d)
+    kinds = [e["ev"] for e in evs]
+    assert "degrade" in kinds
+    assert "rejoin_probe" in kinds
+    assert "rejoined" in kinds, kinds
+    rejoined = next(e for e in evs if e["ev"] == "rejoined")
+    assert rejoined["rank"] == 1 and rejoined["incarnation"] >= 1
+
+    recs = _done_records(d)
+    assert set(recs) == {0, 1}, (list(recs), out[-3000:])
+    exp = _expected_w(total).tolist()
+    for r, rec in recs.items():
+        assert rec["w"] == exp, (r, rec["w"], exp)
+        assert rec["final_step"] == total
+    # the survivor degraded to world 1, then GREW back to world 2
+    assert recs[0]["events"] == [{"world": 1, "rank": 0},
+                                 {"world": 2, "rank": 0}]
+    # after the grow it owns only its half of the index space again
+    assert sorted(recs[0]["my_indices"]) == list(range(0, 16, 2))
+    assert sorted(recs[1]["my_indices"]) == list(range(1, 16, 2))
+    # telemetry: grow + degrade counted on the survivor, the re-admitted
+    # incarnation counted its rejoin
+    c0 = recs[0]["counters"]
+    assert any(v >= 1 for v in
+               c0.get("elastic.degraded_total", {}).values()), c0
+    assert any(v >= 1 for v in
+               c0.get("elastic.grown_total", {}).values()), c0
+    assert recs[1]["incarnation"] >= 1
+    c1 = recs[1]["counters"]
+    assert any(v >= 1 for v in
+               c1.get("elastic.rejoins_total", {}).values()), c1
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.timeout(240)
+def test_chaos_blocked_collective_aborts_watchdog_bounded(tmp_path):
+    """ISSUE 13 acceptance (collective abort): rank 0 is parked INSIDE
+    an in-flight host-channel collective (recv with PADDLE_P2P_TIMEOUT
+    600s >> FLAGS_comm_timeout 120s) when its peer is SIGKILLed. The
+    generation bump must interrupt the wait via collective.abort in
+    heartbeat-bounded time and the job must still finish bitwise."""
+    total = 60
+    # the scenario's RECOVERY is deterministic (asserted on every
+    # attempt below); whether the abort lands while the survivor is
+    # INSIDE recv — vs the between-step generation check winning first —
+    # has an irreducible ~5% timing race, so the in-flight-interruption
+    # observation gets up to 3 attempts (miss^3 ~ 1e-4)
+    blocked = {}
+    for attempt in range(3):
+        d = str(tmp_path / f"try{attempt}")
+        os.makedirs(d, exist_ok=True)
+        rc, out = _run_supervisor(
+            d, [d, str(total), "1", "elastic.heartbeat:crash@20", "p2p"],
+            max_restart=2,
+            extra_env={"PADDLE_P2P_TIMEOUT": "600",
+                       "PADDLE_P2P_BASE_PORT": str(_free_port_pair())})
+        assert rc == 0, out[-4000:]
+
+        recs = _done_records(d)
+        assert set(recs) == {0, 1}, (list(recs), out[-3000:])
+        exp = _expected_w(total).tolist()
+        for r, rec in recs.items():
+            assert rec["w"] == exp, (r, rec["w"], exp)
+            assert rec["final_step"] == total
+        blocked = recs[0]["blocked"]
+        if "aborted_after" in blocked:
+            break
+    # the survivor really was parked in the collective and was aborted
+    assert "aborted_after" in blocked, (blocked, out[-3000:])
+    # recovery-latency budget: the abort lands in heartbeat/watchdog-
+    # bounded time — far below both the 600s p2p wait and the 120s
+    # comm timeout it would otherwise ride out
+    assert blocked["aborted_after"] < 30.0, blocked
+    # ...and the world re-formed promptly after the abort (barrier wait
+    # + peer relaunch, still nowhere near comm-timeout-bounded)
+    assert blocked.get("resumed_after", 0.0) < 90.0, blocked
+    c0 = recs[0]["counters"]
+    assert any(v >= 1 for v in
+               c0.get("collective.aborts_total", {}).values()), c0
+    assert any(v >= 1 for v in
+               c0.get("elastic.recoveries_total", {}).values()), c0
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.timeout(240)
+def test_chaos_master_sigkill_is_a_blip(tmp_path):
+    """ISSUE 13 acceptance (master resilience): the elastic master is
+    SIGKILLed mid-job (elastic.master_serve:crash). The supervisor must
+    restart it from the journal; heartbeats and barriers resume with NO
+    survivor restart and the job finishes bitwise."""
+    d = str(tmp_path)
+    total = 80
+    rc, out = _run_supervisor(
+        d, [d, str(total)],
+        extra_env={"PADDLE_ELASTIC_MASTER_FAULT":
+                   "elastic.master_serve:crash@100",
+                   "PADDLE_ELASTIC_CALL_TIMEOUT": "30"})
+    assert rc == 0, out[-4000:]
+
+    evs = _sup_events(d)
+    kinds = [e["ev"] for e in evs]
+    assert "master_spawn" in kinds
+    assert "master_death" in kinds, kinds
+    assert "master_relaunch" in kinds, kinds
+    death = next(e for e in evs if e["ev"] == "master_death")
+    assert death["rc"] == 137               # SIGKILL parity
+    # NO worker was restarted: the outage was a blip for the trainers
+    assert "worker_death" not in kinds, kinds
+    assert "relaunch" not in kinds, kinds
+
+    recs = _done_records(d)
+    assert set(recs) == {0, 1}, (list(recs), out[-3000:])
+    exp = _expected_w(total).tolist()
+    for r, rec in recs.items():
+        assert rec["w"] == exp, (r, rec["w"], exp)
+        assert rec["final_step"] == total
+        assert rec["incarnation"] == 0      # never relaunched
+        assert rec["losses_len"] == total
+        assert not rec["events"]            # world never changed
+        # generation never moved: restored from the journal, no rank
+        # ever parked at a recovery barrier mid-job
+        assert rec["generation"] == 0
+    # the restarted master kept serving: the job ran to completion with
+    # no coordinated recoveries on either rank
+    for r, rec in recs.items():
+        recov = rec["counters"].get("elastic.recoveries_total", {})
+        assert all(v == 0 for v in recov.values()), (r, recov)
+
+
+# -- ISSUE 13 review fixes: regression pins -----------------------------------
+
+class TestReviewFixes:
+    def test_recv_discards_stale_generation_payloads(self, _p2p_env,
+                                                     monkeypatch):
+        """A payload still in flight from a peer that had not parked
+        yet lands AFTER the abort-time drain: the generation stamp must
+        make recv discard it instead of pairing it into the re-formed
+        world."""
+        monkeypatch.setenv("PADDLE_P2P_TIMEOUT", "10")
+        collective._ensure_p2p_server()
+        try:
+            collective.note_world_generation(5)
+            collective._p2p_inbox[1].put((np.full(2, 1.0), 4))  # stale
+            collective._p2p_inbox[1].put((np.full(2, 2.0), 5))  # current
+            got = collective.recv(paddle.to_tensor(np.zeros(2)), src=1)
+            np.testing.assert_array_equal(
+                np.asarray(got.numpy()), np.full(2, 2.0))
+            # unsupervised / untagged channel: nothing is ever dropped
+            collective.note_world_generation(None)
+            collective._p2p_inbox[1].put((np.full(2, 3.0), None))
+            got = collective.recv(paddle.to_tensor(np.zeros(2)), src=1)
+            np.testing.assert_array_equal(
+                np.asarray(got.numpy()), np.full(2, 3.0))
+        finally:
+            collective.note_world_generation(None)
+
+    def test_watchdog_abort_without_bump_forces_new_generation(
+            self, tmp_path):
+        """An abort with NO observed generation bump (watchdog-sourced
+        local stall) must force a NEW generation — re-arriving at the
+        current one would hand back the CACHED release and silently
+        rewind this rank past its peers."""
+        master, ep = _master(world=1)
+        try:
+            mm = MembershipManager(ep, rank=0, interval=0.05, world=1)
+            em = ElasticManager(str(tmp_path / "ck"), save_interval=1,
+                                keep=20, max_restarts=0, membership=mm)
+            boom = {"armed": True}
+
+            def step(state, s):
+                if s == 3 and boom.pop("armed", False):
+                    raise collective.CollectiveAborted("local stall")
+                return _exact_step(state, s)
+
+            losses = em.run(_state_factory(), step, 6)
+            assert len(losses) == 6
+            # the recovery re-agreed under a FRESH generation whose
+            # release reflects the rank's actual progress (step 3),
+            # not generation 0's cached resume_step=0
+            assert master._generation == 1
+            assert master._released[1]["resume_step"] == 3
+        finally:
+            master.stop()
+
+    def test_world_info_carries_awaited_for_probe_liveness(self):
+        master, ep = _master(world=2)
+        try:
+            info = master._world_info()
+            assert info["awaited"] == 2
+            master._handle(("done", 0))
+            master._abandon(1)
+            # everyone either finished or is degraded away: probing an
+            # abandoned rank back in would re-grow a finished job
+            assert master._world_info()["awaited"] == 0
+        finally:
+            master.stop()
+
+    def test_master_journal_path_stable_across_respawns(self):
+        """Without --log_dir the journal path must be minted ONCE — a
+        respawned master re-deriving it would restore nothing."""
+        from paddle_tpu.distributed.launch.main import (
+            _master_journal_path, _parse)
+        a = _parse(["s.py"])
+        assert _master_journal_path(a) != _master_journal_path(a)
+        # ...which is exactly why _supervise computes it once and
+        # passes the SAME path to every _spawn_master incarnation
+        import inspect
+        from paddle_tpu.distributed.launch import main as lm
+        src = inspect.getsource(lm._supervise)
+        assert "master_journal = _master_journal_path(args)" in src
+
+    def test_ghost_rank_guard_exits_for_relaunch(self, tmp_path,
+                                                 monkeypatch):
+        """A relaunch whose rejoin was NOT admitted (lost to a master
+        restart from a pre-rejoin journal) must DIE with
+        ELASTIC_EXIT_CODE — a swallowable exception would fall into the
+        local-fault handler and train the ghost to completion."""
+        from paddle_tpu.distributed.elastic import ELASTIC_EXIT_CODE
+        master, ep = _master(world=1)
+        try:
+            master._abandon(0)
+            mm = MembershipManager(ep, rank=0, interval=0.05, world=1)
+            monkeypatch.setattr(
+                mm, "rejoin",
+                lambda: {"gen": master._generation,
+                         "readmitted": False})
+            em = ElasticManager(str(tmp_path / "ck"), save_interval=1,
+                                max_restarts=3, membership=mm)
+            with pytest.raises(SystemExit) as ei:
+                em.run(_state_factory(), _exact_step, 4)
+            assert ei.value.code == ELASTIC_EXIT_CODE
+            # no ghost training happened: nothing was checkpointed
+            assert not list((tmp_path / "ck").glob("step_*"))
+        finally:
+            master.stop()
+
+    def test_world_info_completed_distinguishes_total_outage(self):
+        """awaited==0 alone is ambiguous: 'everyone finished' (stop
+        probing) vs 'everyone abandoned' (total outage — keep probing).
+        The completed count disambiguates."""
+        master, ep = _master(world=2)
+        try:
+            master._abandon(0)
+            master._abandon(1)
+            info = master._world_info()
+            assert info["awaited"] == 0 and info["completed"] == 0
+            # total outage: the supervisor must KEEP probing
+        finally:
+            master.stop()
+
+    def test_partial_grow_keeps_seed_consensus_disabled(self,
+                                                        monkeypatch):
+        """Growing 1 -> 2 on a 3-process job is a PARTIAL grow: the
+        whole-world gather would hang on the still-abandoned process,
+        so no member may re-arm the check until the world is full."""
+        import jax as _jax
+        s = DistributedBatchSampler(list(range(9)), batch_size=3,
+                                    num_replicas=3, rank=0, shuffle=True)
+        s.update_world(1, 0)
+        monkeypatch.setattr(_jax, "process_count", lambda: 3)
+        s.update_world(2, 0)                # partial grow
+        assert s._seed_checked is True
+        s.update_world(3, 0)                # full grow: re-armed
+        assert s._seed_checked is False
+
+    def test_abort_wiring_is_idempotent_across_runs(self, tmp_path):
+        """run() twice on the same membership must not stack duplicate
+        generation listeners (each would fire collective.abort forever
+        after)."""
+        master, ep = _master(world=1)
+        try:
+            mm = MembershipManager(ep, rank=0, interval=0.05, world=1)
+            em = ElasticManager(str(tmp_path / "ck"), save_interval=2,
+                                max_restarts=0, membership=mm)
+            assert len(em.run(_state_factory(), _exact_step, 3)) == 3
+            n = len(mm._gen_listeners)
+            assert len(em.run(_state_factory(), _exact_step, 3)) == 3
+            assert len(mm._gen_listeners) == n == 1
+        finally:
+            master.stop()

@@ -353,6 +353,7 @@ class TestElasticRecovery:
 
 # -- acceptance: subprocess chaos --------------------------------------------
 
+@pytest.mark.chaos
 @pytest.mark.timeout(180)
 def test_crash_mid_save_resume_bitwise_subprocess(tmp_path):
     """FLAGS_fault_inject=ckpt.write_shard:crash@2: the worker dies

@@ -93,6 +93,47 @@ class TestMergeSemantics:
         assert fresh["incarnation=1,rank=1"] == 1.0
         assert "federation.last_seen_ts" in merged["gauges"]
 
+    def test_superseded_incarnation_stale_immediately_on_rejoin(self):
+        """ISSUE 13: a re-admitted rank's NEW incarnation must flip the
+        grown world into /metrics within one scrape — the abandoned
+        incarnation goes stale the moment its successor publishes, even
+        if its last snapshot is still inside the stale_after window."""
+        now = 1000.0
+        merged = federation.merge_snapshots([
+            # dead incarnation's final snapshot is only 2s old: the
+            # time-based rule alone would keep it "fresh" for 8 more
+            _snap(1, 0, now - 2.0, counters={"c.total": {"": 10}}),
+            _snap(1, 1, now, counters={"c.total": {"": 1}}),
+            _snap(0, 0, now - 2.0, counters={"c.total": {"": 7}}),
+        ], stale_after=10.0, now=now)
+        fresh = merged["gauges"]["federation.snapshot_fresh"]
+        assert fresh["incarnation=0,rank=1"] == 0.0  # superseded NOW
+        assert fresh["incarnation=1,rank=1"] == 1.0
+        # other ranks keep the pure time-based rule
+        assert fresh["incarnation=0,rank=0"] == 1.0
+        # counters still sum across both incarnations (monotone totals)
+        assert merged["counters"]["c.total"][""] == 18
+
+    def test_health_prefers_newest_incarnation_over_newest_ts(self):
+        """A rejoined rank's first snapshot may carry an OLDER ts than
+        the dead incarnation's last flush (clock skew, slow boot): rank
+        health must still follow the newest INCARNATION."""
+        fed = federation.FederationServer.__new__(
+            federation.FederationServer)
+        fed.snapshot_dir = "/nonexistent"
+        fed.stale_after = 10.0
+        fed.status_provider = None
+        now = time.time()
+        snaps = [_snap(1, 1, now - 1.0), _snap(1, 0, now - 0.5)]
+        orig = federation.read_snapshots
+        federation.read_snapshots = lambda src: snaps
+        try:
+            health = fed.health()
+        finally:
+            federation.read_snapshots = orig
+        assert health["ranks"]["1"]["incarnation"] == "1"
+        assert health["ranks"]["1"]["fresh"] is True
+
     def test_merged_snapshot_renders_as_prometheus(self):
         merged = federation.merge_snapshots(
             [_snap(0, 0, 1000.0, counters={"c.total": {"": 5}})],
@@ -193,6 +234,7 @@ class TestFederationServer:
 
 # -- acceptance: 2-process launch, SIGKILL mid-scrape ------------------------
 
+@pytest.mark.chaos
 @pytest.mark.timeout(240)
 def test_two_process_federated_metrics_survive_rank_kill(tmp_path):
     """ISSUE 11 acceptance: `launch --elastic_level 1 --metrics_port`

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Run every `chaos`-marked pytest drill as its own gate (ISSUE 13).
+
+The subprocess chaos drills — elastic kill/degrade/rejoin, master kill,
+blocked-collective abort, federation churn, checkpoint crash-resume —
+each spawn a supervisor plus worker (plus master) process tree and take
+tens of seconds. Running them inside tier-1 would bloat the gate and a
+single wedged drill would eat the whole suite's budget, so they carry
+the `chaos` pytest marker (the slowest also carry `slow`, which tier-1
+excludes) and THIS runner executes them as a separate gate:
+
+- each test node runs in its OWN `pytest` subprocess (one wedged drill
+  cannot poison another's module state or heartbeat threads),
+- with a per-test wall-clock timeout (--timeout, default 300 s; the
+  process tree is killed on overrun),
+- appending one JSON line per test to --out (default
+  chaos_summary.jsonl): nodeid, status, rc, seconds — machine-readable
+  for a CI annotation or trend dashboard.
+
+Exit code: 0 when every drill passed, 1 otherwise.
+
+    JAX_PLATFORMS=cpu python tools/run_chaos_suite.py
+    python tools/run_chaos_suite.py -k rejoin --timeout 180
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def collect(args) -> list:
+    """Chaos-marked test node ids, via pytest's own collector so marker
+    expressions / -k filters behave exactly as they would in CI."""
+    cmd = [sys.executable, "-m", "pytest", "tests/", "-m", "chaos",
+           "--collect-only", "-q", "-p", "no:cacheprovider",
+           "--disable-warnings"]     # a warnings summary echoes node
+    if args.k:                       # ids and would duplicate drills
+        cmd += ["-k", args.k]
+    r = subprocess.run(cmd, cwd=str(REPO), env=_env(),
+                       capture_output=True, text=True)
+    nodes = []
+    for line in r.stdout.splitlines():
+        line = line.strip()
+        # node ids are `path::test`; summary/blank lines are not
+        if "::" in line and not line.startswith(("=", "<")):
+            node = line.split(" ")[0]
+            if node not in nodes:    # belt: never queue a drill twice
+                nodes.append(node)
+    return nodes
+
+
+def _env():
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def run_one(nodeid: str, timeout: float) -> dict:
+    t0 = time.monotonic()
+    # start_new_session: a timeout must kill the drill's WHOLE process
+    # tree (supervisor + workers + master), not just the pytest shim
+    p = subprocess.Popen(
+        [sys.executable, "-m", "pytest", nodeid, "-q",
+         "-p", "no:cacheprovider"],
+        cwd=str(REPO), env=_env(), start_new_session=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        out, _ = p.communicate(timeout=timeout)
+        rc = p.returncode
+        status = "passed" if rc == 0 else "failed"
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        out, _ = p.communicate()
+        rc, status = -1, "timeout"
+    rec = {"nodeid": nodeid, "status": status, "rc": rc,
+           "seconds": round(time.monotonic() - t0, 2)}
+    if status != "passed":
+        rec["tail"] = out.decode(errors="replace")[-2000:]
+    return rec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="run every chaos-marked drill in its own process "
+                    "with a per-test timeout and a JSONL summary")
+    ap.add_argument("--out", default="chaos_summary.jsonl")
+    ap.add_argument("--timeout", type=float, default=300.0,
+                    help="per-test wall clock bound in seconds")
+    ap.add_argument("-k", default=None,
+                    help="pytest -k expression to filter drills")
+    args = ap.parse_args(argv)
+
+    nodes = collect(args)
+    if not nodes:
+        print("run_chaos_suite: no chaos-marked tests collected",
+              file=sys.stderr)
+        return 1
+    print(f"run_chaos_suite: {len(nodes)} drill(s), "
+          f"{args.timeout:.0f}s each max -> {args.out}")
+    failed = 0
+    with open(args.out, "w") as f:
+        for n in nodes:
+            rec = run_one(n, args.timeout)
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            mark = "ok " if rec["status"] == "passed" else "FAIL"
+            print(f"  [{mark}] {rec['seconds']:7.1f}s {n}")
+            if rec["status"] != "passed":
+                failed += 1
+    print(f"run_chaos_suite: {len(nodes) - failed}/{len(nodes)} passed")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
