@@ -7,7 +7,13 @@ trace vs the SLO engine with inert defaults) and an OVERLOAD scenario
 (arrival rate ~2x capacity, mixed priorities) guarding that
 high-priority p99 TTFT beats the FIFO baseline by >= SLO_MIN_TTFT_RATIO
 and that zero requests wedge: every accepted submit terminates in
-served / shed / deadline-missed.
+served / shed / deadline-missed. ISSUE 15 adds the self-speculative
+scenario pair: a copy-heavy workload guarding FLAGS_speculative >=
+SPEC_MIN_SPEEDUP tokens/s over the non-speculative engine with
+token-identical greedy outputs (acceptance telemetry in the artifact),
+and an adversarial near-zero-acceptance workload guarding a bounded
+<= SPEC_MAX_REGRESSION regression (adaptive draft length must back
+off).
 
 The workload is the serving pathology the ISSUE names: short
 conversations are DECODING when long prompts arrive mid-run. The
@@ -225,6 +231,66 @@ def run_prefix(model, jobs, cache_on):
     return out
 
 
+# -- ISSUE 15: self-speculative decoding scenario ----------------------------
+
+SPEC_MIN_SPEEDUP = float(os.environ.get("SPEC_MIN_SPEEDUP", "1.8"))
+SPEC_MAX_REGRESSION = float(os.environ.get("SPEC_MAX_REGRESSION", "0.10"))
+SPEC_DRAFT_TOKENS = int(os.environ.get("SPEC_DRAFT_TOKENS", "8"))
+
+
+def _spec_copy_workload():
+    """Copy-heavy decode traffic — the prompt-lookup sweet spot: every
+    prompt repeats a 12-token motif (the code/RAG/summarization shape
+    where output quotes input), and greedy decode of the bench model
+    settles into loops the drafter then predicts several tokens at a
+    time. Long generations, staggered arrivals, all four slots
+    decoding concurrently."""
+    rng = np.random.RandomState(5)
+    base = [int(t) for t in rng.randint(1, 256, 12)]
+    return [(2 * i, base * 2 + [int(t) for t in rng.randint(1, 256, 1)],
+             100) for i in range(4)]
+
+
+def _spec_adversarial_workload():
+    """Low-acceptance traffic: distinct fully-random prompts —
+    prefill-heavy, nothing for the drafter to copy, so almost every
+    draft is rejected and adaptive k must back off. The guard is a
+    bounded regression, not a win; the run is sized long enough
+    (24 requests) that container timing noise does not dominate the
+    ratio it guards."""
+    return [(i, [int(t) for t in
+                 np.random.RandomState(100 + i).randint(1, 256, 40)], 12)
+            for i in range(24)]
+
+
+def run_spec(model, jobs, spec_on):
+    """Drive a speculative-scenario workload (ragged regime, inert SLO
+    defaults, degradation pinned off like the parity runs) and report
+    tokens/s + acceptance telemetry."""
+    metrics.reset()
+    eng = ContinuousBatchingEngine(
+        model, max_batch=4, max_seq=MAX_SEQ, prefill_buckets=BUCKETS,
+        max_chunk_tokens=CHUNK, ragged=True, speculative=spec_on,
+        max_draft_tokens=SPEC_DRAFT_TOKENS, degrade_high_water=2.0)
+    w = GenerationRequest([3, 5], max_new_tokens=2)
+    eng.add_request(w)
+    while eng.has_work:
+        eng.step()
+    eng.finished.clear()
+    dt, reqs, ticks, _ = _drive(eng, jobs, max_ticks=6000)
+    tokens = sum(len(r.output) for r in reqs)
+    out = {"seconds": round(dt, 4), "tokens": tokens, "ticks": ticks,
+           "tokens_per_sec": round(tokens / dt, 2),
+           "outputs": [list(r.output) for r in reqs]}
+    if spec_on:
+        out["spec_drafted"] = eng.spec_drafted
+        out["spec_accepted"] = eng.spec_accepted
+        out["acceptance_rate"] = round(
+            eng.spec_accepted / eng.spec_drafted, 4) \
+            if eng.spec_drafted else 0.0
+    return out
+
+
 # -- ISSUE 10: overload scenario ---------------------------------------------
 
 def _overload_workload():
@@ -331,6 +397,27 @@ def main():
     # guard is deterministic), keep greedy outputs token-identical, and
     # prefill the shared pages EXACTLY once (7 beneficiaries x 48
     # prefix tokens of prefill work saved, to the token).
+    # ISSUE 15 guard — self-speculative decoding. Copy-heavy workload:
+    # FLAGS_speculative must multiply tokens/s >= SPEC_MIN_SPEEDUP with
+    # token-identical greedy outputs (acceptance telemetry recorded in
+    # the artifact). Adversarial workload: near-zero acceptance must
+    # cost <= SPEC_MAX_REGRESSION tokens/s (adaptive k backs off; the
+    # padded shape never changes, so a rejected draft is almost free).
+    cjobs = _spec_copy_workload()
+    spec_copy_off = run_spec(model, cjobs, spec_on=False)
+    spec_copy_on = run_spec(model, cjobs, spec_on=True)
+    spec_copy_identical = (spec_copy_off.pop("outputs")
+                           == spec_copy_on.pop("outputs"))
+    spec_speedup = (spec_copy_on["tokens_per_sec"]
+                    / spec_copy_off["tokens_per_sec"])
+    ajobs = _spec_adversarial_workload()
+    spec_adv_off = run_spec(model, ajobs, spec_on=False)
+    spec_adv_on = run_spec(model, ajobs, spec_on=True)
+    spec_adv_identical = (spec_adv_off.pop("outputs")
+                          == spec_adv_on.pop("outputs"))
+    spec_adv_ratio = (spec_adv_on["tokens_per_sec"]
+                      / spec_adv_off["tokens_per_sec"])
+
     prefix_toks, pjobs = _prefix_workload()
     pfx_off = run_prefix(model, pjobs, cache_on=False)
     pfx_on = run_prefix(model, pjobs, cache_on=True)
@@ -361,6 +448,27 @@ def main():
             "slo": slo_over,
             "hi_prio_p99_ttft_ratio": round(ttft_ratio, 2),
             "min_ttft_ratio": MIN_TTFT_RATIO,
+        },
+        "speculative": {
+            "draft_tokens": SPEC_DRAFT_TOKENS,
+            "copy_heavy": {
+                "workload": {"requests": len(cjobs), "motif_tokens": 12,
+                             "max_new_tokens": 100},
+                "off": spec_copy_off,
+                "on": spec_copy_on,
+                "speedup": round(spec_speedup, 2),
+                "min_speedup": SPEC_MIN_SPEEDUP,
+                "token_identical_outputs": bool(spec_copy_identical),
+            },
+            "adversarial": {
+                "workload": {"requests": len(ajobs),
+                             "prompt_tokens": 40, "max_new_tokens": 12},
+                "off": spec_adv_off,
+                "on": spec_adv_on,
+                "tokens_per_sec_ratio": round(spec_adv_ratio, 3),
+                "max_regression": SPEC_MAX_REGRESSION,
+                "token_identical_outputs": bool(spec_adv_identical),
+            },
         },
         "shared_prefix": {
             "workload": {"requests": len(pjobs),
@@ -403,6 +511,19 @@ def main():
     if ttft_ratio < MIN_TTFT_RATIO:
         print(f"FAIL: high-priority p99 TTFT ratio {ttft_ratio:.2f}x "
               f"< required {MIN_TTFT_RATIO}x", file=sys.stderr)
+        return 1
+    if not (spec_copy_identical and spec_adv_identical):
+        print("FAIL: speculative outputs diverge from the "
+              "non-speculative engine", file=sys.stderr)
+        return 1
+    if spec_speedup < SPEC_MIN_SPEEDUP:
+        print(f"FAIL: speculative copy-heavy speedup {spec_speedup:.2f}x "
+              f"< required {SPEC_MIN_SPEEDUP}x", file=sys.stderr)
+        return 1
+    if spec_adv_ratio < 1.0 - SPEC_MAX_REGRESSION:
+        print(f"FAIL: speculative adversarial tokens/s ratio "
+              f"{spec_adv_ratio:.3f} regresses more than "
+              f"{SPEC_MAX_REGRESSION:.0%}", file=sys.stderr)
         return 1
     if not prefix_identical:
         print("FAIL: prefix-cache outputs diverge from the uncached "

@@ -349,6 +349,18 @@ _flags: dict = {
     # FIFO scheduler exactly (same admission order, same preemption
     # victims, same compiled step signatures)
     "FLAGS_serving_slo": True,
+    # self-speculative decoding (chunked-prefill regime, greedy only):
+    # an n-gram prompt-lookup drafter proposes up to
+    # FLAGS_speculative_draft_tokens continuation tokens per decode
+    # slot, packed as q_len=k+1 verification rows into the SAME ragged
+    # step (and the same max_chunk_tokens row budget, so the compiled
+    # shape never changes); greedy argmax verification accepts the
+    # longest agreeing prefix and rolls rejected KV back exactly.
+    # FLAGS_speculative=0 is the kill switch: no drafting, single-token
+    # decode rows, outputs AND the per-tick scheduling trace bitwise
+    # the pre-speculation engine
+    "FLAGS_speculative": True,
+    "FLAGS_speculative_draft_tokens": 4,
     # prefix caching over the KV page pool (chunked-prefill regime
     # only): a content-hash index of fully-written prompt pages with
     # refcounted sharing, so a repeated system-prompt/few-shot prefix

@@ -13,11 +13,14 @@ serving image):
   `prompt` token ids, `max_new_tokens`, `priority`, `deadline_s`,
   `eos_token_id`, `stream`). `stream` (default true) answers
   Server-Sent Events over a close-delimited HTTP/1.0 body: one
-  `data: {"token": t}` frame per generated token, then a terminal
-  `event: end` (served) or `event: error` (failed / shed /
-  deadline_missed / cancelled) frame carrying the engine's terminal
-  status — the structured error frame contract. `stream: false`
-  collects and answers one JSON document.
+  `data: {"tokens": [...]}` frame per ENGINE TICK carrying every token
+  that tick produced for the request (speculative decoding makes
+  multi-token ticks the common case — batching per tick keeps the
+  write amplification at one syscall per tick instead of one per
+  token), then a terminal `event: end` (served) or `event: error`
+  (failed / shed / deadline_missed / cancelled) frame carrying the
+  engine's terminal status — the structured error frame contract.
+  `stream: false` collects and answers one JSON document.
 * Backpressure: `QueueFull` at submit becomes **429** with a
   `Retry-After` header from the engine's `retry_after_s` throughput
   hint; a draining gateway answers **503** the same way.
@@ -157,7 +160,8 @@ def build_engine(model, **knobs) -> ContinuousBatchingEngine:
 
 class _TokenStream:
     """Per-request event funnel from the tick thread to one handler
-    thread: ('token', id) frames then one ('end', status, error)."""
+    thread: ('tokens', [ids...]) frames — one per tick, carrying every
+    token that tick accepted — then one ('end', status, error)."""
 
     def __init__(self, req: GenerationRequest):
         self.req = req
@@ -286,15 +290,18 @@ class EngineRunner:
 
     def _dispatch(self):
         """Push newly generated tokens (and terminal status) to each
-        open stream; consume the engine's finished list so a
-        long-running server does not accumulate every request ever
-        served."""
+        open stream — ONE event per request per tick carrying every
+        token the tick accepted (the speculative engine routinely
+        lands several; per-token events would re-inflate them into
+        per-token socket writes downstream); consume the engine's
+        finished list so a long-running server does not accumulate
+        every request ever served."""
         done = []
         for rid, st in self._streams.items():
             out = st.req.output
-            while st.sent < len(out):
-                st.q.put(("token", out[st.sent]))
-                st.sent += 1
+            if st.sent < len(out):
+                st.q.put(("tokens", list(out[st.sent:])))
+                st.sent = len(out)
             if st.req.done:
                 st.q.put(("end", st.req.status, st.req.error))
                 done.append(rid)
@@ -503,9 +510,10 @@ class ServingGateway:
             self._collect(h, req, stream)
 
     def _stream_sse(self, h, req, stream):
-        """SSE over a close-delimited body: token frames as they land,
-        keepalive comments while decode is parked (they double as the
-        disconnect probe), one terminal end/error frame."""
+        """SSE over a close-delimited body: one tokens frame per tick
+        (all tokens that tick accepted), keepalive comments while
+        decode is parked (they double as the disconnect probe), one
+        terminal end/error frame."""
         t0 = time.perf_counter()
         code = "200"
         try:
@@ -524,10 +532,10 @@ class ServingGateway:
                     h.wfile.flush()
                     continue
                 fault_point("serving.http_request")
-                if ev[0] == "token":
+                if ev[0] == "tokens":
                     h.wfile.write(
                         b"data: " + json.dumps(
-                            {"token": ev[1]}).encode() + b"\n\n")
+                            {"tokens": ev[1]}).encode() + b"\n\n")
                     h.wfile.flush()
                     continue
                 _, status, error = ev

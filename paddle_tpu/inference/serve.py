@@ -56,6 +56,10 @@ def _build_parser():
                    help="queue bound behind the 429 backpressure path "
                         "(default: 8 * max_seq)")
     p.add_argument("--quantize", choices=("int8",), default=None)
+    p.add_argument("--max-draft-tokens", type=int, default=None,
+                   help="self-speculative draft-length cap (default "
+                        "FLAGS_speculative_draft_tokens; 0 disables "
+                        "drafting for this engine)")
     p.add_argument("--keepalive-s", type=float, default=0.5,
                    help="SSE keepalive interval (doubles as the "
                         "client-disconnect probe)")
@@ -85,6 +89,7 @@ def main(argv=None):
             page_size=args.page_size, total_pages=args.total_pages,
             max_chunk_tokens=args.max_chunk_tokens,
             max_queue_tokens=args.max_queue_tokens,
+            max_draft_tokens=args.max_draft_tokens,
             quantize=args.quantize)
         runner = gw.EngineRunner(engine)
     if os.path.exists(args.model + ".pdiparams") and \

@@ -84,6 +84,43 @@ is untouched. `FLAGS_prefix_cache=0` (or the bucketed regime) drops the
 index entirely — every page is refcount-1 and the allocator is
 bitwise the pre-cache free list.
 
+Self-speculative decoding (`FLAGS_speculative`, default on — ISSUE 15;
+ragged regime, greedy only): decode is the engine's throughput floor —
+one token per sequence per tick — and the ragged grid already treats a
+q_len=k decode row as a small prefill chunk, so multi-token
+verification rows are pure scheduling. An n-gram PROMPT-LOOKUP drafter
+(no draft model: match the last few tokens against the request's
+prompt + generated history, propose the continuation — the big win is
+code/RAG/summarization traffic where output quotes input, and the
+repetition loops greedy decoding falls into) proposes up to
+`max_draft_tokens` per decode slot; the scheduler packs (1 real + k
+draft) tokens as ONE q_len=k+1 row inside the SAME `max_chunk_tokens`
+row budget (prefill chunks are funded first; speculation spends only
+the leftover), so every tick still compiles to the ONE fixed padded
+shape. Verification compares the model's greedy argmax at each packed
+row with the draft fed at the next row and commits the longest
+agreeing prefix plus the bonus token from the first disagreement —
+exactly the tokens the non-speculative engine would have produced, so
+greedy outputs are token-identical by construction. KV already written
+for rejected rows is rolled back exactly: `kv_len` truncates via
+slot.length and pages past the new length return to the pool
+(refcount-aware — draft rows only ever write PAST the prompt, so a
+prefix-shared page is never touched). Acceptance telemetry
+(serving.spec_drafted_total / spec_accepted_total, acceptance-rate
+gauge, per-request counters) steers the draft length adaptively per
+slot: shrink on low acceptance, regrow after a hysteresis window of
+full-acceptance ticks (the chunk-budget idiom). `FLAGS_speculative=0`
+is a bitwise kill switch: no drafting, single-token decode rows, the
+pre-speculation compiled signatures and scheduling trace exactly.
+
+Cache-aware admission ordering (ISSUE 15 satellite — the vLLM
+cache-aware scheduling trick): `_admit_ragged` prefers the waiter
+whose prompt prefix is hot in the prefix cache (a side-effect-free
+probe, strictly subordinate to the SLO (priority, EDF) order and
+stable within equal keys), so admissions reuse cached pages instead of
+evicting them to prefill cold prompts. A cold cache, the bucketed
+regime, or `FLAGS_prefix_cache=0` keep pure FIFO.
+
 Weight-only int8 (PTQ) inference: `quantize="int8"` stores every 2-D
 projection as int8 + per-output-channel scale (the PTQ absmax rule,
 ref quantization post-training observers; inference int8 path
@@ -155,6 +192,20 @@ _PREFIX_RATIO = _metrics.gauge(
     "serving.prefix_reuse_ratio",
     "cumulative cacheable-prompt-pages served from the prefix cache "
     "(reused / seen)")
+_SPEC_DRAFTED = _metrics.counter(
+    "serving.spec_drafted_total",
+    "draft tokens proposed by the n-gram prompt-lookup drafter")
+_SPEC_ACCEPTED = _metrics.counter(
+    "serving.spec_accepted_total",
+    "draft tokens confirmed by greedy multi-row verification")
+_SPEC_RATE = _metrics.gauge(
+    "serving.spec_acceptance_rate",
+    "cumulative draft acceptance rate (accepted / drafted) across the "
+    "engine lifetime; per-request rates live on GenerationRequest")
+_CACHE_AWARE = _metrics.counter(
+    "serving.cache_aware_admits_total",
+    "admissions reordered ahead of FIFO because their prompt prefix "
+    "was hot in the prefix cache")
 
 
 class DeadlineExceeded(RuntimeError):
@@ -237,6 +288,15 @@ class GenerationRequest:
     first_token_s: Optional[float] = None
     status: str = "queued"
     error: Optional[str] = None
+    # speculative-decoding bookkeeping (ISSUE 15): how many draft
+    # tokens this request's slot proposed / had confirmed — the
+    # per-request acceptance-rate view behind the engine-wide gauge
+    spec_drafted: int = 0
+    spec_accepted: int = 0
+    # cache-aware admission bookkeeping: how many times a hotter-prefix
+    # waiter was admitted ahead of this one — bounded by the engine's
+    # cache_jump_limit so heat can never starve a cold request
+    admit_bypassed: int = 0
 
     @property
     def done(self) -> bool:
@@ -252,7 +312,8 @@ class GenerationRequest:
 
 class _Slot:
     __slots__ = ("req", "length", "produced", "last_token", "admit_seq",
-                 "pending", "prefix_tokens", "cache_upto", "cache_key")
+                 "pending", "prefix_tokens", "cache_upto", "cache_key",
+                 "spec_k", "spec_calm")
 
     def __init__(self):
         self.req: Optional[GenerationRequest] = None
@@ -268,6 +329,12 @@ class _Slot:
         self.prefix_tokens: List[int] = []
         self.cache_upto = 0
         self.cache_key = b""
+        # speculative decoding: this slot's CURRENT draft-length cap
+        # (adaptive: shrinks on low acceptance, regrows after spec_
+        # hysteresis consecutive full-acceptance ticks) and the calm
+        # counter driving the regrowth
+        self.spec_k = 0
+        self.spec_calm = 0
 
     @property
     def free(self):
@@ -411,6 +478,12 @@ class _PrefixCache:
         # children of the chain root (parent key b"")
         self._root_children: set = set()
         self._clock = 0
+        # bumped only when cached entries are DROPPED — the
+        # invalidation key for admission-ordering probe memos. An
+        # insert can only make a waiter hotter, so a memoized count
+        # stays a valid lower bound; a drop can overstate heat, which
+        # is the case that must force a re-probe
+        self.epoch = 0
         self.hits = 0
         self.misses = 0
         self.pages_reused = 0
@@ -462,6 +535,23 @@ class _PrefixCache:
         if self.pages_seen:
             _PREFIX_RATIO.set(self.pages_reused / self.pages_seen)
         return pages, key
+
+    def probe(self, eff: List[int]) -> int:
+        """Side-effect-free longest-cached-prefix PAGE COUNT for token
+        stream `eff`: no incref, no LRU touch, no hit/miss counters —
+        the cache-aware admission ordering peek (a probe that perturbed
+        eviction order or counters would make scheduling observable
+        through telemetry)."""
+        n = (len(eff) - 1) // self.page
+        key = b""
+        pages = 0
+        for j in range(n):
+            nxt = self._key(key, eff[j * self.page:(j + 1) * self.page])
+            if nxt not in self.entries:
+                break
+            key = nxt
+            pages += 1
+        return pages
 
     def insert(self, parent: bytes, toks: List[int], page: int) -> bytes:
         """Offer one fully-written page to the index. First writer wins:
@@ -517,6 +607,7 @@ class _PrefixCache:
         if parent is not None:
             parent.children.discard(entry.key)
         self._root_children.discard(entry.key)
+        self.epoch += 1
         freed = 0
         stack = [entry]
         while stack:
@@ -542,6 +633,42 @@ class _PrefixCache:
                 if self.pages_seen else 0.0}
 
 
+# ---------------- self-speculative drafting ---------------------------------
+
+
+def _ngram_propose(ctx: List[int], k: int, max_ngram: int,
+                   min_ngram: int) -> List[int]:
+    """Prompt-lookup drafting (the self-speculative n-gram rule): match
+    the last n tokens of `ctx` (prompt + generated history) against the
+    earlier context, longest n first, and propose up to k continuation
+    tokens from the MOST RECENT occurrence. No draft model — the bet is
+    that output quotes input (code, RAG, summarization) or repeats
+    itself (the loop greedy decoding of small models falls into), and
+    exact verification makes a wrong bet cost nothing but the tick's
+    spare row budget."""
+    L = len(ctx)
+    if k <= 0 or L < min_ngram + 1:
+        return []
+    arr = np.asarray(ctx, np.int64)
+    for n in range(min(max_ngram, L - 1), min_ngram - 1, -1):
+        pat = arr[L - n:]
+        # windows over ctx[:-1] so every match has >= 1 continuation
+        # token; a match overlapping the suffix is fine (that is how a
+        # period-p repetition extends itself)
+        win = np.lib.stride_tricks.sliding_window_view(arr[:L - 1], n)
+        hits = np.nonzero((win == pat).all(axis=1))[0]
+        if hits.size:
+            # most recent occurrence wins — but a match butting up
+            # against the end of history truncates the proposal, so
+            # prefer the newest occurrence with a FULL k-token
+            # continuation when one exists (a period-p loop then
+            # drafts k tokens every tick instead of p-1)
+            full = hits[hits + n + k <= L]
+            j = int(full[-1]) if full.size else int(hits[-1])
+            return [int(t) for t in arr[j + n:j + n + k]]
+    return []
+
+
 # ---------------- engine ---------------------------------------------------
 
 class ContinuousBatchingEngine:
@@ -558,6 +685,14 @@ class ContinuousBatchingEngine:
     full-page prompt prefix and fully-written prompt pages enter the
     content-hash index (see _PrefixCache); =False (or the bucketed
     regime) drops the cache entirely — bitwise the uncached allocator.
+
+    speculative=None follows FLAGS_speculative (ragged + greedy only):
+    self-speculative n-gram drafting with multi-token verification
+    rows; max_draft_tokens caps the per-slot draft length (None =
+    FLAGS_speculative_draft_tokens), spec_min_ngram/spec_max_ngram
+    bound the prompt-lookup match, and spec_hysteresis is the
+    full-acceptance tick count before a backed-off slot regrows its
+    draft length.
 
     SLO layer (slo=None follows FLAGS_serving_slo; see the module
     docstring): max_queue_tokens bounds the wait queue (None =
@@ -576,6 +711,10 @@ class ContinuousBatchingEngine:
                  total_pages: Optional[int] = None, page_size: int = 16,
                  max_chunk_tokens: int = 64, ragged: Optional[bool] = None,
                  prefix_cache: Optional[bool] = None,
+                 speculative: Optional[bool] = None,
+                 max_draft_tokens: Optional[int] = None,
+                 spec_min_ngram: int = 1, spec_max_ngram: int = 3,
+                 spec_hysteresis: int = 4, cache_jump_limit: int = 8,
                  slo: Optional[bool] = None,
                  max_queue_tokens: Optional[int] = None,
                  shed_patience: int = 8, min_chunk_tokens: int = 8,
@@ -652,6 +791,36 @@ class ContinuousBatchingEngine:
                if prefix_cache is None else bool(prefix_cache))
         self._pcache = (_PrefixCache(self.pool, page)
                         if pfx and self._ragged else None)
+        # self-speculative decoding (ISSUE 15): ragged + GREEDY only —
+        # verification is defined by greedy-argmax agreement, so a
+        # sampling engine never speculates. FLAGS_speculative=0 (or
+        # max_draft_tokens=0) is the bitwise kill switch: no drafting,
+        # the single-token decode rows and last-row-only compiled
+        # signatures of the pre-speculation engine exactly. Draft rows
+        # ride the max_chunk_tokens budget, so _T_pack (the one fixed
+        # padded shape) is untouched and the compile cache never grows
+        # with the draft length.
+        spec = (_core.get_bool_flag("FLAGS_speculative", True)
+                if speculative is None else bool(speculative))
+        if max_draft_tokens is None:
+            max_draft_tokens = int(_core.get_flag(
+                "FLAGS_speculative_draft_tokens", 4) or 0)
+        self.max_draft_tokens = max(int(max_draft_tokens), 0)
+        self._spec = (spec and self._ragged and self.greedy
+                      and self.max_draft_tokens > 0)
+        self.spec_min_ngram = max(int(spec_min_ngram), 1)
+        self.spec_max_ngram = max(int(spec_max_ngram), self.spec_min_ngram)
+        self.spec_hysteresis = max(int(spec_hysteresis), 1)
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        # cache-aware admission: how many FIFO jumps one waiter may
+        # suffer before it is admitted regardless of heat (liveness —
+        # equal-priority no-deadline waiters must not starve under a
+        # sustained hot-prefix arrival stream), plus a probe memo so
+        # the per-admission peek does not re-hash unchanged prompts
+        self.cache_jump_limit = max(int(cache_jump_limit), 1)
+        self.cache_aware_admits = 0
+        self._probe_memo: Dict[int, Tuple[int, int, int]] = {}
         # donation lets XLA scatter into the pool in place; CPU jit would
         # just warn that the buffers were not donated
         self._donate = jax.default_backend() == "tpu"
@@ -799,7 +968,9 @@ class ContinuousBatchingEngine:
         every packed row's KV scatters into its page and one ragged paged
         attention covers both phases; next[b] is sampled from sequence
         b's last packed row (kept at prev[b] where produce[b] is False:
-        mid-prompt chunks and idle slots)."""
+        mid-prompt chunks and idle slots). Speculation armed, the
+        compiled variant returns next as PER-ROW argmax [T] instead
+        (the `ok` poison flag stays per-sequence [B])."""
         if self._compiled_ragged is not None:
             return self._compiled_ragged
         cfg, dt = self.cfg, self.dtype
@@ -807,6 +978,42 @@ class ContinuousBatchingEngine:
         step_ragged = self._ragged_step
         greedy = self.greedy
         slo = self._slo
+        if self._spec:
+            K = self.max_draft_tokens + 1
+
+            def rstep_spec(state, toks, k_pool, v_pool, page_ids, offs,
+                           pos, page_table, q_start, q_len, kv_len,
+                           produce, verify, key):
+                """Speculation armed (greedy): argmax at each sequence's
+                last min(K, q_len) rows ([B, K], right-aligned — every
+                row a draft could ride, and only those: prefill-chunk
+                interiors never pay lm-head). Non-finite detection
+                covers exactly the rows the host CONSUMES — all rows of
+                a decode/verify entry (`verify`), only the last row of
+                a producing prefill chunk, nothing for mid-prompt/idle
+                slots — so the exemption semantics match the
+                non-speculative step's `ok | ~produce` contract and the
+                kill switch cannot change which requests fail."""
+                st = dq(state, dt) if quant else state
+                lg, k_pool, v_pool = step_ragged(
+                    st, cfg, toks, pos, k_pool, v_pool, page_ids, offs,
+                    page_table, q_start, q_len, kv_len, verify_rows=K)
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)  # [B, K]
+                if slo:
+                    j = jnp.arange(K)[None, :]
+                    in_window = ((j >= K - jnp.minimum(q_len, K)[:, None])
+                                 & (q_len > 0)[:, None])
+                    consumed = jnp.where(
+                        verify[:, None], in_window,
+                        (produce & ~verify)[:, None] & (j == K - 1))
+                    poison = (~jnp.isfinite(lg).all(axis=-1)) & consumed
+                    return nxt, ~poison.any(axis=-1), k_pool, v_pool
+                return nxt, k_pool, v_pool
+
+            self._compiled_ragged = jax.jit(
+                rstep_spec,
+                donate_argnums=(2, 3) if self._donate else ())
+            return self._compiled_ragged
 
         def rstep(state, toks, k_pool, v_pool, page_ids, offs, pos,
                   page_table, q_start, q_len, kv_len, produce, prev, key):
@@ -1115,21 +1322,74 @@ class ContinuousBatchingEngine:
 
     # -- chunked-prefill (ragged) scheduler ---------------------------------
 
+    def _pick_waiter(self) -> int:
+        """Index into self.waiting of the next admission. FIFO (queue
+        order — the SLO sort already ran) unless the prefix cache is
+        WARM: then prefer the waiter with the most cached prefix pages
+        (the vLLM cache-aware scheduling trick — its admission attaches
+        hot pages instead of evicting them to prefill a cold prompt).
+        Strictly subordinate to the SLO keys (priority, then EDF
+        slack) and stable within equal keys, so a cold cache, the
+        bucketed regime, or FLAGS_prefix_cache=0 are exactly FIFO.
+
+        Liveness: a waiter heat has jumped `cache_jump_limit` times is
+        admitted next regardless (a sustained hot-prefix arrival
+        stream must not starve a cold equal-priority request that
+        carries no deadline for EDF to escalate). Probes are memoized
+        per (cache drop-epoch, context length) — inserts only make a
+        memoized count understate, so the peek re-hashes a prompt only
+        after an eviction dropped entries or the request's own context
+        changed (resume)."""
+        if (self._pcache is None or len(self.waiting) < 2
+                or not self._pcache.entries):
+            return 0
+        if self.waiting[0].admit_bypassed >= self.cache_jump_limit:
+            return 0                     # aged out: head goes next
+        epoch = self._pcache.epoch
+        memo = self._probe_memo
+        fresh: Dict[int, Tuple[int, int, int]] = {}
+        best, best_key, best_hot = 0, None, 0
+        for j, r in enumerate(self.waiting):
+            ctx_len = len(r.prompt) + len(r.output)
+            hit = memo.get(r.request_id)
+            if hit is not None and hit[0] == epoch and hit[1] == ctx_len:
+                hot = hit[2]
+            else:
+                hot = self._pcache.probe(list(r.prompt) + list(r.output))
+            fresh[r.request_id] = (epoch, ctx_len, hot)
+            if self._slo:
+                dl = r.deadline_at
+                key = (-r.priority,
+                       dl if dl is not None else float("inf"), -hot, j)
+            else:
+                key = (-hot, j)
+            if best_key is None or key < best_key:
+                best, best_key, best_hot = j, key, hot
+        self._probe_memo = fresh         # drop terminal/admitted entries
+        if best != 0 and best_hot > 0:
+            for r in self.waiting[:best]:
+                r.admit_bypassed += 1
+            self.cache_aware_admits += 1
+            _CACHE_AWARE.inc()
+        return best
+
     def _admit_ragged(self):
         """Token-granular admission: a waiting request takes a free slot
         as soon as ONE exists and the pool has any free page — its prompt
         is funded page by page as chunks are scheduled, not reserved
-        up front (the chunked-prefill admission rule)."""
+        up front (the chunked-prefill admission rule). Among waiters the
+        pick is cache-aware (see _pick_waiter)."""
         free_slots = [i for i, s in enumerate(self.slots) if s.free]
         while self.waiting and free_slots and self.pool.n_free > 0:
-            req = self.waiting[0]
+            idx = self._pick_waiter()
+            req = self.waiting[idx]
             # re-admission after preemption resumes from prompt + output
             eff = list(req.prompt) + list(req.output)
             if self._oversized(len(eff)):
-                self.waiting.pop(0)
+                self.waiting.pop(idx)
                 self._fail_request(req)
                 continue
-            self.waiting.pop(0)
+            self.waiting.pop(idx)
             i = free_slots.pop(0)
             slot = self.slots[i]
             # cache-aware admission: attach the longest cached full-page
@@ -1148,6 +1408,8 @@ class ContinuousBatchingEngine:
             slot.prefix_tokens = eff
             slot.cache_upto = len(cached)
             slot.cache_key = ckey
+            slot.spec_k = self.max_draft_tokens
+            slot.spec_calm = 0
             slot.admit_seq = self._admit_seq
             self._admit_seq += 1
             self.slot_pages[i] = list(cached)
@@ -1197,6 +1459,11 @@ class ContinuousBatchingEngine:
                 entries.append((i, list(slot.pending[:chunk]), True))
                 self.prefill_tokens_total += chunk
                 budget -= chunk
+            if self._spec and budget > 0:
+                # leftover row budget funds speculative draft tokens —
+                # prefill (real work) always outranks speculation, and
+                # the packed total still fits the one fixed _T_pack
+                self._fund_drafts(entries, budget)
             if entries:
                 return entries
             # prefer page-HOLDING victims (evicting a zero-page slot
@@ -1216,6 +1483,140 @@ class ContinuousBatchingEngine:
             else:
                 self._preempt(max(victims,
                                   key=lambda j: self.slots[j].admit_seq))
+
+    # -- self-speculative decoding (ISSUE 15) --------------------------------
+
+    def _draft_for_slot(self, i: int, budget: int) -> List[int]:
+        """Up to slot.spec_k draft tokens for decode-phase slot i,
+        clamped by the tick's spare row budget, the request's remaining
+        token allowance (k+1 tokens can land per verified row), and the
+        slot's KV capacity (rows write positions length..length+k)."""
+        slot = self.slots[i]
+        req = slot.req
+        k = min(slot.spec_k, budget,
+                req.max_new_tokens - slot.produced - 1,
+                self.S - 1 - slot.length)
+        if k <= 0:
+            return []
+        fault_point("serving.draft")
+        return _ngram_propose(list(req.prompt) + list(req.output), k,
+                              self.spec_max_ngram, self.spec_min_ngram)
+
+    def _fund_drafts(self, entries, budget: int) -> None:
+        """Extend decode rows with draft tokens, funding their KV pages
+        at token granularity. Speculation is strictly best-effort: it
+        never takes the pool's LAST free page and never preempts, so
+        real work (decode growth, prefill chunks, admission) is never
+        starved by a bet that verification may throw away."""
+        page = self.page
+        for idx, (i, rows, is_prefill) in enumerate(entries):
+            if budget <= 0:
+                break
+            if is_prefill:
+                continue
+            drafts = self._draft_for_slot(i, budget)
+            if not drafts:
+                continue
+            slot = self.slots[i]
+            have = len(self.slot_pages[i]) * page
+            spare = max(self.pool.n_free - 1, 0)
+            # page funding, the per-slot KV ceiling (rows write
+            # positions length..length+k, which must stay < max_seq),
+            # AND the compiled verify-row window (the [B, K] argmax
+            # covers exactly max_draft_tokens+1 rows) — enforced here
+            # even if a drafter override ignores _draft_for_slot's own
+            # clamps
+            cap_tokens = min(have + spare * page - slot.length - 1,
+                             self.S - 1 - slot.length,
+                             self.max_draft_tokens)
+            drafts = drafts[:max(cap_tokens, 0)]
+            if not drafts:
+                continue
+            need = (-(-(slot.length + 1 + len(drafts)) // page)
+                    - len(self.slot_pages[i]))
+            if need > 0:
+                pages = self.pool.alloc(need)   # <= spare => succeeds
+                if pages is None:
+                    continue
+                n0 = len(self.slot_pages[i])
+                self.slot_pages[i].extend(pages)
+                self.page_table[i, n0:n0 + need] = pages
+            entries[idx] = (i, rows + drafts, False)
+            budget -= len(drafts)
+
+    def _verify_and_commit(self, i: int, rows: List[int], row_tok):
+        """Greedy draft verification (the self-speculative accept
+        rule): row j's argmax is the TRUE next token after row j's
+        input, and draft d_j rode row j — so d_j is confirmed iff row
+        j-1's argmax equals it. The longest agreeing prefix commits,
+        plus the bonus token from the first disagreeing row — exactly
+        the tokens the non-speculative engine would have produced one
+        tick at a time. KV written for rejected rows is rolled back
+        EXACTLY: kv_len truncates via slot.length, and pages wholly
+        past the new length return to the pool through the refcounted
+        free (draft rows only ever write past the prompt, so a
+        prefix-shared page is never corrupted — the free is belt and
+        suspenders on top of that invariant).
+
+        row_tok is the compiled step's [B, K] right-aligned verify-row
+        argmax: this entry's n rows sit at slots K-n..K-1 (n <= K
+        because the drafter caps k at max_draft_tokens)."""
+        slot = self.slots[i]
+        req = slot.req
+        n = len(rows)
+        drafted = n - 1
+        K = row_tok.shape[1]
+        cap = min(self.S, (self.pool.n_pages - 1) * self.page)
+        appended = 0
+        for j in range(n):
+            t = int(row_tok[i, K - n + j])
+            req.output.append(t)
+            appended += 1
+            slot.last_token = t
+            slot.produced = len(req.output)
+            if (slot.produced >= req.max_new_tokens
+                    or (req.eos_token_id is not None
+                        and t == req.eos_token_id)
+                    or slot.length + j + 2 > cap - 1):
+                break                    # the request finishes here
+            if j + 1 < n and rows[j + 1] != t:
+                break                    # draft j+1 refuted: t replaces it
+        slot.length += appended
+        accepted = min(appended - 1, drafted)
+        keep = -(-slot.length // self.page)
+        if len(self.slot_pages[i]) > keep:
+            # exact rollback: every position on these pages now lies
+            # past the truncated kv_len — nothing valid is lost
+            fault_point("serving.verify_rollback")
+            extra = self.slot_pages[i][keep:]
+            del self.slot_pages[i][keep:]
+            self.page_table[i, keep:keep + len(extra)] = 0
+            self.pool.free(extra)
+        # acceptance telemetry + adaptive draft length (the
+        # chunk-budget hysteresis idiom: back off fast, regrow slow)
+        self.spec_drafted += drafted
+        self.spec_accepted += accepted
+        req.spec_drafted += drafted
+        req.spec_accepted += accepted
+        if drafted:
+            _SPEC_DRAFTED.inc(drafted)
+        if accepted:
+            _SPEC_ACCEPTED.inc(accepted)
+        if self.spec_drafted:
+            _SPEC_RATE.set(self.spec_accepted / self.spec_drafted)
+        if accepted == drafted and drafted > 0:
+            slot.spec_calm += 1
+            if (slot.spec_calm >= self.spec_hysteresis
+                    and slot.spec_k < self.max_draft_tokens):
+                slot.spec_k = min(self.max_draft_tokens,
+                                  max(slot.spec_k * 2, 1))
+                slot.spec_calm = 0
+        else:
+            slot.spec_calm = 0
+            if 2 * accepted < drafted:
+                slot.spec_k = max(1, slot.spec_k // 2)
+        self._note_first_token(req)
+        self._maybe_finish(i)
 
     def _offer_prefix(self, i: int):
         """Offer slot i's newly COMPLETED prompt pages to the prefix
@@ -1252,7 +1653,8 @@ class ContinuousBatchingEngine:
         kv_len = np.zeros((B,), np.int32)
         produce = np.zeros((B,), bool)
         prev = np.zeros((B,), np.int32)
-        cur = 0
+        verify = np.zeros((B,), bool)    # decode entries: every row's
+        cur = 0                          # argmax may be consumed (spec)
         for i, rows, is_prefill in entries:
             slot = self.slots[i]
             n = len(rows)
@@ -1260,6 +1662,7 @@ class ContinuousBatchingEngine:
             q_len[i] = n
             kv_len[i] = slot.length + n
             prev[i] = slot.last_token
+            verify[i] = not is_prefill
             # only a COMPLETED prompt (or a decode row) yields a token;
             # mid-prompt chunks keep prev so sampling engines stay
             # deterministic across chunk splits
@@ -1282,7 +1685,9 @@ class ContinuousBatchingEngine:
                 jnp.asarray(pos), jnp.asarray(self.page_table),
                 jnp.asarray(q_start), jnp.asarray(q_len),
                 jnp.asarray(kv_len), jnp.asarray(produce),
-                jnp.asarray(prev), sub)
+                # the 13th arg is the spec variant's consumed-row mask;
+                # the non-speculative step keeps its prev-token slot
+                jnp.asarray(verify if self._spec else prev), sub)
         if self._slo:
             nxt, ok, self.k_pool, self.v_pool = out
             ok = np.asarray(ok)
@@ -1305,6 +1710,11 @@ class ContinuousBatchingEngine:
             slot = self.slots[i]
             req = slot.req
             n = len(rows)
+            if self._spec and not is_prefill and n > 1:
+                # decode row carrying draft tokens: verify the longest
+                # agreeing prefix, commit it, roll the rest back exactly
+                self._verify_and_commit(i, rows, nxt)
+                continue
             slot.length += n
             if is_prefill:
                 del slot.pending[:n]
@@ -1314,7 +1724,11 @@ class ContinuousBatchingEngine:
                     self._offer_prefix(i)
                 if slot.pending:
                     continue             # prompt still streaming in
-            tok = int(nxt[i])
+            # speculation armed, nxt is [B, K] right-aligned verify-row
+            # argmax: a sequence's produced token sits in the LAST slot
+            # (bitwise the non-speculative last-row lm-head — same
+            # rank-3 matmul over gathered rows)
+            tok = int(nxt[i, -1] if self._spec else nxt[i])
             slot.last_token = tok
             req.output.append(tok)
             slot.produced = len(req.output)
@@ -1537,7 +1951,17 @@ class ContinuousBatchingEngine:
             "counters": {"deadline_misses": self.deadline_misses,
                          "sheds": self.sheds,
                          "quarantines": self.quarantines,
-                         "preemptions": self.preemptions},
+                         "preemptions": self.preemptions,
+                         "cache_aware_admits": self.cache_aware_admits},
+            "speculative": {
+                "armed": self._spec,
+                "max_draft_tokens": self.max_draft_tokens,
+                "drafted": self.spec_drafted,
+                "accepted": self.spec_accepted,
+                "acceptance_rate": (
+                    round(self.spec_accepted / self.spec_drafted, 4)
+                    if self.spec_drafted else 0.0),
+            },
         }
         if self._pcache is not None:
             snap["prefix_cache"] = self._pcache.stats()

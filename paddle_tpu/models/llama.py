@@ -756,14 +756,23 @@ def _block_ragged(cfg, h, wl, kp, vp, pos, page_ids, offs, page_table,
 
 
 def _ragged_step_paged(state, cfg, toks, pos, k_pool, v_pool, page_ids,
-                       offs, page_table, q_start, q_len, kv_len):
+                       offs, page_table, q_start, q_len, kv_len,
+                       verify_rows=None):
     """Mixed prefill-chunk + decode rows in ONE call over the page pool.
 
     toks/pos/page_ids/offs: i32[T] packed rows (padding rows: token 0,
     page 0); k/v_pool: [L, kvh, P, page, d]; page_table: i32[B, ppmax];
     q_start/q_len/kv_len: i32[B]. Returns (last_logits[B, V], k_pool,
     v_pool) where last_logits[b] is the logits at each sequence's LAST
-    packed row (garbage for q_len == 0 slots — callers mask)."""
+    packed row (garbage for q_len == 0 slots — callers mask).
+
+    verify_rows=K (speculation armed): returns logits for each
+    sequence's LAST min(K, q_len) packed rows instead ([B, K, V],
+    right-aligned: slot K-1 is the last row, K-1-j the j-th from the
+    end; short sequences duplicate their first row in the unused
+    leading slots — callers mask). The engine verifies draft tokens
+    against the greedy argmax at each draft's own position without
+    paying lm-head for every prefill-chunk row in the packed batch."""
     T = toks.shape[0]
     emb = state["model.embed_tokens"]
     h = jnp.take(emb, toks.astype(jnp.int32), axis=0)        # [T, H]
@@ -777,10 +786,28 @@ def _ragged_step_paged(state, cfg, toks, pos, k_pool, v_pool, page_ids,
 
     h, (k_pool, v_pool) = jax.lax.scan(body, h, (wls, k_pool, v_pool))
     h = _rms(h, state["model.norm.weight"], cfg.rms_norm_eps)
+    # rank-3 matmul on purpose (both branches): XLA CPU's rank-2 bf16
+    # gemm accumulates differently than the batched form every other
+    # decode path uses, which flips greedy argmax at bf16 logit ties
+    # (engine parity bar). The per-row branch keeps the SAME batched
+    # shape so row logits are bitwise-equal to what the last-row branch
+    # would produce for the same row — speculative verification must
+    # not flip ties the non-speculative engine resolves the other way
+    if verify_rows:
+        K = int(verify_rows)
+        B = q_start.shape[0]
+        j = jnp.arange(K)
+        rows = q_start[:, None] + jnp.maximum(
+            q_len[:, None] - K + j[None, :], 0)
+        rows = jnp.clip(rows, 0, T - 1)
+        h_rows = h[rows].reshape(B * K, 1, h.shape[-1])       # [B*K, 1, H]
+        if "lm_head" in state:
+            logits = h_rows @ state["lm_head"]
+        else:
+            logits = h_rows @ jnp.swapaxes(emb, 0, 1)
+        return (logits.astype(jnp.float32).reshape(B, K, -1),
+                k_pool, v_pool)
     last = jnp.clip(q_start + q_len - 1, 0, T - 1)
-    # rank-3 matmul on purpose: XLA CPU's rank-2 bf16 gemm accumulates
-    # differently than the batched form every other decode path uses,
-    # which flips greedy argmax at bf16 logit ties (engine parity bar)
     h_last = h[last][:, None]                                 # [B, 1, H]
     if "lm_head" in state:
         logits = h_last @ state["lm_head"]

@@ -1,0 +1,747 @@
+"""Self-speculative decoding (ISSUE 15): n-gram prompt-lookup drafting,
+multi-token verification rows in the ragged engine, exact KV/page
+rollback (refcount-safe against prefix-shared pages), adaptive draft
+length, the FLAGS_speculative kill switch (token AND trace identity),
+cache-aware admission ordering, per-tick gateway token frames, and the
+serving.draft / serving.verify_rollback chaos points."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import (ContinuousBatchingEngine,
+                                  GenerationRequest)
+from paddle_tpu.inference.serving import _ngram_propose
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.utils import fault_injection as fi
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    fi.configure(None)
+    obs.enable(False)
+
+
+def _tiny_model(seed=0, **kw):
+    paddle.seed(seed)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=256, use_recompute=False,
+                      **kw)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+def _reference_generate(model, prompt, n_new):
+    out = model.generate(paddle.to_tensor(np.array([prompt], np.int32)),
+                         max_new_tokens=n_new, do_sample=False)
+    return [int(t) for t in np.asarray(out.numpy())[0][:n_new]]
+
+
+def _drain(eng, cap=3000):
+    n = 0
+    while eng.has_work and n < cap:
+        eng.step()
+        n += 1
+    assert not eng.has_work, "engine failed to drain"
+    return n
+
+
+def _perfect_drafter(model, eng):
+    """Install a drafter that proposes the model's own greedy
+    continuation (computed from the isolated reference) — every draft
+    verifies, which makes multi-token acceptance deterministic for
+    scheduling tests. Clamps exactly like the real drafter."""
+    refs = {}
+
+    def draft(i, budget):
+        slot = eng.slots[i]
+        req = slot.req
+        key = tuple(req.prompt)
+        if key not in refs:
+            refs[key] = _reference_generate(model, list(req.prompt), 192)
+        k = min(slot.spec_k, budget,
+                req.max_new_tokens - slot.produced - 1,
+                eng.S - 1 - slot.length)
+        if k <= 0:
+            return []
+        got = refs[key][len(req.output):len(req.output) + k]
+        return list(got)
+
+    eng._draft_for_slot = draft
+    return draft
+
+
+def _wrong_drafter(model, eng, k_force=None):
+    """Install a drafter whose first draft token always disagrees with
+    the model's greedy continuation — every draft is rejected at the
+    first verification row."""
+    refs = {}
+
+    def draft(i, budget):
+        slot = eng.slots[i]
+        req = slot.req
+        key = tuple(req.prompt)
+        if key not in refs:
+            refs[key] = _reference_generate(model, list(req.prompt), 192)
+        k = min(slot.spec_k if k_force is None else k_force, budget,
+                req.max_new_tokens - slot.produced - 1,
+                eng.S - 1 - slot.length)
+        if k <= 0:
+            return []
+        nxt = refs[key][len(req.output)]
+        return [(nxt + 1) % eng.cfg.vocab_size] * k
+
+    eng._draft_for_slot = draft
+    return draft
+
+
+class TestDrafter:
+    """_ngram_propose unit behavior (no model)."""
+
+    def test_matches_most_recent_occurrence(self):
+        #         0  1  2  3  4  5  6  7  8
+        ctx = [7, 1, 2, 9, 1, 2, 3, 1, 2]
+        # suffix (1, 2) occurs at 1 and 4; the MOST RECENT (4) wins and
+        # proposes its continuation [3, 1, 2]
+        assert _ngram_propose(ctx, 3, 3, 1) == [3, 1, 2]
+
+    def test_longest_ngram_wins(self):
+        ctx = [5, 1, 2, 3, 8, 2, 3]
+        # 2-gram (2, 3) matches at index 2 -> continuation [8, 2]; the
+        # 1-gram match for (3,) would have proposed [8] too but the
+        # longer match is tried first
+        assert _ngram_propose(ctx, 2, 3, 1) == [8, 2]
+
+    def test_periodic_self_extension(self):
+        # repetition loop: history itself provides the match — the
+        # suffix [9,4,9] recurs one period back, whose continuation
+        # [4,9] extends the cycle (truncated at the history's end)
+        ctx = [4, 9, 4, 9, 4, 9]
+        assert _ngram_propose(ctx, 4, 3, 1) == [4, 9]
+
+    def test_no_match_and_clamps(self):
+        assert _ngram_propose([1, 2, 3, 4], 4, 3, 1) == []
+        assert _ngram_propose([1, 2], 0, 3, 1) == []
+        assert _ngram_propose([1], 4, 3, 1) == []
+        # k larger than the available continuation truncates
+        assert _ngram_propose([1, 2, 1], 8, 2, 1) == [2, 1]
+
+    def test_min_ngram_floor(self):
+        # with min_ngram=2 a lone 1-gram match proposes nothing
+        ctx = [1, 9, 9, 9, 2, 5, 1]
+        assert _ngram_propose(ctx, 2, 3, 2) == []
+        assert _ngram_propose(ctx, 2, 3, 1) == [9, 9]
+
+
+class TestParityAndKillSwitch:
+    def test_outputs_token_identical_on_off_and_reference(self, model):
+        """Mixed workload (decode + chunked prefill + tight pool):
+        speculation on produces token-identical greedy outputs to
+        speculation off AND to the isolated reference."""
+        prompts = [[3, 5, 7], list(range(1, 20)), [9, 4],
+                   list(range(2, 30))]
+
+        def run(spec):
+            eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                           total_pages=6,
+                                           max_chunk_tokens=8,
+                                           speculative=spec)
+            reqs = [GenerationRequest(list(p), max_new_tokens=10)
+                    for p in prompts]
+            for r in reqs:
+                eng.add_request(r)
+            _drain(eng)
+            assert eng.pool.n_free == eng.pool.n_pages - 1
+            return eng, [r.output for r in reqs]
+
+        eng_on, on = run(True)
+        _, off = run(False)
+        assert on == off
+        for p, out in zip(prompts, on):
+            assert out == _reference_generate(model, p, 10)
+
+    def test_kill_switch_flag_matches_kwarg_and_trace(self, model):
+        """FLAGS_speculative=0 must BE the pre-speculation engine: same
+        outputs and the same per-tick scheduling trace as an engine
+        constructed speculative=False (the untouched code path)."""
+        prompts = [[9, 4, 2], list(range(1, 20)), [3, 3, 5, 8]]
+
+        def run(**kw):
+            eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                           total_pages=6,
+                                           max_chunk_tokens=8, **kw)
+            reqs = [GenerationRequest(list(p), max_new_tokens=8)
+                    for p in prompts]
+            for r in reqs:
+                eng.add_request(r)
+            trace = []
+            n = 0
+            while eng.has_work and n < 2000:
+                eng.step()
+                trace.append((eng.last_packed_tokens, len(eng.finished),
+                              eng.preemptions))
+                n += 1
+            return eng, [r.output for r in reqs], trace
+
+        paddle.set_flags({"FLAGS_speculative": False})
+        try:
+            flag_eng, flag_out, flag_trace = run()
+        finally:
+            paddle.set_flags({"FLAGS_speculative": True})
+        kwarg_eng, kwarg_out, kwarg_trace = run(speculative=False)
+        on_eng, on_out, _ = run()
+        assert not flag_eng._spec and not kwarg_eng._spec
+        assert on_eng._spec
+        assert flag_out == kwarg_out == on_out
+        assert flag_trace == kwarg_trace
+        assert flag_eng.spec_drafted == 0
+
+    def test_one_fixed_shape_no_per_k_compiles(self, model):
+        """Speculation rides the chunk budget: _T_pack (the one padded
+        shape) is unchanged vs the non-speculative engine and the
+        ragged step stays ONE compiled callable however k adapts."""
+        eng_on = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                          max_chunk_tokens=16,
+                                          speculative=True)
+        eng_off = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                           max_chunk_tokens=16,
+                                           speculative=False)
+        assert eng_on._T_pack == eng_off._T_pack
+        _perfect_drafter(model, eng_on)
+        for n in (2, 9, 17):
+            eng_on.add_request(GenerationRequest(list(range(1, n + 1)),
+                                                 max_new_tokens=12))
+        _drain(eng_on)
+        assert eng_on.spec_accepted > 0      # k really varied upward
+        assert eng_on._compiled_prefill == {}
+        assert eng_on._compiled_ragged is not None
+
+    def test_sampling_and_bucketed_engines_never_speculate(self, model):
+        assert not ContinuousBatchingEngine(
+            model, greedy=False, speculative=True)._spec
+        assert not ContinuousBatchingEngine(
+            model, ragged=False, speculative=True)._spec
+        assert not ContinuousBatchingEngine(
+            model, speculative=True, max_draft_tokens=0)._spec
+        # explicit kwarg overrides the flag
+        paddle.set_flags({"FLAGS_speculative": False})
+        try:
+            assert ContinuousBatchingEngine(model, speculative=True)._spec
+        finally:
+            paddle.set_flags({"FLAGS_speculative": True})
+
+
+class TestVerifyAndRollback:
+    def test_accepted_drafts_advance_multiple_tokens_per_tick(self, model):
+        """A perfect drafter collapses decode ticks ~(k+1)-fold — the
+        deterministic core of the speculative speedup claim."""
+        prompt = [3, 5, 7]
+        n_new = 25
+
+        def ticks(spec):
+            eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                           max_chunk_tokens=16,
+                                           speculative=spec,
+                                           max_draft_tokens=4)
+            if spec:
+                _perfect_drafter(model, eng)
+            req = GenerationRequest(list(prompt), max_new_tokens=n_new)
+            eng.add_request(req)
+            n = _drain(eng)
+            assert req.output == _reference_generate(model, prompt, n_new)
+            return n, eng
+
+        t_off, _ = ticks(False)
+        t_on, eng = ticks(True)
+        # 25 tokens at up to 5/tick: 1 prefill tick + ceil(24/5)=5 more
+        assert t_on <= 8 < t_off
+        assert eng.spec_accepted >= 15
+        assert eng.spec_drafted == eng.spec_accepted    # all verified
+
+    def test_rejection_mid_page_frees_pages_exactly(self, model):
+        """Rejected draft rows whose pages lie wholly past the
+        truncated kv_len return to the pool the same tick."""
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                       max_chunk_tokens=16,
+                                       speculative=True,
+                                       max_draft_tokens=4)
+        _wrong_drafter(model, eng, k_force=4)
+        # 13-token prompt: after the prefill tick the slot holds 13 KV
+        # tokens in page 1; the decode row writes offset 13 and the 4
+        # draft rows straddle into a SECOND page (positions 14..17)
+        # that rejection must hand back the same tick
+        prompt = list(range(1, 14))
+        req = GenerationRequest(prompt, max_new_tokens=8)
+        eng.add_request(req)
+        eng.step()                       # prefill + first token
+        assert eng.slots[0].length == 13
+        free_before = eng.pool.n_free
+        eng.step()                       # decode + 4 rejected drafts
+        # the draft page was allocated AND rolled back within the tick:
+        # only the committed token (position 13, page 1) remains
+        assert eng.spec_drafted == 4 and eng.spec_accepted == 0
+        assert eng.slots[0].length == 14
+        assert eng.pool.n_free == free_before
+        assert len(eng.slot_pages[0]) == 1
+        assert list(eng.page_table[0, 1:]) == [0] * (eng.ppmax - 1)
+        _drain(eng)
+        assert req.output == _reference_generate(model, prompt, 8)
+        assert eng.pool.n_free == eng.pool.n_pages - 1
+
+    def test_rollback_never_touches_prefix_shared_pages(self, model):
+        """The refcount bar: rollback after rejected drafts must not
+        free or corrupt a page the request shares through the prefix
+        cache (and that another request may attach later)."""
+        PAGE = 16
+        rng = np.random.RandomState(11)
+        prefix = [int(t) for t in rng.randint(1, 128, 2 * PAGE)]
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=96,
+                                       max_chunk_tokens=32,
+                                       prefix_cache=True,
+                                       speculative=True,
+                                       max_draft_tokens=4)
+        a = GenerationRequest(prefix + [5, 9], max_new_tokens=3)
+        eng.add_request(a)
+        _drain(eng)
+        cached = set(eng._pcache.by_page)
+        assert len(cached) == 2
+        _wrong_drafter(model, eng, k_force=4)
+        b = GenerationRequest(prefix + [7, 3], max_new_tokens=8)
+        eng.add_request(b)
+        eng.step()                       # admission attaches 2 cached pages
+        i = next(i for i, s in enumerate(eng.slots) if s.req is b)
+        assert set(eng.slot_pages[i][:2]) == cached
+        hits_before = eng._pcache.hits
+        _drain(eng)
+        # shared pages survived every rollback: still indexed, never
+        # handed back to the free list while B held them, and B's
+        # output is exact
+        assert set(eng._pcache.by_page) >= cached
+        assert b.output == _reference_generate(model, b.prompt, 8)
+        assert eng._pcache.hits == hits_before
+        assert eng.pool.n_free == eng.pool.n_pages - 1
+
+    def test_draft_exceeding_max_seq_is_clamped(self, model):
+        """A drafter proposing past the per-slot KV ceiling is
+        truncated (never an out-of-range page write), and the request
+        finishes at capacity exactly like the non-speculative engine."""
+        prompt = [2, 4, 6]
+
+        def run(spec):
+            eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=32,
+                                           max_chunk_tokens=16,
+                                           speculative=spec,
+                                           max_draft_tokens=4)
+            if spec:
+                real = _perfect_drafter(model, eng)
+                # sabotage the clamp: always claim 4 more than allowed
+                eng._draft_for_slot = lambda i, b: real(i, b) + [1, 1, 1, 1]
+            req = GenerationRequest(list(prompt), max_new_tokens=100)
+            eng.add_request(req)
+            _drain(eng)
+            assert eng.pool.n_free == eng.pool.n_pages - 1
+            return req.output
+
+        on, off = run(True), run(False)
+        assert on == off
+        assert len(prompt) + len(on) <= 32
+
+    def test_eos_inside_accepted_drafts_stops_exactly(self, model):
+        """EOS landing mid-verification commits up to and including the
+        EOS token, never past it."""
+        prompt = [9, 4]
+        ref = _reference_generate(model, prompt, 6)
+        eos = ref[3]
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                       max_chunk_tokens=16,
+                                       speculative=True,
+                                       max_draft_tokens=4)
+        _perfect_drafter(model, eng)
+        req = GenerationRequest(list(prompt), max_new_tokens=16,
+                                eos_token_id=eos)
+        eng.add_request(req)
+        _drain(eng)
+        assert req.output == ref[:4]
+        assert req.output[-1] == eos
+        assert eng.pool.n_free == eng.pool.n_pages - 1
+
+
+class TestConsumedRowExemption:
+    def test_midprompt_poison_not_quarantined_under_spec(self, model):
+        """Parity of the non-finite exemption (review finding): a
+        poisoned logit in a row the host never consumes (mid-prompt
+        chunk rows, interior rows of a producing chunk) must not
+        quarantine under FLAGS_speculative=1 — the kill switch cannot
+        change which requests fail."""
+        import jax.numpy as jnp
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                       max_chunk_tokens=16, slo=True,
+                                       speculative=True,
+                                       max_draft_tokens=4)
+        eng._draft_for_slot = lambda i, b: []   # decode rows stay q_len=1
+        real = eng._ragged_step
+
+        def poisoned(st, cfg, toks, pos, kp, vp, page_ids, offs,
+                     page_table, q_start, q_len, kv_len, verify_rows=None):
+            lg, kp, vp = real(st, cfg, toks, pos, kp, vp, page_ids,
+                              offs, page_table, q_start, q_len, kv_len,
+                              verify_rows=verify_rows)
+            # poison a NON-consumed gathered row whenever slot 0 is
+            # prefilling (q_len > 1 here implies a prefill chunk —
+            # drafting is disabled above): window row 0 is an interior
+            # row for any chunk longer than the verify window
+            bad = ((jnp.arange(lg.shape[0]) == 0)[:, None]
+                   & (jnp.arange(lg.shape[1]) == 0)[None, :]
+                   & (q_len[0] > 1))
+            lg = jnp.where(bad[:, :, None], jnp.inf, lg)
+            return lg, kp, vp
+
+        eng._ragged_step = poisoned
+        prompt = list(range(1, 41))          # 40 tokens = 3 chunks
+        ref = _reference_generate(model, prompt, 3)
+        req = GenerationRequest(prompt, max_new_tokens=3)
+        eng.add_request(req)
+        _drain(eng)
+        assert req.status == "served"
+        assert eng.quarantines == 0
+        assert req.output == ref
+
+    def test_probe_memo_epoch_bumps_only_on_drop(self, model):
+        """Inserts leave the probe-memo epoch alone (a memoized count
+        only understates); dropping cached entries bumps it (a stale
+        count would overstate heat)."""
+        PAGE = 16
+        rng = np.random.RandomState(43)
+        prefix = [int(t) for t in rng.randint(1, 128, 2 * PAGE)]
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=96,
+                                       max_chunk_tokens=48,
+                                       prefix_cache=True)
+        eng.add_request(GenerationRequest(prefix + [5], max_new_tokens=2))
+        _drain(eng)
+        assert len(eng._pcache.entries) == 2
+        assert eng._pcache.epoch == 0        # inserts did not bump
+        root = next(iter(eng._pcache._root_children))
+        eng._pcache._drop_subtree(eng._pcache.entries[root])
+        assert eng._pcache.epoch == 1
+
+
+class TestPreemptionAndDeadlines:
+    def test_preemption_with_draft_rows_in_flight_is_exact(self, model):
+        """Tiny pool forces preemption while slots carry speculative
+        rows; recompute-resume must stay token-exact and leak nothing."""
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                       total_pages=5, max_chunk_tokens=8,
+                                       speculative=True,
+                                       max_draft_tokens=4)
+        _perfect_drafter(model, eng)
+        reqs = [GenerationRequest([11, 5], max_new_tokens=38),
+                GenerationRequest([7, 19], max_new_tokens=38)]
+        for r in reqs:
+            eng.add_request(r)
+        _drain(eng)
+        assert eng.preemptions >= 1
+        assert eng.spec_accepted > 0     # drafts really were in flight
+        for r in reqs:
+            assert r.output == _reference_generate(model, r.prompt, 38)
+        assert eng.pool.n_free == eng.pool.n_pages - 1
+
+    def test_deadline_expiry_between_draft_and_verify_ticks(self, model):
+        """A deadline elapsing while speculative rows are being drafted
+        and verified fails the request fast and reclaims every page —
+        including pages funded for drafts."""
+        import time
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                       max_chunk_tokens=16, slo=True,
+                                       speculative=True,
+                                       max_draft_tokens=4)
+        _perfect_drafter(model, eng)
+        req = GenerationRequest([3, 5, 7], max_new_tokens=500,
+                                deadline_s=0.05)
+        eng.add_request(req)
+        n = 0
+        while eng.has_work and n < 2000:
+            eng.step()
+            n += 1
+            time.sleep(0.01)
+        assert req.status == "deadline_missed"
+        assert len(req.output) < 500
+        assert eng.pool.n_free == eng.pool.n_pages - 1
+        assert all(s.free for s in eng.slots)
+
+
+class TestAdaptiveDraftLength:
+    def test_shrink_on_rejection_regrow_on_calm(self, model):
+        """k halves on zero-acceptance ticks and doubles back after
+        spec_hysteresis consecutive full-acceptance ticks — the
+        chunk-budget hysteresis idiom applied per slot."""
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=128,
+                                       max_chunk_tokens=16,
+                                       speculative=True,
+                                       max_draft_tokens=4,
+                                       spec_hysteresis=2)
+        ref = _reference_generate(model, [3, 5, 7], 192)
+        mode = {"wrong": True}
+
+        def draft(i, budget):
+            slot = eng.slots[i]
+            req = slot.req
+            k = min(slot.spec_k, budget,
+                    req.max_new_tokens - slot.produced - 1,
+                    eng.S - 1 - slot.length)
+            if k <= 0:
+                return []
+            if mode["wrong"]:
+                return [(ref[len(req.output)] + 1) % 128] * k
+            return ref[len(req.output):len(req.output) + k]
+
+        eng._draft_for_slot = draft
+        req = GenerationRequest([3, 5, 7], max_new_tokens=120)
+        eng.add_request(req)
+        eng.step()                       # prefill tick (no drafting)
+        ks = []
+        for _ in range(3):               # rejected ticks: 4 -> 2 -> 1
+            eng.step()
+            ks.append(eng.slots[0].spec_k)
+        assert ks == [2, 1, 1]
+        mode["wrong"] = False
+        regrown = []
+        for _ in range(8):               # calm ticks regrow 1->2->4
+            eng.step()
+            regrown.append(eng.slots[0].spec_k)
+        assert 2 in regrown and regrown[-1] == 4
+        got = len(req.output)
+        assert req.output == ref[:got]   # adaptation never broke tokens
+
+
+class TestTelemetryAndHealth:
+    def test_counters_gauge_and_per_request_rates(self, model):
+        from paddle_tpu.observability import metrics
+        obs.enable(True)
+        metrics.reset()
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                       max_chunk_tokens=16,
+                                       speculative=True,
+                                       max_draft_tokens=4)
+        _perfect_drafter(model, eng)
+        req = GenerationRequest([3, 5, 7], max_new_tokens=20)
+        eng.add_request(req)
+        _drain(eng)
+        snap = metrics.snapshot()
+        drafted = snap["counters"]["serving.spec_drafted_total"][""]
+        accepted = snap["counters"]["serving.spec_accepted_total"][""]
+        assert drafted >= accepted > 0
+        rate = snap["gauges"]["serving.spec_acceptance_rate"][""]
+        assert 0.0 < rate <= 1.0
+        assert req.spec_drafted == drafted
+        assert req.spec_accepted == accepted
+        health = eng.health_snapshot()
+        assert health["speculative"]["armed"]
+        assert health["speculative"]["drafted"] == drafted
+        assert health["speculative"]["acceptance_rate"] == round(
+            accepted / drafted, 4)
+
+    def test_disarmed_spec_metrics_silent(self, model):
+        from paddle_tpu.observability import metrics
+        metrics.reset()
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                       speculative=False)
+        eng.add_request(GenerationRequest([4, 9], max_new_tokens=3))
+        _drain(eng)
+        snap = metrics.snapshot()
+        assert not snap["counters"].get("serving.spec_drafted_total")
+        assert eng.spec_drafted == 0
+        assert eng.health_snapshot()["speculative"]["armed"] is False
+
+
+class TestFaultPoints:
+    def test_draft_fault_isolated_to_one_request(self, model):
+        """serving.draft raising inside the tick quarantines ONE
+        request through the isolation boundary; the engine survives."""
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                       max_chunk_tokens=16, slo=True,
+                                       speculative=True)
+        reqs = [GenerationRequest([3 + i, 5], max_new_tokens=6)
+                for i in range(3)]
+        for r in reqs:
+            eng.add_request(r)
+        fi.configure("serving.draft:raise@2")
+        _drain(eng)
+        fi.configure(None)
+        statuses = sorted(r.status for r in reqs)
+        assert statuses.count("failed") == 1
+        assert statuses.count("served") == 2
+        assert eng.pool.n_free == eng.pool.n_pages - 1
+
+    def test_verify_rollback_fault_isolated(self, model):
+        """serving.verify_rollback raising (mid-rollback chaos) fails
+        one request; pool accounting stays consistent at drain."""
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                       max_chunk_tokens=16, slo=True,
+                                       speculative=True,
+                                       max_draft_tokens=4)
+        _wrong_drafter(model, eng, k_force=4)
+        reqs = [GenerationRequest(list(range(1, 16)), max_new_tokens=6),
+                GenerationRequest([9, 4], max_new_tokens=6)]
+        for r in reqs:
+            eng.add_request(r)
+        fi.configure("serving.verify_rollback:raise@1")
+        _drain(eng)
+        fi.configure(None)
+        statuses = sorted(r.status for r in reqs)
+        assert "failed" in statuses
+        assert eng.quarantines >= 1
+        assert eng.pool.n_free == eng.pool.n_pages - 1
+
+
+class TestCacheAwareAdmission:
+    PAGE = 16
+
+    def _warm(self, model, eng, prefix):
+        a = GenerationRequest(prefix + [5, 9], max_new_tokens=2)
+        eng.add_request(a)
+        _drain(eng)
+        assert len(eng._pcache.by_page) >= 2
+        return a
+
+    def test_hot_waiter_jumps_cold_fifo_head(self, model):
+        """With the cache warm, a waiter whose prompt prefix is cached
+        is admitted before an earlier-submitted cold waiter; the
+        counter records the jump and outputs stay exact."""
+        rng = np.random.RandomState(29)
+        prefix = [int(t) for t in rng.randint(1, 128, 2 * self.PAGE)]
+        cold_prompt = [int(t) for t in rng.randint(1, 128, 20)]
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=96,
+                                       max_chunk_tokens=48,
+                                       prefix_cache=True)
+        self._warm(model, eng, prefix)
+        blocker = GenerationRequest([7, 7], max_new_tokens=6)
+        cold = GenerationRequest(cold_prompt, max_new_tokens=2)
+        hot = GenerationRequest(prefix + [3], max_new_tokens=2)
+        eng.add_request(blocker)
+        eng.step()                       # blocker owns the only slot
+        eng.add_request(cold)            # FIFO head
+        eng.add_request(hot)             # hot jumps it
+        _drain(eng)
+        assert eng.cache_aware_admits >= 1
+        order = [r.request_id for r in eng.finished[-2:]]
+        assert order == [hot.request_id, cold.request_id]
+        assert cold.output == _reference_generate(model, cold_prompt, 2)
+        assert hot.output == _reference_generate(model, hot.prompt, 2)
+
+    def test_cold_cache_and_disabled_cache_stay_fifo(self, model):
+        rng = np.random.RandomState(31)
+        p1 = [int(t) for t in rng.randint(1, 128, 20)]
+        p2 = [int(t) for t in rng.randint(1, 128, 20)]
+        for cache in (True, False):
+            eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=96,
+                                           max_chunk_tokens=48,
+                                           prefix_cache=cache)
+            blocker = GenerationRequest([7, 7], max_new_tokens=6)
+            r1 = GenerationRequest(list(p1), max_new_tokens=2)
+            r2 = GenerationRequest(list(p2), max_new_tokens=2)
+            eng.add_request(blocker)
+            eng.step()
+            eng.add_request(r1)
+            eng.add_request(r2)
+            _drain(eng)
+            assert eng.cache_aware_admits == 0
+            order = [r.request_id for r in eng.finished[-2:]]
+            assert order == [r1.request_id, r2.request_id]
+
+    def test_cold_waiter_cannot_starve_under_hot_stream(self, model):
+        """Liveness bound (review finding): equal-priority cold waiter
+        with no deadline is admitted after at most cache_jump_limit
+        heat jumps, even when hot-prefix arrivals never stop."""
+        rng = np.random.RandomState(41)
+        prefix = [int(t) for t in rng.randint(1, 128, 2 * self.PAGE)]
+        cold_prompt = [int(t) for t in rng.randint(1, 128, 20)]
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=96,
+                                       max_chunk_tokens=48,
+                                       prefix_cache=True,
+                                       cache_jump_limit=3)
+        self._warm(model, eng, prefix)
+        cold = GenerationRequest(list(cold_prompt), max_new_tokens=2)
+        eng.add_request(cold)
+        served_hot_before_cold = 0
+        hot_id = 0
+        for _ in range(400):
+            if cold.done:
+                break
+            # keep a hot waiter queued at all times: without the bound
+            # this stream would bypass `cold` forever
+            while sum(1 for r in eng.waiting if r is not cold) < 2:
+                hot_id += 1
+                eng.add_request(GenerationRequest(prefix + [hot_id],
+                                                  max_new_tokens=2))
+            eng.step()
+        assert cold.done and cold.status == "served"
+        assert cold.admit_bypassed <= 3
+        served_before = [r for r in eng.finished
+                        if r.finished_s is not None and r is not cold
+                        and r.finished_s < cold.finished_s]
+        # the warmup request + at most cache_jump_limit hot jumps (+1
+        # already-running) may legitimately finish first
+        assert len(served_before) <= 6, len(served_before)
+        assert cold.output == _reference_generate(model, cold_prompt, 2)
+
+    def test_priority_outranks_cache_heat(self, model):
+        """SLO order is never subverted: a cold high-priority waiter
+        still beats a hot low-priority one."""
+        rng = np.random.RandomState(37)
+        prefix = [int(t) for t in rng.randint(1, 128, 2 * self.PAGE)]
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=96,
+                                       max_chunk_tokens=48,
+                                       prefix_cache=True, slo=True)
+        self._warm(model, eng, prefix)
+        blocker = GenerationRequest([7, 7], max_new_tokens=6)
+        hot_lo = GenerationRequest(prefix + [3], max_new_tokens=2,
+                                   priority=0)
+        cold_hi = GenerationRequest([4, 8, 15], max_new_tokens=2,
+                                    priority=2)
+        eng.add_request(blocker)
+        eng.step()
+        eng.add_request(hot_lo)
+        eng.add_request(cold_hi)
+        _drain(eng)
+        order = [r.request_id for r in eng.finished[-2:]]
+        assert order == [cold_hi.request_id, hot_lo.request_id]
+
+
+class TestGatewayTickFrames:
+    def test_one_event_per_request_per_tick(self, model):
+        """EngineRunner._dispatch batches every token a tick accepted
+        into ONE ('tokens', [...]) event — the per-tick frame contract
+        speculation relies on (ISSUE 15 satellite)."""
+        from paddle_tpu.inference import EngineRunner
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_seq=64,
+                                       max_chunk_tokens=16,
+                                       speculative=True,
+                                       max_draft_tokens=4)
+        _perfect_drafter(model, eng)
+        runner = EngineRunner(eng)       # never started: manual ticks
+        req = GenerationRequest([3, 5, 7], max_new_tokens=20)
+        stream = runner.submit(req)
+        events = []
+        n = 0
+        while eng.has_work and n < 100:
+            with runner.lock:
+                eng.step()
+                runner._dispatch()
+            n += 1
+        while not stream.q.empty():
+            events.append(stream.q.get())
+        token_events = [e for e in events if e[0] == "tokens"]
+        # one event per producing tick, and at least one carries a
+        # multi-token batch (accepted drafts)
+        assert len(token_events) <= n
+        assert any(len(e[1]) > 1 for e in token_events)
+        flat = [t for e in token_events for t in e[1]]
+        assert flat == req.output == _reference_generate(model, [3, 5, 7],
+                                                         20)
+        assert events[-1][0] == "end" and events[-1][1] == "served"
