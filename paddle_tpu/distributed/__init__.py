@@ -16,6 +16,7 @@ from .collective import (  # noqa: F401
     destroy_process_group, get_group, health_barrier, irecv, isend,
     new_group, quantized_all_reduce, quantized_reduce_scatter, recv,
     reduce, reduce_scatter, scatter, send, wait,
+    zero_grad_reduce_scatter, zero_param_all_gather,
 )
 from .topology import (  # noqa: F401
     AXES, AxisGroup, CommunicateTopology, HybridCommunicateGroup,
@@ -24,7 +25,8 @@ from .topology import (  # noqa: F401
 )
 from .sharding import (  # noqa: F401
     Partial, Placement, ProcessMesh, Replicate, Shard, ShardingPlan,
-    reshard, shard_tensor, to_placements, with_partial_annotation,
+    convert_zero_opt_state, reshard, shard_tensor, to_placements,
+    with_partial_annotation,
 )
 from . import fleet  # noqa: F401
 from .fleet.utils.recompute import recompute  # noqa: F401

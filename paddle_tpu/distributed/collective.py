@@ -467,6 +467,72 @@ def grad_sync_all_reduce(grad, axis=None, nranks=0, cfg=None,
     return out.reshape(arr.shape).astype(arr.dtype), new_residual
 
 
+# ---- ZeRO sharded weight update (arxiv 2004.13336) ----------------------
+# The rs -> per-shard update -> ag sequence jit.TrainStep emits for
+# ShardingPlan(zero=1|2): grads are mean-reduce-scattered so each rank
+# owns 1/nranks of the flat (padded) gradient, the optimizer update runs
+# only on that shard, and the updated param shards are all-gathered back
+# to replicated. The flat layout is quantization/comm.py's shard_sizes
+# contract, so quantized payloads, error-feedback residuals, and ZeRO
+# shards agree on one partitioning.
+
+
+@_collective_telemetry("zero_grad_reduce_scatter")
+def zero_grad_reduce_scatter(grad, axis=None, nranks=0, stage=2, block=1,
+                             cfg=None, residual=None):
+    """ZeRO grad half: mean-reduction of a local (per-shard) gradient
+    over the data-parallel `axis`, returning only THIS rank's flat
+    (s,)-shard of the result (shard_sizes(numel, nranks, block) layout,
+    zero-padded at the tail). Runs inside the shard_map TrainStep wraps
+    the step in.
+
+    cfg=None reduces exactly: zero=2 via a single psum_scatter (the full
+    reduced gradient never materializes), zero=1 via psum + own-row
+    slice (classic grad all-reduce, sharded update only). cfg set routes
+    phase 1 of the EQuARX chain (quantized all_to_all reduce-scatter)
+    with `residual` as this rank's error-feedback carry over the full
+    padded vector; returns (shard, new_residual_or_None)."""
+    from ..quantization import comm as _qc
+    arr = grad.data if isinstance(grad, Tensor) else grad
+    flat = arr.astype(jnp.float32).ravel() / nranks
+    numel = flat.shape[0]
+    if cfg is not None:
+        s, padded = _qc.shard_sizes(numel, nranks, cfg.block)
+        x = jnp.pad(flat, (0, padded - numel))
+        if residual is not None:
+            x = x + residual.reshape(padded)
+        rows = x.reshape(nranks, s)
+        shard, err1 = _quant_reduce_scatter_rows(rows, axis, cfg)
+        new_residual = err1.reshape(padded) if cfg.error_feedback else None
+        per_elem = cfg.wire_bytes_per_element
+        wire = int(round(padded * per_elem))
+        _set_wire_bytes(wire)
+        _COLL_RATIO.set(padded * 4 / wire, op="zero_grad_reduce_scatter")
+        return shard, new_residual
+    s, padded = _qc.shard_sizes(numel, nranks, block)
+    rows = jnp.pad(flat, (0, padded - numel)).reshape(nranks, s)
+    if stage == 1:
+        # ZeRO-1: the full mean gradient is materialized on every rank
+        # (plain all-reduce); only the update/state is sharded
+        full = jax.lax.psum(rows, axis)
+        shard = jax.lax.dynamic_slice_in_dim(
+            full, jax.lax.axis_index(axis), 1, 0).reshape(s)
+    else:
+        shard = jax.lax.psum_scatter(rows, axis, scatter_dimension=0,
+                                     tiled=False)
+    return shard, None
+
+
+@_collective_telemetry("zero_param_all_gather")
+def zero_param_all_gather(shard, axis=None):
+    """ZeRO unshard half: exact all-gather of this rank's updated flat
+    param shard back to the replicated padded vector. Always exact —
+    quantizing here would write wire error straight into the weights
+    with no feedback path to absorb it."""
+    arr = shard.data if isinstance(shard, Tensor) else shard
+    return jax.lax.all_gather(arr, axis, tiled=True)
+
+
 @_collective_telemetry("all_gather", payload_arg=1)
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     axis = _axis_of(group)

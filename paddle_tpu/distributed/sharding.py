@@ -247,7 +247,7 @@ class ShardingPlan:
     def __init__(self, mesh: Mesh, stage: int = 0, param_rules=None,
                  data_axes=("dp", "sharding"), shard_min_size: int = 2 ** 14,
                  grad_sync=None, grad_sync_block=None,
-                 grad_sync_error_feedback: bool = False):
+                 grad_sync_error_feedback: bool = False, zero: int = 0):
         self.mesh = mesh
         self.stage = stage
         self.param_rules = param_rules or {}
@@ -266,12 +266,28 @@ class ShardingPlan:
         self.grad_sync = grad_sync
         self.grad_sync_block = grad_sync_block
         self.grad_sync_error_feedback = bool(grad_sync_error_feedback)
-        if grad_sync is not None and stage != 0:
+        # explicit ZeRO sharded weight update (arxiv 2004.13336):
+        # zero=1 shards optimizer state across the DP axis (grads still
+        # all-reduced), zero=2 additionally reduce-scatters grads so the
+        # full reduced gradient never materializes. Composes WITH
+        # grad_sync (the quantized chain becomes the rs wire path);
+        # armed only when FLAGS_zero != 0 (evaluated at TrainStep build
+        # — the kill switch restores the replicated paths bitwise).
+        if zero not in (0, 1, 2):
+            raise ValueError(f"ShardingPlan(zero={zero!r}): ZeRO mode must "
+                             f"be 0 (off), 1, or 2")
+        self.zero = int(zero)
+        if stage != 0 and (grad_sync is not None or self.zero):
+            knobs = " and ".join(
+                k for k, on in ((f"grad_sync={grad_sync!r}",
+                                 grad_sync is not None),
+                                (f"zero={zero}", bool(self.zero))) if on)
             raise ValueError(
-                "quantized grad sync (grad_sync=...) currently composes "
-                "only with replicated parameters/optimizer state "
-                "(stage=0); ZeRO stages shard state across the same axis "
-                "the quantized chain reduces over")
+                f"ShardingPlan(stage={stage}) GSPMD state/param sharding "
+                f"does not compose with {knobs}: the explicit shard_map "
+                f"paths (grad_sync= quantized sync, zero= ZeRO sharded "
+                f"update) require fully replicated parameters/optimizer "
+                f"state (stage=0) — pick ONE sharding story per plan")
 
     def remesh(self, mesh: Mesh) -> "ShardingPlan":
         """Re-derive this plan over a DIFFERENT (usually smaller) mesh —
@@ -291,7 +307,8 @@ class ShardingPlan:
                             grad_sync=self.grad_sync,
                             grad_sync_block=self.grad_sync_block,
                             grad_sync_error_feedback=self
-                            .grad_sync_error_feedback)
+                            .grad_sync_error_feedback,
+                            zero=self.zero)
         plan.pspecs = dict(self.pspecs)
         if hasattr(self, "_pid_to_name"):
             plan._pid_to_name = dict(self._pid_to_name)
@@ -551,16 +568,55 @@ class ShardingPlan:
     # -- quantized grad-sync TrainStep hook (ISSUE 8) -----------------------
     def quant_sync_axis(self):
         """(axis_name, size) of the single data-parallel mesh axis the
-        quantized grad sync reduces over; raises when the plan has no
-        (or more than one) non-trivial data axis — the chain's
-        all_to_all/all_gather decomposition is built per axis."""
+        explicit shard_map paths (quantized grad sync, ZeRO update)
+        reduce over; raises when the plan has no (or more than one)
+        non-trivial data axis — the chain's all_to_all/all_gather
+        decomposition is built per axis."""
         axes = [a for a in self.data_axes if self.mesh.shape[a] > 1]
         if len(axes) != 1:
             raise ValueError(
-                f"quantized grad sync needs exactly one data-parallel "
+                f"the explicit data-parallel shard_map paths (grad_sync=/"
+                f"zero=) need exactly one data-parallel "
                 f"mesh axis of size > 1, plan has {axes or 'none'} "
                 f"(mesh {dict(self.mesh.shape)})")
         return axes[0], int(self.mesh.shape[axes[0]])
+
+    # -- ZeRO sharded-update TrainStep hooks (arxiv 2004.13336) -------------
+    def zero_armed(self) -> bool:
+        """True when this plan opted into ZeRO AND the FLAGS_zero kill
+        switch is up — the single arming predicate shared by TrainStep's
+        build and the checkpoint layout conversion."""
+        from ..framework import core as _core
+        return bool(self.zero) and _core.get_bool_flag("FLAGS_zero", True)
+
+    def zero_wire_config(self):
+        """The CommQuantConfig the ZeRO grad reduce-scatter puts on the
+        wire, or None for the exact psum_scatter path. Quantization
+        needs BOTH the plan's grad_sync opt-in and the quant kill
+        switch up (same arming as the pure grad_sync path)."""
+        from ..framework import core as _core
+        if self.grad_sync is None or \
+                not _core.get_bool_flag("FLAGS_quant_collectives", True):
+            return None
+        from ..quantization import comm as _qcomm
+        return _qcomm.resolve_config(self.grad_sync, self.grad_sync_block,
+                                     self.grad_sync_error_feedback)
+
+    def zero_block(self) -> int:
+        """Block size of the flat shard layout: the quant block when the
+        wire is quantized (payloads, EF residuals, and param/state
+        shards must agree on one partitioning), else 1 (minimal
+        padding)."""
+        cfg = self.zero_wire_config()
+        return cfg.block if cfg is not None else 1
+
+    def zero_layout(self, numel: int):
+        """(per_rank_shard, padded_total) of a numel-element tensor in
+        this plan's flat ZeRO layout — quantization/comm.py's
+        shard_sizes contract, padding at the tail."""
+        from ..quantization import comm as _qcomm
+        _axis, nranks = self.quant_sync_axis()
+        return _qcomm.shard_sizes(int(numel), nranks, self.zero_block())
 
     def compile_quantized_train_step(self, pure_local, donate):
         """Compile the quantized-grad-sync step: `pure_local` is the
@@ -641,3 +697,142 @@ class ShardingPlan:
                               scaler_state, step_i, lr, key, batch, ef)
 
         return run
+
+    def compile_zero_train_step(self, pure_local, donate):
+        """Compile the ZeRO sharded-update step: `pure_local` is the
+        PER-SHARD body (jit.TrainStep builds it — step_fn + backward +
+        collective.zero_grad_reduce_scatter + per-shard optimizer
+        update + collective.zero_param_all_gather), wrapped here in
+        shard_map over the plan's data axis. Params stay replicated
+        (enforced) but OPTIMIZER STATE rides sharded on the sync axis:
+        each state slot is a flat (s*nranks,)-padded vector of which
+        every rank materializes only its own (s,)-slice — the HBM win.
+        The error-feedback residual tree (quantized wire only) rides
+        sharded exactly as in the grad_sync path."""
+        from jax.experimental.shard_map import shard_map
+
+        mesh = self.mesh
+        axis, _n = self.quant_sync_axis()
+        repl = NamedSharding(mesh, P())
+        shax = NamedSharding(mesh, P(axis))
+
+        def _check_replicated(params):
+            for name in params:
+                spec = self.param_spec(name, params[name])
+                if any(e is not None for e in tuple(spec)):
+                    raise ValueError(
+                        f"the ZeRO sharded update requires fully "
+                        f"replicated parameters, but {name!r} has layout "
+                        f"{spec} — drop the TP annotation/param_rules or "
+                        f"set zero=0")
+
+        def compiled_factory(params, buffers, opt_state, master,
+                             scaler_state, step_i, lr, key, batch, ef):
+            _check_replicated(params)
+            batch_specs = jax.tree_util.tree_map(
+                lambda a: P(axis) if getattr(a, "ndim", 0) else P(), batch)
+            ef_specs = jax.tree_util.tree_map(lambda a: P(axis), ef)
+            os_specs = {k: P(axis) for k in opt_state}
+            in_specs = (P(), P(), os_specs, P(), P(), P(), P(), P(),
+                        batch_specs, ef_specs)
+            # opt_state widens inside the first step (slots created
+            # lazily PER-SHARD — priming would allocate the full-size
+            # state the mode exists to avoid), so the out tree is only
+            # known abstractly; P(axis) as a spec PREFIX covers every
+            # slot the body creates
+            out_specs = (P(), P(), P(), P(axis), P(), P(), ef_specs)
+            fn = shard_map(pure_local, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_rep=False)
+            batch_sh = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), batch_specs)
+            ef_sh = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), ef_specs)
+            in_shardings = (
+                {k: repl for k in params}, {k: repl for k in buffers},
+                {k: shax for k in opt_state}, {k: repl for k in master},
+                {k: repl for k in scaler_state}, repl, repl, repl,
+                batch_sh, ef_sh)
+            out_abs = jax.eval_shape(fn, params, buffers, opt_state,
+                                     master, scaler_state, step_i, lr,
+                                     key, batch, ef)
+            _, p_abs, b_abs, os_abs, mw_abs, sc_abs, _ef_abs = out_abs
+            out_shardings = (
+                repl, {k: repl for k in p_abs}, {k: repl for k in b_abs},
+                {k: shax for k in os_abs}, {k: repl for k in mw_abs},
+                {k: repl for k in sc_abs}, ef_sh)
+            return jax.jit(fn, in_shardings=in_shardings,
+                           out_shardings=out_shardings,
+                           donate_argnums=donate)
+
+        cache = {}
+
+        def run(params, buffers, opt_state, master, scaler_state, step_i,
+                lr, key, batch, ef):
+            struct = jax.tree_util.tree_structure(
+                (params, buffers, opt_state, master, scaler_state, batch,
+                 ef))
+            shapes = tuple(
+                (a.shape, str(a.dtype)) for a in
+                jax.tree_util.tree_leaves((params, opt_state, batch)))
+            sig = (struct, shapes)
+            if sig not in cache:
+                cache[sig] = compiled_factory(params, buffers, opt_state,
+                                              master, scaler_state, step_i,
+                                              lr, key, batch, ef)
+            return cache[sig](params, buffers, opt_state, master,
+                              scaler_state, step_i, lr, key, batch, ef)
+
+        return run
+
+
+def convert_zero_opt_state(saved, optimizer, plan=None):
+    """Re-layout a checkpointed optimizer state dict across ZeRO worlds.
+
+    ZeRO state checkpoints as flat (s*nranks,)-padded vectors (padding
+    at the TAIL — quantization/comm.py's shard_sizes contract), each
+    rank persisting only its own slice through dist_ckpt v2; dist_ckpt's
+    tiling verification reassembles them on load. The flat length is
+    world-size dependent, so restoring onto a different world (or back
+    onto a replicated/FLAGS_zero=0 run) needs this conversion:
+
+      * strip the tail padding of each slot (``ravel()[:numel]`` is
+        layout-invariant — replicated param-shaped state passes through
+        unchanged),
+      * re-pad/re-place for the TARGET: `plan` with an armed zero mode
+        re-pads to the new world's layout and shards it on the plan's
+        data axis; plan=None (or zero off/disarmed) reshapes back to
+        the param's own shape for the replicated update paths.
+
+    `saved` maps optimizer state_dict() keys ("{param_name}.{slot}") to
+    Tensors/arrays; returns a same-keyed dict ready for
+    optimizer.set_state_dict(). Non-tensor entries ("@step",
+    "LR_Scheduler") pass through untouched."""
+    from ..tensor import Tensor as _T
+    to_zero = plan is not None and plan.zero_armed()
+    if to_zero:
+        axis, nranks = plan.quant_sync_axis()
+        target_sh = NamedSharding(plan.mesh, P(axis))
+    prefix_map = {}
+    for i, p in enumerate(optimizer._parameter_list):
+        prefix_map.setdefault(f"{p.name or i}.", p)
+    out = {}
+    for k, v in saved.items():
+        p = None
+        if isinstance(k, str):
+            pos = k.find(".")
+            while pos != -1 and p is None:
+                p = prefix_map.get(k[:pos + 1])
+                pos = k.find(".", pos + 1)
+        if p is None:
+            out[k] = v
+            continue
+        arr = np.asarray(v.data if isinstance(v, _T) else v)
+        numel = int(p.data.size)
+        flat = arr.ravel()[:numel]
+        if to_zero:
+            s, padded = plan.zero_layout(numel)
+            out[k] = jax.device_put(
+                np.pad(flat, (0, padded - numel)), target_sh)
+        else:
+            out[k] = jnp.asarray(flat.reshape(p.data.shape))
+    return out

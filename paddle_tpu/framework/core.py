@@ -378,6 +378,13 @@ _flags: dict = {
     # scale on the wire).
     "FLAGS_quant_collectives": True,
     "FLAGS_quant_collectives_block": 256,
+    # -- ZeRO sharded optimizer update (consumed by jit.TrainStep +
+    # ShardingPlan(zero=)): armed capability for the explicit
+    # reduce-scatter -> per-shard update -> all-gather weight-update
+    # path (arxiv 2004.13336). Like FLAGS_quant_collectives it gates at
+    # TrainStep BUILD time, so 0 is a kill switch that compiles the
+    # exact pre-ZeRO replicated paths bitwise even for opted-in plans.
+    "FLAGS_zero": True,
     "FLAGS_cudnn_exhaustive_search": False,     # alias: force sweeps
     # -- numerics (consumed in _apply_flag -> jax matmul precision) ----
     "FLAGS_gemm_use_half_precision_compute_type": True,

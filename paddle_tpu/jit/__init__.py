@@ -244,6 +244,77 @@ def _quant_sync_grads(model, ef, axis, nranks, cfg):
     return new_ef
 
 
+# per-rank optimizer-state footprint of a compiled TrainStep (ISSUE 16):
+# recorded once per build, after the first step materializes the state —
+# the ZeRO HBM saving (and any regression) is visible in /metrics
+_OPT_STATE_BYTES = _om.gauge(
+    "train.opt_state_bytes",
+    "per-rank optimizer-state bytes of a compiled TrainStep by executable")
+
+
+def _per_rank_nbytes(arr):
+    """Bytes ONE rank holds of `arr`: the addressable-shard size for
+    sharded jax Arrays (ZeRO state slices), the full buffer for
+    replicated/host arrays."""
+    try:
+        if isinstance(arr, jax.Array) and len(arr.sharding.device_set) > 1:
+            shards = arr.addressable_shards
+            if shards:
+                return int(shards[0].data.nbytes)
+    except Exception:
+        pass
+    return int(getattr(arr, "nbytes", 0) or 0)
+
+
+def _zero_sharded_update(model, opt, ef, axis, nranks, stage, cfg, block):
+    """ZeRO-1/2 weight update (arxiv 2004.13336), inside the
+    shard_map-wrapped step body after backward: every trainable param's
+    LOCAL grad is mean-reduce-scattered over `axis`
+    (collective.zero_grad_reduce_scatter — quantized phase-1 chain when
+    `cfg` is armed), the optimizer update runs on THIS rank's flat
+    (s,)-shard of the param with shard-shaped accumulator state (lazily
+    zeros_like(w_shard) — 1/nranks the replicated footprint), and the
+    updated shards are all-gathered back to the replicated param
+    (collective.zero_param_all_gather, always exact). The flat layout is
+    quantization/comm.py's shard_sizes(numel, nranks, block) contract —
+    padding at the tail, so padded lanes carry zero grads and zero
+    moments and never reach the unpadded weights. Returns the updated
+    error-feedback residual tree (quantized wire only)."""
+    from ..distributed import collective as _coll
+    from ..quantization import comm as _qcomm
+    from ..tensor import Parameter
+    opt._step_count += 1
+    lr = opt.get_lr()
+    new_ef = dict(ef or {})
+    for k, t in model.state_dict().items():
+        if not (isinstance(t, Parameter) and not t.stop_gradient):
+            continue
+        g = t.grad
+        if g is None:
+            continue
+        garr = g.data if isinstance(g, Tensor) else g
+        res = ef[k].reshape(-1) if ef and k in ef else None
+        shard_g, new_res = _coll.zero_grad_reduce_scatter(
+            garr, axis=axis, nranks=nranks, stage=stage, block=block,
+            cfg=cfg, residual=res)
+        numel = int(t.data.size)
+        s, padded = _qcomm.shard_sizes(numel, nranks, block)
+        w_flat = jnp.pad(t.data.ravel(), (0, padded - numel))
+        start = jax.lax.axis_index(axis) * s
+        w_shard = jax.lax.dynamic_slice(w_flat, (start,), (s,))
+        gs = shard_g.astype(w_shard.dtype)
+        plr = lr * t.optimize_attr.get("learning_rate", 1.0) \
+            if hasattr(t, "optimize_attr") else lr
+        if t.regularizer is not None:
+            gs = gs + t.regularizer(w_shard)
+        new_shard = opt._apply_one(t, w_shard, gs, plr).astype(w_shard.dtype)
+        full = _coll.zero_param_all_gather(new_shard, axis=axis)
+        t.data = full[:numel].reshape(t.data.shape)
+        if new_res is not None and ef and k in ef:
+            new_ef[k] = new_res.reshape(ef[k].shape)
+    return new_ef
+
+
 # ordinal suffixes for TrainStep executable tags (see _exec_tag)
 _TRAIN_STEP_TAGS = itertools.count(1)
 
@@ -293,6 +364,36 @@ class TrainStep:
                     "quantized grad sync does not compose with "
                     "accumulate_steps > 1 yet — the gradient-merge scan "
                     "owns the backward/update interleaving")
+        if shard is not None and getattr(shard, "zero", 0):
+            if scaler is not None:
+                raise ValueError(
+                    "the ZeRO sharded update (ShardingPlan(zero=...)) is "
+                    "incompatible with a GradScaler: the reduce-scatter "
+                    "chain works on unscaled f32 gradients (bf16 training "
+                    "does not need loss scaling)")
+            if int(accumulate_steps) > 1:
+                raise ValueError(
+                    "the ZeRO sharded update does not compose with "
+                    "accumulate_steps > 1 yet — the gradient-merge scan "
+                    "owns the backward/update interleaving")
+            if getattr(optimizer, "_grad_clip", None) is not None:
+                raise ValueError(
+                    "the ZeRO sharded update does not support grad_clip "
+                    "yet: global-norm clipping needs a cross-shard norm "
+                    "before the per-shard update")
+            if getattr(optimizer, "_master_weights", None):
+                raise ValueError(
+                    "the ZeRO sharded update does not compose with amp O2 "
+                    "master weights yet (fp8/f32 master-weight sharding is "
+                    "a planned follow-on) — use amp level O1 or zero=0")
+            from ..optimizer.optimizer import ASGD, LBFGS, Lamb
+            if isinstance(optimizer, (Lamb, ASGD, LBFGS)):
+                raise ValueError(
+                    f"the ZeRO sharded update supports elementwise "
+                    f"per-shard optimizers only; "
+                    f"{type(optimizer).__name__} needs whole-parameter "
+                    f"reductions (trust ratios / multi-row state) — use "
+                    f"zero=0 or an Adam-family/SGD optimizer")
         # make the plan visible to DataLoader prefetchers so batches
         # stage straight into the mesh layout (io/prefetch.py picks up
         # the active plan's batch_spec at iteration time). Latest step
@@ -312,7 +413,10 @@ class TrainStep:
         self._step_flops = None   # executable cost_analysis FLOPs (MFU)
         self._accum = int(accumulate_steps)
         self._quant = None        # (axis, nranks, CommQuantConfig) at build
+        # (axis, nranks, zero_stage, cfg_or_None, block) at build
+        self._zero = None
         self._ef_state = None     # error-feedback residuals (dp-sharded)
+        self._opt_state_bytes = None  # per-rank bytes, set after build step
         if self._accum > 1 and scaler is not None:
             raise ValueError(
                 "accumulate_steps > 1 is incompatible with a GradScaler: "
@@ -328,9 +432,13 @@ class TrainStep:
         the sync axis so each dp shard carries its OWN residual across
         steps (optimizer-adjacent state — it is this TrainStep's, not
         the optimizer dict's, because it is per-rank rather than
-        replicated). Empty when error feedback is off."""
-        axis, nranks, cfg = self._quant
-        if not cfg.error_feedback:
+        replicated). Empty when error feedback is off (or the ZeRO wire
+        is exact)."""
+        if self._quant is not None:
+            axis, nranks, cfg = self._quant
+        else:
+            axis, nranks, _stage, cfg, _block = self._zero
+        if cfg is None or not cfg.error_feedback:
             return {}
         if self._ef_state is None:
             import numpy as _np
@@ -357,7 +465,22 @@ class TrainStep:
         # (FLAGS_quant_collectives=0) restores the plain GSPMD-psum
         # compile path bitwise, opted-in plan or not
         quant = None
-        if self.shard is not None and \
+        # the ZeRO sharded update likewise arms at BUILD time
+        # (FLAGS_zero=0 restores the replicated compile paths bitwise);
+        # when armed it OWNS the step body — grad_sync then only selects
+        # the wire mode of the ZeRO reduce-scatter
+        zero = None
+        if self.shard is not None and getattr(self.shard, "zero", 0) and \
+                self.shard.zero_armed():
+            axis, nranks = self.shard.quant_sync_axis()
+            if getattr(opt, "_master_weights", None):
+                raise ValueError(
+                    "the ZeRO sharded update does not compose with amp O2 "
+                    "master weights yet — use amp level O1 or zero=0")
+            cfg = self.shard.zero_wire_config()
+            zero = (axis, nranks, self.shard.zero, cfg,
+                    self.shard.zero_block())
+        elif self.shard is not None and \
                 getattr(self.shard, "grad_sync", None) and \
                 core.get_bool_flag("FLAGS_quant_collectives", True):
             from ..quantization import comm as _qcomm
@@ -367,6 +490,7 @@ class TrainStep:
                 self.shard.grad_sync_error_feedback)
             quant = (axis, nranks, cfg)
         self._quant = quant
+        self._zero = zero
 
         def run_accum(batch, key):
             """Gradient-merge path: lax.scan over k micro-batches, grads
@@ -446,12 +570,12 @@ class TrainStep:
             key = jax.random.wrap_key_data(key)
             key = jax.random.fold_in(
                 jax.random.fold_in(key, 0x54524E), step_i)
-            if quant is not None:
+            if quant is not None or zero is not None:
                 # per-shard randomness: the body runs once per dp shard
                 # (shard_map), each on its own batch slice — distinct
                 # dropout masks per shard, like the GSPMD global mask
                 key = jax.random.fold_in(
-                    key, jax.lax.axis_index(quant[0]))
+                    key, jax.lax.axis_index((quant or zero)[0]))
             state = {}
             state.update(params)
             state.update(buffers)
@@ -476,7 +600,16 @@ class TrainStep:
                         scaler._set_traced_state(scaler_state)
                     try:
                         new_ef = ef
-                        if quant is not None:
+                        if zero is not None:
+                            # ZeRO sharded update: backward yields LOCAL
+                            # grads (per-shard body); the rs -> shard
+                            # update -> ag sequence replaces opt.step()
+                            loss = step_fn(*_tree_box(batch))
+                            loss.backward()
+                            new_ef = _zero_sharded_update(
+                                model, opt, ef, zero[0], zero[1],
+                                zero[2], zero[3], zero[4])
+                        elif quant is not None:
                             # quantized DP sync: the body is per-shard
                             # (shard_map) so backward yields LOCAL
                             # grads; the explicit quantized chain is
@@ -514,12 +647,12 @@ class TrainStep:
                         opt._lr = saved_lr
                         if scaler is not None:
                             scaler._set_traced_state(saved_scaler)
-            if quant is not None:
+            if quant is not None or zero is not None:
                 # global loss = mean of the per-shard means; float
                 # buffers (BatchNorm running stats) likewise averaged so
                 # the replicated outputs are well-defined — each shard
                 # saw only its batch slice
-                axis = quant[0]
+                axis = (quant or zero)[0]
                 new_buffers = {
                     k: (jax.lax.pmean(v, axis)
                         if jnp.issubdtype(v.dtype, jnp.floating) else v)
@@ -540,7 +673,12 @@ class TrainStep:
         donate_ok = self._donate and (
             force_inplace or float(flag_gb or 0.0) >= 0.0)
         donate = (0, 1, 2, 3) if donate_ok else ()
-        if quant is not None:
+        if zero is not None:
+            # ef (arg 9) is consumed and returned every step, like quant
+            zdonate = donate + (9,) if donate_ok else ()
+            self._compiled = self.shard.compile_zero_train_step(
+                pure, zdonate)
+        elif quant is not None:
             # the error-feedback residual tree (arg 9) is donated too:
             # it is consumed and returned every step
             qdonate = donate + (9,) if donate_ok else ()
@@ -555,8 +693,15 @@ class TrainStep:
         if self._compiled is None:
             # materialize optimizer state before the first trace: otherwise
             # the state tree widens after step 1 and the whole step
-            # recompiles (minutes for large models)
-            if hasattr(self.optimizer, "prime"):
+            # recompiles (minutes for large models). NOT under an armed
+            # ZeRO plan: priming would allocate the full replicated
+            # state the mode exists to avoid — the body creates
+            # shard-shaped slots inside the first step instead (one
+            # extra compile, 1/nranks the state HBM from step 0 on)
+            zero_pending = (self.shard is not None
+                            and getattr(self.shard, "zero", 0)
+                            and self.shard.zero_armed())
+            if hasattr(self.optimizer, "prime") and not zero_pending:
                 self.optimizer.prime()
             self._build()
         opt = self.optimizer
@@ -604,7 +749,7 @@ class TrainStep:
         call_args = (params, buffers, dict(opt._state),
                      dict(opt._master_weights), scaler_state,
                      step_i, lr, key, batch_arrays)
-        if self._quant is not None:
+        if self._quant is not None or self._zero is not None:
             call_args = call_args + (self._ensure_ef_state(params),)
         if armed and self._step_flops is None:
             # must run BEFORE the call: args 0-3 are donated by it
@@ -617,7 +762,7 @@ class TrainStep:
                 outs = self._compiled(*call_args)
         else:
             outs = self._compiled(*call_args)
-        if self._quant is not None:
+        if self._quant is not None or self._zero is not None:
             (loss, new_params, new_buffers, new_opt_state, new_master,
              new_scaler, new_ef) = outs
             if new_ef:
@@ -632,6 +777,13 @@ class TrainStep:
             sd[k].data = v
         opt._state = dict(new_opt_state)
         opt._master_weights = dict(new_master)
+        if self._opt_state_bytes is None:
+            # the build step materialized every state slot (primed, or
+            # shard-created under ZeRO) — record the per-rank footprint
+            self._opt_state_bytes = self.opt_state_bytes_per_rank()
+            if armed:
+                _OPT_STATE_BYTES.set(self._opt_state_bytes,
+                                     executable=self._exec_tag)
         if self.scaler is not None:
             self.scaler._set_traced_state(new_scaler)
         opt._step_count += 1
@@ -678,6 +830,15 @@ class TrainStep:
             # the executable's own FLOPs feed the live MFU gauge
             _goodput.step_boundary(flops=self._step_flops)
         return Tensor(loss)
+
+    def opt_state_bytes_per_rank(self):
+        """Bytes of optimizer state (accumulators + amp master weights)
+        ONE rank holds: sharded ZeRO slots count a single shard,
+        replicated slots their full buffer. Also exported as the
+        train.opt_state_bytes gauge once per build."""
+        opt = self.optimizer
+        return sum(_per_rank_nbytes(v) for v in opt._state.values()) + \
+            sum(_per_rank_nbytes(v) for v in opt._master_weights.values())
 
     def _lower_flops(self, call_args):
         """The executable's own FLOP count via lowered.cost_analysis()

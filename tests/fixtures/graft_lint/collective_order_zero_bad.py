@@ -1,0 +1,21 @@
+"""Known-bad fixture: the ZeRO rs -> update -> ag sequence (ISSUE 16)
+inside rank-conditional code. The param all-gather is the step's
+convergence point — every rank must contribute its updated shard, so an
+ag reached by only some ranks parks the rest forever."""
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.collective import zero_grad_reduce_scatter
+
+
+def rank_gated_zero_unshard(shard, w, rank):
+    # the sharded update itself is fine per-rank, but gating the
+    # all-gather on rank 0 deadlocks ranks != 0 at their next collective
+    if rank == 0:
+        w = dist.zero_param_all_gather(shard, axis="dp")
+    return w
+
+
+def early_return_then_zero_rs(grad, rank):
+    if dist.get_rank() != 0:
+        return grad
+    shard, _ = zero_grad_reduce_scatter(grad, axis="dp", nranks=8)
+    return shard

@@ -164,6 +164,22 @@ def test_collective_order_covers_quantized_collectives():
                for m in msgs)
 
 
+def test_collective_order_covers_zero_sequence():
+    """ISSUE 16: the ZeRO rs -> update -> ag call names
+    (zero_grad_reduce_scatter / zero_param_all_gather) are flagged
+    inside rank-conditional code — the new sharded-update sequence
+    stays deadlock-checked."""
+    res = _run([CollectiveOrderPass()],
+               paths=[FIXTURES / "collective_order_zero_bad.py"])
+    msgs = [f.message for f in res.active]
+    assert len(msgs) == 2, "\n".join(msgs)
+    assert any("zero_param_all_gather" in m and
+               "inside a rank-conditional branch" in m for m in msgs)
+    assert any("zero_grad_reduce_scatter" in m and
+               "after the rank-conditional early return" in m
+               for m in msgs)
+
+
 # -- flags-hygiene -----------------------------------------------------------
 
 def test_flags_hygiene_catches_typo():

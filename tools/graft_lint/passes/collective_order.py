@@ -63,6 +63,11 @@ COLLECTIVES = {
     # blind spot
     "quantized_all_reduce", "quantized_reduce_scatter",
     "grad_sync_all_reduce",
+    # ZeRO sharded-update sequence (ISSUE 16): reduce-scatter grads ->
+    # per-shard update -> all-gather params. Each half is a collective
+    # every rank must reach — an ag (or rs) inside a rank branch parks
+    # the other ranks exactly like the exact/quantized chains above
+    "zero_grad_reduce_scatter", "zero_param_all_gather",
 }
 LAX_COLLECTIVES = {
     "psum", "pmax", "pmin", "pmean", "psum_scatter", "all_gather",
