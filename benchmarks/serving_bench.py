@@ -291,6 +291,149 @@ def run_spec(model, jobs, spec_on):
     return out
 
 
+# -- ISSUE 17: multi-replica fleet scenario ----------------------------------
+
+FLEET_MIN_REUSE_FRACTION = float(
+    os.environ.get("FLEET_MIN_REUSE_FRACTION", "0.9"))
+FLEET_RANDOM_MARGIN = float(os.environ.get("FLEET_RANDOM_MARGIN", "0.05"))
+
+
+def _fleet_workload(page=16, groups=4, per_group=6):
+    """Fleet traffic: `groups` tenants, each repeating a DISTINCT
+    48-token (3-page) shared prefix across `per_group` requests with
+    short unique suffixes. Affinity routing keeps each tenant pinned to
+    the replica whose cache holds its prefix; random routing scatters
+    the tenant across replicas and re-pays the prefill."""
+    rng = np.random.RandomState(31)
+    out = []
+    for _ in range(groups):
+        prefix = [int(t) for t in rng.randint(1, 256, 3 * page)]
+        out.append([prefix + [int(t) for t in
+                              rng.randint(1, 256, 4 + (i % 3))]
+                    for i in range(per_group)])
+    return out
+
+
+def _http_tokens(port, prompt, max_new=8):
+    import http.client
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+    c.request("POST", "/v1/generate",
+              body=json.dumps({"prompt": prompt,
+                               "max_new_tokens": max_new}))
+    r = c.getresponse()
+    raw = r.read().decode()
+    c.close()
+    toks = []
+    for block in raw.split("\n\n"):
+        block = block.strip()
+        if block.startswith("data: "):
+            toks += json.loads(block[len("data: "):])["tokens"]
+    return toks
+
+
+def run_fleet(model, groups, nreplicas, policy):
+    """Drive the fleet workload over `nreplicas` real gateway+engine
+    stacks behind a FleetRouter (in-process ports, the serving_bench
+    analog of `python -m paddle_tpu.inference.fleet`): a deterministic
+    warm pass pins tenant g's first request DIRECTLY to replica g%N
+    (modeling the per-replica cache state an affinity fleet accretes),
+    one probe refreshes the heat oracle, then every remaining request
+    goes through the router concurrently. Reports the AGGREGATE
+    prefix-reuse ratio (total pages reused / total cacheable pages seen
+    across the fleet) plus per-replica cache and routing stats."""
+    import threading
+
+    from paddle_tpu.inference import (EngineRunner, FleetRouter,
+                                      ServingGateway)
+    metrics.reset()
+    stacks = []
+    for _ in range(nreplicas):
+        eng = ContinuousBatchingEngine(
+            model, max_batch=4, max_seq=MAX_SEQ, prefill_buckets=BUCKETS,
+            page_size=16, max_chunk_tokens=16, ragged=True,
+            prefix_cache=True)
+        g = ServingGateway(runner=EngineRunner(eng), port=0,
+                           keepalive_s=5.0)
+        stacks.append((g, g.start(), eng))
+    router = FleetRouter(
+        endpoints=[("127.0.0.1", p) for _, p, _ in stacks], policy=policy)
+    router.probe_all()
+    router.start(probe=False)      # heat refresh is explicit, below
+    outputs = {}
+
+    def _one(gi, ri, prompt, port=None):
+        outputs[(gi, ri)] = _http_tokens(port or router.port, prompt)
+
+    t0 = time.perf_counter()
+    warm = [threading.Thread(
+                target=_one,
+                args=(gi, 0, reqs[0], stacks[gi % nreplicas][1]))
+            for gi, reqs in enumerate(groups)]
+    for t in warm:
+        t.start()
+    for t in warm:
+        t.join()
+    router.probe_all()             # the heat oracle now maps the tenants
+    rest = [threading.Thread(target=_one, args=(gi, ri, reqs[ri]))
+            for gi, reqs in enumerate(groups)
+            for ri in range(1, len(reqs))]
+    for t in rest:
+        t.start()
+    for t in rest:
+        t.join()
+    dt = time.perf_counter() - t0
+    reused = sum(e._pcache.pages_reused for _, _, e in stacks)
+    seen = sum(e._pcache.pages_seen for _, _, e in stacks)
+    per_replica = []
+    for rep, (_, _, eng) in zip(router.replicas, stacks):
+        per_replica.append({**rep.stats(),
+                            "prefix_cache": eng._pcache.stats()})
+    router.stop()
+    for g, _, _ in stacks:
+        g.stop()
+    n_req = sum(len(reqs) for reqs in groups)
+    return {
+        "seconds": round(dt, 4),
+        "requests": n_req,
+        "tokens_per_sec": round(8 * n_req / dt, 2),
+        "aggregate_reuse_ratio": round(reused / seen, 4) if seen else 0.0,
+        "pages_reused": int(reused), "pages_seen": int(seen),
+        "replicas": per_replica,
+        "outputs": [outputs[k] for k in sorted(outputs)],
+    }
+
+
+def _append_trend(value):
+    """One serving_fleet_prefix_reuse_ratio@<device> point in the
+    cross-round series (zero_bench idiom: atomic tmp+replace, series
+    capped at 50)."""
+    import jax
+    trend_p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_TREND.json")
+    try:
+        with open(trend_p) as f:
+            trend = json.load(f)
+    except (OSError, ValueError):
+        trend = {}
+    device = jax.devices()[0].platform
+    series = trend.setdefault(
+        f"serving_fleet_prefix_reuse_ratio@{device}", [])
+    series.append({
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "value": round(value, 4),
+        "unit": "reused_per_seen_page",
+        "device": device,
+    })
+    del series[:-50]
+    try:
+        tmp = trend_p + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(trend, f, indent=1)
+        os.replace(tmp, trend_p)
+    except OSError:
+        pass
+
+
 # -- ISSUE 10: overload scenario ---------------------------------------------
 
 def _overload_workload():
@@ -418,6 +561,25 @@ def main():
     spec_adv_ratio = (spec_adv_on["tokens_per_sec"]
                       / spec_adv_off["tokens_per_sec"])
 
+    # ISSUE 17 guard — the fleet must PRESERVE the cache win: routed
+    # through 2 replicas with prefix-affinity, the aggregate reuse
+    # ratio stays within FLEET_MIN_REUSE_FRACTION of a single replica
+    # (the prefix win does not dilute as the fleet scales), random
+    # routing measurably loses it (the ablation), and greedy outputs
+    # stay token-identical through every routing policy.
+    fgroups = _fleet_workload()
+    fleet_single = run_fleet(model, fgroups, nreplicas=1,
+                             policy="affinity")
+    fleet_affinity = run_fleet(model, fgroups, nreplicas=2,
+                               policy="affinity")
+    fleet_random = run_fleet(model, fgroups, nreplicas=2, policy="random")
+    fleet_identical = (fleet_single.pop("outputs")
+                       == fleet_affinity.pop("outputs")
+                       == fleet_random.pop("outputs"))
+    fleet_fraction = (fleet_affinity["aggregate_reuse_ratio"]
+                      / max(fleet_single["aggregate_reuse_ratio"], 1e-9))
+    _append_trend(fleet_affinity["aggregate_reuse_ratio"])
+
     prefix_toks, pjobs = _prefix_workload()
     pfx_off = run_prefix(model, pjobs, cache_on=False)
     pfx_on = run_prefix(model, pjobs, cache_on=True)
@@ -483,6 +645,18 @@ def main():
             "prefill_tokens_saved_expected": int(prefix_expected_saved),
             "reuse_ratio": pfx_on["prefix_cache"]["reuse_ratio"],
         },
+        "fleet": {
+            "workload": {"tenant_groups": len(fgroups),
+                         "requests_per_group": len(fgroups[0]),
+                         "prefix_pages": 3},
+            "single_replica": fleet_single,
+            "affinity_2_replicas": fleet_affinity,
+            "random_2_replicas": fleet_random,
+            "reuse_fraction_of_single": round(fleet_fraction, 4),
+            "min_reuse_fraction": FLEET_MIN_REUSE_FRACTION,
+            "random_margin": FLEET_RANDOM_MARGIN,
+            "token_identical_outputs": bool(fleet_identical),
+        },
     }
     print(json.dumps(report, indent=2))
     with open(ARTIFACT, "w") as f:
@@ -537,6 +711,26 @@ def main():
         print(f"FAIL: prefix cache saved {prefill_saved} prefill tokens, "
               f"expected exactly {prefix_expected_saved} (shared pages "
               f"must prefill once)", file=sys.stderr)
+        return 1
+    if not fleet_identical:
+        print("FAIL: fleet outputs diverge across routing policies",
+              file=sys.stderr)
+        return 1
+    if fleet_fraction < FLEET_MIN_REUSE_FRACTION:
+        print(f"FAIL: 2-replica affinity reuse ratio is "
+              f"{fleet_fraction:.2%} of single-replica "
+              f"(< {FLEET_MIN_REUSE_FRACTION:.0%}: the fleet dilutes "
+              f"the prefix-cache win)", file=sys.stderr)
+        return 1
+    if (fleet_random["aggregate_reuse_ratio"]
+            > fleet_affinity["aggregate_reuse_ratio"]
+            - FLEET_RANDOM_MARGIN):
+        print(f"FAIL: random routing reuse "
+              f"{fleet_random['aggregate_reuse_ratio']:.3f} is not "
+              f"measurably below affinity "
+              f"{fleet_affinity['aggregate_reuse_ratio']:.3f} (margin "
+              f"{FLEET_RANDOM_MARGIN}) — the affinity policy is not "
+              f"earning its keep", file=sys.stderr)
         return 1
     return 0
 

@@ -368,6 +368,12 @@ _flags: dict = {
     # 0 is the kill switch: no index, every page refcount-1, the engine
     # is token-identical AND allocation-identical to the uncached one
     "FLAGS_prefix_cache": True,
+    # serving fleet (consumed by inference/fleet.py): N supervised
+    # serve replicas behind the cache-affinity failover router
+    # (`python -m paddle_tpu.inference.fleet`). 0 is the kill switch:
+    # the fleet CLI collapses to a direct single-process
+    # `inference.serve` run — byte-identical wire behavior, no router
+    "FLAGS_serving_fleet": True,
     # -- quantized collectives (consumed by distributed/collective.py +
     # the jit.TrainStep/ShardingPlan grad-sync seam): armed capability
     # for the blockwise int8/fp8 communication path — quantization still

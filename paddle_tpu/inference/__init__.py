@@ -239,12 +239,16 @@ from .gateway import (EngineRunner, ServingGateway,  # noqa: E402,F401
                       build_engine, load_generation_model,
                       load_static_model, resolve_config,
                       save_for_serving)
+from .router import (FleetRouter, Replica,  # noqa: E402,F401
+                     ReplicaSupervisor, chain_key, head_key_hex)
 
 __all__ += ["ContinuousBatchingEngine", "GenerationRequest", "PagePool",
             "DeadlineExceeded", "QueueFull", "quantize_state_int8",
             "EngineRunner", "ServingGateway", "build_engine",
             "load_generation_model", "load_static_model",
-            "resolve_config", "save_for_serving"]
+            "resolve_config", "save_for_serving",
+            "FleetRouter", "Replica", "ReplicaSupervisor",
+            "chain_key", "head_key_hex"]
 
 
 def convert_to_mixed_precision(*a, **kw):

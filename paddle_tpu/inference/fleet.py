@@ -1,0 +1,183 @@
+"""`python -m paddle_tpu.inference.fleet` — run N supervised
+`inference.serve` replicas behind the cache-affinity failover router
+(ISSUE 17; see `inference/router.py` for the full contracts).
+
+Topology: this process supervises N replica subprocesses (each the
+ordinary single-engine serving stack on `--port 0`, identity-stamped
+with PADDLE_TRAINER_ID / PADDLE_INCARNATION and publishing its registry
+snapshot to `<log_dir>/metrics.rank{R}.inc{K}.json`) and serves the
+fleet front door:
+
+  POST /v1/generate  — prefix-affinity routed, failover on replica
+                       death, redirect-then-shed on backpressure
+  GET  /healthz      — 200 while ANY replica can take work
+  GET  /metrics      — federation-merged view of every replica + the
+                       router's own counters
+
+Signals: the first SIGTERM/SIGINT starts the zero-downtime ROLLING
+drain — the router stops accepting (healthz + submits flip 503),
+in-flight streams keep relaying, then each replica is SIGTERMed in turn
+through its own graceful-drain contract (finish streams, exit) — zero
+dropped in-flight streams. A second signal exits immediately.
+
+`FLAGS_serving_fleet=0` is the kill switch: the fleet CLI collapses to
+a direct single-process `inference.serve` run (same argv surface), so
+the wire behavior is bit-for-bit the pre-fleet stack.
+
+Example:
+  JAX_PLATFORMS=cpu python -m paddle_tpu.inference.fleet \\
+      --model /tmp/m --nreplicas 2 --port 8080
+  curl -N localhost:8080/v1/generate \\
+      -d '{"prompt": [3, 5, 7], "max_new_tokens": 8}'
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import tempfile
+import threading
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.inference.fleet",
+        description="supervised replica fleet behind the cache-affinity "
+                    "failover router")
+    p.add_argument("--model", required=True,
+                   help="artifact path prefix (jit.save / "
+                        "save_for_serving)")
+    p.add_argument("--config", default=None)
+    p.add_argument("--nreplicas", type=int, default=2)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080,
+                   help="router port; 0 picks a free port (printed at "
+                        "startup)")
+    p.add_argument("--policy", choices=("affinity", "random"),
+                   default="affinity",
+                   help="replica selection: prefix-cache heat oracle "
+                        "(default) or uniform random (the ablation "
+                        "baseline serving_bench measures against)")
+    p.add_argument("--log-dir", default=None,
+                   help="replica logs, metric snapshots and the "
+                        "fleet_events.jsonl flight recorder (default: "
+                        "a fresh temp dir, printed at startup)")
+    p.add_argument("--probe-interval", type=float, default=0.5)
+    p.add_argument("--max-restarts", type=int, default=5,
+                   help="per-replica relaunch budget before the "
+                        "supervisor gives a crash-looping replica up")
+    p.add_argument("--startup-timeout", type=float, default=180.0)
+    # pass-through engine/gateway knobs (one per serve.py flag)
+    p.add_argument("--max-batch", type=int, default=4)
+    p.add_argument("--max-seq", type=int, default=256)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--total-pages", type=int, default=None)
+    p.add_argument("--max-chunk-tokens", type=int, default=64)
+    p.add_argument("--max-queue-tokens", type=int, default=None)
+    p.add_argument("--max-draft-tokens", type=int, default=None)
+    p.add_argument("--quantize", choices=("int8",), default=None)
+    p.add_argument("--keepalive-s", type=float, default=0.5)
+    p.add_argument("--drain-timeout", type=float, default=30.0)
+    return p
+
+
+def _serve_argv(args, port: str) -> list:
+    argv = ["--model", args.model, "--host", args.host, "--port", port,
+            "--max-batch", str(args.max_batch),
+            "--max-seq", str(args.max_seq),
+            "--page-size", str(args.page_size),
+            "--max-chunk-tokens", str(args.max_chunk_tokens),
+            "--keepalive-s", str(args.keepalive_s),
+            "--drain-timeout", str(args.drain_timeout)]
+    if args.config is not None:
+        argv += ["--config", args.config]
+    if args.total_pages is not None:
+        argv += ["--total-pages", str(args.total_pages)]
+    if args.max_queue_tokens is not None:
+        argv += ["--max-queue-tokens", str(args.max_queue_tokens)]
+    if args.max_draft_tokens is not None:
+        argv += ["--max-draft-tokens", str(args.max_draft_tokens)]
+    if args.quantize is not None:
+        argv += ["--quantize", args.quantize]
+    return argv
+
+
+def main(argv=None):
+    args = _build_parser().parse_args(argv)
+    from ..framework.core import get_bool_flag
+    if not get_bool_flag("FLAGS_serving_fleet", True):
+        # kill switch: collapse to the direct single-process serving
+        # stack — byte-identical wire behavior, no router in the path
+        from . import serve
+        print("FLAGS_serving_fleet=0: single-replica pass-through",
+              flush=True)
+        return serve.main(_serve_argv(args, str(args.port)))
+
+    from .. import observability as obs
+    from .router import FleetRouter, ReplicaSupervisor
+    obs.enable(True)
+
+    log_dir = args.log_dir or tempfile.mkdtemp(prefix="paddle_fleet_")
+    os.makedirs(log_dir, exist_ok=True)
+    events = os.path.join(log_dir, "fleet_events.jsonl")
+
+    def argv_factory(rep):
+        # every replica picks its own free port; the supervisor parses
+        # it from the startup line (a relaunch may land elsewhere)
+        return [sys.executable, "-m", "paddle_tpu.inference.serve"] \
+            + _serve_argv(args, "0")
+
+    sup = ReplicaSupervisor(
+        argv_factory, args.nreplicas, host=args.host, log_dir=log_dir,
+        events_path=events, max_restarts=args.max_restarts)
+    sup.start()
+    try:
+        sup.wait_ready(timeout=args.startup_timeout)
+    except TimeoutError as e:
+        print(f"fleet startup failed: {e}", file=sys.stderr)
+        sup.stop()
+        return 2
+
+    router = FleetRouter(
+        replicas=sup.replicas, host=args.host, port=args.port,
+        snapshot_dir=log_dir, probe_interval_s=args.probe_interval,
+        policy=args.policy, recorder=sup.record)
+    router.probe_all()               # first heat/health view before traffic
+    port = router.start()
+    print(f"fleet serving on http://{args.host}:{port}  "
+          f"({args.nreplicas} replicas, policy={args.policy}, "
+          f"logs {log_dir})", flush=True)
+
+    stop = threading.Event()
+
+    def _drain_then_stop():
+        # rolling drain: reject new work at the router, keep relaying
+        # in-flight streams, then drain replicas one at a time through
+        # their own SIGTERM contract — zero dropped streams
+        router.drain()
+        sup.drain_rolling(per_replica_timeout=args.drain_timeout + 30)
+        router.wait_idle(timeout=args.drain_timeout)
+        stop.set()
+
+    def _on_signal(signum, frame):
+        if router.draining:             # second signal: leave now
+            stop.set()
+            return
+        print(f"signal {signum}: rolling drain "
+              f"({args.nreplicas} replicas)", flush=True)
+        threading.Thread(target=_drain_then_stop, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    try:
+        stop.wait()
+    finally:
+        router.stop()
+        sup.stop()
+    print("fleet drained, bye", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,7 +56,6 @@ scheduling contract, preserved under concurrency.
 from __future__ import annotations
 
 import json
-import math
 import os
 import queue
 import threading
@@ -66,6 +65,7 @@ from typing import Optional
 from ..observability import export as _oexp
 from ..observability import metrics as _metrics
 from ..utils.fault_injection import fault_point
+from .router import _retry_after_header
 from .serving import ContinuousBatchingEngine, GenerationRequest, QueueFull
 
 __all__ = ["EngineRunner", "ServingGateway", "resolve_config",
@@ -392,6 +392,15 @@ class ServingGateway:
     def _health_provider(self) -> dict:
         out = {"accepting": self.accepting, "draining": self.draining,
                "port": self.port}
+        # fleet identity (ISSUE 17): a supervised replica carries its
+        # index + incarnation so the router's probe can verify it is
+        # talking to the RELAUNCHED process, not a stale socket
+        rid = os.environ.get("PADDLE_TRAINER_ID")
+        if rid is not None:
+            out["replica"] = rid
+        inc = os.environ.get("PADDLE_INCARNATION")
+        if inc is not None:
+            out["incarnation"] = inc
         if self.runner is not None:
             out["engine"] = self.runner.health()
         return out
@@ -411,7 +420,7 @@ class ServingGateway:
             extra = {}
             if status != 200:
                 retry = body.get("engine", {}).get("retry_after_s", 1.0)
-                extra["Retry-After"] = str(max(1, math.ceil(retry)))
+                extra["Retry-After"] = _retry_after_header(retry)
             self._json(h, status, body, extra)
             return
         if path in ("", "/metrics"):
@@ -491,12 +500,14 @@ class ServingGateway:
             stream = self.runner.submit(req)
         except QueueFull as e:
             # the engine's backpressure contract on the wire: finite
-            # Retry-After from the observed token throughput
+            # Retry-After from the observed token throughput, clamped
+            # to the fleet-wide ceiling (a degenerate hint must never
+            # park a client for an hour — ISSUE 17)
             self._json(h, 429,
                        {"error": str(e),
                         "retry_after_s": round(e.retry_after_s, 3)},
-                       {"Retry-After":
-                        str(max(1, math.ceil(e.retry_after_s)))})
+                       {"Retry-After": _retry_after_header(
+                           e.retry_after_s)})
             return
         except ValueError as e:         # oversized prompt, rejected at submit
             self._json(h, 400, {"error": str(e)})

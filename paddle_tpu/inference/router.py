@@ -1,0 +1,976 @@
+"""Fault-tolerant serving fleet: replica supervision + cache-affinity
+failover routing over `inference.serve` replicas (ISSUE 17).
+
+One serving process on one chip dies with a single SIGKILL, wedged tick
+or deploy. This module is the data plane that survives all three,
+stdlib-only in its own logic (ThreadingHTTPServer + http.client — no
+jax, no numpy — the same discipline as `gateway.py` and
+`observability/federation.py`, so a routing tier bakes into a serving
+image without a backend):
+
+* `ReplicaSupervisor` — spawns N `python -m paddle_tpu.inference.serve`
+  subprocesses and relaunches dead ones under fresh INCARNATION ids
+  with capped exponential backoff and a restart budget (the
+  `launch --elastic_level 1` supervisor idiom). Every lifecycle event —
+  replica_spawn / replica_death / replica_relaunch / replica_giveup /
+  replica_eject / replica_readmit / replica_drained — lands as one
+  crash-safe JSONL line (`observability.export.append_jsonl`), the
+  flight-recorder record a postmortem greps.
+
+* `FleetRouter` — one `POST /v1/generate` front door over the replica
+  set:
+
+  - **prefix-affinity routing**: the request prompt's first full page
+    is hashed with `chain_key` — the SAME blake2b chain the engine's
+    `_PrefixCache` keys on (`serving._PrefixCache._key` delegates here,
+    so router and replica agree by construction) — and looked up in
+    each replica's exported heat oracle (`health_snapshot()` →
+    `prefix_cache.heat`, refreshed by the active prober). The replica
+    already holding the hot prefix gets the request and its 8x
+    shared-prefix TTFT win; cold prompts go least-loaded.
+  - **failure detection**: passive (connect errors, mid-stream socket
+    death during a relay) plus an active `/healthz` prober; failures
+    EJECT a replica from rotation, probe-success streaks re-admit it.
+  - **failure handling end-to-end**: a request that has not yet
+    streamed a token fails over transparently to another replica with
+    bounded retries + jittered backoff; a mid-stream death emits a
+    structured `event: error` SSE frame (never a silent hang);
+    429+Retry-After from a replica redirects to the next candidate and
+    sheds at FLEET scope (min observed Retry-After, clamped) only when
+    every replica is backpressured.
+  - **fleet `/metrics` + `/healthz`**: per-replica registry snapshots
+    (each replica publishes `metrics.rank{R}.inc{K}.json` via
+    FLAGS_metrics_snapshot) merge through
+    `observability.federation.merge_snapshots` — counters sum into
+    job-level cells, gauges stay per-replica, relaunched incarnations
+    relabel — with the router's own registry riding along as
+    rank="router". `/healthz` answers 200 while ANY replica can take
+    work, so a 1-of-N death never flips the fleet unready.
+
+Fault points: `router.dispatch` (each dispatch attempt),
+`router.probe` (each active health probe), `router.relaunch` (each
+supervisor respawn) — schedule via FLAGS_fault_inject, same grammar as
+every other chaos point.
+
+`python -m paddle_tpu.inference.fleet` (fleet.py) wires both into a
+CLI with a rolling SIGTERM drain: stop accepting at the router, then
+SIGTERM replicas one at a time through their existing drain semantics
+— zero dropped in-flight streams, the zero-downtime rollout primitive.
+"""
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import math
+import os
+import random
+import re
+import signal
+import struct
+import subprocess
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..observability import export as _oexp
+from ..observability import federation as _ofed
+from ..observability import metrics as _metrics
+from ..utils.fault_injection import fault_point
+
+__all__ = ["chain_key", "head_key_hex", "Replica", "ReplicaSupervisor",
+           "FleetRouter", "RETRY_AFTER_CEILING_S"]
+
+# ceiling for every Retry-After the fleet emits or relays: a degenerate
+# throughput estimate must never tell a client to come back in an hour
+RETRY_AFTER_CEILING_S = 60.0
+
+_ROUTED = _metrics.counter(
+    "router.routed_total",
+    "requests dispatched to a replica, labeled by replica index")
+_AFFINITY = _metrics.counter(
+    "router.affinity_hits_total",
+    "dispatches that followed the prefix-cache heat oracle (the "
+    "router-side cache-hit counter), labeled by replica index")
+_FAILOVER = _metrics.counter(
+    "router.failovers_total",
+    "dispatch attempts abandoned for another replica, labeled by the "
+    "replica that failed")
+_SHED = _metrics.counter(
+    "router.sheds_total",
+    "requests answered 429/503 at fleet scope (no replica available)")
+_EJECT = _metrics.counter(
+    "router.ejections_total",
+    "replicas removed from rotation, labeled by replica index")
+_READMIT = _metrics.counter(
+    "router.readmissions_total",
+    "ejected replicas returned to rotation, labeled by replica index")
+_RELAUNCH = _metrics.counter(
+    "router.relaunches_total",
+    "dead replica respawns, labeled by replica index")
+
+
+# ---------------- the shared chain hash -------------------------------------
+
+def chain_key(parent: bytes, toks) -> bytes:
+    """The `_PrefixCache` chain hash — THE single source of truth:
+    blake2b(parent_key, digest_size=16) over the page's token ids as
+    little-endian int64 (bit-identical to the engine's former
+    `np.asarray(toks, np.int64).tobytes()` form). `serving._PrefixCache`
+    delegates its `_key` here, so the router's affinity lookup and the
+    replica's cache index can never disagree about what a prefix
+    hashes to."""
+    h = hashlib.blake2b(parent, digest_size=16)
+    h.update(struct.pack("<%dq" % len(toks), *(int(t) for t in toks)))
+    return h.digest()
+
+
+def head_key_hex(prompt, page_size: int) -> Optional[str]:
+    """Chain-HEAD key (hex) of `prompt`'s first full page — the unit the
+    heat oracle is keyed on — or None when the prompt has no cacheable
+    page. Mirrors `_PrefixCache.lookup`'s `(len-1)//page` rule: at
+    least one trailing token always stays uncached, so a prompt needs
+    page_size+1 tokens before its head page can be indexed."""
+    if page_size <= 0 or (len(prompt) - 1) // page_size < 1:
+        return None
+    return chain_key(b"", prompt[:page_size]).hex()
+
+
+# ---------------- replica state ---------------------------------------------
+
+class Replica:
+    """One serving backend as the fleet sees it. The supervisor owns
+    spawn/port/incarnation, the router owns routing state — both under
+    the router's lock once attached."""
+
+    def __init__(self, idx: int, host: str = "127.0.0.1",
+                 port: Optional[int] = None):
+        self.idx = int(idx)
+        self.host = host
+        self.port = port
+        self.incarnation = 0
+        self.pid: Optional[int] = None
+        # starting -> healthy <-> ejected; dead = restart budget spent
+        self.state = "starting"
+        self.accepting = True        # optimistic until the first probe
+        self.retry_after_s = 1.0
+        self.heat: Dict[str, int] = {}   # chain-head hex -> cached pages
+        self.heat_page_size = 0
+        self.consecutive_fail = 0
+        self.consecutive_ok = 0
+        self.inflight = 0
+        self.routed_total = 0
+        self.affinity_hits = 0
+        self.failovers = 0
+        self.ejections = 0
+
+    @property
+    def routable(self) -> bool:
+        return (self.port is not None
+                and self.state in ("starting", "healthy"))
+
+    def stats(self) -> dict:
+        return {"idx": self.idx, "port": self.port, "pid": self.pid,
+                "incarnation": self.incarnation, "state": self.state,
+                "accepting": self.accepting, "inflight": self.inflight,
+                "routed_total": self.routed_total,
+                "affinity_hits": self.affinity_hits,
+                "failovers": self.failovers,
+                "ejections": self.ejections,
+                "hot_prefixes": len(self.heat)}
+
+
+# ---------------- replica supervision ---------------------------------------
+
+_STARTUP_PORT_RE = re.compile(r"http://[^:\s]+:(\d+)")
+
+
+class ReplicaSupervisor:
+    """Spawn N replica subprocesses; relaunch the dead under fresh
+    incarnation ids with capped backoff (the `launch --elastic_level 1`
+    idiom scaled down to one host). Ports are discovered from each
+    child's startup line (`serving on http://host:port ...` — children
+    run `--port 0`), so a relaunched replica may come back on a NEW
+    port: the shared `Replica` record is updated in place and the
+    router's next probe picks it up.
+
+    Each child gets PADDLE_TRAINER_ID / PADDLE_INCARNATION plus
+    FLAGS_metrics_snapshot=<log_dir>/metrics.rank{R}.inc{K}.json, so
+    the fleet /metrics merge sees exactly the federation layer's
+    per-rank snapshot files."""
+
+    def __init__(self, argv_factory, nreplicas: int,
+                 host: str = "127.0.0.1", log_dir: Optional[str] = None,
+                 events_path: Optional[str] = None,
+                 max_restarts: int = 5, backoff_base_s: float = 0.5,
+                 backoff_cap_s: float = 8.0):
+        self.argv_factory = argv_factory
+        self.replicas = [Replica(i, host=host) for i in range(nreplicas)]
+        self.log_dir = log_dir
+        self.events_path = events_path
+        self.max_restarts = int(max_restarts)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.draining = False
+        self._procs: Dict[int, subprocess.Popen] = {}
+        self._restarts: Dict[int, int] = {}
+        self._respawn_at: Dict[int, float] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._rng = random.Random(0xF1EE7)
+
+    # -- flight recorder ------------------------------------------------------
+
+    def record(self, rec: dict) -> None:
+        """One JSONL flight-recorder line (append + flush: survives the
+        supervisor itself being killed). Also the router's eject/readmit
+        recorder when wired through FleetRouter(recorder=...)."""
+        if self.events_path:
+            try:
+                _oexp.append_jsonl(self.events_path,
+                                   {"ts": round(time.time(), 3), **rec})
+            except OSError:
+                pass                 # telemetry must not kill the fleet
+
+    # -- spawn / relaunch -----------------------------------------------------
+
+    def _spawn(self, rep: Replica) -> None:
+        env = dict(os.environ)
+        env["PADDLE_TRAINER_ID"] = str(rep.idx)
+        env["PADDLE_INCARNATION"] = str(rep.incarnation)
+        if self.log_dir:
+            env["FLAGS_metrics_snapshot"] = os.path.join(
+                self.log_dir,
+                f"metrics.rank{rep.idx}.inc{rep.incarnation}.json")
+        p = subprocess.Popen(
+            self.argv_factory(rep), env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        with self._lock:
+            self._procs[rep.idx] = p
+            rep.pid = p.pid
+            rep.port = None
+            rep.state = "starting"
+            rep.accepting = True
+            rep.heat = {}
+            rep.consecutive_ok = rep.consecutive_fail = 0
+        threading.Thread(target=self._read_child, args=(rep, p),
+                         daemon=True,
+                         name=f"replica{rep.idx}-stdout").start()
+        self.record({"ev": "replica_spawn", "replica": rep.idx,
+                     "incarnation": rep.incarnation, "pid": p.pid})
+
+    def _read_child(self, rep: Replica, p: subprocess.Popen) -> None:
+        """Tee the child's stdout to a per-incarnation log and parse the
+        startup line for its port (children run `--port 0`)."""
+        logf = None
+        if self.log_dir:
+            try:
+                logf = open(os.path.join(
+                    self.log_dir,
+                    f"replica{rep.idx}.inc{rep.incarnation}.log"), "a")
+            except OSError:
+                logf = None
+        try:
+            for line in p.stdout:
+                if logf is not None:
+                    logf.write(line)
+                    logf.flush()
+                if rep.port is None and "serving on http://" in line:
+                    m = _STARTUP_PORT_RE.search(line)
+                    if m:
+                        with self._lock:
+                            rep.port = int(m.group(1))
+        except (OSError, ValueError):
+            pass
+        finally:
+            if logf is not None:
+                logf.close()
+
+    def start(self) -> "ReplicaSupervisor":
+        for rep in self.replicas:
+            self._spawn(rep)
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._monitor, daemon=True, name="fleet-supervisor")
+            self._thread.start()
+        return self
+
+    def wait_ready(self, timeout: float = 120.0) -> None:
+        """Block until every non-dead replica has reported a port."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                pending = [r for r in self.replicas
+                           if r.state != "dead" and r.port is None]
+            if not pending:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"replicas never reported a port: "
+            f"{[r.idx for r in pending]}")
+
+    def _monitor(self) -> None:
+        """Death watch: a dead child (outside a drain) is relaunched
+        under the next incarnation after a capped, jittered backoff;
+        the restart budget turns a crash LOOP into a terminal 'dead'
+        state instead of an infinite respawn storm."""
+        while not self._stop.wait(0.1):
+            now = time.monotonic()
+            for rep in self.replicas:
+                with self._lock:
+                    p = self._procs.get(rep.idx)
+                    due = self._respawn_at.get(rep.idx)
+                if due is not None:
+                    if now >= due:
+                        with self._lock:
+                            self._respawn_at.pop(rep.idx, None)
+                            rep.incarnation += 1
+                        fault_point("router.relaunch")
+                        self._spawn(rep)
+                        _RELAUNCH.inc(replica=str(rep.idx))
+                        self.record({"ev": "replica_relaunch",
+                                     "replica": rep.idx,
+                                     "incarnation": rep.incarnation})
+                    continue
+                if p is None or p.poll() is None or self.draining:
+                    continue
+                if rep.state == "dead":
+                    continue
+                rc = p.returncode
+                self.record({"ev": "replica_death", "replica": rep.idx,
+                             "incarnation": rep.incarnation, "rc": rc})
+                with self._lock:
+                    self._procs.pop(rep.idx, None)
+                    rep.port = None
+                    rep.state = "ejected"   # out of rotation immediately
+                    n = self._restarts[rep.idx] = \
+                        self._restarts.get(rep.idx, 0) + 1
+                if n > self.max_restarts:
+                    with self._lock:
+                        rep.state = "dead"
+                    self.record({"ev": "replica_giveup",
+                                 "replica": rep.idx,
+                                 "restarts": n - 1})
+                    continue
+                backoff = min(self.backoff_cap_s,
+                              self.backoff_base_s * (2 ** (n - 1)))
+                backoff *= 0.5 + self._rng.random()   # jitter 0.5x-1.5x
+                with self._lock:
+                    self._respawn_at[rep.idx] = now + backoff
+
+    # -- drain / stop ---------------------------------------------------------
+
+    def drain_rolling(self, per_replica_timeout: float = 60.0) -> bool:
+        """Rolling drain, one replica at a time: SIGTERM (the child's
+        own graceful-drain contract — finish in-flight streams, then
+        exit), wait for exit, move on. Returns True when every child
+        exited inside its budget. Marks the supervisor draining FIRST
+        so the death watch never relaunches a drained replica."""
+        self.draining = True
+        ok = True
+        for rep in self.replicas:
+            with self._lock:
+                p = self._procs.get(rep.idx)
+            if p is None or p.poll() is not None:
+                continue
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                continue
+            try:
+                p.wait(timeout=per_replica_timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+                ok = False
+            self.record({"ev": "replica_drained", "replica": rep.idx,
+                         "incarnation": rep.incarnation,
+                         "rc": p.returncode})
+        return ok
+
+    def stop(self) -> None:
+        self.draining = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        with self._lock:
+            procs = list(self._procs.values())
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+
+
+# ---------------- the fleet router ------------------------------------------
+
+class FleetRouter:
+    """Cache-affinity failover router over a set of `Replica` backends.
+    See the module docstring for the routing / failure-handling /
+    metrics contracts. `replicas` may come from a `ReplicaSupervisor`
+    (shared records, updated across relaunches) or be built from static
+    `endpoints=[(host, port), ...]` for in-process fleets (tests,
+    serving_bench)."""
+
+    def __init__(self, replicas: Optional[List[Replica]] = None,
+                 endpoints: Optional[List[Tuple[str, int]]] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 snapshot_dir: Optional[str] = None,
+                 probe_interval_s: float = 0.5,
+                 probe_timeout_s: float = 2.0,
+                 eject_after: int = 2, readmit_after: int = 2,
+                 max_retries: int = 3, backoff_base_s: float = 0.05,
+                 backoff_cap_s: float = 0.5,
+                 stream_timeout_s: float = 30.0,
+                 policy: str = "affinity", recorder=None):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        if replicas is None:
+            replicas = [Replica(i, host=h, port=p)
+                        for i, (h, p) in enumerate(endpoints or [])]
+        if not replicas:
+            raise ValueError("router needs replicas= or endpoints=")
+        if policy not in ("affinity", "random"):
+            raise ValueError(f"unknown routing policy {policy!r}")
+        self.replicas = replicas
+        self.snapshot_dir = snapshot_dir
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.eject_after = int(eject_after)
+        self.readmit_after = int(readmit_after)
+        self.max_retries = int(max_retries)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.stream_timeout_s = float(stream_timeout_s)
+        self.policy = policy
+        self.recorder = recorder
+        self.draining = False
+        self.inflight = 0
+        self.lock = threading.RLock()
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        self._rng = random.Random(0x5EED)
+        rt = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"   # close-delimited SSE bodies
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                rt._handle_get(self)
+
+            def do_POST(self):
+                rt._handle_post(self)
+
+        self._server = ThreadingHTTPServer((host, int(port)), _Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self, probe: bool = True) -> int:
+        """Serve; `probe=False` skips the background prober (tests and
+        benches drive `probe_all()` by hand for determinism)."""
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._server.serve_forever, name="router-http",
+                daemon=True)
+            self._thread.start()
+        if probe and (self._probe_thread is None
+                      or not self._probe_thread.is_alive()):
+            self._stop.clear()
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, name="router-probe", daemon=True)
+            self._probe_thread.start()
+        return self.port
+
+    def drain(self) -> None:
+        """Stop accepting new work (healthz + submits flip 503);
+        in-flight relays keep streaming — the rolling-drain first
+        phase."""
+        self.draining = True
+
+    def wait_idle(self, timeout: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self.lock:
+                if self.inflight == 0:
+                    return True
+            time.sleep(0.02)
+        return False
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5)
+
+    def _record(self, rec: dict) -> None:
+        if self.recorder is not None:
+            try:
+                self.recorder(rec)
+            except Exception:
+                pass
+
+    # -- active probing / ejection / re-admission -----------------------------
+
+    def _probe_loop(self) -> None:
+        while not self._stop.is_set():
+            self.probe_all()
+            self._stop.wait(self.probe_interval_s)
+
+    def probe_all(self) -> None:
+        for rep in self.replicas:
+            if rep.state == "dead" or rep.port is None:
+                continue
+            self._probe_one(rep)
+
+    def _probe_one(self, rep: Replica) -> None:
+        try:
+            fault_point("router.probe")
+            conn = http.client.HTTPConnection(
+                rep.host, rep.port, timeout=self.probe_timeout_s)
+            conn.request("GET", "/healthz")
+            r = conn.getresponse()
+            body = json.loads(r.read() or b"{}")
+            status = r.status
+            conn.close()
+        except Exception:
+            self._probe_failed(rep)
+            return
+        # any well-formed answer means the process is ALIVE — 503 only
+        # says it is draining/saturated, which gates routing via
+        # `accepting`, not membership
+        with self.lock:
+            rep.consecutive_fail = 0
+            rep.consecutive_ok += 1
+            rep.accepting = status == 200
+            eng = body.get("engine") or {}
+            rep.retry_after_s = float(eng.get("retry_after_s", 1.0))
+            inc = body.get("incarnation")
+            if inc is not None:
+                try:
+                    rep.incarnation = int(inc)
+                except (TypeError, ValueError):
+                    pass
+            pc = eng.get("prefix_cache") or {}
+            heat = pc.get("heat")
+            if isinstance(heat, dict):
+                rep.heat = {str(k): int(v) for k, v in heat.items()}
+                rep.heat_page_size = int(pc.get("page_size", 0))
+            if rep.state == "starting":
+                rep.state = "healthy"
+            elif (rep.state == "ejected"
+                    and rep.consecutive_ok >= self.readmit_after):
+                rep.state = "healthy"
+                _READMIT.inc(replica=str(rep.idx))
+                self._record({"ev": "replica_readmit",
+                              "replica": rep.idx,
+                              "incarnation": rep.incarnation})
+
+    def _probe_failed(self, rep: Replica) -> None:
+        with self.lock:
+            rep.consecutive_ok = 0
+            rep.consecutive_fail += 1
+            if (rep.state == "healthy"
+                    and rep.consecutive_fail >= self.eject_after):
+                self._eject(rep, "probe failures")
+
+    def _eject(self, rep: Replica, reason: str) -> None:
+        """Caller holds self.lock."""
+        if rep.state in ("ejected", "dead"):
+            return
+        rep.state = "ejected"
+        rep.ejections += 1
+        rep.consecutive_ok = 0
+        _EJECT.inc(replica=str(rep.idx))
+        self._record({"ev": "replica_eject", "replica": rep.idx,
+                      "incarnation": rep.incarnation, "reason": reason})
+
+    def _passive_fail(self, rep: Replica, reason: str) -> None:
+        """Connect/mid-stream failure observed on the request path: the
+        replica leaves rotation NOW (a refused connect means the
+        process is gone — waiting out eject_after probes would keep
+        routing real traffic at a corpse); the prober re-admits it
+        after `readmit_after` consecutive successes."""
+        with self.lock:
+            rep.consecutive_ok = 0
+            rep.consecutive_fail += 1
+            self._eject(rep, reason)
+
+    # -- routing --------------------------------------------------------------
+
+    def _head_hex(self, prompt) -> Optional[str]:
+        if not isinstance(prompt, list) or not prompt or \
+                not all(isinstance(t, int) for t in prompt):
+            return None
+        with self.lock:
+            page = next((r.heat_page_size for r in self.replicas
+                         if r.heat_page_size), 0)
+        return head_key_hex(prompt, page) if page else None
+
+    def _pick(self, head_hex: Optional[str],
+              exclude: set) -> Tuple[Optional[Replica], bool]:
+        """(replica, via_affinity). Healthy+accepting candidates first;
+        'starting' replicas count too (optimistic first contact — a
+        failure ejects them through the passive path)."""
+        with self.lock:
+            cands = [r for r in self.replicas
+                     if r.routable and r.accepting
+                     and r.idx not in exclude]
+            if not cands:
+                return None, False
+            if self.policy == "random":
+                return self._rng.choice(cands), False
+            if head_hex:
+                hot = [r for r in cands if r.heat.get(head_hex)]
+                if hot:
+                    return max(hot, key=lambda r: (r.heat[head_hex],
+                                                   -r.inflight)), True
+            return min(cands, key=lambda r: (r.inflight, r.idx)), False
+
+    # -- GET ------------------------------------------------------------------
+
+    def _handle_get(self, h) -> None:
+        path = h.path.split("?", 1)[0].rstrip("/")
+        if path == "/healthz":
+            self._healthz(h)
+        elif path in ("", "/metrics"):
+            try:
+                text = self.metrics_text()
+            except Exception as exc:
+                self._json(h, 500,
+                           {"error": f"{type(exc).__name__}: {exc}"})
+                return
+            self._raw(h, 200, "text/plain; version=0.0.4",
+                      text.encode())
+        else:
+            self._json(h, 404, {"error": f"no route for {h.path!r}"})
+
+    def _healthz(self, h) -> None:
+        with self.lock:
+            stats = [r.stats() for r in self.replicas]
+            usable = [r for r in self.replicas
+                      if r.routable and r.accepting]
+            hints = [r.retry_after_s for r in self.replicas
+                     if r.port is not None]
+        accepting = bool(usable) and not self.draining
+        body = {"accepting": accepting, "draining": self.draining,
+                "port": self.port, "policy": self.policy,
+                "healthy_replicas": len(usable),
+                "replicas": stats}
+        extra = {}
+        if not accepting:
+            extra["Retry-After"] = _retry_after_header(
+                min(hints) if hints else 1.0)
+        self._json(h, 200 if accepting else 503, body, extra)
+
+    def metrics_text(self) -> str:
+        """Fleet-level exposition: every replica's published registry
+        snapshot + the router's own registry, merged through
+        federation's defined semantics (counters sum into job-level
+        cells, gauges keep per-rank cells, stale/superseded
+        incarnations flagged)."""
+        snaps = []
+        if self.snapshot_dir:
+            snaps = _ofed.read_snapshots(self.snapshot_dir)
+        snaps.append({"ts": time.time(), "metrics": _metrics.snapshot(),
+                      "rank": "router", "incarnation": "0"})
+        return _oexp.prometheus_text(_ofed.merge_snapshots(snaps))
+
+    # -- POST (the request plane) --------------------------------------------
+
+    def _handle_post(self, h) -> None:
+        path = h.path.split("?", 1)[0].rstrip("/")
+        if path not in ("/v1/generate", "/v1/infer"):
+            self._json(h, 404, {"error": f"no route for {h.path!r}"})
+            return
+        try:
+            n = int(h.headers.get("Content-Length") or 0)
+            raw = h.rfile.read(n) if n else b"{}"
+            try:
+                spec = json.loads(raw or b"{}")
+            except ValueError:
+                spec = {}
+            with self.lock:
+                self.inflight += 1
+            try:
+                self._dispatch(h, path, raw, spec)
+            finally:
+                with self.lock:
+                    self.inflight -= 1
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as exc:       # one request fails, not the router
+            try:
+                self._json(h, 500,
+                           {"error": f"{type(exc).__name__}: {exc}"})
+            except Exception:
+                pass
+
+    def _dispatch(self, h, path: str, raw: bytes, spec: dict) -> None:
+        if self.draining:
+            self._json(h, 503, {"error": "fleet is draining"},
+                       {"Retry-After": "1"})
+            return
+        head = self._head_hex(spec.get("prompt")) \
+            if path == "/v1/generate" else None
+        state = {"headers_sent": False, "tokens": 0, "terminal": False}
+        tried: set = set()
+        saw_429: Optional[float] = None
+        for attempt in range(self.max_retries + 1):
+            rep, via_affinity = self._pick(head, tried)
+            if rep is None:
+                break
+            try:
+                # inside the try: an armed raise is indistinguishable
+                # from a connect failure, so it drives the real
+                # bounded-retry failover path
+                fault_point("router.dispatch")
+                conn = http.client.HTTPConnection(
+                    rep.host, rep.port, timeout=self.stream_timeout_s)
+                conn.request("POST", path, body=raw,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+            except Exception:
+                self._passive_fail(rep, "connect/submit failed")
+                tried.add(rep.idx)
+                with self.lock:
+                    rep.failovers += 1
+                _FAILOVER.inc(replica=str(rep.idx))
+                self._backoff(attempt)
+                continue
+            if resp.status == 429:
+                # redirect-then-shed: remember the hint, try the next
+                # candidate; only a fully backpressured fleet sheds
+                saw_429 = self._min_hint(saw_429, resp)
+                with self.lock:
+                    rep.accepting = False
+                    if saw_429 is not None:
+                        rep.retry_after_s = saw_429
+                tried.add(rep.idx)
+                conn.close()
+                continue
+            if resp.status in (500, 503) and not _has_outcome(resp):
+                # replica-health failure (draining gateway / handler
+                # crash), NOT a generation outcome — fail over.
+                # _has_outcome consumed the body; the conn is done.
+                tried.add(rep.idx)
+                with self.lock:
+                    rep.accepting = False
+                    rep.failovers += 1
+                _FAILOVER.inc(replica=str(rep.idx))
+                conn.close()
+                self._backoff(attempt)
+                continue
+            # a real answer (stream, JSON outcome, or a 4xx the client
+            # must see) — account the dispatch and relay it
+            with self.lock:
+                rep.routed_total += 1
+                rep.inflight += 1
+                if via_affinity:
+                    rep.affinity_hits += 1
+            _ROUTED.inc(replica=str(rep.idx))
+            if via_affinity:
+                _AFFINITY.inc(replica=str(rep.idx))
+            try:
+                ctype = resp.getheader("Content-Type", "") or ""
+                if resp.status == 200 and "text/event-stream" in ctype:
+                    outcome = self._relay_sse(h, resp, rep, state)
+                else:
+                    outcome = self._relay_plain(h, resp)
+            finally:
+                with self.lock:
+                    rep.inflight -= 1
+                conn.close()
+            if outcome == "retry":
+                # upstream died before ANY token reached the client:
+                # transparent failover
+                self._passive_fail(rep, "died before first token")
+                tried.add(rep.idx)
+                with self.lock:
+                    rep.failovers += 1
+                _FAILOVER.inc(replica=str(rep.idx))
+                self._backoff(attempt)
+                continue
+            if outcome == "mid_stream_death":
+                # tokens already streamed — the stream cannot be
+                # replayed; the client got a structured error frame
+                self._passive_fail(rep, "died mid-stream")
+                with self.lock:
+                    rep.failovers += 1
+                _FAILOVER.inc(replica=str(rep.idx))
+            return
+        # candidates exhausted: shed at fleet scope
+        _SHED.inc()
+        with self.lock:
+            hints = [r.retry_after_s for r in self.replicas
+                     if r.port is not None and r.state != "dead"]
+        if saw_429 is not None:
+            hints.append(saw_429)
+        retry = min(hints) if hints else 1.0
+        if state["headers_sent"]:
+            self._error_frame(h, state, "shed",
+                              "no replica available (fleet saturated)")
+            return
+        status = 429 if saw_429 is not None else 503
+        self._json(h, status,
+                   {"error": "no replica available",
+                    "retry_after_s": round(
+                        _clamp_retry(retry), 3)},
+                   {"Retry-After": _retry_after_header(retry)})
+
+    def _backoff(self, attempt: int) -> None:
+        base = min(self.backoff_cap_s,
+                   self.backoff_base_s * (2 ** attempt))
+        time.sleep(base * (0.5 + self._rng.random() * 0.5))
+
+    @staticmethod
+    def _min_hint(cur: Optional[float], resp) -> Optional[float]:
+        try:
+            resp.read()              # drain the 429 body
+        except Exception:
+            pass
+        try:
+            hint = float(resp.getheader("Retry-After", "1") or 1)
+        except ValueError:
+            hint = 1.0
+        return hint if cur is None else min(cur, hint)
+
+    # -- relays ---------------------------------------------------------------
+
+    def _relay_sse(self, h, resp, rep: Replica, state: dict) -> str:
+        """Frame-preserving SSE relay: upstream bytes are split on the
+        frame delimiter and re-emitted VERBATIM (byte-identical bodies
+        — the nreplicas=1 parity bar), while the router tracks whether
+        a token frame has reached the client (the failover window) and
+        whether the terminal frame arrived (anything else is a
+        mid-stream death). Returns 'done' | 'retry' |
+        'mid_stream_death' | 'client_gone'."""
+        if not state["headers_sent"]:
+            h.send_response(200)
+            h.send_header("Content-Type", "text/event-stream")
+            h.send_header("Cache-Control", "no-cache")
+            h.send_header("Connection", "close")
+            h.end_headers()
+            state["headers_sent"] = True
+        buf = b""
+        while True:
+            try:
+                chunk = resp.read1(65536)
+            except Exception:
+                chunk = b""              # upstream died / read timeout
+            if not chunk:
+                if state["terminal"]:
+                    return "done"
+                return "retry" if state["tokens"] == 0 \
+                    else self._mid_stream(h, rep, state)
+            buf += chunk
+            while b"\n\n" in buf:
+                frame, buf = buf.split(b"\n\n", 1)
+                try:
+                    h.wfile.write(frame + b"\n\n")
+                    h.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    return "client_gone"   # closing resp cancels upstream
+                if frame.startswith(b"data:"):
+                    try:
+                        state["tokens"] += len(
+                            json.loads(frame[5:])["tokens"])
+                    except (ValueError, KeyError, TypeError):
+                        state["tokens"] += 1
+                elif frame.startswith(b"event:"):
+                    state["terminal"] = True
+            if state["terminal"]:
+                return "done"
+
+    def _mid_stream(self, h, rep: Replica, state: dict) -> str:
+        self._error_frame(
+            h, state, "failed",
+            f"replica {rep.idx} (incarnation {rep.incarnation}) "
+            f"died mid-stream")
+        return "mid_stream_death"
+
+    def _error_frame(self, h, state: dict, status: str,
+                     error: str) -> None:
+        """The structured terminal frame the gateway contract promises:
+        a client mid-stream NEVER sees a silent close."""
+        payload = {"status": status, "n_tokens": state["tokens"],
+                   "error": error}
+        try:
+            h.wfile.write(b"event: error\ndata: "
+                          + json.dumps(payload).encode() + b"\n\n")
+            h.wfile.flush()
+        except Exception:
+            pass
+
+    def _relay_plain(self, h, resp) -> str:
+        """Buffer-then-relay for JSON answers (stream:false, 4xx,
+        generation outcomes): nothing reaches the client until the
+        whole upstream body arrived, so an upstream death here is
+        always transparently retryable."""
+        try:
+            body = resp.read()
+        except Exception:
+            return "retry"
+        extra = {}
+        ra = resp.getheader("Retry-After")
+        if ra:
+            extra["Retry-After"] = ra
+        self._raw(h, resp.status,
+                  resp.getheader("Content-Type", "application/json")
+                  or "application/json", body, extra)
+        return "done"
+
+    # -- response helpers -----------------------------------------------------
+
+    def _json(self, h, status, obj, extra_headers=None):
+        self._raw(h, status, "application/json",
+                  json.dumps(obj).encode(), extra_headers)
+
+    def _raw(self, h, status, ctype, body, extra_headers=None):
+        try:
+            h.send_response(status)
+            h.send_header("Content-Type", ctype)
+            h.send_header("Content-Length", str(len(body)))
+            for k, v in (extra_headers or {}).items():
+                h.send_header(k, v)
+            h.end_headers()
+            h.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+
+
+def _has_outcome(resp) -> bool:
+    """True when a non-200 answer carries a GENERATION outcome (shed /
+    deadline_missed / failed from `_collect`) rather than a
+    replica-health error: outcomes are terminal and must reach the
+    client; health errors fail over. Consumes the response body and
+    stashes it on the response for the relay."""
+    try:
+        body = resp.read()
+    except Exception:
+        return False
+    resp.read = lambda *a, **k: body      # replay for _relay_plain
+    try:
+        return "status" in json.loads(body or b"{}")
+    except ValueError:
+        return False
+
+
+def _clamp_retry(seconds: float) -> float:
+    return max(0.01, min(float(seconds), RETRY_AFTER_CEILING_S))
+
+
+def _retry_after_header(seconds: float) -> str:
+    return str(max(1, math.ceil(_clamp_retry(seconds))))

@@ -109,8 +109,16 @@ def main(argv=None):
         endpoints.insert(0, "POST /v1/generate")
     if static_model is not None:
         endpoints.insert(1, "POST /v1/infer")
+    # a fleet-supervised replica announces its identity (the supervisor
+    # parses the port from this line; the identity also rides /healthz
+    # so the router can verify a relaunched incarnation)
+    ident = ""
+    rid = os.environ.get("PADDLE_TRAINER_ID")
+    if rid is not None:
+        ident = (f"  [replica {rid} "
+                 f"inc {os.environ.get('PADDLE_INCARNATION', '0')}]")
     print(f"serving on http://{args.host}:{port}  "
-          f"({', '.join(endpoints)})", flush=True)
+          f"({', '.join(endpoints)}){ident}", flush=True)
 
     stop = threading.Event()
 

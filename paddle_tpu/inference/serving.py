@@ -130,7 +130,6 @@ width, which is what decode (memory-bound) is priced by.
 """
 from __future__ import annotations
 
-import hashlib
 import time
 import weakref
 from dataclasses import dataclass, field
@@ -144,6 +143,8 @@ from ..framework import core as _core
 from ..observability import device_events as _devev
 from ..observability import metrics as _metrics
 from ..utils.fault_injection import fault_point
+from .router import RETRY_AFTER_CEILING_S
+from .router import chain_key as _chain_key
 
 __all__ = ["GenerationRequest", "ContinuousBatchingEngine", "PagePool",
            "quantize_state_int8", "DeadlineExceeded", "QueueFull"]
@@ -489,12 +490,17 @@ class _PrefixCache:
         self.pages_reused = 0
         self.pages_seen = 0          # cacheable prompt pages offered to lookup
         self.evictions = 0
+        # heat-oracle memo, keyed (epoch, entry count): inserts change
+        # the count, drops bump the epoch — same invalidation story as
+        # the engine's probe memo (ISSUE 17)
+        self._heat_memo: Tuple[Optional[tuple], Dict[str, int]] = (None, {})
         pool.attach_cache(self)
 
     def _key(self, parent: bytes, toks: List[int]) -> bytes:
-        h = hashlib.blake2b(parent, digest_size=16)
-        h.update(np.asarray(toks, np.int64).tobytes())
-        return h.digest()
+        # single source of truth shared with the fleet router's
+        # affinity lookup (router.chain_key) — the cross-process heat
+        # oracle only works if both sides hash a page identically
+        return _chain_key(parent, toks)
 
     def owns(self, page: int) -> bool:
         return page in self.by_page
@@ -621,6 +627,37 @@ class _PrefixCache:
             stack.extend(self.entries[k] for k in e.children
                          if k in self.entries)
         return freed
+
+    def heat(self, cap: int = 64) -> Dict[str, int]:
+        """The per-replica heat oracle the fleet router routes on
+        (ISSUE 17, the seam ROADMAP names): chain-HEAD key (hex) ->
+        cached pages reachable under that head. Side-effect-free like
+        `probe` — no incref, no LRU touch, no counters — and memoized
+        on (epoch, entry count), the same invalidation rule as the
+        admission-ordering probe memo: an insert only grows a subtree
+        (count changes), a drop can shrink one (epoch bumps). Capped
+        at the `cap` hottest heads so the /healthz payload the router
+        polls stays bounded."""
+        key = (self.epoch, len(self.entries))
+        memo_key, memo = self._heat_memo
+        if memo_key == key:
+            return memo
+        out: Dict[str, int] = {}
+        for head in self._root_children:
+            pages = 0
+            stack = [head]
+            while stack:
+                e = self.entries.get(stack.pop())
+                if e is None:
+                    continue
+                pages += 1
+                stack.extend(e.children)
+            out[head.hex()] = pages
+        if len(out) > cap:
+            out = dict(sorted(out.items(),
+                              key=lambda kv: -kv[1])[:cap])
+        self._heat_memo = (key, out)
+        return out
 
     def stats(self) -> dict:
         return {"entries": len(self.entries),
@@ -1078,10 +1115,14 @@ class ContinuousBatchingEngine:
 
     def _retry_after_hint(self, overflow_tokens: int) -> float:
         """Seconds until ~overflow_tokens of queue should have drained,
-        from the EMA token throughput; 1s floor before any tick has
-        been measured (no rate to extrapolate from)."""
-        if self._tokens_per_s > 0:
-            return max(overflow_tokens / self._tokens_per_s, 0.01)
+        from the EMA token throughput. Bounded on BOTH ends (ISSUE 17):
+        a cold engine (no tick measured yet) or a degenerate near-zero
+        EMA — idle ticks decay it arbitrarily low — must answer a
+        finite default instead of telling a client to come back in a
+        year; the ceiling matches the router/gateway Retry-After clamp."""
+        if self.ticks > 0 and self._tokens_per_s > 1e-6:
+            return min(max(overflow_tokens / self._tokens_per_s, 0.01),
+                       RETRY_AFTER_CEILING_S)
         return 1.0
 
     def _bucket(self, T):
@@ -1964,7 +2005,13 @@ class ContinuousBatchingEngine:
             },
         }
         if self._pcache is not None:
-            snap["prefix_cache"] = self._pcache.stats()
+            # the router's affinity seam: chain-head heat + the page
+            # size it must hash at ride the snapshot, so routing needs
+            # no extra round trip (ISSUE 17)
+            snap["prefix_cache"] = {**self._pcache.stats(),
+                                    "page_size": self._pcache.page,
+                                    "epoch": self._pcache.epoch,
+                                    "heat": self._pcache.heat()}
         if not accepting:
             snap["retry_after_s"] = round(self._retry_after_hint(
                 max(queued - self.max_queue_tokens, 1)), 3)

@@ -47,7 +47,12 @@ per-incarnation worker spawn). The serving engine (ISSUE 10) adds
 boundary — an armed ``raise`` exercises per-request quarantine, a
 ``delay`` a wedged tick the engine watchdog must catch),
 ``serving.admit`` (``add_request`` under the SLO layer), and
-``serving.page_alloc`` (every KV page-pool allocation).
+``serving.page_alloc`` (every KV page-pool allocation). The serving
+fleet (ISSUE 17) adds ``router.dispatch`` (each replica dispatch
+attempt — an armed ``raise`` exercises the bounded-retry failover
+path), ``router.probe`` (each active /healthz probe — failures drive
+ejection), and ``router.relaunch`` (each supervisor respawn of a dead
+replica).
 
 Every point literal is linted by graft-lint's ``fault-point-hygiene``
 pass: unique to one module, ``subsystem.name`` snake_case, and listed

@@ -1,0 +1,687 @@
+"""Fault-tolerant serving fleet (ISSUE 17): the shared chain hash +
+heat oracle, bounded Retry-After hints, and the FleetRouter's routing /
+failover / ejection / drain / metrics contracts — exercised against
+stdlib fake replicas (wire-exact gateway emulations with failure knobs)
+plus a real-engine pass for the nreplicas=1 byte-parity bar and the
+affinity cache win. The subprocess chaos drill (SIGKILL a real replica
+mid-stream) lives in test_serving_fleet_chaos.py."""
+import hashlib
+import http.client
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import (ContinuousBatchingEngine, EngineRunner,
+                                  FleetRouter, GenerationRequest, PagePool,
+                                  ServingGateway, chain_key, head_key_hex)
+from paddle_tpu.inference.router import (RETRY_AFTER_CEILING_S,
+                                         _clamp_retry, _retry_after_header)
+from paddle_tpu.inference.serving import _PrefixCache
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.utils import fault_injection as fi
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    fi.configure(None)
+    obs.enable(False)
+
+
+def _tiny_model(seed=0):
+    paddle.seed(seed)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=128, use_recompute=False)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+# ---------------- wire helpers ----------------------------------------------
+
+def _post(port, body, timeout=30):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    c.request("POST", "/v1/generate", body=json.dumps(body))
+    return c.getresponse()
+
+
+def _get(port, path, timeout=10):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    c.request("GET", path)
+    return c.getresponse()
+
+
+def _sse_frames(raw: str):
+    frames, terminal = [], None
+    for block in raw.split("\n\n"):
+        block = block.strip()
+        if block.startswith("data: "):
+            frames.append(json.loads(block[len("data: "):])["tokens"])
+        elif block.startswith("event: "):
+            name, _, data = block.partition("\n")
+            terminal = (name[len("event: "):],
+                        json.loads(data[len("data: "):]))
+    return frames, terminal
+
+
+def _reference_generate(model, prompt, n_new):
+    out = model.generate(paddle.to_tensor(np.array([prompt], np.int32)),
+                         max_new_tokens=n_new, do_sample=False)
+    return [int(t) for t in np.asarray(out.numpy())[0][:n_new]]
+
+
+# ---------------- the fake replica ------------------------------------------
+
+class _FakeReplica:
+    """A wire-exact stand-in for one `inference.serve` replica: speaks
+    the gateway's /healthz JSON and /v1/generate SSE contracts from
+    plain stdlib, with knobs for heat advertisement, 429 backpressure,
+    health-vs-outcome 503s, pre-token and mid-stream death, and an
+    abrupt `kill()` (the SIGKILL moral equivalent: refuse new connects,
+    snap open streams with no terminal frame)."""
+
+    def __init__(self, port=0, heat=None, page_size=4, n_frames=3,
+                 tokens_per_frame=2, frame_delay_s=0.0, mode="serve",
+                 die_after_frames=1, retry_after=0.25,
+                 retry_header="1", incarnation=0, accepting=True):
+        self.cfg = {"heat": dict(heat or {}), "page_size": page_size,
+                    "n_frames": n_frames,
+                    "tokens_per_frame": tokens_per_frame,
+                    "frame_delay_s": frame_delay_s, "mode": mode,
+                    "die_after_frames": die_after_frames,
+                    "retry_after": retry_after,
+                    "retry_header": retry_header,
+                    "incarnation": incarnation, "accepting": accepting}
+        self.requests = []          # prompts that reached /v1/generate
+        self.die = threading.Event()
+        fake = self
+
+        class _H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                fake._healthz(self)
+
+            def do_POST(self):
+                fake._generate(self)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), _H)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def kill(self):
+        self.die.set()
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass
+
+    stop = kill
+
+    # -- handlers -------------------------------------------------------------
+
+    def _send_json(self, h, status, obj, extra=None):
+        body = json.dumps(obj).encode()
+        h.send_response(status)
+        h.send_header("Content-Type", "application/json")
+        h.send_header("Content-Length", str(len(body)))
+        for k, v in (extra or {}).items():
+            h.send_header(k, v)
+        h.end_headers()
+        h.wfile.write(body)
+
+    def _healthz(self, h):
+        c = dict(self.cfg)
+        accepting = c["accepting"]
+        body = {"accepting": accepting, "draining": False,
+                "port": self.port, "incarnation": str(c["incarnation"]),
+                "engine": {"accepting": accepting,
+                           "retry_after_s": c["retry_after"],
+                           "prefix_cache": {"heat": c["heat"],
+                                            "page_size": c["page_size"]}}}
+        self._send_json(h, 200 if accepting else 503, body)
+
+    def _generate(self, h):
+        n = int(h.headers.get("Content-Length") or 0)
+        spec = json.loads(h.rfile.read(n) or b"{}")
+        self.requests.append(spec.get("prompt"))
+        c = dict(self.cfg)
+        mode = c["mode"]
+        if mode == "429":
+            self._send_json(
+                h, 429, {"error": "queue full",
+                         "retry_after_s": c["retry_after"]},
+                {"Retry-After": c["retry_header"]})
+            return
+        if mode == "outcome_503":       # a generation OUTCOME: relay it
+            self._send_json(h, 503, {"status": "shed", "n_tokens": 0,
+                                     "error": "shed by slo"})
+            return
+        if mode == "health_503":        # replica-health error: fail over
+            self._send_json(h, 503, {"error": "gateway is draining"},
+                            {"Retry-After": "1"})
+            return
+        h.send_response(200)
+        h.send_header("Content-Type", "text/event-stream")
+        h.send_header("Cache-Control", "no-cache")
+        h.send_header("Connection", "close")
+        h.end_headers()
+        if mode == "die_pretoken":
+            return                      # headers then EOF: zero tokens out
+        tok = 0
+        for i in range(c["n_frames"]):
+            if mode == "die_midstream" and i >= c["die_after_frames"]:
+                return                  # abrupt EOF, no terminal frame
+            if self.die.is_set():
+                return
+            frame = {"tokens": list(range(tok, tok + c["tokens_per_frame"]))}
+            tok += c["tokens_per_frame"]
+            try:
+                h.wfile.write(b"data: " + json.dumps(frame).encode()
+                              + b"\n\n")
+                h.wfile.flush()
+            except OSError:
+                return
+            if c["frame_delay_s"]:
+                time.sleep(c["frame_delay_s"])
+            if self.die.is_set():
+                return
+        try:
+            h.wfile.write(b"event: end\ndata: " + json.dumps(
+                {"status": "served", "n_tokens": tok}).encode() + b"\n\n")
+            h.wfile.flush()
+        except OSError:
+            pass
+
+
+def _router(fakes, **kw):
+    """Router over fake replicas: no background prober (tests drive
+    probe_all() by hand for determinism), tiny failover backoff."""
+    kw.setdefault("backoff_base_s", 0.01)
+    kw.setdefault("backoff_cap_s", 0.02)
+    r = FleetRouter(endpoints=[("127.0.0.1", f.port) for f in fakes], **kw)
+    r.probe_all()
+    r.start(probe=False)
+    return r
+
+
+# page_size 4 everywhere below: [1,2,3,4,99] has exactly one cacheable
+# head page, [1,2,3,4] none (lookup's at-least-one-trailing-token rule)
+_PROMPT = [1, 2, 3, 4, 99]
+_HEAD = head_key_hex(_PROMPT, 4)
+
+
+# ---------------- chain hash + heat oracle ----------------------------------
+
+class TestChainKey:
+    def test_bit_identical_to_engine_form(self):
+        # the engine formerly hashed np.asarray(toks, int64).tobytes();
+        # chain_key must never drift from that or every deployed cache
+        # key changes under users' feet
+        for toks in ([7], [1, 2, 3, 4], [0, -5, 2 ** 40], list(range(16))):
+            h = hashlib.blake2b(b"parent", digest_size=16)
+            h.update(np.asarray(toks, np.int64).tobytes())
+            assert chain_key(b"parent", toks) == h.digest()
+
+    def test_prefix_cache_delegates(self):
+        pc = _PrefixCache(PagePool(8, page_size=4), page_size=4)
+        assert pc._key(b"", [1, 2, 3, 4]) == chain_key(b"", [1, 2, 3, 4])
+
+    def test_head_key_boundaries(self):
+        assert head_key_hex(_PROMPT, 4) == chain_key(b"", [1, 2, 3, 4]).hex()
+        assert head_key_hex([1, 2, 3, 4], 4) is None   # no trailing token
+        assert head_key_hex([1, 2], 4) is None
+        assert head_key_hex(_PROMPT, 0) is None
+
+    def test_chaining(self):
+        k1 = chain_key(b"", [1, 2, 3, 4])
+        assert chain_key(k1, [5, 6, 7, 8]) != chain_key(b"", [5, 6, 7, 8])
+
+
+class TestHeatOracle:
+    def _cache(self):
+        return _PrefixCache(PagePool(32, page_size=4), page_size=4)
+
+    def test_heat_counts_subtree_pages(self):
+        pc = self._cache()
+        k1 = pc.insert(b"", [1, 2, 3, 4], 1)
+        pc.insert(k1, [5, 6, 7, 8], 2)
+        k3 = pc.insert(b"", [9, 9, 9, 9], 3)
+        assert pc.heat() == {k1.hex(): 2, k3.hex(): 1}
+
+    def test_memo_and_invalidation(self):
+        pc = self._cache()
+        k1 = pc.insert(b"", [1, 2, 3, 4], 1)
+        first = pc.heat()
+        assert pc.heat() is first           # memo hit: same object
+        pc.insert(k1, [5, 6, 7, 8], 2)      # entry count changed
+        assert pc.heat() == {k1.hex(): 2}
+
+    def test_heat_is_side_effect_free(self):
+        pc = self._cache()
+        pc.insert(b"", [1, 2, 3, 4], 1)
+        before = (pc.hits, pc.misses, pc.pages_reused, pc._clock)
+        pc.heat()
+        assert (pc.hits, pc.misses, pc.pages_reused, pc._clock) == before
+
+    def test_heat_capped(self):
+        pc = self._cache()
+        for i in range(10):
+            pc.insert(b"", [i, i, i, i], i)
+        assert len(pc.heat(cap=4)) == 4
+
+    def test_health_snapshot_exports_heat(self, model):
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_seq=64,
+                                       page_size=4, max_chunk_tokens=8)
+        eng.add_request(GenerationRequest(prompt=list(_PROMPT),
+                                          max_new_tokens=4))
+        for _ in range(40):
+            if not eng.has_work:
+                break
+            eng.step()
+        pc = eng.health_snapshot()["prefix_cache"]
+        assert pc["page_size"] == 4
+        assert pc["heat"].get(_HEAD, 0) >= 1
+        assert "epoch" in pc
+
+
+class TestRetryAfterBounds:
+    def test_cold_engine_finite_default(self):
+        hint = ContinuousBatchingEngine._retry_after_hint(
+            SimpleNamespace(ticks=0, _tokens_per_s=0.0), 10_000)
+        assert hint == 1.0
+
+    def test_degenerate_ema_clamped(self):
+        hint = ContinuousBatchingEngine._retry_after_hint(
+            SimpleNamespace(ticks=100, _tokens_per_s=1e-3), 1000)
+        assert hint == RETRY_AFTER_CEILING_S
+
+    def test_healthy_ema_passes_through(self):
+        hint = ContinuousBatchingEngine._retry_after_hint(
+            SimpleNamespace(ticks=10, _tokens_per_s=100.0), 50)
+        assert hint == pytest.approx(0.5)
+
+    def test_header_clamps(self):
+        assert _retry_after_header(1e9) == "60"
+        assert _retry_after_header(0.2) == "1"
+        assert _clamp_retry(-5.0) == 0.01
+
+
+# ---------------- routing over fake replicas --------------------------------
+
+class TestRouting:
+    def test_affinity_routes_to_hot_replica(self):
+        a = _FakeReplica(heat={_HEAD: 3})
+        b = _FakeReplica()
+        r = _router([a, b])
+        try:
+            resp = _post(r.port, {"prompt": _PROMPT, "max_new_tokens": 4})
+            assert resp.status == 200
+            _, terminal = _sse_frames(resp.read().decode())
+            assert terminal[0] == "end"
+            assert len(a.requests) == 1 and not b.requests
+            hz = json.loads(_get(r.port, "/healthz").read())
+            assert hz["replicas"][0]["affinity_hits"] == 1
+        finally:
+            r.stop(), a.stop(), b.stop()
+
+    def test_cold_prompt_goes_least_loaded(self):
+        a, b = _FakeReplica(), _FakeReplica()
+        r = _router([a, b])
+        try:
+            resp = _post(r.port, {"prompt": [1, 2], "max_new_tokens": 2})
+            assert resp.status == 200
+            resp.read()
+            # no heat anywhere: least-loaded, idx tiebreak -> replica 0
+            assert len(a.requests) == 1 and not b.requests
+        finally:
+            r.stop(), a.stop(), b.stop()
+
+    def test_random_policy_spreads(self):
+        a, b = _FakeReplica(heat={_HEAD: 3}), _FakeReplica()
+        r = _router([a, b], policy="random")
+        try:
+            for _ in range(12):
+                _post(r.port, {"prompt": _PROMPT,
+                               "max_new_tokens": 2}).read()
+            # a hot prefix must NOT pin a random-policy fleet
+            assert a.requests and b.requests
+        finally:
+            r.stop(), a.stop(), b.stop()
+
+    def test_429_redirects_to_next_replica(self):
+        a = _FakeReplica(heat={_HEAD: 3}, mode="429")
+        b = _FakeReplica()
+        r = _router([a, b])
+        try:
+            resp = _post(r.port, {"prompt": _PROMPT, "max_new_tokens": 4})
+            assert resp.status == 200          # the client never saw a 429
+            _, terminal = _sse_frames(resp.read().decode())
+            assert terminal[0] == "end"
+            assert len(a.requests) == 1 and len(b.requests) == 1
+        finally:
+            r.stop(), a.stop(), b.stop()
+
+    def test_fully_backpressured_fleet_sheds_429_clamped(self):
+        a = _FakeReplica(mode="429", retry_header="100000")
+        b = _FakeReplica(mode="429", retry_header="100000")
+        r = _router([a, b])
+        try:
+            resp = _post(r.port, {"prompt": _PROMPT, "max_new_tokens": 4})
+            assert resp.status == 429
+            assert int(resp.getheader("Retry-After")) <= 60
+            body = json.loads(resp.read())
+            assert body["retry_after_s"] <= RETRY_AFTER_CEILING_S
+        finally:
+            r.stop(), a.stop(), b.stop()
+
+    def test_health_503_fails_over(self):
+        a = _FakeReplica(heat={_HEAD: 3}, mode="health_503")
+        b = _FakeReplica()
+        r = _router([a, b])
+        try:
+            resp = _post(r.port, {"prompt": _PROMPT, "max_new_tokens": 4})
+            assert resp.status == 200
+            _, terminal = _sse_frames(resp.read().decode())
+            assert terminal[0] == "end"
+            assert len(a.requests) == 1 and len(b.requests) == 1
+        finally:
+            r.stop(), a.stop(), b.stop()
+
+    def test_outcome_503_is_relayed_not_retried(self):
+        a = _FakeReplica(mode="outcome_503")
+        b = _FakeReplica(mode="outcome_503")
+        r = _router([a, b])
+        try:
+            resp = _post(r.port, {"prompt": _PROMPT, "max_new_tokens": 4})
+            assert resp.status == 503
+            assert json.loads(resp.read())["status"] == "shed"
+            # a generation outcome is terminal: exactly one dispatch
+            assert len(a.requests) + len(b.requests) == 1
+        finally:
+            r.stop(), a.stop(), b.stop()
+
+    def test_pretoken_death_fails_over_transparently(self):
+        a = _FakeReplica(heat={_HEAD: 3}, mode="die_pretoken")
+        b = _FakeReplica(n_frames=3)
+        r = _router([a, b])
+        try:
+            resp = _post(r.port, {"prompt": _PROMPT, "max_new_tokens": 6})
+            assert resp.status == 200
+            frames, terminal = _sse_frames(resp.read().decode())
+            # the client sees B's COMPLETE stream: the failover happened
+            # inside the router, invisible on the wire
+            assert len(frames) == 3
+            assert terminal == ("end", {"status": "served", "n_tokens": 6})
+            assert r.replicas[0].state == "ejected"   # passive ejection
+            hz = json.loads(_get(r.port, "/healthz").read())
+            assert hz["accepting"] is True            # B keeps the fleet up
+            assert hz["replicas"][0]["failovers"] >= 1
+        finally:
+            r.stop(), a.stop(), b.stop()
+
+    def test_midstream_death_emits_error_frame(self):
+        a = _FakeReplica(heat={_HEAD: 3}, mode="die_midstream",
+                         die_after_frames=1)
+        b = _FakeReplica()
+        r = _router([a, b])
+        try:
+            resp = _post(r.port, {"prompt": _PROMPT, "max_new_tokens": 6},
+                         timeout=10)
+            assert resp.status == 200
+            frames, terminal = _sse_frames(resp.read().decode())
+            assert len(frames) == 1            # tokens already escaped
+            assert terminal is not None        # NEVER a silent close
+            name, payload = terminal
+            assert name == "error"
+            assert payload["status"] == "failed"
+            assert "died mid-stream" in payload["error"]
+            assert payload["n_tokens"] == 2
+            assert r.replicas[0].state == "ejected"
+        finally:
+            r.stop(), a.stop(), b.stop()
+
+    def test_connect_refused_ejects_and_probe_readmits(self):
+        a = _FakeReplica(heat={_HEAD: 3})
+        b = _FakeReplica()
+        r = _router([a, b], readmit_after=2)
+        try:
+            port_a = a.port
+            a.kill()
+            resp = _post(r.port, {"prompt": _PROMPT, "max_new_tokens": 4})
+            assert resp.status == 200          # failover to B
+            resp.read()
+            assert r.replicas[0].state == "ejected"
+            assert len(b.requests) == 1
+            # the process comes back on the SAME port under a new
+            # incarnation; probe-success streak re-admits it
+            a2 = _FakeReplica(port=port_a, incarnation=1)
+            r.probe_all()
+            assert r.replicas[0].state == "ejected"   # one ok != readmit
+            r.probe_all()
+            assert r.replicas[0].state == "healthy"
+            assert r.replicas[0].incarnation == 1
+            a2.stop()
+        finally:
+            r.stop(), a.stop(), b.stop()
+
+    def test_probe_failure_streak_ejects(self):
+        # no start(): the prober is driven by hand so the streak count
+        # is deterministic (a background probe would race the asserts)
+        a, b = _FakeReplica(), _FakeReplica()
+        r = FleetRouter(endpoints=[("127.0.0.1", a.port),
+                                   ("127.0.0.1", b.port)], eject_after=2)
+        try:
+            r.probe_all()
+            assert r.replicas[0].state == "healthy"
+            a.kill()
+            r.probe_all()
+            assert r.replicas[0].state == "healthy"   # one miss is noise
+            r.probe_all()
+            assert r.replicas[0].state == "ejected"
+        finally:
+            # never start()ed: shutdown() would block on a server that
+            # never entered serve_forever — just close the socket
+            r._server.server_close(), a.stop(), b.stop()
+
+    def test_drain_rejects_new_work(self):
+        a = _FakeReplica()
+        r = _router([a])
+        try:
+            r.drain()
+            resp = _post(r.port, {"prompt": _PROMPT, "max_new_tokens": 4})
+            assert resp.status == 503
+            assert "draining" in json.loads(resp.read())["error"]
+            hz = _get(r.port, "/healthz")
+            assert hz.status == 503
+            assert hz.getheader("Retry-After") is not None
+            assert not a.requests
+        finally:
+            r.stop(), a.stop()
+
+    def test_dispatch_fault_point_drives_failover(self):
+        a, b = _FakeReplica(), _FakeReplica()
+        r = _router([a, b])
+        try:
+            fi.configure("router.dispatch:raise@1")
+            resp = _post(r.port, {"prompt": _PROMPT, "max_new_tokens": 4})
+            # the armed raise aborts attempt 1; the retry loop answers
+            # anyway (that is the whole point of the fault seam)
+            assert resp.status == 200
+            resp.read()
+            assert len(a.requests) + len(b.requests) == 1
+        finally:
+            r.stop(), a.stop(), b.stop()
+
+    def test_metrics_federates_replica_snapshots(self, tmp_path):
+        snap = {"ts": time.time(), "rank": "0", "incarnation": "0",
+                "metrics": {"counters": {"serving.requests":
+                                         {"code=200": 5}},
+                            "gauges": {}, "histograms": {}}}
+        (tmp_path / "metrics.rank0.inc0.json").write_text(json.dumps(snap))
+        a = _FakeReplica()
+        r = _router([a], snapshot_dir=str(tmp_path))
+        try:
+            _post(r.port, {"prompt": _PROMPT, "max_new_tokens": 2}).read()
+            resp = _get(r.port, "/metrics")
+            assert resp.status == 200
+            text = resp.read().decode()
+            assert 'rank="0"' in text            # the replica's series
+            assert "serving_requests" in text
+            assert "router_routed_total" in text  # the router's own
+        finally:
+            r.stop(), a.stop()
+
+
+# ---------------- the no-request-lost invariant (satellite 3) ---------------
+
+class TestNoRequestLost:
+    def _drive(self, port, results, idx):
+        try:
+            resp = _post(port, {"prompt": _PROMPT, "max_new_tokens": 12},
+                         timeout=20)
+            if resp.status != 200:
+                resp.read()
+                results[idx] = ("http", resp.status)
+                return
+            _, terminal = _sse_frames(resp.read().decode())
+            results[idx] = ("sse", terminal)
+        except Exception as exc:
+            results[idx] = ("exc", repr(exc))
+
+    def test_every_request_terminal_under_replica_kill(self):
+        a = _FakeReplica(n_frames=8, frame_delay_s=0.03)
+        b = _FakeReplica(n_frames=8, frame_delay_s=0.03)
+        r = _router([a, b], stream_timeout_s=10.0)
+        results = [None] * 8
+        threads = [threading.Thread(target=self._drive,
+                                    args=(r.port, results, i))
+                   for i in range(len(results))]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.1)
+            a.kill()                       # 1-of-2 dies with streams open
+            for t in threads:
+                t.join(timeout=30)
+            assert not any(t.is_alive() for t in threads), \
+                "a client hung: the no-silent-hang contract is broken"
+            # EVERY accepted request reached a terminal outcome: a full
+            # stream, a structured error frame, or an HTTP error code —
+            # and none raised out of the client
+            for kind, detail in results:
+                if kind == "sse":
+                    assert detail is not None, "stream ended frameless"
+                    assert detail[0] in ("end", "error")
+                else:
+                    assert kind == "http", detail
+            hz = json.loads(_get(r.port, "/healthz").read())
+            assert hz["accepting"] is True     # B kept the fleet up
+        finally:
+            r.stop(), a.stop(), b.stop()
+
+    def test_rolling_drain_drops_no_streams(self):
+        a = _FakeReplica(n_frames=6, frame_delay_s=0.05)
+        b = _FakeReplica(n_frames=6, frame_delay_s=0.05)
+        r = _router([a, b])
+        results = [None] * 4
+        threads = [threading.Thread(target=self._drive,
+                                    args=(r.port, results, i))
+                   for i in range(len(results))]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.08)               # streams in flight
+            r.drain()                      # rolling-drain phase 1
+            late = _post(r.port, {"prompt": _PROMPT, "max_new_tokens": 2})
+            assert late.status == 503      # new work bounces...
+            late.read()
+            for t in threads:
+                t.join(timeout=30)
+            for kind, detail in results:   # ...in-flight streams finish
+                assert kind == "sse" and detail[0] == "end", (kind, detail)
+            assert r.wait_idle(timeout=10)
+        finally:
+            r.stop(), a.stop(), b.stop()
+
+
+# ---------------- real engines behind the router ----------------------------
+
+def _gateway(model, **eng_kw):
+    eng_kw.setdefault("max_batch", 2)
+    eng_kw.setdefault("max_seq", 64)
+    eng_kw.setdefault("max_chunk_tokens", 8)
+    eng = ContinuousBatchingEngine(model, **eng_kw)
+    runner = EngineRunner(eng)
+    g = ServingGateway(runner=runner, port=0, keepalive_s=5.0)
+    return g, g.start(), eng
+
+
+class TestFleetWithEngines:
+    def test_single_replica_byte_identical_to_direct(self, model):
+        """The nreplicas=1 parity bar: the router relays frames
+        VERBATIM, so a fleet of one is byte-identical to hitting the
+        gateway directly (two fresh engines keep the tick sequences
+        comparable)."""
+        body = {"prompt": [3, 5, 7, 9, 2], "max_new_tokens": 6}
+        g1, p1, _ = _gateway(model)
+        g2, p2, _ = _gateway(model)
+        r = FleetRouter(endpoints=[("127.0.0.1", p2)])
+        r.probe_all()
+        r.start(probe=False)
+        try:
+            direct = _post(p1, body)
+            direct_raw = direct.read()
+            assert direct.status == 200
+            routed = _post(r.port, body)
+            routed_raw = routed.read()
+            assert routed.status == 200
+            assert routed_raw == direct_raw
+        finally:
+            r.stop(), g1.stop(), g2.stop()
+
+    def test_affinity_follows_real_heat(self, model):
+        """Warm one replica's prefix cache, probe, and the router must
+        send the same-prefix follow-up to the warm replica — the
+        cache-win preservation bar (quantified in serving_bench)."""
+        ga, pa, ea = _gateway(model, page_size=4)
+        gb, pb, eb = _gateway(model, page_size=4)
+        r = FleetRouter(endpoints=[("127.0.0.1", pa), ("127.0.0.1", pb)])
+        r.probe_all()
+        r.start(probe=False)
+        try:
+            prompt = [3, 5, 7, 9, 2, 4, 6, 8, 1]     # 2 cacheable pages
+            ref = _reference_generate(model, prompt, 4)
+            first = _post(r.port, {"prompt": prompt, "max_new_tokens": 4})
+            assert first.status == 200
+            first.read()
+            warm = ea if ea._pcache.entries else eb
+            r.probe_all()                  # pick up the heat oracle
+            second = _post(r.port, {"prompt": prompt, "max_new_tokens": 4})
+            assert second.status == 200
+            frames, terminal = _sse_frames(second.read().decode())
+            assert [t for f in frames for t in f] == ref   # token-identical
+            assert terminal[0] == "end"
+            assert warm._pcache.hits >= 1  # the reuse actually happened
+            hot_idx = 0 if warm is ea else 1
+            assert r.replicas[hot_idx].affinity_hits == 1
+        finally:
+            r.stop(), ga.stop(), gb.stop()

@@ -1,0 +1,285 @@
+"""Serving-fleet chaos drill (ISSUE 17 acceptance): SIGKILL 1-of-2 real
+replica subprocesses mid-stream and prove no accepted request is lost —
+every stream reaches a terminal frame, the fleet /healthz never leaves
+200, the killed replica relaunches under a fresh incarnation and gets
+routed to again — then a rolling SIGTERM drain finishes every in-flight
+stream before the fleet exits 0. Runs as its own process tree via
+tools/run_chaos_suite.py; `slow` keeps it out of tier-1."""
+import http.client
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import save_for_serving
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tiny_model(seed=0):
+    paddle.seed(seed)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=128, use_recompute=False)
+    return LlamaForCausalLM(cfg)
+
+
+def _get_json(port, path, timeout=10):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    c.request("GET", path)
+    r = c.getresponse()
+    body = r.read()
+    c.close()
+    return r.status, json.loads(body) if path == "/healthz" else body
+
+
+def _sse_frames(raw: str):
+    frames, terminal = [], None
+    for block in raw.split("\n\n"):
+        block = block.strip()
+        if block.startswith("data: "):
+            frames.append(json.loads(block[len("data: "):])["tokens"])
+        elif block.startswith("event: "):
+            name, _, data = block.partition("\n")
+            terminal = (name[len("event: "):],
+                        json.loads(data[len("data: "):]))
+    return frames, terminal
+
+
+def _stream(port, prompt, max_new, results, i, saw_frame):
+    """One streaming client: records ('sse', terminal) | ('http', code)
+    | ('exc', repr) — ANY of which is a terminal outcome; a hang (never
+    returning) is the failure the invariant forbids."""
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        c.request("POST", "/v1/generate",
+                  body=json.dumps({"prompt": prompt,
+                                   "max_new_tokens": max_new}))
+        r = c.getresponse()
+        if r.status != 200:
+            r.read()
+            results[i] = ("http", r.status)
+            return
+        raw = b""
+        while True:
+            chunk = r.read1(65536)
+            if not chunk:
+                break
+            raw += chunk
+            if b"data:" in raw:
+                saw_frame.set()
+        results[i] = ("sse", _sse_frames(raw.decode())[1])
+    except Exception as exc:
+        results[i] = ("exc", repr(exc))
+    finally:
+        try:
+            c.close()
+        except Exception:
+            pass
+
+
+def _events(path):
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    pass
+    except OSError:
+        pass
+    return out
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.timeout(300)
+def test_fleet_survives_replica_sigkill_then_drains(tmp_path):
+    prefix = os.path.join(str(tmp_path), "m")
+    model = _tiny_model()
+    save_for_serving(model, prefix)
+    ref = model.generate(paddle.to_tensor(np.array([[3, 5, 7]], np.int32)),
+                         max_new_tokens=5, do_sample=False)
+    ref = [int(t) for t in np.asarray(ref.numpy())[0][:5]]
+
+    log_dir = os.path.join(str(tmp_path), "logs")
+    events_path = os.path.join(log_dir, "fleet_events.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.inference.fleet",
+         "--model", prefix, "--nreplicas", "2", "--port", "0",
+         "--log-dir", log_dir, "--probe-interval", "0.2",
+         "--max-batch", "2", "--max-seq", "160",
+         "--max-chunk-tokens", "8", "--max-draft-tokens", "0",
+         "--keepalive-s", "0.2", "--drain-timeout", "20"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    out_lines = []
+    started = threading.Event()
+    port_box = {}
+
+    def _pump():
+        for line in proc.stdout:
+            out_lines.append(line)
+            if "fleet serving on http://" in line and not started.is_set():
+                m = re.search(r"http://[^:\s]+:(\d+)", line)
+                if m:
+                    port_box["port"] = int(m.group(1))
+                    started.set()
+
+    threading.Thread(target=_pump, daemon=True).start()
+    try:
+        assert started.wait(timeout=180), \
+            f"fleet never started: {''.join(out_lines)[-2000:]}"
+        port = port_box["port"]
+
+        # -- baseline + warm BOTH replicas (each compiles on first use)
+        warm = [None, None]
+        w0 = threading.Event()
+        ts = [threading.Thread(target=_stream,
+                               args=(port, [3, 5, 7], 5, warm, i, w0))
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=150)
+        assert warm[0] and warm[0][0] == "sse", warm[0]
+        assert warm[0][1] == ("end", {"status": "served", "n_tokens": 5})
+        st, hz = _get_json(port, "/healthz")
+        assert st == 200
+        # determinism through the router: the same greedy tokens as the
+        # in-process reference, whichever replica served it
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        c.request("POST", "/v1/generate",
+                  body=json.dumps({"prompt": [3, 5, 7],
+                                   "max_new_tokens": 5}))
+        r = c.getresponse()
+        frames, terminal = _sse_frames(r.read().decode())
+        c.close()
+        assert [t for f in frames for t in f] == ref
+        assert terminal[0] == "end"
+
+        # -- SIGKILL one replica with streams in flight ------------------
+        results = [None] * 6
+        saw_frame = threading.Event()
+        clients = [threading.Thread(target=_stream,
+                                    args=(port, [3 + i, 5, 7], 96,
+                                          results, i, saw_frame))
+                   for i in range(len(results))]
+        for t in clients:
+            t.start()
+        assert saw_frame.wait(timeout=120), "no stream ever produced a token"
+        victim = None
+        deadline = time.time() + 30
+        while victim is None and time.time() < deadline:
+            st, hz = _get_json(port, "/healthz")
+            assert st == 200
+            busy = [rp for rp in hz["replicas"]
+                    if rp["state"] == "healthy" and rp["inflight"] > 0
+                    and rp["pid"]]
+            if busy:
+                victim = busy[0]
+            else:
+                time.sleep(0.03)
+        assert victim is not None, "no replica ever had an in-flight stream"
+        os.kill(victim["pid"], signal.SIGKILL)
+
+        # the fleet front door stays up THROUGH the failure window
+        for _ in range(10):
+            st, _ = _get_json(port, "/healthz")
+            assert st == 200, "fleet /healthz flipped during 1-of-2 death"
+            time.sleep(0.1)
+
+        for t in clients:
+            t.join(timeout=150)
+        assert not any(t.is_alive() for t in clients), \
+            "a client hung after the replica kill (silent-hang violation)"
+        # the no-request-lost invariant: every accepted request reached
+        # a terminal status (complete stream, structured error frame,
+        # or an HTTP error) — and none raised out of the client
+        for kind, detail in results:
+            if kind == "sse":
+                assert detail is not None, "stream closed with no terminal"
+                assert detail[0] in ("end", "error"), detail
+            else:
+                assert kind == "http", (kind, detail)
+
+        # -- flight recorder + relaunch under a fresh incarnation --------
+        deadline = time.time() + 120
+        relaunched = None
+        while relaunched is None and time.time() < deadline:
+            evs = _events(events_path)
+            rel = [e for e in evs if e.get("ev") == "replica_relaunch"
+                   and e.get("replica") == victim["idx"]]
+            if rel:
+                relaunched = rel[-1]
+            else:
+                time.sleep(0.2)
+        assert relaunched is not None, "killed replica never relaunched"
+        assert relaunched["incarnation"] >= 1
+        assert any(e.get("ev") == "replica_death"
+                   and e.get("replica") == victim["idx"]
+                   for e in _events(events_path))
+
+        # ...and it is ROUTED TO again once healthy
+        deadline = time.time() + 120
+        back = None
+        while back is None and time.time() < deadline:
+            st, hz = _get_json(port, "/healthz")
+            rp = hz["replicas"][victim["idx"]]
+            if st == 200 and rp["state"] == "healthy" \
+                    and rp["incarnation"] >= 1:
+                back = rp
+            else:
+                time.sleep(0.2)
+        assert back is not None, "relaunched replica never turned healthy"
+        routed_before = back["routed_total"]
+        rr = [None] * 3
+        ts = [threading.Thread(target=_stream,
+                               args=(port, [9 + i, 4, 2], 4, rr, i,
+                                     threading.Event()))
+              for i in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=150)
+        assert all(k == "sse" and d and d[0] == "end" for k, d in rr), rr
+        _, hz = _get_json(port, "/healthz")
+        assert hz["replicas"][victim["idx"]]["routed_total"] > routed_before
+
+        # -- rolling SIGTERM drain: zero dropped in-flight streams -------
+        dr = [None] * 2
+        drain_clients = [
+            threading.Thread(target=_stream,
+                             args=(port, [11 + i, 6, 2], 64, dr, i,
+                                   threading.Event()))
+            for i in range(2)]
+        for t in drain_clients:
+            t.start()
+        time.sleep(0.4)                    # streams in flight
+        proc.send_signal(signal.SIGTERM)
+        for t in drain_clients:
+            t.join(timeout=120)
+        for kind, detail in dr:
+            assert kind == "sse" and detail is not None, (kind, detail)
+            assert detail[0] == "end", detail   # finished, not cut
+        rc = proc.wait(timeout=120)
+        assert rc == 0
+        assert any("fleet drained, bye" in ln for ln in out_lines)
+        assert any(e.get("ev") == "replica_drained"
+                   for e in _events(events_path))
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)

@@ -2,7 +2,8 @@
 """Run every `chaos`-marked pytest drill as its own gate (ISSUE 13).
 
 The subprocess chaos drills — elastic kill/degrade/rejoin, master kill,
-blocked-collective abort, federation churn, checkpoint crash-resume —
+blocked-collective abort, federation churn, checkpoint crash-resume,
+serving-fleet replica SIGKILL + rolling drain —
 each spawn a supervisor plus worker (plus master) process tree and take
 tens of seconds. Running them inside tier-1 would bloat the gate and a
 single wedged drill would eat the whole suite's budget, so they carry
