@@ -128,9 +128,11 @@ def _snapshot_serving():
     return out
 
 
-def run(model, jobs, ragged, slo=None):
+def run(model, jobs, ragged, slo=None, request_trace=None):
     metrics.reset()
     kw = {} if slo is None else {"slo": slo}
+    if request_trace is not None:
+        kw["request_trace"] = request_trace
     # degradation pinned OFF for the mixed-workload runs: this bench is
     # the PR 7 throughput regression guard AND the kill-switch parity
     # trace — pool-pressure-driven chunk shrinking would make the armed
@@ -434,6 +436,105 @@ def _append_trend(value):
         pass
 
 
+# -- ISSUE 18: request tracing scenario --------------------------------------
+
+TRACE_TOLERANCE = 1e-6
+
+
+def run_fleet_trace(model):
+    """2-replica fleet with an INDUCED FAILOVER, reported end-to-end
+    through the trace surfaces: warm a tenant prefix onto replica 0,
+    refresh the heat oracle, stop replica 0 cold (the in-process SIGKILL
+    stand-in), then send the tenant's next request through the router —
+    affinity steers it at the dead replica, the connect fails, the hop
+    is recorded, replica 1 serves it with the hop time preloaded into
+    the `failover` bucket. The whole run writes through one JSONL sink +
+    a fleet_events.jsonl recorder + a metrics snapshot, and the guard is
+    what `tools/trace_report.py` can RECONSTRUCT from those remains:
+    --check passes (every ledger exact), the percentile attribution
+    table prints, and >= 1 exemplar resolves to a timeline naming the
+    failover hop."""
+    import contextlib
+    import io
+    import shutil
+    import tempfile
+
+    from paddle_tpu.inference import (EngineRunner, FleetRouter,
+                                      ServingGateway)
+    from paddle_tpu.observability import reqtrace
+    from tools import trace_report
+
+    td = tempfile.mkdtemp(prefix="serving_trace_")
+    events_path = os.path.join(td, "fleet_events.jsonl")
+
+    def _rec(rec):
+        with open(events_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    metrics.reset()
+    reqtrace.set_sink(os.path.join(td, "trace.rank0.inc0.jsonl"))
+    stacks = []
+    try:
+        for _ in range(2):
+            eng = ContinuousBatchingEngine(
+                model, max_batch=4, max_seq=MAX_SEQ,
+                prefill_buckets=BUCKETS, page_size=16,
+                max_chunk_tokens=16, ragged=True, prefix_cache=True)
+            g = ServingGateway(runner=EngineRunner(eng), port=0,
+                               keepalive_s=5.0)
+            stacks.append((g, g.start(), eng))
+        router = FleetRouter(
+            endpoints=[("127.0.0.1", p) for _, p, _ in stacks],
+            policy="affinity", recorder=_rec)
+        router.probe_all()
+        router.start(probe=False)
+        rng = np.random.RandomState(77)
+        prefix = [int(t) for t in rng.randint(1, 256, 48)]
+        # warm the tenant prefix onto replica 0 and compile replica 1
+        _http_tokens(stacks[0][1], prefix + [7])
+        _http_tokens(stacks[1][1],
+                     [int(t) for t in rng.randint(1, 256, 10)])
+        router.probe_all()     # heat oracle: tenant prefix -> replica 0
+        # the failover request owns every exemplar recorded from here on
+        metrics.reset()
+        stacks[0][0].stop()    # replica 0 vanishes; router's view is stale
+        toks = _http_tokens(router.port, prefix + [9])
+        router.stop()
+        for g, _, _ in stacks[1:]:
+            g.stop()
+    finally:
+        reqtrace.set_sink(None)
+    with open(os.path.join(td, "metrics.rank0.inc0.json"), "w") as f:
+        json.dump({"metrics": metrics.snapshot()}, f)
+
+    traces, errors = trace_report.load([td])
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        check_rc = trace_report.check(traces, errors)
+        trace_report.report([td], top=3)
+    out = buf.getvalue()
+    hop_traces = [t for t in traces.values() if t.hops]
+    exemplar_secs = [s for s in out.split("-- exemplar")[1:]
+                     if s.startswith(" serving.")]
+    result = {
+        "tokens": len(toks),
+        "traces": len(traces),
+        "terminal": sum(1 for t in traces.values()
+                        if t.terminal is not None),
+        "failover_traces": len(hop_traces),
+        "failover_bucket_s": round(
+            hop_traces[0].buckets.get("failover", 0.0), 6)
+        if hop_traces and hop_traces[0].terminal else 0.0,
+        "check_ok": check_rc == 0,
+        "table_printed": ("p99" in out and "queue_wait" in out),
+        "exemplars_resolved": len(exemplar_secs),
+        "exemplar_names_failover": any("failover_hop" in s
+                                       for s in exemplar_secs),
+    }
+    shutil.rmtree(td, ignore_errors=True)
+    return result, out
+
+
 # -- ISSUE 10: overload scenario ---------------------------------------------
 
 def _overload_workload():
@@ -504,15 +605,52 @@ def run_overload(model, jobs, slo):
 
 
 def main():
+    import shutil
+    import tempfile
+
+    from paddle_tpu.observability import reqtrace
+    from tools import trace_report
+
     obs.enable(True)
     model = _model()
     jobs = _workload()
-    base = run(model, jobs, ragged=False)      # serialized bucketed prefill
-    chunked = run(model, jobs, ragged=True)    # ragged chunked prefill
+    # ISSUE 18 guard 1 — exact accounting: both mixed-workload regimes
+    # run with request tracing armed (the default) writing through a
+    # sink; afterwards trace_report's --check gate must find EVERY
+    # terminal ledger summing to its wall within TRACE_TOLERANCE.
+    trace_td = tempfile.mkdtemp(prefix="serving_bench_trace_")
+    reqtrace.set_sink(os.path.join(trace_td, "trace.mixed.jsonl"))
+    try:
+        base = run(model, jobs, ragged=False)   # serialized bucketed prefill
+        chunked = run(model, jobs, ragged=True)  # ragged chunked prefill
+    finally:
+        reqtrace.set_sink(None)
+    mixed_traces, mixed_errors = trace_report.load([trace_td])
+    import contextlib
+    import io
+    _buf = io.StringIO()
+    with contextlib.redirect_stdout(_buf):
+        trace_exact = trace_report.check(mixed_traces, mixed_errors) == 0
+    trace_terminal = sum(1 for t in mixed_traces.values()
+                         if t.terminal is not None)
+    shutil.rmtree(trace_td, ignore_errors=True)
     base.pop("trace")
     chunk_trace = chunked.pop("trace")
     identical = base.pop("outputs") == chunked["outputs"]
     speedup = chunked["tokens_per_sec"] / base["tokens_per_sec"]
+
+    # ISSUE 18 guard 2 — kill switch: FLAGS_request_trace=0 must be the
+    # pre-trace tick loop bitwise — token-identical outputs AND an
+    # identical per-tick scheduling trace vs the armed run above
+    # (tracing is pure observation; no scheduling decision reads it).
+    trace_off = run(model, jobs, ragged=True, request_trace=False)
+    trace_parity = (trace_off.pop("outputs") == chunked["outputs"]
+                    and trace_off.pop("trace") == chunk_trace)
+
+    # ISSUE 18 guard 3 — the fleet failover scenario: trace_report must
+    # reconstruct WHERE a failed-over request's latency went from the
+    # sink + fleet events + metrics snapshot a dead fleet leaves behind.
+    fleet_trace, fleet_trace_out = run_fleet_trace(model)
 
     # ISSUE 10 guard 1 — kill-switch parity: FLAGS_serving_slo=0 must
     # be the exact pre-SLO FIFO engine. The SLO run above used the
@@ -657,6 +795,13 @@ def main():
             "random_margin": FLEET_RANDOM_MARGIN,
             "token_identical_outputs": bool(fleet_identical),
         },
+        "request_trace": {
+            "exact_accounting": bool(trace_exact),
+            "terminal_traces_checked": int(trace_terminal),
+            "tolerance": TRACE_TOLERANCE,
+            "kill_switch_parity": bool(trace_parity),
+            "fleet_failover": fleet_trace,
+        },
     }
     print(json.dumps(report, indent=2))
     with open(ARTIFACT, "w") as f:
@@ -731,6 +876,27 @@ def main():
               f"{fleet_affinity['aggregate_reuse_ratio']:.3f} (margin "
               f"{FLEET_RANDOM_MARGIN}) — the affinity policy is not "
               f"earning its keep", file=sys.stderr)
+        return 1
+    if not trace_exact or trace_terminal == 0:
+        print(f"FAIL: request-trace exact accounting violated "
+              f"({trace_terminal} terminal traces; every ledger must "
+              f"sum to its wall within {TRACE_TOLERANCE})",
+              file=sys.stderr)
+        return 1
+    if not trace_parity:
+        print("FAIL: FLAGS_request_trace=0 diverges from the armed "
+              "engine (outputs or per-tick scheduling trace)",
+              file=sys.stderr)
+        return 1
+    ft = fleet_trace
+    if not (ft["check_ok"] and ft["table_printed"]
+            and ft["failover_traces"] >= 1
+            and ft["exemplar_names_failover"]):
+        print("FAIL: fleet failover trace scenario — trace_report must "
+              "pass --check, print the attribution table, and resolve "
+              ">= 1 exemplar to a timeline naming the failover hop; "
+              f"got {ft}", file=sys.stderr)
+        print(fleet_trace_out, file=sys.stderr)
         return 1
     return 0
 

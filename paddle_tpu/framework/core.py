@@ -322,6 +322,15 @@ _flags: dict = {
     # merge one job-level /metrics), and the rewrite interval in seconds
     "FLAGS_metrics_snapshot": "",
     "FLAGS_metrics_snapshot_interval": 2.0,
+    # request tracing (consumed by inference/serving.py +
+    # observability/reqtrace.py): per-request event timelines and the
+    # exact tail-latency attribution ledger (sum(buckets) == wall); ON
+    # by default — =0 restores the pre-trace tick loop bitwise. The sink
+    # is an append-only JSONL path (empty = in-memory store only); the
+    # replica supervisor sets it per child so a SIGKILLed replica's
+    # traces survive for the router's fleet-scope /v1/trace lookup
+    "FLAGS_request_trace": True,
+    "FLAGS_request_trace_sink": "",
     # -- input pipeline (consumed by io/prefetch.py + io DataLoader):
     # device-side double-buffered batch staging via jax.device_put; false
     # restores the synchronous un-staged loader path (the debugging kill
@@ -484,6 +493,9 @@ def _apply_flag(key, value):
         from ..observability import federation as _ofed
         if _ofed._publisher is not None:
             _ofed._publisher.interval = max(0.05, float(value))
+    elif key == "FLAGS_request_trace_sink":
+        from ..observability import reqtrace as _ortrace
+        _ortrace.set_sink(str(value) if value else None)
     elif key == "FLAGS_eager_dispatch_cache_size":
         from ..autograd import tape  # late: tape imports this module
         tape._dispatch_cache.resize(int(value))

@@ -64,6 +64,7 @@ from typing import Optional
 
 from ..observability import export as _oexp
 from ..observability import metrics as _metrics
+from ..observability import reqtrace as _rtrace
 from ..utils.fault_injection import fault_point
 from .router import _retry_after_header
 from .serving import ContinuousBatchingEngine, GenerationRequest, QueueFull
@@ -300,8 +301,17 @@ class EngineRunner:
         for rid, st in self._streams.items():
             out = st.req.output
             if st.sent < len(out):
+                first = st.sent == 0
                 st.q.put(("tokens", list(out[st.sent:])))
                 st.sent = len(out)
+                tr = getattr(st.req, "trace", None)
+                if tr is not None and tr.status is None:
+                    # the span since the tick's last charge was spent
+                    # handing tokens to the stream queue (same thread
+                    # as step(), so the ledger mark is still ours)
+                    tr.charge("stream_write")
+                    if first:
+                        tr.event("stream_write", n=st.sent)
             if st.req.done:
                 st.q.put(("end", st.req.status, st.req.error))
                 done.append(rid)
@@ -428,6 +438,17 @@ class ServingGateway:
             status, ctype, body = got
             self._raw(h, status, ctype, body)
             return
+        if path.startswith("/v1/trace/"):
+            # replica-scope trace view: the live in-process store (the
+            # fleet router serves the cross-replica merge, including
+            # traces of replicas that died — from the JSONL sink)
+            tid = path.rsplit("/", 1)[1]
+            snap = _rtrace.lookup(tid)
+            if snap is None:
+                self._json(h, 404, {"error": f"unknown trace {tid!r}"})
+            else:
+                self._json(h, 200, snap)
+            return
         self._json(h, 404, {"error": f"no route for {h.path!r}"})
 
     # -- POST ----------------------------------------------------------------
@@ -496,6 +517,19 @@ class ServingGateway:
             eos_token_id=eos,
             priority=priority,
             deadline_s=deadline)
+        # request-scope tracing (ISSUE 18): honor an incoming trace id
+        # (the router's X-Request-Trace, or a client traceparent), mint
+        # otherwise; a router failover carries the time already burned
+        # on dead replicas so this replica's ledger still sums to the
+        # CLIENT-observed wall
+        req.trace_id = (_rtrace.parse_trace_header(
+            h.headers.get("X-Request-Trace")
+            or h.headers.get("traceparent")) or _rtrace.mint_trace_id())
+        try:
+            req.failover_preload_s = max(
+                float(h.headers.get("X-Trace-Failover-S") or 0.0), 0.0)
+        except (TypeError, ValueError):
+            req.failover_preload_s = 0.0
         try:
             stream = self.runner.submit(req)
         except QueueFull as e:
@@ -532,6 +566,10 @@ class ServingGateway:
             h.send_header("Content-Type", "text/event-stream")
             h.send_header("Cache-Control", "no-cache")
             h.send_header("Connection", "close")
+            if req.trace_id:
+                # the client-visible correlation handle: quote this id
+                # at GET /v1/trace/<id> (gateway or fleet router)
+                h.send_header("X-Request-Id", req.trace_id)
             h.end_headers()
             while True:
                 try:
@@ -551,6 +589,8 @@ class ServingGateway:
                     continue
                 _, status, error = ev
                 payload = {"status": status, "n_tokens": len(req.output)}
+                if req.trace_id:
+                    payload["trace_id"] = req.trace_id
                 name = b"end"
                 if status != "served":
                     payload["error"] = error
@@ -590,10 +630,14 @@ class ServingGateway:
                 _, status, error = ev
                 break
         body = {"status": status, "output": list(req.output)}
+        if req.trace_id:
+            body["trace_id"] = req.trace_id
         if error:
             body["error"] = error
         _STREAM_SECONDS.observe(time.perf_counter() - t0)
-        self._json(h, _STATUS_HTTP.get(status, 500), body)
+        headers = ({"X-Request-Id": req.trace_id}
+                   if req.trace_id else None)
+        self._json(h, _STATUS_HTTP.get(status, 500), body, headers)
 
     def _infer(self, h, spec):
         if self.static_model is None:

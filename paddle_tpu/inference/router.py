@@ -71,11 +71,13 @@ import struct
 import subprocess
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 from ..observability import export as _oexp
 from ..observability import federation as _ofed
 from ..observability import metrics as _metrics
+from ..observability import reqtrace as _rtrace
 from ..utils.fault_injection import fault_point
 
 __all__ = ["chain_key", "head_key_hex", "Replica", "ReplicaSupervisor",
@@ -156,6 +158,12 @@ class Replica:
         self.retry_after_s = 1.0
         self.heat: Dict[str, int] = {}   # chain-head hex -> cached pages
         self.heat_page_size = 0
+        # heat freshness (ISSUE 18 satellite): when the map was last
+        # refreshed (router monotonic clock) and the cache epoch it
+        # reflects — affinity ignores a map older than heat_ttl_s, so a
+        # silent replica cannot keep attracting its old hot prefixes
+        self.heat_mono = 0.0
+        self.heat_epoch = -1
         self.consecutive_fail = 0
         self.consecutive_ok = 0
         self.inflight = 0
@@ -243,6 +251,12 @@ class ReplicaSupervisor:
             env["FLAGS_metrics_snapshot"] = os.path.join(
                 self.log_dir,
                 f"metrics.rank{rep.idx}.inc{rep.incarnation}.json")
+            # per-replica request-trace JSONL sink (ISSUE 18): written
+            # through live, so the router can still serve
+            # GET /v1/trace/<id> for a replica that died by SIGKILL
+            env["FLAGS_request_trace_sink"] = os.path.join(
+                self.log_dir,
+                f"trace.rank{rep.idx}.inc{rep.incarnation}.jsonl")
         p = subprocess.Popen(
             self.argv_factory(rep), env=env, text=True,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
@@ -427,7 +441,8 @@ class FleetRouter:
                  max_retries: int = 3, backoff_base_s: float = 0.05,
                  backoff_cap_s: float = 0.5,
                  stream_timeout_s: float = 30.0,
-                 policy: str = "affinity", recorder=None):
+                 policy: str = "affinity", recorder=None,
+                 heat_ttl_s: float = 5.0):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
         if replicas is None:
             replicas = [Replica(i, host=h, port=p)
@@ -448,8 +463,13 @@ class FleetRouter:
         self.stream_timeout_s = float(stream_timeout_s)
         self.policy = policy
         self.recorder = recorder
+        self.heat_ttl_s = float(heat_ttl_s)
         self.draining = False
         self.inflight = 0
+        # trace id -> this router's failover-hop records (bounded LRU):
+        # the fleet-scope /v1/trace/<id> merge names every hop a
+        # request took even when a replica's sink never saw it
+        self._trace_hops: "OrderedDict[str, list]" = OrderedDict()
         self.lock = threading.RLock()
         self._stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
@@ -568,6 +588,11 @@ class FleetRouter:
             if isinstance(heat, dict):
                 rep.heat = {str(k): int(v) for k, v in heat.items()}
                 rep.heat_page_size = int(pc.get("page_size", 0))
+                rep.heat_mono = time.monotonic()   # freshness stamp
+                try:
+                    rep.heat_epoch = int(pc.get("epoch", -1))
+                except (TypeError, ValueError):
+                    rep.heat_epoch = -1
             if rep.state == "starting":
                 rep.state = "healthy"
             elif (rep.state == "ejected"
@@ -593,6 +618,11 @@ class FleetRouter:
         rep.state = "ejected"
         rep.ejections += 1
         rep.consecutive_ok = 0
+        # its cache is gone with the process (a relaunch starts cold):
+        # drop the heat map NOW so re-admission cannot route by a
+        # dead incarnation's prefixes before the next probe refresh
+        rep.heat = {}
+        rep.heat_epoch = -1
         _EJECT.inc(replica=str(rep.idx))
         self._record({"ev": "replica_eject", "replica": rep.idx,
                       "incarnation": rep.incarnation, "reason": reason})
@@ -633,7 +663,14 @@ class FleetRouter:
             if self.policy == "random":
                 return self._rng.choice(cands), False
             if head_hex:
-                hot = [r for r in cands if r.heat.get(head_hex)]
+                # stale-heat expiry (ISSUE 18 satellite): a map older
+                # than heat_ttl_s no longer predicts the replica's
+                # cache — fall through to least-loaded instead of
+                # chasing prefixes that were likely evicted since
+                fresh_after = time.monotonic() - self.heat_ttl_s
+                hot = [r for r in cands
+                       if r.heat.get(head_hex)
+                       and r.heat_mono >= fresh_after]
                 if hot:
                     return max(hot, key=lambda r: (r.heat[head_hex],
                                                    -r.inflight)), True
@@ -645,6 +682,13 @@ class FleetRouter:
         path = h.path.split("?", 1)[0].rstrip("/")
         if path == "/healthz":
             self._healthz(h)
+        elif path.startswith("/v1/trace/"):
+            tid = path.rsplit("/", 1)[1]
+            snap = self.trace_lookup(tid)
+            if snap is None:
+                self._json(h, 404, {"error": f"unknown trace {tid!r}"})
+            else:
+                self._json(h, 200, snap)
         elif path in ("", "/metrics"):
             try:
                 text = self.metrics_text()
@@ -688,6 +732,102 @@ class FleetRouter:
                       "rank": "router", "incarnation": "0"})
         return _oexp.prometheus_text(_ofed.merge_snapshots(snaps))
 
+    # -- fleet-scope trace view (ISSUE 18) -----------------------------------
+
+    def trace_lookup(self, tid: str) -> Optional[dict]:
+        """Merge every view of one trace id across the fleet: the
+        per-replica JSONL sinks under snapshot_dir (written through
+        live, so they survive a SIGKILLed replica), this router's own
+        failover-hop records, and — when no sink is configured — the
+        live replicas' /v1/trace endpoints. None when nobody has it."""
+        out = {"trace_id": tid, "terminal": False, "events": [],
+               "hops": [], "replicas": []}
+        found = False
+        if self.snapshot_dir:
+            pat = re.compile(r"trace\.rank(\d+)\.inc(\d+)\.jsonl$")
+            try:
+                names = sorted(os.listdir(self.snapshot_dir))
+            except OSError:
+                names = []
+            for name in names:
+                m = pat.match(name)
+                if not m:
+                    continue
+                evs, term = _scan_trace_jsonl(
+                    os.path.join(self.snapshot_dir, name), tid)
+                if not evs and term is None:
+                    continue
+                found = True
+                src = {"replica": int(m.group(1)),
+                       "incarnation": int(m.group(2))}
+                out["replicas"].append(src)
+                # live event lines already include the terminal event
+                # (finish() streams it before the terminal record), so
+                # the timeline needs no extraction from `term`
+                out["events"].extend({**e, **src} for e in evs)
+                if term is not None:
+                    out["terminal"] = True
+                    for k in ("status", "wall", "buckets",
+                              "decode_ticks"):
+                        if k in term:
+                            out[k] = term[k]
+        else:
+            for rep in list(self.replicas):
+                if not rep.routable:
+                    continue
+                try:
+                    conn = http.client.HTTPConnection(
+                        rep.host, rep.port,
+                        timeout=self.probe_timeout_s)
+                    conn.request("GET", f"/v1/trace/{tid}")
+                    r = conn.getresponse()
+                    body = json.loads(r.read() or b"{}")
+                    status = r.status
+                    conn.close()
+                except Exception:
+                    continue
+                if status != 200:
+                    continue
+                found = True
+                src = {"replica": rep.idx,
+                       "incarnation": rep.incarnation}
+                out["replicas"].append(src)
+                out["events"].extend(
+                    {**e, **src} for e in body.get("events", ()))
+                if body.get("terminal"):
+                    out["terminal"] = True
+                    for k in ("status", "wall", "buckets",
+                              "decode_ticks"):
+                        if k in body:
+                            out[k] = body[k]
+        with self.lock:
+            hops = list(self._trace_hops.get(tid, ()))
+        if hops:
+            found = True
+            out["hops"] = hops
+        if not found:
+            return None
+        out["events"].sort(key=lambda e: e.get("ts", 0))
+        return out
+
+    def _note_hop(self, tid: Optional[str], hop: int, rep: Replica,
+                  reason: str) -> None:
+        """One failover hop: flight-recorder line (fleet_events.jsonl,
+        trace id echoed — the satellite contract) + the bounded
+        in-router store the fleet trace view merges from."""
+        rec = {"ev": "failover_hop", "hop": hop, "replica": rep.idx,
+               "incarnation": rep.incarnation, "reason": reason,
+               "ts": round(time.time(), 3)}
+        if tid:
+            rec["trace_id"] = tid
+        self._record(rec)
+        if not tid:
+            return
+        with self.lock:
+            self._trace_hops.setdefault(tid, []).append(rec)
+            while len(self._trace_hops) > 512:
+                self._trace_hops.popitem(last=False)
+
     # -- POST (the request plane) --------------------------------------------
 
     def _handle_post(self, h) -> None:
@@ -725,13 +865,34 @@ class FleetRouter:
             return
         head = self._head_hex(spec.get("prompt")) \
             if path == "/v1/generate" else None
-        state = {"headers_sent": False, "tokens": 0, "terminal": False}
+        state = {"headers_sent": False, "tokens": 0, "terminal": False,
+                 "trace_id": None}
+        tid: Optional[str] = None
+        t0 = time.perf_counter()
+        if path == "/v1/generate":
+            # request-scope tracing (ISSUE 18): honor the client's id
+            # (X-Request-Trace or W3C traceparent), mint otherwise —
+            # ONE id for every hop this request takes across the fleet
+            tid = (_rtrace.parse_trace_header(
+                h.headers.get("X-Request-Trace")
+                or h.headers.get("traceparent"))
+                or _rtrace.mint_trace_id())
+            state["trace_id"] = tid
         tried: set = set()
         saw_429: Optional[float] = None
         for attempt in range(self.max_retries + 1):
             rep, via_affinity = self._pick(head, tried)
             if rep is None:
                 break
+            headers = {"Content-Type": "application/json"}
+            if tid:
+                headers["X-Request-Trace"] = tid
+                # seconds already burned at the router (failed hops,
+                # backoff) — the replica preloads this into the
+                # `failover` bucket so its ledger sums to the
+                # CLIENT-observed wall, not just its own
+                headers["X-Trace-Failover-S"] = (
+                    "%.6f" % (time.perf_counter() - t0))
             try:
                 # inside the try: an armed raise is indistinguishable
                 # from a connect failure, so it drives the real
@@ -739,8 +900,7 @@ class FleetRouter:
                 fault_point("router.dispatch")
                 conn = http.client.HTTPConnection(
                     rep.host, rep.port, timeout=self.stream_timeout_s)
-                conn.request("POST", path, body=raw,
-                             headers={"Content-Type": "application/json"})
+                conn.request("POST", path, body=raw, headers=headers)
                 resp = conn.getresponse()
             except Exception:
                 self._passive_fail(rep, "connect/submit failed")
@@ -748,6 +908,7 @@ class FleetRouter:
                 with self.lock:
                     rep.failovers += 1
                 _FAILOVER.inc(replica=str(rep.idx))
+                self._note_hop(tid, attempt, rep, "connect/submit failed")
                 self._backoff(attempt)
                 continue
             if resp.status == 429:
@@ -770,6 +931,7 @@ class FleetRouter:
                     rep.accepting = False
                     rep.failovers += 1
                 _FAILOVER.inc(replica=str(rep.idx))
+                self._note_hop(tid, attempt, rep, "replica unhealthy")
                 conn.close()
                 self._backoff(attempt)
                 continue
@@ -801,6 +963,8 @@ class FleetRouter:
                 with self.lock:
                     rep.failovers += 1
                 _FAILOVER.inc(replica=str(rep.idx))
+                self._note_hop(tid, attempt, rep,
+                               "died before first token")
                 self._backoff(attempt)
                 continue
             if outcome == "mid_stream_death":
@@ -810,6 +974,7 @@ class FleetRouter:
                 with self.lock:
                     rep.failovers += 1
                 _FAILOVER.inc(replica=str(rep.idx))
+                self._note_hop(tid, attempt, rep, "died mid-stream")
             return
         # candidates exhausted: shed at fleet scope
         _SHED.inc()
@@ -862,6 +1027,10 @@ class FleetRouter:
             h.send_header("Content-Type", "text/event-stream")
             h.send_header("Cache-Control", "no-cache")
             h.send_header("Connection", "close")
+            if state.get("trace_id"):
+                # relays forward only body frames, so the router must
+                # re-stamp the correlation header itself
+                h.send_header("X-Request-Id", state["trace_id"])
             h.end_headers()
             state["headers_sent"] = True
         buf = b""
@@ -907,6 +1076,8 @@ class FleetRouter:
         a client mid-stream NEVER sees a silent close."""
         payload = {"status": status, "n_tokens": state["tokens"],
                    "error": error}
+        if state.get("trace_id"):
+            payload["trace_id"] = state["trace_id"]
         try:
             h.wfile.write(b"event: error\ndata: "
                           + json.dumps(payload).encode() + b"\n\n")
@@ -949,6 +1120,32 @@ class FleetRouter:
             h.wfile.write(body)
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
+
+
+def _scan_trace_jsonl(path: str, tid: str) -> Tuple[list, Optional[dict]]:
+    """Pull one trace id's records out of a replica sink file:
+    (event lines, terminal record or None). Torn tails (a replica
+    SIGKILLed mid-write) and foreign lines are skipped, not fatal."""
+    evs: list = []
+    term: Optional[dict] = None
+    try:
+        with open(path) as f:
+            for line in f:
+                if tid not in line:        # cheap pre-filter
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("trace_id") != tid:
+                    continue
+                if rec.get("ev") == "terminal":
+                    term = rec
+                else:
+                    evs.append(rec)
+    except OSError:
+        pass
+    return evs, term
 
 
 def _has_outcome(resp) -> bool:

@@ -142,6 +142,7 @@ import numpy as np
 from ..framework import core as _core
 from ..observability import device_events as _devev
 from ..observability import metrics as _metrics
+from ..observability import reqtrace as _rtrace
 from ..utils.fault_injection import fault_point
 from .router import RETRY_AFTER_CEILING_S
 from .router import chain_key as _chain_key
@@ -207,6 +208,12 @@ _CACHE_AWARE = _metrics.counter(
     "serving.cache_aware_admits_total",
     "admissions reordered ahead of FIFO because their prompt prefix "
     "was hot in the prefix cache")
+_ATTR = _metrics.histogram(
+    "serving.attribution_seconds",
+    "per-request wall decomposed into the request-trace attribution "
+    "buckets (label bucket=queue_wait|prefill_compute|decode_compute|"
+    "preempted|page_wait|draft_overhead|failover|stream_write); per "
+    "request, sum over buckets == wall by construction (ISSUE 18)")
 
 
 class DeadlineExceeded(RuntimeError):
@@ -298,6 +305,15 @@ class GenerationRequest:
     # waiter was admitted ahead of this one — bounded by the engine's
     # cache_jump_limit so heat can never starve a cold request
     admit_bypassed: int = 0
+    # request-scope tracing (ISSUE 18): the traceparent-style id the
+    # router/gateway minted (or honored from the client), the seconds
+    # the router already spent on failed hops before THIS replica saw
+    # the request (preloaded into the ledger's `failover` bucket AND
+    # the reported wall, keeping sum(buckets)==wall end-to-end), and
+    # the engine-attached RequestTrace carrying timeline + ledger
+    trace_id: Optional[str] = None
+    failover_preload_s: float = 0.0
+    trace: Optional[object] = field(default=None, repr=False)
 
     @property
     def done(self) -> bool:
@@ -758,7 +774,8 @@ class ContinuousBatchingEngine:
                  degrade_high_water: float = 0.85,
                  degrade_low_water: float = 0.5,
                  degrade_hysteresis: int = 16,
-                 tick_timeout_s: Optional[float] = None):
+                 tick_timeout_s: Optional[float] = None,
+                 request_trace: Optional[bool] = None):
         from ..models import llama as L
         self.cfg = model.cfg
         self.B = int(max_batch)
@@ -896,6 +913,15 @@ class ContinuousBatchingEngine:
                                     on_timeout="warn")
         if self._slo:
             _register_health_engine(self)
+        # -- request-scope tracing (ISSUE 18). Resolved ONCE here (the
+        # established kill-switch idiom); every instrumented site guards
+        # on the bool. =0 restores the pre-trace tick loop bitwise:
+        # tracing is pure observation — no scheduling decision reads it.
+        self._rtrace = (_core.get_bool_flag("FLAGS_request_trace", True)
+                        if request_trace is None else bool(request_trace))
+        # request_id -> (req, bucket) for slots that DID something this
+        # tick; settled into each request's ledger at the end of step()
+        self._tick_roles: Dict[int, tuple] = {}
 
     # -- memory accounting ---------------------------------------------------
 
@@ -1107,6 +1133,16 @@ class ContinuousBatchingEngine:
             self._next_id += 1
         req.arrived_s = time.perf_counter()
         req.status = "queued"
+        if self._rtrace:
+            tr = _rtrace.new_trace(req.trace_id, now=req.arrived_s)
+            req.trace = tr
+            req.trace_id = tr.trace_id
+            if req.failover_preload_s > 0:
+                # router-measured failed-hop seconds carried in on the
+                # request: credited to the failover bucket AND the wall
+                tr.preload("failover", req.failover_preload_s)
+            tr.event("arrival", prompt_tokens=len(req.prompt),
+                     priority=req.priority)
         self.waiting.append(req)
         return req.request_id
 
@@ -1148,6 +1184,16 @@ class ContinuousBatchingEngine:
         slot.pending = []
         self._free_slot_pages(i)
         req.status = "queued"
+        if self._rtrace and req.trace is not None:
+            tr = req.trace
+            self._tick_roles.pop(req.request_id, None)
+            # the span up to this instant was active work (this tick's
+            # role if one was assigned, else the last charged bucket);
+            # from here to re-admission it waits as `preempted`
+            ent = self._tick_roles.pop(req.request_id, None)
+            tr.charge(ent[1] if ent is not None else tr.pending_bucket)
+            tr.pending_bucket = "preempted"
+            tr.event("preempted")
         self.waiting.insert(0, req)
         self.preemptions += 1
         _PREEMPTS.inc()
@@ -1158,6 +1204,43 @@ class ContinuousBatchingEngine:
         return (-(-eff_len // self.page) > self.pool.n_pages - 1
                 or eff_len > self.S)
 
+    def _trace_settle(self, req, event: str, **fields):
+        """Terminal trace bookkeeping: charge the residual span (last
+        mark -> finished_s) to the in-flight bucket, write the terminal
+        record through the sink, and roll the ledger into the labeled
+        attribution histogram with this trace as the exemplar. The
+        charge chain guarantees sum(buckets) == wall by construction."""
+        if not self._rtrace or req.trace is None:
+            return
+        tr = req.trace
+        if tr.status is not None:
+            return                       # already terminal (idempotent)
+        now = (req.finished_s if req.finished_s is not None
+               else time.perf_counter())
+        ent = self._tick_roles.pop(req.request_id, None)
+        bucket = ent[1] if ent is not None else tr.pending_bucket
+        tr.charge(bucket, now)
+        if req.error:
+            fields.setdefault("error", req.error)
+        tr.finish(req.status, event, now=now, **fields)
+        for name, secs in tr.buckets.items():
+            _ATTR.observe(secs, exemplar=tr.trace_id, bucket=name)
+
+    def _trace_charge_tick(self):
+        """End-of-tick ledger settlement: every request that played a
+        role this tick gets the span since its last mark charged to
+        that role (terminal requests already settled at finish and are
+        skipped by the status guard in charge order)."""
+        if not self._tick_roles:
+            return
+        now = time.perf_counter()
+        for req, bucket in self._tick_roles.values():
+            tr = req.trace
+            if tr is None or tr.status is not None:
+                continue
+            tr.charge(bucket, now)
+        self._tick_roles.clear()
+
     def _fail_request(self, req):
         """Defensive terminal path shared by both admission regimes:
         add_request gates prompts and _maybe_finish caps growth, so an
@@ -1167,6 +1250,7 @@ class ContinuousBatchingEngine:
         req.status = "failed"
         req.error = "oversized resume stream"
         req.finished_s = time.perf_counter()
+        self._trace_settle(req, "failed")
         self.finished.append(req)
 
     def _note_first_token(self, req):
@@ -1175,11 +1259,18 @@ class ContinuousBatchingEngine:
         ragged one). Resumed requests keep their original stamp."""
         if len(req.output) == 1 and req.first_token_s is None:
             req.first_token_s = time.perf_counter()
+            ttft = req.first_token_s - req.arrived_s
+            # exemplar=None is a no-op inside observe(), so the metric
+            # cells stay bitwise identical with tracing disarmed
+            ex = (req.trace_id
+                  if self._rtrace and req.trace is not None else None)
             if self._slo:
-                _TTFT.observe(req.first_token_s - req.arrived_s,
+                _TTFT.observe(ttft, exemplar=ex,
                               priority=str(req.priority))
             else:
-                _TTFT.observe(req.first_token_s - req.arrived_s)
+                _TTFT.observe(ttft, exemplar=ex)
+            if ex is not None:
+                req.trace.event("first_token", ttft_s=ttft)
 
     def _admit(self):
         """Move waiting requests into free slots, allocating ONLY the
@@ -1234,8 +1325,22 @@ class ContinuousBatchingEngine:
         for j, (_, _, eff, T, _, _) in enumerate(group):
             ids[j, :T] = eff
             n_valid[j] = T
+        if self._rtrace:
+            # close the waiting span NOW, before the prefill dispatch,
+            # so the compute lands in prefill_compute (settled at end
+            # of step by _trace_charge_tick, or at finish)
+            for _, req, _, T, need, _ in group:
+                tr = req.trace
+                if tr is None:
+                    continue
+                wait = tr.pending_bucket
+                tr.charge(wait)
+                tr.event("resumed" if wait == "preempted" else "admitted",
+                         tokens=T, pages=need)
+                tr.event("prefill_chunk", tokens=T, pages=need)
+                self._tick_roles[req.request_id] = (req, "prefill_compute")
         # per-execution device telemetry: stable executable tag stamped
-        # at trace time (xla.execute_seconds / compile attribution)
+        # at trace time (xla.dispatch_seconds / compile attribution)
         with _devev.execution("serving.prefill"):
             last, k_new, v_new = self._prefill_fn(bucket, k)(
                 self._state_arg(), jnp.asarray(ids), jnp.asarray(n_valid))
@@ -1306,10 +1411,14 @@ class ContinuousBatchingEngine:
             if req.first_token_s is not None and len(req.output) > 1:
                 tpot = ((req.finished_s - req.first_token_s)
                         / (len(req.output) - 1))
+                ex = (req.trace_id
+                      if self._rtrace and req.trace is not None else None)
                 if self._slo:
-                    _TPOT.observe(tpot, priority=str(req.priority))
+                    _TPOT.observe(tpot, exemplar=ex,
+                                  priority=str(req.priority))
                 else:
-                    _TPOT.observe(tpot)
+                    _TPOT.observe(tpot, exemplar=ex)
+            self._trace_settle(req, "finished", n_tokens=len(req.output))
             self.finished.append(req)
             slot.req = None
             slot.pending = []
@@ -1457,6 +1566,14 @@ class ContinuousBatchingEngine:
             self.page_table[i, :] = 0
             if cached:
                 self.page_table[i, :len(cached)] = cached
+            if self._rtrace and req.trace is not None:
+                tr = req.trace
+                wait = tr.pending_bucket
+                tr.charge(wait)
+                tr.event("resumed" if wait == "preempted" else "admitted",
+                         cached_pages=len(cached))
+                if cached:
+                    tr.event("prefix_reuse", pages=len(cached))
 
     def _schedule_chunks(self) -> List[Tuple[int, List[int], bool]]:
         """Build this tick's ragged batch: one decode row per active
@@ -1656,6 +1773,17 @@ class ContinuousBatchingEngine:
             slot.spec_calm = 0
             if 2 * accepted < drafted:
                 slot.spec_k = max(1, slot.spec_k // 2)
+        if self._rtrace and req.trace is not None and drafted:
+            tr = req.trace
+            tr.event("draft_proposed", n=drafted)
+            if accepted:
+                tr.event("draft_accepted", n=accepted)
+            if drafted - accepted:
+                tr.event("draft_rejected", n=drafted - accepted)
+            # a tick whose entire draft was refuted bought nothing: its
+            # wall is speculation overhead, not decode progress
+            self._tick_roles[req.request_id] = (
+                req, "draft_overhead" if accepted == 0 else "decode_compute")
         self._note_first_token(req)
         self._maybe_finish(i)
 
@@ -1684,6 +1812,33 @@ class ContinuousBatchingEngine:
         if not entries:
             self.last_packed_tokens = 0
             return
+        if self._rtrace:
+            # tick-role assignment: what each in-flight request is DOING
+            # this tick. The span since its last mark is charged to this
+            # role at end of step (_trace_charge_tick) or at finish.
+            scheduled = set()
+            for i, rows, is_prefill in entries:
+                scheduled.add(i)
+                r = self.slots[i].req
+                if r is None or r.trace is None:
+                    continue
+                if is_prefill:
+                    self._tick_roles[r.request_id] = (r, "prefill_compute")
+                    r.trace.event("prefill_chunk", tokens=len(rows),
+                                  pages=len(self.slot_pages[i]))
+                else:
+                    self._tick_roles.setdefault(
+                        r.request_id, (r, "decode_compute"))
+                    r.trace.event("decode_tick")
+            for i, slot in enumerate(self.slots):
+                if slot.free or i in scheduled:
+                    continue
+                r = slot.req
+                if r is None or r.trace is None:
+                    continue
+                # active but unscheduled: parked on a dry pool / spent
+                # chunk budget — that wait is page_wait, not compute
+                self._tick_roles[r.request_id] = (r, "page_wait")
         B, page, T = self.B, self.page, self._T_pack
         toks = np.zeros((T,), np.int32)
         pos = np.zeros((T,), np.int32)
@@ -1880,6 +2035,7 @@ class ContinuousBatchingEngine:
         victim.error = ("shed under sustained admission starvation "
                         f"({self.shed_patience} ticks)")
         victim.finished_s = time.perf_counter()
+        self._trace_settle(victim, "shed")
         self.finished.append(victim)
         self.sheds += 1
         _SHEDS.inc()
@@ -1889,6 +2045,7 @@ class ContinuousBatchingEngine:
         req.error = (f"DeadlineExceeded: deadline_s={req.deadline_s} "
                      f"passed after {len(req.output)} token(s)")
         req.finished_s = time.perf_counter()
+        self._trace_settle(req, "deadline_miss")
         self.finished.append(req)
         self.deadline_misses += 1
         _DEADLINE_MISSES.inc()
@@ -1905,6 +2062,7 @@ class ContinuousBatchingEngine:
         req.status = "failed"
         req.error = reason
         req.finished_s = time.perf_counter()
+        self._trace_settle(req, "failed")
         self.finished.append(req)
         self.quarantines += 1
         _QUARANTINES.inc()
@@ -1935,6 +2093,7 @@ class ContinuousBatchingEngine:
             req.status = "failed"
             req.error = f"{type(exc).__name__}: {exc}"
             req.finished_s = time.perf_counter()
+            self._trace_settle(req, "failed")
             self.finished.append(req)
             self.quarantines += 1
             _QUARANTINES.inc()
@@ -1963,6 +2122,7 @@ class ContinuousBatchingEngine:
         req.status = "cancelled"
         req.error = reason
         req.finished_s = time.perf_counter()
+        self._trace_settle(req, "cancelled")
         self.finished.append(req)
         return True
 
@@ -2011,7 +2171,12 @@ class ContinuousBatchingEngine:
             snap["prefix_cache"] = {**self._pcache.stats(),
                                     "page_size": self._pcache.page,
                                     "epoch": self._pcache.epoch,
-                                    "heat": self._pcache.heat()}
+                                    "heat": self._pcache.heat(),
+                                    # heat freshness stamp: the router's
+                                    # prober expires affinity when this
+                                    # age crosses its TTL (or the epoch
+                                    # moved — an eviction decayed heat)
+                                    "heat_ts": time.time()}
         if not accepting:
             snap["retry_after_s"] = round(self._retry_after_hint(
                 max(queued - self.max_queue_tokens, 1)), 3)
@@ -2060,6 +2225,10 @@ class ContinuousBatchingEngine:
                 slot.produced += 1
                 slot.last_token = int(nxt[i])
                 slot.req.output.append(slot.last_token)
+                if self._rtrace and slot.req.trace is not None:
+                    self._tick_roles.setdefault(
+                        slot.req.request_id, (slot.req, "decode_compute"))
+                    slot.req.trace.event("decode_tick")
                 self._maybe_finish(i)
 
     def step(self) -> List[GenerationRequest]:
@@ -2089,6 +2258,8 @@ class ContinuousBatchingEngine:
             except Exception as exc:    # isolation boundary: one
                 self._on_tick_failure(exc)   # request fails, not the tick loop
             self._slo_post_tick()
+        if self._rtrace:
+            self._trace_charge_tick()
         _KV_PAGES.set(float(self.pool.n_pages - 1 - self.pool.n_free))
         self.ticks += 1
         return self.finished[n_done_before:]

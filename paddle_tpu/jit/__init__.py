@@ -405,7 +405,7 @@ class TrainStep:
         self._donate = donate
         self._key_base = None     # per-instance RNG base (see __call__)
         # stable executable tag stamped at trace time: per-execution
-        # device telemetry (xla.execute_seconds, per-execution collective
+        # device telemetry (xla.dispatch_seconds, per-execution collective
         # counts) and compile attribution key on it. First instance is
         # plain "train_step" so single-step jobs need no label juggling.
         n = next(_TRAIN_STEP_TAGS)
@@ -755,7 +755,7 @@ class TrainStep:
             # must run BEFORE the call: args 0-3 are donated by it
             self._step_flops = self._lower_flops(call_args)
         if armed:
-            # execution window: xla.execute_seconds{executable=tag} +
+            # execution window: xla.dispatch_seconds{executable=tag} +
             # per-execution collective counts replayed from the tag's
             # trace-time composition (observability/device_events.py)
             with _devev.execution(self._exec_tag):

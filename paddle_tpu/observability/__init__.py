@@ -34,7 +34,8 @@ from __future__ import annotations
 import os
 import threading
 
-from . import device_events, export, goodput, metrics, spans  # noqa: F401
+from . import (device_events, export, goodput, metrics,  # noqa: F401
+               reqtrace, spans)
 from .export import (append_jsonl, flight_dump,  # noqa: F401
                      install_flight_recorder, prometheus_text,
                      serve_metrics, uninstall_flight_recorder,
@@ -43,7 +44,7 @@ from .metrics import counter, gauge, histogram, snapshot  # noqa: F401
 from .spans import span  # noqa: F401
 
 __all__ = ["metrics", "spans", "export", "goodput", "device_events",
-           "enable", "enabled", "arm", "span",
+           "reqtrace", "enable", "enabled", "arm", "span",
            "counter", "gauge", "histogram", "snapshot", "prometheus_text",
            "write_snapshot", "append_jsonl", "serve_metrics",
            "install_flight_recorder", "uninstall_flight_recorder",
@@ -179,4 +180,10 @@ if _snapshot_path:
         from . import federation as _federation
         _federation.start_publisher(_snapshot_path)
     except Exception:
+        pass    # unwritable path must not break `import paddle_tpu`
+_trace_sink_path = os.environ.get("FLAGS_request_trace_sink")
+if _trace_sink_path:
+    try:
+        reqtrace.set_sink(_trace_sink_path)
+    except OSError:
         pass    # unwritable path must not break `import paddle_tpu`

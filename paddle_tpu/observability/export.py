@@ -105,13 +105,21 @@ def prometheus_text(snap: Optional[dict] = None) -> str:
     for metric_id, series in sorted(snap.get("histograms", {}).items()):
         name = _head(metric_id, "histogram")
         for label_key, cell in sorted(series.items()):
+            exemplars = cell.get("exemplars") or {}
             cum = 0
             for le, n in cell["buckets"]:
                 cum += n
                 le_s = "+Inf" if le == "+Inf" else "%g" % le
-                lines.append(
-                    f"{name}_bucket"
-                    f"{_prom_label_str(label_key, {'le': le_s})} {cum}")
+                line = (f"{name}_bucket"
+                        f"{_prom_label_str(label_key, {'le': le_s})} {cum}")
+                ex = exemplars.get(le_s)
+                if ex:
+                    # OpenMetrics exemplar: a p99 bucket names a
+                    # concrete trace id to pull via GET /v1/trace/<id>
+                    line += (' # {trace_id="%s"} %s %s'
+                             % (ex["trace_id"], _prom_value(ex["value"]),
+                                _prom_value(ex["ts"])))
+                lines.append(line)
             lines.append(
                 f"{name}_sum{_prom_label_str(label_key)} "
                 f"{_prom_value(cell['sum'])}")

@@ -216,14 +216,25 @@ def _relabel(label_key: str, rank, inc) -> str:
 
 
 def _merge_hist_cells(a: dict, b: dict) -> Optional[dict]:
-    """Bucket-merge two histogram cells; None when edges disagree."""
+    """Bucket-merge two histogram cells; None when edges disagree.
+    Per-bucket exemplars survive the merge: the NEWEST exemplar (by its
+    observation ts) wins per bucket, so the job-level rollup still links
+    a p99 bucket to a pullable trace id."""
     ea = [x[0] for x in a["buckets"]]
     eb = [x[0] for x in b["buckets"]]
     if ea != eb:
         return None
-    return {"buckets": [[le, na + nb] for (le, na), (_, nb) in
-                        zip(a["buckets"], b["buckets"])],
-            "sum": a["sum"] + b["sum"], "count": a["count"] + b["count"]}
+    out = {"buckets": [[le, na + nb] for (le, na), (_, nb) in
+                       zip(a["buckets"], b["buckets"])],
+           "sum": a["sum"] + b["sum"], "count": a["count"] + b["count"]}
+    exemplars = dict(a.get("exemplars") or {})
+    for le, ex in (b.get("exemplars") or {}).items():
+        cur = exemplars.get(le)
+        if cur is None or ex.get("ts", 0) >= cur.get("ts", 0):
+            exemplars[le] = ex
+    if exemplars:
+        out["exemplars"] = exemplars
+    return out
 
 
 def _int_inc(snap) -> int:

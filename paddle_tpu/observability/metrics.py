@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 from bisect import bisect_left
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -182,7 +183,13 @@ class Gauge(_Instrument):
 class Histogram(_Instrument):
     """Fixed-bucket histogram: per-bucket counts + sum + count per label
     set. Bucket bounds are upper-inclusive edges; an implicit +Inf bucket
-    catches the tail (Prometheus histogram semantics)."""
+    catches the tail (Prometheus histogram semantics).
+
+    `observe(v, exemplar="<trace id>")` additionally pins the LAST
+    exemplar per bucket — `{trace_id, value, ts}` riding the bucket the
+    observation landed in (OpenMetrics exemplar semantics) — so a p99
+    bucket in the exported histogram links to a concrete inspectable
+    request trace instead of being an anonymous count."""
 
     kind = "histogram"
     __slots__ = ("buckets",)
@@ -195,7 +202,8 @@ class Histogram(_Instrument):
             raise ValueError(f"histogram {name!r}: needs >= 1 bucket")
         self.buckets = b
 
-    def observe(self, v: float, **labels) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None,
+                **labels) -> None:
         if not _enabled:
             return
         key = _label_key(labels)
@@ -203,22 +211,34 @@ class Histogram(_Instrument):
         with self._vlock:
             cell = self._values.get(key)
             if cell is None:
-                # [counts per bucket + overflow, sum, count]
+                # [counts per bucket + overflow, sum, count,
+                #  {bucket_idx: [trace_id, value, ts]}]
                 cell = self._values[key] = \
-                    [[0] * (len(self.buckets) + 1), 0.0, 0]
+                    [[0] * (len(self.buckets) + 1), 0.0, 0, {}]
             cell[0][i] += 1
             cell[1] += v
             cell[2] += 1
+            if exemplar is not None:
+                cell[3][i] = [str(exemplar), float(v), time.time()]
 
     def snapshot(self) -> dict:
         with self._vlock:
             out = {}
-            for key, (counts, total, n) in self._values.items():
-                out[key] = {
+            for key, cell in self._values.items():
+                counts, total, n = cell[0], cell[1], cell[2]
+                d = {
                     "buckets": [[b, c] for b, c in
                                 zip(self.buckets, counts)] +
                                [["+Inf", counts[-1]]],
                     "sum": total, "count": n}
+                exemplars = cell[3] if len(cell) > 3 else None
+                if exemplars:
+                    edges = list(self.buckets) + ["+Inf"]
+                    d["exemplars"] = {
+                        ("+Inf" if edges[i] == "+Inf" else "%g" % edges[i]):
+                        {"trace_id": ex[0], "value": ex[1], "ts": ex[2]}
+                        for i, ex in sorted(exemplars.items())}
+                out[key] = d
             return out
 
 

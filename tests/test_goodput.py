@@ -177,7 +177,7 @@ class TestDeviceEvents:
         execd = snap["counters"]["collective.executed_calls_total"]
         key = "executable=testexec.toy,op=all_reduce"
         assert execd[key] == 3
-        exe = snap["histograms"]["xla.execute_seconds"]
+        exe = snap["histograms"]["xla.dispatch_seconds"]
         assert exe["executable=testexec.toy"]["count"] == 3
 
     def test_compile_durations_attributed_to_tag(self):
@@ -186,7 +186,7 @@ class TestDeviceEvents:
         comp = snap["histograms"].get("xla.compile_seconds", {})
         tagged = [k for k in comp if "executable=train_step" in k]
         assert tagged, comp.keys()
-        exe = snap["histograms"]["xla.execute_seconds"]
+        exe = snap["histograms"]["xla.dispatch_seconds"]
         tag_cells = [k for k in exe if k.startswith("executable=train_step")]
         assert tag_cells and sum(exe[k]["count"] for k in tag_cells) == 2
 
@@ -212,7 +212,7 @@ class TestDeviceEvents:
         with device_events.execution("testexec.off"):
             pass
         assert metrics.snapshot()["histograms"].get(
-            "xla.execute_seconds", {}) == {}
+            "xla.dispatch_seconds", {}) == {}
 
 
 class TestDeviceMemoryGauges:

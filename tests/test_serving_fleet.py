@@ -647,14 +647,26 @@ class TestFleetWithEngines:
         r = FleetRouter(endpoints=[("127.0.0.1", p2)])
         r.probe_all()
         r.start(probe=False)
+        # pin the trace id (ISSUE 18): the end frame echoes it, so the
+        # two requests must carry the SAME id for the byte comparison —
+        # the router honors a client trace header just like the gateway
+        hdr = {"X-Request-Trace": "0123456789abcdef" * 2}
         try:
-            direct = _post(p1, body)
+            c1 = http.client.HTTPConnection("127.0.0.1", p1, timeout=30)
+            c1.request("POST", "/v1/generate", body=json.dumps(body),
+                       headers=hdr)
+            direct = c1.getresponse()
             direct_raw = direct.read()
             assert direct.status == 200
-            routed = _post(r.port, body)
+            c2 = http.client.HTTPConnection("127.0.0.1", r.port,
+                                            timeout=30)
+            c2.request("POST", "/v1/generate", body=json.dumps(body),
+                       headers=hdr)
+            routed = c2.getresponse()
             routed_raw = routed.read()
             assert routed.status == 200
             assert routed_raw == direct_raw
+            c1.close(), c2.close()
         finally:
             r.stop(), g1.stop(), g2.stop()
 

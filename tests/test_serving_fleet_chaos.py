@@ -39,7 +39,9 @@ def _get_json(port, path, timeout=10):
     r = c.getresponse()
     body = r.read()
     c.close()
-    return r.status, json.loads(body) if path == "/healthz" else body
+    return r.status, (json.loads(body)
+                      if path == "/healthz" or path.startswith("/v1/trace/")
+                      else body)
 
 
 def _sse_frames(raw: str):
@@ -155,7 +157,10 @@ def test_fleet_survives_replica_sigkill_then_drains(tmp_path):
         for t in ts:
             t.join(timeout=150)
         assert warm[0] and warm[0][0] == "sse", warm[0]
-        assert warm[0][1] == ("end", {"status": "served", "n_tokens": 5})
+        wname, wpayload = warm[0][1]
+        assert wname == "end"
+        assert len(wpayload.pop("trace_id")) == 32    # ISSUE 18 handle
+        assert wpayload == {"status": "served", "n_tokens": 5}
         st, hz = _get_json(port, "/healthz")
         assert st == 200
         # determinism through the router: the same greedy tokens as the
@@ -214,6 +219,28 @@ def test_fleet_survives_replica_sigkill_then_drains(tmp_path):
                 assert detail[0] in ("end", "error"), detail
             else:
                 assert kind == "http", (kind, detail)
+
+        # -- fleet-scope trace view through the kill (ISSUE 18) ----------
+        # Every terminal frame carried a trace id; the fleet router must
+        # resolve each at GET /v1/trace/<id> FROM THE JSONL SINKS under
+        # --log-dir — for the SIGKILLed replica the sink is all that
+        # remains of it — and at least one trace (the stream in flight
+        # on the victim) must name a failover hop off the dead replica.
+        tids = [detail[1].get("trace_id") for kind, detail in results
+                if kind == "sse" and detail]
+        tids = [t for t in tids if t]
+        assert tids, "no terminal frame carried a trace id"
+        hopped = 0
+        for tid in tids:
+            st, doc = _get_json(port, f"/v1/trace/{tid}")
+            assert st == 200, f"fleet router cannot resolve trace {tid}"
+            assert doc["trace_id"] == tid
+            assert doc["events"] or doc["hops"], doc
+            if doc["hops"]:
+                hopped += 1
+                assert doc["hops"][0]["replica"] == victim["idx"]
+        assert hopped >= 1, \
+            "no trace recorded a failover hop off the killed replica"
 
         # -- flight recorder + relaunch under a fresh incarnation --------
         deadline = time.time() + 120

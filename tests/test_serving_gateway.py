@@ -115,7 +115,13 @@ class TestWire:
         assert r.getheader("Content-Type") == "text/event-stream"
         toks, terminal = _sse_tokens(r.read().decode())
         assert toks == ref
-        assert terminal == ("end", {"status": "served", "n_tokens": 6})
+        name, payload = terminal
+        assert name == "end"
+        # the end frame carries the request's trace id (ISSUE 18): the
+        # client-visible handle for GET /v1/trace/<id>
+        tid = payload.pop("trace_id")
+        assert len(tid) == 32 and tid == r.getheader("X-Request-Id")
+        assert payload == {"status": "served", "n_tokens": 6}
 
     def test_non_stream_document(self, served, model):
         _, port, _, _ = served
@@ -124,6 +130,7 @@ class TestWire:
                          "stream": False})
         assert r.status == 200
         body = json.loads(r.read())
+        assert len(body.pop("trace_id")) == 32
         assert body == {"status": "served", "output": ref}
 
     def test_bad_requests(self, served):

@@ -27,6 +27,17 @@ tail stays dynamic. Fully dynamic names (a bare variable/attribute) are
 flagged — suppress with a rationale where the dynamism is the API
 (profiler.RecordEvent forwarding user names).
 
+TRACE EVENT names (ISSUE 18) are the third namespace riding this
+discipline: every `tr.event("...")` / `req.trace.event("...")` call
+site must pass a literal snake_case id that is REGISTERED in
+`observability.reqtrace.EVENTS` — the runtime raises on unregistered
+names, but only when the site executes; this lint catches the typo'd
+event (which would fork a timeline series the trace tooling cannot
+merge) before any request has to hit the path. A conditional between
+two registered literals (`"resumed" if ... else "admitted"`) is fine —
+both arms are validated. The taxonomy is read from reqtrace.py's AST,
+not imported, so the linter never pays the jax import chain.
+
 Collector-bridged ids (register_collector rows) are data, not creation
 sites, and are out of scope here; the registry's own name validation
 still covers them at runtime.
@@ -36,7 +47,7 @@ from __future__ import annotations
 import ast
 import re
 
-from ..core import FileContext, LintPass
+from ..core import REPO, FileContext, LintPass
 
 KINDS = ("counter", "gauge", "histogram")
 # module aliases the registry is conventionally imported under
@@ -61,6 +72,64 @@ def _creation_calls(tree):
             yield node, fn.attr
 
 
+# receivers a request-trace conventionally binds to; `<x>.trace.event`
+# also matches (the GenerationRequest.trace attribute form)
+TRACE_RECEIVERS = {"tr", "trace"}
+EVENT_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_REQTRACE_PATH = REPO / "paddle_tpu" / "observability" / "reqtrace.py"
+
+
+def _load_trace_events():
+    """The registered taxonomy, from reqtrace.py's AST: the module-level
+    `EVENTS = frozenset((...))` literal. None when unreadable (the
+    taxonomy checks then stand down; literal/shape checks still run)."""
+    try:
+        tree = ast.parse(_REQTRACE_PATH.read_text())
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "EVENTS"
+                for t in node.targets):
+            val = node.value
+            if isinstance(val, ast.Call) and val.args:
+                val = val.args[0]
+            try:
+                return frozenset(ast.literal_eval(val))
+            except ValueError:
+                return None
+    return None
+
+
+def _trace_event_calls(tree):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr == "event"):
+            continue
+        recv = fn.value
+        if (isinstance(recv, ast.Name) and recv.id in TRACE_RECEIVERS) \
+                or (isinstance(recv, ast.Attribute)
+                    and recv.attr == "trace"):
+            yield node
+
+
+def _event_name_literals(arg):
+    """The literal candidates an event-name argument can resolve to:
+    [name] for a string constant, both arms for a literal conditional,
+    None when the argument is not statically known."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return [arg.value]
+    if isinstance(arg, ast.IfExp) \
+            and isinstance(arg.body, ast.Constant) \
+            and isinstance(arg.body.value, str) \
+            and isinstance(arg.orelse, ast.Constant) \
+            and isinstance(arg.orelse.value, str):
+        return [arg.body.value, arg.orelse.value]
+    return None
+
+
 def _span_calls(tree):
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
@@ -78,13 +147,16 @@ class MetricNamesPass(LintPass):
     name = "metric-names"
     description = ("metric ids must be literal, unique, snake_case "
                    "'subsystem.name'; span names literal (or literal-"
-                   "prefixed) with one home module per name")
+                   "prefixed) with one home module per name; trace "
+                   "event names literal and registered in "
+                   "reqtrace.EVENTS")
     severity = "error"
     scope = ("paddle_tpu/",)
 
     def begin(self, repo):
         self._seen = {}     # (kind, id) -> (relpath, line)
         self._span_seen = {}    # span name -> (relpath, line)
+        self._events = _load_trace_events()
 
     def check_file(self, ctx: FileContext):
         out = []
@@ -159,4 +231,34 @@ class MetricNamesPass(LintPass):
                     "\"subsystem.\" + tail concatenation) — fully "
                     "dynamic names defeat grep and the post-mortem "
                     "tooling"))
+        # reqtrace.py itself forwards a validated variable through
+        # self.event(...) — its receiver is `self`, outside
+        # TRACE_RECEIVERS, so the module needs no suppression.
+        for node in _trace_event_calls(ctx.tree):
+            if not node.args:
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    "trace .event(...) with no event-name argument"))
+                continue
+            names = _event_name_literals(node.args[0])
+            if names is None:
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    "trace event name must be a string LITERAL (or a "
+                    "conditional between two literals) — computed "
+                    "names defeat grep and the timeline tooling"))
+                continue
+            for name in names:
+                if not EVENT_NAME_RE.match(name):
+                    out.append(self.finding(
+                        ctx, node.lineno,
+                        f"trace event name {name!r} must be snake_case "
+                        f"(e.g. 'prefill_chunk')"))
+                elif self._events is not None and name not in self._events:
+                    out.append(self.finding(
+                        ctx, node.lineno,
+                        f"trace event {name!r} is not registered in "
+                        f"observability.reqtrace.EVENTS — add it to the "
+                        f"taxonomy (with a comment saying what it "
+                        f"marks) or fix the typo"))
         return out

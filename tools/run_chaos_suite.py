@@ -16,9 +16,16 @@ excludes) and THIS runner executes them as a separate gate:
   process tree is killed on overrun),
 - appending one JSON line per test to --out (default
   chaos_summary.jsonl): nodeid, status, rc, seconds — machine-readable
-  for a CI annotation or trend dashboard.
+  for a CI annotation or trend dashboard,
+- with a per-drill request-trace sink (FLAGS_request_trace_sink into
+  --trace-dir) so every in-process engine a drill builds leaves its
+  timelines behind, and a FINAL gate row: `trace_report.py --check`
+  over the collected sinks — any trace whose attribution ledger does
+  not sum exactly to its wall (or any torn sink line) fails the suite,
+  turning every chaos drill into an exact-accounting probe for free.
 
-Exit code: 0 when every drill passed, 1 otherwise.
+Exit code: 0 when every drill passed AND the trace check passed, 1
+otherwise.
 
     JAX_PLATFORMS=cpu python tools/run_chaos_suite.py
     python tools/run_chaos_suite.py -k rejoin --timeout 180
@@ -65,14 +72,21 @@ def _env():
     return env
 
 
-def run_one(nodeid: str, timeout: float) -> dict:
+def run_one(nodeid: str, timeout: float, trace_dir: str = "") -> dict:
     t0 = time.monotonic()
+    env = _env()
+    if trace_dir:
+        # one sink per drill: in-process engines the drill builds write
+        # their timelines here; the post-suite trace check reads them
+        safe = "".join(c if c.isalnum() else "_" for c in nodeid)[-80:]
+        env["FLAGS_request_trace_sink"] = os.path.join(
+            trace_dir, f"trace.{safe}.jsonl")
     # start_new_session: a timeout must kill the drill's WHOLE process
     # tree (supervisor + workers + master), not just the pytest shim
     p = subprocess.Popen(
         [sys.executable, "-m", "pytest", nodeid, "-q",
          "-p", "no:cacheprovider"],
-        cwd=str(REPO), env=_env(), start_new_session=True,
+        cwd=str(REPO), env=env, start_new_session=True,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
     try:
         out, _ = p.communicate(timeout=timeout)
@@ -101,7 +115,13 @@ def main(argv=None) -> int:
                     help="per-test wall clock bound in seconds")
     ap.add_argument("-k", default=None,
                     help="pytest -k expression to filter drills")
+    ap.add_argument("--trace-dir", default="chaos_traces",
+                    help="request-trace sink dir, checked with "
+                         "trace_report.py --check after the drills "
+                         "('' disables)")
     args = ap.parse_args(argv)
+    if args.trace_dir:
+        os.makedirs(args.trace_dir, exist_ok=True)
 
     nodes = collect(args)
     if not nodes:
@@ -113,14 +133,36 @@ def main(argv=None) -> int:
     failed = 0
     with open(args.out, "w") as f:
         for n in nodes:
-            rec = run_one(n, args.timeout)
+            rec = run_one(n, args.timeout, args.trace_dir)
             f.write(json.dumps(rec) + "\n")
             f.flush()
             mark = "ok " if rec["status"] == "passed" else "FAIL"
             print(f"  [{mark}] {rec['seconds']:7.1f}s {n}")
             if rec["status"] != "passed":
                 failed += 1
-    print(f"run_chaos_suite: {len(nodes) - failed}/{len(nodes)} passed")
+        if args.trace_dir:
+            # the exact-accounting gate over every sink the drills left
+            t0 = time.monotonic()
+            r = subprocess.run(
+                [sys.executable, str(REPO / "tools" / "trace_report.py"),
+                 args.trace_dir, "--check"],
+                cwd=str(REPO), env=_env(),
+                capture_output=True, text=True)
+            rec = {"nodeid": f"trace_report --check {args.trace_dir}",
+                   "status": "passed" if r.returncode == 0 else "failed",
+                   "rc": r.returncode,
+                   "seconds": round(time.monotonic() - t0, 2)}
+            if r.returncode != 0:
+                rec["tail"] = (r.stdout + r.stderr)[-2000:]
+                failed += 1
+            f.write(json.dumps(rec) + "\n")
+            mark = "ok " if rec["status"] == "passed" else "FAIL"
+            lines = (r.stdout or "").strip().splitlines()
+            print(f"  [{mark}] {rec['seconds']:7.1f}s "
+                  f"{lines[-1] if lines else 'trace check'}"[:200])
+    print(f"run_chaos_suite: {len(nodes) - min(failed, len(nodes))}"
+          f"/{len(nodes)} passed"
+          + (" + trace check" if args.trace_dir else ""))
     return 1 if failed else 0
 
 

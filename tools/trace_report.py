@@ -1,0 +1,347 @@
+#!/usr/bin/env python
+"""Offline request-trace reporter (ISSUE 18): percentile tail-latency
+attribution + top-k slowest per-request timelines from the per-replica
+JSONL trace sinks a serving fleet leaves behind.
+
+Input: directories (scanned for `trace*.jsonl` sink files, the
+supervisor's `fleet_events.jsonl`, and `metrics.rank*.inc*.json`
+registry snapshots) and/or individual JSONL files. Everything on disk
+was written through append+flush, so the report works on the remains of
+a SIGKILLed fleet — the whole point of the sink.
+
+Output:
+
+- a status census (served / failed / shed / deadline_missed / ...),
+- the attribution percentile table: for the end-to-end wall, TTFT, and
+  every ledger bucket (queue_wait, prefill_compute, decode_compute,
+  preempted, page_wait, draft_overhead, failover, stream_write), the
+  p50/p90/p99/max over terminal traces plus each bucket's mean share of
+  wall — WHERE the tail lives, not just that it exists,
+- the top-k slowest request timelines (events with offsets from
+  arrival, failover hops merged in from fleet_events.jsonl),
+- p99 exemplar resolution: the trace ids riding the TTFT/TPOT histogram
+  buckets (metrics snapshots) resolved to their full timelines, so the
+  histogram's worst bucket points at an actual request.
+
+`--check` is the machine gate (wired into tools/run_chaos_suite.py):
+every sink line must parse as JSON and every terminal record must
+satisfy |sum(buckets) - wall| <= 1e-6 — the exact-accounting invariant
+the engine promises by construction. Exit 0 clean, 1 violated.
+
+    python tools/trace_report.py /tmp/fleet_logs --top 3
+    python tools/trace_report.py /tmp/fleet_logs --check
+    python tools/trace_report.py /tmp/fleet_logs --trace <id>
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+BUCKETS = ("queue_wait", "prefill_compute", "decode_compute", "preempted",
+           "page_wait", "draft_overhead", "failover", "stream_write")
+
+TOLERANCE = 1e-6
+
+_SINK_RE = re.compile(r"trace(?:\.rank(\d+)\.inc(\d+))?\.jsonl$")
+
+
+class Trace:
+    """One trace id's merged view across every sink file."""
+
+    def __init__(self, trace_id: str):
+        self.trace_id = trace_id
+        self.events: List[dict] = []
+        self.terminal: Optional[dict] = None
+        self.hops: List[dict] = []
+        self.sources: List[str] = []
+
+    @property
+    def wall(self) -> Optional[float]:
+        return self.terminal.get("wall") if self.terminal else None
+
+    @property
+    def buckets(self) -> Dict[str, float]:
+        return (self.terminal.get("buckets") or {}) if self.terminal \
+            else {}
+
+    @property
+    def status(self) -> str:
+        return (self.terminal.get("status") or "?") if self.terminal \
+            else "in-flight"
+
+    def ttft(self) -> Optional[float]:
+        for e in self.events:
+            if e.get("ev") == "first_token":
+                return e.get("ttft_s")
+        return None
+
+
+def _iter_files(paths: List[str]) -> Tuple[List[str], List[str], List[str]]:
+    """(sink files, fleet-event files, metrics snapshot files)."""
+    sinks: List[str] = []
+    events: List[str] = []
+    snaps: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            sinks.extend(sorted(glob.glob(os.path.join(p, "trace*.jsonl"))))
+            events.extend(sorted(glob.glob(
+                os.path.join(p, "*events*.jsonl"))))
+            snaps.extend(sorted(glob.glob(
+                os.path.join(p, "metrics*.json"))))
+        elif p.endswith(".jsonl"):
+            (events if "events" in os.path.basename(p)
+             else sinks).append(p)
+        elif p.endswith(".json"):
+            snaps.append(p)
+    return sinks, events, snaps
+
+
+def load(paths: List[str]) -> Tuple[Dict[str, Trace], List[str]]:
+    """Parse every sink + fleet-event file into per-trace records.
+    Returns (traces by id, parse-error strings)."""
+    traces: Dict[str, Trace] = {}
+    errors: List[str] = []
+    sinks, event_files, _ = _iter_files(paths)
+
+    def tr(tid: str) -> Trace:
+        t = traces.get(tid)
+        if t is None:
+            t = traces[tid] = Trace(tid)
+        return t
+
+    for path in sinks:
+        src = os.path.basename(path)
+        try:
+            with open(path) as f:
+                for ln, line in enumerate(f, 1):
+                    if not line.strip():
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        errors.append(f"{src}:{ln}: unparseable line")
+                        continue
+                    tid = rec.get("trace_id")
+                    if not tid:
+                        continue
+                    t = tr(tid)
+                    if src not in t.sources:
+                        t.sources.append(src)
+                    if rec.get("ev") == "terminal":
+                        t.terminal = rec
+                    else:
+                        t.events.append(rec)
+        except OSError as e:
+            errors.append(f"{src}: {e}")
+    for path in event_files:
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue     # fleet events are advisory here
+                    tid = rec.get("trace_id")
+                    if tid and rec.get("ev") == "failover_hop":
+                        tr(tid).hops.append(rec)
+        except OSError:
+            pass
+    for t in traces.values():
+        t.events.sort(key=lambda e: e.get("ts", 0))
+    return traces, errors
+
+
+def check(traces: Dict[str, Trace], errors: List[str]) -> int:
+    """The --check gate: parse cleanliness + exact accounting."""
+    bad = list(errors)
+    n_terminal = 0
+    for t in traces.values():
+        if t.terminal is None:
+            continue
+        n_terminal += 1
+        wall = t.wall
+        total = sum(t.buckets.values())
+        if wall is None or not math.isfinite(wall):
+            bad.append(f"{t.trace_id}: terminal record without a wall")
+        elif abs(total - wall) > TOLERANCE:
+            bad.append(f"{t.trace_id}: sum(buckets)={total!r} != "
+                       f"wall={wall!r} (|diff|="
+                       f"{abs(total - wall):.3e} > {TOLERANCE})")
+        for name in t.buckets:
+            if name not in BUCKETS:
+                bad.append(f"{t.trace_id}: unregistered bucket {name!r}")
+    if bad:
+        for msg in bad:
+            print(f"CHECK FAIL {msg}")
+        print(f"trace check: {len(bad)} violation(s) over "
+              f"{n_terminal} terminal trace(s)")
+        return 1
+    print(f"trace check: OK — {n_terminal} terminal trace(s), every "
+          f"line parsed, every ledger exact to {TOLERANCE}")
+    return 0
+
+
+def percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    i = max(0, min(len(sorted_vals) - 1,
+                   int(math.ceil(q * len(sorted_vals))) - 1))
+    return sorted_vals[i]
+
+
+def attribution_table(traces: List[Trace]) -> str:
+    walls = sorted(t.wall for t in traces)
+    ttfts = sorted(v for v in (t.ttft() for t in traces)
+                   if v is not None)
+    wall_total = sum(walls) or 1.0
+    rows = []
+
+    def row(name, vals, share):
+        vals = sorted(vals)
+        rows.append((name, percentile(vals, 0.50),
+                     percentile(vals, 0.90), percentile(vals, 0.99),
+                     vals[-1] if vals else float("nan"), share))
+
+    row("wall", walls, 1.0)
+    if ttfts:
+        row("ttft", ttfts, float("nan"))
+    for b in BUCKETS:
+        vals = [t.buckets.get(b, 0.0) for t in traces]
+        row(b, vals, sum(vals) / wall_total)
+    lines = ["%-16s %10s %10s %10s %10s %8s"
+             % ("series", "p50", "p90", "p99", "max", "share")]
+    for name, p50, p90, p99, mx, share in rows:
+        lines.append("%-16s %10.4f %10.4f %10.4f %10.4f %8s"
+                     % (name, p50, p90, p99, mx,
+                        ("%.1f%%" % (100 * share))
+                        if not math.isnan(share) else "-"))
+    return "\n".join(lines)
+
+
+def format_timeline(t: Trace) -> str:
+    out = [f"trace {t.trace_id}  status={t.status}"
+           + (f"  wall={t.wall:.4f}s" if t.wall is not None else "")
+           + (f"  [{', '.join(t.sources)}]" if t.sources else "")]
+    if t.terminal:
+        parts = ["%s=%.4f" % (k, v)
+                 for k, v in sorted(t.buckets.items(),
+                                    key=lambda kv: -kv[1]) if v > 0]
+        out.append("  buckets: " + (", ".join(parts) or "(empty)")
+                   + f"  decode_ticks={t.terminal.get('decode_ticks', 0)}")
+    merged = sorted(t.events + t.hops, key=lambda e: e.get("ts", 0))
+    t0 = merged[0].get("ts", 0) if merged else 0
+    for e in merged:
+        fields = {k: v for k, v in e.items()
+                  if k not in ("ev", "ts", "trace_id")}
+        extra = ("  " + " ".join(f"{k}={v}"
+                                 for k, v in sorted(fields.items()))
+                 if fields else "")
+        out.append("  +%8.4fs %-14s%s"
+                   % (e.get("ts", 0) - t0, e.get("ev", "?"), extra))
+    return "\n".join(out)
+
+
+def _exemplar_ids(snap_paths: List[str]) -> List[Tuple[str, str, str]]:
+    """(metric, le, trace_id) for the highest-edge exemplar of every
+    latency histogram cell in the metrics snapshots — the p99 pointer."""
+    out = []
+    for path in snap_paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        hists = (doc.get("metrics") or {}).get("histograms") or {}
+        for name, cells in hists.items():
+            if not name.startswith("serving."):
+                continue
+            for cell in cells.values():
+                ex = cell.get("exemplars") or {}
+                if not ex:
+                    continue
+
+                def edge(le):
+                    return math.inf if le == "+Inf" else float(le)
+
+                top = max(ex, key=edge)
+                out.append((name, top, ex[top]["trace_id"]))
+    # dedupe, newest-file-last wins order-wise
+    seen = set()
+    uniq = []
+    for item in out:
+        if item[2] not in seen:
+            seen.add(item[2])
+            uniq.append(item)
+    return uniq
+
+
+def report(paths: List[str], top: int) -> int:
+    traces, errors = load(paths)
+    _, _, snaps = _iter_files(paths)
+    for msg in errors:
+        print(f"warning: {msg}")
+    terminal = [t for t in traces.values() if t.terminal is not None
+                and t.wall is not None]
+    print(f"{len(traces)} trace(s), {len(terminal)} terminal")
+    if not terminal:
+        return 0
+    census: Dict[str, int] = {}
+    for t in terminal:
+        census[t.status] = census.get(t.status, 0) + 1
+    print("status: " + ", ".join(f"{k}={v}"
+                                 for k, v in sorted(census.items())))
+    print()
+    print(attribution_table(terminal))
+    slowest = sorted(terminal, key=lambda t: -t.wall)[:top]
+    if slowest:
+        print(f"\n-- top {len(slowest)} slowest --")
+        for t in slowest:
+            print(format_timeline(t))
+            print()
+    for metric, le, tid in _exemplar_ids(snaps):
+        t = traces.get(tid)
+        print(f"-- exemplar {metric} le={le} --")
+        if t is None:
+            print(f"trace {tid} (not in the provided sinks)")
+        else:
+            print(format_timeline(t))
+        print()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="sink dirs / trace*.jsonl files (default: .)")
+    ap.add_argument("--top", type=int, default=5,
+                    help="slowest timelines to print (default 5)")
+    ap.add_argument("--trace", default=None,
+                    help="print one trace id's full timeline and exit")
+    ap.add_argument("--check", action="store_true",
+                    help="machine gate: parse + exact-accounting check")
+    args = ap.parse_args(argv)
+    paths = args.paths or ["."]
+    if args.check:
+        traces, errors = load(paths)
+        return check(traces, errors)
+    if args.trace:
+        traces, errors = load(paths)
+        for msg in errors:
+            print(f"warning: {msg}")
+        t = traces.get(args.trace)
+        if t is None:
+            print(f"no trace {args.trace!r} in {paths}")
+            return 1
+        print(format_timeline(t))
+        return 0
+    return report(paths, args.top)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
