@@ -260,7 +260,8 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
         except BaseException as e:      # captured; re-raised on the
             rec.error = e               # caller's thread, never lost
 
-    rec.thread = threading.Thread(target=_run, daemon=True)
+    rec.thread = threading.Thread(target=_run, daemon=True,
+                                  name="paddle-ckpt-save")
     _pending.append(rec)
     rec.thread.start()
 

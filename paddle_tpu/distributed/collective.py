@@ -861,9 +861,17 @@ def _ensure_p2p_server():
                 except (EOFError, OSError):
                     c.close()
 
-            threading.Thread(target=drain, daemon=True).start()
+            # fire-and-forget by design: the drain thread exits on the
+            # peer's EOF/close; there is no shutdown path to join from
+            # graft-lint: disable=thread-hygiene
+            threading.Thread(target=drain, daemon=True,
+                             name="paddle-collective-p2p-drain").start()
 
-    threading.Thread(target=loop, daemon=True).start()
+    # process-lifetime accept loop for the module-level p2p inbox; dies
+    # with the interpreter (daemon), nothing to join
+    # graft-lint: disable=thread-hygiene
+    threading.Thread(target=loop, daemon=True,
+                     name="paddle-collective-p2p-accept").start()
 
 
 @_collective_telemetry("send")
@@ -1001,7 +1009,8 @@ def _async(fn, *args, **kw):
         except Exception as e:
             box["err"] = e
 
-    th = threading.Thread(target=run, daemon=True)
+    th = threading.Thread(target=run, daemon=True,
+                          name="paddle-collective-p2p-task")
     th.start()
     return _P2PTask(th, box)
 

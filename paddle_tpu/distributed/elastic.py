@@ -268,7 +268,8 @@ class CheckpointScrubber:
         import threading
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
-            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="paddle-ckpt-scrubber")
             self._thread.start()
         return self
 
@@ -930,10 +931,13 @@ class MembershipManager:
                 # conn.recv() while every other rank's heartbeat and
                 # barrier poll queues behind it in the TCP backlog —
                 # observed as a whole-world recovery wedge
+                # graft-lint: disable=thread-hygiene
                 threading.Thread(target=self._serve_conn, args=(conn,),
-                                 daemon=True).start()
+                                 daemon=True,
+                                 name="paddle-elastic-master-conn").start()
 
-        t = threading.Thread(target=serve, daemon=True)
+        t = threading.Thread(target=serve, daemon=True,
+                             name="paddle-elastic-master-accept")
         t.start()
         self._threads.append(t)
         return self
@@ -1293,7 +1297,8 @@ class MembershipManager:
                     pass
                 self._stop.wait(self.interval)
 
-        t = threading.Thread(target=beat, daemon=True)
+        t = threading.Thread(target=beat, daemon=True,
+                             name="paddle-elastic-heartbeat")
         t.start()
         self._threads.append(t)
         return self

@@ -357,10 +357,15 @@ class ParameterServer:
                         break
                     time.sleep(0.02)  # no busy-spin on persistent errors
                     continue
+                # per-connection handler: exits on the client's EOF /
+                # server stop; no join path by design
+                # graft-lint: disable=thread-hygiene
                 threading.Thread(target=self._handle, args=(conn,),
-                                 daemon=True).start()
+                                 daemon=True,
+                                 name="paddle-ps-conn").start()
 
-        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="paddle-ps-accept")
         self._thread.start()
         return self
 
@@ -448,7 +453,12 @@ class PSClient:
         if self._local is not None:
             return getattr(self._local, op)(*args)
         with self._lock:
+            # the lock IS this client's socket serializer: request and
+            # reply must stay paired on one connection, and no other
+            # lock is ever taken around it (bounded by the server's
+            # 30s abandoned-connection drop)
             self._conn.send((op, args))
+            # graft-lint: disable=lock-discipline
             status, out = self._conn.recv()
         if status == "err":
             raise RuntimeError(f"server error in {op}: {out}")

@@ -163,7 +163,8 @@ def init_rpc(name: str, rank: int = None, world_size: int = None,
     _state.listener = listener
     _state.me = WorkerInfo(name, rank, my_ep)
     _state.serve_thread = threading.Thread(
-        target=_serve_loop, args=(listener,), daemon=True)
+        target=_serve_loop, args=(listener,), daemon=True,
+        name="paddle-rpc-serve")
     _state.serve_thread.start()
 
     # register with rank 0 and fetch the full worker table (shared

@@ -94,7 +94,8 @@ class CommWatchdog:
     def _ensure_monitor(self):
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
-            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="paddle-comm-watchdog")
             self._thread.start()
 
     def _loop(self):

@@ -331,6 +331,14 @@ _flags: dict = {
     # traces survive for the router's fleet-scope /v1/trace lookup
     "FLAGS_request_trace": True,
     "FLAGS_request_trace_sink": "",
+    # lockdep-style lock-order witness (consumed by
+    # observability/lockwitness.py): wraps threading.Lock/RLock
+    # construction to report order inversions (potential deadlocks that
+    # never fired), held-too-long and blocked-under-lock events through
+    # the metrics registry + flight recorder. Default off: the wrappers
+    # are never even installed (zero overhead); armed by the chaos
+    # suite and the threaded tier-1 witness tests
+    "FLAGS_lock_witness": False,
     # -- input pipeline (consumed by io/prefetch.py + io DataLoader):
     # device-side double-buffered batch staging via jax.device_put; false
     # restores the synchronous un-staged loader path (the debugging kill
@@ -493,6 +501,9 @@ def _apply_flag(key, value):
         from ..observability import federation as _ofed
         if _ofed._publisher is not None:
             _ofed._publisher.interval = max(0.05, float(value))
+    elif key == "FLAGS_lock_witness":
+        from ..observability import lockwitness
+        lockwitness.enable(value not in _FALSY)
     elif key == "FLAGS_request_trace_sink":
         from ..observability import reqtrace as _ortrace
         _ortrace.set_sink(str(value) if value else None)

@@ -166,7 +166,10 @@ def main(argv=None):
             return
         print(f"signal {signum}: rolling drain "
               f"({args.nreplicas} replicas)", flush=True)
-        threading.Thread(target=_drain_then_stop, daemon=True).start()
+        # one-shot signal-driven drain; main's stop.wait() is the
+        # join path  # graft-lint: disable=thread-hygiene
+        threading.Thread(target=_drain_then_stop, daemon=True,
+                         name="paddle-fleet-drain").start()
 
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)

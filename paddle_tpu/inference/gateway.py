@@ -280,6 +280,9 @@ class EngineRunner:
                         # parked on their queues
                         self.fatal = exc
                         for st in self._streams.values():
+                            # per-stream queues are UNBOUNDED — this
+                            # put can never block
+                            # graft-lint: disable=lock-discipline
                             st.q.put(("end", "failed",
                                       f"engine fault: {exc}"))
                         self._streams.clear()

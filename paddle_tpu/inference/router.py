@@ -268,6 +268,8 @@ class ReplicaSupervisor:
             rep.accepting = True
             rep.heat = {}
             rep.consecutive_ok = rep.consecutive_fail = 0
+        # stdout pump exits on the child's EOF (child death IS the
+        # join)  # graft-lint: disable=thread-hygiene
         threading.Thread(target=self._read_child, args=(rep, p),
                          daemon=True,
                          name=f"replica{rep.idx}-stdout").start()

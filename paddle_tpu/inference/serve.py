@@ -132,7 +132,10 @@ def main(argv=None):
             return
         print(f"signal {signum}: draining "
               f"(timeout {args.drain_timeout}s)", flush=True)
-        threading.Thread(target=_drain_then_stop, daemon=True).start()
+        # one-shot signal-driven drain; main's stop.wait() is the
+        # join path  # graft-lint: disable=thread-hygiene
+        threading.Thread(target=_drain_then_stop, daemon=True,
+                         name="paddle-serve-drain").start()
 
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)

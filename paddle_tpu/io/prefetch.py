@@ -227,6 +227,9 @@ class DevicePrefetcher:
                         pass
                 t.join(timeout=1.0)
                 if t.is_alive():
+                    # deliberately unowned: the whole point is to NOT
+                    # block the consumer on the wedged staging thread
+                    # graft-lint: disable=thread-hygiene
                     threading.Thread(
                         target=lambda: (t.join(), _close_src()),
                         daemon=True, name="paddle-io-prefetch-reaper",

@@ -181,6 +181,9 @@ if _snapshot_path:
         _federation.start_publisher(_snapshot_path)
     except Exception:
         pass    # unwritable path must not break `import paddle_tpu`
+if os.environ.get("FLAGS_lock_witness") not in _FALSY_ENV:
+    from . import lockwitness as _lockwitness
+    _lockwitness.enable(True)
 _trace_sink_path = os.environ.get("FLAGS_request_trace_sink")
 if _trace_sink_path:
     try:

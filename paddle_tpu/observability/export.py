@@ -243,7 +243,8 @@ def serve_metrics(port: int, host: Optional[str] = None) -> Optional[int]:
     host = host or os.environ.get("PADDLE_METRICS_HOST", "127.0.0.1")
     _server = ThreadingHTTPServer((host, int(port)), _Handler)
     _server_thread = threading.Thread(target=_server.serve_forever,
-                                      daemon=True)
+                                      daemon=True,
+                                      name="paddle-metrics-http")
     _server_thread.start()
     return _server.server_address[1]
 
@@ -316,8 +317,14 @@ class _FlightRecorder:
                 pass
 
     def dump(self, reason: str) -> None:
+        # thread ident -> NAME of every live thread, so a post-mortem
+        # reading open_spans (which carry idents) can say "wedged in
+        # router-probe", not "wedged in Thread-7"
+        threads = {str(t.ident): t.name for t in threading.enumerate()
+                   if t.ident is not None}
         self._write({"ev": "dump", "reason": reason, "ts": time.time(),
                      "pid": os.getpid(), **_identity(),
+                     "threads": threads,
                      "open_spans": spans.open_spans(),
                      "ring_tail": spans.ring()[-64:],
                      "metrics": metrics.snapshot()})
@@ -419,3 +426,11 @@ def flight_dump(reason: str) -> None:
     CommWatchdog when a step overruns."""
     if _recorder is not None:
         _recorder.dump(reason)
+
+
+def flight_event(record: dict) -> None:
+    """Write one record through the installed flight recorder (no-op
+    otherwise). For events that must survive SIGKILL the instant they
+    happen — the lock witness reports inversions through here."""
+    if _recorder is not None:
+        _recorder._write(record)

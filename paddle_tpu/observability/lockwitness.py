@@ -1,0 +1,399 @@
+"""Lockdep-style runtime lock-order witness (FLAGS_lock_witness).
+
+The threaded runtime — fleet supervisor, affinity router, membership
+master, worker pools, prefetcher, watchdogs — has grown enough lock
+sites that a deadlock can hide for months as a never-yet-collided pair
+of nested acquisitions. This witness finds those pairs WITHOUT needing
+the deadlock to fire: it wraps `threading.Lock`/`threading.RLock`
+construction so every acquisition feeds a process-wide lock-ORDER
+graph (the Linux lockdep idea), keyed by the lock's creation site (all
+locks born at one `file:line` form one class, like lockdep lock
+classes). Holding A while acquiring B records the edge A -> B; a later
+acquisition that would close a cycle (B held, A wanted, A ->* B
+already on record) is reported as an ORDER INVERSION — a potential
+deadlock that never fired — through the metrics registry and, write-
+through, the flight recorder (kernel-buffered appends survive SIGKILL,
+so a drill killed mid-inversion still leaves the report on disk).
+
+Two more runtime smells ride on the same hooks:
+
+- held-too-long: a lock held longer than `HELD_TOO_LONG_S` (waits in
+  `Condition.wait` don't count — `_release_save` drops the hold),
+- blocked-under-lock: an acquisition that stalls longer than
+  `BLOCKED_UNDER_LOCK_S` while the thread already holds another lock
+  (the accept-loop-pinned / stalled-client signature).
+
+Discipline (same as the metrics registry): DISARMED by default. The
+default process never even installs the wrappers — `threading.Lock` is
+untouched and the overhead is exactly zero. Arming (`FLAGS_lock_witness
+=1`, env or `paddle.set_flags`, or `enable(True)`) swaps the
+`threading.Lock`/`threading.RLock` factories once; a disarmed-but-
+installed wrapper is a single module-global bool check per acquire
+(guarded by tests/test_lock_witness.py). Locks created BEFORE install
+stay unwitnessed — arm via env (the chaos-suite path) so the wrappers
+are in place before paddle_tpu's module-level locks are born.
+
+`Condition`/`Event`/`queue.Queue` need no patching of their own: they
+construct their internal locks through the `threading.Lock`/`RLock`
+module attributes at call time, so they inherit witnessed locks for
+free. RLock reentrancy is instance-aware (re-acquiring a lock you
+already hold records nothing), so reentrant designs — the recorder's
+signal-handler RLock, metrics `_vlock` — are not false positives.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import metrics
+
+__all__ = ["enable", "enabled", "install", "uninstall", "installed",
+           "report", "reset", "inversions", "HELD_TOO_LONG_S",
+           "BLOCKED_UNDER_LOCK_S"]
+
+# thresholds for the two duration smells (seconds); chaos drills and
+# tests may lower them to provoke events deterministically
+HELD_TOO_LONG_S = 1.0
+BLOCKED_UNDER_LOCK_S = 0.5
+
+# fast-path guard: every witnessed acquire reads this module global and
+# delegates raw when False — the disarmed cost of an installed wrapper
+_enabled = False
+_installed = False
+
+# originals captured at install() so uninstall() restores them exactly
+_real_lock = None
+_real_rlock = None
+
+# the witness's own state locks are REAL (pre-install) locks: the graph
+# update runs inside every witnessed acquire and must never recurse
+# into itself
+_state_lock = threading.RLock()
+
+# acquisition-order graph over lock CLASSES (creation-site keys):
+# _succ[a] = {b: first-seen info} means "a was held while b was taken"
+_succ: Dict[str, Dict[str, dict]] = {}
+_inversions: List[dict] = []
+_reported_pairs: Set[Tuple[str, str]] = set()
+_events: List[dict] = []         # held-too-long / blocked-under-lock
+
+_tls = threading.local()
+
+_C_INVERSIONS = metrics.counter(
+    "lockwitness.inversions_total",
+    "lock-order inversions (potential deadlocks) witnessed")
+_C_HELD = metrics.counter(
+    "lockwitness.held_too_long_total",
+    "lock holds exceeding the held-too-long threshold")
+_C_BLOCKED = metrics.counter(
+    "lockwitness.blocked_under_lock_total",
+    "acquisitions that stalled while another lock was held")
+
+
+def _held() -> list:
+    """This thread's stack of (wrapper, key, t_acquired)."""
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _site_key(depth: int) -> str:
+    """Creation-site lock class: 'pkg/module.py:lineno' of the frame
+    that called the factory (two trailing path parts keep keys stable
+    across checkout roots)."""
+    try:
+        f = sys._getframe(depth)
+    except ValueError:
+        return "<unknown>"
+    fn = f.f_code.co_filename.replace("\\", "/")
+    parts = fn.split("/")
+    return "/".join(parts[-2:]) + f":{f.f_lineno}"
+
+
+def _flight(record: dict) -> None:
+    """Write-through to the flight recorder (no-op when uninstalled):
+    an inversion report must survive the process being SIGKILLed before
+    anyone calls report()."""
+    from . import export
+    export.flight_event(record)
+
+
+def _record_inversion(held_key: str, want_key: str, chain: list) -> None:
+    pair = (want_key, held_key)
+    with _state_lock:
+        if pair in _reported_pairs:
+            return
+        _reported_pairs.add(pair)
+        rec = {"ev": "lock_inversion", "ts": time.time(),
+               "pid": os.getpid(),
+               "held": held_key, "wanted": want_key,
+               "established_order": chain,
+               "thread": threading.current_thread().name}
+        _inversions.append(rec)
+    _C_INVERSIONS.inc()
+    _flight(rec)
+
+
+def _record_event(ev: str, counter, **fields) -> None:
+    rec = {"ev": ev, "ts": time.time(), "pid": os.getpid(),
+           "thread": threading.current_thread().name, **fields}
+    with _state_lock:
+        _events.append(rec)
+        del _events[:-256]           # bounded: this is a smell log
+    counter.inc()
+    _flight(rec)
+
+
+def _path(frm: str, to: str) -> Optional[list]:
+    """Established-order chain frm ->* to in the acquisition graph, or
+    None. Iterative DFS; the graph is tiny (one node per lock site)."""
+    with _state_lock:
+        succ = {k: list(v) for k, v in _succ.items()}
+    stack = [(frm, [frm])]
+    seen = {frm}
+    while stack:
+        node, chain = stack.pop()
+        for nxt in succ.get(node, ()):
+            if nxt == to:
+                return chain + [to]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, chain + [nxt]))
+    return None
+
+
+def _note_acquired(wrapper: "_WitnessedLock", blocked_s: float) -> None:
+    """Graph bookkeeping after a successful witnessed acquire."""
+    held = _held()
+    key = wrapper._key
+    if held:
+        if blocked_s > BLOCKED_UNDER_LOCK_S:
+            _record_event(
+                "lock_blocked_under_lock", _C_BLOCKED,
+                wanted=key, held=[h[1] for h in held],
+                blocked_s=round(blocked_s, 4))
+        for _, held_key, _t in held:
+            if held_key == key:
+                continue         # same class nested (per-instance locks)
+            # would held_key -> key close a cycle? i.e. key ->* held_key
+            chain = _path(key, held_key)
+            if chain is not None:
+                _record_inversion(held_key, key, chain)
+                continue         # keep the graph acyclic
+            with _state_lock:
+                edges = _succ.setdefault(held_key, {})
+                if key not in edges:
+                    edges[key] = {
+                        "thread": threading.current_thread().name,
+                        "ts": time.time()}
+    held.append((wrapper, key, time.monotonic()))
+
+
+def _note_released(wrapper: "_WitnessedLock") -> None:
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i][0] is wrapper:
+            _, key, t0 = held.pop(i)
+            dt = time.monotonic() - t0
+            if dt > HELD_TOO_LONG_S:
+                _record_event("lock_held_too_long", _C_HELD,
+                              lock=key, held_s=round(dt, 4))
+            return
+
+
+class _WitnessedLock:
+    """Wrapper over one threading.Lock/RLock. Exposes the Condition
+    protocol (`_release_save`/`_acquire_restore`/`_is_owned`) so
+    `threading.Condition(witnessed_lock)` behaves exactly like the raw
+    lock — including dropping the witness's held-entry across `wait()`
+    (a condition wait is not a long hold)."""
+
+    __slots__ = ("_inner", "_key", "_reentrant")
+
+    def __init__(self, inner, key: str, reentrant: bool):
+        self._inner = inner
+        self._key = key
+        self._reentrant = reentrant
+
+    # -- core protocol -------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if not _enabled:
+            return self._inner.acquire(blocking, timeout)
+        if getattr(_tls, "in_witness", False):
+            return self._inner.acquire(blocking, timeout)
+        if self._reentrant and any(h[0] is self for h in _held()):
+            # RLock re-acquisition by the owner: no ordering event
+            return self._inner.acquire(blocking, timeout)
+        t0 = time.monotonic()
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _tls.in_witness = True
+            try:
+                _note_acquired(self, time.monotonic() - t0)
+            finally:
+                _tls.in_witness = False
+        return got
+
+    def release(self):
+        if _enabled and not getattr(_tls, "in_witness", False):
+            held = _held()
+            n = sum(1 for h in held if h[0] is self)
+            # reentrant lock: only the LAST release drops the hold
+            if n and not (self._reentrant and n < self._owned_depth()):
+                _tls.in_witness = True
+                try:
+                    _note_released(self)
+                finally:
+                    _tls.in_witness = False
+        return self._inner.release()
+
+    def _owned_depth(self) -> int:
+        """Recursion depth of an owned RLock: parsed from the repr
+        ('<locked _thread.RLock object owner=... count=N>') — the only
+        portable view; 1 on any parse failure (safe: treat release as
+        final)."""
+        r = repr(self._inner)
+        i = r.find("count=")
+        if i < 0:
+            return 1
+        try:
+            return int(r[i + 6:].split()[0].rstrip(">"))
+        except ValueError:
+            return 1
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    # -- Condition protocol -------------------------------------------
+    def _release_save(self):
+        removed = 0
+        if _enabled:
+            held = _held()
+            for i in range(len(held) - 1, -1, -1):
+                if held[i][0] is self:
+                    held.pop(i)
+                    removed += 1
+        if hasattr(self._inner, "_release_save"):
+            state = self._inner._release_save()
+        else:
+            self._inner.release()
+            state = None
+        return (state, removed)
+
+    def _acquire_restore(self, saved):
+        state, removed = saved
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        if _enabled and removed:
+            held = _held()
+            now = time.monotonic()
+            for _ in range(removed):
+                held.append((self, self._key, now))
+
+    def _is_owned(self):
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _at_fork_reinit(self):
+        self._inner._at_fork_reinit()
+        if _enabled:
+            _tls.held = []
+
+    def __repr__(self):
+        return f"<witnessed {self._key} {self._inner!r}>"
+
+
+def _lock_factory():
+    return _WitnessedLock(_real_lock(), _site_key(2), reentrant=False)
+
+
+def _rlock_factory():
+    return _WitnessedLock(_real_rlock(), _site_key(2), reentrant=True)
+
+
+def install() -> None:
+    """Swap the threading.Lock/RLock factories for witnessing wrappers
+    (idempotent). Locks created from here on are witnessed; existing
+    locks are untouched."""
+    global _installed, _real_lock, _real_rlock
+    if _installed:
+        return
+    _real_lock = threading.Lock
+    _real_rlock = threading.RLock
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    _installed = True
+
+
+def uninstall() -> None:
+    """Restore the original factories. Wrappers already handed out keep
+    working (disarmed they are one bool check), they just stop being
+    created."""
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _real_lock
+    threading.RLock = _real_rlock
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def enable(on: bool = True) -> None:
+    """Arm (installing the wrappers if needed) or disarm the witness.
+    Consumed by FLAGS_lock_witness."""
+    global _enabled
+    if on:
+        install()
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def inversions() -> List[dict]:
+    with _state_lock:
+        return [dict(r) for r in _inversions]
+
+
+def report() -> dict:
+    """{'inversions': [...], 'events': [...], 'edges': n, 'locks': n} —
+    the in-process view; the flight recorder holds the crash-safe one."""
+    with _state_lock:
+        nodes = set(_succ) | {b for v in _succ.values() for b in v}
+        return {
+            "inversions": [dict(r) for r in _inversions],
+            "events": [dict(r) for r in _events],
+            "edges": sum(len(v) for v in _succ.values()),
+            "locks": len(nodes),
+        }
+
+
+def reset() -> None:
+    """Drop the graph and all reports (test isolation)."""
+    with _state_lock:
+        _succ.clear()
+        _inversions.clear()
+        _reported_pairs.clear()
+        _events.clear()

@@ -138,7 +138,8 @@ class span:
         self._sid = next(_seq)
         self._p0 = time.perf_counter()
         ev = {"ev": "span_begin", "sid": self._sid, "name": self.name,
-              "ts": time.time(), "thread": threading.get_ident()}
+              "ts": time.time(), "thread": threading.get_ident(),
+              "thread_name": threading.current_thread().name}
         if self.attrs:
             ev["attrs"] = {k: str(v) for k, v in self.attrs.items()}
         with _lock:

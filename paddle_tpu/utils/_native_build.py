@@ -33,8 +33,12 @@ def build_and_load(src: str, so: str,
                 # atomic install: a concurrent builder in another
                 # process must never dlopen a half-written .so
                 tmp = so + f".tmp.{os.getpid()}"
+                # bounded: a wedged compiler must not pin every thread
+                # that imports a native helper behind _lock forever —
+                # TimeoutExpired lands in the except and latches failure
                 subprocess.run(["g++", *flags, src, "-o", tmp],
-                               check=True, capture_output=True)
+                               check=True, capture_output=True,
+                               timeout=600)
                 os.replace(tmp, so)
             lib = ctypes.CDLL(so)
             if configure is not None:

@@ -1,0 +1,63 @@
+"""Positive fixture for the lock-discipline pass (parsed, never
+imported): every marked line must produce exactly one finding."""
+import queue
+import subprocess
+import threading
+import time
+
+import jax.numpy as jnp
+
+_lock = threading.Lock()
+_jobs_q = queue.Queue()
+
+
+def blocking_under_lock(sock, th, ev, proc):
+    with _lock:
+        time.sleep(1.0)              # sleep under lock
+        item = _jobs_q.get()         # untimed queue get
+        _jobs_q.put(item)            # untimed queue put
+        th.join()                    # untimed join
+        ev.wait()                    # untimed wait (not the held cv)
+        sock.accept()                # socket op under lock
+        proc.communicate()           # untimed communicate
+        subprocess.run(["true"])     # subprocess without timeout
+
+
+def fixable_get():
+    while True:
+        try:
+            with _lock:
+                return _jobs_q.get()     # untimed get, --fix eligible
+        except queue.Empty:
+            continue
+
+
+def tensor_sync_under_lock():
+    val = jnp.zeros((2,))
+    with _lock:
+        x = float(val)               # device cast under lock
+        y = val.numpy()              # device sync under lock
+        return x, y
+
+
+def acquire_release(sock):
+    _lock.acquire()
+    sock.recv(1024)                  # socket op between acquire/release
+    _lock.release()
+    sock.recv(1024)                  # ok: lock released
+
+
+class Inverted:
+    def __init__(self):
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+
+    def one(self):
+        with self.lock_a:
+            with self.lock_b:
+                pass
+
+    def two(self):
+        with self.lock_b:
+            with self.lock_a:        # closes the a->b cycle: ERROR
+                pass

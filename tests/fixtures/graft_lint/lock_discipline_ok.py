@@ -1,0 +1,37 @@
+"""Negative fixture for the lock-discipline pass (parsed, never
+imported): nothing here may produce a finding."""
+import queue
+import threading
+import time
+
+_lock = threading.Lock()
+_cv = threading.Condition()
+_q = queue.Queue()
+
+
+def timed_ops(th, ev):
+    with _lock:
+        item = _q.get(timeout=0.5)       # timed: loop turn, not a stall
+        _q.put(item, timeout=0.5)
+        _q.get(block=False)
+        th.join(0.5)
+        ev.wait(0.5)
+    time.sleep(0.01)                     # outside the critical section
+    return _q.get()                      # untimed but no lock held
+
+
+def cv_protocol():
+    with _cv:
+        _cv.wait()       # waiting ON the held condition releases it
+
+
+def consistent_order():
+    with _lock:
+        with _cv:
+            pass
+
+
+def consistent_order_again():
+    with _lock:                          # same global order: no cycle
+        with _cv:
+            pass

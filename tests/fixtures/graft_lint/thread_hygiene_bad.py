@@ -1,0 +1,21 @@
+"""Positive fixture for the thread-hygiene pass (parsed, never
+imported)."""
+import threading
+
+
+def _worker():
+    while True:
+        try:
+            do_work()                    # noqa: F821 (never imported)
+        except:                          # bare except in thread target
+            pass
+
+
+def unnamed_unowned():
+    # chained construct+start: no name, no handle
+    threading.Thread(target=_worker, daemon=True).start()
+
+
+def unnamed_assigned():
+    t = threading.Thread(target=_worker)     # no name, no daemon choice
+    t.start()                                # started, never owned

@@ -1,0 +1,34 @@
+"""Negative fixture for the thread-hygiene pass (parsed, never
+imported): nothing here may produce a finding."""
+import threading
+
+
+class Owner:
+    def __init__(self):
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="paddle-fixture-loop")
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            try:
+                work()                   # noqa: F821 (never imported)
+            except Exception:            # named: shutdown still works
+                pass
+
+
+def joined():
+    t = threading.Thread(target=print, daemon=False,
+                         name="paddle-fixture-print")
+    t.start()
+    t.join()
+
+
+def explicit_daemon_attr():
+    t = threading.Thread(target=print, name="paddle-fixture-attr")
+    t.daemon = True                      # explicit choice, post-hoc
+    t.start()
+    t.join()

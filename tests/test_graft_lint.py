@@ -277,6 +277,141 @@ def test_fault_point_table_vs_live_sites_round_trip():
     assert dead == [], dead
 
 
+# -- lock-discipline ---------------------------------------------------------
+
+def test_lock_discipline_catches_bug_classes():
+    from tools.graft_lint.passes.lock_discipline import LockDisciplinePass
+    res = _run([LockDisciplinePass()],
+               paths=[FIXTURES / "lock_discipline_bad.py"])
+    msgs = [f.message for f in res.active]
+    assert len(msgs) == 13, "\n".join(msgs)
+    assert sum("time.sleep()" in m for m in msgs) == 1
+    assert sum("untimed queue .get()" in m for m in msgs) == 2
+    assert sum("untimed queue .put()" in m for m in msgs) == 1
+    assert sum("untimed .join()" in m for m in msgs) == 1
+    assert sum("untimed .wait()" in m for m in msgs) == 1
+    assert sum(".accept()" in m for m in msgs) == 1
+    assert sum("untimed .communicate()" in m for m in msgs) == 1
+    assert sum("subprocess.run() without timeout=" in m
+               for m in msgs) == 1
+    assert sum("float() on a device value" in m for m in msgs) == 1
+    assert sum(".numpy()" in m for m in msgs) == 1
+    # acquire()/release() straight-line tracking: the recv between the
+    # calls fires; the recv after release() does not
+    assert sum(".recv()" in m for m in msgs) == 1
+    # every blocking-call message names the held lock
+    assert all("while holding" in m for m in msgs
+               if "lock-order cycle" not in m)
+
+
+def test_lock_discipline_cycle_is_an_error():
+    from tools.graft_lint.passes.lock_discipline import LockDisciplinePass
+    res = _run([LockDisciplinePass()],
+               paths=[FIXTURES / "lock_discipline_bad.py"])
+    errors = [f for f in res.active if f.severity == "error"]
+    assert len(errors) == 1
+    assert "lock-order cycle" in errors[0].message
+    assert "Inverted.self.lock_a" in errors[0].message
+    assert "Inverted.self.lock_b" in errors[0].message
+
+
+def test_lock_discipline_negative():
+    from tools.graft_lint.passes.lock_discipline import LockDisciplinePass
+    res = _run([LockDisciplinePass()],
+               paths=[FIXTURES / "lock_discipline_ok.py"])
+    assert res.active == [], "\n".join(f.render() for f in res.active)
+
+
+# -- thread-hygiene ----------------------------------------------------------
+
+def test_thread_hygiene_catches_bug_classes():
+    from tools.graft_lint.passes.thread_hygiene import ThreadHygienePass
+    res = _run([ThreadHygienePass()],
+               paths=[FIXTURES / "thread_hygiene_bad.py"])
+    msgs = [f.message for f in res.active]
+    assert len(msgs) == 6, "\n".join(msgs)
+    assert sum("without name=" in m for m in msgs) == 2
+    assert sum("explicit daemon=" in m for m in msgs) == 1
+    assert sum("never joined, stored or returned" in m
+               for m in msgs) == 2
+    assert sum("bare except:" in m for m in msgs) == 1
+
+
+def test_thread_hygiene_negative():
+    from tools.graft_lint.passes.thread_hygiene import ThreadHygienePass
+    res = _run([ThreadHygienePass()],
+               paths=[FIXTURES / "thread_hygiene_ok.py"])
+    assert res.active == [], "\n".join(f.render() for f in res.active)
+
+
+# -- --fix mode --------------------------------------------------------------
+
+def _fix_sandbox(tmp_path):
+    """Copies of the positive fixtures, since --fix rewrites in place."""
+    import shutil
+    paths = []
+    for name in ("lock_discipline_bad.py", "thread_hygiene_bad.py"):
+        dst = tmp_path / name
+        shutil.copy(FIXTURES / name, dst)
+        paths.append(dst)
+    return paths
+
+
+def test_fix_dry_run_prints_diff_and_leaves_files_alone(tmp_path):
+    from tools.graft_lint.core import run
+    paths = _fix_sandbox(tmp_path)
+    before = [p.read_text() for p in paths]
+    out = tmp_path / "out.txt"
+    run(pass_names=["lock-discipline", "thread-hygiene"],
+        paths=[str(p) for p in paths],
+        fix=True, fix_dry_run=True, out=open(out, "w"))
+    text = out.read_text()
+    assert "+                return _jobs_q.get(timeout=0.1)" in text
+    assert '+    threading.Thread(target=_worker, daemon=True, ' \
+           'name="paddle-worker").start()' in text
+    assert [p.read_text() for p in paths] == before   # dry: untouched
+
+
+def test_fix_applies_and_resolves_findings(tmp_path):
+    from tools.graft_lint.core import run
+    from tools.graft_lint.passes.lock_discipline import LockDisciplinePass
+    from tools.graft_lint.passes.thread_hygiene import ThreadHygienePass
+    paths = _fix_sandbox(tmp_path)
+    passes = [LockDisciplinePass(), ThreadHygienePass()]
+    before = len(_run(passes, paths=paths).active)
+    out = tmp_path / "out.txt"
+    rc = run(pass_names=["lock-discipline", "thread-hygiene"],
+             paths=[str(p) for p in paths],
+             fix=True, out=open(out, "w"))
+    assert rc == 0
+    assert "3 fix(es) applied" in out.read_text()
+    # exactly the three mechanical findings are gone; judgement calls
+    # (daemon choice, ownership, cycles) remain for a human
+    after = _run([LockDisciplinePass(), ThreadHygienePass()],
+                 paths=paths)
+    assert len(after.active) == before - 3
+    fixed = (tmp_path / "lock_discipline_bad.py").read_text()
+    assert "_jobs_q.get(timeout=0.1)" in fixed
+    assert 'name="paddle-worker"' in \
+        (tmp_path / "thread_hygiene_bad.py").read_text()
+
+
+def test_fix_skips_stale_lines(tmp_path):
+    """A fix whose recorded line drifted (file edited between collect
+    and apply) is skipped, never misapplied."""
+    from tools.graft_lint.core import apply_fixes, run_collect
+    from tools.graft_lint.passes.thread_hygiene import ThreadHygienePass
+    paths = _fix_sandbox(tmp_path)
+    res = run_collect([ThreadHygienePass()], paths=paths, repo=REPO)
+    target = tmp_path / "thread_hygiene_bad.py"
+    target.write_text(target.read_text().replace(
+        "target=_worker, daemon=True", "target=_worker,  daemon=True"))
+    out = tmp_path / "out.txt"
+    applied = apply_fixes(res.findings, REPO, out=open(out, "w"))
+    assert "line no longer matches" in out.read_text()
+    assert applied < sum(1 for f in res.findings if f.fix)
+
+
 # -- suppressions ------------------------------------------------------------
 
 def test_suppressions_inline_and_standalone():
@@ -547,5 +682,5 @@ def test_cli_list_passes(capsys):
     out = capsys.readouterr().out
     for name in ("trace-safety", "host-sync", "collective-order",
                  "flags-hygiene", "apply-op-closures", "atomic-writes",
-                 "metric-names"):
+                 "metric-names", "lock-discipline", "thread-hygiene"):
         assert name in out

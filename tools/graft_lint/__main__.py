@@ -28,8 +28,18 @@ def main(argv=None) -> int:
                          "the current findings")
     ap.add_argument("--show-baselined", action="store_true",
                     help="also print findings covered by the baseline")
+    ap.add_argument("--fix", action="store_true",
+                    help="apply the mechanical fixes findings carry "
+                         "(Thread name= insertion, timed queue.get "
+                         "under a lock where the except-Empty loop "
+                         "makes it unambiguous)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="with --fix: print the would-be diff instead "
+                         "of writing files")
     ap.add_argument("--list-passes", action="store_true")
     args = ap.parse_args(argv)
+    if args.dry_run and not args.fix:
+        ap.error("--dry-run only makes sense with --fix")
 
     if args.list_passes:
         for p in ALL_PASSES:
@@ -47,7 +57,8 @@ def main(argv=None) -> int:
     return run(pass_names=selected, paths=args.paths or None,
                fmt=args.format, changed=args.changed,
                regen_baseline=args.write_baseline,
-               show_baselined=args.show_baselined)
+               show_baselined=args.show_baselined,
+               fix=args.fix, fix_dry_run=args.fix and args.dry_run)
 
 
 if __name__ == "__main__":

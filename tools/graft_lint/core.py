@@ -26,7 +26,10 @@ _SUPPRESS_RE = re.compile(r"#\s*graft-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
 @dataclasses.dataclass
 class Finding:
     """One lint hit. `path` is repo-relative (posix) for files under the
-    repo so baseline keys survive checkouts at different roots."""
+    repo so baseline keys survive checkouts at different roots. `fix`,
+    when a pass can repair the site mechanically, is
+    {"line": n, "old": <exact current line>, "new": <replacement>} —
+    applied by `--fix` only while `old` still matches the file."""
 
     path: str
     line: int
@@ -34,13 +37,16 @@ class Finding:
     message: str
     severity: str = "error"          # "error" | "warning"
     baselined: bool = False
+    fix: Optional[dict] = None
 
     @property
     def key(self) -> str:
         return f"{self.pass_name}:{self.path}"
 
     def as_dict(self) -> dict:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        d["fixable"] = d.pop("fix") is not None
+        return d
 
     def render(self) -> str:
         tag = self.pass_name + (
@@ -204,6 +210,64 @@ def apply_baseline(findings: List[Finding],
             for f in group:
                 f.baselined = True
     return sorted(stale)
+
+
+# -- mechanical fixes (--fix) ------------------------------------------------
+
+def apply_fixes(findings: Sequence[Finding], repo: Path,
+                dry_run: bool = False, out=None) -> int:
+    """Apply the line-level fixes attached to `findings` (suppressed
+    findings never get here — run_collect drops them). Each fix is
+    verified against the file's CURRENT line text before writing: a fix
+    computed from a stale parse, or two fixes colliding on one line,
+    is skipped loudly rather than applied wrong. `dry_run` prints the
+    would-be diff instead of writing. Returns fixes applied (or
+    printed)."""
+    out = out or sys.stdout
+    by_path: Dict[str, List[Finding]] = {}
+    for f in findings:
+        if f.fix:
+            by_path.setdefault(f.path, []).append(f)
+    applied = 0
+    for rel, group in sorted(by_path.items()):
+        p = Path(rel)
+        if not p.is_absolute():
+            p = repo / rel
+        try:
+            lines = p.read_text().splitlines(keepends=True)
+        except OSError as e:
+            print(f"{rel}: unreadable, fixes skipped: {e}", file=out)
+            continue
+        taken: Set[int] = set()
+        wrote = 0
+        for f in sorted(group, key=lambda f: f.fix["line"]):
+            ln = f.fix["line"]
+            if ln in taken:
+                print(f"{rel}:{ln}: fix skipped ({f.pass_name}): "
+                      f"another fix already edits this line", file=out)
+                continue
+            if ln > len(lines) or \
+                    lines[ln - 1].rstrip("\n") != f.fix["old"]:
+                print(f"{rel}:{ln}: fix skipped ({f.pass_name}): "
+                      f"line no longer matches", file=out)
+                continue
+            taken.add(ln)
+            if dry_run:
+                print(f"--- {rel}:{ln} [{f.pass_name}]", file=out)
+                print(f"-{f.fix['old']}", file=out)
+                print(f"+{f.fix['new']}", file=out)
+            else:
+                eol = "\n" if lines[ln - 1].endswith("\n") else ""
+                lines[ln - 1] = f.fix["new"] + eol
+                wrote += 1
+            applied += 1
+        if wrote:
+            p.write_text("".join(lines))
+            print(f"{rel}: {wrote} fix(es) applied", file=out)
+    verb = "printable" if dry_run else "applied"
+    print(f"{applied} fix(es) {verb} across {len(by_path)} file(s)",
+          file=out)
+    return applied
 
 
 # -- run loop ----------------------------------------------------------------
@@ -390,17 +454,26 @@ def run(pass_names: Optional[Sequence[str]] = None,
         baseline_path: Optional[Path] = None,
         regen_baseline: bool = False,
         show_baselined: bool = False,
+        fix: bool = False,
+        fix_dry_run: bool = False,
         repo: Optional[Path] = None,
         out=None) -> int:
     """CLI-shaped entry: select passes by name, run, print, return the
     exit code. `regen_baseline` rewrites the baseline from the current
-    findings (after suppressions) instead of judging against it."""
+    findings (after suppressions) instead of judging against it. `fix`
+    applies the mechanical fixes findings carry (baselined ones too —
+    a grandfathered site is still worth repairing); `fix_dry_run`
+    prints the diff instead."""
     from .passes import get_passes
     out = out or sys.stdout
     passes = get_passes(pass_names)
     baseline = {} if regen_baseline else load_baseline(baseline_path)
     res = run_collect(passes, [Path(p) for p in paths] if paths else None,
                       changed=changed, baseline=baseline, repo=repo)
+    if fix or fix_dry_run:
+        apply_fixes(res.findings, repo or REPO, dry_run=fix_dry_run,
+                    out=out)
+        return 0
     if regen_baseline:
         # only WARNING-tier debt is baseline-eligible: silently
         # grandfathering an error (a deadlock signature, a typo'd flag)

@@ -12,7 +12,9 @@ from .collective_order import CollectiveOrderPass
 from .fault_points import FaultPointsPass
 from .flags_hygiene import FlagsHygienePass
 from .host_sync import HostSyncPass
+from .lock_discipline import LockDisciplinePass
 from .metric_names import MetricNamesPass
+from .thread_hygiene import ThreadHygienePass
 from .trace_safety import TraceSafetyPass
 
 ALL_PASSES: List[LintPass] = [
@@ -24,6 +26,8 @@ ALL_PASSES: List[LintPass] = [
     CollectiveOrderPass(),
     FlagsHygienePass(),
     FaultPointsPass(),
+    LockDisciplinePass(),
+    ThreadHygienePass(),
 ]
 
 

@@ -1,0 +1,405 @@
+"""Pass: blocking calls under a held lock + static lock-order cycles.
+
+A lock wants to guard a few loads and stores. The deadlocks this repo
+has actually shipped (accept loop pinned by one stalled client, reaper
+wedged behind a stale staging thread) all started as an innocent
+blocking call — an untimed `q.get()`, a `sock.recv()`, a
+`thread.join()` — made while a lock was held, so every other thread
+needing that lock inherited the stall. This pass flags the blocking
+families inside `with <lock>:` bodies (or between `lock.acquire()` /
+`lock.release()` in straight-line code):
+
+- untimed `queue.get()/put()` (the fix idiom is io/__init__.py's
+  `_interruptible_put`: a short-timeout poll loop checking a stop
+  Event),
+- untimed `.wait()` / `.join()`,
+- socket ops (`accept/recv/recvfrom/connect/sendall`) and
+  `urlopen(...)` without a timeout,
+- subprocess waits (`.wait()`, `.communicate()` / `subprocess.run`
+  family without `timeout=`),
+- `time.sleep(...)`,
+- host-sync tensor pulls (`.numpy()/.item()/.tolist()`,
+  `float()/int()/bool()` on a device value) — a device sync under a
+  lock serializes every thread behind the accelerator.
+
+Warning tier: some blocking-under-lock is a considered design (a
+documented two-lock handoff, a shutdown path) — those carry a
+`# graft-lint: disable=lock-discipline` with the rationale.
+
+The second check is ERROR tier: a statically-visible nested-acquisition
+CYCLE in the per-module lock-order graph (`with a:` containing
+`with b:` somewhere, `with b:` containing `with a:` somewhere else) is
+a deadlock signature, not a smell — two threads entering the two sites
+concurrently wedge forever. Locks are identified by their assigned
+name, qualified by the enclosing class (`Router.self._lock`); what this
+can't see across modules, the runtime witness
+(observability/lockwitness.py) covers.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import FileContext, LintPass
+from ..tensorish import (CAST_FUNCS as _CAST_FUNCS,
+                         SYNC_ATTRS as _SYNC_ATTRS, HOST, TENSOR,
+                         TensorEnv)
+
+# threading factories whose call result is a lock-ish guard
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+
+# receivers that look like queues (component of the dotted name); keeps
+# `.get()` findings away from dicts/sessions — dict.get always takes an
+# argument anyway, but `.put()` needs the hint
+_QUEUE_RE = re.compile(r"(^|\.)_?([a-z_]*q|[a-z_]*queue|jobs|tasks)$")
+
+_SOCKET_BLOCKING = {"accept", "recv", "recvfrom", "connect", "sendall"}
+_SUBPROCESS_RUNNERS = {"run", "call", "check_call", "check_output"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'self._lock' for Attribute chains / Names; None for anything
+    dynamic (subscripts, calls)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _has_kw(call: ast.Call, name: str) -> bool:
+    return any(k.arg == name for k in call.keywords)
+
+
+def _kw_false(call: ast.Call, name: str) -> bool:
+    for k in call.keywords:
+        if k.arg == name and isinstance(k.value, ast.Constant):
+            return k.value.value is False
+    return False
+
+
+class LockDisciplinePass(LintPass):
+    name = "lock-discipline"
+    description = ("blocking calls under a held lock; nested-"
+                   "acquisition cycles in the module lock-order graph")
+    severity = "warning"
+    scope = ("paddle_tpu/",)
+
+    # -- per-file analysis ---------------------------------------------
+    def check_file(self, ctx: FileContext):
+        out: List = []
+        locks = self._collect_lock_names(ctx.tree)
+        if not locks:
+            return out
+        self._empty_spans = _empty_handler_spans(ctx.tree)
+        # (held, taken) -> first-seen line of the nested acquisition
+        edges: Dict[Tuple[str, str], int] = {}
+
+        for cls, fn in _functions(ctx.tree):
+            env = TensorEnv(fn)
+            self._check_fn(ctx, fn, cls, locks, env, edges, out)
+
+        self._check_cycles(ctx, edges, out)
+        return out
+
+    def _collect_lock_names(self, tree: ast.Module) -> Set[str]:
+        """Dotted names assigned from threading.Lock()/RLock()/
+        Condition()/Semaphore() anywhere in the module, qualified by the
+        enclosing class ('Router.self._lock'); module-level locks keep
+        their bare dotted name."""
+        locks: Set[str] = set()
+
+        def visit(node, cls):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name)
+                    continue
+                if isinstance(child, (ast.Assign, ast.AnnAssign)):
+                    value = child.value
+                    targets = child.targets if isinstance(
+                        child, ast.Assign) else [child.target]
+                    if isinstance(value, ast.Call):
+                        f = value.func
+                        attr = f.attr if isinstance(f, ast.Attribute) \
+                            else (f.id if isinstance(f, ast.Name) else "")
+                        if attr in _LOCK_FACTORIES:
+                            for t in targets:
+                                d = _dotted(t)
+                                if d:
+                                    locks.add(self._qual(cls, d))
+                visit(child, cls)
+
+        visit(tree, None)
+        return locks
+
+    @staticmethod
+    def _qual(cls: Optional[str], dotted: str) -> str:
+        if cls and dotted.startswith("self."):
+            return f"{cls}.{dotted}"
+        return dotted
+
+    def _lock_name(self, expr: ast.AST, cls: Optional[str],
+                   locks: Set[str]) -> Optional[str]:
+        d = _dotted(expr)
+        if d is None:
+            return None
+        q = self._qual(cls, d)
+        return q if q in locks else None
+
+    def _check_fn(self, ctx, fn, cls, locks, env, edges, out):
+        """Walk one function's own statements tracking the held-lock
+        stack through `with <lock>:` nesting and straight-line
+        `.acquire()`/`.release()` pairs; nested defs get their own
+        walk (they run on another thread's schedule)."""
+        pass_self = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.held: List[Tuple[str, int]] = []   # (lock, line)
+
+            def visit_FunctionDef(self, node):
+                pass                        # walked separately
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_With(self, node):
+                pushed = 0
+                for item in node.items:
+                    lname = pass_self._lock_name(
+                        item.context_expr, cls, locks)
+                    if lname is not None:
+                        pass_self._note_edge(
+                            self.held, lname, item.context_expr.lineno,
+                            edges)
+                        self.held.append((lname,
+                                          item.context_expr.lineno))
+                        pushed += 1
+                    else:
+                        self.generic_visit_expr(item.context_expr)
+                for stmt in node.body:
+                    self.visit(stmt)
+                del self.held[len(self.held) - pushed:]
+
+            visit_AsyncWith = visit_With
+
+            def generic_visit_expr(self, node):
+                self.visit(node)
+
+            def visit_Expr(self, node):
+                # straight-line lock.acquire() / lock.release()
+                v = node.value
+                if isinstance(v, ast.Call) and \
+                        isinstance(v.func, ast.Attribute):
+                    lname = pass_self._lock_name(v.func.value, cls,
+                                                 locks)
+                    if lname is not None and v.func.attr == "acquire":
+                        pass_self._note_edge(self.held, lname,
+                                             node.lineno, edges)
+                        self.held.append((lname, node.lineno))
+                        return
+                    if lname is not None and v.func.attr == "release":
+                        for i in range(len(self.held) - 1, -1, -1):
+                            if self.held[i][0] == lname:
+                                del self.held[i]
+                                break
+                        return
+                self.generic_visit(node)
+
+            def visit_Call(self, node):
+                if self.held:
+                    pass_self._check_blocking_call(
+                        ctx, node, cls, locks, env,
+                        [h[0] for h in self.held], out)
+                self.generic_visit(node)
+
+        v = V()
+        for stmt in fn.body:
+            v.visit(stmt)
+
+    def _note_edge(self, held, taken, line, edges):
+        if held:
+            outer = held[-1][0]
+            if outer != taken:
+                edges.setdefault((outer, taken), line)
+
+    # -- the blocking-call families ------------------------------------
+    def _check_blocking_call(self, ctx, node: ast.Call, cls, locks, env,
+                             held: List[str], out: List):
+        f = node.func
+        held_desc = held[-1]
+
+        def flag(msg):
+            out.append(self.finding(ctx, node.lineno,
+                                    f"{msg} while holding {held_desc}"))
+
+        if isinstance(f, ast.Name):
+            if f.id in _CAST_FUNCS and len(node.args) == 1 and \
+                    env.classify(node.args[0]) == TENSOR:
+                flag(f"{f.id}() on a device value is a blocking host "
+                     f"sync — every thread needing the lock now waits "
+                     f"on the accelerator; pull the value before "
+                     f"taking the lock")
+            elif f.id == "urlopen" and not _has_kw(node, "timeout"):
+                flag("urlopen() without timeout= can block forever")
+            return
+        if not isinstance(f, ast.Attribute):
+            return
+        recv = _dotted(f.value) or ""
+        attr = f.attr
+
+        if attr == "sleep" and recv in ("time",):
+            flag("time.sleep() parks the thread with the lock held — "
+                 "release first, or poll outside the critical section")
+        elif attr == "get" and not node.args and \
+                not _has_kw(node, "timeout") and \
+                not _kw_false(node, "block"):
+            # zero-arg .get() is queue-shaped (dict.get needs a key)
+            flag("untimed queue .get() can block forever — use the "
+                 "timed poll idiom (get(timeout=...) in a stop-Event "
+                 "loop, see io._interruptible_put)")
+            # mechanical fix only when the surrounding try already
+            # handles queue.Empty — then a timeout just becomes one
+            # more loop turn (unambiguous rewrite; --fix applies it)
+            if any(a <= node.lineno <= b for a, b in self._empty_spans):
+                out[-1].fix = _timed_get_fix(ctx, node)
+        elif attr == "put" and _QUEUE_RE.search(recv.lower()) and \
+                not _has_kw(node, "timeout") and \
+                not _kw_false(node, "block") and node.args:
+            flag("untimed queue .put() blocks when the queue is full — "
+                 "use the _interruptible_put idiom (timed put in a "
+                 "stop-Event loop)")
+        elif attr == "join" and not node.args and \
+                not _has_kw(node, "timeout"):
+            flag("untimed .join() waits on another thread — if that "
+                 "thread needs this lock, this is a deadlock; join "
+                 "with a timeout outside the lock")
+        elif attr == "wait" and not node.args and \
+                not _has_kw(node, "timeout"):
+            # waiting ON the held condition is the cv protocol (wait
+            # releases it); waiting on anything else is a stall
+            if self._lock_name(f.value, cls, locks) != held_desc:
+                flag("untimed .wait() under a held lock — pass a "
+                     "timeout or wait before acquiring")
+        elif attr in _SOCKET_BLOCKING:
+            flag(f"socket .{attr}() under a held lock pins every "
+                 f"other thread behind one peer — do network I/O "
+                 f"outside the critical section")
+        elif attr == "communicate" and not _has_kw(node, "timeout"):
+            flag("untimed .communicate() waits for process exit")
+        elif attr in _SUBPROCESS_RUNNERS and recv == "subprocess" and \
+                not _has_kw(node, "timeout"):
+            flag(f"subprocess.{attr}() without timeout= waits for "
+                 f"process exit")
+        elif attr in _SYNC_ATTRS and not node.args and \
+                env.classify(f.value) != HOST:
+            flag(f".{attr}() blocks on the device and copies to host "
+                 f"— sync before taking the lock")
+
+    # -- lock-order cycles ---------------------------------------------
+    def _check_cycles(self, ctx, edges: Dict[Tuple[str, str], int],
+                      out: List):
+        succ: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            succ.setdefault(a, set()).add(b)
+        reported: Set[frozenset] = set()
+        for (a, b), line in sorted(edges.items(),
+                                   key=lambda kv: kv[1]):
+            # path b ->* a means a->b closes a cycle
+            chain = _find_path(succ, b, a)
+            if chain is None:
+                continue
+            cyc = frozenset(chain + [b])
+            if cyc in reported:
+                continue
+            reported.add(cyc)
+            order = " -> ".join(chain + [b])
+            other = edges.get((b, a))
+            where = (f" (opposite order established at line {other})"
+                     if other else "")
+            out.append(self.finding(
+                ctx, line,
+                f"lock-order cycle: taking {b} while holding {a} "
+                f"inverts the established order {order}{where} — two "
+                f"threads entering these sites concurrently deadlock; "
+                f"pick one global order", severity="error"))
+
+
+def _empty_handler_spans(tree: ast.Module) -> List[Tuple[int, int]]:
+    """(first, last) body line ranges of every Try whose handlers catch
+    queue.Empty / Empty — inside one, get(timeout=...) raising Empty is
+    already part of the control flow."""
+    spans: List[Tuple[int, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for h in node.handlers:
+            names = []
+            types = h.type.elts if isinstance(h.type, ast.Tuple) \
+                else ([h.type] if h.type is not None else [])
+            for t in types:
+                if isinstance(t, ast.Attribute):
+                    names.append(t.attr)
+                elif isinstance(t, ast.Name):
+                    names.append(t.id)
+            if "Empty" in names:
+                last = max(getattr(s, "end_lineno", s.lineno)
+                           for s in node.body)
+                spans.append((node.body[0].lineno, last))
+                break
+    return spans
+
+
+def _timed_get_fix(ctx: FileContext, node: ast.Call):
+    """Insert timeout=0.1 before the get's closing paren (single-line
+    calls only)."""
+    end_line = getattr(node, "end_lineno", None)
+    end_col = getattr(node, "end_col_offset", None)
+    if end_line != node.lineno or end_col is None or \
+            end_line > len(ctx.lines):
+        return None
+    old = ctx.lines[end_line - 1]
+    pos = end_col - 1
+    if pos < 0 or pos >= len(old) or old[pos] != ")":
+        return None
+    return {"line": end_line, "old": old,
+            "new": old[:pos] + "timeout=0.1" + old[pos:]}
+
+
+def _find_path(succ: Dict[str, Set[str]], frm: str,
+               to: str) -> Optional[List[str]]:
+    stack = [(frm, [frm])]
+    seen = {frm}
+    while stack:
+        node, chain = stack.pop()
+        for nxt in succ.get(node, ()):
+            if nxt == to:
+                return chain + [to]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, chain + [nxt]))
+    return None
+
+
+def _functions(tree: ast.Module):
+    """(enclosing_class_name_or_None, FunctionDef) pairs, every def in
+    the module including methods and nested defs."""
+    out = []
+
+    def visit(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, child.name)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                out.append((cls, child))
+                visit(child, cls)
+            else:
+                visit(child, cls)
+
+    visit(tree, None)
+    return out

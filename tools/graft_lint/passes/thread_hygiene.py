@@ -1,0 +1,251 @@
+"""Pass: thread construction and lifecycle hygiene.
+
+Three checks over every `threading.Thread(...)` in the package:
+
+- **no name=**: an anonymous thread shows up in stack dumps, the
+  flight recorder's post-mortem `threads` map and `py-spy` as
+  `Thread-7` — useless at 3am. Every thread gets a `name=` (the repo
+  convention is dashed lowercase, e.g. `paddle-io-prefetcher`).
+  Mechanically fixable (`--fix` derives the name from `target=`).
+- **no explicit daemon choice**: `daemon` is inherited from the
+  CREATING thread, so the same constructor makes a process-pinning
+  thread from main and a silently-killable one from a worker. Say
+  which one you mean — `daemon=True` (killable at exit) or
+  `daemon=False` (owns process lifetime, needs a join path).
+- **bare `except:` in a thread target**: a bare except in a run loop
+  swallows SystemExit/KeyboardInterrupt and turns an interpreter
+  shutdown into a wedged thread; catch `Exception`.
+- **start() with no ownership**: a thread that is started but never
+  joined, stored, or returned cannot be waited for, drained, or named
+  in a post-mortem. Keep the handle (`self._thread = t`) or join it;
+  genuinely fire-and-forget designs (per-connection handlers bounded
+  by socket close) carry a rationale suppression.
+
+Warning tier: hygiene, not deadlock signatures — grandfathered sites
+live in the shrink-only baseline until converted.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..core import FileContext, LintPass
+
+
+def _is_thread_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "Thread" and \
+            isinstance(f.value, ast.Name) and f.value.id == "threading":
+        return True
+    return isinstance(f, ast.Name) and f.id == "Thread"
+
+
+def _kw(node: ast.Call, name: str) -> bool:
+    return any(k.arg == name for k in node.keywords)
+
+
+def _target_label(node: ast.Call) -> Optional[str]:
+    """Short label of the target= callable: `target=self._probe_loop`
+    -> 'probe-loop' (for --fix name derivation and messages)."""
+    for k in node.keywords:
+        if k.arg != "target":
+            continue
+        v = k.value
+        parts = []
+        while isinstance(v, ast.Attribute):
+            parts.append(v.attr)
+            v = v.value
+        if isinstance(v, ast.Name) and not parts:
+            parts.append(v.id)
+        if not parts:
+            return None
+        label = parts[0].lstrip("_").replace("_", "-")
+        return label or None
+    return None
+
+
+def _target_names(tree: ast.Module) -> Set[str]:
+    """Simple names of every callable passed as target= in the module —
+    these functions run on a thread's schedule."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_thread_call(node):
+            for k in node.keywords:
+                if k.arg != "target":
+                    continue
+                v = k.value
+                if isinstance(v, ast.Attribute):
+                    out.add(v.attr)
+                elif isinstance(v, ast.Name):
+                    out.add(v.id)
+    return out
+
+
+class ThreadHygienePass(LintPass):
+    name = "thread-hygiene"
+    description = ("threads need name= + an explicit daemon choice, "
+                   "no bare except in run loops, and a join/ownership "
+                   "path after start()")
+    severity = "warning"
+    scope = ("paddle_tpu/",)
+
+    def check_file(self, ctx: FileContext):
+        out: List = []
+        targets = _target_names(ctx.tree)
+
+        for fn in _all_functions(ctx.tree):
+            self._check_constructions(ctx, fn, out)
+            if fn.name in targets or fn.name in ("run",):
+                self._check_bare_except(ctx, fn, out)
+        return out
+
+    # -- construction checks -------------------------------------------
+    def _check_constructions(self, ctx, fn, out):
+        own = list(_own_nodes(fn))
+        # names whose .daemon / .name is set after construction, and
+        # names with an ownership path (join/store/return/yield/append)
+        daemon_set: Set[str] = set()
+        owned: Set[str] = set()
+        thread_vars: dict = {}          # local name -> Thread call node
+        # a handle assigned to a `global`/`nonlocal` name outlives the
+        # function — that IS the ownership path (export._server_thread)
+        escaping: Set[str] = set()
+        for node in own:
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                escaping.update(node.names)
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name):
+                        if t.attr == "daemon":
+                            daemon_set.add(t.value.id)
+                        # self.x = t / obj.attr = t stores the handle
+                    if isinstance(node.value, ast.Name) and \
+                            isinstance(t, (ast.Attribute, ast.Subscript)):
+                        owned.add(node.value.id)
+                    if isinstance(t, ast.Name) and \
+                            isinstance(node.value, ast.Call) and \
+                            _is_thread_call(node.value):
+                        thread_vars[t.id] = node.value
+            elif isinstance(node, (ast.Return, ast.Yield)) and \
+                    isinstance(getattr(node, "value", None), ast.Name):
+                owned.add(node.value.id)
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr == "join" and isinstance(f.value, ast.Name):
+                    owned.add(f.value.id)
+                # the handle passed into ANY call (list.append, a task
+                # wrapper's constructor) escapes — that is ownership
+                for a in node.args:
+                    if isinstance(a, ast.Name):
+                        owned.add(a.id)
+
+        for node in own:
+            if not (isinstance(node, ast.Call) and _is_thread_call(node)):
+                continue
+            assigned = next((n for n, c in thread_vars.items()
+                             if c is node), None)
+            if not _kw(node, "name"):
+                fnd = self.finding(
+                    ctx, node.lineno,
+                    "Thread() without name= — post-mortems and stack "
+                    "dumps will call it Thread-N; name it "
+                    "(convention: 'paddle-<subsystem>-<role>')")
+                fnd.fix = _name_fix(ctx, node)
+                out.append(fnd)
+            if not _kw(node, "daemon") and \
+                    (assigned is None or assigned not in daemon_set):
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    "Thread() without an explicit daemon= choice — "
+                    "daemon-ness is inherited from the CREATING thread; "
+                    "say daemon=True (killable at exit) or daemon=False "
+                    "(owns process lifetime)"))
+            # chained threading.Thread(...).start() is never owned
+            if assigned is not None and \
+                    (assigned in owned or assigned in escaping):
+                continue
+            started = assigned is None and _is_chained_start(node, own) \
+                or (assigned is not None and
+                    _name_started(assigned, own))
+            if started:
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    "thread is start()ed but never joined, stored or "
+                    "returned — keep the handle so shutdown can drain "
+                    "it (or suppress with the fire-and-forget "
+                    "rationale)"))
+
+    # -- bare except in run loops --------------------------------------
+    def _check_bare_except(self, ctx, fn, out):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    f"bare except: in thread target {fn.name}() "
+                    f"swallows SystemExit/KeyboardInterrupt and wedges "
+                    f"interpreter shutdown — catch Exception"))
+
+
+def _is_chained_start(call: ast.Call, own_nodes) -> bool:
+    """threading.Thread(...).start() — the handle is dropped on the
+    floor the moment it starts."""
+    for node in own_nodes:
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "start" and node.func.value is call:
+            return True
+    return False
+
+
+def _name_started(name: str, own_nodes) -> bool:
+    for node in own_nodes:
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "start" and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == name:
+            return True
+    return False
+
+
+def _name_fix(ctx: FileContext, node: ast.Call) -> Optional[dict]:
+    """Mechanical fix: insert `name="paddle-<target>"` before the
+    call's closing paren (works for multi-line constructions too — the
+    insert lands on the closing line). None when the target can't be
+    derived or the closing line doesn't look as expected."""
+    label = _target_label(node)
+    if label is None:
+        return None
+    end_line = getattr(node, "end_lineno", None)
+    end_col = getattr(node, "end_col_offset", None)
+    if end_line is None or end_col is None or \
+            end_line > len(ctx.lines):
+        return None
+    old = ctx.lines[end_line - 1]
+    pos = end_col - 1
+    if pos < 0 or pos >= len(old) or old[pos] != ")":
+        return None
+    before = old[:pos].rstrip()
+    sep = "" if before.endswith("(") else \
+        (" " if before.endswith(",") else ", ")
+    new = f'{old[:pos]}{sep}name="paddle-{label}"{old[pos:]}'
+    return {"line": end_line, "old": old, "new": new}
+
+
+def _all_functions(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _own_nodes(fn):
+    """Nodes of `fn` excluding nested function bodies."""
+    stack = [c for c in ast.iter_child_nodes(fn)]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))

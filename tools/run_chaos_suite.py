@@ -22,10 +22,16 @@ excludes) and THIS runner executes them as a separate gate:
   timelines behind, and a FINAL gate row: `trace_report.py --check`
   over the collected sinks — any trace whose attribution ledger does
   not sum exactly to its wall (or any torn sink line) fails the suite,
-  turning every chaos drill into an exact-accounting probe for free.
+  turning every chaos drill into an exact-accounting probe for free,
+- with the lock-order WITNESS armed (FLAGS_lock_witness=1 plus a
+  per-drill flight-recorder file in --witness-dir): every drill's
+  process tree runs under witnessed threading.Lock/RLock, and a second
+  FINAL gate row scans the collected flight files for `lock_inversion`
+  events — a single AB/BA lock-order inversion anywhere in the fleet
+  fails the suite, making every chaos drill a lockdep probe for free.
 
-Exit code: 0 when every drill passed AND the trace check passed, 1
-otherwise.
+Exit code: 0 when every drill passed AND the trace check passed AND
+no lock inversion was witnessed, 1 otherwise.
 
     JAX_PLATFORMS=cpu python tools/run_chaos_suite.py
     python tools/run_chaos_suite.py -k rejoin --timeout 180
@@ -72,15 +78,23 @@ def _env():
     return env
 
 
-def run_one(nodeid: str, timeout: float, trace_dir: str = "") -> dict:
+def run_one(nodeid: str, timeout: float, trace_dir: str = "",
+            witness_dir: str = "") -> dict:
     t0 = time.monotonic()
     env = _env()
+    safe = "".join(c if c.isalnum() else "_" for c in nodeid)[-80:]
     if trace_dir:
         # one sink per drill: in-process engines the drill builds write
         # their timelines here; the post-suite trace check reads them
-        safe = "".join(c if c.isalnum() else "_" for c in nodeid)[-80:]
         env["FLAGS_request_trace_sink"] = os.path.join(
             trace_dir, f"trace.{safe}.jsonl")
+    if witness_dir:
+        # arm the lockdep witness, with a flight file the drill writes
+        # through on EVERY inversion — a drill the chaos fault then
+        # SIGKILLs still leaves its verdict behind
+        env["FLAGS_lock_witness"] = "1"
+        env["FLAGS_flight_recorder"] = os.path.join(
+            witness_dir, f"flight.{safe}.jsonl")
     # start_new_session: a timeout must kill the drill's WHOLE process
     # tree (supervisor + workers + master), not just the pytest shim
     p = subprocess.Popen(
@@ -106,6 +120,26 @@ def run_one(nodeid: str, timeout: float, trace_dir: str = "") -> dict:
     return rec
 
 
+def scan_witness(witness_dir: str) -> list:
+    """Every `lock_inversion` event across the drills' flight files.
+
+    A torn final line (the writer was SIGKILLed mid-record) is normal
+    for flight files and is skipped, not failed — unlike trace sinks,
+    the flight recorder's contract is write-through, not atomicity.
+    """
+    inversions = []
+    for path in sorted(Path(witness_dir).glob("flight.*.jsonl")):
+        for line in path.read_text(errors="replace").splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("ev") == "lock_inversion":
+                rec["_file"] = path.name
+                inversions.append(rec)
+    return inversions
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="run every chaos-marked drill in its own process "
@@ -119,9 +153,15 @@ def main(argv=None) -> int:
                     help="request-trace sink dir, checked with "
                          "trace_report.py --check after the drills "
                          "('' disables)")
+    ap.add_argument("--witness-dir", default="chaos_witness",
+                    help="lock-witness flight-recorder dir; drills run "
+                         "with FLAGS_lock_witness=1 and the suite fails "
+                         "on any recorded lock_inversion ('' disables)")
     args = ap.parse_args(argv)
     if args.trace_dir:
         os.makedirs(args.trace_dir, exist_ok=True)
+    if args.witness_dir:
+        os.makedirs(args.witness_dir, exist_ok=True)
 
     nodes = collect(args)
     if not nodes:
@@ -133,7 +173,8 @@ def main(argv=None) -> int:
     failed = 0
     with open(args.out, "w") as f:
         for n in nodes:
-            rec = run_one(n, args.timeout, args.trace_dir)
+            rec = run_one(n, args.timeout, args.trace_dir,
+                          args.witness_dir)
             f.write(json.dumps(rec) + "\n")
             f.flush()
             mark = "ok " if rec["status"] == "passed" else "FAIL"
@@ -160,9 +201,30 @@ def main(argv=None) -> int:
             lines = (r.stdout or "").strip().splitlines()
             print(f"  [{mark}] {rec['seconds']:7.1f}s "
                   f"{lines[-1] if lines else 'trace check'}"[:200])
+        if args.witness_dir:
+            # the lockdep gate: any inversion any drill witnessed —
+            # including in a process the fault injection then killed —
+            # fails the suite
+            inv = scan_witness(args.witness_dir)
+            rec = {"nodeid": f"lock-witness scan {args.witness_dir}",
+                   "status": "passed" if not inv else "failed",
+                   "rc": 0 if not inv else 1,
+                   "inversions": len(inv)}
+            if inv:
+                rec["tail"] = json.dumps(inv[:5])[-2000:]
+                failed += 1
+            f.write(json.dumps(rec) + "\n")
+            mark = "ok " if not inv else "FAIL"
+            print(f"  [{mark}]          lock-witness: "
+                  f"{len(inv)} inversion(s) across drills")
+            for r_ in inv[:5]:
+                print(f"         {r_['_file']}: {r_.get('held')} "
+                      f"-> {r_.get('wanted')} "
+                      f"(established {r_.get('established_order')})")
     print(f"run_chaos_suite: {len(nodes) - min(failed, len(nodes))}"
           f"/{len(nodes)} passed"
-          + (" + trace check" if args.trace_dir else ""))
+          + (" + trace check" if args.trace_dir else "")
+          + (" + lock witness" if args.witness_dir else ""))
     return 1 if failed else 0
 
 
