@@ -39,6 +39,7 @@ _ELEMENTWISE = {
     "select_n", "clamp", "nextafter", "real", "imag", "conj",
     "convert_element_type", "stop_gradient", "copy", "square",
     "add_any",   # transpose-rule gradient accumulation (same as add)
+    "name",      # jax.ad_checkpoint.checkpoint_name remat-policy stamp
 }
 
 _REDUCE = {"reduce_sum": True, "reduce_max": False, "reduce_min": False,

@@ -153,6 +153,38 @@ def no_grad_guard():
 
 
 # ---------------------------------------------------------------------------
+# Remat policy: a trace-time context threading a jax.checkpoint `policy`
+# (e.g. save_only_these_names over checkpoint_name-stamped matmul
+# outputs) from jit.TrainStep down to the jax.checkpoint sites inside
+# the models (_scan_stack/_recompute_stack). None (the default) leaves
+# jax.checkpoint at its save-nothing default — bitwise today's remat.
+# ---------------------------------------------------------------------------
+
+class _RematState(threading.local):
+    def __init__(self):
+        self.policy = None
+
+
+_remat_state = _RematState()
+
+
+def current_remat_policy():
+    """The jax.checkpoint policy callable armed for this trace (None =
+    jax.checkpoint's default: save nothing, recompute everything)."""
+    return _remat_state.policy
+
+
+@contextlib.contextmanager
+def remat_policy_guard(policy):
+    prev = _remat_state.policy
+    _remat_state.policy = policy
+    try:
+        yield
+    finally:
+        _remat_state.policy = prev
+
+
+# ---------------------------------------------------------------------------
 # RNG: stateful shell over functional JAX keys.
 #
 # Eager ops fold a counter into the global key (fast, reproducible).
@@ -356,6 +388,15 @@ _flags: dict = {
     # (benchmarks/MEASUREMENT_RUNBOOK.md).
     "FLAGS_use_fused_ce": False,       # Pallas blockwise CE vs XLA CE
     "FLAGS_use_flash_attention": True,  # Pallas flash vs dense XLA attn
+    # fused transformer hot path (consumed by models/llama.py): fused
+    # residual+RMSNorm and SwiGLU Pallas kernels plus the fused QKV+RoPE
+    # prologue, one kernel surface for train (LlamaDecoderLayer /
+    # _scan_stack / _recompute_stack) and serve (_block_with_cache /
+    # _block_paged / _block_ragged). 0 is the kill switch restoring the
+    # unfused jnp paths bitwise (greedy serving tokens identical,
+    # training loss trajectory within 1e-6 over 40 steps —
+    # benchmarks/fusion_bench.py is the gate)
+    "FLAGS_fused_transformer": True,
     # -- serving (consumed by inference/serving.py): ragged paged
     # attention + chunked-prefill continuous batching; 0 is the kill
     # switch restoring the bucketed-prefill engine exactly

@@ -315,6 +315,40 @@ def _zero_sharded_update(model, opt, ef, axis, nranks, stage, cfg, block):
     return new_ef
 
 
+def resolve_remat_policy(policy):
+    """Map TrainStep's remat_policy= knob onto a jax.checkpoint policy.
+
+    None             -> jax.checkpoint's own default (save nothing,
+                        recompute everything) — bitwise the pre-knob remat
+    "save_matmul_outputs" (the TrainStep default) ->
+                        save_only_these_names over the
+                        checkpoint_name-stamped matmul outputs
+                        (models.llama.MATMUL_CHECKPOINT_NAMES); models
+                        that stamp no names degrade to the save-nothing
+                        default
+    "nothing"        -> nothing_saveable (explicit recompute-everything)
+    "dots"           -> checkpoint_dots (save every unnamed matmul too)
+    callable         -> passed through (any jax.checkpoint_policies
+                        predicate)
+
+    Policies change memory/recompute placement only, never values.
+    """
+    if policy is None or callable(policy):
+        return policy
+    if policy == "save_matmul_outputs":
+        from ..models.llama import MATMUL_CHECKPOINT_NAMES
+        return jax.checkpoint_policies.save_only_these_names(
+            *MATMUL_CHECKPOINT_NAMES)
+    if policy in ("nothing", "recompute_all"):
+        return jax.checkpoint_policies.nothing_saveable
+    if policy == "dots":
+        return jax.checkpoint_policies.checkpoint_dots
+    raise ValueError(
+        f"TrainStep: unknown remat_policy {policy!r} — expected None, "
+        f"'save_matmul_outputs', 'nothing', 'dots' or a "
+        f"jax.checkpoint_policies callable")
+
+
 # ordinal suffixes for TrainStep executable tags (see _exec_tag)
 _TRAIN_STEP_TAGS = itertools.count(1)
 
@@ -344,7 +378,8 @@ class TrainStep:
     """
 
     def __init__(self, model, optimizer, step_fn, scaler=None, shard=None,
-                 donate=True, accumulate_steps=1):
+                 donate=True, accumulate_steps=1,
+                 remat_policy="save_matmul_outputs"):
         self.model = model
         self.optimizer = optimizer
         self.step_fn = step_fn
@@ -403,6 +438,13 @@ class TrainStep:
         _prefetch.set_active_plan(shard)
         self._compiled = None
         self._donate = donate
+        # jax.checkpoint policy armed while the step traces (consumed by
+        # the models' remat sites via core.current_remat_policy). The
+        # default saves the checkpoint_name-stamped matmul outputs so
+        # norms/activations recompute instead of living across the
+        # backward; models that stamp no names degrade to
+        # jax.checkpoint's save-nothing default — bitwise the old remat
+        self._remat_policy = resolve_remat_policy(remat_policy)
         self._key_base = None     # per-instance RNG base (see __call__)
         # stable executable tag stamped at trace time: per-execution
         # device telemetry (xla.dispatch_seconds, per-execution collective
@@ -559,8 +601,8 @@ class TrainStep:
             opt.step()
             return _TT(loss_sum * inv_k)
 
-        def pure(params, buffers, opt_state, master, scaler_state, step_i,
-                 lr, key, batch, ef=None):
+        def _pure_body(params, buffers, opt_state, master, scaler_state,
+                       step_i, lr, key, batch, ef=None):
             # key travels as raw uint32 key-data (host numpy — typed PRNG
             # keys are committed device arrays, which a multi-process
             # mesh jit cannot accept); rewrap to a typed key here. The
@@ -662,6 +704,17 @@ class TrainStep:
                         new_scaler, new_ef)
             return (loss.data, new_params, new_buffers, new_opt_state,
                     new_master, new_scaler)
+
+        remat_pol = self._remat_policy
+
+        def pure(params, buffers, opt_state, master, scaler_state, step_i,
+                 lr, key, batch, ef=None):
+            # arm the jax.checkpoint policy for THIS trace — the models'
+            # remat sites (_scan_stack/_recompute_stack) read it via
+            # core.current_remat_policy() while the body traces
+            with core.remat_policy_guard(remat_pol):
+                return _pure_body(params, buffers, opt_state, master,
+                                  scaler_state, step_i, lr, key, batch, ef)
 
         # FLAGS_eager_delete_tensor_gb < 0 disables buffer donation (the
         # reference's eager-deletion kill switch maps to donation here);

@@ -6,5 +6,7 @@ Pallas kernels. Import is lazy/defensive: on CPU test meshes the jnp
 fallbacks in nn.functional are used instead.
 """
 from . import flash_attention  # noqa: F401
+from . import fused_norm_residual  # noqa: F401
 from . import rms_norm  # noqa: F401
 from . import rope  # noqa: F401
+from . import swiglu  # noqa: F401

@@ -29,6 +29,35 @@ def _rotate_half(x):
     return jnp.concatenate([-x2, x1], axis=-1)
 
 
+def fused_qkv_rope(a, w_qkv, num_heads, num_kv_heads, head_dim,
+                   position_ids=None, base=10000.0, seq_len=None):
+    """Fused QKV+RoPE prologue: one wide projection, then rope applied
+    to the q/k slices in-register via the cos/sin cache — no separate
+    narrow matmuls, no standalone elementwise pass over q and k.
+
+    a: [B, S, H] (or [S, H] packed rows); w_qkv:
+    [H, (num_heads + 2*num_kv_heads) * head_dim] with q|k|v column
+    layout (the fuse_attention_qkv checkpoint layout). Returns
+    (q, k, v) shaped [..., heads, head_dim] with rope already applied
+    to q and k. position_ids/seq_len follow apply_rope (packed [S]
+    rows get a broadcast batch dim internally)."""
+    from jax.ad_checkpoint import checkpoint_name
+    nh, kvh, d = num_heads, num_kv_heads, head_dim
+    qkv = checkpoint_name(a @ w_qkv, "llama_qkv")
+    lead = qkv.shape[:-1]
+    q = qkv[..., :nh * d].reshape(*lead, nh, d)
+    k = qkv[..., nh * d:(nh + kvh) * d].reshape(*lead, kvh, d)
+    v = qkv[..., (nh + kvh) * d:].reshape(*lead, kvh, d)
+    if a.ndim == 2:                      # packed rows: [S, H]
+        pids = None if position_ids is None else position_ids[None]
+        q4, k4 = apply_rope(q[None], k[None], position_ids=pids,
+                            base=base, seq_len=seq_len)
+        return q4[0], k4[0], v
+    q, k = apply_rope(q, k, position_ids=position_ids, base=base,
+                      seq_len=seq_len)
+    return q, k, v
+
+
 def apply_rope(q, k, position_ids=None, base=10000.0, seq_len=None):
     """q, k: [B, S, H, D] -> rotated (same shapes), f32 math, input dtype out.
 

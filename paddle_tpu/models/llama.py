@@ -37,6 +37,14 @@ from ..tensor import Tensor
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_tiny",
            "llama_350m", "llama_1b", "llama_7b"]
 
+# matmul outputs stamped with jax.ad_checkpoint.checkpoint_name on the
+# FLAGS_fused_transformer hot path — the name vocabulary that
+# jit.TrainStep's default remat_policy="save_matmul_outputs"
+# (save_only_these_names) keeps across the backward, so norms and
+# activations recompute instead of living through it
+MATMUL_CHECKPOINT_NAMES = ("llama_qkv", "llama_attn_o", "llama_swiglu",
+                           "llama_mlp_down")
+
 
 @dataclass
 class LlamaConfig:
@@ -122,10 +130,8 @@ class LlamaAttention(Layer):
         B = x.shape[0]
         nh, kvh, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
 
-        def _attend(q, k, v):
+        def _core(q, k, v):
             from ..kernels import flash_attention as fa
-            from ..kernels.rope import apply_rope
-            q, k = apply_rope(q, k, base=cfg.rope_theta)
             # GQA/MQA is native in the kernel wrapper (splash MQA mode —
             # no materialized kv repeat); dense fallback broadcasts
             if fa.supported(q.shape, k.shape, True):
@@ -136,7 +142,29 @@ class LlamaAttention(Layer):
                 v = jnp.repeat(v, rep, axis=2)
             return _sdpa(q, k, v)
 
+        def _attend(q, k, v):
+            from ..kernels.rope import apply_rope
+            q, k = apply_rope(q, k, base=cfg.rope_theta)
+            return _core(q, k, v)
+
         if cfg.fuse_attention_qkv:
+            if core.get_bool_flag("FLAGS_fused_transformer", True):
+                # fused QKV+RoPE prologue: one wide projection, rope on
+                # the q/k slices in-register (kernels/rope.py), matmul
+                # outputs stamped for the save_only_these_names remat
+                # policy (jit.TrainStep remat_policy=)
+                def attn(a, wqkv, wo):
+                    from jax.ad_checkpoint import checkpoint_name
+                    from ..kernels.rope import fused_qkv_rope
+                    q, k, v = fused_qkv_rope(a, wqkv, nh, kvh, d,
+                                             base=cfg.rope_theta)
+                    o = _core(q, k, v)
+                    return checkpoint_name(
+                        o.reshape(B, -1, nh * d) @ wo, "llama_attn_o")
+
+                return apply_op(attn, to_tensor_like(x), self.qkv_proj,
+                                self.o_proj, name="llama_attn_fused")
+
             def attn(a, wqkv, wo):
                 qkv = a @ wqkv
                 q = qkv[..., : nh * d].reshape(B, -1, nh, d)
@@ -190,6 +218,19 @@ class LlamaMLP(Layer):
     def forward(self, x):
         m = self._m
         if self._fused:
+            if core.get_bool_flag("FLAGS_fused_transformer", True):
+                # blockwise Pallas SwiGLU: the [T, 2M] gate/up tensor
+                # never hits HBM (kernels/swiglu.py); outputs stamped
+                # for the save_only_these_names remat policy
+                def mlp(a, wgu, wd):
+                    from jax.ad_checkpoint import checkpoint_name
+                    from ..kernels.swiglu import swiglu
+                    o = checkpoint_name(swiglu(a, wgu), "llama_swiglu")
+                    return checkpoint_name(o @ wd, "llama_mlp_down")
+
+                return apply_op(mlp, to_tensor_like(x), self.gate_up_proj,
+                                self.down_proj, name="llama_mlp_fused")
+
             def mlp(a, wgu, wd):
                 gu = a @ wgu
                 return (jax.nn.silu(gu[..., :m]) * gu[..., m:]) @ wd
@@ -214,6 +255,20 @@ class LlamaDecoderLayer(Layer):
         self.sequence_parallel = cfg.sequence_parallel
 
     def forward(self, x, position_ids=None):
+        if core.get_bool_flag("FLAGS_fused_transformer", True) and \
+                not self.sequence_parallel:
+            # fused hot path: the residual add + post-attention RMSNorm
+            # collapse into one Pallas pass that emits BOTH the summed
+            # stream h and the normalized a2 (kernels/fused_norm_residual)
+            from ..kernels.fused_norm_residual import fused_add_rms_norm
+            attn_out = self.self_attn(self.input_layernorm(x), position_ids)
+            eps = self.post_attention_layernorm.eps
+            a2, h = apply_op(
+                lambda r, dlt, w: fused_add_rms_norm(r, dlt, w, eps),
+                to_tensor_like(x), attn_out,
+                self.post_attention_layernorm.weight,
+                n_outputs=2, name="fused_add_rms_norm")
+            return h + self.mlp(a2)
         if self.sequence_parallel:
             from ..distributed.fleet.utils.sequence_parallel_utils import \
                 scatter
@@ -276,7 +331,11 @@ def _scan_stack(layers, x, use_remat=True):
             with _swap_param_data(objs, pl):
                 return _call_pure(template, h), None
 
-        b = jax.checkpoint(body) if use_remat else body
+        # policy=None is jax.checkpoint's own default (save nothing);
+        # TrainStep(remat_policy=) arms save_only_these_names over the
+        # checkpoint_name-stamped matmul outputs via the core context
+        b = jax.checkpoint(body, policy=core.current_remat_policy()) \
+            if use_remat else body
         h, _ = jax.lax.scan(b, a, tuple(stacks))
         return h
 
@@ -294,7 +353,7 @@ def _recompute_stack(layers, x, position_ids):
             with _swap_param_data(_params, ws):
                 return _call_pure(_lyr, a)
 
-        ckpt = jax.checkpoint(run)
+        ckpt = jax.checkpoint(run, policy=core.current_remat_policy())
         x = apply_op(ckpt, x, *params, name="decoder_layer_ckpt")
     return x
 
@@ -518,6 +577,22 @@ def _gather_layer_weights(state, cfg):
            ["input_layernorm.weight", "post_attention_layernorm.weight",
             "self_attn.o_proj", "mlp.down_proj"]}
     nh, kvh, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    if core.get_bool_flag("FLAGS_fused_transformer", True):
+        # keep (or build) the WIDE projections: the serving blocks run
+        # one qkv matmul + fused_qkv_rope and the swiglu kernel instead
+        # of splitting into narrow per-projection matmuls
+        if cfg.fuse_attention_qkv:
+            out["self_attn.qkv_proj"] = stack("self_attn.qkv_proj")
+        else:
+            out["self_attn.qkv_proj"] = jnp.concatenate(
+                [stack("self_attn.q_proj"), stack("self_attn.k_proj"),
+                 stack("self_attn.v_proj")], axis=-1)
+        if cfg.fuse_mlp:
+            out["mlp.gate_up_proj"] = stack("mlp.gate_up_proj")
+        else:
+            out["mlp.gate_up_proj"] = jnp.concatenate(
+                [stack("mlp.gate_proj"), stack("mlp.up_proj")], axis=-1)
+        return out
     if cfg.fuse_attention_qkv:
         qkv = stack("self_attn.qkv_proj")
         out["self_attn.q_proj"] = qkv[..., : nh * d]
@@ -539,9 +614,26 @@ def _gather_layer_weights(state, cfg):
 
 
 def _rms(x, w, eps):
+    """RMSNorm for the serving cache paths — routed through
+    kernels/rms_norm.py (Pallas on TPU; its jnp fallback is bitwise the
+    inline expression this used to carry). FLAGS_fused_transformer=0
+    keeps the historical inline jnp, bitwise."""
+    if core.get_bool_flag("FLAGS_fused_transformer", True):
+        from ..kernels.rms_norm import rms_norm
+        return rms_norm(x, w, eps)
     xf = x.astype(jnp.float32)
     out = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
     return (out * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _serving_mlp(a2, wl):
+    """SwiGLU for the serving blocks: the Pallas kernel over the wide
+    gate_up layout when FLAGS_fused_transformer built `wl` that way,
+    else the historical unfused expression (bitwise)."""
+    if "mlp.gate_up_proj" in wl:
+        from ..kernels.swiglu import swiglu
+        return swiglu(a2, wl["mlp.gate_up_proj"])
+    return jax.nn.silu(a2 @ wl["mlp.gate_proj"]) * (a2 @ wl["mlp.up_proj"])
 
 
 def _block_with_cache(cfg, h, wl, ck, cv, pos_ids, cache_mask):
@@ -557,12 +649,18 @@ def _block_with_cache(cfg, h, wl, ck, cv, pos_ids, cache_mask):
     B, T = h.shape[0], h.shape[1]
     nh, kvh, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
     a = _rms(h, wl["input_layernorm.weight"], cfg.rms_norm_eps)
-    q = (a @ wl["self_attn.q_proj"]).reshape(B, T, nh, d)
-    k = (a @ wl["self_attn.k_proj"]).reshape(B, T, kvh, d)
-    v = (a @ wl["self_attn.v_proj"]).reshape(B, T, kvh, d)
     max_pos = max(cfg.max_position_embeddings, ck.shape[1])
-    q, k = apply_rope(q, k, position_ids=pos_ids, base=cfg.rope_theta,
-                      seq_len=max_pos)
+    if "self_attn.qkv_proj" in wl:     # FLAGS_fused_transformer layout
+        from ..kernels.rope import fused_qkv_rope
+        q, k, v = fused_qkv_rope(a, wl["self_attn.qkv_proj"], nh, kvh, d,
+                                 position_ids=pos_ids, base=cfg.rope_theta,
+                                 seq_len=max_pos)
+    else:
+        q = (a @ wl["self_attn.q_proj"]).reshape(B, T, nh, d)
+        k = (a @ wl["self_attn.k_proj"]).reshape(B, T, kvh, d)
+        v = (a @ wl["self_attn.v_proj"]).reshape(B, T, kvh, d)
+        q, k = apply_rope(q, k, position_ids=pos_ids, base=cfg.rope_theta,
+                          seq_len=max_pos)
     # write new keys/values into the cache at their absolute positions
     oh = jax.nn.one_hot(pos_ids, ck.shape[1], dtype=ck.dtype)  # [B,T,S_max]
     ck = ck * (1 - oh.sum(1)[:, :, None, None]) + jnp.einsum(
@@ -596,7 +694,7 @@ def _block_with_cache(cfg, h, wl, ck, cv, pos_ids, cache_mask):
         o = o.astype(h.dtype).reshape(B, T, nh * d)
     h = h + o @ wl["self_attn.o_proj"]
     a2 = _rms(h, wl["post_attention_layernorm.weight"], cfg.rms_norm_eps)
-    up = jax.nn.silu(a2 @ wl["mlp.gate_proj"]) * (a2 @ wl["mlp.up_proj"])
+    up = _serving_mlp(a2, wl)
     return h + up @ wl["mlp.down_proj"], ck, cv
 
 
@@ -653,13 +751,19 @@ def _block_paged(cfg, h, wl, kp, vp, pos_ids, pg, off, page_table, lens):
     B = h.shape[0]
     nh, kvh, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
     a = _rms(h, wl["input_layernorm.weight"], cfg.rms_norm_eps)
-    q = (a @ wl["self_attn.q_proj"]).reshape(B, 1, nh, d)
-    k = (a @ wl["self_attn.k_proj"]).reshape(B, 1, kvh, d)
-    v = (a @ wl["self_attn.v_proj"]).reshape(B, 1, kvh, d)
     max_pos = max(cfg.max_position_embeddings,
                   page_table.shape[1] * kp.shape[2])
-    q, k = apply_rope(q, k, position_ids=pos_ids, base=cfg.rope_theta,
-                      seq_len=max_pos)
+    if "self_attn.qkv_proj" in wl:     # FLAGS_fused_transformer layout
+        from ..kernels.rope import fused_qkv_rope
+        q, k, v = fused_qkv_rope(a, wl["self_attn.qkv_proj"], nh, kvh, d,
+                                 position_ids=pos_ids, base=cfg.rope_theta,
+                                 seq_len=max_pos)
+    else:
+        q = (a @ wl["self_attn.q_proj"]).reshape(B, 1, nh, d)
+        k = (a @ wl["self_attn.k_proj"]).reshape(B, 1, kvh, d)
+        v = (a @ wl["self_attn.v_proj"]).reshape(B, 1, kvh, d)
+        q, k = apply_rope(q, k, position_ids=pos_ids, base=cfg.rope_theta,
+                          seq_len=max_pos)
     # scatter this token's k/v into page (pg[b], off[b]) — a B-element
     # scatter, not a cache rewrite
     kp = kp.at[:, pg, off].set(jnp.moveaxis(k[:, 0], 1, 0).astype(kp.dtype))
@@ -670,7 +774,7 @@ def _block_paged(cfg, h, wl, kp, vp, pos_ids, pg, off, page_table, lens):
     o = o.astype(h.dtype).reshape(B, 1, nh * d)
     h = h + o @ wl["self_attn.o_proj"]
     a2 = _rms(h, wl["post_attention_layernorm.weight"], cfg.rms_norm_eps)
-    up = jax.nn.silu(a2 @ wl["mlp.gate_proj"]) * (a2 @ wl["mlp.up_proj"])
+    up = _serving_mlp(a2, wl)
     return h + up @ wl["mlp.down_proj"], kp, vp
 
 
@@ -735,14 +839,20 @@ def _block_ragged(cfg, h, wl, kp, vp, pos, page_ids, offs, page_table,
     T = h.shape[0]
     nh, kvh, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
     a = _rms(h, wl["input_layernorm.weight"], cfg.rms_norm_eps)
-    q = (a @ wl["self_attn.q_proj"]).reshape(T, nh, d)
-    k = (a @ wl["self_attn.k_proj"]).reshape(T, kvh, d)
-    v = (a @ wl["self_attn.v_proj"]).reshape(T, kvh, d)
     max_pos = max(cfg.max_position_embeddings,
                   page_table.shape[1] * kp.shape[2])
-    q4, k4 = apply_rope(q[None], k[None], position_ids=pos[None],
-                        base=cfg.rope_theta, seq_len=max_pos)
-    q, k = q4[0], k4[0]
+    if "self_attn.qkv_proj" in wl:     # FLAGS_fused_transformer layout
+        from ..kernels.rope import fused_qkv_rope
+        q, k, v = fused_qkv_rope(a, wl["self_attn.qkv_proj"], nh, kvh, d,
+                                 position_ids=pos, base=cfg.rope_theta,
+                                 seq_len=max_pos)
+    else:
+        q = (a @ wl["self_attn.q_proj"]).reshape(T, nh, d)
+        k = (a @ wl["self_attn.k_proj"]).reshape(T, kvh, d)
+        v = (a @ wl["self_attn.v_proj"]).reshape(T, kvh, d)
+        q4, k4 = apply_rope(q[None], k[None], position_ids=pos[None],
+                            base=cfg.rope_theta, seq_len=max_pos)
+        q, k = q4[0], k4[0]
     # ONE T-row page scatter per layer (prefill chunks and decode tokens
     # alike); duplicate scratch-page writes from padding rows are benign
     kp = kp.at[:, page_ids, offs].set(jnp.moveaxis(k, 1, 0).astype(kp.dtype))
@@ -751,7 +861,7 @@ def _block_ragged(cfg, h, wl, kp, vp, pos, page_ids, offs, page_table,
                                page_table, scale=1.0 / math.sqrt(d))
     h = h + o.astype(h.dtype).reshape(T, nh * d) @ wl["self_attn.o_proj"]
     a2 = _rms(h, wl["post_attention_layernorm.weight"], cfg.rms_norm_eps)
-    up = jax.nn.silu(a2 @ wl["mlp.gate_proj"]) * (a2 @ wl["mlp.up_proj"])
+    up = _serving_mlp(a2, wl)
     return h + up @ wl["mlp.down_proj"], kp, vp
 
 

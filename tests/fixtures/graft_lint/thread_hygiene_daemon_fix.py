@@ -1,0 +1,48 @@
+"""Positive fixture for the --fix daemon= insertion (parsed, never
+imported). `_child_spawner` only ever runs as the target= of a thread
+constructed with an explicit daemon=True, so the daemon-ness its own
+child threads inherit is statically known — mechanically fixable.
+`_orphan_spawner` is not a thread target anywhere (unknown creator) and
+`_conflicted` is targeted by creators that disagree (daemon=True AND
+daemon=False) — both stay human judgement calls, no fix attached."""
+import threading
+
+
+def _tick():
+    pass
+
+
+def _child_spawner():
+    t = threading.Thread(target=_tick, name="paddle-ticker")
+    t.start()
+    t.join()
+
+
+def _orphan_spawner():
+    t = threading.Thread(target=_tick, name="paddle-ticker2")
+    t.start()
+    t.join()
+
+
+def _conflicted():
+    t = threading.Thread(target=_tick, name="paddle-ticker3")
+    t.start()
+    t.join()
+
+
+def boot():
+    s = threading.Thread(target=_child_spawner, daemon=True,
+                         name="paddle-spawner")
+    s.start()
+    s.join()
+
+
+def boot_mixed():
+    a = threading.Thread(target=_conflicted, daemon=True,
+                         name="paddle-mixed-a")
+    b = threading.Thread(target=_conflicted, daemon=False,
+                         name="paddle-mixed-b")
+    a.start()
+    b.start()
+    a.join()
+    b.join()

@@ -1,0 +1,410 @@
+"""Fused transformer hot path (FLAGS_fused_transformer; ISSUE 20):
+fused residual+RMSNorm and SwiGLU Pallas kernels, fused QKV+RoPE
+prologue, remat-policy knob and the donation audit.
+
+Kernel tests mirror tests/test_ragged_attention.py's split: fallback
+parity (the jnp route IS the unfused math, bitwise), interpret-mode
+Pallas parity (fwd + grads vs that same fallback), explicit
+use_pallas=True raising on unaligned shapes instead of silently timing
+the fallback, and the autotune key being consulted. The grad harness is
+shared between the new kernels and the pre-existing rms_norm custom_vjp
+(satellite: bwd vs jnp autodiff at fp32 AND bf16).
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu.framework import core
+from paddle_tpu.kernels import fused_norm_residual as fnr
+from paddle_tpu.kernels import rope
+from paddle_tpu.kernels import swiglu as sg
+from paddle_tpu.kernels.rms_norm import rms_norm
+from paddle_tpu.models import llama
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+
+@pytest.fixture
+def fused_flag():
+    """Restore FLAGS_fused_transformer after tests that flip it."""
+    prior = core.get_bool_flag("FLAGS_fused_transformer", True)
+    yield
+    paddle.set_flags({"FLAGS_fused_transformer": prior})
+
+
+# ---------------------------------------------------------------- harness
+
+def _weighted_sum(out):
+    """Scalar loss over one-or-tuple outputs; distinct weights per
+    output so swapped/aliased outputs can't cancel in the grad check."""
+    if not isinstance(out, tuple):
+        out = (out,)
+    return sum((i + 2.0) * jnp.sum(o.astype(jnp.float32) ** 2)
+               for i, o in enumerate(out))
+
+
+def _check_grads(fn, ref, args, rtol, atol):
+    """jax.grad of fn vs ref w.r.t. every arg — the shared harness for
+    rms_norm and both new kernels (custom_vjp bwd vs jnp autodiff, or
+    Pallas bwd vs fallback bwd)."""
+    argnums = tuple(range(len(args)))
+    got = jax.grad(lambda *a: _weighted_sum(fn(*a)), argnums)(*args)
+    want = jax.grad(lambda *a: _weighted_sum(ref(*a)), argnums)(*args)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g, np.float32),
+                                   np.asarray(w, np.float32),
+                                   rtol=rtol, atol=atol)
+
+
+def _rand(shape, dtype, seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(*shape).astype(np.float32)).astype(dtype)
+
+
+# ------------------------------------------- rms_norm grad equivalence
+
+def _rms_autodiff_ref(x, w, eps=1e-6):
+    """The rms_norm fallback math WITHOUT the custom_vjp wrapper, so
+    jax.grad differentiates it with plain autodiff."""
+    x32 = x.astype(jnp.float32)
+    ms = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(ms + eps)
+            * w.astype(jnp.float32)).astype(x.dtype)
+
+
+class TestRmsNormGradEquivalence:
+    def test_fp32_bwd_matches_autodiff(self):
+        x = _rand((4, 6, 96), jnp.float32)
+        w = _rand((96,), jnp.float32, seed=1) * 0.1 + 1.0
+        np.testing.assert_allclose(np.asarray(rms_norm(x, w)),
+                                   np.asarray(_rms_autodiff_ref(x, w)),
+                                   rtol=0, atol=0)
+        _check_grads(rms_norm, _rms_autodiff_ref, (x, w),
+                     rtol=1e-5, atol=1e-4)
+
+    def test_bf16_bwd_matches_autodiff(self):
+        x = _rand((4, 6, 96), jnp.bfloat16)
+        w = (_rand((96,), jnp.float32, seed=1) * 0.1 + 1.0
+             ).astype(jnp.bfloat16)
+        # the analytic bwd and autodiff round to bf16 at different
+        # points; agreement is to bf16 resolution, not bitwise
+        _check_grads(rms_norm, _rms_autodiff_ref, (x, w),
+                     rtol=0.06, atol=0.3)
+
+
+# ------------------------------------------- fused residual + RMSNorm
+
+def _fnr_unfused_ref(x, r, w, eps=1e-6):
+    """The unfused two-op sequence the kill switch runs: residual add
+    (rounded to the stream dtype) then rms_norm — the parity target."""
+    h = (x.astype(jnp.float32) + r.astype(jnp.float32)).astype(x.dtype)
+    return _rms_autodiff_ref(h, w, eps), h
+
+
+class TestFusedNormResidual:
+    def test_fallback_matches_unfused_sequence_bitwise(self):
+        for dtype in (jnp.float32, jnp.bfloat16):
+            x = _rand((2, 8, 256), dtype)
+            r = _rand((2, 8, 256), dtype, seed=1)
+            w = _rand((256,), dtype, seed=2) * 0.1 + 1.0
+            y, h = fnr.fused_add_rms_norm(x, r, w, use_pallas=False)
+            yr, hr = _fnr_unfused_ref(x, r, w)
+            assert np.array_equal(np.asarray(h, np.float32),
+                                  np.asarray(hr, np.float32))
+            assert np.array_equal(np.asarray(y, np.float32),
+                                  np.asarray(yr, np.float32))
+
+    def test_interpret_parity_fwd(self):
+        x = _rand((4, 8, 256), jnp.float32)
+        r = _rand((4, 8, 256), jnp.float32, seed=1)
+        w = _rand((256,), jnp.float32, seed=2) * 0.1 + 1.0
+        y, h = fnr.fused_add_rms_norm(x, r, w, use_pallas=True)
+        yr, hr = _fnr_unfused_ref(x, r, w)
+        np.testing.assert_allclose(np.asarray(h), np.asarray(hr),
+                                   rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_interpret_parity_grads(self):
+        x = _rand((2, 8, 256), jnp.float32)
+        r = _rand((2, 8, 256), jnp.float32, seed=1)
+        w = _rand((256,), jnp.float32, seed=2) * 0.1 + 1.0
+        _check_grads(
+            lambda *a: fnr.fused_add_rms_norm(*a, use_pallas=True),
+            lambda *a: fnr.fused_add_rms_norm(*a, use_pallas=False),
+            (x, r, w), rtol=1e-5, atol=1e-4)
+
+    def test_fallback_grads_match_unfused_autodiff(self):
+        """The custom bwd vs plain autodiff of the unfused sequence —
+        the tape FLAGS_fused_transformer=0 would build."""
+        for dtype, rtol, atol in ((jnp.float32, 1e-5, 1e-4),
+                                  (jnp.bfloat16, 0.06, 0.5)):
+            x = _rand((2, 8, 256), dtype)
+            r = _rand((2, 8, 256), dtype, seed=1)
+            w = _rand((256,), dtype, seed=2) * 0.1 + 1.0
+            _check_grads(
+                lambda *a: fnr.fused_add_rms_norm(*a, use_pallas=False),
+                _fnr_unfused_ref, (x, r, w), rtol=rtol, atol=atol)
+
+    def test_explicit_use_pallas_rejects_unaligned(self):
+        x = _rand((2, 4, 200), jnp.float32)
+        with pytest.raises(ValueError, match="Mosaic-aligned"):
+            fnr.fused_add_rms_norm(x, x, jnp.ones((200,)),
+                                   use_pallas=True)
+
+    def test_force_pallas_hook_dispatches_interpreter(self, monkeypatch):
+        called = []
+        real = fnr._fwd_kernel
+
+        def spy(*a, **k):
+            called.append(1)
+            return real(*a, **k)
+
+        monkeypatch.setattr(fnr, "_fwd_kernel", spy)
+        monkeypatch.setattr(fnr, "_FORCE_PALLAS", True)
+        x = _rand((2, 4, 256), jnp.float32)
+        fnr.fused_add_rms_norm(x, x, jnp.ones((256,)))
+        assert called, "_FORCE_PALLAS must route auto dispatch to Pallas"
+
+    def test_block_rows_consults_autotune(self, monkeypatch):
+        from paddle_tpu.kernels import autotune
+        key = autotune.cache_key("fused_norm", H=fnr._size_class(256))
+        monkeypatch.setattr(autotune, "lookup",
+                            lambda k: [64] if k == key else None)
+        assert fnr._block_rows(512, 256) == 64
+        # default chain: 256 rows, shrunk to a divisor
+        monkeypatch.setattr(autotune, "lookup", lambda k: None)
+        assert fnr._block_rows(512, 256) == 256
+        assert 512 % fnr._block_rows(512, 256, block_rows=100) == 0
+
+
+# --------------------------------------------------------------- swiglu
+
+class TestSwiGLU:
+    def test_fallback_is_exact_unfused_expression(self):
+        for dtype in (jnp.float32, jnp.bfloat16):
+            a = _rand((3, 8, 256), dtype)
+            w = _rand((256, 512), dtype, seed=1) * 0.05
+            got = sg.swiglu(a, w, use_pallas=False)
+            gu = a @ w
+            want = jax.nn.silu(gu[..., :256]) * gu[..., 256:]
+            assert np.array_equal(np.asarray(got, np.float32),
+                                  np.asarray(want, np.float32))
+
+    def test_interpret_parity_fwd(self):
+        a = _rand((64, 256), jnp.float32)
+        w = _rand((256, 512), jnp.float32, seed=1) * 0.05
+        got = sg.swiglu(a, w, use_pallas=True)
+        want = sg.swiglu(a, w, use_pallas=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_interpret_parity_grads(self):
+        a = _rand((32, 256), jnp.float32)
+        w = _rand((256, 512), jnp.float32, seed=1) * 0.05
+        _check_grads(lambda *x: sg.swiglu(*x, use_pallas=True),
+                     lambda *x: sg.swiglu(*x, use_pallas=False),
+                     (a, w), rtol=1e-4, atol=1e-4)
+
+    def test_blocks_override_changes_blocking_not_results(self):
+        a = _rand((64, 256), jnp.float32)
+        w = _rand((256, 512), jnp.float32, seed=1) * 0.05
+        base = np.asarray(sg.swiglu(a, w, use_pallas=True))
+        for blocks in ((16, 64), (32, 128)):
+            out = np.asarray(sg.swiglu(a, w, use_pallas=True,
+                                       blocks=blocks))
+            np.testing.assert_allclose(out, base, rtol=1e-5, atol=1e-5)
+
+    def test_explicit_use_pallas_rejects_unaligned(self):
+        a = _rand((8, 256), jnp.float32)
+        with pytest.raises(ValueError, match="Mosaic-aligned"):
+            sg.swiglu(a, _rand((256, 200), jnp.float32), use_pallas=True)
+
+    def test_force_pallas_hook_dispatches_interpreter(self, monkeypatch):
+        called = []
+        real = sg._fwd_kernel
+
+        def spy(*a, **k):
+            called.append(1)
+            return real(*a, **k)
+
+        monkeypatch.setattr(sg, "_fwd_kernel", spy)
+        monkeypatch.setattr(sg, "_FORCE_PALLAS", True)
+        sg.swiglu(_rand((8, 256), jnp.float32),
+                  _rand((256, 512), jnp.float32, seed=1))
+        assert called, "_FORCE_PALLAS must route auto dispatch to Pallas"
+
+    def test_blocks_consult_autotune(self, monkeypatch):
+        from paddle_tpu.kernels import autotune
+        key = autotune.cache_key("swiglu", M=sg._size_class(256))
+        monkeypatch.setattr(autotune, "lookup",
+                            lambda k: [64, 128] if k == key else None)
+        assert sg._blocks(512, 256) == (64, 128)
+        # default chain: (256, 512) shrunk to divisors of (T, M)
+        monkeypatch.setattr(autotune, "lookup", lambda k: None)
+        assert sg._blocks(512, 256) == (256, 256)
+
+    def test_supported_gates(self):
+        assert sg.supported((8, 256), (256, 512))
+        assert not sg.supported((8, 256), (256, 400))   # M % 128
+        assert not sg.supported((8, 200), (200, 512))   # H % 128
+        assert not sg.supported((8, 128), (256, 512))   # a[-1] != H
+
+
+# ------------------------------------------------- fused QKV + RoPE
+
+class TestFusedQKVRope:
+    def _manual(self, a, w, nh, kvh, d, position_ids=None, seq_len=None):
+        qkv = a @ w
+        lead = qkv.shape[:-1]
+        q = qkv[..., :nh * d].reshape(*lead, nh, d)
+        k = qkv[..., nh * d:(nh + kvh) * d].reshape(*lead, kvh, d)
+        v = qkv[..., (nh + kvh) * d:].reshape(*lead, kvh, d)
+        q, k = rope.apply_rope(q, k, position_ids=position_ids,
+                               seq_len=seq_len)
+        return q, k, v
+
+    @pytest.mark.parametrize("nh,kvh", [(4, 4), (8, 2)])
+    def test_batch_parity_incl_gqa(self, nh, kvh):
+        d = 8
+        a = _rand((2, 6, 64), jnp.float32)
+        w = _rand((64, (nh + 2 * kvh) * d), jnp.float32, seed=1) * 0.1
+        got = rope.fused_qkv_rope(a, w, nh, kvh, d)
+        want = self._manual(a, w, nh, kvh, d)
+        for g, t in zip(got, want):
+            assert np.array_equal(np.asarray(g), np.asarray(t))
+
+    def test_packed_rows_with_positions(self):
+        nh, kvh, d = 8, 2, 8
+        a = _rand((6, 64), jnp.float32)
+        w = _rand((64, (nh + 2 * kvh) * d), jnp.float32, seed=1) * 0.1
+        pos = jnp.asarray([0, 1, 2, 0, 1, 5])
+        got = rope.fused_qkv_rope(a, w, nh, kvh, d, position_ids=pos,
+                                  seq_len=16)
+        want = self._manual(a[None], w, nh, kvh, d,
+                            position_ids=pos[None], seq_len=16)
+        want = tuple(t[0] for t in want)
+        for g, t in zip(got, want):
+            assert g.shape == t.shape
+            assert np.array_equal(np.asarray(g), np.asarray(t))
+
+
+# ----------------------------------------- model-level flag parity
+
+def _tiny_model(seed=0):
+    paddle.seed(seed)
+    cfg = llama_tiny(dtype="float32")
+    return LlamaForCausalLM(cfg)
+
+
+def _loss_and_grads(flag):
+    paddle.set_flags({"FLAGS_fused_transformer": flag})
+    m = _tiny_model()
+    rng = np.random.RandomState(3)
+    ids = paddle.to_tensor(rng.randint(0, 1024, (2, 16)).astype(np.int64))
+    loss = m.loss(ids, ids)
+    loss.backward()
+    grads = {k: np.asarray(p.grad.data)
+             for k, p in m.state_dict().items()
+             if getattr(p, "grad", None) is not None}
+    return float(loss.numpy()), grads
+
+
+class TestModelFlagParity:
+    def test_train_tape_bitwise_on_cpu(self, fused_flag):
+        """Fused path vs FLAGS_fused_transformer=0 — on CPU every fused
+        route falls back to jnp mirrors of the unfused math, so loss
+        AND all grads are bitwise."""
+        loss_on, g_on = _loss_and_grads(True)
+        loss_off, g_off = _loss_and_grads(False)
+        assert loss_on == loss_off
+        assert g_on.keys() == g_off.keys() and g_on
+        for k in g_on:
+            assert np.array_equal(g_on[k], g_off[k]), k
+
+    def test_greedy_serving_tokens_identical(self, fused_flag):
+        rng = np.random.RandomState(5)
+        prompt = rng.randint(0, 1024, (2, 8)).astype(np.int64)
+        toks = {}
+        for flag in (True, False):
+            paddle.set_flags({"FLAGS_fused_transformer": flag})
+            m = _tiny_model()
+            toks[flag] = np.asarray(
+                m.generate(paddle.to_tensor(prompt),
+                           max_new_tokens=6).data)
+        assert np.array_equal(toks[True], toks[False])
+
+    def test_rms_dedupe_routes_through_kernel(self, fused_flag,
+                                              monkeypatch):
+        """Satellite (a): llama's serving _rms is the kernels/rms_norm
+        implementation when the flag is on."""
+        from paddle_tpu.kernels import rms_norm as rn
+        calls = []
+        real = rn.rms_norm
+
+        def spy(x, w, eps=1e-6):
+            calls.append(1)
+            return real(x, w, eps)
+
+        monkeypatch.setattr(rn, "rms_norm", spy)
+        x = _rand((4, 256), jnp.float32)
+        w = jnp.ones((256,), jnp.float32)
+        paddle.set_flags({"FLAGS_fused_transformer": True})
+        on = np.asarray(llama._rms(x, w, 1e-6))
+        assert calls
+        paddle.set_flags({"FLAGS_fused_transformer": False})
+        off = np.asarray(llama._rms(x, w, 1e-6))
+        assert np.array_equal(on, off)
+
+
+# ------------------------------- remat-policy knob + donation audit
+
+class TestRematPolicyAndDonation:
+    def test_resolve_remat_policy(self):
+        resolve = paddle.jit.resolve_remat_policy
+        assert resolve(None) is None
+        assert callable(resolve("save_matmul_outputs"))
+        assert callable(resolve("nothing"))
+        assert callable(resolve("dots"))
+        sentinel = lambda *a, **k: True  # noqa: E731
+        assert resolve(sentinel) is sentinel
+        with pytest.raises(ValueError, match="remat_policy"):
+            resolve("save_everything_twice")
+
+    def test_policies_bitwise_and_donation_clean(self, fused_flag):
+        """Remat policies move memory, not values: identical losses.
+        Donation audit: the old param buffers are actually consumed
+        (donated) and XLA emits no donation-ignored warnings."""
+        paddle.set_flags({"FLAGS_fused_transformer": True})
+        rng = np.random.RandomState(11)
+        ids = paddle.to_tensor(
+            rng.randint(0, 1024, (2, 16)).astype(np.int64))
+        losses = {}
+        for policy in ("save_matmul_outputs", "nothing"):
+            m = _tiny_model()
+            o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+            ts = paddle.jit.TrainStep(m, o, lambda i, l: m.loss(i, l),
+                                      remat_policy=policy)
+            with warnings.catch_warnings(record=True) as rec:
+                warnings.simplefilter("always")
+                first = m.state_dict()
+                old = {k: t.data for k, t in first.items()}
+                run = [float(ts(ids, ids).numpy()) for _ in range(3)]
+            losses[policy] = run
+            donation_msgs = [str(w.message) for w in rec
+                             if "donat" in str(w.message).lower()]
+            assert not donation_msgs, donation_msgs
+            deleted = [old[k].is_deleted() for k in old]
+            assert any(deleted), \
+                "no param buffer was donated into the compiled step"
+        assert losses["save_matmul_outputs"] == losses["nothing"]
+
+    def test_checkpoint_name_stamps_exist(self):
+        assert llama.MATMUL_CHECKPOINT_NAMES == (
+            "llama_qkv", "llama_attn_o", "llama_swiglu",
+            "llama_mlp_down")

@@ -396,6 +396,35 @@ def test_fix_applies_and_resolves_findings(tmp_path):
         (tmp_path / "thread_hygiene_bad.py").read_text()
 
 
+def test_fix_inserts_daemon_when_statically_known(tmp_path):
+    """--fix writes daemon=K only where the CREATING thread's
+    daemon-ness is statically known: the enclosing function is a
+    target= of threads unanimously constructed with constant daemon=K.
+    Unknown creators and conflicting creators keep findings un-fixed."""
+    import shutil
+    from tools.graft_lint.core import run
+    from tools.graft_lint.passes.thread_hygiene import ThreadHygienePass
+    dst = tmp_path / "thread_hygiene_daemon_fix.py"
+    shutil.copy(FIXTURES / "thread_hygiene_daemon_fix.py", dst)
+
+    res = _run([ThreadHygienePass()], paths=[dst])
+    msgs = [f.message for f in res.active]
+    assert len(msgs) == 3, "\n".join(msgs)
+    assert all("explicit daemon=" in m for m in msgs)
+    assert sum(1 for f in res.active if f.fix) == 1
+
+    out = tmp_path / "out.txt"
+    rc = run(pass_names=["thread-hygiene"], paths=[str(dst)],
+             fix=True, out=open(out, "w"))
+    assert rc == 0
+    assert "1 fix(es) applied" in out.read_text()
+    assert 'target=_tick, name="paddle-ticker", daemon=True)' in \
+        dst.read_text()
+    after = _run([ThreadHygienePass()], paths=[dst])
+    assert sum("explicit daemon=" in f.message
+               for f in after.active) == 2
+
+
 def test_fix_skips_stale_lines(tmp_path):
     """A fix whose recorded line drifted (file edited between collect
     and apply) is skipped, never misapplied."""

@@ -12,6 +12,12 @@ Three checks over every `threading.Thread(...)` in the package:
   thread from main and a silently-killable one from a worker. Say
   which one you mean — `daemon=True` (killable at exit) or
   `daemon=False` (owns process lifetime, needs a join path).
+  Mechanically fixable when the creating thread's daemon-ness is
+  statically known: the enclosing function is itself a `target=` of
+  Thread constructions that all carry the same constant `daemon=K`,
+  so the inherited value IS K and `--fix` writes it out. Anything
+  less certain (module scope, conflicting creators, non-constant
+  daemon=) stays a human judgement call.
 - **bare `except:` in a thread target**: a bare except in a run loop
   swallows SystemExit/KeyboardInterrupt and turns an interpreter
   shutdown into a wedged thread; catch `Exception`.
@@ -64,6 +70,35 @@ def _target_label(node: ast.Call) -> Optional[str]:
     return None
 
 
+def _target_daemons(tree: ast.Module) -> dict:
+    """target-name -> set of daemon values over every Thread
+    construction naming it: True/False for a constant `daemon=`, None
+    for absent or non-constant (the creator's own daemon-ness is then
+    unknown). A {True} or {False} singleton means every thread running
+    that function has statically-known daemon-ness — the value its own
+    child threads inherit."""
+    out: dict = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_thread_call(node)):
+            continue
+        tname = None
+        daemon = None
+        for k in node.keywords:
+            if k.arg == "target":
+                v = k.value
+                if isinstance(v, ast.Attribute):
+                    tname = v.attr
+                elif isinstance(v, ast.Name):
+                    tname = v.id
+            elif k.arg == "daemon" and \
+                    isinstance(k.value, ast.Constant) and \
+                    isinstance(k.value.value, bool):
+                daemon = k.value.value
+        if tname:
+            out.setdefault(tname, set()).add(daemon)
+    return out
+
+
 def _target_names(tree: ast.Module) -> Set[str]:
     """Simple names of every callable passed as target= in the module —
     these functions run on a thread's schedule."""
@@ -92,15 +127,16 @@ class ThreadHygienePass(LintPass):
     def check_file(self, ctx: FileContext):
         out: List = []
         targets = _target_names(ctx.tree)
+        daemons = _target_daemons(ctx.tree)
 
         for fn in _all_functions(ctx.tree):
-            self._check_constructions(ctx, fn, out)
+            self._check_constructions(ctx, fn, out, daemons)
             if fn.name in targets or fn.name in ("run",):
                 self._check_bare_except(ctx, fn, out)
         return out
 
     # -- construction checks -------------------------------------------
-    def _check_constructions(self, ctx, fn, out):
+    def _check_constructions(self, ctx, fn, out, daemons=None):
         own = list(_own_nodes(fn))
         # names whose .daemon / .name is set after construction, and
         # names with an ownership path (join/store/return/yield/append)
@@ -156,12 +192,20 @@ class ThreadHygienePass(LintPass):
                 out.append(fnd)
             if not _kw(node, "daemon") and \
                     (assigned is None or assigned not in daemon_set):
-                out.append(self.finding(
+                fnd = self.finding(
                     ctx, node.lineno,
                     "Thread() without an explicit daemon= choice — "
                     "daemon-ness is inherited from the CREATING thread; "
                     "say daemon=True (killable at exit) or daemon=False "
-                    "(owns process lifetime)"))
+                    "(owns process lifetime)")
+                # fixable iff the creating thread's daemon-ness is
+                # statically known: this function only ever runs as a
+                # target= of threads unanimously constructed daemon=K
+                vals = (daemons or {}).get(fn.name, set())
+                if len(vals) == 1 and isinstance(next(iter(vals)), bool):
+                    fnd.fix = _insert_kw_fix(
+                        ctx, node, f"daemon={next(iter(vals))}")
+                out.append(fnd)
             # chained threading.Thread(...).start() is never owned
             if assigned is not None and \
                     (assigned in owned or assigned in escaping):
@@ -212,12 +256,19 @@ def _name_started(name: str, own_nodes) -> bool:
 
 def _name_fix(ctx: FileContext, node: ast.Call) -> Optional[dict]:
     """Mechanical fix: insert `name="paddle-<target>"` before the
-    call's closing paren (works for multi-line constructions too — the
-    insert lands on the closing line). None when the target can't be
-    derived or the closing line doesn't look as expected."""
+    call's closing paren. None when the target can't be derived."""
     label = _target_label(node)
     if label is None:
         return None
+    return _insert_kw_fix(ctx, node, f'name="paddle-{label}"')
+
+
+def _insert_kw_fix(ctx: FileContext, node: ast.Call,
+                   kwtext: str) -> Optional[dict]:
+    """Insert `kwtext` as a trailing keyword before the call's closing
+    paren (works for multi-line constructions too — the insert lands on
+    the closing line). None when the closing line doesn't look as
+    expected."""
     end_line = getattr(node, "end_lineno", None)
     end_col = getattr(node, "end_col_offset", None)
     if end_line is None or end_col is None or \
@@ -230,7 +281,7 @@ def _name_fix(ctx: FileContext, node: ast.Call) -> Optional[dict]:
     before = old[:pos].rstrip()
     sep = "" if before.endswith("(") else \
         (" " if before.endswith(",") else ", ")
-    new = f'{old[:pos]}{sep}name="paddle-{label}"{old[pos:]}'
+    new = f"{old[:pos]}{sep}{kwtext}{old[pos:]}"
     return {"line": end_line, "old": old, "new": new}
 
 
